@@ -1,0 +1,58 @@
+"""ray_tpu: a TPU-native distributed compute and ML framework.
+
+Core surface mirrors the reference's (reference: python/ray/__init__.py):
+``init/shutdown/remote/get/put/wait/kill/cancel/get_actor`` plus placement
+groups, collectives, Train, Data, Tune, and RL subpackages.
+
+The top-level package deliberately does NOT import jax: the tasks/actors
+core is accelerator-agnostic and worker processes must start fast. JAX loads
+when you import ray_tpu.parallel / ray_tpu.ops / ray_tpu.models /
+ray_tpu.train et al.
+"""
+from ray_tpu.core.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    free,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    timeline,
+    wait,
+    wait_actor_ready,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.actor import ActorClass, ActorHandle
+from ray_tpu import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "free",
+    "wait_actor_ready",
+    "is_initialized",
+    "cluster_resources",
+    "available_resources",
+    "nodes",
+    "timeline",
+    "ObjectRef",
+    "ActorClass",
+    "ActorHandle",
+    "exceptions",
+    "__version__",
+]

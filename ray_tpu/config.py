@@ -1,0 +1,110 @@
+"""Global config table.
+
+The reference defines 217 ``RAY_CONFIG(type, name, default)`` entries
+overridable via ``RAY_<name>`` env vars (reference:
+src/ray/common/ray_config_def.h). Same pattern here: a declarative table,
+env-var override ``RAY_TPU_<NAME>``, plus per-``init`` ``_system_config``
+dict overrides.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+
+def _env(name: str, default):
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    t = type(default)
+    if t is bool:
+        return raw.lower() in ("1", "true", "yes")
+    if t in (int, float, str):
+        return t(raw)
+    return json.loads(raw)
+
+
+@dataclass
+class Config:
+    # --- object store ---
+    # Objects at or below this size are carried inline through the control
+    # plane instead of the shared-memory store (reference default 100KB:
+    # ray_config_def.h ``max_direct_call_object_size``).
+    max_inline_object_size: int = 100 * 1024
+    # Per-node shared-memory store capacity (bytes). 0 = auto (30% of RAM).
+    object_store_memory: int = 0
+    # Chunk size for node-to-node object transfer (reference 64MB chunks:
+    # object_manager.cc).
+    object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+    # Spill to disk when store is above this fraction.
+    object_spilling_threshold: float = 0.8
+    spill_directory: str = ""
+
+    # --- scheduler ---
+    # Hybrid policy: pack onto lower-index nodes until utilization crosses
+    # this threshold, then spread (reference:
+    # raylet/scheduling/policy/hybrid_scheduling_policy.h:50).
+    scheduler_spread_threshold: float = 0.5
+    # Max tasks a single lease dispatch round hands to one worker.
+    max_tasks_in_flight_per_worker: int = 10
+    worker_lease_timeout_s: float = 30.0
+
+    # --- workers ---
+    # Prestarted workers per node (reference prestarts 1/CPU:
+    # raylet/worker_pool.h:365).
+    prestart_workers: bool = True
+    worker_register_timeout_s: float = 60.0
+    idle_worker_killing_time_s: float = 300.0
+    maximum_startup_concurrency: int = 8
+
+    # --- fault tolerance ---
+    health_check_period_s: float = 1.0
+    health_check_failure_threshold: int = 5
+    task_retry_delay_s: float = 0.05
+    actor_restart_delay_s: float = 0.1
+
+    # --- control plane ---
+    raylet_heartbeat_period_s: float = 0.5
+    pubsub_batch_size: int = 1000
+    task_event_buffer_size: int = 100000
+    event_flush_period_s: float = 1.0
+
+    # --- misc ---
+    temp_dir: str = field(default_factory=lambda: os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu"))
+    log_to_driver: bool = True
+
+    def apply_overrides(self, overrides: dict[str, Any] | None):
+        if not overrides:
+            return self
+        for k, v in overrides.items():
+            if not hasattr(self, k):
+                raise ValueError(f"Unknown config key: {k}")
+            setattr(self, k, v)
+        return self
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        cfg = cls()
+        for f in fields(cls):
+            setattr(cfg, f.name, _env(f.name, getattr(cfg, f.name)))
+        return cfg
+
+    def to_dict(self) -> dict:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config.from_env()
+    return _global_config
+
+
+def set_config(cfg: Config):
+    global _global_config
+    _global_config = cfg

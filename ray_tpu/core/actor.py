@@ -1,0 +1,152 @@
+"""Actor API: ActorClass / ActorHandle / ActorMethod.
+
+Reference: python/ray/actor.py (``ActorClass._remote`` :869, method
+wrappers, ``max_restarts``/``max_task_retries`` semantics :75-171). Handles
+pickle down to the actor id and rebind on deserialization, so they can be
+passed between tasks/actors freely.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Optional
+
+from ray_tpu.core.remote_function import (
+    _DEFAULT_TASK_OPTIONS,
+    build_resource_set,
+    normalize_strategy,
+)
+from ray_tpu.core.task_spec import TaskSpec, TaskType
+from ray_tpu.utils.ids import ActorID, TaskID
+from ray_tpu.utils.serialization import serialize_function
+
+_DEFAULT_ACTOR_OPTIONS = dict(
+    num_cpus=None,  # None → 1 CPU for placement only (reference default)
+    num_tpus=0,
+    memory=0,
+    resources=None,
+    max_restarts=0,
+    max_task_retries=0,
+    max_concurrency=1,
+    name=None,
+    lifetime=None,
+    scheduling_strategy=None,
+    runtime_env=None,
+)
+
+
+class ActorClass:
+    def __init__(self, cls, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = dict(_DEFAULT_ACTOR_OPTIONS)
+        self._options.update(options or {})
+        self._blob: Optional[bytes] = None
+        self._digest: Optional[bytes] = None
+
+    def options(self, **opts) -> "ActorClass":
+        new = ActorClass(self._cls, {**self._options, **opts})
+        new._blob, new._digest = self._blob, self._digest
+        return new
+
+    def remote(self, *args, **kwargs) -> "ActorHandle":
+        from ray_tpu.core.api import _require_worker
+
+        core = _require_worker()
+        if self._blob is None:
+            self._blob = serialize_function(self._cls)
+            self._digest = hashlib.blake2b(self._blob, digest_size=16).digest()
+        opts = self._options
+        actor_id = ActorID.from_random()
+        args_blob, deps = core.build_args(args, kwargs)
+        res_opts = dict(opts)
+        if res_opts["num_cpus"] is None:
+            # Default: 1 CPU for scheduling, 0 held while alive (reference:
+            # actor.py default num_cpus semantics).
+            res_opts["num_cpus"] = 1
+        runtime_env = dict(opts.get("runtime_env") or {})
+        if opts.get("name"):
+            runtime_env["__actor_name__"] = opts["name"]
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_creation(actor_id),
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            name=f"{self._cls.__name__}.__init__",
+            func_digest=self._digest,
+            func_blob=self._blob,
+            args_blob=args_blob,
+            dependencies=deps,
+            num_returns=1,
+            resources=build_resource_set(res_opts),
+            owner_id=core.worker_id,
+            scheduling_strategy=normalize_strategy(opts.get("scheduling_strategy")),
+            max_retries=0,
+            actor_id=actor_id,
+            max_restarts=opts["max_restarts"],
+            max_task_retries=opts["max_task_retries"],
+            max_concurrency=opts["max_concurrency"],
+            runtime_env=runtime_env,
+        )
+        core.create_actor(spec)
+        return ActorHandle(actor_id, max_task_retries=opts["max_task_retries"])
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actors cannot be instantiated directly. Use {self._cls.__name__}.remote() instead."
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._max_task_retries = max_task_retries
+
+    def __getattr__(self, item: str) -> "ActorMethod":
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return ActorMethod(self, item)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._max_task_retries))
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
+
+
+class ActorMethod:
+    def __init__(self, handle: ActorHandle, name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu.core.api import _require_worker
+
+        core = _require_worker()
+        args_blob, deps = core.build_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            task_type=TaskType.ACTOR_TASK,
+            name=f"actor.{self._name}",
+            func_digest=b"\x00" * 16,
+            func_blob=None,
+            args_blob=args_blob,
+            dependencies=deps,
+            num_returns=self._num_returns,
+            resources=build_resource_set({}),
+            owner_id=core.worker_id,
+            max_retries=self._handle._max_task_retries,
+            actor_id=self._handle._actor_id,
+            actor_method_name=self._name,
+        )
+        refs = core.submit_actor_task(spec)
+        return refs[0] if self._num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(f"Actor methods cannot be called directly. Use .{self._name}.remote().")

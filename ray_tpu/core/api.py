@@ -1,0 +1,230 @@
+"""Public core API: init/remote/get/put/wait/kill/cancel/get_actor.
+
+Reference: python/ray/_private/worker.py (``ray.init`` :1240, ``get`` :2601,
+``put`` :2737, ``wait`` :2802, ``kill`` :2983, ``cancel`` :3014,
+``get_actor`` :2948).
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Optional, Sequence
+
+from ray_tpu.config import Config, get_config
+from ray_tpu.core.actor import ActorClass, ActorHandle
+from ray_tpu.core.client import CoreWorker
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import RemoteFunction
+from ray_tpu.utils import rpc
+
+_global_worker: Optional[CoreWorker] = None
+_controller_proc: Optional[subprocess.Popen] = None
+_session_dir: Optional[str] = None
+
+
+def is_initialized() -> bool:
+    return _global_worker is not None
+
+
+def _require_worker() -> CoreWorker:
+    if _global_worker is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _global_worker
+
+
+def _attach_worker(core: CoreWorker):
+    """Called by worker processes so the public API works inside tasks."""
+    global _global_worker
+    _global_worker = core
+
+
+def _detect_tpu_chips() -> int:
+    """Count local TPU chips (reference:
+    python/ray/_private/accelerators/tpu.py:98-117 — /dev/accel* and vfio)."""
+    import glob
+
+    n = len(glob.glob("/dev/accel*"))
+    if n == 0:
+        n = len(glob.glob("/dev/vfio/*")) - (1 if os.path.exists("/dev/vfio/vfio") else 0)
+        n = max(n, 0)
+    return n
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[dict] = None,
+    object_store_memory: Optional[int] = None,
+    ignore_reinit_error: bool = False,
+    _system_config: Optional[dict] = None,
+) -> dict:
+    """Start (or connect to) a cluster and connect this process as a driver."""
+    global _global_worker, _controller_proc, _session_dir
+    if _global_worker is not None:
+        if ignore_reinit_error:
+            return {"address": _global_worker.address}
+        raise RuntimeError("ray_tpu.init() called twice; use ignore_reinit_error=True")
+
+    if address is None:
+        head_resources = dict(resources or {})
+        head_resources.setdefault("CPU", num_cpus if num_cpus is not None else os.cpu_count() or 1)
+        tpus = num_tpus if num_tpus is not None else _detect_tpu_chips()
+        if tpus:
+            head_resources.setdefault("TPU", tpus)
+        cfg_overrides = dict(_system_config or {})
+        if object_store_memory:
+            cfg_overrides["object_store_memory"] = object_store_memory
+        address, _controller_proc, _session_dir = _start_controller(
+            head_resources, cfg_overrides, owned=True
+        )
+
+    loop_runner = rpc.EventLoopThread("driver-io")
+    _global_worker = CoreWorker(address, mode="driver", loop_runner=loop_runner)
+    atexit.register(shutdown)
+    return {"address": address, "session_dir": _global_worker.session_dir}
+
+
+def _start_controller(head_resources: dict, cfg_overrides: dict, owned: bool):
+    session_dir = os.path.join(
+        get_config().temp_dir, f"session_{int(time.time()*1000)}_{os.getpid()}"
+    )
+    os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+    from ray_tpu.core.node_agent import child_env
+
+    env = child_env(needs_tpu=False)
+    log = open(os.path.join(session_dir, "logs", "controller.log"), "ab")
+    cmd = [
+        sys.executable,
+        "-m",
+        "ray_tpu.core.controller",
+        "--session-dir",
+        session_dir,
+        "--resources",
+        json.dumps(head_resources),
+        "--config",
+        json.dumps(cfg_overrides),
+    ]
+    if owned:
+        cmd.append("--owned")
+    proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=subprocess.STDOUT)
+    port_file = os.path.join(session_dir, "controller_port")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if os.path.exists(port_file):
+            with open(port_file) as f:
+                content = f.read().strip()
+            if content:
+                return f"127.0.0.1:{content}", proc, session_dir
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"controller exited with {proc.returncode}; see {session_dir}/logs/controller.log"
+            )
+        time.sleep(0.02)
+    raise RuntimeError("timed out waiting for controller to start")
+
+
+def shutdown():
+    global _global_worker, _controller_proc, _session_dir
+    if _global_worker is None:
+        return
+    try:
+        if _controller_proc is not None:
+            try:
+                _global_worker._call("shutdown_cluster", timeout=5)
+            except Exception:
+                pass
+    finally:
+        _global_worker.disconnect()
+        _global_worker.loop_runner.stop()
+        _global_worker = None
+        if _controller_proc is not None:
+            try:
+                _controller_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                _controller_proc.kill()
+            _controller_proc = None
+        atexit.unregister(shutdown)
+
+
+def remote(*args, **kwargs):
+    """``@remote`` / ``@remote(num_cpus=..., num_tpus=...)`` decorator for
+    functions (→ RemoteFunction) and classes (→ ActorClass)."""
+
+    def wrap(target, options):
+        if isinstance(target, type):
+            return ActorClass(target, options)
+        return RemoteFunction(target, options)
+
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        return wrap(args[0], {})
+    if args:
+        raise TypeError("@remote only accepts keyword options")
+    return lambda target: wrap(target, kwargs)
+
+
+def get(refs, timeout: Optional[float] = None):
+    return _require_worker().get(refs, timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return _require_worker().put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1, timeout: Optional[float] = None):
+    if num_returns > len(refs):
+        raise ValueError(
+            f"num_returns ({num_returns}) cannot exceed the number of refs ({len(refs)})"
+        )
+    return _require_worker().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    _require_worker().kill_actor(actor._actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    # The return object id embeds the producing task id only server-side;
+    # look the task up by its return object.
+    core = _require_worker()
+    core._call("cancel_by_object", ref.id, force)
+
+
+def get_actor(name: str) -> ActorHandle:
+    info = _require_worker().get_actor_by_name(name)
+    if info is None:
+        raise ValueError(f"Failed to look up actor with name '{name}'")
+    spec = info["creation_spec"]
+    return ActorHandle(info["actor_id"], max_task_retries=spec.max_task_retries)
+
+
+def free(refs: Sequence[ObjectRef]):
+    _require_worker().free(refs)
+
+
+def wait_actor_ready(actor: ActorHandle, timeout: Optional[float] = None):
+    """Block until the actor finished __init__ (handy in tests)."""
+    return _require_worker().wait_actor_ready(actor._actor_id, timeout=timeout)
+
+
+def cluster_resources() -> dict:
+    return _require_worker().cluster_resources()
+
+
+def available_resources() -> dict:
+    return _require_worker().available_resources()
+
+
+def nodes() -> list:
+    return _require_worker().list_state("nodes")
+
+
+def timeline() -> list:
+    """Task state-transition events (reference: `ray timeline` CLI →
+    chrome_tracing_dump, python/ray/_private/state.py:438)."""
+    return _require_worker().list_state("events")

@@ -1,0 +1,262 @@
+"""CoreWorker: the in-process runtime embedded by drivers and workers.
+
+Reference: src/ray/core_worker/core_worker.h:295 (SubmitTask / CreateActor /
+SubmitActorTask / Get / Put / Wait) and its Cython surface
+python/ray/_raylet.pyx:3282. Blocking public methods bridge onto the
+process's asyncio loop; object payloads are read zero-copy out of the node's
+shared-memory store.
+"""
+from __future__ import annotations
+
+import itertools
+import mmap
+import os
+import threading
+from concurrent.futures import Future
+from typing import Any, List, Optional, Sequence
+
+from ray_tpu.core.object_ref import ObjectRef, _RefMarker
+from ray_tpu.core.object_store import PlasmaClient
+from ray_tpu.core.task_spec import SchedulingStrategy, TaskSpec, TaskType
+from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
+from ray_tpu.utils import rpc
+from ray_tpu.utils.ids import NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.utils.serialization import deserialize, serialize
+
+INLINE_LIMIT_FALLBACK = 100 * 1024
+
+
+def _read_shm(path: str, size: int) -> memoryview:
+    """Map an object file; the mmap stays alive as long as views into it do."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        mm = mmap.mmap(fd, size, access=mmap.ACCESS_READ)
+    finally:
+        os.close(fd)
+    return memoryview(mm)
+
+
+class CoreWorker:
+    """One per process. ``mode`` is "driver" or "worker"."""
+
+    def __init__(
+        self,
+        address: str,
+        mode: str,
+        loop_runner: rpc.EventLoopThread,
+        handler: Any = None,
+        worker_id: Optional[WorkerID] = None,
+        node_id: Optional[NodeID] = None,
+        local_shm_dir: Optional[str] = None,
+    ):
+        self.mode = mode
+        self.address = address
+        self.loop_runner = loop_runner
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.node_id = node_id
+        self._put_counter = itertools.count()
+        self._task_counter = itertools.count()
+        self._lock = threading.Lock()
+        host, port = address.rsplit(":", 1)
+        self.peer: rpc.Peer = loop_runner.run(rpc.connect(host, int(port), handler or _NullHandler()))
+        if mode == "driver":
+            info = self._call("register_driver")
+            self.node_id = NodeID.from_hex(info["head_node_id"])
+            self.local_shm_dir = info["shm_dir"]
+        else:
+            info = self._call("register_worker", self.worker_id, node_id, os.getpid())
+            self.local_shm_dir = local_shm_dir
+        self.session_dir = info["session_dir"]
+        self.config = info["config"]
+        self.inline_limit = self.config.get("max_inline_object_size", INLINE_LIMIT_FALLBACK)
+        self.plasma = PlasmaClient(self.local_shm_dir)
+
+    # ------------------------------------------------------------------
+    def _call(self, method: str, *args, timeout: Optional[float] = None, **kwargs):
+        return self.loop_runner.run(self.peer.call(method, *args, **kwargs), timeout)
+
+    def _submit(self, method: str, *args, **kwargs) -> Future:
+        return self.loop_runner.submit(self.peer.call(method, *args, **kwargs))
+
+    # ------------------------------------------------------------------
+    # Objects
+    # ------------------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put(self.worker_id, next(self._put_counter))
+        data = serialize(value)
+        self.put_serialized(oid, data)
+        return ObjectRef(oid)
+
+    def put_serialized(self, oid: ObjectID, data: bytes, is_error: bool = False):
+        if len(data) <= self.inline_limit:
+            self._call("object_put_inline", oid, data, is_error)
+        else:
+            self.plasma.put_bytes(oid, data)
+            self._call("object_put_shm", oid, len(data), self.node_id)
+
+    def get(self, refs: Sequence[ObjectRef] | ObjectRef, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list: List[ObjectRef] = [refs] if single else list(refs)
+        values = self._get_values([r.id for r in ref_list], timeout)
+        return values[0] if single else values
+
+    def get_async(self, refs: Sequence[ObjectRef]) -> Future:
+        """Future-returning get (used by ObjectRef.future())."""
+        fut: Future = Future()
+
+        def _run():
+            try:
+                fut.set_result(self._get_values([r.id for r in refs]))
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=_run, daemon=True).start()
+        return fut
+
+    def _get_values(self, oids: List[ObjectID], timeout: Optional[float] = None) -> List[Any]:
+        resp = self._call("object_get", oids, timeout)
+        if resp["timeout"]:
+            raise GetTimeoutError(f"get() timed out after {timeout}s")
+        metas = resp["metas"]
+        out = []
+        for oid in oids:
+            meta = metas[oid.hex()]
+            kind = meta[0]
+            if kind == "lost":
+                raise ObjectLostError(oid.hex(), "object lost and could not be reconstructed")
+            if kind == "inline":
+                _, data, is_error = meta
+                value = deserialize(data)
+            else:
+                _, size, node_hex, shm_dir, is_error = meta
+                value = deserialize(self._read_object(oid, size, node_hex, shm_dir))
+            if is_error:
+                raise value
+            out.append(value)
+        return out
+
+    def _read_object(self, oid: ObjectID, size: int, node_hex: str, shm_dir: str) -> memoryview:
+        path = os.path.join(shm_dir, oid.hex())
+        try:
+            return _read_shm(path, size)
+        except FileNotFoundError:
+            # Possibly spilled to disk — ask the owning node to restore it.
+            if not self._call("object_ensure_local", oid, node_hex):
+                raise ObjectLostError(oid.hex(), "object missing from store")
+            return _read_shm(path, size)
+
+    def get_raw(self, oid: ObjectID) -> tuple[Any, bool]:
+        """(value, is_error) without raising — used by arg resolution."""
+        resp = self._call("object_get", [oid], None)
+        meta = resp["metas"][oid.hex()]
+        if meta[0] == "lost":
+            return ObjectLostError(oid.hex(), "lost"), True
+        if meta[0] == "inline":
+            return deserialize(meta[1]), meta[2]
+        _, size, node_hex, shm_dir, is_error = meta
+        return deserialize(self._read_object(oid, size, node_hex, shm_dir)), is_error
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1, timeout: Optional[float] = None):
+        ready_hex = set(self._call("object_wait", [r.id for r in refs], num_returns, timeout))
+        ready, not_ready = [], []
+        for r in refs:
+            (ready if r.id.hex() in ready_hex and len(ready) < num_returns else not_ready).append(r)
+        return ready, not_ready
+
+    def free(self, refs: Sequence[ObjectRef]):
+        self._call("object_free", [r.id for r in refs])
+
+    # ------------------------------------------------------------------
+    # Tasks
+    # ------------------------------------------------------------------
+    def build_args(self, args: tuple, kwargs: dict) -> tuple[bytes, List[ObjectID]]:
+        deps: List[ObjectID] = []
+
+        def mark(v):
+            if isinstance(v, ObjectRef):
+                deps.append(v.id)
+                return _RefMarker(v.id)
+            return v
+
+        margs = tuple(mark(a) for a in args)
+        mkwargs = {k: mark(v) for k, v in kwargs.items()}
+        return serialize((margs, mkwargs)), deps
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        self._call("submit_task", spec)
+        return [ObjectRef(oid) for oid in spec.return_ids()]
+
+    def create_actor(self, spec: TaskSpec):
+        self._call("create_actor", spec)
+
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        self._call("submit_task", spec)
+        return [ObjectRef(oid) for oid in spec.return_ids()]
+
+    def next_task_id(self) -> TaskID:
+        return TaskID.from_random()
+
+    # ------------------------------------------------------------------
+    # Control
+    # ------------------------------------------------------------------
+    def kill_actor(self, actor_id, no_restart: bool):
+        self._call("kill_actor", actor_id, no_restart)
+
+    def wait_actor_ready(self, actor_id, timeout: Optional[float] = None):
+        return self._call("wait_actor_ready", actor_id, timeout=timeout)
+
+    def get_actor_by_name(self, name: str):
+        return self._call("get_actor_by_name", name)
+
+    def cancel_task(self, task_id: TaskID, force: bool):
+        self._call("cancel_task", task_id, force)
+
+    # KV
+    def kv_put(self, ns: str, key: bytes, value: bytes, overwrite: bool = True) -> bool:
+        return self._call("kv_put", ns, key, value, overwrite)
+
+    def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
+        return self._call("kv_get", ns, key)
+
+    def kv_del(self, ns: str, key: bytes) -> bool:
+        return self._call("kv_del", ns, key)
+
+    def kv_keys(self, ns: str, prefix: bytes) -> List[bytes]:
+        return self._call("kv_keys", ns, prefix)
+
+    # PGs
+    def pg_create(self, bundles, strategy: str, name: str):
+        return self._call("pg_create", bundles, strategy, name)
+
+    def pg_wait_ready(self, pg_id, timeout):
+        return self._call("pg_wait_ready", pg_id, timeout)
+
+    def pg_remove(self, pg_id):
+        return self._call("pg_remove", pg_id)
+
+    def pg_table(self):
+        return self._call("pg_table")
+
+    def pg_bundle_nodes(self, pg_id):
+        return self._call("pg_bundle_nodes", pg_id)
+
+    # Introspection
+    def cluster_resources(self):
+        return self._call("cluster_resources")
+
+    def available_resources(self):
+        return self._call("available_resources")
+
+    def list_state(self, what: str, **kwargs):
+        return self._call(f"list_{what}", **kwargs)
+
+    def disconnect(self):
+        try:
+            self.loop_runner.run(self.peer.close(), timeout=2)
+        except Exception:
+            pass
+
+
+class _NullHandler:
+    def on_disconnect(self, peer):
+        pass

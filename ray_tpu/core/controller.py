@@ -1,0 +1,1059 @@
+"""The controller: head-node control plane.
+
+One process combining what the reference splits across the GCS server
+(src/ray/gcs/gcs_server/gcs_server.h:219-297 — node/actor/PG/job/KV/pubsub
+managers), the raylet's cluster scheduler (src/ray/raylet/scheduling/
+cluster_task_manager.cc, GCS-direct mode per gcs_actor_scheduler.cc:60), and
+the object directory (src/ray/object_manager/ownership_based_object_directory.cc).
+
+Everything runs on one asyncio loop — state is mutated only from loop
+callbacks, which supplies the single-writer discipline the reference gets
+from per-component io_contexts (src/ray/common/asio/instrumented_io_context).
+
+Process topology (cf. reference python/ray/_private/node.py:37):
+  controller (this)      — control plane + head-node worker pool
+  node agents (0..N)     — extra nodes; spawn/kill worker processes
+  workers                — connect directly to the controller for dispatch
+  drivers                — connect directly to the controller
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set
+
+from ray_tpu.config import Config, set_config
+from ray_tpu.core.object_store import PlasmaStore
+from ray_tpu.core.placement_group import PlacementGroupManager
+from ray_tpu.core.resources import NodeResources, ResourceSet
+from ray_tpu.core.scheduler import ClusterResourceScheduler, ClusterState
+from ray_tpu.core.task_spec import SchedulingStrategy, TaskSpec, TaskType
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ObjectLostError,
+    TaskCancelledError,
+    WorkerCrashedError,
+)
+from ray_tpu.utils import rpc
+from ray_tpu.utils.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
+
+logger = logging.getLogger("ray_tpu.controller")
+
+# Object meta shapes returned to clients:
+#   ("inline", bytes, is_error)
+#   ("shm", size, node_id_hex, shm_dir, is_error)
+
+
+@dataclass
+class ObjectRecord:
+    oid: ObjectID
+    state: str = "PENDING"  # PENDING | READY | FAILED
+    inline: Optional[bytes] = None
+    size: int = 0
+    locations: Set[NodeID] = field(default_factory=set)
+    is_error: bool = False
+    creating_task: Optional[TaskID] = None
+    waiters: List[asyncio.Future] = field(default_factory=list)
+
+    def meta(self, shm_dirs: Dict[NodeID, str]):
+        if self.inline is not None:
+            return ("inline", self.inline, self.is_error)
+        nid = next(iter(self.locations))
+        return ("shm", self.size, nid.hex(), shm_dirs[nid], self.is_error)
+
+
+@dataclass
+class WorkerRecord:
+    worker_id: WorkerID
+    node_id: NodeID
+    peer: rpc.Peer
+    pid: int = 0
+    state: str = "IDLE"  # STARTING | IDLE | LEASED | ACTOR | DEAD
+    running: Set[TaskID] = field(default_factory=set)
+    actor_id: Optional[ActorID] = None
+
+
+@dataclass
+class NodeRecord:
+    node_id: NodeID
+    shm_dir: str
+    peer: Optional[rpc.Peer]  # None for the head node (controller-managed)
+    hostname: str = "localhost"
+    state: str = "ALIVE"
+    workers: Set[WorkerID] = field(default_factory=set)
+    num_starting: int = 0
+    max_workers: int = 32
+
+
+@dataclass
+class TaskRecord:
+    spec: TaskSpec
+    state: str = "PENDING"  # PENDING | DISPATCHED | RUNNING | FINISHED | FAILED
+    worker_id: Optional[WorkerID] = None
+    node_id: Optional[NodeID] = None
+    retries_left: int = 0
+    acquired: Optional[ResourceSet] = None
+    submitted_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class ActorRecord:
+    actor_id: ActorID
+    creation_spec: TaskSpec
+    state: str = "PENDING"  # PENDING | ALIVE | RESTARTING | DEAD
+    worker_id: Optional[WorkerID] = None
+    node_id: Optional[NodeID] = None
+    name: str = ""
+    restarts_left: int = 0
+    num_restarts: int = 0
+    death_reason: str = ""
+    # Tasks queued while the actor is not ALIVE.
+    pending_tasks: List[TaskSpec] = field(default_factory=list)
+    ready_waiters: List[asyncio.Future] = field(default_factory=list)
+
+
+class Controller:
+    def __init__(self, session_dir: str, head_resources: Dict[str, float], config: Config, owned: bool):
+        self.session_dir = session_dir
+        self.config = config
+        self.owned = owned
+        self.cluster = ClusterState()
+        self.scheduler = ClusterResourceScheduler(self.cluster)
+        self.pg_manager = PlacementGroupManager(self.cluster)
+        self.objects: Dict[ObjectID, ObjectRecord] = {}
+        self.workers: Dict[WorkerID, WorkerRecord] = {}
+        self.nodes: Dict[NodeID, NodeRecord] = {}
+        self.tasks: Dict[TaskID, TaskRecord] = {}
+        self.actors: Dict[ActorID, ActorRecord] = {}
+        self.named_actors: Dict[str, ActorID] = {}
+        self.kv: Dict[str, Dict[bytes, bytes]] = {}
+        self.pending_tasks: List[TaskID] = []
+        self.drivers: Set[rpc.Peer] = set()
+        self._pump_scheduled = False
+        self._pump_running = False
+        self._pump_rerun = False
+        self._shutdown = asyncio.Event()
+        self.events: List[dict] = []  # task event ring buffer
+        self.finished_specs: Dict[TaskID, TaskSpec] = {}  # lineage for reconstruction
+
+        # Head node: controller doubles as its node agent.
+        self.head_node_id = NodeID.from_random()
+        cap = config.object_store_memory or _default_store_bytes()
+        self.head_store = PlasmaStore(session_dir, cap)
+        head_total = ResourceSet.from_dict(head_resources)
+        self.cluster.add_node(self.head_node_id, NodeResources(head_total, labels={"node_type": "head"}))
+        self.nodes[self.head_node_id] = NodeRecord(
+            node_id=self.head_node_id, shm_dir=self.head_store.shm_dir, peer=None
+        )
+        ncpu = int(head_resources.get("CPU", 1))
+        self.nodes[self.head_node_id].max_workers = max(4 * max(ncpu, 1), 16)
+        self._head_prestart = max(ncpu, 1) if config.prestart_workers else 0
+
+    # =================================================================
+    # Connection lifecycle
+    # =================================================================
+    def on_connect(self, peer: rpc.Peer):
+        pass
+
+    async def on_disconnect(self, peer: rpc.Peer):
+        kind = peer.meta.get("kind")
+        if kind == "worker":
+            await self._on_worker_death(peer.meta["worker_id"], "connection lost")
+        elif kind == "agent":
+            await self._on_node_death(peer.meta["node_id"])
+        elif kind == "driver":
+            self.drivers.discard(peer)
+            if self.owned and not self.drivers:
+                # The driver that owns this cluster is gone — tear down.
+                self._shutdown.set()
+
+    # =================================================================
+    # Registration RPCs
+    # =================================================================
+    async def rpc_register_driver(self, peer: rpc.Peer):
+        peer.meta.update(kind="driver")
+        self.drivers.add(peer)
+        return {
+            "session_dir": self.session_dir,
+            "head_node_id": self.head_node_id.hex(),
+            "shm_dir": self.head_store.shm_dir,
+            "config": self.config.to_dict(),
+        }
+
+    async def rpc_register_worker(self, peer: rpc.Peer, worker_id: WorkerID, node_id: NodeID, pid: int):
+        peer.meta.update(kind="worker", worker_id=worker_id)
+        rec = WorkerRecord(worker_id=worker_id, node_id=node_id, peer=peer, pid=pid)
+        self.workers[worker_id] = rec
+        node = self.nodes.get(node_id)
+        if node is not None:
+            node.workers.add(worker_id)
+            node.num_starting = max(0, node.num_starting - 1)
+        self._schedule_pump()
+        return {"session_dir": self.session_dir, "config": self.config.to_dict()}
+
+    async def rpc_register_node(self, peer: rpc.Peer, node_id: NodeID, resources: Dict[str, float], shm_dir: str, hostname: str = "localhost"):
+        peer.meta.update(kind="agent", node_id=node_id)
+        total = ResourceSet.from_dict(resources)
+        self.cluster.add_node(node_id, NodeResources(total))
+        ncpu = int(resources.get("CPU", 1))
+        rec = NodeRecord(node_id=node_id, shm_dir=shm_dir, peer=peer)
+        rec.max_workers = max(4 * max(ncpu, 1), 16)
+        self.nodes[node_id] = rec
+        self.pg_manager.retry_pending()
+        self._schedule_pump()
+        if self.config.prestart_workers:
+            await self._request_workers(rec, max(ncpu, 1))
+        return {"session_dir": self.session_dir, "config": self.config.to_dict()}
+
+    # =================================================================
+    # Worker pool
+    # =================================================================
+    async def _request_workers(self, node: NodeRecord, n: int):
+        live = len(node.workers) + node.num_starting
+        n = min(n, node.max_workers - live)
+        if n <= 0:
+            return
+        node.num_starting += n
+        if node.peer is None:
+            from ray_tpu.core.node_agent import spawn_worker
+
+            for _ in range(n):
+                spawn_worker(self.session_dir, f"127.0.0.1:{self.port}", node.node_id, node.shm_dir)
+        else:
+            await node.peer.notify("start_workers", n)
+
+    def _idle_worker_on(self, node_id: NodeID) -> Optional[WorkerRecord]:
+        node = self.nodes.get(node_id)
+        if node is None:
+            return None
+        for wid in node.workers:
+            w = self.workers.get(wid)
+            if w is not None and w.state == "IDLE":
+                return w
+        return None
+
+    # =================================================================
+    # Task submission / scheduling pump
+    # =================================================================
+    async def rpc_submit_task(self, peer: rpc.Peer, spec: TaskSpec):
+        rec = TaskRecord(spec=spec, retries_left=spec.max_retries)
+        self.tasks[spec.task_id] = rec
+        for oid in spec.return_ids():
+            self._object(oid).creating_task = spec.task_id
+        if spec.task_type == TaskType.ACTOR_TASK:
+            await self._submit_actor_task(spec)
+        else:
+            self.pending_tasks.append(spec.task_id)
+            self._event("task", spec, "PENDING_SCHEDULING")
+            self._schedule_pump()
+        return True
+
+    async def rpc_create_actor(self, peer: rpc.Peer, spec: TaskSpec):
+        actor = ActorRecord(
+            actor_id=spec.actor_id,
+            creation_spec=spec,
+            restarts_left=spec.max_restarts,
+        )
+        # The name travels in runtime_env["__actor_name__"] to keep TaskSpec lean.
+        name = (spec.runtime_env or {}).get("__actor_name__", "")
+        actor.name = name
+        if name:
+            if name in self.named_actors:
+                raise ValueError(f"Actor with name {name!r} already exists")
+            self.named_actors[name] = spec.actor_id
+        self.actors[spec.actor_id] = actor
+        rec = TaskRecord(spec=spec, retries_left=0)
+        self.tasks[spec.task_id] = rec
+        self.pending_tasks.append(spec.task_id)
+        self._event("actor", spec, "PENDING_CREATION")
+        self._schedule_pump()
+        return True
+
+    async def _submit_actor_task(self, spec: TaskSpec):
+        actor = self.actors.get(spec.actor_id)
+        if actor is None or actor.state == "DEAD":
+            reason = actor.death_reason if actor else "actor not found"
+            self._fail_task_objects(spec, ActorDiedError(spec.actor_id.hex(), reason))
+            return
+        if actor.state != "ALIVE":
+            actor.pending_tasks.append(spec)
+            return
+        await self._dispatch_actor_task(actor, spec)
+
+    async def _dispatch_actor_task(self, actor: ActorRecord, spec: TaskSpec):
+        worker = self.workers.get(actor.worker_id)
+        if worker is None or worker.peer.closed:
+            actor.pending_tasks.append(spec)
+            return
+        rec = self.tasks.get(spec.task_id)
+        if rec is None:
+            rec = TaskRecord(spec=spec, retries_left=spec.max_task_retries)
+            self.tasks[spec.task_id] = rec
+        rec.state = "RUNNING"
+        rec.worker_id = worker.worker_id
+        rec.node_id = worker.node_id
+        worker.running.add(spec.task_id)
+        self._event("task", spec, "RUNNING")
+        await worker.peer.notify("execute_actor_task", spec)
+
+    def _schedule_pump(self):
+        if self._pump_scheduled:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # loop shutting down
+        self._pump_scheduled = True
+        loop.call_soon(lambda: asyncio.ensure_future(self._pump()))
+
+    async def _pump(self):
+        self._pump_scheduled = False
+        # Non-reentrant: the loop awaits (notify/spawn) mid-iteration, and a
+        # second concurrent pump would race the pending_tasks rebind below
+        # and could drop newly submitted tasks.
+        if self._pump_running:
+            self._pump_rerun = True
+            return
+        self._pump_running = True
+        try:
+            while True:
+                self._pump_rerun = False
+                await self._pump_once()
+                if not self._pump_rerun:
+                    break
+        finally:
+            self._pump_running = False
+
+    async def _pump_once(self):
+        queue, self.pending_tasks = self.pending_tasks, []
+        still_pending: List[TaskID] = []
+        spawn_requests: Dict[NodeID, int] = {}
+        for tid in queue:
+            rec = self.tasks.get(tid)
+            if rec is None or rec.state != "PENDING":
+                continue
+            spec = rec.spec
+            # 1. dependencies local?
+            deps_ready = True
+            for dep in spec.dependencies:
+                orec = self._object(dep)
+                if orec.state == "FAILED":
+                    self._fail_task_objects(spec, ObjectLostError(dep.hex(), "dependency failed"))
+                    rec.state = "FAILED"
+                    deps_ready = False
+                    break
+                if orec.state != "READY":
+                    deps_ready = False
+                    self._wait_dep(dep)
+                    still_pending.append(tid)
+                    break
+            if not deps_ready:
+                continue
+            # 2. pick node
+            demand = self.scheduler.translated_pg_demand(spec.resources, spec.scheduling_strategy)
+            result = self.scheduler.schedule(spec.resources, spec.scheduling_strategy)
+            if result.node_id is None:
+                still_pending.append(tid)
+                continue
+            # 3. idle worker?
+            worker = self._idle_worker_on(result.node_id)
+            if worker is None:
+                node = self.nodes[result.node_id]
+                spawn_requests[result.node_id] = spawn_requests.get(result.node_id, 0) + 1
+                still_pending.append(tid)
+                continue
+            # 4. acquire resources + dispatch
+            node_res = self.cluster.nodes[result.node_id]
+            if not node_res.acquire(demand):
+                still_pending.append(tid)
+                continue
+            rec.acquired = demand
+            rec.node_id = result.node_id
+            rec.worker_id = worker.worker_id
+            rec.state = "DISPATCHED"
+            worker.running.add(tid)
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                worker.state = "ACTOR"
+                worker.actor_id = spec.actor_id
+                actor = self.actors[spec.actor_id]
+                actor.worker_id = worker.worker_id
+                actor.node_id = result.node_id
+                self._event("actor", spec, "CREATING")
+                await worker.peer.notify("create_actor", spec)
+            else:
+                worker.state = "LEASED"
+                self._event("task", spec, "RUNNING")
+                await worker.peer.notify("execute_task", spec)
+        # New submissions may have arrived into self.pending_tasks while this
+        # loop awaited — keep both.
+        self.pending_tasks = still_pending + self.pending_tasks
+        for nid, n in spawn_requests.items():
+            node = self.nodes.get(nid)
+            if node is not None:
+                await self._request_workers(node, n)
+
+    def _wait_dep(self, dep: ObjectID):
+        orec = self._object(dep)
+        fut = asyncio.get_running_loop().create_future()
+        fut.add_done_callback(lambda _: self._schedule_pump())
+        orec.waiters.append(fut)
+
+    # =================================================================
+    # Task completion
+    # =================================================================
+    async def rpc_task_done(
+        self,
+        peer: rpc.Peer,
+        task_id: TaskID,
+        results: List[tuple],  # (oid, "inline", data) | (oid, "shm", size)
+        error: Optional[Exception],
+    ):
+        rec = self.tasks.get(task_id)
+        if rec is None:
+            return False
+        spec = rec.spec
+        worker = self.workers.get(rec.worker_id) if rec.worker_id else None
+        if worker is not None:
+            worker.running.discard(task_id)
+        # release resources
+        self._release_task(rec)
+        if error is not None:
+            retriable = rec.retries_left > 0 and (
+                spec.retry_exceptions or isinstance(error, (WorkerCrashedError,))
+            )
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                # __init__ raised: the actor is dead on arrival (reference:
+                # gcs_actor_manager — creation failure is not retried as a
+                # restart). Free the half-initialized worker.
+                rec.state = "FAILED"
+                self._event("actor", spec, "CREATION_FAILED")
+                self._fail_task_objects(spec, error)
+                actor = self.actors.get(spec.actor_id)
+                if actor is not None:
+                    actor.restarts_left = 0
+                    await self._on_actor_death(spec.actor_id, f"__init__ failed: {error}")
+                if worker is not None:
+                    worker.actor_id = None
+                    await worker.peer.notify("exit")
+            elif retriable:
+                rec.retries_left -= 1
+                rec.state = "PENDING"
+                self.pending_tasks.append(task_id)
+                self._event("task", spec, "RETRYING")
+            else:
+                rec.state = "FAILED"
+                self._event("task", spec, "FAILED")
+                self._fail_task_objects(spec, error)
+        else:
+            rec.state = "FINISHED"
+            self.finished_specs[task_id] = spec
+            self._event("task", spec, "FINISHED")
+            node_id = worker.node_id if worker else rec.node_id
+            for item in results:
+                oid, kind = item[0], item[1]
+                orec = self._object(oid)
+                if kind == "inline":
+                    orec.inline = item[2]
+                    orec.size = len(item[2])
+                    orec.is_error = bool(item[3]) if len(item) > 3 else False
+                else:
+                    orec.size = item[2]
+                    orec.locations.add(node_id)
+                    await self._account_object(node_id, oid, item[2])
+                orec.state = "READY"
+                self._wake(orec)
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                await self._on_actor_created(spec)
+        # Return worker to pool.
+        if worker is not None and worker.state == "LEASED":
+            worker.state = "IDLE"
+        self._schedule_pump()
+        return True
+
+    def _release_task(self, rec: TaskRecord):
+        if rec.acquired is not None and rec.node_id in self.cluster.nodes:
+            self.cluster.nodes[rec.node_id].release(rec.acquired)
+        rec.acquired = None
+
+    async def _on_actor_created(self, spec: TaskSpec):
+        actor = self.actors.get(spec.actor_id)
+        if actor is None:
+            return
+        actor.state = "ALIVE"
+        for fut in actor.ready_waiters:
+            if not fut.done():
+                fut.set_result(True)
+        actor.ready_waiters.clear()
+        pending, actor.pending_tasks = actor.pending_tasks, []
+        for t in pending:
+            await self._dispatch_actor_task(actor, t)
+
+    def _fail_task_objects(self, spec: TaskSpec, error: Exception):
+        from ray_tpu.utils.serialization import serialize
+
+        blob = serialize(error)
+        for oid in spec.return_ids():
+            orec = self._object(oid)
+            orec.inline = blob
+            orec.is_error = True
+            orec.state = "READY"
+            self._wake(orec)
+
+    # =================================================================
+    # Failure handling
+    # =================================================================
+    async def _on_worker_death(self, worker_id: WorkerID, reason: str):
+        worker = self.workers.pop(worker_id, None)
+        if worker is None:
+            return
+        worker.state = "DEAD"
+        node = self.nodes.get(worker.node_id)
+        if node is not None:
+            node.workers.discard(worker_id)
+        # Fail or retry running tasks FIRST: _on_actor_death below requeues
+        # the creation task under the same deterministic task id, and must
+        # not have its fresh record clobbered by this loop.
+        will_restart = False
+        if worker.actor_id is not None:
+            actor = self.actors.get(worker.actor_id)
+            will_restart = actor is not None and actor.restarts_left > 0
+        for tid in list(worker.running):
+            rec = self.tasks.get(tid)
+            if rec is None:
+                continue
+            self._release_task(rec)
+            spec = rec.spec
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                if will_restart:
+                    continue  # restart path requeues this same spec
+                rec.state = "FAILED"
+                self._fail_task_objects(
+                    spec, ActorDiedError(spec.actor_id.hex(), f"died in __init__ ({reason})")
+                )
+            elif spec.task_type == TaskType.ACTOR_TASK:
+                actor = self.actors.get(spec.actor_id)
+                actor_alive = actor is not None and (
+                    actor.state != "DEAD" or will_restart
+                )
+                if rec.retries_left > 0 and actor_alive:
+                    rec.retries_left -= 1
+                    rec.state = "PENDING"
+                    actor.pending_tasks.append(spec)
+                else:
+                    rec.state = "FAILED"
+                    self._fail_task_objects(
+                        spec,
+                        ActorDiedError(spec.actor_id.hex(), f"actor worker died ({reason})"),
+                    )
+            else:
+                if rec.retries_left > 0:
+                    rec.retries_left -= 1
+                    rec.state = "PENDING"
+                    self.pending_tasks.append(tid)
+                else:
+                    rec.state = "FAILED"
+                    self._fail_task_objects(
+                        spec,
+                        WorkerCrashedError(
+                            f"worker {worker_id.hex()[:8]} died while running task ({reason})"
+                        ),
+                    )
+        if worker.actor_id is not None:
+            await self._on_actor_death(worker.actor_id, f"worker died: {reason}")
+        self._schedule_pump()
+
+    async def _on_actor_death(self, actor_id: ActorID, reason: str):
+        actor = self.actors.get(actor_id)
+        if actor is None or actor.state == "DEAD":
+            return
+        actor.worker_id = None
+        if actor.restarts_left > 0:
+            actor.restarts_left -= 1
+            actor.num_restarts += 1
+            actor.state = "RESTARTING"
+            self._event("actor", actor.creation_spec, "RESTARTING")
+            # Re-run the creation task.
+            spec = actor.creation_spec
+            rec = TaskRecord(spec=spec, retries_left=0)
+            self.tasks[spec.task_id] = rec
+            self.pending_tasks.append(spec.task_id)
+            self._schedule_pump()
+        else:
+            actor.state = "DEAD"
+            actor.death_reason = reason
+            self._event("actor", actor.creation_spec, "DEAD")
+            if actor.name:
+                self.named_actors.pop(actor.name, None)
+            err = ActorDiedError(actor_id.hex(), reason)
+            for spec in actor.pending_tasks:
+                self._fail_task_objects(spec, err)
+            actor.pending_tasks.clear()
+            for fut in actor.ready_waiters:
+                if not fut.done():
+                    fut.set_exception(err)
+            actor.ready_waiters.clear()
+
+    async def _on_node_death(self, node_id: NodeID):
+        node = self.nodes.pop(node_id, None)
+        if node is None:
+            return
+        node.state = "DEAD"
+        self.cluster.remove_node(node_id)
+        for wid in list(node.workers):
+            w = self.workers.get(wid)
+            if w is not None:
+                try:
+                    await w.peer.notify("exit")
+                except Exception:
+                    pass
+            await self._on_worker_death(wid, "node died")
+        # Objects whose only copy was there: attempt lineage reconstruction.
+        for orec in self.objects.values():
+            if orec.state == "READY" and orec.inline is None and orec.locations and orec.locations <= {node_id}:
+                orec.locations.discard(node_id)
+                await self._try_reconstruct(orec)
+        self.pg_manager.on_node_removed(node_id)
+        self._schedule_pump()
+
+    async def _try_reconstruct(self, orec: ObjectRecord):
+        """Lineage reconstruction: resubmit the creating task (reference:
+        src/ray/core_worker/object_recovery_manager.h:70-84)."""
+        spec = self.finished_specs.get(orec.creating_task) if orec.creating_task else None
+        if spec is None or spec.task_type != TaskType.NORMAL_TASK:
+            orec.state = "FAILED"
+            orec.inline = None
+            self._wake(orec)
+            return
+        orec.state = "PENDING"
+        rec = TaskRecord(spec=spec, retries_left=0)
+        self.tasks[spec.task_id] = rec
+        self.pending_tasks.append(spec.task_id)
+        self._event("task", spec, "RECONSTRUCTING")
+        self._schedule_pump()
+
+    # =================================================================
+    # Objects
+    # =================================================================
+    def _object(self, oid: ObjectID) -> ObjectRecord:
+        rec = self.objects.get(oid)
+        if rec is None:
+            rec = ObjectRecord(oid=oid)
+            self.objects[oid] = rec
+        return rec
+
+    def _wake(self, orec: ObjectRecord):
+        for fut in orec.waiters:
+            if not fut.done():
+                fut.set_result(True)
+        orec.waiters.clear()
+
+    def _shm_dirs(self) -> Dict[NodeID, str]:
+        return {nid: n.shm_dir for nid, n in self.nodes.items()}
+
+    async def rpc_object_put_inline(self, peer: rpc.Peer, oid: ObjectID, data: bytes, is_error: bool = False):
+        orec = self._object(oid)
+        orec.inline = data
+        orec.size = len(data)
+        orec.is_error = is_error
+        orec.state = "READY"
+        self._wake(orec)
+        return True
+
+    async def rpc_object_put_shm(self, peer: rpc.Peer, oid: ObjectID, size: int, node_id: NodeID):
+        orec = self._object(oid)
+        orec.size = size
+        orec.locations.add(node_id)
+        await self._account_object(node_id, oid, size)
+        orec.state = "READY"
+        self._wake(orec)
+        return True
+
+    async def _account_object(self, node_id: NodeID, oid: ObjectID, size: int):
+        """Register a worker-written shm object with its node's store so
+        capacity accounting and spill/eviction see it."""
+        node = self.nodes.get(node_id)
+        if node is None:
+            return
+        if node.peer is None:
+            self.head_store.adopt(oid, size)
+        else:
+            await node.peer.notify("adopt_object", oid, size)
+
+    async def rpc_object_ensure_local(self, peer: rpc.Peer, oid: ObjectID, node_hex: str):
+        """Restore a spilled object into its node's shm dir before a reader
+        maps it (reference: spilled-object restore via IO workers,
+        raylet/local_object_manager.cc)."""
+        node = self.nodes.get(NodeID.from_hex(node_hex))
+        if node is None:
+            return False
+        if node.peer is None:
+            return self.head_store.ensure_local(oid)
+        return await node.peer.call("ensure_local", oid)
+
+    async def rpc_object_get(self, peer: rpc.Peer, oids: List[ObjectID], timeout: Optional[float]):
+        """Long-poll get: resolves when ALL are ready (or raises on timeout)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        metas = {}
+        for oid in oids:
+            orec = self._object(oid)
+            while orec.state == "PENDING":
+                fut = asyncio.get_running_loop().create_future()
+                orec.waiters.append(fut)
+                remain = None if deadline is None else deadline - time.monotonic()
+                if remain is not None and remain <= 0:
+                    return {"timeout": True, "metas": metas}
+                try:
+                    await asyncio.wait_for(asyncio.shield(fut), remain)
+                except asyncio.TimeoutError:
+                    return {"timeout": True, "metas": metas}
+            if orec.state == "FAILED":
+                metas[oid.hex()] = ("lost", None, True)
+            else:
+                metas[oid.hex()] = orec.meta(self._shm_dirs())
+        return {"timeout": False, "metas": metas}
+
+    async def rpc_object_wait(self, peer: rpc.Peer, oids: List[ObjectID], num_returns: int, timeout: Optional[float]):
+        """ray.wait semantics: return when num_returns of oids are ready."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ready = [o for o in oids if self._object(o).state != "PENDING"]
+            if len(ready) >= num_returns:
+                return [o.hex() for o in ready]
+            remain = None if deadline is None else deadline - time.monotonic()
+            if remain is not None and remain <= 0:
+                return [o.hex() for o in ready]
+            futs = []
+            for o in oids:
+                orec = self._object(o)
+                if orec.state == "PENDING":
+                    fut = asyncio.get_running_loop().create_future()
+                    orec.waiters.append(fut)
+                    futs.append(fut)
+            if not futs:
+                # Everything resolved but fewer than num_returns exist —
+                # nothing more can become ready.
+                return [o.hex() for o in oids if self._object(o).state != "PENDING"]
+            try:
+                await asyncio.wait_for(
+                    asyncio.wait(futs, return_when=asyncio.FIRST_COMPLETED), remain
+                )
+            except asyncio.TimeoutError:
+                pass
+
+    async def rpc_object_free(self, peer: rpc.Peer, oids: List[ObjectID]):
+        for oid in oids:
+            orec = self.objects.pop(oid, None)
+            if orec is None:
+                continue
+            for nid in orec.locations:
+                node = self.nodes.get(nid)
+                if node is None:
+                    continue
+                if node.peer is None:
+                    self.head_store.delete(oid)
+                else:
+                    await node.peer.notify("delete_object", oid)
+        return True
+
+    async def rpc_object_sealed(self, peer: rpc.Peer, oid: ObjectID, size: int, node_id: NodeID):
+        await self._account_object(node_id, oid, size)
+        return True
+
+    # =================================================================
+    # Actors: kill / get-by-name / wait-ready
+    # =================================================================
+    async def rpc_kill_actor(self, peer: rpc.Peer, actor_id: ActorID, no_restart: bool):
+        actor = self.actors.get(actor_id)
+        if actor is None:
+            return False
+        if no_restart:
+            actor.restarts_left = 0
+        worker = self.workers.get(actor.worker_id) if actor.worker_id else None
+        if worker is not None:
+            await worker.peer.notify("exit")
+        else:
+            await self._on_actor_death(actor_id, "killed via ray_tpu.kill")
+        return True
+
+    async def rpc_wait_actor_ready(self, peer: rpc.Peer, actor_id: ActorID):
+        actor = self.actors.get(actor_id)
+        if actor is None:
+            raise ActorDiedError(actor_id.hex(), "unknown actor")
+        if actor.state == "ALIVE":
+            return True
+        if actor.state == "DEAD":
+            raise ActorDiedError(actor_id.hex(), actor.death_reason)
+        fut = asyncio.get_running_loop().create_future()
+        actor.ready_waiters.append(fut)
+        return await fut
+
+    async def rpc_get_actor_by_name(self, peer: rpc.Peer, name: str):
+        actor_id = self.named_actors.get(name)
+        if actor_id is None:
+            return None
+        actor = self.actors[actor_id]
+        return {
+            "actor_id": actor_id,
+            "creation_spec": actor.creation_spec,
+        }
+
+    async def rpc_cancel_by_object(self, peer: rpc.Peer, oid: ObjectID, force: bool):
+        orec = self.objects.get(oid)
+        if orec is None or orec.creating_task is None:
+            return False
+        return await self.rpc_cancel_task(peer, orec.creating_task, force)
+
+    async def rpc_cancel_task(self, peer: rpc.Peer, task_id: TaskID, force: bool):
+        rec = self.tasks.get(task_id)
+        if rec is None:
+            return False
+        if rec.state == "PENDING":
+            rec.state = "FAILED"
+            rec.retries_left = 0
+            self.pending_tasks = [t for t in self.pending_tasks if t != task_id]
+            self._fail_task_objects(rec.spec, TaskCancelledError(task_id.hex()))
+            return True
+        if rec.state in ("DISPATCHED", "RUNNING") and rec.worker_id:
+            worker = self.workers.get(rec.worker_id)
+            if worker is not None:
+                rec.retries_left = 0
+                if force:
+                    await worker.peer.notify("exit")
+                else:
+                    await worker.peer.notify("cancel", task_id)
+            return True
+        return False
+
+    # =================================================================
+    # KV store (reference: gcs/gcs_server/gcs_kv_manager.cc)
+    # =================================================================
+    async def rpc_kv_put(self, peer, ns: str, key: bytes, value: bytes, overwrite: bool = True):
+        table = self.kv.setdefault(ns, {})
+        if not overwrite and key in table:
+            return False
+        table[key] = value
+        return True
+
+    async def rpc_kv_get(self, peer, ns: str, key: bytes):
+        return self.kv.get(ns, {}).get(key)
+
+    async def rpc_kv_del(self, peer, ns: str, key: bytes):
+        return self.kv.get(ns, {}).pop(key, None) is not None
+
+    async def rpc_kv_keys(self, peer, ns: str, prefix: bytes):
+        return [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]
+
+    # =================================================================
+    # Placement groups
+    # =================================================================
+    async def rpc_pg_create(self, peer, bundles: List[Dict[str, float]], strategy: str, name: str):
+        pg_id = PlacementGroupID.from_random()
+        rs = [ResourceSet.from_dict(b) for b in bundles]
+        self.pg_manager.create(pg_id, rs, strategy, name)
+        self._schedule_pump()
+        return pg_id
+
+    async def rpc_pg_wait_ready(self, peer, pg_id: PlacementGroupID, timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self.pg_manager.is_ready(pg_id):
+            if pg_id not in self.pg_manager.groups:
+                raise ValueError(f"placement group {pg_id.hex()} not found")
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            self.pg_manager.retry_pending()
+            await asyncio.sleep(0.02)
+        return True
+
+    async def rpc_pg_remove(self, peer, pg_id: PlacementGroupID):
+        self.pg_manager.remove(pg_id)
+        self._schedule_pump()
+        return True
+
+    async def rpc_pg_table(self, peer):
+        return self.pg_manager.table()
+
+    async def rpc_pg_bundle_nodes(self, peer, pg_id: PlacementGroupID):
+        rec = self.pg_manager.groups.get(pg_id)
+        if rec is None:
+            return None
+        return [n.hex() if n else None for n in rec.bundle_nodes]
+
+    # =================================================================
+    # Introspection / state API (reference: python/ray/util/state/api.py)
+    # =================================================================
+    async def rpc_cluster_resources(self, peer):
+        total = ResourceSet()
+        for n in self.cluster.nodes.values():
+            total = total + n.total
+        return total.to_dict()
+
+    async def rpc_available_resources(self, peer):
+        total = ResourceSet()
+        for n in self.cluster.nodes.values():
+            total = total + n.available
+        return total.to_dict()
+
+    async def rpc_list_nodes(self, peer):
+        out = []
+        for nid, node in self.nodes.items():
+            res = self.cluster.nodes.get(nid)
+            out.append(
+                {
+                    "node_id": nid.hex(),
+                    "state": node.state,
+                    "is_head": node.peer is None,
+                    "num_workers": len(node.workers),
+                    "resources": res.to_dict() if res else {},
+                }
+            )
+        return out
+
+    async def rpc_list_workers(self, peer):
+        return [
+            {
+                "worker_id": w.worker_id.hex(),
+                "node_id": w.node_id.hex(),
+                "state": w.state,
+                "pid": w.pid,
+                "actor_id": w.actor_id.hex() if w.actor_id else None,
+            }
+            for w in self.workers.values()
+        ]
+
+    async def rpc_list_tasks(self, peer, limit: int = 1000):
+        out = []
+        for tid, rec in list(self.tasks.items())[-limit:]:
+            out.append(
+                {
+                    "task_id": tid.hex(),
+                    "name": rec.spec.name,
+                    "state": rec.state,
+                    "type": rec.spec.task_type.name,
+                    "node_id": rec.node_id.hex() if rec.node_id else None,
+                }
+            )
+        return out
+
+    async def rpc_list_actors(self, peer):
+        return [
+            {
+                "actor_id": a.actor_id.hex(),
+                "state": a.state,
+                "name": a.name,
+                "num_restarts": a.num_restarts,
+                "node_id": a.node_id.hex() if a.node_id else None,
+                "death_reason": a.death_reason,
+            }
+            for a in self.actors.values()
+        ]
+
+    async def rpc_list_objects(self, peer, limit: int = 1000):
+        out = []
+        for oid, rec in list(self.objects.items())[-limit:]:
+            out.append(
+                {
+                    "object_id": oid.hex(),
+                    "state": rec.state,
+                    "size": rec.size,
+                    "is_error": rec.is_error,
+                    "locations": [n.hex() for n in rec.locations],
+                }
+            )
+        return out
+
+    async def rpc_list_events(self, peer, limit: int = 10000):
+        return self.events[-limit:]
+
+    async def rpc_ping(self, peer):
+        return "pong"
+
+    async def rpc_shutdown_cluster(self, peer):
+        self._shutdown.set()
+        return True
+
+    # =================================================================
+    def _event(self, kind: str, spec: TaskSpec, state: str):
+        self.events.append(
+            {
+                "ts": time.time(),
+                "kind": kind,
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "state": state,
+            }
+        )
+        if len(self.events) > self.config.task_event_buffer_size:
+            del self.events[: len(self.events) // 2]
+
+    # =================================================================
+    async def run(self, port: int = 0):
+        server, self.port = await rpc.serve(self, port=port)
+        with open(os.path.join(self.session_dir, "controller_port"), "w") as f:
+            f.write(str(self.port))
+        if self._head_prestart:
+            await self._request_workers(self.nodes[self.head_node_id], self._head_prestart)
+        await self._shutdown.wait()
+        # Teardown: tell everyone to exit.
+        for w in list(self.workers.values()):
+            try:
+                await w.peer.notify("exit")
+            except Exception:
+                pass
+        for n in self.nodes.values():
+            if n.peer is not None:
+                try:
+                    await n.peer.notify("exit")
+                except Exception:
+                    pass
+        await asyncio.sleep(0.1)
+        server.close()
+        self.head_store.destroy()
+
+
+def _default_store_bytes() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    kb = int(line.split()[1])
+                    return min(int(kb * 1024 * 0.3), 16 * 1024**3)
+    except Exception:
+        pass
+    return 2 * 1024**3
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--config", default="{}")
+    parser.add_argument("--owned", action="store_true")
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="[controller] %(levelname)s %(message)s",
+    )
+    cfg = Config.from_env().apply_overrides(json.loads(args.config))
+    set_config(cfg)
+    os.makedirs(args.session_dir, exist_ok=True)
+    ctrl = Controller(args.session_dir, json.loads(args.resources), cfg, owned=args.owned)
+
+    loop = asyncio.new_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, ctrl._shutdown.set)
+    try:
+        loop.run_until_complete(ctrl.run(args.port))
+    finally:
+        loop.close()
+
+
+if __name__ == "__main__":
+    main()

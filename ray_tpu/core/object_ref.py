@@ -1,0 +1,59 @@
+"""ObjectRef: the distributed future handed back by task submission / put.
+
+Reference: python/ray/includes/object_ref.pxi + ownership in
+src/ray/core_worker/reference_count.cc. v0 keeps session-lifetime objects
+(no distributed refcounting yet); refs are plain ids that bind to whatever
+worker context deserializes them — exactly how the reference's refs rebind
+on deserialization in a borrowing worker.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.utils.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id",)
+
+    def __init__(self, oid: ObjectID):
+        self.id = oid
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        return (ObjectRef, (self.id,))
+
+    def future(self):
+        """A concurrent.futures.Future resolving to the object's value."""
+        from ray_tpu.core.api import _require_worker
+
+        return _require_worker().get_async([self])
+
+
+class _RefMarker:
+    """Placeholder substituted for top-level ObjectRef args in a task's
+    serialized arguments; the executing worker replaces it with the
+    fetched value (reference: LocalDependencyResolver,
+    src/ray/core_worker/transport/dependency_resolver.cc)."""
+
+    __slots__ = ("oid",)
+
+    def __init__(self, oid: ObjectID):
+        self.oid = oid
+
+    def __reduce__(self):
+        return (_RefMarker, (self.oid,))

@@ -1,0 +1,249 @@
+"""Object storage.
+
+Two tiers, mirroring the reference's split (reference:
+src/ray/core_worker/store_provider/memory_store/memory_store.cc for small
+objects, src/ray/object_manager/plasma/store.cc for the shared-memory
+store):
+
+- *Inline tier*: objects at or below ``max_inline_object_size`` travel by
+  value through the control plane and live in the controller's memory store.
+- *Shared-memory tier* (``PlasmaStore``): large objects are written to
+  mmap-able files under ``/dev/shm`` by the creating process and mapped
+  read-only (zero-copy) by readers on the same host. Eviction spills sealed
+  objects to a disk directory and restores them on access (reference:
+  src/ray/raylet/local_object_manager.cc spilling + restore;
+  python/ray/_private/external_storage.py).
+
+The plasma arena itself is intentionally file-per-object on tmpfs rather
+than a dlmalloc arena: on TPU hosts the kernel's tmpfs already provides the
+shared mapping + lazy page allocation the reference built dlmalloc-over-mmap
+for (reference: object_manager/plasma/dlmalloc.cc). A C++ slab allocator can
+replace this behind the same interface if file-per-object overhead shows up.
+"""
+from __future__ import annotations
+
+import mmap
+import os
+import shutil
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ray_tpu.utils.ids import ObjectID
+
+
+@dataclass
+class PlasmaEntry:
+    size: int
+    sealed: bool = False
+    pinned: int = 0
+    last_access: float = field(default_factory=time.monotonic)
+    spilled: bool = False
+
+
+class PlasmaBuffer:
+    """A writable or readable mmap view of a stored object."""
+
+    def __init__(self, path: str, size: int, writable: bool):
+        flags = os.O_RDWR | (os.O_CREAT if writable else 0)
+        self._fd = os.open(path, flags, 0o600)
+        if writable:
+            os.ftruncate(self._fd, size)
+        self._mm = mmap.mmap(
+            self._fd, size, access=mmap.ACCESS_WRITE if writable else mmap.ACCESS_READ
+        )
+        self.size = size
+
+    def view(self) -> memoryview:
+        return memoryview(self._mm)
+
+    def close(self):
+        try:
+            self._mm.close()
+        finally:
+            os.close(self._fd)
+
+
+class PlasmaStore:
+    """Per-node shared-memory object store.
+
+    Thread-safe; used directly by every process on the node (the creating
+    process writes, readers map read-only). Capacity accounting and
+    spill/evict decisions live here in the node agent's instance; worker
+    processes use lightweight :class:`PlasmaClient` views.
+    """
+
+    def __init__(self, session_dir: str, capacity: int, spill_dir: Optional[str] = None, name: str = "head"):
+        self.shm_dir = os.path.join(
+            "/dev/shm", "ray_tpu", f"{os.path.basename(session_dir)}_{name}"
+        )
+        os.makedirs(self.shm_dir, exist_ok=True)
+        self.spill_dir = spill_dir or os.path.join(session_dir, f"spilled_objects_{name}")
+        os.makedirs(self.spill_dir, exist_ok=True)
+        self.capacity = capacity
+        self.used = 0
+        self._entries: Dict[ObjectID, PlasmaEntry] = {}
+        self._lock = threading.Lock()
+
+    # -- paths -------------------------------------------------------------
+    def _shm_path(self, oid: ObjectID) -> str:
+        return os.path.join(self.shm_dir, oid.hex())
+
+    def _spill_path(self, oid: ObjectID) -> str:
+        return os.path.join(self.spill_dir, oid.hex())
+
+    # -- write path --------------------------------------------------------
+    def create(self, oid: ObjectID, size: int) -> PlasmaBuffer:
+        with self._lock:
+            if oid in self._entries:
+                raise FileExistsError(f"object {oid.hex()} already exists")
+            self._maybe_evict(size)
+            self._entries[oid] = PlasmaEntry(size=size)
+            self.used += size
+        return PlasmaBuffer(self._shm_path(oid), size, writable=True)
+
+    def seal(self, oid: ObjectID):
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None:
+                e.sealed = True
+
+    def put_bytes(self, oid: ObjectID, data: bytes | memoryview) -> int:
+        buf = self.create(oid, len(data))
+        buf.view()[:] = data
+        buf.close()
+        self.seal(oid)
+        return len(data)
+
+    def adopt(self, oid: ObjectID, size: int):
+        """Account for an object another process wrote directly into the shm
+        dir (workers write via PlasmaClient; the store owner is told after —
+        the reference's seal notification, plasma/store.cc SealObjects)."""
+        with self._lock:
+            if oid in self._entries:
+                return
+            self._maybe_evict(size)
+            self._entries[oid] = PlasmaEntry(size=size, sealed=True)
+            self.used += size
+
+    def ensure_local(self, oid: ObjectID) -> bool:
+        """Restore a spilled object into shm; True if readable there."""
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None or not e.sealed:
+                return os.path.exists(self._shm_path(oid))
+            if e.spilled:
+                self._restore_locked(oid, e)
+            e.last_access = time.monotonic()
+            return True
+
+    # -- read path ---------------------------------------------------------
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._entries
+
+    def get(self, oid: ObjectID) -> Optional[PlasmaBuffer]:
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None or not e.sealed:
+                return None
+            e.last_access = time.monotonic()
+            if e.spilled:
+                self._restore_locked(oid, e)
+        return PlasmaBuffer(self._shm_path(oid), e.size, writable=False)
+
+    def size_of(self, oid: ObjectID) -> Optional[int]:
+        with self._lock:
+            e = self._entries.get(oid)
+            return e.size if e else None
+
+    # -- lifecycle ---------------------------------------------------------
+    def pin(self, oid: ObjectID):
+        with self._lock:
+            e = self._entries.get(oid)
+            if e:
+                e.pinned += 1
+
+    def unpin(self, oid: ObjectID):
+        with self._lock:
+            e = self._entries.get(oid)
+            if e and e.pinned > 0:
+                e.pinned -= 1
+
+    def delete(self, oid: ObjectID):
+        with self._lock:
+            e = self._entries.pop(oid, None)
+            if e is None:
+                return
+            if not e.spilled:
+                self.used -= e.size
+            for p in (self._shm_path(oid), self._spill_path(oid)):
+                try:
+                    os.unlink(p)
+                except FileNotFoundError:
+                    pass
+
+    # -- eviction / spilling ----------------------------------------------
+    def _maybe_evict(self, incoming: int):
+        """Spill LRU sealed, unpinned objects until ``incoming`` fits."""
+        if self.capacity <= 0 or self.used + incoming <= self.capacity:
+            return
+        victims = sorted(
+            (
+                (e.last_access, oid, e)
+                for oid, e in self._entries.items()
+                if e.sealed and e.pinned == 0 and not e.spilled
+            ),
+        )
+        for _, oid, e in victims:
+            if self.used + incoming <= self.capacity:
+                break
+            shutil.move(self._shm_path(oid), self._spill_path(oid))
+            e.spilled = True
+            self.used -= e.size
+
+    def _restore_locked(self, oid: ObjectID, e: PlasmaEntry):
+        self._maybe_evict(e.size)
+        shutil.move(self._spill_path(oid), self._shm_path(oid))
+        e.spilled = False
+        self.used += e.size
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "used": self.used,
+                "num_objects": len(self._entries),
+                "num_spilled": sum(1 for e in self._entries.values() if e.spilled),
+            }
+
+    def destroy(self):
+        shutil.rmtree(self.shm_dir, ignore_errors=True)
+        shutil.rmtree(self.spill_dir, ignore_errors=True)
+
+
+class PlasmaClient:
+    """Worker-side view: maps objects created by any process on this node."""
+
+    def __init__(self, shm_dir: str):
+        self.shm_dir = shm_dir
+
+    def _path(self, oid: ObjectID) -> str:
+        return os.path.join(self.shm_dir, oid.hex())
+
+    def put_bytes(self, oid: ObjectID, data: bytes | memoryview) -> int:
+        # Writes directly into the node's shm dir; the node agent is told of
+        # the new object afterwards (seal notification) and does accounting.
+        path = self._path(oid)
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+        try:
+            os.ftruncate(fd, len(data))
+            with mmap.mmap(fd, len(data), access=mmap.ACCESS_WRITE) as mm:
+                mm[: len(data)] = data
+        finally:
+            os.close(fd)
+        return len(data)
+
+    def get_buffer(self, oid: ObjectID, size: int) -> PlasmaBuffer:
+        return PlasmaBuffer(self._path(oid), size, writable=False)

@@ -1,0 +1,142 @@
+"""Placement group manager (control-plane side).
+
+Reference: src/ray/gcs/gcs_server/gcs_placement_group_manager.cc (lifecycle
+FSM) + src/ray/raylet/placement_group_resource_manager.h:44-84 (2-phase
+bundle reservation: Prepare atomically holds base resources, Commit renames
+them into group resources ``CPU_group_<pgid>`` / ``CPU_group_<i>_<pgid>``).
+
+TPU-specific: ``STRICT_PACK`` is the gang-scheduling primitive for an ICI
+slice — a multi-chip pjit program needs all its chips on one slice, so the
+TPU trainer always reserves its chips via STRICT_PACK per host plus a
+pod-level SPREAD across hosts (see ray_tpu.train).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.core.resources import NodeResources, ResourceSet
+from ray_tpu.core.scheduler import ClusterState, schedule_bundles
+from ray_tpu.utils.ids import NodeID, PlacementGroupID
+
+
+class PGState(enum.Enum):
+    PENDING = 0
+    CREATED = 1
+    REMOVED = 2
+    RESCHEDULING = 3
+
+
+@dataclass
+class PlacementGroupRecord:
+    pg_id: PlacementGroupID
+    bundles: List[ResourceSet]
+    strategy: str
+    name: str = ""
+    state: PGState = PGState.PENDING
+    # node per bundle once placed
+    bundle_nodes: List[Optional[NodeID]] = field(default_factory=list)
+
+    def to_dict(self):
+        return {
+            "placement_group_id": self.pg_id.hex(),
+            "name": self.name,
+            "strategy": self.strategy,
+            "state": self.state.name,
+            "bundles": [b.to_dict() for b in self.bundles],
+            "bundle_nodes": [n.hex() if n else None for n in self.bundle_nodes],
+        }
+
+
+def _group_resources(pg_id: PlacementGroupID, index: int, bundle: ResourceSet) -> ResourceSet:
+    parts: Dict[str, int] = {}
+    for k, v in bundle.items_fp():
+        parts[f"{k}_group_{index}_{pg_id.hex()}"] = v
+        parts[f"{k}_group_{pg_id.hex()}"] = parts.get(f"{k}_group_{pg_id.hex()}", 0) + v
+    return ResourceSet(parts)
+
+
+class PlacementGroupManager:
+    def __init__(self, state: ClusterState):
+        self.state = state
+        self.groups: Dict[PlacementGroupID, PlacementGroupRecord] = {}
+
+    # ------------------------------------------------------------------
+    def create(self, pg_id: PlacementGroupID, bundles: List[ResourceSet], strategy: str, name: str = "") -> PlacementGroupRecord:
+        rec = PlacementGroupRecord(pg_id=pg_id, bundles=bundles, strategy=strategy, name=name)
+        self.groups[pg_id] = rec
+        self.try_place(rec)
+        return rec
+
+    def try_place(self, rec: PlacementGroupRecord) -> bool:
+        """Prepare + commit. Placement is atomic against the cluster view; if
+        any bundle can't be prepared nothing is reserved (the 2PC invariant
+        from the reference)."""
+        if rec.state == PGState.CREATED:
+            return True
+        nodes = schedule_bundles(self.state, rec.bundles, rec.strategy)
+        if nodes is None:
+            return False
+        # Prepare: acquire base resources on each node.
+        acquired: List[tuple] = []
+        ok = True
+        for idx, (nid, bundle) in enumerate(zip(nodes, rec.bundles)):
+            node = self.state.nodes.get(nid)
+            if node is None or not node.acquire(bundle):
+                ok = False
+                break
+            acquired.append((nid, bundle, idx))
+        if not ok:
+            for nid, bundle, _ in acquired:
+                if nid in self.state.nodes:
+                    self.state.nodes[nid].release(bundle)
+            return False
+        # Commit: add renamed group resources.
+        for nid, bundle, idx in acquired:
+            self.state.nodes[nid].add_total(_group_resources(rec.pg_id, idx, bundle))
+        rec.bundle_nodes = list(nodes)
+        rec.state = PGState.CREATED
+        return True
+
+    # ------------------------------------------------------------------
+    def remove(self, pg_id: PlacementGroupID):
+        rec = self.groups.get(pg_id)
+        if rec is None or rec.state == PGState.REMOVED:
+            return
+        if rec.state == PGState.CREATED:
+            for idx, (nid, bundle) in enumerate(zip(rec.bundle_nodes, rec.bundles)):
+                node = self.state.nodes.get(nid)
+                if node is None:
+                    continue
+                node.remove_total(_group_resources(rec.pg_id, idx, bundle))
+                node.release(bundle)
+        rec.state = PGState.REMOVED
+
+    # ------------------------------------------------------------------
+    def on_node_removed(self, node_id: NodeID):
+        """Bundles on a dead node → PG goes back to rescheduling
+        (reference: gcs_placement_group_manager.cc OnNodeDead)."""
+        for rec in self.groups.values():
+            if rec.state == PGState.CREATED and node_id in rec.bundle_nodes:
+                # Release surviving bundles and re-place the whole group.
+                for idx, (nid, bundle) in enumerate(zip(rec.bundle_nodes, rec.bundles)):
+                    node = self.state.nodes.get(nid)
+                    if node is not None:
+                        node.remove_total(_group_resources(rec.pg_id, idx, bundle))
+                        node.release(bundle)
+                rec.state = PGState.RESCHEDULING
+                rec.bundle_nodes = []
+                self.try_place(rec)
+
+    def retry_pending(self):
+        for rec in self.groups.values():
+            if rec.state in (PGState.PENDING, PGState.RESCHEDULING):
+                self.try_place(rec)
+
+    def is_ready(self, pg_id: PlacementGroupID) -> bool:
+        rec = self.groups.get(pg_id)
+        return rec is not None and rec.state == PGState.CREATED
+
+    def table(self) -> dict:
+        return {pid.hex(): rec.to_dict() for pid, rec in self.groups.items()}

@@ -1,0 +1,119 @@
+"""@remote function plumbing.
+
+Reference: python/ray/remote_function.py (decorator, ``.options()``,
+``_remote`` at :266). The serialized function is cached on the handle and
+shipped inside the TaskSpec; workers cache it by digest.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Optional
+
+from ray_tpu.core.resources import ResourceSet
+from ray_tpu.core.task_spec import SchedulingStrategy, TaskSpec, TaskType
+from ray_tpu.utils.ids import TaskID, WorkerID
+from ray_tpu.utils.serialization import serialize_function
+
+_DEFAULT_TASK_OPTIONS = dict(
+    num_cpus=1,
+    num_tpus=0,
+    memory=0,
+    resources=None,
+    num_returns=1,
+    max_retries=3,
+    retry_exceptions=False,
+    scheduling_strategy=None,
+    name=None,
+    runtime_env=None,
+)
+
+
+def build_resource_set(opts: Dict[str, Any]) -> ResourceSet:
+    d: Dict[str, float] = {}
+    if opts.get("num_cpus"):
+        d["CPU"] = opts["num_cpus"]
+    if opts.get("num_tpus"):
+        d["TPU"] = opts["num_tpus"]
+    if opts.get("memory"):
+        d["memory"] = opts["memory"]
+    for k, v in (opts.get("resources") or {}).items():
+        d[k] = v
+    return ResourceSet.from_dict(d)
+
+
+def normalize_strategy(raw) -> SchedulingStrategy:
+    if raw is None:
+        return SchedulingStrategy()
+    if isinstance(raw, SchedulingStrategy):
+        return raw
+    if isinstance(raw, str):
+        if raw == "SPREAD":
+            return SchedulingStrategy(kind="SPREAD")
+        if raw == "DEFAULT":
+            return SchedulingStrategy()
+        raise ValueError(f"unknown scheduling strategy {raw!r}")
+    # Duck-typed strategy objects from ray_tpu.util.scheduling_strategies.
+    if hasattr(raw, "placement_group"):
+        pg = raw.placement_group
+        return SchedulingStrategy(
+            kind="PLACEMENT_GROUP",
+            placement_group_id=pg.id if hasattr(pg, "id") else pg,
+            bundle_index=getattr(raw, "placement_group_bundle_index", -1),
+            capture_child_tasks=getattr(raw, "placement_group_capture_child_tasks", False),
+        )
+    if hasattr(raw, "node_id"):
+        return SchedulingStrategy(
+            kind="NODE_AFFINITY", node_id=raw.node_id, soft=getattr(raw, "soft", False)
+        )
+    raise ValueError(f"unsupported scheduling strategy: {raw!r}")
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._options = dict(_DEFAULT_TASK_OPTIONS)
+        self._options.update(options or {})
+        self._blob: Optional[bytes] = None
+        self._digest: Optional[bytes] = None
+
+    def _ensure_exported(self):
+        if self._blob is None:
+            self._blob = serialize_function(self._fn)
+            self._digest = hashlib.blake2b(self._blob, digest_size=16).digest()
+
+    def options(self, **opts) -> "RemoteFunction":
+        new = RemoteFunction(self._fn, {**self._options, **opts})
+        new._blob, new._digest = self._blob, self._digest
+        return new
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu.core.api import _require_worker
+
+        core = _require_worker()
+        self._ensure_exported()
+        opts = self._options
+        args_blob, deps = core.build_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            task_type=TaskType.NORMAL_TASK,
+            name=opts.get("name") or getattr(self._fn, "__name__", "anonymous"),
+            func_digest=self._digest,
+            func_blob=self._blob,
+            args_blob=args_blob,
+            dependencies=deps,
+            num_returns=opts["num_returns"],
+            resources=build_resource_set(opts),
+            owner_id=core.worker_id,
+            scheduling_strategy=normalize_strategy(opts.get("scheduling_strategy")),
+            max_retries=opts["max_retries"],
+            retry_exceptions=bool(opts["retry_exceptions"]),
+            runtime_env=opts.get("runtime_env"),
+        )
+        refs = core.submit_task(spec)
+        return refs[0] if opts["num_returns"] == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            "Remote functions cannot be called directly. "
+            f"Use {getattr(self._fn, '__name__', 'fn')}.remote() instead."
+        )

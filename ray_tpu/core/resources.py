@@ -1,0 +1,142 @@
+"""Resource model.
+
+Fixed-point resource arithmetic with fractional support, mirroring the
+reference's scheduling substrate (reference:
+src/ray/common/scheduling/fixed_point.h, cluster_resource_data.h,
+resource_instance_set.cc). Quantities are stored as integer 1/10000 units so
+fractional CPUs/TPUs never accumulate float error.
+
+TPU-specific: ``TPU`` is a countable chip resource like GPU; pod-slice
+topology resources (``TPU-v5p-8-head``-style, reference:
+python/ray/_private/accelerators/tpu.py:334-397) are plain custom resources
+layered on top by the node's accelerator detection.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable
+
+PRECISION = 10000
+
+
+def to_fp(v: float | int) -> int:
+    return int(round(v * PRECISION))
+
+
+def from_fp(v: int) -> float:
+    f = v / PRECISION
+    return int(f) if f.is_integer() else f
+
+
+class ResourceSet:
+    """An immutable-ish mapping resource-name -> fixed-point quantity."""
+
+    __slots__ = ("_m",)
+
+    def __init__(self, m: Dict[str, int] | None = None):
+        self._m = {k: v for k, v in (m or {}).items() if v != 0}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, float] | None) -> "ResourceSet":
+        return cls({k: to_fp(v) for k, v in (d or {}).items()})
+
+    def to_dict(self) -> Dict[str, float]:
+        return {k: from_fp(v) for k, v in self._m.items()}
+
+    def get(self, name: str) -> int:
+        return self._m.get(name, 0)
+
+    def is_empty(self) -> bool:
+        return not self._m
+
+    def names(self) -> Iterable[str]:
+        return self._m.keys()
+
+    def fits(self, other: "ResourceSet") -> bool:
+        """True if self (available) can satisfy other (demand)."""
+        return all(self._m.get(k, 0) >= v for k, v in other._m.items())
+
+    def __add__(self, other: "ResourceSet") -> "ResourceSet":
+        m = dict(self._m)
+        for k, v in other._m.items():
+            m[k] = m.get(k, 0) + v
+        return ResourceSet(m)
+
+    def __sub__(self, other: "ResourceSet") -> "ResourceSet":
+        m = dict(self._m)
+        for k, v in other._m.items():
+            m[k] = m.get(k, 0) - v
+        return ResourceSet(m)
+
+    def __eq__(self, other):
+        return isinstance(other, ResourceSet) and self._m == other._m
+
+    def __repr__(self):
+        return f"ResourceSet({self.to_dict()})"
+
+    def __reduce__(self):
+        return (ResourceSet, (self._m,))
+
+    def items_fp(self):
+        return self._m.items()
+
+
+class NodeResources:
+    """Total and available resources of one node, plus labels.
+
+    Reference: src/ray/common/scheduling/cluster_resource_data.h
+    ``NodeResources`` {total, available, labels}.
+    """
+
+    def __init__(self, total: ResourceSet, labels: Dict[str, str] | None = None):
+        self.total = total
+        self.available = ResourceSet(dict(total.items_fp()))
+        self.labels = dict(labels or {})
+
+    def fits(self, demand: ResourceSet) -> bool:
+        return self.available.fits(demand)
+
+    def is_feasible(self, demand: ResourceSet) -> bool:
+        """Could this node EVER satisfy demand (ignores current usage)."""
+        return self.total.fits(demand)
+
+    def acquire(self, demand: ResourceSet) -> bool:
+        if not self.available.fits(demand):
+            return False
+        self.available = self.available - demand
+        return True
+
+    def release(self, demand: ResourceSet):
+        self.available = self.available + demand
+        # Clamp: releasing more than total indicates a bug elsewhere, but
+        # never let availability exceed capacity for dynamic resources.
+        m = {}
+        for k, v in self.available.items_fp():
+            cap = self.total.get(k)
+            m[k] = min(v, cap) if cap else v
+        self.available = ResourceSet(m)
+
+    def utilization(self) -> float:
+        """Max utilization across resource kinds — drives the hybrid policy's
+        pack/spread decision (reference: hybrid_scheduling_policy.cc)."""
+        best = 0.0
+        for k, tot in self.total.items_fp():
+            if tot <= 0:
+                continue
+            used = tot - self.available.get(k)
+            best = max(best, used / tot)
+        return best
+
+    def add_total(self, extra: ResourceSet):
+        self.total = self.total + extra
+        self.available = self.available + extra
+
+    def remove_total(self, extra: ResourceSet):
+        self.total = self.total - extra
+        self.available = self.available - extra
+
+    def to_dict(self):
+        return {
+            "total": self.total.to_dict(),
+            "available": self.available.to_dict(),
+            "labels": dict(self.labels),
+        }

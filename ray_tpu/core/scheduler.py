@@ -1,0 +1,231 @@
+"""Cluster scheduling: policies + resource bookkeeping.
+
+Reference: src/ray/raylet/scheduling/ — ``ClusterResourceScheduler``
+(cluster_resource_scheduler.cc) picks nodes with pluggable policies
+(policy/hybrid_scheduling_policy.h:50, scheduling_policy.h), and placement
+groups reserve bundle resources through a 2-phase prepare/commit
+(placement_group_resource_manager.h:44-84).
+
+Architectural difference from the reference: scheduling here is
+GCS-direct — the controller holds the authoritative resource view and
+assigns leases itself (the reference supports this mode too:
+gcs_actor_scheduler.cc:60 ``ScheduleByGcs``). Raylet-side spillover
+scheduling can be reintroduced when nodes own their local view.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ray_tpu.config import get_config
+from ray_tpu.core.resources import NodeResources, ResourceSet
+from ray_tpu.core.task_spec import SchedulingStrategy
+from ray_tpu.utils.ids import NodeID, PlacementGroupID
+
+
+@dataclass
+class ScheduleResult:
+    node_id: Optional[NodeID]
+    infeasible: bool = False  # no node could EVER run this → autoscaler hint
+
+
+class ClusterState:
+    """Authoritative view of node resources (reference:
+    ClusterResourceManager, cluster_resource_data.h)."""
+
+    def __init__(self):
+        self.nodes: Dict[NodeID, NodeResources] = {}
+        # Stable ordering for deterministic pack behavior.
+        self._order: List[NodeID] = []
+        self._spread_rr = itertools.count()
+
+    def add_node(self, node_id: NodeID, resources: NodeResources):
+        self.nodes[node_id] = resources
+        self._order.append(node_id)
+
+    def remove_node(self, node_id: NodeID):
+        self.nodes.pop(node_id, None)
+        self._order = [n for n in self._order if n != node_id]
+
+    def ordered_nodes(self) -> List[NodeID]:
+        return [n for n in self._order if n in self.nodes]
+
+
+class ClusterResourceScheduler:
+    def __init__(self, state: ClusterState):
+        self.state = state
+        self._spread_idx = 0
+
+    # ------------------------------------------------------------------
+    def schedule(self, demand: ResourceSet, strategy: SchedulingStrategy) -> ScheduleResult:
+        if strategy.kind == "NODE_AFFINITY":
+            return self._node_affinity(demand, strategy)
+        if strategy.kind == "SPREAD":
+            return self._spread(demand)
+        if strategy.kind == "PLACEMENT_GROUP":
+            return self._placement_group(demand, strategy)
+        return self._hybrid(demand)
+
+    # ------------------------------------------------------------------
+    def _feasible_nodes(self, demand: ResourceSet) -> List[NodeID]:
+        return [
+            nid
+            for nid in self.state.ordered_nodes()
+            if self.state.nodes[nid].is_feasible(demand)
+        ]
+
+    def _hybrid(self, demand: ResourceSet) -> ScheduleResult:
+        """Pack onto the first nodes (stable order) while their utilization is
+        below ``scheduler_spread_threshold``; otherwise pick the
+        least-utilized available node (reference:
+        hybrid_scheduling_policy.cc HybridPolicyWithFilter)."""
+        threshold = get_config().scheduler_spread_threshold
+        feasible = self._feasible_nodes(demand)
+        if not feasible:
+            return ScheduleResult(None, infeasible=True)
+        available = [n for n in feasible if self.state.nodes[n].fits(demand)]
+        if not available:
+            return ScheduleResult(None, infeasible=False)
+        for nid in available:
+            if self.state.nodes[nid].utilization() < threshold:
+                return ScheduleResult(nid)
+        best = min(available, key=lambda n: self.state.nodes[n].utilization())
+        return ScheduleResult(best)
+
+    def _spread(self, demand: ResourceSet) -> ScheduleResult:
+        feasible = self._feasible_nodes(demand)
+        if not feasible:
+            return ScheduleResult(None, infeasible=True)
+        available = [n for n in feasible if self.state.nodes[n].fits(demand)]
+        if not available:
+            return ScheduleResult(None)
+        pick = available[self._spread_idx % len(available)]
+        self._spread_idx += 1
+        return ScheduleResult(pick)
+
+    def _node_affinity(self, demand: ResourceSet, strategy: SchedulingStrategy) -> ScheduleResult:
+        nid = NodeID.from_hex(strategy.node_id) if isinstance(strategy.node_id, str) else strategy.node_id
+        node = self.state.nodes.get(nid)
+        if node is not None and node.fits(demand):
+            return ScheduleResult(nid)
+        if strategy.soft:
+            return self._hybrid(demand)
+        if node is None:
+            return ScheduleResult(None, infeasible=True)
+        return ScheduleResult(None)
+
+    def _placement_group(self, demand: ResourceSet, strategy: SchedulingStrategy) -> ScheduleResult:
+        """Translate demand into the PG's renamed group resources
+        (reference: placement_group_resource_manager.h — ``CPU`` →
+        ``CPU_group_<pgid>`` / ``CPU_group_<i>_<pgid>``)."""
+        pgid = strategy.placement_group_id
+        suffix = (
+            f"_group_{strategy.bundle_index}_{pgid.hex()}"
+            if strategy.bundle_index >= 0
+            else f"_group_{pgid.hex()}"
+        )
+        translated = ResourceSet({k + suffix: v for k, v in demand.items_fp()})
+        # Also consume the wildcard pool when a specific bundle was requested,
+        # so pg-wide accounting stays consistent with the reference.
+        if strategy.bundle_index >= 0:
+            wildcard = ResourceSet({f"{k}_group_{pgid.hex()}": v for k, v in demand.items_fp()})
+            translated = translated + wildcard
+        for nid in self.state.ordered_nodes():
+            if self.state.nodes[nid].fits(translated):
+                return ScheduleResult(nid)
+        return ScheduleResult(None)
+
+    def translated_pg_demand(self, demand: ResourceSet, strategy: SchedulingStrategy) -> ResourceSet:
+        if strategy.kind != "PLACEMENT_GROUP":
+            return demand
+        pgid = strategy.placement_group_id
+        parts = {}
+        for k, v in demand.items_fp():
+            if strategy.bundle_index >= 0:
+                parts[f"{k}_group_{strategy.bundle_index}_{pgid.hex()}"] = v
+                parts[f"{k}_group_{pgid.hex()}"] = parts.get(f"{k}_group_{pgid.hex()}", 0) + v
+            else:
+                parts[f"{k}_group_{pgid.hex()}"] = v
+        return ResourceSet(parts)
+
+
+def schedule_bundles(
+    state: ClusterState,
+    bundles: List[ResourceSet],
+    strategy: str,
+) -> Optional[List[NodeID]]:
+    """Place PG bundles per PACK/SPREAD/STRICT_PACK/STRICT_SPREAD
+    (reference: raylet/scheduling/policy/bundle_scheduling_policy.h:82-106).
+
+    Returns one node per bundle or None if infeasible. Trial placement is
+    done against a scratch copy of availability so multi-bundle-per-node
+    accounting is correct.
+    """
+    # Scratch availability.
+    avail: Dict[NodeID, ResourceSet] = {
+        nid: ResourceSet(dict(state.nodes[nid].available.items_fp()))
+        for nid in state.ordered_nodes()
+    }
+    order = state.ordered_nodes()
+
+    def try_place(nid: NodeID, demand: ResourceSet) -> bool:
+        if avail[nid].fits(demand):
+            avail[nid] = avail[nid] - demand
+            return True
+        return False
+
+    placement: List[Optional[NodeID]] = [None] * len(bundles)
+
+    if strategy in ("STRICT_PACK", "PACK"):
+        # STRICT_PACK: all bundles on one node (one ICI slice on TPU).
+        for nid in order:
+            ok = all(avail[nid].fits(b) for b in _stack(bundles))
+            if ok and _fits_all(avail[nid], bundles):
+                return [nid] * len(bundles)
+        if strategy == "STRICT_PACK":
+            return None
+        # PACK fallback: greedy fill nodes in order.
+        for i, b in enumerate(bundles):
+            placed = False
+            for nid in order:
+                if try_place(nid, b):
+                    placement[i] = nid
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return placement  # type: ignore[return-value]
+
+    if strategy in ("SPREAD", "STRICT_SPREAD"):
+        used_nodes: set = set()
+        for i, b in enumerate(bundles):
+            candidates = [n for n in order if n not in used_nodes] + (
+                [] if strategy == "STRICT_SPREAD" else [n for n in order if n in used_nodes]
+            )
+            placed = False
+            for nid in candidates:
+                if try_place(nid, b):
+                    placement[i] = nid
+                    used_nodes.add(nid)
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return placement  # type: ignore[return-value]
+
+    raise ValueError(f"unknown bundle strategy {strategy}")
+
+
+def _stack(bundles: List[ResourceSet]) -> List[ResourceSet]:
+    total = ResourceSet()
+    for b in bundles:
+        total = total + b
+    return [total]
+
+
+def _fits_all(avail: ResourceSet, bundles: List[ResourceSet]) -> bool:
+    total = ResourceSet()
+    for b in bundles:
+        total = total + b
+    return avail.fits(total)

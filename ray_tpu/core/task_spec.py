@@ -1,0 +1,81 @@
+"""Task and actor specifications — the unit the scheduler moves around.
+
+Reference: src/ray/common/task/task_spec.cc (TaskSpecification) and
+src/ray/protobuf/common.proto (TaskSpec message). We keep a plain dataclass;
+the function payload travels by value the first time and is cached by its
+digest on each node afterwards (the reference exports functions through the
+GCS KV — python/ray/_private/worker.py function table).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.resources import ResourceSet
+from ray_tpu.utils.ids import ActorID, ObjectID, PlacementGroupID, TaskID, WorkerID
+
+
+class TaskType(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class SchedulingStrategy:
+    """Union of the reference's strategies (reference:
+    python/ray/util/scheduling_strategies.py): default hybrid, spread,
+    node-affinity, PG, node-label."""
+
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP
+    node_id: Optional[str] = None
+    soft: bool = False
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+    node_labels: Optional[Dict[str, Any]] = None
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    task_type: TaskType
+    name: str
+    # Digest of the serialized function / actor class for per-node caching.
+    func_digest: bytes
+    # Serialized function (may be None if receiver already cached it).
+    func_blob: Optional[bytes]
+    # Serialized (args, kwargs) with ObjectID placeholders for ref args.
+    args_blob: bytes
+    # ObjectIDs this task depends on (must be local before dispatch).
+    dependencies: List[ObjectID]
+    num_returns: int
+    resources: ResourceSet
+    owner_id: WorkerID
+    scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    # Actor fields
+    actor_id: Optional[ActorID] = None
+    actor_method_name: Optional[str] = None
+    actor_seq_no: int = 0
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    # Runtime env (env vars only in v0; reference has full plugin system).
+    runtime_env: Optional[dict] = None
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+
+    def scheduling_class(self) -> Tuple:
+        """Tasks with equal scheduling class share lease requests (reference:
+        normal_task_submitter.h:40 SchedulingKey)."""
+        return (
+            tuple(sorted(self.resources.items_fp())),
+            self.scheduling_strategy.kind,
+            self.scheduling_strategy.node_id,
+            str(self.scheduling_strategy.placement_group_id),
+            self.func_digest,
+        )

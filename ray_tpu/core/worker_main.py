@@ -1,0 +1,222 @@
+"""Worker process: executes tasks and hosts actors.
+
+Reference: python/ray/_private/workers/default_worker.py (entrypoint) +
+the Cython execution path python/ray/_raylet.pyx:2222
+``task_execution_handler`` and the receiver-side scheduling queues
+(src/ray/core_worker/transport/task_receiver.cc, concurrency groups in
+transport/concurrency_group_manager.h).
+
+Structure: the asyncio loop (in a background thread via EventLoopThread)
+handles RPC; execution happens on a ThreadPoolExecutor so blocking user code
+never stalls the control plane. Actor tasks run on a per-actor pool of
+``max_concurrency`` threads — FIFO when 1 (ordered actors), concurrent
+otherwise. ``async def`` methods are driven to completion on the executing
+thread (the reference uses boost fibers — transport/fiber.h).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+from ray_tpu.core.client import CoreWorker
+from ray_tpu.core.object_ref import _RefMarker
+from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.exceptions import TaskError
+from ray_tpu.utils import rpc
+from ray_tpu.utils.ids import NodeID, TaskID, WorkerID
+from ray_tpu.utils.serialization import (
+    deserialize,
+    deserialize_function,
+    serialize,
+)
+
+logger = logging.getLogger("ray_tpu.worker")
+
+
+class WorkerHandler:
+    """RPC handler for controller→worker messages.
+
+    Dispatches may arrive between worker registration and executor attach
+    (registration happens inside CoreWorker.__init__) — buffer until ready.
+    """
+
+    def __init__(self):
+        self.executor: Optional[TaskExecutor] = None
+        self._buffer: list = []
+
+    def attach_executor(self, executor: "TaskExecutor"):
+        self.executor = executor
+        buffered, self._buffer = self._buffer, []
+        for spec, kind in buffered:
+            executor.submit(spec, kind)
+
+    def _dispatch(self, spec: TaskSpec, kind: str):
+        if self.executor is None:
+            self._buffer.append((spec, kind))
+        else:
+            self.executor.submit(spec, kind)
+
+    def rpc_execute_task(self, peer, spec: TaskSpec):
+        self._dispatch(spec, "task")
+
+    def rpc_create_actor(self, peer, spec: TaskSpec):
+        self._dispatch(spec, "actor_create")
+
+    def rpc_execute_actor_task(self, peer, spec: TaskSpec):
+        self._dispatch(spec, "actor_task")
+
+    def rpc_cancel(self, peer, task_id: TaskID):
+        if self.executor is not None:
+            self.executor.cancelled.add(task_id)
+
+    def rpc_exit(self, peer):
+        os._exit(0)
+
+    def rpc_ping(self, peer):
+        return "pong"
+
+    def on_disconnect(self, peer):
+        # Controller gone — nothing useful left to do.
+        os._exit(1)
+
+
+class TaskExecutor:
+    def __init__(self, core: CoreWorker):
+        self.core = core
+        self.pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="task-exec")
+        self.actor_pool: Optional[ThreadPoolExecutor] = None
+        self.actor_instance: Any = None
+        self.cancelled: set = set()
+        self._func_cache: Dict[bytes, Any] = {}
+
+    def submit(self, spec: TaskSpec, kind: str):
+        if kind == "actor_task":
+            pool = self.actor_pool or self.pool
+        else:
+            pool = self.pool
+        pool.submit(self._guarded_run, spec, kind)
+
+    def _guarded_run(self, spec: TaskSpec, kind: str):
+        try:
+            self._run(spec, kind)
+        except Exception:
+            logger.exception("internal error running task %s", spec.name)
+
+    # ------------------------------------------------------------------
+    def _load_func(self, spec: TaskSpec):
+        fn = self._func_cache.get(spec.func_digest)
+        if fn is None:
+            fn = deserialize_function(spec.func_blob)
+            self._func_cache[spec.func_digest] = fn
+        return fn
+
+    def _resolve_args(self, spec: TaskSpec):
+        args, kwargs = deserialize(spec.args_blob)
+
+        def res(v):
+            if isinstance(v, _RefMarker):
+                value, is_error = self.core.get_raw(v.oid)
+                if is_error:
+                    raise value
+                return value
+            return v
+
+        return tuple(res(a) for a in args), {k: res(v) for k, v in kwargs.items()}
+
+    def _run(self, spec: TaskSpec, kind: str):
+        if spec.task_id in self.cancelled:
+            from ray_tpu.exceptions import TaskCancelledError
+
+            self._report(spec, None, TaskCancelledError(spec.task_id.hex()))
+            return
+        try:
+            args, kwargs = self._resolve_args(spec)
+            if kind == "task":
+                fn = self._load_func(spec)
+                result = _maybe_async(fn(*args, **kwargs))
+            elif kind == "actor_create":
+                cls = self._load_func(spec)
+                self.actor_instance = cls(*args, **kwargs)
+                n = max(1, spec.max_concurrency)
+                self.actor_pool = ThreadPoolExecutor(n, thread_name_prefix="actor-exec")
+                result = None
+            else:  # actor_task
+                method = getattr(self.actor_instance, spec.actor_method_name)
+                result = _maybe_async(method(*args, **kwargs))
+        except Exception as e:  # noqa: BLE001 — user errors cross the wire
+            tb = traceback.format_exc()
+            err = e if isinstance(e, TaskError) else TaskError(spec.name, tb, None)
+            self._report(spec, None, err)
+            return
+        self._report(spec, result, None)
+
+    def _report(self, spec: TaskSpec, result, error):
+        results = []
+        if error is None:
+            try:
+                if spec.num_returns == 1:
+                    values = [result]
+                else:
+                    values = list(result)
+                    if len(values) != spec.num_returns:
+                        raise ValueError(
+                            f"task {spec.name} returned {len(values)} values, "
+                            f"expected num_returns={spec.num_returns}"
+                        )
+                for oid, value in zip(spec.return_ids(), values):
+                    data = serialize(value)
+                    if len(data) <= self.core.inline_limit:
+                        results.append((oid, "inline", data, False))
+                    else:
+                        self.core.plasma.put_bytes(oid, data)
+                        results.append((oid, "shm", len(data)))
+            except Exception:  # noqa: BLE001 — unpicklable results must not hang the caller
+                results = []
+                error = TaskError(spec.name, traceback.format_exc(), None)
+        try:
+            self.core._call("task_done", spec.task_id, results, error)
+        except rpc.ConnectionLost:
+            os._exit(1)
+
+
+def _maybe_async(result):
+    if asyncio.iscoroutine(result):
+        return asyncio.run(result)
+    return result
+
+
+def main():
+    logging.basicConfig(level=logging.INFO, format="[worker] %(levelname)s %(message)s")
+    addr = os.environ["RAY_TPU_CONTROLLER"]
+    node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
+    worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+    shm_dir = os.environ["RAY_TPU_SHM_DIR"]
+
+    handler = WorkerHandler()
+    loop_runner = rpc.EventLoopThread("worker-io")
+    core = CoreWorker(
+        addr,
+        mode="worker",
+        loop_runner=loop_runner,
+        handler=handler,
+        worker_id=worker_id,
+        node_id=node_id,
+        local_shm_dir=shm_dir,
+    )
+    # Make the full public API usable from inside tasks (nested tasks,
+    # ray_tpu.get/put in user code) BEFORE any buffered task can run.
+    from ray_tpu.core import api
+
+    api._attach_worker(core)
+    handler.attach_executor(TaskExecutor(core))
+
+    threading.Event().wait()  # serve forever; exit via rpc_exit / disconnect
+
+
+if __name__ == "__main__":
+    main()

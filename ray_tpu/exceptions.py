@@ -1,0 +1,112 @@
+"""Exception taxonomy.
+
+Mirrors the reference's failure taxonomy (reference:
+python/ray/exceptions.py:27-858) so users can handle the same classes of
+failures: task errors wrapping user exceptions, actor death/unavailability,
+object loss (with causes), OOM, and cancellation.
+"""
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception during execution.
+
+    Wraps the user exception with the remote traceback string so the driver
+    sees where the failure happened (reference: python/ray/exceptions.py
+    ``RayTaskError``).
+    """
+
+    def __init__(self, function_name: str, traceback_str: str, cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(
+            f"Task {function_name} failed.\nRemote traceback:\n{traceback_str}"
+        )
+
+
+    def __reduce__(self):
+        return (TaskError, (self.function_name, self.traceback_str, self.cause))
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead and will not be restarted (reference:
+    python/ray/exceptions.py:326)."""
+
+    def __init__(self, actor_id: str = "", reason: str = ""):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"Actor {actor_id} is dead: {reason}")
+
+
+    def __reduce__(self):
+        return (ActorDiedError, (self.actor_id, self.reason))
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (restarting or network partition)
+    (reference: python/ray/exceptions.py:402)."""
+
+
+class ObjectLostError(RayTpuError):
+    """An object is unrecoverable (reference: python/ray/exceptions.py:511)."""
+
+    def __init__(self, object_id: str = "", reason: str = ""):
+        self.object_id = object_id
+        self.reason = reason
+        super().__init__(f"Object {object_id} lost: {reason}")
+
+
+    def __reduce__(self):
+        return (type(self), (self.object_id, self.reason))
+
+class ObjectFetchTimedOutError(ObjectLostError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    pass
+
+
+class OutOfMemoryError(RayTpuError):
+    """Node memory is exhausted; the task/actor was killed by the memory
+    monitor (reference: python/ray/exceptions.py:483)."""
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id: str = ""):
+        self.task_id = task_id
+        super().__init__(f"Task {task_id} was cancelled")
+
+
+    def __reduce__(self):
+        return (TaskCancelledError, (self.task_id,))
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """ray_tpu.get() timed out."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class ChannelError(RayTpuError):
+    """Compiled-graph channel error (reference: python/ray/exceptions.py:842)."""
+
+
+class PlacementGroupError(RayTpuError):
+    pass

@@ -1,0 +1,55 @@
+"""Placement group public API.
+
+Reference: python/ray/util/placement_group.py (``placement_group`` /
+``remove_placement_group`` / ``placement_group_table`` / ``get``-style
+readiness).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.core.api import _require_worker
+from ray_tpu.utils.ids import PlacementGroupID
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]]):
+        self.id = pg_id
+        self.bundle_specs = bundles
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until all bundles are reserved (the reference returns an
+        ObjectRef; we block directly — await-able form comes with the async
+        API)."""
+        return _require_worker().pg_wait_ready(self.id, timeout)
+
+    def wait(self, timeout_seconds: float = 30) -> bool:
+        return self.ready(timeout=timeout_seconds)
+
+    def bundle_nodes(self) -> List[Optional[str]]:
+        """Node (hex id) hosting each bundle — used by the trainer to
+        co-locate TPU worker groups."""
+        return _require_worker().pg_bundle_nodes(self.id)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundle_specs))
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+) -> PlacementGroup:
+    if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
+        raise ValueError(f"invalid strategy {strategy}")
+    pg_id = _require_worker().pg_create(bundles, strategy, name)
+    return PlacementGroup(pg_id, bundles)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    _require_worker().pg_remove(pg.id)
+
+
+def placement_group_table() -> dict:
+    return _require_worker().pg_table()

@@ -1,0 +1,235 @@
+"""Asyncio message-passing RPC.
+
+The reference's control plane is gRPC (reference: src/ray/rpc/grpc_server.h,
+rpc/client_call.h). We use a symmetric length-prefixed pickle protocol over
+TCP: either end of a connection can issue requests and receive responses on
+the same socket (the reference needs bidirectional streams for the same
+reason — ray_syncer.proto). This keeps the control plane dependency-free and
+fast enough for the microbenchmark targets (tens of thousands of small
+messages/sec).
+
+Frame: ``[u64 length][pickle (kind, msg_id, method_or_result, payload)]``
+kinds: 0=request, 1=response, 2=error-response, 3=one-way notification.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import pickle
+import struct
+import threading
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+_REQ, _RESP, _ERR, _NOTIFY = 0, 1, 2, 3
+_HDR = struct.Struct("<Q")
+
+
+class ConnectionLost(ConnectionError):
+    pass
+
+
+class Peer:
+    """One side of an established RPC connection."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter, handler: Any):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler
+        self._ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._send_lock = asyncio.Lock()
+        self._closed = False
+        self._recv_task: asyncio.Task | None = None
+        # Arbitrary metadata the handler may attach (worker id, node id, ...).
+        self.meta: dict[str, Any] = {}
+
+    def start(self):
+        self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
+        return self
+
+    async def _send(self, frame: tuple):
+        data = pickle.dumps(frame, protocol=5)
+        async with self._send_lock:
+            self.writer.write(_HDR.pack(len(data)))
+            self.writer.write(data)
+            await self.writer.drain()
+
+    async def call(self, method: str, *args, **kwargs) -> Any:
+        if self._closed:
+            raise ConnectionLost(f"connection closed (call to {method})")
+        msg_id = next(self._ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        try:
+            await self._send((_REQ, msg_id, method, (args, kwargs)))
+        except (ConnectionError, OSError) as e:
+            self._pending.pop(msg_id, None)
+            raise ConnectionLost(str(e)) from e
+        return await fut
+
+    async def notify(self, method: str, *args, **kwargs):
+        if self._closed:
+            return
+        try:
+            await self._send((_NOTIFY, 0, method, (args, kwargs)))
+        except (ConnectionError, OSError):
+            pass
+
+    async def _recv_loop(self):
+        try:
+            while True:
+                hdr = await self.reader.readexactly(_HDR.size)
+                (length,) = _HDR.unpack(hdr)
+                data = await self.reader.readexactly(length)
+                kind, msg_id, a, b = pickle.loads(data)
+                if kind == _REQ:
+                    asyncio.get_running_loop().create_task(self._handle(msg_id, a, b))
+                elif kind == _NOTIFY:
+                    asyncio.get_running_loop().create_task(self._handle(None, a, b))
+                elif kind == _RESP:
+                    fut = self._pending.pop(msg_id, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(a)
+                elif kind == _ERR:
+                    fut = self._pending.pop(msg_id, None)
+                    if fut is not None and not fut.done():
+                        fut.set_exception(a)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except asyncio.CancelledError:
+            return
+        except Exception:
+            logger.exception("rpc recv loop error")
+        finally:
+            await self._on_disconnect()
+
+    async def _handle(self, msg_id, method, payload):
+        args, kwargs = payload
+        try:
+            fn = getattr(self.handler, "rpc_" + method, None)
+            if fn is None:
+                raise AttributeError(f"no rpc method {method!r} on {type(self.handler).__name__}")
+            res = fn(self, *args, **kwargs)
+            if asyncio.iscoroutine(res):
+                res = await res
+            if msg_id is not None:
+                await self._send((_RESP, msg_id, res, None))
+        except Exception as e:  # noqa: BLE001 — errors cross the wire
+            if msg_id is not None:
+                try:
+                    await self._send((_ERR, msg_id, e, None))
+                except Exception:
+                    logger.exception("failed to send error response for %s", method)
+            else:
+                logger.exception("error in notification handler %s", method)
+
+    async def _on_disconnect(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost("peer disconnected"))
+        self._pending.clear()
+        cb = getattr(self.handler, "on_disconnect", None)
+        if cb is not None:
+            try:
+                res = cb(self)
+                if asyncio.iscoroutine(res):
+                    await res
+            except Exception:
+                logger.exception("on_disconnect handler error")
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+    async def close(self):
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except Exception:
+            pass
+        await self._on_disconnect()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+async def serve(handler_factory: Callable[[], Any] | Any, host: str = "127.0.0.1", port: int = 0):
+    """Start a server; each connection gets a Peer bound to the handler.
+
+    Returns (server, port). ``handler_factory`` may be a shared handler
+    object (typical: the Controller) — its ``on_connect(peer)`` is called for
+    every new connection.
+    """
+    handler = handler_factory() if callable(handler_factory) and not hasattr(handler_factory, "on_connect") else handler_factory
+
+    async def on_conn(reader, writer):
+        peer = Peer(reader, writer, handler).start()
+        cb = getattr(handler, "on_connect", None)
+        if cb is not None:
+            res = cb(peer)
+            if asyncio.iscoroutine(res):
+                await res
+
+    server = await asyncio.start_server(on_conn, host, port)
+    actual_port = server.sockets[0].getsockname()[1]
+    return server, actual_port
+
+
+async def connect(host: str, port: int, handler: Any, retries: int = 60, delay: float = 0.1) -> Peer:
+    last = None
+    for _ in range(retries):
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+            return Peer(reader, writer, handler).start()
+        except (ConnectionError, OSError) as e:
+            last = e
+            await asyncio.sleep(delay)
+    raise ConnectionLost(f"could not connect to {host}:{port}: {last}")
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop running in a daemon thread.
+
+    The driver and each worker embed one (the reference embeds a C++ io
+    service per CoreWorker — core_worker/core_worker_process.cc); blocking
+    public APIs bridge into it with run_coroutine_threadsafe.
+    """
+
+    def __init__(self, name: str = "ray-tpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: float | None = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def submit(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        def _cancel_all():
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+
+        try:
+            self.loop.call_soon_threadsafe(_cancel_all)
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self.thread.join(timeout=5)
+            if not self.loop.is_running():
+                self.loop.close()
+        except Exception:
+            pass

@@ -1,0 +1,42 @@
+"""Test configuration.
+
+Mirrors the reference's workhorse pattern of single-process-host multi-node
+clusters (reference: python/ray/tests/conftest.py:419 ``ray_start_regular``,
+python/ray/cluster_utils.py:135 ``Cluster``): every test runs against a real
+multi-process cluster on localhost.
+
+JAX tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware (the driver separately dry-runs the multichip
+path; see ``__graft_entry__.py``).
+"""
+import os
+
+# Must be set before jax is imported anywhere in the test process tree.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    """A running 1-node cluster, torn down after the test."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, resources={"TPU": 4})
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """A Cluster object tests can add/remove nodes on (multi-node on one host)."""
+    from ray_tpu.core.cluster_utils import Cluster
+
+    cluster = Cluster()
+    yield cluster
+    cluster.shutdown()

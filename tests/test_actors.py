@@ -1,0 +1,133 @@
+"""Actor tests (reference model: python/ray/tests/test_actor.py)."""
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError
+
+
+def test_basic_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.inc.remote()) == 11
+    assert ray_tpu.get(c.inc.remote(5)) == 16
+    assert ray_tpu.get(c.value.remote()) == 16
+
+
+def test_actor_ordering(ray_start_regular):
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+
+        def get_items(self):
+            return self.items
+
+    a = Appender.remote()
+    for i in range(20):
+        a.add.remote(i)
+    assert ray_tpu.get(a.get_items.remote()) == list(range(20))
+
+
+def test_actor_handle_passing(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    @ray_tpu.remote
+    def bump(counter):
+        return ray_tpu.get(counter.inc.remote())
+
+    c = Counter.remote()
+    assert ray_tpu.get(bump.remote(c)) == 1
+    assert ray_tpu.get(c.inc.remote()) == 2
+
+
+def test_named_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Registry:
+        def ping(self):
+            return "ok"
+
+    Registry.options(name="reg").remote()
+    h = ray_tpu.get_actor("reg")
+    assert ray_tpu.get(h.ping.remote()) == "ok"
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("missing")
+
+
+def test_actor_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def boom(self):
+            raise RuntimeError("actor error")
+
+        def fine(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(Exception, match="actor error"):
+        ray_tpu.get(b.boom.remote())
+    # actor still alive after user exception
+    assert ray_tpu.get(b.fine.remote()) == 1
+
+
+def test_kill_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "ok"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote()) == "ok"
+    ray_tpu.kill(v)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(v.ping.remote(), timeout=10)
+
+
+def test_max_concurrency(ray_start_regular):
+    import time
+
+    @ray_tpu.remote(max_concurrency=4)
+    class Sleeper:
+        def nap(self):
+            time.sleep(0.3)
+            return 1
+
+    s = Sleeper.remote()
+    ray_tpu.wait_actor_ready(s, timeout=20)
+    t0 = time.time()
+    refs = [s.nap.remote() for _ in range(4)]
+    assert sum(ray_tpu.get(refs)) == 4
+    assert time.time() - t0 < 1.0  # 4 concurrent 0.3s naps < 1s
+
+
+def test_async_actor_method(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncActor:
+        async def compute(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncActor.remote()
+    assert ray_tpu.get(a.compute.remote(21)) == 42

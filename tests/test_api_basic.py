@@ -1,0 +1,131 @@
+"""Basic task/object API tests (reference test model:
+python/ray/tests/test_basic.py)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, TaskError
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    ref2 = ray_tpu.put({"a": [1, 2, 3]})
+    assert ray_tpu.get(ref2) == {"a": [1, 2, 3]}
+
+
+def test_put_get_large_numpy(ray_start_regular):
+    arr = np.arange(1_000_000, dtype=np.float32)  # 4MB → shared memory path
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_arg(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    r1 = double.remote(10)
+    r2 = double.remote(r1)
+    assert ray_tpu.get(r2) == 40
+
+
+def test_many_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def f(i):
+        return i * i
+
+    refs = [f.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kapow")
+
+    with pytest.raises(TaskError) as exc_info:
+        ray_tpu.get(boom.remote())
+    assert "kapow" in str(exc_info.value)
+
+
+def test_error_propagates_through_dependency(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kapow")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(Exception):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        import time
+
+        time.sleep(10)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.2)
+
+
+def test_wait(ray_start_regular):
+    import time
+
+    @ray_tpu.remote
+    def f(t):
+        time.sleep(t)
+        return t
+
+    fast = f.remote(0.01)
+    slow = f.remote(5)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=3)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(1)) == 12
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4
+    assert res["TPU"] == 4
+
+
+def test_put_roundtrip_zero_copy_view(ray_start_regular):
+    arr = np.ones((512, 512), dtype=np.float32)
+    out = ray_tpu.get(ray_tpu.put(arr))
+    # zero-copy objects come back read-only (backed by shm mapping)
+    assert out.flags.writeable is False or out.base is not None

@@ -1,0 +1,94 @@
+"""Regression tests for failure-path edge cases found in review:
+unpicklable returns, actor __init__ failures, num_returns mismatch,
+wait() validation, spilled-object restore."""
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, TaskError
+
+
+def test_unpicklable_return_raises_not_hangs(ray_start_regular):
+    @ray_tpu.remote
+    def bad():
+        import threading
+
+        return threading.Lock()
+
+    with pytest.raises(TaskError):
+        ray_tpu.get(bad.remote(), timeout=60)
+
+
+def test_num_returns_mismatch_raises(ray_start_regular):
+    @ray_tpu.remote(num_returns=2)
+    def three():
+        return 1, 2, 3
+
+    refs = three.remote()
+    with pytest.raises(TaskError, match="num_returns"):
+        ray_tpu.get(refs[0], timeout=60)
+
+
+def test_actor_init_exception_marks_actor_dead(ray_start_regular):
+    @ray_tpu.remote
+    class Doomed:
+        def __init__(self):
+            raise RuntimeError("bad init")
+
+        def ping(self):
+            return "ok"
+
+    d = Doomed.remote()
+    with pytest.raises(ActorDiedError):
+        ray_tpu.wait_actor_ready(d, timeout=60)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(d.ping.remote(), timeout=60)
+    # Cluster still healthy afterwards.
+    @ray_tpu.remote
+    def ok():
+        return 1
+
+    assert ray_tpu.get(ok.remote(), timeout=60) == 1
+
+
+def test_actor_init_worker_crash_restarts(ray_start_regular):
+    sentinel = "/tmp/ray_tpu_init_crash"
+    if os.path.exists(sentinel):
+        os.unlink(sentinel)
+
+    @ray_tpu.remote(max_restarts=2)
+    class CrashyInit:
+        def __init__(self):
+            if not os.path.exists(sentinel):
+                open(sentinel, "w").close()
+                os._exit(1)
+
+        def ping(self):
+            return "alive"
+
+    c = CrashyInit.remote()
+    assert ray_tpu.get(c.ping.remote(), timeout=120) == "alive"
+    os.unlink(sentinel)
+
+
+def test_wait_num_returns_validation(ray_start_regular):
+    ref = ray_tpu.put(1)
+    with pytest.raises(ValueError, match="num_returns"):
+        ray_tpu.wait([ref], num_returns=2)
+
+
+def test_spill_and_restore():
+    """Objects beyond store capacity spill to disk and restore on get."""
+    import numpy as np
+
+    ray_tpu.init(num_cpus=2, object_store_memory=20 * 1024 * 1024)
+    try:
+        refs = [ray_tpu.put(np.full(2_000_000, i, dtype=np.float32)) for i in range(4)]
+        # 4 × 8MB > 20MB capacity → early ones spilled; all still readable.
+        for i, r in enumerate(refs):
+            arr = ray_tpu.get(r, timeout=60)
+            assert arr[0] == i
+    finally:
+        ray_tpu.shutdown()

@@ -1,0 +1,89 @@
+"""Placement group tests (reference model:
+python/ray/tests/test_placement_group.py)."""
+import pytest
+
+import ray_tpu
+from ray_tpu.util.placement_group import (
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+def test_pg_create_ready_remove(ray_start_regular):
+    pg = placement_group([{"CPU": 1, "TPU": 2}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=10)
+    table = placement_group_table()
+    assert table[pg.id.hex()]["state"] == "CREATED"
+    # resources are held by the PG
+    avail = ray_tpu.available_resources()
+    assert avail.get("TPU", 0) == 2
+    remove_placement_group(pg)
+    avail = ray_tpu.available_resources()
+    assert avail.get("TPU", 0) == 4
+
+
+def test_pg_infeasible_until_node_added(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.connect()
+    pg = placement_group([{"TPU": 4}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=0.5) is False
+    cluster.add_node(num_cpus=1, resources={"TPU": 4})
+    assert pg.ready(timeout=30)
+
+
+def test_task_in_pg_bundle(ray_start_regular):
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.ready(timeout=10)
+
+    @ray_tpu.remote(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        ),
+    )
+    def inside():
+        return "ran"
+
+    assert ray_tpu.get(inside.remote(), timeout=60) == "ran"
+
+
+def test_actor_in_pg(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=10)
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "ok"
+
+    a = A.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(placement_group=pg)
+    ).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "ok"
+
+
+def test_strict_spread_over_cluster(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=30)
+    nodes = pg.bundle_nodes()
+    assert len(set(nodes)) == 3
+
+
+def test_pg_reschedules_after_node_death(ray_start_cluster):
+    cluster = ray_start_cluster
+    n1 = cluster.add_node(num_cpus=1, resources={"TPU": 4})
+    cluster.connect()
+    pg = placement_group([{"TPU": 4}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=30)
+    # Kill the node hosting the bundle; PG goes back to pending...
+    cluster.remove_node(n1)
+    assert pg.ready(timeout=1) is False
+    # ...and recovers when capacity returns.
+    cluster.add_node(num_cpus=1, resources={"TPU": 4})
+    assert pg.ready(timeout=30)

@@ -1,0 +1,143 @@
+"""Scheduling policy unit tests + multi-node placement tests.
+
+Reference model: src/ray/raylet/scheduling/cluster_task_manager_test.cc and
+policy tests (hybrid_scheduling_policy_test.cc), plus
+python/ray/tests/test_scheduling.py.
+"""
+import pytest
+
+import ray_tpu
+from ray_tpu.core.resources import NodeResources, ResourceSet
+from ray_tpu.core.scheduler import (
+    ClusterResourceScheduler,
+    ClusterState,
+    schedule_bundles,
+)
+from ray_tpu.core.task_spec import SchedulingStrategy
+from ray_tpu.utils.ids import NodeID
+
+
+def _mk_state(node_resources):
+    state = ClusterState()
+    ids = []
+    for res in node_resources:
+        nid = NodeID.from_random()
+        state.add_node(nid, NodeResources(ResourceSet.from_dict(res)))
+        ids.append(nid)
+    return state, ids
+
+
+def test_hybrid_packs_then_spreads():
+    state, ids = _mk_state([{"CPU": 4}, {"CPU": 4}])
+    sched = ClusterResourceScheduler(state)
+    demand = ResourceSet.from_dict({"CPU": 1})
+    # First node util 0 → pack onto node 0.
+    r = sched.schedule(demand, SchedulingStrategy())
+    assert r.node_id == ids[0]
+    state.nodes[ids[0]].acquire(demand)
+    # Utilization 0.25 < 0.5 → still packs.
+    r = sched.schedule(demand, SchedulingStrategy())
+    assert r.node_id == ids[0]
+    state.nodes[ids[0]].acquire(demand)
+    state.nodes[ids[0]].acquire(demand)  # util now 0.75 ≥ 0.5 → spread
+    r = sched.schedule(demand, SchedulingStrategy())
+    assert r.node_id == ids[1]
+
+
+def test_infeasible_detection():
+    state, _ = _mk_state([{"CPU": 2}])
+    sched = ClusterResourceScheduler(state)
+    r = sched.schedule(ResourceSet.from_dict({"TPU": 8}), SchedulingStrategy())
+    assert r.node_id is None and r.infeasible
+
+
+def test_unavailable_but_feasible():
+    state, ids = _mk_state([{"CPU": 1}])
+    sched = ClusterResourceScheduler(state)
+    state.nodes[ids[0]].acquire(ResourceSet.from_dict({"CPU": 1}))
+    r = sched.schedule(ResourceSet.from_dict({"CPU": 1}), SchedulingStrategy())
+    assert r.node_id is None and not r.infeasible
+
+
+def test_spread_round_robins():
+    state, ids = _mk_state([{"CPU": 4}, {"CPU": 4}, {"CPU": 4}])
+    sched = ClusterResourceScheduler(state)
+    demand = ResourceSet.from_dict({"CPU": 1})
+    picks = {sched.schedule(demand, SchedulingStrategy(kind="SPREAD")).node_id for _ in range(3)}
+    assert picks == set(ids)
+
+
+def test_node_affinity():
+    state, ids = _mk_state([{"CPU": 4}, {"CPU": 4}])
+    sched = ClusterResourceScheduler(state)
+    demand = ResourceSet.from_dict({"CPU": 1})
+    st = SchedulingStrategy(kind="NODE_AFFINITY", node_id=ids[1].hex())
+    assert sched.schedule(demand, st).node_id == ids[1]
+    # hard affinity to a full node → unschedulable
+    state.nodes[ids[1]].acquire(ResourceSet.from_dict({"CPU": 4}))
+    assert sched.schedule(demand, st).node_id is None
+    # soft affinity falls back
+    st_soft = SchedulingStrategy(kind="NODE_AFFINITY", node_id=ids[1].hex(), soft=True)
+    assert sched.schedule(demand, st_soft).node_id == ids[0]
+
+
+def test_bundle_strict_pack_and_spread():
+    state, ids = _mk_state([{"CPU": 4, "TPU": 4}, {"CPU": 4, "TPU": 4}])
+    bundles = [ResourceSet.from_dict({"TPU": 2}), ResourceSet.from_dict({"TPU": 2})]
+    placement = schedule_bundles(state, bundles, "STRICT_PACK")
+    assert placement is not None and len(set(placement)) == 1
+    placement = schedule_bundles(state, bundles, "STRICT_SPREAD")
+    assert placement is not None and len(set(placement)) == 2
+    # STRICT_PACK that can't fit on any single node
+    big = [ResourceSet.from_dict({"TPU": 3}), ResourceSet.from_dict({"TPU": 3})]
+    assert schedule_bundles(state, big, "STRICT_PACK") is None
+    # PACK degrades gracefully across nodes
+    assert schedule_bundles(state, big, "PACK") is not None
+
+
+def test_fractional_resources():
+    state, ids = _mk_state([{"CPU": 1}])
+    sched = ClusterResourceScheduler(state)
+    half = ResourceSet.from_dict({"CPU": 0.5})
+    assert state.nodes[ids[0]].acquire(half)
+    assert state.nodes[ids[0]].acquire(half)
+    assert not state.nodes[ids[0]].acquire(half)
+    state.nodes[ids[0]].release(half)
+    assert state.nodes[ids[0]].available.to_dict() == {"CPU": 0.5}
+
+
+# ---------------------------------------------------------------------------
+# End-to-end placement over a real multi-node cluster
+# ---------------------------------------------------------------------------
+
+
+def test_custom_resource_placement(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"fast_disk": 1})
+    cluster.connect()
+
+    @ray_tpu.remote(resources={"fast_disk": 1}, num_cpus=1)
+    def where():
+        import os
+
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    node_hex = ray_tpu.get(where.remote(), timeout=60)
+    assert node_hex == cluster._nodes[0].node_id_hex
+
+
+def test_spread_tasks_across_nodes(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+
+    @ray_tpu.remote(scheduling_strategy="SPREAD", num_cpus=1)
+    def where():
+        import os, time
+
+        time.sleep(0.2)
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    nodes = set(ray_tpu.get([where.remote() for _ in range(6)], timeout=90))
+    assert len(nodes) >= 2
