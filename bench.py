@@ -1,0 +1,134 @@
+"""Benchmark: flagship-model training throughput on the local chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/s/chip", "vs_baseline": N}
+
+North star (BASELINE.json): framework throughput >= 90% of single-process
+JAX on the same hardware. ``vs_baseline`` is therefore measured directly:
+framework train step (ray_tpu.parallel.make_train_step — the same compiled
+path the JaxTrainer drives) vs a plain hand-rolled jax.jit train step
+written inline below with no framework imports in the loop. >= 0.9 meets
+the target; ~1.0 means the framework adds no overhead over raw JAX.
+
+Diagnostics (MFU, step times) go to stderr; stdout stays one JSON line.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+
+    cpu_mode = "--cpu" in sys.argv
+    if cpu_mode:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import transformer as tf
+    from ray_tpu.parallel import MeshPlan, build_mesh, make_train_state, make_train_step
+    from ray_tpu.parallel import mesh as mesh_lib
+    from ray_tpu.parallel.train_step import make_optimizer
+
+    n_dev = jax.device_count()
+    platform = jax.devices()[0].platform
+    log(f"devices: {n_dev} x {platform}")
+
+    if cpu_mode:
+        cfg = tf.TransformerConfig.tiny(dtype=jnp.float32)
+        batch_size, seq, steps, warmup = 4, 64, 3, 1
+    else:
+        # ~400M-param model sized for one v5e chip's HBM.
+        cfg = tf.TransformerConfig(
+            vocab_size=32000,
+            d_model=1024,
+            n_layers=24,
+            n_heads=16,
+            n_kv_heads=16,
+            d_ff=4096,
+            max_seq_len=2048,
+            dtype=jnp.bfloat16,
+            remat=True,
+        )
+        batch_size, seq, steps, warmup = 8, 2048, 8, 2
+
+    plan = MeshPlan(dp=n_dev)
+    mesh = build_mesh(plan)
+    opt = make_optimizer(lr=3e-4, warmup=10)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch_size, seq + 1), 0, cfg.vocab_size)
+    batch = {"tokens": jax.device_put(tokens, mesh_lib.batch_sharding(mesh, plan))}
+
+    # ---- framework path -------------------------------------------------
+    params, opt_state, _ = make_train_state(cfg, plan, mesh, opt)
+    step = make_train_step(cfg, plan, mesh, opt)
+    fw_time = _time_steps(step, params, opt_state, batch, steps, warmup, log, "framework")
+
+    # ---- plain JAX baseline (no framework in the loop) ------------------
+    def plain_loss(params, batch):
+        return tf.loss_fn(params, batch, cfg)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def plain_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(plain_loss)(params, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss}
+
+    # Same placement a plain-JAX user would pick on this mesh: replicated
+    # params, batch-sharded data (single-device this is a no-op).
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+    params2 = jax.jit(lambda k: tf.init_params(k, cfg), out_shardings=rep)(jax.random.PRNGKey(0))
+    opt_state2 = jax.jit(opt.init, out_shardings=rep)(params2)
+    pj_time = _time_steps(plain_step, params2, opt_state2, batch, steps, warmup, log, "plain-jax")
+
+    tokens_per_step = batch_size * seq
+    value = tokens_per_step / fw_time / n_dev
+    vs_baseline = pj_time / fw_time  # >1 → framework faster than plain JAX
+
+    flops_tok = tf.flops_per_token(cfg, seq)
+    peak = {"tpu": 197e12, "cpu": 1e12}.get(platform, 100e12)  # v5e bf16 peak
+    mfu = (flops_tok * tokens_per_step / fw_time) / (peak * n_dev)
+    log(f"step: framework {fw_time*1e3:.1f}ms, plain-jax {pj_time*1e3:.1f}ms")
+    log(f"tokens/s/chip {value:.0f}  MFU~{mfu:.2%} (peak {peak/1e12:.0f}TF)")
+
+    print(
+        json.dumps(
+            {
+                "metric": "train_tokens_per_sec_per_chip_400m_bf16" if not cpu_mode else "train_tokens_per_sec_per_chip_tiny_cpu",
+                "value": round(value, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )
+
+
+def _time_steps(step, params, opt_state, batch, steps, warmup, log, tag):
+    import jax
+
+    for i in range(warmup):
+        t0 = time.perf_counter()
+        params, opt_state, m = step(params, opt_state, batch)
+        jax.block_until_ready(m["loss"])
+        log(f"{tag} warmup[{i}] {time.perf_counter()-t0:.2f}s loss={float(m['loss']):.3f}")
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, m = step(params, opt_state, batch)
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    del params, opt_state
+    return dt
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    forward,
+    loss_fn,
+)
+
+__all__ = ["TransformerConfig", "init_params", "forward", "loss_fn"]

@@ -1,0 +1,261 @@
+"""Flagship model: llama-style decoder-only transformer, TPU-first.
+
+Design choices (vs. the reference, which delegates models to torch):
+- Pure functional pytree params (nested dicts of jnp arrays) — shardings
+  attach cleanly with jax.sharding, and optimizer state mirrors the tree.
+- Layer parameters are STACKED along a leading [num_layers] axis and the
+  decoder runs as one ``lax.scan`` — O(1) compile time in depth, and the
+  leading axis doubles as the pipeline-stage axis when pp>1
+  (ray_tpu/parallel/pipeline.py reshapes [L,...] → [S, L/S, ...]).
+- bf16 compute / fp32 params + optimizer, fp32 logits for the loss.
+- GQA attention through ray_tpu.ops.flash_attention (Pallas on TPU);
+  when a sequence-parallel mesh axis is active the caller routes attention
+  through ring attention instead (ray_tpu/parallel/ring.py).
+- ``jax.checkpoint`` per layer to trade FLOPs for HBM (remat).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 11008
+    rope_theta: float = 10000.0
+    max_seq_len: int = 4096
+    dtype: Any = jnp.bfloat16  # compute dtype
+    remat: bool = True
+    # MoE (expert parallelism): 0 = dense MLP.
+    num_experts: int = 0
+    experts_per_token: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def llama7b(cls, **kw):
+        return cls(**{**dict(vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+                             n_kv_heads=32, d_ff=11008), **kw})
+
+    @classmethod
+    def tiny(cls, **kw):
+        """Small config for tests/dryrun."""
+        return cls(**{**dict(vocab_size=256, d_model=64, n_layers=4, n_heads=4,
+                             n_kv_heads=2, d_ff=128, max_seq_len=128), **kw})
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> Params:
+    k_emb, k_layers, k_out = jax.random.split(key, 3)
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    def norm_init(*shape):
+        return jnp.ones(shape, jnp.float32)
+
+    def dense_init(key, *shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * (fan_in**-0.5)).astype(jnp.float32)
+
+    ks = jax.random.split(k_layers, 8)
+    layers = {
+        "attn_norm": norm_init(L, D),
+        "wq": dense_init(ks[0], L, D, H * HD, fan_in=D),
+        "wk": dense_init(ks[1], L, D, KV * HD, fan_in=D),
+        "wv": dense_init(ks[2], L, D, KV * HD, fan_in=D),
+        "wo": dense_init(ks[3], L, H * HD, D, fan_in=H * HD),
+        "mlp_norm": norm_init(L, D),
+    }
+    if cfg.num_experts:
+        E = cfg.num_experts
+        layers.update(
+            router=dense_init(ks[7], L, D, E, fan_in=D),
+            w_gate=dense_init(ks[4], L, E, D, F, fan_in=D),
+            w_up=dense_init(ks[5], L, E, D, F, fan_in=D),
+            w_down=dense_init(ks[6], L, E, F, D, fan_in=F),
+        )
+    else:
+        layers.update(
+            w_gate=dense_init(ks[4], L, D, F, fan_in=D),
+            w_up=dense_init(ks[5], L, D, F, fan_in=D),
+            w_down=dense_init(ks[6], L, F, D, fan_in=F),
+        )
+    return {
+        "embed": dense_init(k_emb, cfg.vocab_size, D, fan_in=1),
+        "layers": layers,
+        "final_norm": norm_init(D),
+        "lm_head": dense_init(k_out, D, cfg.vocab_size, fan_in=D),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x, scale, eps: float = 1e-5):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _rope(x, positions, theta: float):
+    """x: [b, s, h, hd]; rotate pairs (llama convention: split halves)."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]  # [b,s,half]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def attention_block(
+    x,
+    lp: Params,
+    cfg: TransformerConfig,
+    positions,
+    attn_fn: Optional[Callable] = None,
+):
+    """x: [b, s, d]. attn_fn overrides the core attention (ring attention
+    under sequence parallelism)."""
+    b, s, d = x.shape
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = rms_norm(x, lp["attn_norm"])
+    q = (h @ lp["wq"].astype(h.dtype)).reshape(b, s, H, HD)
+    k = (h @ lp["wk"].astype(h.dtype)).reshape(b, s, KV, HD)
+    v = (h @ lp["wv"].astype(h.dtype)).reshape(b, s, KV, HD)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if KV != H:
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [b,h,s,hd]
+    fn = attn_fn or (lambda q, k, v: flash_attention(q, k, v, True, None))
+    o = fn(q, k, v)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, H * HD)
+    return x + o @ lp["wo"].astype(o.dtype)
+
+
+def mlp_block(x, lp: Params, cfg: TransformerConfig):
+    h = rms_norm(x, lp["mlp_norm"])
+    if cfg.num_experts:
+        return x + _moe_mlp(h, lp, cfg)
+    gate = jax.nn.silu(h @ lp["w_gate"].astype(h.dtype))
+    up = h @ lp["w_up"].astype(h.dtype)
+    return x + (gate * up) @ lp["w_down"].astype(h.dtype)
+
+
+def _moe_mlp(h, lp: Params, cfg: TransformerConfig):
+    """Mixtral-style top-k MoE with dense dispatch.
+
+    Dense dispatch (einsum over the expert axis) keeps shapes static so XLA
+    shards experts over the ``ep`` mesh axis and inserts the all-to-alls;
+    a capacity-based sparse dispatch kernel is a later optimization.
+    """
+    b, s, d = h.shape
+    E, K = cfg.num_experts, cfg.experts_per_token
+    logits = (h @ lp["router"].astype(h.dtype)).astype(jnp.float32)  # [b,s,E]
+    weights, idx = jax.lax.top_k(logits, K)
+    weights = jax.nn.softmax(weights, axis=-1)
+    # combine[b,s,E]: weight of each expert for each token (0 if unused)
+    combine = jnp.zeros((b, s, E), jnp.float32).at[
+        jnp.arange(b)[:, None, None], jnp.arange(s)[None, :, None], idx
+    ].set(weights)
+    combine = combine.astype(h.dtype)
+    gate = jax.nn.silu(jnp.einsum("bsd,edf->bsef", h, lp["w_gate"].astype(h.dtype)))
+    up = jnp.einsum("bsd,edf->bsef", h, lp["w_up"].astype(h.dtype))
+    expert_out = jnp.einsum("bsef,efd->bsed", gate * up, lp["w_down"].astype(h.dtype))
+    return jnp.einsum("bsed,bse->bsd", expert_out, combine)
+
+
+def decoder_layer(x, lp: Params, cfg: TransformerConfig, positions, attn_fn=None):
+    x = attention_block(x, lp, cfg, positions, attn_fn)
+    x = mlp_block(x, lp, cfg)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Full forward
+# ---------------------------------------------------------------------------
+
+
+def embed(params: Params, tokens, cfg: TransformerConfig):
+    return params["embed"].astype(cfg.dtype)[tokens]
+
+
+def decoder_stack(params: Params, h, cfg: TransformerConfig, positions, attn_fn=None):
+    """Scan over stacked layers; optionally rematerialized."""
+
+    def layer_fn(carry, lp):
+        out = decoder_layer(carry, lp, cfg, positions, attn_fn)
+        return out, None
+
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+    h, _ = jax.lax.scan(layer_fn, h, params["layers"])
+    return h
+
+
+def unembed(params: Params, h, cfg: TransformerConfig):
+    h = rms_norm(h, params["final_norm"])
+    return (h @ params["lm_head"].astype(h.dtype)).astype(jnp.float32)
+
+
+def forward(params: Params, tokens, cfg: TransformerConfig, attn_fn=None, positions=None):
+    """tokens: [b, s] int32 → logits [b, s, vocab] fp32."""
+    if positions is None:
+        b, s = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+    h = embed(params, tokens, cfg)
+    h = decoder_stack(params, h, cfg, positions, attn_fn)
+    return unembed(params, h, cfg)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig, attn_fn=None):
+    """batch: {"tokens": [b, s+1]} — next-token cross-entropy."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, cfg, attn_fn)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is not None:
+        mask = mask[:, 1:]
+        return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return -ll.mean()
+
+
+def init_shapes(cfg: TransformerConfig):
+    return jax.tree.map(lambda x: x.shape, jax.eval_shape(lambda k: init_params(k, cfg), jax.random.PRNGKey(0)))
+
+
+def num_params(cfg: TransformerConfig) -> int:
+    import math
+
+    return sum(math.prod(s) for s in jax.tree.leaves(init_shapes(cfg), is_leaf=lambda x: isinstance(x, tuple)))
+
+
+def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
+    """Approximate training FLOPs/token (6·N params + attention term)."""
+    attn = 12 * cfg.n_layers * cfg.d_model * seq_len  # fwd+bwd QK^T and PV
+    return 6.0 * num_params(cfg) + attn

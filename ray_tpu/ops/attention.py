@@ -1,0 +1,149 @@
+"""Attention ops: Pallas flash-attention forward for TPU + reference path.
+
+The reference framework has no attention kernels (it orchestrates external
+libraries); on TPU the kernel must be native (SURVEY.md §2.9). Design:
+
+- ``flash_attention``: blocked online-softmax forward as a Pallas kernel
+  (MXU-shaped 128-tiles, fp32 accumulation), with a custom VJP whose
+  backward recomputes via the XLA reference path (flash backward kernel is a
+  later optimization; recompute keeps memory O(seq·d) instead of O(seq²)).
+- ``reference_attention``: straight jnp implementation used for CPU tests,
+  as the VJP recompute path, and as the numerical oracle.
+
+Layouts: q, k, v are [batch, heads, seq, head_dim]; GQA is handled by the
+caller (kv heads repeated before the call or via q head grouping).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def reference_attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
+    *_, q_len, head_dim = q.shape
+    k_len = k.shape[-2]
+    scale = scale if scale is not None else head_dim**-0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((q_len, k_len), dtype=bool), k=k_len - q_len)
+        logits = jnp.where(mask, logits, DEFAULT_MASK_VALUE)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, scale: float):
+    """One (batch·head, q-block) program: online softmax over k blocks."""
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
+    block_q, head_dim = q.shape
+    k_len = k_ref.shape[1]
+    q_blk = pl.program_id(1)
+    q_start = q_blk * block_q
+
+    num_k_blocks = pl.cdiv(k_len, block_k)
+    if causal:
+        # Only k blocks at or before the diagonal contribute.
+        num_k_blocks_needed = jax.lax.div(q_start + block_q - 1, block_k) + 1
+    else:
+        num_k_blocks_needed = num_k_blocks
+
+    def body(kb, carry):
+        acc, m_prev, l_prev = carry
+        k_start = kb * block_k
+        kblk = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, DEFAULT_MASK_VALUE)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=-1)
+        acc = acc * correction[:, None] + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc, m_new, l_new
+
+    init = (
+        jnp.zeros((block_q, head_dim), jnp.float32),
+        jnp.full((block_q,), -jnp.inf, jnp.float32),
+        jnp.zeros((block_q,), jnp.float32),
+    )
+    acc, _, l = jax.lax.fori_loop(0, num_k_blocks_needed, body, init)
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int, block_k: int, interpret: bool):
+    batch, heads, q_len, head_dim = q.shape
+    k_len = k.shape[2]
+    bq = min(block_q, q_len)
+    bk = min(block_k, k_len)
+    qr = q.reshape(batch * heads, q_len, head_dim)
+    kr = k.reshape(batch * heads, k_len, head_dim)
+    vr = v.reshape(batch * heads, k_len, head_dim)
+    grid = (batch * heads, pl.cdiv(q_len, bq))
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, block_k=bk, causal=causal, scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, k_len, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, k_len, head_dim), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch * heads, q_len, head_dim), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(batch, heads, q_len, head_dim)
+
+
+def _use_pallas() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
+    """Flash attention: Pallas kernel on TPU, jnp reference elsewhere."""
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    if _use_pallas():
+        return _flash_forward(q, k, v, causal, s, block_q=256, block_k=256, interpret=False)
+    return reference_attention(q, k, v, causal=causal, scale=s)
+
+
+def _fwd(q, k, v, causal, scale):
+    return flash_attention(q, k, v, causal, scale), (q, k, v)
+
+
+def _bwd(causal, scale, res, g):
+    # Recompute-based backward: O(seq·d) memory, XLA fuses the softmax chain.
+    q, k, v = res
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+
+    def ref(q, k, v):
+        return reference_attention(q, k, v, causal=causal, scale=s)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,4 @@
+from ray_tpu.parallel.mesh import MeshPlan, build_mesh
+from ray_tpu.parallel.train_step import make_train_state, make_train_step
+
+__all__ = ["MeshPlan", "build_mesh", "make_train_state", "make_train_step"]

@@ -1,0 +1,147 @@
+"""MeshPlan: one declarative object lowering to jax.sharding.
+
+This is the native replacement for the reference's delegated parallelism
+(SURVEY.md §2.9: the reference provides DP via torch DDP and leaves
+TP/PP/SP/EP to external libraries; here they are mesh axes):
+
+- dp    data parallel (pure replication of params)
+- fsdp  fully-sharded data parallel (params sharded over this data axis —
+        ZeRO-3 via GSPMD all-gather, cf. the weight-update sharding paper in
+        PAPERS.md)
+- ep    expert parallel (MoE experts sharded; XLA inserts all-to-alls)
+- pp    pipeline parallel (layer stack split into stages;
+        ray_tpu/parallel/pipeline.py runs microbatched GPipe with ppermute)
+- sp    sequence/context parallel (ring attention over the seq axis;
+        ray_tpu/parallel/ring.py)
+- tp    tensor parallel (heads / ffn sharded; Megatron-style pairs of
+        column+row splits so XLA inserts one psum per block)
+
+Axis order puts dp outermost and tp innermost so tp collectives ride the
+fastest ICI links on a real pod (mesh axes map to the physical torus
+major→minor).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "ep", "pp", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    dp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.fsdp * self.ep * self.pp * self.sp * self.tp
+
+    def sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXES}
+
+    @classmethod
+    def data_parallel(cls, n: int) -> "MeshPlan":
+        return cls(dp=n)
+
+    @classmethod
+    def fsdp_plan(cls, n: int) -> "MeshPlan":
+        return cls(fsdp=n)
+
+    def validate(self, n_devices: int):
+        if self.num_devices != n_devices:
+            raise ValueError(
+                f"MeshPlan {self.sizes()} needs {self.num_devices} devices, "
+                f"got {n_devices}"
+            )
+
+
+def build_mesh(plan: MeshPlan, devices: Optional[Sequence[Any]] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    plan.validate(len(devices))
+    arr = np.array(devices).reshape([plan.dp, plan.fsdp, plan.ep, plan.pp, plan.sp, plan.tp])
+    return Mesh(arr, AXES)
+
+
+# ---------------------------------------------------------------------------
+# Sharding rules for the flagship transformer
+# ---------------------------------------------------------------------------
+
+BATCH_AXES = ("dp", "fsdp")  # batch is split over both data axes
+
+
+def param_specs(cfg, plan: MeshPlan, stacked_stage_axis: bool = False) -> Dict[str, Any]:
+    """PartitionSpecs for ray_tpu.models.transformer params.
+
+    2D weights: rows over fsdp (ZeRO-3 shard), cols over tp (Megatron
+    split) — with the row/col roles flipped on the output projections so
+    each attention/MLP block is one column-split matmul followed by one
+    row-split matmul (single psum at the block end).
+
+    Layer stacks carry a leading [n_layers] axis; when ``stacked_stage_axis``
+    the leading axis is the pipeline-stage axis sharded over pp.
+    """
+    L = "pp" if (plan.pp > 1 or stacked_stage_axis) else None
+
+    def lay(*rest):
+        return P(L, *rest)
+
+    layers = {
+        "attn_norm": lay(None),
+        "wq": lay("fsdp", "tp"),
+        "wk": lay("fsdp", "tp"),
+        "wv": lay("fsdp", "tp"),
+        "wo": lay("tp", "fsdp"),
+        "mlp_norm": lay(None),
+    }
+    if getattr(cfg, "num_experts", 0):
+        layers.update(
+            router=lay("fsdp", None),
+            w_gate=lay("ep", "fsdp", "tp"),
+            w_up=lay("ep", "fsdp", "tp"),
+            w_down=lay("ep", "tp", "fsdp"),
+        )
+    else:
+        layers.update(
+            w_gate=lay("fsdp", "tp"),
+            w_up=lay("fsdp", "tp"),
+            w_down=lay("tp", "fsdp"),
+        )
+    return {
+        "embed": P("tp", "fsdp"),
+        "layers": layers,
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+def param_shardings(mesh: Mesh, cfg, plan: MeshPlan, params_tree=None):
+    specs = param_specs(cfg, plan)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def batch_spec(plan: MeshPlan) -> P:
+    """tokens [batch, seq]: batch over data axes. The raw token array keeps
+    its seq dim unsharded (length s+1 rarely divides sp); under sequence
+    parallelism GSPMD reshards the hidden states at the ring-attention
+    shard_map boundary."""
+    return P(BATCH_AXES, None)
+
+
+def batch_sharding(mesh: Mesh, plan: MeshPlan) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(plan))
+
+
+def activation_spec(plan: MeshPlan) -> P:
+    """hidden states [batch, seq, d_model]."""
+    return P(BATCH_AXES, "sp" if plan.sp > 1 else None, None)

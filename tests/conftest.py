@@ -11,15 +11,32 @@ path; see ``__graft_entry__.py``).
 """
 import os
 
-# Must be set before jax is imported anywhere in the test process tree.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU with 8 virtual devices. The env writes are a hard override (the
+# host image exports JAX_PLATFORMS=axon for the TPU tunnel) and are
+# inherited by worker subprocesses the tests spawn.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The env vars above only cover worker subprocesses (spawned fresh). For
+# THIS process they are too late: the image's sitecustomize imports jax at
+# interpreter startup, baking JAX_PLATFORMS=axon into jax's config before
+# this file runs. Backends initialize lazily, so flipping the config before
+# first use is what actually switches this process to CPU — do not remove.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
+
+# NOTE on numerics: this CPU backend's default matmul runs at reduced
+# precision (bf16-class, ~1e-3 relative error). Tests that compare two ways
+# of computing the same numbers either use `jax.default_matmul_precision
+# ("highest")` locally (slow — avoid around pallas interpret mode) or use
+# tolerances sized for the low-precision default.
 
 
 @pytest.fixture
