@@ -1,0 +1,54 @@
+"""ray_tpu.collective — collective communication between tasks/actors.
+
+Reference: python/ray/util/collective/ (API in collective.py). Eager host
+path: TCP ring collectives with KV rendezvous. Compiled TPU path: mesh +
+axis handout for lax.p* inside pjit programs (xla_group.py).
+"""
+from ray_tpu.collective.collective import (
+    GroupManager,
+    allgather,
+    allreduce,
+    allreduce_multigpu,
+    barrier,
+    broadcast,
+    broadcast_multigpu,
+    create_collective_group,
+    declare_collective_group,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    get_world_size,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from ray_tpu.collective.types import Backend, ReduceOp
+from ray_tpu.collective import xla_group
+
+__all__ = [
+    "init_collective_group",
+    "create_collective_group",
+    "declare_collective_group",
+    "destroy_collective_group",
+    "is_group_initialized",
+    "get_rank",
+    "get_world_size",
+    "get_collective_group_size",
+    "allreduce",
+    "allreduce_multigpu",
+    "reduce",
+    "broadcast",
+    "broadcast_multigpu",
+    "allgather",
+    "reducescatter",
+    "barrier",
+    "send",
+    "recv",
+    "ReduceOp",
+    "Backend",
+    "GroupManager",
+    "xla_group",
+]

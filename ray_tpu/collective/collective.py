@@ -1,0 +1,262 @@
+"""Process-group style collectives between tasks/actors.
+
+Reference API surface: python/ray/util/collective/collective.py —
+``init_collective_group`` :120, ``create_collective_group`` :151,
+``allreduce`` :258, ``barrier`` :298, ``reduce/broadcast/allgather/
+reducescatter`` :311-502, p2p ``send/recv`` :531-615, plus the
+``GroupManager`` :40 pattern.
+
+TPU-first split (SURVEY.md §5.8): the *fast* path is in-graph — a group
+hands out a ``jax.sharding.Mesh`` + axis name and collectives are
+``lax.psum`` et al. inside a pjit program riding ICI. The *eager* API
+below is the host/DCN path: ring collectives over TCP with controller-KV
+rendezvous (host_group.py), accepting numpy or jax arrays (jax arrays
+round-trip through host memory and are put back on their devices).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.collective.host_group import HostGroup
+from ray_tpu.collective.types import Backend, ReduceOp
+
+_DECL_NS = "collective_decl"
+
+
+class GroupManager:
+    """Per-process registry of collective groups (reference:
+    collective.py:40)."""
+
+    def __init__(self):
+        self._groups: Dict[str, HostGroup] = {}
+        self._meta: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def create_group(
+        self, group_name: str, world_size: int, rank: int, backend: str
+    ) -> HostGroup:
+        with self._lock:
+            if group_name in self._groups:
+                raise RuntimeError(f"collective group '{group_name}' already initialized")
+            return self._create_locked(group_name, world_size, rank, backend)
+
+    def _create_locked(self, group_name, world_size, rank, backend) -> HostGroup:
+        backend = Backend(backend)
+        group = HostGroup(_kv(), group_name, world_size, rank)
+        self._groups[group_name] = group
+        self._meta[group_name] = {
+            "world_size": world_size,
+            "rank": rank,
+            "backend": backend.value,
+        }
+        return group
+
+    def get_group(self, group_name: str) -> HostGroup:
+        # Check + lazy declarative join under one lock so concurrent actor
+        # tasks (max_concurrency > 1) can't double-create the group.
+        with self._lock:
+            group = self._groups.get(group_name)
+            if group is None:
+                group = self._try_declared_locked(group_name)
+        if group is None:
+            raise RuntimeError(
+                f"collective group '{group_name}' is not initialized in this "
+                "process; call init_collective_group() or declare it with "
+                "create_collective_group()"
+            )
+        return group
+
+    def _try_declared_locked(self, group_name: str) -> Optional[HostGroup]:
+        """Lazy join for declaratively created groups (reference:
+        collective.py:151 create_collective_group): look up this actor's
+        rank by actor id in the KV declaration. Caller holds the lock."""
+        from ray_tpu.runtime_context import get_runtime_context
+
+        actor_id = get_runtime_context().get_actor_id()
+        if actor_id is None:
+            return None
+        raw = _kv().kv_get(_DECL_NS, f"{group_name}/{actor_id}".encode())
+        if raw is None:
+            return None
+        decl = json.loads(raw)
+        return self._create_locked(
+            group_name, decl["world_size"], decl["rank"], decl["backend"]
+        )
+
+    def is_group_exist(self, group_name: str) -> bool:
+        return group_name in self._groups
+
+    def destroy_group(self, group_name: str):
+        with self._lock:
+            group = self._groups.pop(group_name, None)
+            self._meta.pop(group_name, None)
+        if group is not None:
+            group.destroy()
+
+
+_group_mgr = GroupManager()
+
+
+def _kv():
+    from ray_tpu.core.api import _require_worker
+
+    return _require_worker()
+
+
+# ---------------------------------------------------------------------------
+# Group lifecycle
+# ---------------------------------------------------------------------------
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "host",
+    group_name: str = "default",
+):
+    """Join a named collective group from inside a task/actor (reference:
+    collective.py:120)."""
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    _group_mgr.create_group(group_name, world_size, rank, backend)
+
+
+def create_collective_group(
+    actors: Sequence,
+    world_size: int,
+    ranks: Sequence[int],
+    backend: str = "host",
+    group_name: str = "default",
+):
+    """Declare a group over actor handles from the driver (reference:
+    collective.py:151). Actors join lazily on their first collective call."""
+    if len(actors) != len(ranks) or len(set(ranks)) != len(ranks):
+        raise ValueError("ranks must be unique and match actors")
+    if sorted(ranks) != list(range(world_size)):
+        raise ValueError(f"ranks {ranks} must cover 0..{world_size - 1}")
+    kv = _kv()
+    for actor, rank in zip(actors, ranks):
+        decl = json.dumps(
+            {"world_size": world_size, "rank": rank, "backend": backend}
+        ).encode()
+        kv.kv_put(_DECL_NS, f"{group_name}/{actor._actor_id.hex()}".encode(), decl)
+
+
+# Declarative alias kept for surface parity with the reference.
+declare_collective_group = create_collective_group
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _group_mgr.destroy_group(group_name)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return _group_mgr.is_group_exist(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group_mgr.get_group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group_mgr.get_group(group_name).world_size
+
+
+get_world_size = get_collective_group_size
+
+
+# ---------------------------------------------------------------------------
+# Tensor conversion: numpy passthrough; jax arrays round-trip via host.
+# ---------------------------------------------------------------------------
+def _to_host(tensor):
+    if isinstance(tensor, np.ndarray):
+        return tensor, None
+    mod = type(tensor).__module__
+    if mod.startswith("jax"):
+        import jax
+
+        sharding = tensor.sharding if hasattr(tensor, "sharding") else None
+        return np.asarray(tensor), ("jax", sharding)
+    return np.asarray(tensor), None
+
+
+def _restore(arr: np.ndarray, token):
+    if token is None:
+        return arr
+    kind, sharding = token
+    if kind == "jax":
+        import jax
+
+        return jax.device_put(arr, sharding) if sharding is not None else jax.numpy.asarray(arr)
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# Eager collectives
+# ---------------------------------------------------------------------------
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    group = _group_mgr.get_group(group_name)
+    arr, token = _to_host(tensor)
+    return _restore(group.allreduce(arr, op), token)
+
+
+def reduce(
+    tensor, dst_rank: int = 0, group_name: str = "default", op: ReduceOp = ReduceOp.SUM
+):
+    group = _group_mgr.get_group(group_name)
+    arr, token = _to_host(tensor)
+    return _restore(group.reduce(arr, dst_rank, op), token)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    group = _group_mgr.get_group(group_name)
+    arr, token = _to_host(tensor)
+    return _restore(group.broadcast(arr, src_rank), token)
+
+
+def allgather(tensor, group_name: str = "default") -> List:
+    group = _group_mgr.get_group(group_name)
+    arr, token = _to_host(tensor)
+    return [_restore(a, token) for a in group.allgather(arr)]
+
+
+def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    group = _group_mgr.get_group(group_name)
+    arr, token = _to_host(tensor)
+    return _restore(group.reducescatter(arr, op), token)
+
+
+def barrier(group_name: str = "default"):
+    _group_mgr.get_group(group_name).barrier()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0):
+    group = _group_mgr.get_group(group_name)
+    if dst_rank == group.rank:
+        raise ValueError("cannot send to self")
+    arr, _ = _to_host(tensor)
+    # P2P tags live in their own space so they never collide with the
+    # per-step tags used by ring collectives.
+    group.send(arr, dst_rank, tag=tag + 2_000_000)
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0):
+    """Receive a tensor from ``src_rank``. Unlike the reference (which
+    fills a preallocated tensor), returns the received array — shapes
+    travel on the wire, so preallocation is unnecessary."""
+    group = _group_mgr.get_group(group_name)
+    if src_rank == group.rank:
+        raise ValueError("cannot recv from self")
+    return group.recv(src_rank, tag=tag + 2_000_000)
+
+
+# Multi-tensor variants (reference has *_multigpu; on TPU host path these
+# just apply the op per tensor over the same ring).
+def allreduce_multigpu(tensors, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return [allreduce(t, group_name, op) for t in tensors]
+
+
+def broadcast_multigpu(tensors, src_rank: int = 0, group_name: str = "default"):
+    return [broadcast(t, src_rank, group_name) for t in tensors]

@@ -1,0 +1,310 @@
+"""Host (DCN) collective group: TCP ring collectives with KV rendezvous.
+
+Replaces the reference's GLOO group (python/ray/util/collective/
+collective_group/gloo_collective_group.py) and its Redis rendezvous
+(`gloo_util.py`); rendezvous here rides the controller KV, the same
+pattern as the reference NCCL group's GCS-KV `Rendezvous`
+(collective_group/nccl_collective_group.py:29).
+
+Data plane is rank↔rank TCP sockets (no controller in the loop):
+- allreduce: chunked ring reduce-scatter + ring all-gather (bandwidth
+  optimal, 2·(n-1)/n · bytes per link).
+- allgather / reducescatter: the corresponding ring halves.
+- broadcast: ring pass-along from src.
+- send/recv: direct p2p with matching tags.
+
+All ops run on flattened numpy buffers; dtype/shape ride a JSON header.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from queue import Empty, Queue
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.collective.types import ReduceOp
+
+_HDR = struct.Struct("!I")
+
+_REDUCE = {
+    ReduceOp.SUM: lambda a, b: np.add(a, b, out=a),
+    ReduceOp.PRODUCT: lambda a, b: np.multiply(a, b, out=a),
+    ReduceOp.MIN: lambda a, b: np.minimum(a, b, out=a),
+    ReduceOp.MAX: lambda a, b: np.maximum(a, b, out=a),
+}
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("collective peer closed connection")
+        got += r
+    return bytes(buf)
+
+
+def _send_msg(sock: socket.socket, header: dict, payload: bytes | memoryview):
+    hdr = json.dumps(header).encode()
+    with_len = _HDR.pack(len(hdr)) + hdr + _HDR.pack(len(payload))
+    sock.sendall(with_len)
+    if len(payload):
+        sock.sendall(payload)
+
+
+def _recv_msg(sock: socket.socket):
+    hdr_len = _HDR.unpack(_recv_exact(sock, 4))[0]
+    header = json.loads(_recv_exact(sock, hdr_len))
+    payload_len = _HDR.unpack(_recv_exact(sock, 4))[0]
+    payload = _recv_exact(sock, payload_len) if payload_len else b""
+    return header, payload
+
+
+class HostGroup:
+    """One rank's membership in a named host collective group."""
+
+    def __init__(self, kv, group_name: str, world_size: int, rank: int, timeout: float = 60.0):
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self._kv = kv
+        self._ns = f"collective/{group_name}"
+        self._out: Dict[int, socket.socket] = {}
+        self._out_lock = threading.Lock()
+        self._inbox: Dict[int, Queue] = {r: Queue() for r in range(world_size)}
+        self._closed = False
+
+        # Listener for inbound peers.
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("127.0.0.1", 0))
+        self._server.listen(world_size + 2)
+        port = self._server.getsockname()[1]
+        host = socket.gethostbyname(socket.gethostname()) if _multi_host() else "127.0.0.1"
+        self._kv.kv_put(self._ns, f"rank_{rank}".encode(), f"{host}:{port}".encode())
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+        self._wait_members(timeout)
+
+    # -- rendezvous ------------------------------------------------------
+    def _wait_members(self, timeout: float):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            keys = self._kv.kv_keys(self._ns, b"rank_")
+            if len(keys) >= self.world_size:
+                return
+            time.sleep(0.02)
+        raise TimeoutError(
+            f"collective group '{self.group_name}': only "
+            f"{len(self._kv.kv_keys(self._ns, b'rank_'))}/{self.world_size} ranks joined"
+        )
+
+    def _addr(self, peer: int) -> tuple:
+        raw = self._kv.kv_get(self._ns, f"rank_{peer}".encode())
+        if raw is None:
+            raise RuntimeError(f"rank {peer} not registered in group {self.group_name}")
+        host, port = raw.decode().rsplit(":", 1)
+        return host, int(port)
+
+    # -- connections -----------------------------------------------------
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                header, _ = _recv_msg(conn)
+                peer = int(header["rank"])
+            except Exception:
+                conn.close()
+                continue
+            threading.Thread(
+                target=self._reader_loop, args=(conn, peer), daemon=True
+            ).start()
+
+    def _reader_loop(self, conn: socket.socket, peer: int):
+        while not self._closed:
+            try:
+                header, payload = _recv_msg(conn)
+            except (ConnectionError, OSError):
+                # Peer died: fail any blocked recv immediately instead of
+                # letting it run out its timeout (fast failure detection —
+                # the gang restarts sooner).
+                if not self._closed:
+                    self._inbox[peer].put((None, None))
+                return
+            # bytearray keeps the array writable — callers mutate results.
+            arr = np.frombuffer(bytearray(payload), dtype=np.dtype(header["dtype"])).reshape(
+                header["shape"]
+            )
+            self._inbox[peer].put((header.get("tag", 0), arr))
+
+    def _conn(self, peer: int) -> socket.socket:
+        with self._out_lock:
+            sock = self._out.get(peer)
+            if sock is None:
+                sock = socket.create_connection(self._addr(peer), timeout=30)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _send_msg(sock, {"rank": self.rank}, b"")
+                self._out[peer] = sock
+            return sock
+
+    # -- p2p -------------------------------------------------------------
+    def send(self, arr: np.ndarray, dst: int, tag: int = 0):
+        arr = np.ascontiguousarray(arr)
+        _send_msg(
+            self._conn(dst),
+            {"dtype": arr.dtype.str, "shape": list(arr.shape), "tag": tag},
+            memoryview(arr).cast("B"),
+        )
+
+    def recv(self, src: int, tag: int = 0, timeout: float = 60.0) -> np.ndarray:
+        deadline = time.time() + timeout
+        stash = []
+        try:
+            while True:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(f"recv from rank {src} (tag {tag}) timed out")
+                try:
+                    got_tag, arr = self._inbox[src].get(timeout=remaining)
+                except Empty:
+                    raise TimeoutError(f"recv from rank {src} (tag {tag}) timed out")
+                if got_tag is None:
+                    self._inbox[src].put((None, None))  # re-arm for other waiters
+                    raise ConnectionError(
+                        f"collective peer rank {src} disconnected"
+                    )
+                if got_tag == tag:
+                    return arr
+                stash.append((got_tag, arr))
+        finally:
+            for item in stash:
+                self._inbox[src].put(item)
+
+    def _send_async(self, arr: np.ndarray, dst: int, tag: int) -> threading.Thread:
+        t = threading.Thread(target=self.send, args=(arr, dst, tag), daemon=True)
+        t.start()
+        return t
+
+    # -- collectives -----------------------------------------------------
+    def barrier(self, tag: int = 0):
+        self.allreduce(np.zeros(1, np.float32), ReduceOp.SUM, tag=tag | (1 << 24))
+
+    def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM, tag: int = 0) -> np.ndarray:
+        """Ring reduce-scatter + ring all-gather over flattened chunks."""
+        ws, rank = self.world_size, self.rank
+        if ws == 1:
+            return arr
+        shape, dtype = arr.shape, arr.dtype
+        flat = np.ascontiguousarray(arr).reshape(-1).copy()
+        n = flat.size
+        chunk = -(-n // ws)  # ceil
+        padded = np.zeros(chunk * ws, dtype)
+        padded[:n] = flat
+        chunks = padded.reshape(ws, chunk)
+        nxt, prv = (rank + 1) % ws, (rank - 1) % ws
+        reduce_fn = _REDUCE[op]
+        # reduce-scatter: after ws-1 steps, rank owns fully reduced chunk
+        # (rank+1)%ws.
+        for step in range(ws - 1):
+            send_idx = (rank - step) % ws
+            recv_idx = (rank - step - 1) % ws
+            sender = self._send_async(chunks[send_idx], nxt, tag + step)
+            incoming = self.recv(prv, tag + step)
+            reduce_fn(chunks[recv_idx], incoming)
+            sender.join()
+        # all-gather the reduced chunks.
+        for step in range(ws - 1):
+            send_idx = (rank - step + 1) % ws
+            recv_idx = (rank - step) % ws
+            sender = self._send_async(chunks[send_idx], nxt, tag + 1000 + step)
+            chunks[recv_idx] = self.recv(prv, tag + 1000 + step)
+            sender.join()
+        return chunks.reshape(-1)[:n].reshape(shape)
+
+    def reducescatter(
+        self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM, tag: int = 0
+    ) -> np.ndarray:
+        """Input split into world_size equal parts along axis 0; returns
+        this rank's reduced part."""
+        ws, rank = self.world_size, self.rank
+        if arr.shape[0] % ws:
+            raise ValueError(f"reducescatter dim0 {arr.shape[0]} not divisible by {ws}")
+        if ws == 1:
+            return arr
+        parts = [np.ascontiguousarray(p).copy() for p in np.split(arr, ws, axis=0)]
+        nxt, prv = (rank + 1) % ws, (rank - 1) % ws
+        reduce_fn = _REDUCE[op]
+        # Shifted ring so the fully reduced part landing on rank r is part r.
+        for step in range(ws - 1):
+            send_idx = (rank - step - 1) % ws
+            recv_idx = (rank - step - 2) % ws
+            sender = self._send_async(parts[send_idx], nxt, tag + step)
+            reduce_fn(parts[recv_idx], self.recv(prv, tag + step))
+            sender.join()
+        return parts[rank]
+
+    def allgather(self, arr: np.ndarray, tag: int = 0) -> List[np.ndarray]:
+        ws, rank = self.world_size, self.rank
+        if ws == 1:
+            return [arr]
+        out: List[Optional[np.ndarray]] = [None] * ws
+        out[rank] = np.ascontiguousarray(arr)
+        nxt, prv = (rank + 1) % ws, (rank - 1) % ws
+        for step in range(ws - 1):
+            send_idx = (rank - step) % ws
+            recv_idx = (rank - step - 1) % ws
+            sender = self._send_async(out[send_idx], nxt, tag + step)
+            out[recv_idx] = self.recv(prv, tag + step)
+            sender.join()
+        return out  # type: ignore[return-value]
+
+    def broadcast(self, arr: np.ndarray, src: int, tag: int = 0) -> np.ndarray:
+        ws, rank = self.world_size, self.rank
+        if ws == 1:
+            return arr
+        # Pass along the ring starting at src; (src-1)%ws is the tail.
+        if rank == src:
+            self.send(np.ascontiguousarray(arr), (rank + 1) % ws, tag)
+            return arr
+        got = self.recv((rank - 1) % ws, tag)
+        if (rank + 1) % ws != src:
+            self.send(got, (rank + 1) % ws, tag)
+        return got
+
+    def reduce(self, arr: np.ndarray, dst: int, op: ReduceOp = ReduceOp.SUM, tag: int = 0):
+        # Host groups are small; allreduce and keep the value at dst. The
+        # extra all-gather half is the price of code we don't duplicate.
+        out = self.allreduce(arr, op, tag=tag)
+        return out if self.rank == dst else arr
+
+    def destroy(self):
+        self._closed = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._out_lock:
+            for sock in self._out.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._out.clear()
+        self._kv.kv_del(self._ns, f"rank_{self.rank}".encode())
+
+
+def _multi_host() -> bool:
+    import os
+
+    return bool(os.environ.get("RAY_TPU_MULTI_HOST"))

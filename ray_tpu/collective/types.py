@@ -1,0 +1,80 @@
+"""Collective types (reference: python/ray/util/collective/types.py).
+
+ReduceOp + option dataclasses. Tensors may be numpy arrays or jax arrays;
+jax arrays are converted to host numpy for the host (DCN) backend and
+placed back on device afterwards. The fast path on TPU is *in-graph*
+(``lax.psum`` inside a pjit program over the group's mesh) — see
+ray_tpu/collective/xla_group.py.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+class Backend(str, enum.Enum):
+    """Supported backends (reference: collective/types.py Backend).
+
+    - ``HOST``: host-memory ring collectives over TCP with KV rendezvous —
+      the DCN / non-compiled path (replaces the reference's GLOO backend).
+    - ``XLA``: in-graph ICI collectives; the group hands out a
+      ``jax.sharding.Mesh`` + axis name and eager calls jit a shard_map'd
+      ``lax.p*`` when all ranks live in one process, else fall back to HOST.
+    """
+
+    HOST = "host"
+    XLA = "xla"
+
+
+@dataclass
+class AllReduceOptions:
+    reduce_op: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30000
+
+
+@dataclass
+class BarrierOptions:
+    timeout_ms: int = 30000
+
+
+@dataclass
+class ReduceOptions:
+    reduce_op: ReduceOp = ReduceOp.SUM
+    root_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class BroadcastOptions:
+    src_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class AllGatherOptions:
+    timeout_ms: int = 30000
+
+
+@dataclass
+class ReduceScatterOptions:
+    reduce_op: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30000
+
+
+@dataclass
+class SendOptions:
+    dst_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class RecvOptions:
+    src_rank: int = 0
+    timeout_ms: int = 30000

@@ -106,6 +106,10 @@ class TaskExecutor:
             self._run(spec, kind)
         except Exception:
             logger.exception("internal error running task %s", spec.name)
+        finally:
+            from ray_tpu import runtime_context
+
+            runtime_context._set_task(None, None)
 
     # ------------------------------------------------------------------
     def _load_func(self, spec: TaskSpec):
@@ -134,6 +138,11 @@ class TaskExecutor:
 
             self._report(spec, None, TaskCancelledError(spec.task_id.hex()))
             return
+        from ray_tpu import runtime_context
+
+        runtime_context._set_task(
+            spec.task_id.hex(), spec.actor_id.hex() if spec.actor_id else None
+        )
         try:
             args, kwargs = self._resolve_args(spec)
             if kind == "task":
@@ -211,7 +220,9 @@ def main():
     # Make the full public API usable from inside tasks (nested tasks,
     # ray_tpu.get/put in user code) BEFORE any buffered task can run.
     from ray_tpu.core import api
+    from ray_tpu import runtime_context
 
+    runtime_context._set_process(node_id.hex(), worker_id.hex())
     api._attach_worker(core)
     handler.attach_executor(TaskExecutor(core))
 

@@ -231,18 +231,22 @@ def forward(params: Params, tokens, cfg: TransformerConfig, attn_fn=None, positi
     return unembed(params, h, cfg)
 
 
+def token_nll(logits: jax.Array, targets: jax.Array, mask=None):
+    """Mean next-token negative log-likelihood, optionally mask-weighted."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return -ll.mean()
+
+
 def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig, attn_fn=None):
     """batch: {"tokens": [b, s+1]} — next-token cross-entropy."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     logits = forward(params, inputs, cfg, attn_fn)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = batch.get("mask")
-    if mask is not None:
-        mask = mask[:, 1:]
-        return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1)
-    return -ll.mean()
+    return token_nll(logits, targets, mask[:, 1:] if mask is not None else None)
 
 
 def init_shapes(cfg: TransformerConfig):

@@ -44,15 +44,22 @@ def reference_attention(q, k, v, causal: bool = True, scale: Optional[float] = N
 # ---------------------------------------------------------------------------
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, scale: float):
-    """One (batch·head, q-block) program: online softmax over k blocks."""
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, scale: float, k_len_actual: int
+):
+    """One (batch·head, q-block) program: online softmax over k blocks.
+
+    ``k_ref`` is padded to a multiple of ``block_k`` by the wrapper so
+    dynamic k-block slices never clamp (a clamped slice would silently
+    shift key rows); padded columns are masked via ``k_len_actual``.
+    """
     q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
     block_q, head_dim = q.shape
-    k_len = k_ref.shape[1]
+    k_len = k_ref.shape[1]  # padded length, multiple of block_k
     q_blk = pl.program_id(1)
     q_start = q_blk * block_q
 
-    num_k_blocks = pl.cdiv(k_len, block_k)
+    num_k_blocks = k_len // block_k
     if causal:
         # Only k blocks at or before the diagonal contribute.
         num_k_blocks_needed = jax.lax.div(q_start + block_q - 1, block_k) + 1
@@ -67,10 +74,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         s = jax.lax.dot_general(
             q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
-        if causal:
-            q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        # k_len/k_len_actual are trace-time ints: unpadded non-causal runs
+        # skip masking entirely.
+        needs_pad_mask = k_len_actual < k_len
+        if causal or needs_pad_mask:
             k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, DEFAULT_MASK_VALUE)
+            valid = (k_ids < k_len_actual) if needs_pad_mask else True
+            if causal:
+                q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                valid = valid & (q_ids >= k_ids)
+            s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new[:, None])
@@ -98,14 +111,22 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int, block_k: i
     qr = q.reshape(batch * heads, q_len, head_dim)
     kr = k.reshape(batch * heads, k_len, head_dim)
     vr = v.reshape(batch * heads, k_len, head_dim)
+    # Pad K/V so every k-block slice is in bounds (see kernel docstring).
+    k_pad = (-k_len) % bk
+    if k_pad:
+        kr = jnp.pad(kr, ((0, 0), (0, k_pad), (0, 0)))
+        vr = jnp.pad(vr, ((0, 0), (0, k_pad), (0, 0)))
+    k_len_padded = k_len + k_pad
     grid = (batch * heads, pl.cdiv(q_len, bq))
     out = pl.pallas_call(
-        functools.partial(_flash_fwd_kernel, block_k=bk, causal=causal, scale=scale),
+        functools.partial(
+            _flash_fwd_kernel, block_k=bk, causal=causal, scale=scale, k_len_actual=k_len
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, k_len, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, k_len, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, k_len_padded, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, k_len_padded, head_dim), lambda b, i: (b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((batch * heads, q_len, head_dim), q.dtype),

@@ -58,8 +58,8 @@ def ring_attention_local(q, k, v, axis_name: str, scale: Optional[float] = None)
     my_idx = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
 
-    def step(carry, r):
-        k_cur, v_cur, acc, m_run, l_run = carry
+    def merge(carry, k_cur, v_cur, r):
+        acc, m_run, l_run = carry
         src_idx = (my_idx - r) % sp  # whose K/V shard we currently hold
         mode = jnp.where(src_idx == my_idx, 1, jnp.where(src_idx < my_idx, 2, 0))
         num, m_blk, l_blk = _block_attend(q, k_cur, v_cur, scale, mode)
@@ -68,20 +68,26 @@ def ring_attention_local(q, k, v, axis_name: str, scale: Optional[float] = None)
         c_blk = jnp.exp(m_blk - m_new)
         acc = acc * c_run[..., None] + num * c_blk[..., None]
         l_run = l_run * c_run + l_blk * c_blk
-        # Rotate K/V around the ring for the next step (skip after last).
-        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        return acc, m_new, l_run
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(carry, r):
+        k_cur, v_cur, inner = carry
+        inner = merge(inner, k_cur, v_cur, r)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, acc, m_new, l_run), None
+        return (k_nxt, v_nxt, inner), None
 
-    init = (
-        k,
-        v,
+    inner0 = (
         jnp.zeros((b, h, sq, d), jnp.float32),
         jnp.full((b, h, sq), -jnp.inf, jnp.float32),
         jnp.zeros((b, h, sq), jnp.float32),
     )
-    (k, v, acc, m_run, l_run), _ = jax.lax.scan(step, init, jnp.arange(sp))
+    # sp-1 attend+rotate steps, then a final attend with no rotation —
+    # exactly sp-1 ppermute pairs instead of sp.
+    (k, v, inner), _ = jax.lax.scan(step, (k, v, inner0), jnp.arange(sp - 1))
+    acc, m_run, l_run = merge(inner, k, v, sp - 1)
     out = acc / jnp.maximum(l_run, 1e-30)[..., None]
     return out.astype(q.dtype)
 

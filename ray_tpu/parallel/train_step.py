@@ -64,9 +64,8 @@ def build_loss_fn(cfg: tf.TransformerConfig, plan: MeshPlan, mesh: Mesh, num_mic
         staged = split_stages(params["layers"], S)
         h = pipeline_apply(stage_fn, staged, h, positions, mesh, S, num_microbatches)
         logits = tf.unembed(params, h, cfg)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -ll.mean()
+        mask = batch.get("mask")
+        return tf.token_nll(logits, targets, mask[:, 1:] if mask is not None else None)
 
     return loss
 
@@ -123,13 +122,10 @@ def make_train_step(
     mesh: Mesh,
     optimizer=None,
     num_microbatches: int = 4,
-    p_shard=None,
-    opt_shard=None,
 ) -> Callable:
     """jitted (params, opt_state, batch) → (params, opt_state, metrics)."""
     optimizer = optimizer or make_optimizer()
     loss_fn = build_loss_fn(cfg, plan, mesh, num_microbatches)
-    batch_shard = mesh_lib.batch_sharding(mesh, plan)
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)

@@ -1,0 +1,60 @@
+"""Runtime context: who am I, where am I running.
+
+Reference: python/ray/runtime_context.py (``ray.get_runtime_context()`` —
+``get_node_id``, ``get_actor_id``, ``get_task_id``, ``get_worker_id``).
+Process-level fields are set once by the worker entrypoint; task-scoped
+fields are thread-local because user code runs on executor threads.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+_process = {"node_id": None, "worker_id": None, "job_id": "default"}
+_task_local = threading.local()
+
+
+def _set_process(node_id: Optional[str], worker_id: Optional[str]):
+    _process["node_id"] = node_id
+    _process["worker_id"] = worker_id
+
+
+def _set_task(task_id: Optional[str], actor_id: Optional[str]):
+    _task_local.task_id = task_id
+    _task_local.actor_id = actor_id
+
+
+class RuntimeContext:
+    """Snapshot view; create via :func:`get_runtime_context`."""
+
+    def get_node_id(self) -> Optional[str]:
+        return _process["node_id"]
+
+    def get_worker_id(self) -> Optional[str]:
+        return _process["worker_id"]
+
+    def get_job_id(self) -> str:
+        return _process["job_id"]
+
+    def get_task_id(self) -> Optional[str]:
+        return getattr(_task_local, "task_id", None)
+
+    def get_actor_id(self) -> Optional[str]:
+        return getattr(_task_local, "actor_id", None)
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return False
+
+    def get(self) -> dict:
+        return {
+            "node_id": self.get_node_id(),
+            "worker_id": self.get_worker_id(),
+            "task_id": self.get_task_id(),
+            "actor_id": self.get_actor_id(),
+            "job_id": self.get_job_id(),
+        }
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()

@@ -1,0 +1,34 @@
+"""ray_tpu.train — distributed (data/model-parallel) training.
+
+Reference: python/ray/train/ (§2.4 of SURVEY.md).
+"""
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.session import get_checkpoint, get_context, report
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
+from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
+from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "FailureConfig",
+    "RunConfig",
+    "ScalingConfig",
+    "report",
+    "get_context",
+    "get_checkpoint",
+    "DataParallelTrainer",
+    "JaxTrainer",
+    "Result",
+    "TrainWorker",
+    "WorkerGroup",
+    "BackendExecutor",
+    "TrainingFailedError",
+]

@@ -1,0 +1,148 @@
+"""BackendExecutor: PG + worker group + rank env + training drive loop.
+
+Reference: python/ray/train/_internal/backend_executor.py — PG creation
+:219, worker start :135, accelerator-visibility sharing :299
+(``_share_resource_ids`` — CUDA/TPU env vars), rank assignment :369,
+``start_training`` :451, health-check + ``_restart`` :759 (elastic retry).
+"""
+from __future__ import annotations
+
+import logging
+import uuid
+from typing import Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, ActorError, TaskError, WorkerCrashedError
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.session import TrainContext
+from ray_tpu.train.worker_group import WorkerGroup
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+logger = logging.getLogger("ray_tpu.train")
+
+TRAINABLE_FAILURES = (ActorDiedError, ActorError, WorkerCrashedError, TaskError)
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    def __init__(
+        self,
+        scaling: ScalingConfig,
+        experiment_name: str,
+        storage_path: str,
+        max_failures: int = 0,
+    ):
+        self.scaling = scaling
+        self.experiment_name = experiment_name
+        self.storage_path = storage_path
+        self.max_failures = max_failures
+        self.pg = None
+        self.worker_group: Optional[WorkerGroup] = None
+        self._failures = 0
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        self.pg = placement_group(
+            self.scaling.bundles(), strategy=self.scaling.placement_strategy
+        )
+        if not self.pg.wait(timeout_seconds=60):
+            raise TrainingFailedError(
+                f"placement group for {self.scaling.num_workers} workers "
+                f"({self.scaling.worker_resources()}) not placeable"
+            )
+        self.worker_group = WorkerGroup(
+            self.scaling.num_workers,
+            self.scaling.worker_resources(),
+            placement_group=self.pg,
+        )
+
+    def setup_sessions(self, latest_checkpoint: Optional[str]):
+        assert self.worker_group is not None
+        group_name = f"__train__{uuid.uuid4().hex[:8]}"
+        self._group_name = group_name
+        tpu_per_worker = self.scaling.worker_resources().get("TPU", 0)
+        refs = []
+        for w in self.worker_group.workers:
+            ctx = TrainContext(
+                world_size=len(self.worker_group),
+                world_rank=w.world_rank,
+                local_rank=w.local_rank,
+                node_rank=w.node_rank,
+                experiment_name=self.experiment_name,
+                storage_path=self.storage_path,
+            )
+            env = self._visibility_env(w, tpu_per_worker)
+            refs.append(
+                w.actor.setup_session.remote(ctx, group_name, latest_checkpoint, env)
+            )
+        ray_tpu.get(refs)
+
+    def _visibility_env(self, w, tpu_per_worker) -> Dict[str, str]:
+        """Chip isolation for co-located workers (reference:
+        accelerators/tpu.py:155-195 TPU_VISIBLE_CHIPS + backend_executor.py
+        :299 _share_resource_ids)."""
+        if not tpu_per_worker:
+            return {}
+        n = int(tpu_per_worker)
+        start = w.local_rank * n
+        chips = ",".join(str(c) for c in range(start, start + n))
+        return {
+            "TPU_VISIBLE_CHIPS": chips,
+            "TPU_CHIPS_PER_PROCESS_BOUNDS": f"1,{n},1",
+        }
+
+    def start_training(self, train_fn: Callable, config: Optional[dict]) -> List:
+        assert self.worker_group is not None
+        return [
+            w.actor.run_train_fn.remote(train_fn, config)
+            for w in self.worker_group.workers
+        ]
+
+    def next_results(self) -> Optional[List[dict]]:
+        """One result per rank, or None when all loops finished."""
+        assert self.worker_group is not None
+        results = ray_tpu.get(
+            [w.actor.next_result.remote() for w in self.worker_group.workers]
+        )
+        done = [r is None for r in results]
+        if all(done):
+            return None
+        if any(done):
+            raise TrainingFailedError(
+                "ranks reported unevenly: some training loops finished while "
+                "others are still calling report()"
+            )
+        return results
+
+    def can_retry(self) -> bool:
+        self._failures += 1
+        return self.max_failures < 0 or self._failures <= self.max_failures
+
+    def restart(self):
+        """Tear down the gang and rebuild it (reference: _restart :759)."""
+        logger.warning("restarting worker group (failure %d)", self._failures)
+        self.shutdown_workers()
+        self.start()
+
+    def shutdown_workers(self):
+        if self.worker_group is not None:
+            self.worker_group.shutdown()
+            self.worker_group = None
+        if self.pg is not None:
+            try:
+                remove_placement_group(self.pg)
+            except Exception:
+                pass
+            self.pg = None
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            for w in self.worker_group.workers:
+                try:
+                    ray_tpu.get(w.actor.teardown.remote(), timeout=5)
+                except Exception:
+                    pass
+        self.shutdown_workers()

@@ -1,0 +1,146 @@
+"""Checkpoint object + top-k retention manager.
+
+Reference: python/ray/train/_checkpoint.py (Checkpoint = directory handle)
+and train/_internal/checkpoint_manager.py:43,80 (_CheckpointManager).
+Storage is a filesystem path (local or mounted GCS/NFS — the reference uses
+pyarrow.fs; local-path semantics are the common denominator here, and orbax
+handles cloud URIs natively on the TPU path).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from contextlib import contextmanager
+from typing import List, Optional
+
+
+class Checkpoint:
+    """A handle to a directory of checkpoint data."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(os.path.abspath(path))
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        dest = path or tempfile.mkdtemp(prefix="rt_ckpt_")
+        if os.path.abspath(dest) != os.path.abspath(self.path):
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextmanager
+    def as_directory(self):
+        yield self.path
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+
+class ReportedCheckpoint:
+    def __init__(self, checkpoint: Checkpoint, metrics: dict, index: int):
+        self.checkpoint = checkpoint
+        self.metrics = metrics
+        self.index = index
+
+
+class CheckpointManager:
+    """Keeps the top-k checkpoints by score under ``root`` (reference:
+    checkpoint_manager.py:80 register_checkpoint)."""
+
+    def __init__(self, root: str, num_to_keep: Optional[int] = None,
+                 score_attr: Optional[str] = None, score_order: str = "max"):
+        self.root = root
+        self.num_to_keep = num_to_keep
+        self.score_attr = score_attr
+        self.score_order = score_order
+        self._kept: List[ReportedCheckpoint] = []
+        os.makedirs(root, exist_ok=True)
+
+    @property
+    def latest(self) -> Optional[ReportedCheckpoint]:
+        return self._kept[-1] if self._kept else None
+
+    @property
+    def best(self) -> Optional[ReportedCheckpoint]:
+        if not self._kept:
+            return None
+        if not self.score_attr:
+            return self._kept[-1]
+        scored = [c for c in self._kept if self.score_attr in c.metrics]
+        if not scored:
+            return self._kept[-1]
+        return max(
+            scored,
+            key=lambda c: c.metrics[self.score_attr] * (1 if self.score_order == "max" else -1),
+        )
+
+    def register(self, checkpoint: Checkpoint, metrics: dict, index: int) -> ReportedCheckpoint:
+        rc = ReportedCheckpoint(checkpoint, metrics, index)
+        self._kept.append(rc)
+        with open(os.path.join(self.root, "checkpoints.json"), "w") as f:
+            json.dump(
+                [{"path": c.checkpoint.path, "metrics": c.metrics, "index": c.index}
+                 for c in self._kept],
+                f,
+            )
+        self._evict()
+        return rc
+
+    def _evict(self):
+        if self.num_to_keep is None or len(self._kept) <= self.num_to_keep:
+            return
+        # Never evict the most recent (resume anchor); evict worst/oldest.
+        candidates = self._kept[:-1]
+        if self.score_attr:
+            candidates = sorted(
+                candidates,
+                key=lambda c: c.metrics.get(
+                    self.score_attr, float("-inf") if self.score_order == "max" else float("inf")
+                ),
+                reverse=(self.score_order == "min"),
+            )
+        while len(self._kept) > self.num_to_keep and candidates:
+            victim = candidates.pop(0)
+            self._kept.remove(victim)
+            shutil.rmtree(victim.checkpoint.path, ignore_errors=True)
+
+    def sync_from_storage(self):
+        """Register checkpoints that were fully persisted (``.complete``
+        marker — all ranks past the report barrier) but whose report the
+        driver never consumed because the gang died first."""
+        known = {c.checkpoint.path for c in self._kept}
+        found = []
+        for entry in sorted(os.listdir(self.root)):
+            path = os.path.join(self.root, entry)
+            if (
+                entry.startswith("checkpoint_")
+                and os.path.isdir(path)
+                and os.path.exists(os.path.join(path, ".complete"))
+                and path not in known
+            ):
+                try:
+                    index = int(entry.split("_")[-1])
+                except ValueError:
+                    continue
+                found.append((index, path))
+        for index, path in sorted(found):
+            self.register(Checkpoint(path), {}, index)
+
+    @classmethod
+    def restore_state(cls, root: str, **kwargs) -> "CheckpointManager":
+        mgr = cls(root, **kwargs)
+        state_file = os.path.join(root, "checkpoints.json")
+        if os.path.exists(state_file):
+            with open(state_file) as f:
+                for entry in json.load(f):
+                    if os.path.exists(entry["path"]):
+                        mgr._kept.append(
+                            ReportedCheckpoint(
+                                Checkpoint(entry["path"]), entry["metrics"], entry["index"]
+                            )
+                        )
+        return mgr

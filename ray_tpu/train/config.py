@@ -1,0 +1,74 @@
+"""Train configuration dataclasses.
+
+Reference: python/ray/air/config.py — ``ScalingConfig`` :102,
+``FailureConfig`` :394, ``CheckpointConfig`` :444, ``RunConfig`` :593.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many workers and what each one holds.
+
+    ``use_tpu`` replaces the reference's ``use_gpu``; ``topology`` lets the
+    TPU scheduler gang-place workers onto one ICI slice (STRICT_PACK).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    # e.g. "v5p-16": informs slice-aware placement; None = any chips.
+    topology: Optional[str] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            res = dict(self.resources_per_worker)
+            if self.use_tpu:
+                res.setdefault("TPU", 1)
+            return res
+        res = {"CPU": 1.0}
+        if self.use_tpu:
+            res["TPU"] = 1.0
+        return res
+
+    def bundles(self):
+        return [self.worker_resources() for _ in range(self.num_workers)]
+
+
+@dataclass
+class FailureConfig:
+    """max_failures: worker-group restarts before giving up (-1 = infinite)."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Top-k checkpoint retention (reference:
+    train/_internal/checkpoint_manager.py:43)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+
+    def resolve_storage(self) -> str:
+        base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
+        name = self.name or "train_run"
+        return os.path.join(base, name)

@@ -1,0 +1,140 @@
+"""Per-worker training session: ranks, report(), checkpoint access.
+
+Reference: python/ray/train/_internal/session.py:111 (_TrainSession) and
+:403 (``ray.train.report`` — synchronizes ranks, ships results to the
+driver via a queue), train/context.py:26 (TrainContext).
+
+The session lives inside each TrainWorker actor. ``report`` barriers the
+ranks over the worker group's collective group, persists the checkpoint
+directory into run storage, then hands the result to the driver through a
+bounded queue (the driver paces training exactly like the reference's
+TrainingIterator).
+"""
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_session: Optional["_TrainSession"] = None
+
+
+@dataclass
+class TrainContext:
+    world_size: int
+    world_rank: int
+    local_rank: int
+    node_rank: int
+    experiment_name: str
+    storage_path: str
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+
+class _TrainSession:
+    def __init__(self, ctx: TrainContext, group_name: str, latest_checkpoint: Optional[str]):
+        self.ctx = ctx
+        self.group_name = group_name
+        self.result_queue: queue.Queue = queue.Queue(maxsize=1)
+        self.ckpt_seq = 0
+        self.latest_checkpoint = latest_checkpoint
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    # -- worker-side API --------------------------------------------------
+    def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
+        from ray_tpu import collective
+
+        persisted = None
+        if checkpoint is not None:
+            dest = os.path.join(
+                self.ctx.storage_path, f"checkpoint_{self.ckpt_seq:06d}"
+            )
+            os.makedirs(dest, exist_ok=True)
+            # Every rank copies its files into the shared checkpoint dir
+            # (sharded checkpoints: orbax writes disjoint per-host files;
+            # reference: storage.py:508 persist_current_checkpoint).
+            if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            persisted = dest
+        self.ckpt_seq += 1
+        # Rank synchronization barrier (reference session.py:403 semantics).
+        collective.barrier(self.group_name)
+        if persisted is not None:
+            # Past the barrier every rank has persisted its shard; the marker
+            # makes the checkpoint discoverable on restart even if the driver
+            # never consumes this report (rank death races the queue).
+            if self.ctx.world_rank == 0:
+                open(os.path.join(persisted, ".complete"), "w").close()
+            self.latest_checkpoint = persisted
+        # Block until the driver consumed the previous result — keeps
+        # training paced with the driver loop.
+        self.result_queue.put(
+            {
+                "metrics": metrics,
+                "checkpoint": persisted,
+                "ckpt_index": self.ckpt_seq - 1,
+            }
+        )
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return Checkpoint(self.latest_checkpoint) if self.latest_checkpoint else None
+
+    # -- driver-facing (via actor method) ---------------------------------
+    def next_result(self, timeout: Optional[float] = None):
+        """Blocks for the next report; returns None when the loop is done."""
+        while True:
+            try:
+                return self.result_queue.get(timeout=0.2)
+            except queue.Empty:
+                if self.finished.is_set() and self.result_queue.empty():
+                    if self.error is not None:
+                        raise self.error
+                    return None
+
+
+def _set_session(session: Optional[_TrainSession]):
+    global _session
+    _session = session
+
+
+def _get_session() -> _TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "No train session active — this API must be called inside a "
+            "train_loop_per_worker launched by a Trainer"
+        )
+    return _session
+
+
+# ---------------------------------------------------------------------------
+# Public API (reference: ray.train.report / get_context / get_checkpoint)
+# ---------------------------------------------------------------------------
+def report(metrics: dict, checkpoint: Optional[Checkpoint] = None):
+    _get_session().report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    return _get_session().ctx
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return _get_session().get_checkpoint()

@@ -1,0 +1,178 @@
+"""Generic actor worker group + the TrainWorker actor.
+
+Reference: python/ray/train/_internal/worker_group.py — ``RayTrainWorker``
+:19-35 (an actor that executes arbitrary functions), ``execute/
+execute_single(_async)`` :233-316, add/remove workers :318-361; rank sort
+by node :363.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.session import TrainContext, _TrainSession, _set_session
+
+
+class TrainWorker:
+    """Actor hosting one training rank. ``run_train_fn`` occupies one actor
+    thread for the whole training loop; ``next_result``/``execute`` run on
+    the other threads (max_concurrency > 1)."""
+
+    def __init__(self):
+        self._session: Optional[_TrainSession] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- generic execution (reference worker_group.py:19 __execute) -------
+    def execute(self, fn: Callable, *args, **kwargs):
+        return fn(*args, **kwargs)
+
+    def node_info(self) -> dict:
+        from ray_tpu.runtime_context import get_runtime_context
+
+        return {"node_id": get_runtime_context().get_node_id(), "pid": os.getpid()}
+
+    # -- training lifecycle ----------------------------------------------
+    def setup_session(
+        self,
+        ctx: TrainContext,
+        group_name: str,
+        latest_checkpoint: Optional[str],
+        env_vars: Optional[Dict[str, str]] = None,
+    ):
+        from ray_tpu import collective
+
+        for k, v in (env_vars or {}).items():
+            os.environ[k] = v
+        self._session = _TrainSession(ctx, group_name, latest_checkpoint)
+        _set_session(self._session)
+        # Join the rank-sync collective group for report() barriers.
+        collective.init_collective_group(
+            ctx.world_size, ctx.world_rank, "host", group_name
+        )
+        return True
+
+    def run_train_fn(self, train_fn: Callable, config: Optional[dict]):
+        """Runs the user loop to completion; reports stream via the session."""
+        import inspect
+
+        session = self._session
+        assert session is not None, "setup_session must run first"
+        try:
+            if len(inspect.signature(train_fn).parameters) >= 1:
+                train_fn(config if config is not None else {})
+            else:
+                train_fn()
+        except BaseException as e:  # noqa: BLE001 — surfaced to the driver
+            session.error = e
+            session.finished.set()
+            raise
+        session.finished.set()
+        return True
+
+    def next_result(self):
+        assert self._session is not None
+        return self._session.next_result()
+
+    def teardown(self):
+        from ray_tpu import collective
+
+        if self._session is not None:
+            try:
+                collective.destroy_collective_group(self._session.group_name)
+            except Exception:
+                pass
+            _set_session(None)
+            self._session = None
+        return True
+
+
+@dataclass
+class WorkerMetadata:
+    actor: Any
+    node_id: str
+    pid: int
+    world_rank: int = -1
+    local_rank: int = -1
+    node_rank: int = -1
+
+
+class WorkerGroup:
+    """Creates and addresses a gang of TrainWorker actors (reference:
+    worker_group.py:102 start)."""
+
+    def __init__(
+        self,
+        num_workers: int,
+        resources_per_worker: Dict[str, float],
+        placement_group=None,
+        max_concurrency: int = 4,
+    ):
+        self.num_workers = num_workers
+        self.workers: List[WorkerMetadata] = []
+        remote_cls = ray_tpu.remote(TrainWorker)
+        opts: Dict[str, Any] = {
+            "max_concurrency": max_concurrency,
+            "num_cpus": resources_per_worker.get("CPU", 1),
+        }
+        extra = {k: v for k, v in resources_per_worker.items() if k != "CPU"}
+        if extra:
+            opts["resources"] = extra
+        handles = []
+        for i in range(num_workers):
+            o = dict(opts)
+            if placement_group is not None:
+                from ray_tpu.util.scheduling_strategies import (
+                    PlacementGroupSchedulingStrategy,
+                )
+
+                o["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                    placement_group=placement_group, placement_group_bundle_index=i
+                )
+            handles.append(remote_cls.options(**o).remote())
+        infos = ray_tpu.get([h.node_info.remote() for h in handles])
+        self.workers = [
+            WorkerMetadata(actor=h, node_id=i["node_id"], pid=i["pid"])
+            for h, i in zip(handles, infos)
+        ]
+        self._assign_ranks()
+
+    def _assign_ranks(self):
+        """Ranks sorted so co-located workers get contiguous ranks
+        (reference: backend_executor.py:369 + worker_group.py:363)."""
+        order = sorted(range(len(self.workers)), key=lambda i: (self.workers[i].node_id, i))
+        node_rank_map: Dict[str, int] = {}
+        local_counter: Dict[str, int] = {}
+        for rank, idx in enumerate(order):
+            w = self.workers[idx]
+            if w.node_id not in node_rank_map:
+                node_rank_map[w.node_id] = len(node_rank_map)
+                local_counter[w.node_id] = 0
+            w.world_rank = rank
+            w.node_rank = node_rank_map[w.node_id]
+            w.local_rank = local_counter[w.node_id]
+            local_counter[w.node_id] += 1
+        self.workers.sort(key=lambda w: w.world_rank)
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return ray_tpu.get(self.execute_async(fn, *args, **kwargs))
+
+    def execute_async(self, fn: Callable, *args, **kwargs):
+        return [w.actor.execute.remote(fn, *args, **kwargs) for w in self.workers]
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        return ray_tpu.get(self.workers[rank].actor.execute.remote(fn, *args, **kwargs))
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w.actor)
+            except Exception:
+                pass
+        self.workers = []
+
+    def __len__(self):
+        return len(self.workers)

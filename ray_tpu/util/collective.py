@@ -1,0 +1,4 @@
+"""Alias so the reference's import path works: ``ray.util.collective`` →
+``ray_tpu.util.collective`` (reference: python/ray/util/collective/)."""
+from ray_tpu.collective import *  # noqa: F401,F403
+from ray_tpu.collective import __all__  # noqa: F401

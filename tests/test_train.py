@@ -1,0 +1,129 @@
+"""Train tests: JaxTrainer end-to-end, report/checkpoint, failure restart.
+
+Reference test model: python/ray/train/tests/test_backend.py,
+test_torch_trainer.py (tiny end-to-end runs + failure injection).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+def test_trainer_reports_and_ranks(ray_start_regular, tmp_path):
+    def loop(config):
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        for step in range(3):
+            train.report({"step": step, "rank": ctx.get_world_rank(), "ws": ctx.get_world_size()})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t1", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert result.metrics["ws"] == 2
+    assert len(result.metrics_history) == 3
+
+
+def test_trainer_checkpoint_topk(ray_start_regular, tmp_path):
+    def loop(config):
+        import tempfile
+
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        for step in range(4):
+            with tempfile.TemporaryDirectory() as d:
+                if ctx.get_world_rank() == 0:
+                    with open(os.path.join(d, "model.npy"), "wb") as f:
+                        np.save(f, np.full((3,), step, np.float32))
+                train.report(
+                    {"score": float(step)}, checkpoint=train.Checkpoint.from_directory(d)
+                )
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="t2",
+            storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=2, checkpoint_score_attribute="score"
+            ),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    # Best checkpoint = highest score = last step.
+    arr = np.load(os.path.join(result.checkpoint.path, "model.npy"))
+    np.testing.assert_array_equal(arr, np.full((3,), 3, np.float32))
+    # top-k eviction: at most 2 checkpoint dirs remain.
+    ckpts = [d for d in os.listdir(result.path) if d.startswith("checkpoint_")]
+    assert len(ckpts) == 2, ckpts
+
+
+def test_trainer_failure_restart_resumes_from_checkpoint(ray_start_regular, tmp_path):
+    marker = str(tmp_path / "died_once")
+
+    def loop(config):
+        import tempfile
+
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            start = int(np.load(os.path.join(ckpt.path, "step.npy"))) + 1
+        for step in range(start, 4):
+            if step == 2 and ctx.get_world_rank() == 0 and not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                os._exit(1)  # hard kill: actor dies mid-training
+            with tempfile.TemporaryDirectory() as d:
+                if ctx.get_world_rank() == 0:
+                    np.save(os.path.join(d, "step.npy"), np.int64(step))
+                train.report({"step": step, "resumed_from": start},
+                             checkpoint=train.Checkpoint.from_directory(d))
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="t3",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+    # Second incarnation resumed from the step-1 checkpoint, not scratch.
+    assert result.metrics["resumed_from"] == 2
+
+
+def test_trainer_exhausts_failures(ray_start_regular, tmp_path):
+    def loop():
+        raise RuntimeError("boom")
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t4", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is not None
