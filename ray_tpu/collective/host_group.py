@@ -84,7 +84,9 @@ class HostGroup:
         # Listener for inbound peers.
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._server.bind(("127.0.0.1", 0))
+        # Multi-host: bind all interfaces so cross-host peers can reach the
+        # advertised external IP; single-host stays loopback-only.
+        self._server.bind(("0.0.0.0" if _multi_host() else "127.0.0.1", 0))
         self._server.listen(world_size + 2)
         port = self._server.getsockname()[1]
         host = socket.gethostbyname(socket.gethostname()) if _multi_host() else "127.0.0.1"

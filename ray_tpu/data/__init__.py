@@ -1,0 +1,113 @@
+"""ray_tpu.data: streaming distributed data processing for TPU ingest.
+
+Reference: python/ray/data/__init__.py (read_* / from_* factory surface).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.dataset import Dataset, GroupedData
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.datasource import (
+    BinaryDatasource,
+    CSVDatasource,
+    Datasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    NumpyFileDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    TextDatasource,
+)
+from ray_tpu.data.logical import Read
+
+
+def _read(ds: Datasource, parallelism: int = -1) -> Dataset:
+    return Dataset(Read(name=f"Read{ds.name}", datasource=ds, parallelism=parallelism))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    return _read(RangeDatasource(n), parallelism)
+
+
+def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = -1) -> Dataset:
+    return _read(RangeDatasource(n, tensor_shape=tuple(shape)), parallelism)
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    return _read(ItemsDatasource(list(items)), parallelism)
+
+
+def from_numpy(arrays, *, parallelism: int = -1) -> Dataset:
+    if isinstance(arrays, np.ndarray):
+        arrays = {"data": arrays}
+    return _read(NumpyDatasource({k: np.asarray(v) for k, v in arrays.items()}), parallelism)
+
+
+def from_pandas(df, *, parallelism: int = -1) -> Dataset:
+    return _read(
+        NumpyDatasource({c: df[c].to_numpy() for c in df.columns}), parallelism
+    )
+
+
+def read_csv(paths, *, parallelism: int = -1) -> Dataset:
+    return _read(CSVDatasource(paths), parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    return _read(JSONDatasource(paths), parallelism)
+
+
+def read_text(paths, *, parallelism: int = -1) -> Dataset:
+    return _read(TextDatasource(paths), parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
+    return _read(BinaryDatasource(paths), parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
+    return _read(NumpyFileDatasource(paths), parallelism)
+
+
+def read_parquet(paths, *, parallelism: int = -1) -> Dataset:
+    return _read(ParquetDatasource(paths), parallelism)
+
+
+def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:
+    return _read(ds, parallelism)
+
+
+__all__ = [
+    "Dataset",
+    "DataIterator",
+    "GroupedData",
+    "Datasource",
+    "Block",
+    "BlockAccessor",
+    "BlockMetadata",
+    "AggregateFn",
+    "Count",
+    "Sum",
+    "Min",
+    "Max",
+    "Mean",
+    "Std",
+    "range",
+    "range_tensor",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "read_csv",
+    "read_json",
+    "read_text",
+    "read_binary_files",
+    "read_numpy",
+    "read_parquet",
+    "read_datasource",
+]

@@ -1,0 +1,142 @@
+"""Aggregation functions for groupby / global aggregates.
+
+Reference: python/ray/data/aggregate.py (AggregateFn, Count/Sum/Min/Max/
+Mean/Std) — Std uses Welford-style merge of (count, mean, M2).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+class AggregateFn:
+    def __init__(
+        self,
+        init: Callable[[], Any],
+        accumulate_row: Callable[[Any, Any], Any],
+        merge: Callable[[Any, Any], Any],
+        finalize: Callable[[Any], Any] = lambda a: a,
+        name: str = "agg",
+        on: Optional[str] = None,
+    ):
+        self.init = init
+        self.accumulate_row = accumulate_row
+        self.merge = merge
+        self.finalize = finalize
+        self.name = name
+        self.on = on
+
+    def _value(self, row):
+        if self.on is None:
+            return row
+        return row[self.on]
+
+
+class Count(AggregateFn):
+    def __init__(self):
+        super().__init__(
+            init=lambda: 0,
+            accumulate_row=lambda a, r: a + 1,
+            merge=lambda a, b: a + b,
+            name="count()",
+        )
+
+
+class Sum(AggregateFn):
+    def __init__(self, on: Optional[str] = None):
+        super().__init__(
+            init=lambda: 0,
+            accumulate_row=lambda a, r: a + self._value(r),
+            merge=lambda a, b: a + b,
+            name=f"sum({on or ''})",
+            on=on,
+        )
+
+
+class Min(AggregateFn):
+    def __init__(self, on: Optional[str] = None):
+        super().__init__(
+            init=lambda: None,
+            accumulate_row=lambda a, r: self._value(r) if a is None else min(a, self._value(r)),
+            merge=lambda a, b: b if a is None else (a if b is None else min(a, b)),
+            name=f"min({on or ''})",
+            on=on,
+        )
+
+
+class Max(AggregateFn):
+    def __init__(self, on: Optional[str] = None):
+        super().__init__(
+            init=lambda: None,
+            accumulate_row=lambda a, r: self._value(r) if a is None else max(a, self._value(r)),
+            merge=lambda a, b: b if a is None else (a if b is None else max(a, b)),
+            name=f"max({on or ''})",
+            on=on,
+        )
+
+
+class Mean(AggregateFn):
+    def __init__(self, on: Optional[str] = None):
+        super().__init__(
+            init=lambda: (0, 0.0),
+            accumulate_row=lambda a, r: (a[0] + 1, a[1] + self._value(r)),
+            merge=lambda a, b: (a[0] + b[0], a[1] + b[1]),
+            finalize=lambda a: a[1] / a[0] if a[0] else None,
+            name=f"mean({on or ''})",
+            on=on,
+        )
+
+
+class Std(AggregateFn):
+    def __init__(self, on: Optional[str] = None, ddof: int = 1):
+        def acc(a, r):
+            n, mean, m2 = a
+            x = self._value(r)
+            n += 1
+            d = x - mean
+            mean += d / n
+            m2 += d * (x - mean)
+            return (n, mean, m2)
+
+        def merge(a, b):
+            na, ma, m2a = a
+            nb, mb, m2b = b
+            if na == 0:
+                return b
+            if nb == 0:
+                return a
+            n = na + nb
+            d = mb - ma
+            return (n, ma + d * nb / n, m2a + m2b + d * d * na * nb / n)
+
+        super().__init__(
+            init=lambda: (0, 0.0, 0.0),
+            accumulate_row=acc,
+            merge=merge,
+            finalize=lambda a: float(np.sqrt(a[2] / (a[0] - ddof))) if a[0] > ddof else None,
+            name=f"std({on or ''})",
+            on=on,
+        )
+
+
+def aggregate_block(block: Block, key: Optional[str], aggs) -> Block:
+    """Per-partition grouped aggregation; runs inside a remote task."""
+    acc = BlockAccessor.for_block(block)
+    groups: dict = {}
+    for row in acc.iter_rows():
+        k = row[key] if key is not None else None
+        if k not in groups:
+            groups[k] = [a.init() for a in aggs]
+        st = groups[k]
+        for i, a in enumerate(aggs):
+            st[i] = a.accumulate_row(st[i], row)
+    rows = []
+    for k in sorted(groups, key=lambda x: (x is None, x)):
+        row = {} if key is None else {key: k}
+        for a, s in zip(aggs, groups[k]):
+            row[a.name] = a.finalize(s)
+        rows.append(row)
+    return rows

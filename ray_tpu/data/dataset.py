@@ -1,0 +1,386 @@
+"""Dataset: the lazy, streaming-executed distributed data API.
+
+Reference: python/ray/data/dataset.py:139 (Dataset, 5,255 L). Transforms
+append logical operators; execution happens when an action
+(take/count/iter_batches/materialize/...) pulls on the stream, via the
+streaming executor over remote tasks.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.aggregate import AggregateFn, Count as _CountAgg, aggregate_block
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.executor import SplitCoordinator, StreamingExecutor, plan_to_operators
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.logical import (
+    AllToAll,
+    InputData,
+    Limit,
+    LogicalOp,
+    LogicalPlan,
+    MapLike,
+    Read,
+)
+
+
+class Dataset:
+    def __init__(self, dag: LogicalOp):
+        self._dag = dag
+
+    # ------------------------------------------------------------------
+    # Transforms (lazy)
+    # ------------------------------------------------------------------
+    def _append(self, op: LogicalOp) -> "Dataset":
+        op.input = self._dag
+        return Dataset(op)
+
+    def map(self, fn: Callable, **opts) -> "Dataset":
+        return self._append(_map_op("map", fn, opts))
+
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_size: Optional[int] = None,
+        concurrency: Optional[int] = None,
+        fn_args: tuple = (),
+        fn_kwargs: Optional[dict] = None,
+        fn_constructor_args: tuple = (),
+        num_cpus: float = 1,
+        num_tpus: float = 0,
+        **_,
+    ) -> "Dataset":
+        if isinstance(fn, type) and not concurrency:
+            raise ValueError(
+                "class-based map_batches UDFs are stateful and run in an actor "
+                "pool; pass concurrency=N (reference: Dataset.map_batches "
+                "compute semantics)"
+            )
+        op = MapLike(
+            name=f"MapBatches({getattr(fn, '__name__', type(fn).__name__)})",
+            kind="map_batches",
+            fn=fn,
+            fn_args=fn_args,
+            fn_kwargs=fn_kwargs or {},
+            batch_size=batch_size,
+            compute_actors=concurrency if isinstance(fn, type) else 0,
+            fn_constructor_args=fn_constructor_args,
+            num_cpus=num_cpus,
+            num_tpus=num_tpus,
+        )
+        return self._append(op)
+
+    def flat_map(self, fn: Callable, **opts) -> "Dataset":
+        return self._append(_map_op("flat_map", fn, opts))
+
+    def filter(self, fn: Callable, **opts) -> "Dataset":
+        return self._append(_map_op("filter", fn, opts))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def add(batch, name=name, fn=fn):
+            batch = dict(batch)
+            batch[name] = np.asarray(fn(batch))
+            return batch
+
+        return self.map_batches(add)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda b, cols=tuple(cols): {k: v for k, v in b.items() if k not in cols}
+        )
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda b, cols=tuple(cols): {k: b[k] for k in cols}
+        )
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._append(AllToAll(name="Repartition", kind="repartition", num_outputs=num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None, num_blocks: Optional[int] = None) -> "Dataset":
+        return self._append(
+            AllToAll(name="RandomShuffle", kind="shuffle", num_outputs=num_blocks, seed=seed)
+        )
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._append(
+            AllToAll(name=f"Sort({key})", kind="sort", key=key, descending=descending)
+        )
+
+    def limit(self, n: int) -> "Dataset":
+        return self._append(Limit(name=f"Limit[{n}]", limit=n))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Lazy union: branches execute only when this dataset is pulled on,
+        streamed one branch after another."""
+        from ray_tpu.data.logical import Union as LUnion
+
+        return Dataset(
+            LUnion(name="Union", input=self._dag, others=[o._dag for o in others])
+        )
+
+    def groupby(self, key: Optional[str]) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def random_sample(self, fraction: float, *, seed: Optional[int] = None) -> "Dataset":
+        def sample(batch, fraction=fraction, seed=seed):
+            n = len(next(iter(batch.values()))) if batch else 0
+            rng = np.random.default_rng(seed)
+            mask = rng.random(n) < fraction
+            return {k: np.asarray(v)[mask] for k, v in batch.items()}
+
+        return self.map_batches(sample)
+
+    # ------------------------------------------------------------------
+    # Execution
+    # ------------------------------------------------------------------
+    def _plan(self) -> LogicalPlan:
+        return LogicalPlan(self._dag).optimized()
+
+    def _execute_bundles(self):
+        ops = plan_to_operators(self._plan())
+        return StreamingExecutor(ops).iter_bundles()
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(self._execute_bundles)
+
+    def iter_rows(self):
+        return self.iterator().iter_rows()
+
+    def iter_batches(self, **kw):
+        return self.iterator().iter_batches(**kw)
+
+    def iter_jax_batches(self, **kw):
+        return self.iterator().iter_jax_batches(**kw)
+
+    def iter_torch_batches(self, **kw):
+        return self.iterator().iter_torch_batches(**kw)
+
+    def streaming_split(self, n: int, *, equal: bool = True) -> List[DataIterator]:
+        """N concurrent iterators over one shared execution (reference:
+        dataset.py streaming_split → StreamSplitDataIterator); the canonical
+        per-training-worker ingest path."""
+        coord = SplitCoordinator(plan_to_operators(self._plan()), n, equal)
+        return [
+            DataIterator(functools.partial(coord.iter_split, i)) for i in range(n)
+        ]
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for row in self.limit(n).iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(b.meta.num_rows for b in self._execute_bundles())
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        for b in self._execute_bundles():
+            if b.meta.schema:
+                return b.meta.schema
+        return None
+
+    def num_blocks(self) -> int:
+        return sum(1 for _ in self._execute_bundles())
+
+    def size_bytes(self) -> int:
+        return sum(b.meta.size_bytes for b in self._execute_bundles())
+
+    def materialize(self) -> "Dataset":
+        """Execute now, pin blocks in the object store, return a dataset
+        over the materialized bundles (reference: Dataset.materialize)."""
+        bundles = [(b.ref, b.meta) for b in self._execute_bundles()]
+        return Dataset(InputData(name="Materialized", bundles=bundles))
+
+    def stats(self) -> List[dict]:
+        ops = plan_to_operators(self._plan())
+        ex = StreamingExecutor(ops)
+        for _ in ex.iter_bundles():
+            pass
+        return ex.stats()
+
+    def to_pandas(self):
+        import pandas as pd
+
+        blocks = [
+            BlockAccessor.for_block(ray_tpu.get(b.ref)).to_pandas()
+            for b in self._execute_bundles()
+        ]
+        if not blocks:
+            return pd.DataFrame()
+        return pd.concat(blocks, ignore_index=True)
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        batches = [
+            BlockAccessor.for_block(ray_tpu.get(b.ref)).to_batch()
+            for b in self._execute_bundles()
+        ]
+        batches = [b for b in batches if b]
+        if not batches:
+            return {}
+        return {k: np.concatenate([np.asarray(b[k]) for b in batches]) for k in batches[0]}
+
+    # Global aggregates -------------------------------------------------
+    def aggregate(self, *aggs: AggregateFn) -> Dict[str, Any]:
+        rows = self.groupby(None)._aggregate_rows(*aggs)
+        return rows[0] if rows else {}
+
+    def sum(self, on: str):
+        from ray_tpu.data.aggregate import Sum
+
+        return self.aggregate(Sum(on)).get(f"sum({on})")
+
+    def min(self, on: str):
+        from ray_tpu.data.aggregate import Min
+
+        return self.aggregate(Min(on)).get(f"min({on})")
+
+    def max(self, on: str):
+        from ray_tpu.data.aggregate import Max
+
+        return self.aggregate(Max(on)).get(f"max({on})")
+
+    def mean(self, on: str):
+        from ray_tpu.data.aggregate import Mean
+
+        return self.aggregate(Mean(on)).get(f"mean({on})")
+
+    def std(self, on: str):
+        from ray_tpu.data.aggregate import Std
+
+        return self.aggregate(Std(on)).get(f"std({on})")
+
+    def __repr__(self):
+        names = [op.name for op in self._dag.chain()]
+        return f"Dataset({' -> '.join(names)})"
+
+
+class GroupedData:
+    """Reference: python/ray/data/grouped_data.py."""
+
+    def __init__(self, ds: Dataset, key: Optional[str]):
+        self._ds = ds
+        self._key = key
+
+    def _aggregate_rows(self, *aggs: AggregateFn) -> List[dict]:
+        key = self._key
+        agg_list = list(aggs)
+        if key is None:
+            # Global aggregate: tree-merge unfinalized accumulator states.
+            return [_merge_global(self._ds, agg_list)]
+        # Hash-partition by key so each partition holds whole groups, then
+        # aggregate partition-side in remote tasks.
+        ds = self._ds._append(
+            AllToAll(name=f"GroupBy({key})", kind="aggregate", key=key)
+        )
+        fn = ray_tpu.remote(num_returns=1)(aggregate_block)
+        row_refs = [
+            fn.remote(bundle.ref, key, agg_list) for bundle in ds._execute_bundles()
+        ]
+        partials: List[dict] = []
+        for rows in ray_tpu.get(row_refs):
+            partials.extend(rows)
+        return sorted(partials, key=lambda r: (r[key] is None, r[key]))
+
+    def aggregate(self, *aggs: AggregateFn) -> Dataset:
+        rows = self._aggregate_rows(*aggs)
+        from ray_tpu.data import from_items
+
+        return from_items(rows)
+
+    def count(self) -> Dataset:
+        return self.aggregate(_CountAgg())
+
+    def sum(self, on: str) -> Dataset:
+        from ray_tpu.data.aggregate import Sum
+
+        return self.aggregate(Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        from ray_tpu.data.aggregate import Min
+
+        return self.aggregate(Min(on))
+
+    def max(self, on: str) -> Dataset:
+        from ray_tpu.data.aggregate import Max
+
+        return self.aggregate(Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        from ray_tpu.data.aggregate import Mean
+
+        return self.aggregate(Mean(on))
+
+    def std(self, on: str) -> Dataset:
+        from ray_tpu.data.aggregate import Std
+
+        return self.aggregate(Std(on))
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        key = self._key
+        ds = self._ds._append(
+            AllToAll(name=f"GroupBy({key})", kind="aggregate", key=key)
+        )
+
+        def apply_groups(batch, key=key, fn=fn):
+            acc = BlockAccessor.for_block(batch)
+            groups: dict = {}
+            for row in acc.iter_rows():
+                groups.setdefault(row[key], []).append(row)
+            out = []
+            for k in sorted(groups, key=lambda x: (x is None, x)):
+                res = fn(groups[k])
+                out.extend(res if isinstance(res, list) else [res])
+            return BlockAccessor.for_block(out).to_batch()
+
+        # Applies per whole partition (batch_size=None → no sub-batching).
+        return Dataset(
+            MapLike(
+                name=f"MapGroups({key})",
+                kind="map_batches",
+                fn=apply_groups,
+                input=ds._dag,
+            )
+        )
+
+
+def _merge_global(ds: Dataset, aggs: List[AggregateFn]) -> dict:
+    """Tree-merge unfinalized accumulator states for a global aggregate."""
+
+    def partial_states(block, aggs=aggs):
+        states = [a.init() for a in aggs]
+        for row in BlockAccessor.for_block(block).iter_rows():
+            for i, a in enumerate(aggs):
+                states[i] = a.accumulate_row(states[i], row)
+        return states
+
+    state_refs = []
+    fn = ray_tpu.remote(num_returns=1)(partial_states)
+    for bundle in ds._execute_bundles():
+        state_refs.append(fn.remote(bundle.ref))
+    merged = [a.init() for a in aggs]
+    for states in ray_tpu.get(state_refs):
+        merged = [a.merge(m, s) for a, m, s in zip(aggs, merged, states)]
+    return {a.name: a.finalize(m) for a, m in zip(aggs, merged)}
+
+
+def _map_op(kind: str, fn: Callable, opts: dict) -> MapLike:
+    return MapLike(
+        name=f"{kind.title().replace('_','')}({getattr(fn, '__name__', 'fn')})",
+        kind=kind,
+        fn=fn,
+        fn_args=opts.get("fn_args", ()),
+        fn_kwargs=opts.get("fn_kwargs", {}) or {},
+        num_cpus=opts.get("num_cpus", 1),
+        num_tpus=opts.get("num_tpus", 0),
+    )

@@ -1,0 +1,222 @@
+"""Datasources: lazily-planned read tasks.
+
+Reference: python/ray/data/datasource/ (Datasource/ReadTask/Reader) and
+python/ray/data/_internal/datasource/ (per-format impls). Each datasource
+plans ``ReadTask``s — serializable zero-arg callables that yield blocks —
+so reads execute remotely, in parallel, and only when the streaming
+executor pulls on them.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import json as _json
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+
+
+@dataclass
+class ReadTask:
+    read_fn: Callable[[], Iterable[Block]]
+    metadata: BlockMetadata
+
+
+class Datasource:
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__.replace("Datasource", "")
+
+
+class RangeDatasource(Datasource):
+    """``range(n)`` / ``range_tensor`` (reference:
+    python/ray/data/_internal/datasource/range_datasource.py)."""
+
+    def __init__(self, n: int, tensor_shape: Optional[tuple] = None):
+        self._n = n
+        self._shape = tensor_shape
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        per = 8 * (int(np.prod(self._shape)) if self._shape else 1)
+        return self._n * per
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        parallelism = max(1, min(parallelism, self._n or 1))
+        chunk = -(-self._n // parallelism)
+        for start in range(0, self._n, chunk):
+            end = min(start + chunk, self._n)
+            shape = self._shape
+
+            def read(start=start, end=end, shape=shape) -> Iterable[Block]:
+                ids = np.arange(start, end, dtype=np.int64)
+                if shape:
+                    data = np.broadcast_to(
+                        ids.reshape((-1,) + (1,) * len(shape)), (end - start,) + shape
+                    ).copy()
+                    yield {"data": data}
+                else:
+                    yield {"id": ids}
+
+            meta = BlockMetadata(num_rows=end - start, size_bytes=(end - start) * 8)
+            tasks.append(ReadTask(read, meta))
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self._items = items
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        items = self._items
+        n = len(items)
+        parallelism = max(1, min(parallelism, n or 1))
+        chunk = -(-n // parallelism) if n else 1
+        tasks = []
+        for start in range(0, n, chunk):
+            part = items[start : start + chunk]
+
+            def read(part=part) -> Iterable[Block]:
+                yield part
+
+            tasks.append(ReadTask(read, BlockAccessor(part).metadata()))
+        return tasks or [ReadTask(lambda: iter([[]]), BlockMetadata(0, 0))]
+
+
+class NumpyDatasource(Datasource):
+    def __init__(self, arrays: Dict[str, np.ndarray]):
+        n = {len(v) for v in arrays.values()}
+        if len(n) > 1:
+            raise ValueError(f"ragged columns: {n}")
+        self._arrays = arrays
+        self._n = n.pop() if n else 0
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return sum(v.nbytes for v in self._arrays.values())
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self._n or 1))
+        chunk = -(-self._n // parallelism) if self._n else 1
+        tasks = []
+        for start in range(0, self._n, chunk):
+            end = min(start + chunk, self._n)
+            part = {k: v[start:end] for k, v in self._arrays.items()}
+
+            def read(part=part) -> Iterable[Block]:
+                yield part
+
+            tasks.append(ReadTask(read, BlockAccessor(part).metadata()))
+        return tasks or [ReadTask(lambda: iter([{}]), BlockMetadata(0, 0))]
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(_glob.glob(os.path.join(p, "**", "*"), recursive=True)))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    return [p for p in out if os.path.isfile(p)]
+
+
+class FileBasedDatasource(Datasource):
+    """One read task per file group (reference:
+    python/ray/data/datasource/file_based_datasource.py)."""
+
+    def __init__(self, paths):
+        self._files = _expand_paths(paths)
+        if not self._files:
+            raise ValueError(f"no input files found for {paths!r}")
+
+    def _read_file(self, path: str) -> Iterable[Block]:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        groups: List[List[str]] = [[] for _ in range(max(1, min(parallelism, len(self._files))))]
+        for i, f in enumerate(self._files):
+            groups[i % len(groups)].append(f)
+        read_file = self._read_file
+        tasks = []
+        for grp in groups:
+            if not grp:
+                continue
+
+            def read(grp=grp) -> Iterable[Block]:
+                for path in grp:
+                    yield from read_file(path)
+
+            size = sum(os.path.getsize(f) for f in grp)
+            tasks.append(
+                ReadTask(read, BlockMetadata(num_rows=0, size_bytes=size, input_files=grp))
+            )
+        return tasks
+
+
+class CSVDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Iterable[Block]:
+        import csv
+
+        with open(path, newline="") as f:
+            rows = list(csv.DictReader(f))
+        for r in rows:
+            for k, v in r.items():
+                try:
+                    r[k] = int(v)
+                except (TypeError, ValueError):
+                    try:
+                        r[k] = float(v)
+                    except (TypeError, ValueError):
+                        pass
+        yield rows
+
+
+class JSONDatasource(FileBasedDatasource):
+    """JSONL or a top-level JSON array per file."""
+
+    def _read_file(self, path: str) -> Iterable[Block]:
+        with open(path) as f:
+            head = f.read(1)
+            f.seek(0)
+            if head == "[":
+                yield _json.load(f)
+            else:
+                yield [_json.loads(line) for line in f if line.strip()]
+
+
+class TextDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Iterable[Block]:
+        with open(path) as f:
+            yield [{"text": line.rstrip("\n")} for line in f]
+
+
+class BinaryDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Iterable[Block]:
+        with open(path, "rb") as f:
+            yield [{"path": path, "bytes": f.read()}]
+
+
+class NumpyFileDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Iterable[Block]:
+        arr = np.load(path)
+        yield {"data": arr}
+
+
+class ParquetDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Iterable[Block]:
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(path)
+        yield {c: table.column(c).to_numpy(zero_copy_only=False) for c in table.column_names}

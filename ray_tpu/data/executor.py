@@ -1,0 +1,262 @@
+"""Streaming executor: drives the physical operator chain.
+
+Reference: python/ray/data/_internal/execution/streaming_executor.py
+(StreamingExecutor thread, ``_scheduling_loop_step`` :272) plus the
+backpressure policies under execution/backpressure_policy/. The loop here
+is pull-based: each tick moves bundles downstream, polls operators (which
+submit/harvest remote tasks), and applies backpressure by refusing to poll
+an operator whose downstream buffer is already full.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterator, List, Optional
+
+from ray_tpu.data.logical import (
+    AllToAll,
+    FusedMap,
+    InputData,
+    Limit,
+    LogicalPlan,
+    MapLike,
+    Read,
+    Union as LUnion,
+)
+from ray_tpu.data.operators import (
+    ActorPoolMapOperator,
+    AllToAllOperator,
+    InputDataBuffer,
+    LimitOperator,
+    PhysicalOperator,
+    ReadOperator,
+    RefBundle,
+    TaskPoolMapOperator,
+)
+
+# Max bundles buffered between two operators before upstream is paused
+# (reference: backpressure_policy/streaming_output_backpressure_policy.py).
+MAX_BUFFERED = 16
+
+
+def plan_to_operators(plan: LogicalPlan, concurrency: int = 8) -> List[PhysicalOperator]:
+    """Lower the optimized logical DAG to a physical chain (reference:
+    _internal/planner/planner.py)."""
+    ops: List[PhysicalOperator] = []
+    for lop in plan.dag.chain():
+        if isinstance(lop, Read):
+            par = lop.parallelism if lop.parallelism > 0 else concurrency * 2
+            ops.append(ReadOperator(lop.datasource.get_read_tasks(par), [], concurrency))
+        elif isinstance(lop, InputData):
+            ops.append(InputDataBuffer([RefBundle(r, m) for r, m in lop.bundles]))
+        elif isinstance(lop, FusedMap):
+            # Read->Map fusion: fold map stages into the upstream read tasks.
+            if ops and isinstance(ops[-1], ReadOperator) and not ops[-1]._stages and not ops[-1].tasks_submitted:
+                rd = ops[-1]
+                rd._stages = lop.stages
+                rd.name = "Read->" + "->".join(s.name for s in lop.stages)
+            else:
+                ops.append(TaskPoolMapOperator(lop, concurrency))
+        elif isinstance(lop, MapLike):  # unfused: actor-pool compute
+            ops.append(ActorPoolMapOperator(lop))
+        elif isinstance(lop, AllToAll):
+            kind = {"repartition": "repartition", "shuffle": "shuffle", "sort": "sort", "aggregate": "hash"}[lop.kind]
+            ops.append(
+                AllToAllOperator(
+                    kind, lop.num_outputs, key=lop.key, descending=lop.descending, seed=lop.seed
+                )
+            )
+        elif isinstance(lop, Limit):
+            ops.append(LimitOperator(lop.limit))
+        elif isinstance(lop, LUnion):
+            # The chain walked so far is branch 0; the other branches lower
+            # recursively. All collapse into one UnionOperator node.
+            chains = [ops] + [
+                plan_to_operators(LogicalPlan(o), concurrency) for o in lop.others
+            ]
+            ops = [UnionOperator(chains)]
+        else:
+            raise NotImplementedError(f"cannot lower {lop}")
+    return ops
+
+
+class StreamingExecutor:
+    """Executes the chain, yielding output RefBundles as they materialize."""
+
+    def __init__(self, ops: List[PhysicalOperator]):
+        self._ops = ops
+        self._stopped = False
+
+    def stats(self) -> List[dict]:
+        return [
+            dict(
+                op=o.name,
+                rows_out=o.rows_out,
+                blocks_out=o.blocks_out,
+                tasks=o.tasks_submitted,
+            )
+            for o in self._ops
+        ]
+
+    def _step(self) -> bool:
+        """One scheduling tick; returns True if the pipeline is finished."""
+        return _step_chain(self._ops)
+
+    def iter_bundles(self) -> Iterator[RefBundle]:
+        last = self._ops[-1]
+        try:
+            while True:
+                done = self._step()
+                emitted = False
+                while last.has_next():
+                    emitted = True
+                    yield last.get_next()
+                if done and not last.has_next():
+                    break
+                if not emitted:
+                    time.sleep(0.002)
+        finally:
+            self.shutdown()
+
+    def shutdown(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        for op in self._ops:
+            op.shutdown()
+
+
+def _step_chain(ops: List[PhysicalOperator]) -> bool:
+    # Move bundles downstream (last op's outputs are consumed by caller).
+    for i, op in enumerate(ops[:-1]):
+        nxt = ops[i + 1]
+        while op.has_next() and len(nxt._in_queue) < MAX_BUFFERED:
+            nxt.add_input(op.get_next())
+        if op.completed() and not nxt._inputs_done:
+            nxt.all_inputs_done()
+    # Early-exit: a satisfied Limit upstream-cancels the producers
+    # (reference: streaming executor limit propagation).
+    for i, op in enumerate(ops):
+        if isinstance(op, LimitOperator) and op.reached_limit():
+            for up in ops[:i]:
+                if not up._inputs_done:
+                    up.all_inputs_done()
+                up._in_queue.clear()
+                if hasattr(up, "_pending"):
+                    up._pending = []
+            if not op._inputs_done:
+                op.all_inputs_done()
+    # Poll operators unless their downstream buffer is saturated.
+    for i, op in enumerate(ops):
+        downstream_full = (
+            i + 1 < len(ops) and len(ops[i + 1]._in_queue) >= MAX_BUFFERED
+        )
+        out_full = op.outputs_buffered() >= MAX_BUFFERED
+        if not (downstream_full or out_full):
+            op.poll()
+    return all(o.completed() for o in ops)
+
+
+class UnionOperator(PhysicalOperator):
+    """Lazy union: owns the branch operator chains and steps them in place.
+    Branches execute concurrently (each chain's own backpressure applies)
+    but outputs stream in branch order for determinism."""
+
+    def __init__(self, chains: List[List[PhysicalOperator]]):
+        super().__init__(f"Union[{len(chains)}]")
+        self._chains = chains
+        self._emit_branch = 0
+        self._inputs_done = True
+
+    def num_active_tasks(self) -> int:
+        return sum(op.num_active_tasks() for ch in self._chains for op in ch)
+
+    def poll(self):
+        for ch in self._chains:
+            _step_chain(ch)
+        while self._emit_branch < len(self._chains):
+            ch = self._chains[self._emit_branch]
+            last = ch[-1]
+            emitted = False
+            while last.has_next() and len(self._out_queue) < MAX_BUFFERED:
+                self._out_queue.append(last.get_next())
+                emitted = True
+            if all(op.completed() for op in ch) and not last.has_next():
+                self._emit_branch += 1
+                continue
+            if not emitted or len(self._out_queue) >= MAX_BUFFERED:
+                break
+
+    def _finished_extra(self) -> bool:
+        return self._emit_branch >= len(self._chains)
+
+    def shutdown(self):
+        for ch in self._chains:
+            for op in ch:
+                op.shutdown()
+
+
+class SplitCoordinator:
+    """Driver-side fan-out for ``streaming_split`` (reference:
+    execution/operators/output_splitter.py + StreamSplitDataIterator).
+
+    Runs the executor on a background thread; ``n`` consumers each pull
+    from a dedicated queue fed round-robin (equal-ish block counts).
+    """
+
+    def __init__(self, ops: List[PhysicalOperator], n: int, equal: bool):
+        import queue
+
+        self._executor = StreamingExecutor(ops)
+        self._queues = [queue.Queue(maxsize=MAX_BUFFERED) for _ in range(n)]
+        self._dead = [False] * n
+        self._n = n
+        self._equal = equal
+        self._thread = threading.Thread(target=self._pump, daemon=True, name="split-pump")
+        self._thread.start()
+
+    def _pump(self):
+        import queue as _q
+
+        i = 0
+        try:
+            for bundle in self._executor.iter_bundles():
+                # Round-robin keeps block counts equal across splits. A
+                # consumer that abandoned its iterator is skipped so one
+                # dead split can't stall the others.
+                placed = False
+                while not placed:
+                    if all(self._dead):
+                        return
+                    target = i % self._n
+                    i += 1
+                    if self._dead[target]:
+                        continue
+                    try:
+                        self._queues[target].put(bundle, timeout=1.0)
+                        placed = True
+                    except _q.Full:
+                        if not self._equal:
+                            continue  # try the next split
+                        # equal=True: must keep round-robin; retry same slot
+                        # by rewinding unless it died meanwhile.
+                        i -= 1
+        finally:
+            for idx, q in enumerate(self._queues):
+                while not self._dead[idx]:
+                    try:
+                        q.put(None, timeout=0.5)
+                        break
+                    except _q.Full:
+                        continue
+
+    def iter_split(self, idx: int) -> Iterator[RefBundle]:
+        q = self._queues[idx]
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                yield item
+        finally:
+            self._dead[idx] = True

@@ -1,0 +1,122 @@
+"""Logical plan: lazy operator DAG + optimizer.
+
+Reference: python/ray/data/_internal/logical/ (LogicalPlan, operators,
+``_internal/logical/optimizers.py:43-59`` rule-based optimizer with the
+operator-fusion rule in ``_internal/logical/rules/operator_fusion.py``).
+
+The optimizer here implements the one rule that matters for throughput:
+fusing chains of one-to-one (map-like) operators into a single task per
+block, which removes intermediate object-store round trips.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.data.datasource import Datasource
+
+
+@dataclass
+class LogicalOp:
+    name: str
+    input: Optional["LogicalOp"] = None
+
+    def chain(self) -> List["LogicalOp"]:
+        ops: List[LogicalOp] = []
+        op: Optional[LogicalOp] = self
+        while op is not None:
+            ops.append(op)
+            op = op.input
+        return list(reversed(ops))
+
+
+@dataclass
+class Read(LogicalOp):
+    datasource: Datasource = None
+    parallelism: int = -1
+
+
+@dataclass
+class InputData(LogicalOp):
+    """Pre-materialized (block_ref, metadata) pairs — from_blocks / unions."""
+
+    bundles: List[Any] = field(default_factory=list)
+
+
+@dataclass
+class MapLike(LogicalOp):
+    """One-to-one row/batch transform; fusable.
+
+    kind: map | map_batches | flat_map | filter
+    """
+
+    kind: str = "map"
+    fn: Callable = None
+    fn_args: tuple = ()
+    fn_kwargs: Dict[str, Any] = field(default_factory=dict)
+    batch_size: Optional[int] = None
+    # Actor-pool compute for stateful/expensive UDFs (class constructors).
+    compute_actors: int = 0
+    fn_constructor_args: tuple = ()
+    num_cpus: float = 1
+    num_tpus: float = 0
+
+
+@dataclass
+class AllToAll(LogicalOp):
+    """Barrier ops: repartition / random_shuffle / sort / groupby-aggregate.
+
+    kind: repartition | shuffle | sort | aggregate
+    """
+
+    kind: str = "repartition"
+    num_outputs: Optional[int] = None
+    key: Optional[str] = None
+    descending: bool = False
+    seed: Optional[int] = None
+    aggs: List[Any] = field(default_factory=list)
+
+
+@dataclass
+class Limit(LogicalOp):
+    limit: int = 0
+
+
+@dataclass
+class Union(LogicalOp):
+    others: List[LogicalOp] = field(default_factory=list)
+
+
+@dataclass
+class LogicalPlan:
+    dag: LogicalOp
+
+    def optimized(self) -> "LogicalPlan":
+        return LogicalPlan(_fuse(self.dag))
+
+
+def _fuse(op: LogicalOp) -> LogicalOp:
+    """Collapse MapLike→MapLike edges into FusedMap nodes."""
+    if op is None:
+        return None
+    inp = _fuse(op.input)
+    if isinstance(op, Union):
+        op = replace(op, others=[_fuse(o) for o in op.others])
+    op = replace(op, input=inp)
+    if (
+        isinstance(op, MapLike)
+        and isinstance(inp, FusedMap)
+        and op.compute_actors == 0
+        and all(s.compute_actors == 0 for s in inp.stages)
+    ):
+        return FusedMap(
+            name=f"{inp.name}->{op.name}", input=inp.input, stages=inp.stages + [op]
+        )
+    if isinstance(op, MapLike) and op.compute_actors == 0:
+        return FusedMap(name=op.name, input=inp, stages=[op])
+    return op
+
+
+@dataclass
+class FusedMap(LogicalOp):
+    stages: List[MapLike] = field(default_factory=list)

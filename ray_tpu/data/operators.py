@@ -1,0 +1,535 @@
+"""Physical operators for the streaming executor.
+
+Reference: python/ray/data/_internal/execution/operators/ —
+TaskPoolMapOperator, ActorPoolMapOperator, InputDataBuffer,
+AllToAllOperator (exchange-based shuffle under
+_internal/planner/exchange/), LimitOperator, OutputSplitter.
+
+Blocks move between operators as ``RefBundle``s (an ObjectRef plus
+driver-side BlockMetadata); transforms run as remote tasks returning
+``(block, metadata)`` so the driver only ever fetches the tiny metadata.
+"""
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.logical import FusedMap, MapLike
+
+
+@dataclass
+class RefBundle:
+    ref: Any  # ObjectRef[Block]
+    meta: BlockMetadata
+
+
+# ---------------------------------------------------------------------------
+# Remote transform kernels (plain functions wrapped lazily with ray_tpu.remote
+# so importing this module never requires an initialized cluster).
+# ---------------------------------------------------------------------------
+
+
+def _apply_stage(block: Block, st: MapLike, udf: Optional[Callable] = None) -> Block:
+    fn = udf if udf is not None else st.fn
+    acc = BlockAccessor.for_block(block)
+    if st.kind == "map_batches":
+        batch = acc.to_batch()
+        n = acc.num_rows()
+        bs = st.batch_size
+        if bs is None or n <= bs:
+            out = fn(batch, *st.fn_args, **st.fn_kwargs)
+            return out if isinstance(out, (dict, list)) else list(out)
+        parts = []
+        for s in range(0, n, bs):
+            sub = {k: v[s : s + bs] for k, v in batch.items()}
+            parts.append(fn(sub, *st.fn_args, **st.fn_kwargs))
+        return BlockAccessor.concat(parts)
+    if st.kind == "map":
+        return [fn(r, *st.fn_args, **st.fn_kwargs) for r in acc.iter_rows()]
+    if st.kind == "flat_map":
+        out: List[Any] = []
+        for r in acc.iter_rows():
+            out.extend(fn(r, *st.fn_args, **st.fn_kwargs))
+        return out
+    if st.kind == "filter":
+        rows = [r for r in acc.iter_rows() if fn(r, *st.fn_args, **st.fn_kwargs)]
+        if isinstance(block, dict) and rows:
+            return {k: np.asarray([r[k] for r in rows]) for k in rows[0]}
+        return rows
+    raise ValueError(f"unknown map kind {st.kind}")
+
+
+def _run_stages(block: Block, stages: List[MapLike]) -> Tuple[Block, BlockMetadata]:
+    for st in stages:
+        block = _apply_stage(block, st)
+    return block, BlockAccessor.for_block(block).metadata()
+
+
+def _run_read(read_fn: Callable, stages: List[MapLike]) -> Tuple[Block, BlockMetadata]:
+    blocks = list(read_fn())
+    block = blocks[0] if len(blocks) == 1 else BlockAccessor.concat(blocks)
+    return _run_stages(block, stages)
+
+
+def _slice_block(block: Block, start: int, end: int) -> Tuple[Block, BlockMetadata]:
+    out = BlockAccessor.for_block(block).slice(start, end)
+    return out, BlockAccessor.for_block(out).metadata()
+
+
+def _partition_block(
+    block: Block, n: int, key: Optional[str], mode: str, seed, boundaries
+) -> Tuple:
+    """Map side of the exchange: split one block into n sub-blocks.
+
+    mode: 'rr' (repartition round-robin), 'random' (shuffle), 'hash'
+    (groupby), 'range' (sort).  Returns n blocks + 1 metadata list.
+    """
+    acc = BlockAccessor.for_block(block)
+    rows = acc.num_rows()
+    if mode == "rr":
+        idx = np.arange(rows) % n
+    elif mode == "random":
+        rng = np.random.default_rng(seed)
+        idx = rng.integers(0, n, size=rows)
+    elif mode == "hash":
+        # NOT python hash(): that is salted per process, and map tasks for
+        # different blocks run in different workers — keys must route to
+        # the same partition regardless of which worker partitioned them.
+        def stable_hash(x) -> int:
+            import zlib
+
+            return zlib.crc32(repr(x).encode())
+
+        if isinstance(block, dict):
+            col = block[key]
+            idx = np.asarray([stable_hash(x) % n for x in col])
+        else:
+            idx = np.asarray([stable_hash(r[key]) % n for r in acc.iter_rows()])
+    elif mode == "range":
+        if isinstance(block, dict):
+            col = np.asarray(block[key])
+        else:
+            col = np.asarray([r[key] for r in acc.iter_rows()])
+        idx = np.searchsorted(np.asarray(boundaries), col, side="right")
+    else:
+        raise ValueError(mode)
+    outs = [acc.take_indices(np.nonzero(idx == i)[0]) for i in range(n)]
+    metas = [BlockAccessor.for_block(o).metadata() for o in outs]
+    return tuple(outs) + (metas,)
+
+
+def _merge_blocks(*parts_and_opts) -> Tuple[Block, BlockMetadata]:
+    """Reduce side: concat sub-blocks; optional sort within partition."""
+    *parts, key, descending, shuffle_seed = parts_and_opts
+    block = BlockAccessor.concat(list(parts))
+    acc = BlockAccessor.for_block(block)
+    if key is not None:
+        batch_or_rows = block
+        if isinstance(batch_or_rows, dict):
+            order = np.argsort(np.asarray(batch_or_rows[key]), kind="stable")
+            if descending:
+                order = order[::-1]
+            block = acc.take_indices(order)
+        else:
+            block = sorted(acc.to_rows(), key=lambda r: r[key], reverse=descending)
+    elif shuffle_seed is not None:
+        rng = np.random.default_rng(shuffle_seed)
+        order = rng.permutation(acc.num_rows())
+        block = acc.take_indices(order)
+    return block, BlockAccessor.for_block(block).metadata()
+
+
+def _sample_boundaries(block: Block, key: Optional[str]) -> List[Any]:
+    return BlockAccessor.for_block(block).sample_keys(key)
+
+
+_REMOTE_CACHE: Dict[Tuple[str, float, float], Any] = {}
+
+
+def _remote(fn, num_returns=2, num_cpus=1, num_tpus=0):
+    k = (fn.__name__, num_returns, num_cpus, num_tpus)
+    if k not in _REMOTE_CACHE:
+        _REMOTE_CACHE[k] = ray_tpu.remote(
+            num_returns=num_returns, num_cpus=num_cpus, num_tpus=num_tpus
+        )(fn)
+    return _REMOTE_CACHE[k]
+
+
+# ---------------------------------------------------------------------------
+# Physical operator interface
+# ---------------------------------------------------------------------------
+
+
+class PhysicalOperator:
+    def __init__(self, name: str):
+        self.name = name
+        self._in_queue: collections.deque = collections.deque()
+        self._out_queue: collections.deque = collections.deque()
+        self._inputs_done = False
+        self._finished = False
+        # stats
+        self.rows_out = 0
+        self.blocks_out = 0
+        self.tasks_submitted = 0
+
+    # -- executor-facing ---------------------------------------------------
+    def add_input(self, bundle: RefBundle):
+        self._in_queue.append(bundle)
+
+    def all_inputs_done(self):
+        self._inputs_done = True
+
+    def has_next(self) -> bool:
+        return bool(self._out_queue)
+
+    def get_next(self) -> RefBundle:
+        b = self._out_queue.popleft()
+        self.rows_out += b.meta.num_rows
+        self.blocks_out += 1
+        return b
+
+    def outputs_buffered(self) -> int:
+        return len(self._out_queue)
+
+    def num_active_tasks(self) -> int:
+        return 0
+
+    def poll(self):
+        """Advance: submit work, harvest finished tasks. Non-blocking."""
+
+    def completed(self) -> bool:
+        return (
+            self._inputs_done
+            and not self._in_queue
+            and not self._out_queue
+            and self.num_active_tasks() == 0
+            and self._finished_extra()
+        )
+
+    def _finished_extra(self) -> bool:
+        return True
+
+    def _harvest_ordered(self):
+        """Emit the ready *prefix* of ``self._live`` in submission order so
+        downstream row order is deterministic (reference: ExecutionOptions
+        preserve_order)."""
+        while self._live:
+            block_ref, meta_ref = self._live[0]
+            ready, _ = ray_tpu.wait([meta_ref], timeout=0)
+            if not ready:
+                break
+            self._live.pop(0)
+            self._out_queue.append(RefBundle(block_ref, ray_tpu.get(meta_ref)))
+
+    def shutdown(self):
+        pass
+
+
+class InputDataBuffer(PhysicalOperator):
+    """Holds pre-planned input bundles (reference:
+    execution/operators/input_data_buffer.py)."""
+
+    def __init__(self, bundles: List[RefBundle]):
+        super().__init__("Input")
+        self._out_queue.extend(bundles)
+        self._inputs_done = True
+
+
+class ReadOperator(PhysicalOperator):
+    """Executes ReadTasks remotely, with any fused map stages applied
+    in the same task (read fusion — reference: operator fusion rule)."""
+
+    def __init__(self, read_tasks, stages: List[MapLike], concurrency: int = 8):
+        super().__init__("Read" + ("->" + "->".join(s.name for s in stages) if stages else ""))
+        self._pending = list(read_tasks)
+        self._stages = stages
+        self._concurrency = concurrency
+        self._live: List[Tuple[Any, Any]] = []  # (block_ref, meta_ref)
+        self._inputs_done = True
+
+    def num_active_tasks(self) -> int:
+        return len(self._live)
+
+    def poll(self):
+        fn = _remote(_run_read)
+        while self._pending and len(self._live) < self._concurrency:
+            rt = self._pending.pop(0)
+            block_ref, meta_ref = fn.remote(rt.read_fn, self._stages)
+            self.tasks_submitted += 1
+            self._live.append((block_ref, meta_ref))
+        self._harvest_ordered()
+
+    def _finished_extra(self) -> bool:
+        return not self._pending and not self._live
+
+
+class TaskPoolMapOperator(PhysicalOperator):
+    def __init__(self, fused: FusedMap, concurrency: int = 8):
+        super().__init__(fused.name)
+        self._stages = fused.stages
+        self._concurrency = concurrency
+        st = fused.stages[0]
+        self._num_cpus = st.num_cpus
+        self._num_tpus = st.num_tpus
+        self._live: List[Tuple[Any, Any]] = []
+
+    def num_active_tasks(self) -> int:
+        return len(self._live)
+
+    def poll(self):
+        fn = _remote(_run_stages, num_cpus=self._num_cpus, num_tpus=self._num_tpus)
+        while self._in_queue and len(self._live) < self._concurrency:
+            bundle = self._in_queue.popleft()
+            block_ref, meta_ref = fn.remote(bundle.ref, self._stages)
+            self.tasks_submitted += 1
+            self._live.append((block_ref, meta_ref))
+        self._harvest_ordered()
+
+    def _finished_extra(self) -> bool:
+        return not self._live
+
+
+class _UDFActor:
+    """Actor wrapper instantiating a stateful UDF class once (reference:
+    execution/operators/actor_pool_map_operator.py _MapWorker)."""
+
+    def __init__(self, cls_or_fn, ctor_args, stages):
+        self._stages = stages
+        self._udf = cls_or_fn(*ctor_args) if isinstance(cls_or_fn, type) else cls_or_fn
+
+    def apply(self, block):
+        st = self._stages[0]
+        block = _apply_stage(block, st, udf=self._udf)
+        for extra in self._stages[1:]:
+            block = _apply_stage(block, extra)
+        return block, BlockAccessor.for_block(block).metadata()
+
+
+class ActorPoolMapOperator(PhysicalOperator):
+    def __init__(self, op: MapLike, tasks_per_actor: int = 2):
+        super().__init__(f"{op.name}(actors={op.compute_actors})")
+        self._op = op
+        self._pool_size = op.compute_actors
+        self._tasks_per_actor = tasks_per_actor
+        self._actors: List[Any] = []
+        self._load: Dict[int, int] = {}
+        self._live: List[Tuple[int, Any, Any]] = []
+
+    def _ensure_pool(self):
+        if self._actors:
+            return
+        cls = ray_tpu.remote(num_cpus=self._op.num_cpus, num_tpus=self._op.num_tpus)(
+            _UDFActor
+        )
+        for i in range(self._pool_size):
+            self._actors.append(
+                cls.remote(self._op.fn, self._op.fn_constructor_args, [self._op])
+            )
+            self._load[i] = 0
+
+    def num_active_tasks(self) -> int:
+        return len(self._live)
+
+    def poll(self):
+        self._ensure_pool()
+        cap = self._pool_size * self._tasks_per_actor
+        while self._in_queue and len(self._live) < cap:
+            bundle = self._in_queue.popleft()
+            i = min(self._load, key=self._load.get)
+            block_ref, meta_ref = (
+                self._actors[i].apply.options(num_returns=2).remote(bundle.ref)
+            )
+            self.tasks_submitted += 1
+            self._load[i] += 1
+            self._live.append((i, block_ref, meta_ref))
+        while self._live:
+            i, block_ref, meta_ref = self._live[0]
+            ready, _ = ray_tpu.wait([meta_ref], timeout=0)
+            if not ready:
+                break
+            self._live.pop(0)
+            self._load[i] -= 1
+            self._out_queue.append(RefBundle(block_ref, ray_tpu.get(meta_ref)))
+
+    def _finished_extra(self) -> bool:
+        return not self._live
+
+    def shutdown(self):
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._actors = []
+
+
+class AllToAllOperator(PhysicalOperator):
+    """Two-stage push-based exchange (reference:
+    _internal/planner/exchange/ shuffle_task_scheduler + sort/hash
+    partition specs). Barriers on all inputs, then map-partitions each
+    block into N sub-blocks and merges partition-wise."""
+
+    def __init__(self, kind: str, num_outputs, key=None, descending=False, seed=None):
+        super().__init__(kind)
+        self.kind = kind
+        self._num_outputs = num_outputs
+        self._key = key
+        self._descending = descending
+        if kind == "shuffle" and seed is None:
+            # Unseeded shuffle must differ across calls; draw fresh entropy
+            # once so the execution itself is still internally consistent.
+            import secrets
+
+            seed = secrets.randbits(32)
+        self._seed = seed
+        self._collected: List[RefBundle] = []
+        self._phase = "collect"
+        self._map_live: List[Any] = []
+        self._reduce_live: List[Tuple[Any, Any]] = []
+        self._boundary_refs: List[Any] = []
+
+    def num_active_tasks(self) -> int:
+        return len(self._map_live) + len(self._reduce_live)
+
+    def poll(self):
+        while self._in_queue:
+            self._collected.append(self._in_queue.popleft())
+        if self._phase == "collect" and self._inputs_done:
+            self._start_exchange()
+        elif self._phase == "boundaries":
+            self._poll_boundaries()
+        elif self._phase == "map":
+            self._poll_map()
+        elif self._phase == "reduce":
+            self._poll_reduce()
+
+    def _start_exchange(self):
+        if not self._collected:
+            self._phase = "done"
+            return
+        n = self._num_outputs or len(self._collected)
+        self._n = max(1, n)
+        if self.kind == "sort":
+            sample = _remote(_sample_boundaries, num_returns=1)
+            self._boundary_refs = [
+                sample.remote(b.ref, self._key) for b in self._collected
+            ]
+            self._phase = "boundaries"
+        else:
+            self._launch_map(None)
+
+    def _poll_boundaries(self):
+        ready, _ = ray_tpu.wait(
+            self._boundary_refs, num_returns=len(self._boundary_refs), timeout=0
+        )
+        if len(ready) < len(self._boundary_refs):
+            return
+        samples = sorted(
+            s for ref in self._boundary_refs for s in ray_tpu.get(ref)
+        )
+        if samples:
+            idx = np.linspace(0, len(samples) - 1, num=self._n + 1).astype(int)[1:-1]
+            boundaries = [samples[i] for i in idx]
+        else:
+            boundaries = []
+        self._launch_map(boundaries)
+
+    def _launch_map(self, boundaries):
+        mode = {"repartition": "rr", "shuffle": "random", "sort": "range", "hash": "hash"}[
+            self.kind
+        ]
+        part = _remote(_partition_block, num_returns=self._n + 1)
+        self._partials: List[List[Any]] = [[] for _ in range(self._n)]
+        for j, b in enumerate(self._collected):
+            seed = None if self._seed is None else self._seed + j
+            out = part.remote(b.ref, self._n, self._key, mode, seed, boundaries)
+            for i in range(self._n):
+                self._partials[i].append(out[i])
+            self._map_live.append(out[self._n])  # metas ref as completion marker
+        self._phase = "map"
+
+    def _poll_map(self):
+        ready, _ = ray_tpu.wait(self._map_live, num_returns=len(self._map_live), timeout=0)
+        if len(ready) < len(self._map_live):
+            return
+        merge = _remote(_merge_blocks)
+        sort_key = self._key if self.kind == "sort" else None
+        partials = self._partials
+        if self.kind == "sort" and self._descending:
+            # Range partitions are ascending; a descending sort emits them
+            # in reverse partition order.
+            partials = list(reversed(partials))
+        for i, parts in enumerate(partials):
+            shuffle_seed = (
+                None if self.kind != "shuffle" else (self._seed or 0) * 13 + i
+            )
+            block_ref, meta_ref = merge.remote(
+                *parts, sort_key, self._descending, shuffle_seed
+            )
+            self._reduce_live.append((block_ref, meta_ref))
+        self._map_live = []
+        self._phase = "reduce"
+
+    def _poll_reduce(self):
+        # Ordered harvest: partition order IS the output order (a sorted
+        # dataset's global order depends on emitting partition i before i+1).
+        while self._reduce_live:
+            block_ref, meta_ref = self._reduce_live[0]
+            ready, _ = ray_tpu.wait([meta_ref], timeout=0)
+            if not ready:
+                return
+            self._reduce_live.pop(0)
+            self._out_queue.append(RefBundle(block_ref, ray_tpu.get(meta_ref)))
+        self._phase = "done"
+
+    def _finished_extra(self) -> bool:
+        return self._phase == "done" and not self.num_active_tasks()
+
+
+class LimitOperator(PhysicalOperator):
+    def __init__(self, limit: int):
+        super().__init__(f"Limit[{limit}]")
+        self._remaining = limit
+        self._slice_live: List[Tuple[Any, Any]] = []
+
+    def num_active_tasks(self) -> int:
+        return len(self._slice_live)
+
+    def poll(self):
+        while self._in_queue:
+            bundle = self._in_queue.popleft()
+            if self._remaining <= 0:
+                continue
+            if bundle.meta.num_rows <= self._remaining:
+                self._remaining -= bundle.meta.num_rows
+                self._out_queue.append(bundle)
+            else:
+                fn = _remote(_slice_block)
+                block_ref, meta_ref = fn.remote(bundle.ref, 0, self._remaining)
+                self._remaining = 0
+                self._slice_live.append((block_ref, meta_ref))
+        if self._slice_live:
+            ready, _ = ray_tpu.wait(
+                [m for _, m in self._slice_live],
+                num_returns=len(self._slice_live),
+                timeout=0,
+            )
+            ready_set = set(ready)
+            still = []
+            for block_ref, meta_ref in self._slice_live:
+                if meta_ref in ready_set:
+                    self._out_queue.append(RefBundle(block_ref, ray_tpu.get(meta_ref)))
+                else:
+                    still.append((block_ref, meta_ref))
+            self._slice_live = still
+
+    def reached_limit(self) -> bool:
+        return self._remaining <= 0 and not self._slice_live
+
+    def _finished_extra(self) -> bool:
+        return not self._slice_live

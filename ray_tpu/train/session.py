@@ -100,7 +100,11 @@ class _TrainSession:
 
     # -- driver-facing (via actor method) ---------------------------------
     def next_result(self, timeout: Optional[float] = None):
-        """Blocks for the next report; returns None when the loop is done."""
+        """Blocks (up to ``timeout``) for the next report; returns None when
+        the loop is done; raises TimeoutError when the bound expires."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
         while True:
             try:
                 return self.result_queue.get(timeout=0.2)
@@ -109,6 +113,10 @@ class _TrainSession:
                     if self.error is not None:
                         raise self.error
                     return None
+                if deadline is not None and _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"no train result within {timeout}s (worker still running)"
+                    )
 
 
 def _set_session(session: Optional[_TrainSession]):

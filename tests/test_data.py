@@ -1,0 +1,193 @@
+"""ray_tpu.data tests (reference test model: python/ray/data/tests/
+test_dataset*.py, test_streaming_executor.py)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+from ray_tpu.data.logical import FusedMap, LogicalPlan
+
+
+def test_range_take(ray_start_regular):
+    ds = data.range(100)
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+    assert ds.count() == 100
+
+
+def test_map_and_filter(ray_start_regular):
+    ds = data.range(50).map(lambda r: {"id": r["id"] * 2})
+    ds = ds.filter(lambda r: r["id"] % 4 == 0)
+    got = sorted(r["id"] for r in ds.take_all())
+    assert got == [i * 2 for i in range(50) if (i * 2) % 4 == 0]
+
+
+def test_map_batches_columnar(ray_start_regular):
+    ds = data.range(64).map_batches(lambda b: {"x": b["id"] * 10})
+    out = ds.to_numpy()
+    np.testing.assert_array_equal(np.sort(out["x"]), np.arange(64) * 10)
+
+
+def test_operator_fusion_plan():
+    ds = data.range(10).map(lambda r: r).filter(lambda r: True).map_batches(lambda b: b)
+    plan = LogicalPlan(ds._dag).optimized()
+    # read + 3 fused map stages → one FusedMap node over the Read
+    assert isinstance(plan.dag, FusedMap)
+    assert len(plan.dag.stages) == 3
+
+
+def test_flat_map(ray_start_regular):
+    ds = data.from_items([1, 2, 3]).flat_map(lambda r: [r, r])
+    assert sorted(ds.take_all()) == [1, 1, 2, 2, 3, 3]
+
+
+def test_repartition(ray_start_regular):
+    ds = data.range(100, parallelism=2).repartition(5)
+    assert ds.num_blocks() == 5
+    assert ds.count() == 100
+
+
+def test_random_shuffle(ray_start_regular):
+    ds = data.range(200, parallelism=4).random_shuffle(seed=7)
+    got = [r["id"] for r in ds.take_all()]
+    assert sorted(got) == list(range(200))
+    assert got != list(range(200))
+
+
+def test_sort(ray_start_regular):
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(500)
+    ds = data.from_numpy({"v": vals}, parallelism=8).sort("v")
+    got = [int(r["v"]) for r in ds.take_all()]
+    assert got == sorted(got)
+    ds2 = data.from_numpy({"v": vals}, parallelism=4).sort("v", descending=True)
+    got2 = [int(r["v"]) for r in ds2.take_all()]
+    assert got2 == sorted(got2, reverse=True)
+
+
+def test_groupby_aggregate(ray_start_regular):
+    items = [{"k": i % 3, "v": i} for i in range(30)]
+    out = data.from_items(items, parallelism=4).groupby("k").sum("v").take_all()
+    expect = {k: sum(i for i in range(30) if i % 3 == k) for k in range(3)}
+    assert {r["k"]: r["sum(v)"] for r in out} == expect
+
+
+def test_global_aggregates(ray_start_regular):
+    ds = data.from_numpy({"v": np.arange(100, dtype=np.float64)}, parallelism=5)
+    assert ds.sum("v") == float(np.sum(np.arange(100)))
+    assert ds.mean("v") == pytest.approx(49.5)
+    assert ds.min("v") == 0
+    assert ds.max("v") == 99
+    assert ds.std("v") == pytest.approx(np.std(np.arange(100), ddof=1))
+
+
+def test_iter_batches_rebatching(ray_start_regular):
+    ds = data.range(100, parallelism=7)
+    batches = list(ds.iter_batches(batch_size=32))
+    sizes = [len(b["id"]) for b in batches]
+    assert sum(sizes) == 100
+    assert all(s == 32 for s in sizes[:-1])
+
+
+def test_iter_jax_batches(ray_start_regular):
+    import jax
+
+    ds = data.range(32)
+    batches = list(ds.iter_jax_batches(batch_size=16, dtypes={"id": np.int32}))
+    assert len(batches) == 2
+    assert isinstance(batches[0]["id"], jax.Array)
+    assert batches[0]["id"].dtype == np.int32
+
+
+def test_streaming_split(ray_start_regular):
+    ds = data.range(80, parallelism=8)
+    it_a, it_b = ds.streaming_split(2)
+    import threading
+
+    results = {}
+
+    def consume(name, it):
+        results[name] = [int(r["id"]) for r in it.iter_rows()]
+
+    ta = threading.Thread(target=consume, args=("a", it_a))
+    tb = threading.Thread(target=consume, args=("b", it_b))
+    ta.start(), tb.start()
+    ta.join(30), tb.join(30)
+    assert sorted(results["a"] + results["b"]) == list(range(80))
+    assert results["a"] and results["b"]
+
+
+def test_limit_early_exit(ray_start_regular):
+    ds = data.range(10_000, parallelism=50).limit(10)
+    rows = ds.take_all()
+    assert [r["id"] for r in rows] == list(range(10))
+
+
+def test_union_and_materialize(ray_start_regular):
+    a = data.range(10)
+    b = data.range(10).map(lambda r: {"id": r["id"] + 10})
+    u = a.union(b)
+    assert sorted(r["id"] for r in u.take_all()) == list(range(20))
+    m = u.materialize()
+    assert m.count() == 20
+
+
+def test_read_csv_json_text(ray_start_regular, tmp_path):
+    csv_f = tmp_path / "x.csv"
+    csv_f.write_text("a,b\n1,hello\n2,world\n")
+    out = data.read_csv(str(csv_f)).take_all()
+    assert out == [{"a": 1, "b": "hello"}, {"a": 2, "b": "world"}]
+
+    json_f = tmp_path / "x.jsonl"
+    json_f.write_text('{"v": 1}\n{"v": 2}\n')
+    assert [r["v"] for r in data.read_json(str(json_f)).take_all()] == [1, 2]
+
+    txt_f = tmp_path / "x.txt"
+    txt_f.write_text("one\ntwo\n")
+    assert [r["text"] for r in data.read_text(str(txt_f)).take_all()] == ["one", "two"]
+
+
+def test_read_parquet_roundtrip(ray_start_regular, tmp_path):
+    import pandas as pd
+
+    df = pd.DataFrame({"x": np.arange(20), "y": np.arange(20) * 1.5})
+    p = tmp_path / "t.parquet"
+    df.to_parquet(p)
+    ds = data.read_parquet(str(p))
+    out = ds.to_pandas().sort_values("x").reset_index(drop=True)
+    pd.testing.assert_frame_equal(out, df)
+
+
+def test_actor_pool_map_batches(ray_start_regular):
+    class AddConst:
+        def __init__(self, c):
+            self.c = c
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.c}
+
+    ds = data.range(40, parallelism=4).map_batches(
+        AddConst, concurrency=2, fn_constructor_args=(100,)
+    )
+    got = sorted(r["id"] for r in ds.take_all())
+    assert got == [i + 100 for i in range(40)]
+
+
+def test_add_drop_select_columns(ray_start_regular):
+    ds = data.range(10).add_column("sq", lambda b: b["id"] ** 2)
+    row = ds.take(1)[0]
+    assert row["sq"] == 0
+    ds2 = ds.select_columns(["sq"])
+    assert set(ds2.take(1)[0].keys()) == {"sq"}
+
+
+def test_random_sample(ray_start_regular):
+    ds = data.range(1000).random_sample(0.1, seed=3)
+    n = ds.count()
+    assert 50 < n < 200
+
+
+def test_schema_and_size(ray_start_regular):
+    ds = data.range(10)
+    assert ds.schema() == {"id": "int64"}
+    assert ds.size_bytes() == 80
