@@ -50,8 +50,10 @@ class RangeDatasource(Datasource):
         return self._n * per
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        if self._n == 0:
+            return [ReadTask(lambda: iter([{"id": np.empty(0, np.int64)}]), BlockMetadata(0, 0))]
         tasks = []
-        parallelism = max(1, min(parallelism, self._n or 1))
+        parallelism = max(1, min(parallelism, self._n))
         chunk = -(-self._n // parallelism)
         for start in range(0, self._n, chunk):
             end = min(start + chunk, self._n)

@@ -50,8 +50,13 @@ def plan_to_operators(plan: LogicalPlan, concurrency: int = 8) -> List[PhysicalO
         elif isinstance(lop, InputData):
             ops.append(InputDataBuffer([RefBundle(r, m) for r, m in lop.bundles]))
         elif isinstance(lop, FusedMap):
-            # Read->Map fusion: fold map stages into the upstream read tasks.
-            if ops and isinstance(ops[-1], ReadOperator) and not ops[-1]._stages and not ops[-1].tasks_submitted:
+            # Read->Map fusion: fold map stages into the upstream read tasks
+            # (only for default-resource stages — reads run with 1 CPU, so a
+            # stage requesting TPUs/extra CPUs must stay its own task).
+            default_res = all(
+                (s.num_cpus, s.num_tpus) == (1, 0) for s in lop.stages
+            )
+            if ops and default_res and isinstance(ops[-1], ReadOperator) and not ops[-1]._stages and not ops[-1].tasks_submitted:
                 rd = ops[-1]
                 rd._stages = lop.stages
                 rd.name = "Read->" + "->".join(s.name for s in lop.stages)

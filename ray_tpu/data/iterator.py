@@ -42,14 +42,40 @@ class DataIterator:
             if local_shuffle_buffer_size
             else None
         )
-        for block in self._iter_blocks():
-            batch = BlockAccessor.for_block(block).to_batch()
+
+        def blocks_with_shuffle_buffer():
+            """Accumulate ≥buffer_size rows, emit random permutations — rows
+            mix ACROSS blocks up to the buffer size (reference:
+            iterator local_shuffle_buffer_size semantics)."""
+            buf: Optional[Dict[str, np.ndarray]] = None
+            for block in self._iter_blocks():
+                b = BlockAccessor.for_block(block).to_batch()
+                if not b:
+                    continue
+                buf = (
+                    b
+                    if buf is None
+                    else {k: np.concatenate([buf[k], np.asarray(b[k])]) for k in b}
+                )
+                n = len(next(iter(buf.values())))
+                if n >= local_shuffle_buffer_size:
+                    order = rng.permutation(n)
+                    yield {k: np.asarray(v)[order] for k, v in buf.items()}
+                    buf = None
+            if buf is not None:
+                n = len(next(iter(buf.values())))
+                order = rng.permutation(n)
+                yield {k: np.asarray(v)[order] for k, v in buf.items()}
+
+        if rng is not None:
+            source = blocks_with_shuffle_buffer()
+        else:
+            source = (
+                BlockAccessor.for_block(b).to_batch() for b in self._iter_blocks()
+            )
+        for batch in source:
             if not batch:
                 continue
-            if rng is not None:
-                n = len(next(iter(batch.values())))
-                order = rng.permutation(n)
-                batch = {k: np.asarray(v)[order] for k, v in batch.items()}
             if carry is not None:
                 batch = {
                     k: np.concatenate([carry[k], np.asarray(batch[k])]) for k in batch

@@ -108,6 +108,12 @@ def _fuse(op: LogicalOp) -> LogicalOp:
         and isinstance(inp, FusedMap)
         and op.compute_actors == 0
         and all(s.compute_actors == 0 for s in inp.stages)
+        # Fusing stages with different resource requests would silently run
+        # one stage under the other's reservation — keep them separate tasks.
+        and all(
+            (s.num_cpus, s.num_tpus) == (op.num_cpus, op.num_tpus)
+            for s in inp.stages
+        )
     ):
         return FusedMap(
             name=f"{inp.name}->{op.name}", input=inp.input, stages=inp.stages + [op]

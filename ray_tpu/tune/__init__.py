@@ -1,0 +1,68 @@
+"""ray_tpu.tune: hyperparameter search over cluster-scheduled trials.
+
+Reference: python/ray/tune (Tuner/tune.run, search spaces, schedulers).
+"""
+from ray_tpu.tune.schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trial import (
+    Trial,
+    get_checkpoint_dir,
+    make_checkpoint_dir,
+    report,
+)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, TuneController, Tuner
+
+
+def run(trainable, *, config=None, num_samples=1, metric="score", mode="max", scheduler=None, **kw):
+    """tune.run legacy-style entry (reference: tune/tune.py)."""
+    tuner = Tuner(
+        trainable,
+        param_space=config or {},
+        tune_config=TuneConfig(
+            metric=metric, mode=mode, num_samples=num_samples, scheduler=scheduler, **kw
+        ),
+    )
+    return tuner.fit()
+
+
+__all__ = [
+    "Tuner",
+    "TuneConfig",
+    "TuneController",
+    "ResultGrid",
+    "Trial",
+    "report",
+    "get_checkpoint_dir",
+    "make_checkpoint_dir",
+    "run",
+    "grid_search",
+    "choice",
+    "uniform",
+    "loguniform",
+    "randint",
+    "sample_from",
+    "Searcher",
+    "BasicVariantGenerator",
+    "ConcurrencyLimiter",
+    "TrialScheduler",
+    "FIFOScheduler",
+    "AsyncHyperBandScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+]

@@ -1,0 +1,180 @@
+"""Trial schedulers: FIFO, ASHA, median-stopping, PBT.
+
+Reference: python/ray/tune/schedulers/ — async_hyperband.py (ASHA),
+median_stopping_rule.py, pbt.py. The scheduler sees every reported result
+and decides CONTINUE/STOP/PAUSE; PBT additionally mutates paused trials'
+configs (exploit+explore) before they resume.
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.tune.trial import Trial
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+PAUSE = "PAUSE"
+
+
+class TrialScheduler:
+    def set_search_properties(self, metric: str, mode: str):
+        self._metric = metric
+        self._mode = mode
+
+    def _score(self, result: dict) -> float:
+        v = result.get(self._metric, float("-inf"))
+        return v if self._mode == "max" else -v
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial: Trial, result: Optional[dict]):
+        pass
+
+    def choose_config(self, trial: Trial) -> Optional[Dict[str, Any]]:
+        """PBT hook: new config for a resuming trial (None = unchanged)."""
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference: tune/schedulers/async_hyperband.py): successive
+    halving with rungs at grace_period * reduction_factor^k; a trial
+    reaching a rung is stopped unless it is in the top 1/reduction_factor
+    of results recorded at that rung."""
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        grace_period: int = 1,
+        max_t: int = 100,
+        reduction_factor: float = 4,
+        brackets: int = 1,
+    ):
+        self._time_attr = time_attr
+        self._grace = grace_period
+        self._max_t = max_t
+        self._rf = reduction_factor
+        # rung milestones: grace, grace*rf, grace*rf^2, ... < max_t
+        self._rungs: Dict[int, List[float]] = {}
+        self._reached: Dict[str, set] = {}  # trial_id -> rungs already recorded
+        t = grace_period
+        while t < max_t:
+            self._rungs[int(t)] = []
+            t *= reduction_factor
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        t = result.get(self._time_attr, trial.iteration)
+        if t >= self._max_t:
+            return STOP
+        score = self._score(result)
+        reached = self._reached.setdefault(trial.trial_id, set())
+        for milestone in sorted(self._rungs, reverse=True):
+            if t >= milestone:
+                # one entry per trial per rung — re-reports between
+                # milestones neither re-record nor re-evaluate
+                if milestone in reached:
+                    break
+                reached.add(milestone)
+                recorded = self._rungs[milestone]
+                recorded.append(score)
+                # top 1/rf cutoff among scores seen at this rung
+                k = max(1, int(len(recorded) / self._rf))
+                cutoff = sorted(recorded, reverse=True)[k - 1]
+                if score < cutoff:
+                    return STOP
+                break
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result is below the median of running
+    averages (reference: tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, time_attr: str = "training_iteration", grace_period: int = 1, min_samples_required: int = 3):
+        self._time_attr = time_attr
+        self._grace = grace_period
+        self._min_samples = min_samples_required
+        self._avgs: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        scores = self._avgs.setdefault(trial.trial_id, [])
+        scores.append(self._score(result))
+        t = result.get(self._time_attr, trial.iteration)
+        if t < self._grace or len(self._avgs) < self._min_samples:
+            return CONTINUE
+        my_avg = sum(scores) / len(scores)
+        others = [sum(v) / len(v) for k, v in self._avgs.items() if k != trial.trial_id and v]
+        if len(others) < self._min_samples - 1:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        return STOP if my_avg < median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: tune/schedulers/pbt.py): every
+    ``perturbation_interval`` iterations, bottom-quantile trials PAUSE,
+    clone the checkpoint+config of a top-quantile trial (exploit) and
+    perturb hyperparameters (explore), then resume."""
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        perturbation_interval: int = 5,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        self._time_attr = time_attr
+        self._interval = perturbation_interval
+        self._mutations = hyperparam_mutations or {}
+        self._quantile = quantile_fraction
+        self._resample_prob = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+        self._population: Dict[str, Trial] = {}
+        self._exploit_from: Dict[str, Trial] = {}
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        self._population[trial.trial_id] = trial
+        t = result.get(self._time_attr, trial.iteration)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self._interval:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        trials = [tr for tr in self._population.values() if tr.last_result]
+        if len(trials) < 2:
+            return CONTINUE
+        trials.sort(key=lambda tr: self._score(tr.last_result), reverse=True)
+        k = max(1, int(len(trials) * self._quantile))
+        top, bottom = trials[:k], trials[-k:]
+        if trial in bottom and trial not in top:
+            donor = self._rng.choice(top)
+            if donor.checkpoint_dir is not None:
+                self._exploit_from[trial.trial_id] = donor
+                return PAUSE
+        return CONTINUE
+
+    def choose_config(self, trial: Trial) -> Optional[Dict[str, Any]]:
+        donor = self._exploit_from.pop(trial.trial_id, None)
+        if donor is None:
+            return None
+        # exploit: clone donor config + checkpoint; explore: perturb
+        cfg = dict(donor.config)
+        trial.checkpoint_dir = donor.checkpoint_dir
+        for k, spec in self._mutations.items():
+            if self._rng.random() < self._resample_prob:
+                cfg[k] = spec() if callable(spec) else self._rng.choice(spec)
+            elif isinstance(cfg.get(k), (int, float)):
+                factor = self._rng.choice([0.8, 1.2])
+                cfg[k] = cfg[k] * factor
+                if isinstance(donor.config[k], int):
+                    cfg[k] = max(1, int(round(cfg[k])))
+        return cfg

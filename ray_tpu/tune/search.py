@@ -1,0 +1,164 @@
+"""Search spaces + search algorithms.
+
+Reference: python/ray/tune/search/ — sample-space primitives
+(tune/search/sample.py), BasicVariantGenerator (grid/random,
+tune/search/basic_variant.py), ConcurrencyLimiter, Repeater. The external
+searcher integrations (hyperopt/optuna/...) are out of capability scope;
+the Searcher interface is the plug point.
+"""
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        import math
+
+        self.lo, self.hi = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self.lo, self.hi))
+
+
+class Randint(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable[[], Any]):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn()
+
+
+class GridSearch:
+    def __init__(self, values: List[Any]):
+        self.values = list(values)
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> Randint:
+    return Randint(low, high)
+
+
+def choice(categories) -> Choice:
+    return Choice(categories)
+
+
+def sample_from(fn) -> Function:
+    return Function(fn)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+class Searcher:
+    """Pluggable suggestion interface (reference: tune/search/searcher.py)."""
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict], error: bool = False):
+        pass
+
+    def set_search_properties(self, metric: Optional[str], mode: Optional[str]):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid-cross-product × num_samples random sampling (reference:
+    tune/search/basic_variant.py)."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1, seed: Optional[int] = None):
+        self._rng = random.Random(seed)
+        self._variants = self._expand(param_space, num_samples)
+        self._i = 0
+
+    def _expand(self, space: Dict[str, Any], num_samples: int) -> List[Dict[str, Any]]:
+        grid_keys = [k for k, v in space.items() if isinstance(v, GridSearch)]
+        grids = [space[k].values for k in grid_keys]
+        variants = []
+        for _ in range(num_samples):
+            for combo in itertools.product(*grids) if grids else [()]:
+                cfg = {}
+                for k, v in space.items():
+                    if isinstance(v, GridSearch):
+                        cfg[k] = combo[grid_keys.index(k)]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self._rng)
+                    else:
+                        cfg[k] = v
+                variants.append(cfg)
+        return variants
+
+    @property
+    def total_trials(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._i >= len(self._variants):
+            return None
+        cfg = self._variants[self._i]
+        self._i += 1
+        return cfg
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps concurrent suggestions (reference:
+    tune/search/concurrency_limiter.py)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id: str):
+        if len(self._live) >= self.max_concurrent:
+            return "__pending__"
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None and cfg != "__pending__":
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result=None, error: bool = False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)

@@ -1,0 +1,125 @@
+"""Trial state + the trial-runner actor.
+
+Reference: python/ray/tune/experiment/trial.py (Trial FSM) and
+tune/trainable/function_trainable.py:36 (FunctionTrainable: user fn in a
+thread + result queue — the same mechanism ray_tpu.train's session uses).
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+# Trial statuses (reference: trial.py Trial.PENDING/RUNNING/...)
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    status: str = PENDING
+    last_result: Optional[dict] = None
+    results: List[dict] = field(default_factory=list)
+    error: Optional[str] = None
+    num_failures: int = 0
+    actor: Any = None
+    checkpoint_dir: Optional[str] = None  # last checkpoint (for restore/PBT)
+    iteration: int = 0
+    paused_at_iteration: int = 0
+
+    @property
+    def is_finished(self) -> bool:
+        return self.status in (TERMINATED, ERROR)
+
+    def metric(self, name: str, default=None):
+        if self.last_result is None:
+            return default
+        return self.last_result.get(name, default)
+
+
+class _TuneSession:
+    """Per-trial worker-side session: report()/get_checkpoint() plumbing."""
+
+    def __init__(self, config, local_dir, restored_checkpoint):
+        self.config = config
+        self.local_dir = local_dir
+        self.result_queue: "queue.Queue" = queue.Queue(maxsize=4)
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.restored_checkpoint = restored_checkpoint
+        self.ckpt_seq = 0
+
+
+_session: Optional[_TuneSession] = None
+
+
+def report(metrics: dict, checkpoint_dir: Optional[str] = None):
+    """tune.report inside a trainable (reference: ray.tune.report)."""
+    if _session is None:
+        raise RuntimeError("tune.report() called outside a Tune trial")
+    _session.result_queue.put({"metrics": dict(metrics), "checkpoint": checkpoint_dir})
+
+
+def get_checkpoint_dir() -> Optional[str]:
+    if _session is None:
+        raise RuntimeError("not inside a Tune trial")
+    return _session.restored_checkpoint
+
+
+def make_checkpoint_dir() -> str:
+    """A fresh directory the trainable can write a checkpoint into."""
+    if _session is None:
+        raise RuntimeError("not inside a Tune trial")
+    d = os.path.join(_session.local_dir, f"checkpoint_{_session.ckpt_seq:06d}")
+    _session.ckpt_seq += 1
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class TrialRunner:
+    """The per-trial actor: runs the trainable fn in a thread, streams
+    results to the controller (reference: FunctionTrainable + the
+    ray.air.execution actor manager's train-result polling)."""
+
+    def __init__(self, fn_blob: bytes, config: dict, local_dir: str, restored_checkpoint):
+        from ray_tpu.utils.serialization import deserialize_function
+
+        global _session
+        os.makedirs(local_dir, exist_ok=True)
+        self._fn = deserialize_function(fn_blob)
+        self._session = _TuneSession(config, local_dir, restored_checkpoint)
+        _session = self._session
+        self._thread = threading.Thread(target=self._run, daemon=True, name="trial-fn")
+        self._thread.start()
+
+    def _run(self):
+        try:
+            self._fn(self._session.config)
+        except BaseException as e:  # noqa: BLE001
+            self._session.error = e
+            self._session.error_tb = traceback.format_exc()
+        finally:
+            self._session.finished.set()
+
+    def next_result(self) -> Optional[dict]:
+        """One report, or None when the trainable returned. Raises the
+        trainable's error."""
+        while True:
+            try:
+                return self._session.result_queue.get(timeout=0.2)
+            except queue.Empty:
+                if self._session.finished.is_set() and self._session.result_queue.empty():
+                    if self._session.error is not None:
+                        raise RuntimeError(
+                            f"trial fn failed: {self._session.error}\n"
+                            + getattr(self._session, "error_tb", "")
+                        )
+                    return None

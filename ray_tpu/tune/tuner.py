@@ -1,0 +1,367 @@
+"""Tuner + TuneController: the experiment event loop.
+
+Reference: python/ray/tune/tuner.py:44 (Tuner.fit :344) and
+tune/execution/tune_controller.py:68 (step :666 — schedule trial actors,
+poll results, drive scheduler decisions, save/restore trials). Trials run
+as TrialRunner actors; one in-flight ``next_result`` call per running
+trial keeps the control loop non-blocking.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.trainer import Result
+from ray_tpu.tune import trial as trial_mod
+from ray_tpu.tune.schedulers import CONTINUE, PAUSE, STOP, FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.trial import ERROR, PAUSED, PENDING, RUNNING, TERMINATED, Trial, TrialRunner
+from ray_tpu.utils.serialization import serialize_function
+
+
+@dataclass
+class TuneConfig:
+    """Reference: tune/tune_config.py."""
+
+    metric: str = "score"
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 0  # 0 → resource-bound
+    scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Searcher] = None
+    seed: Optional[int] = None
+    max_failures: int = 0
+    resources_per_trial: Dict[str, float] = field(default_factory=lambda: {"num_cpus": 1})
+
+
+class ResultGrid:
+    """Reference: tune/result_grid.py."""
+
+    def __init__(self, trials: List[Trial], metric: str, mode: str):
+        self.trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def get_best_result(self, metric: Optional[str] = None, mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        best: Optional[Trial] = None
+        best_v = None
+        for t in self.trials:
+            v = t.metric(metric)
+            if v is None:
+                continue
+            if best is None or (v > best_v if mode == "max" else v < best_v):
+                best, best_v = t, v
+        if best is None:
+            raise RuntimeError("no trial reported the metric " + metric)
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        return Result(
+            metrics=best.last_result,
+            checkpoint=Checkpoint(best.checkpoint_dir) if best.checkpoint_dir else None,
+            path=best.checkpoint_dir or "",
+            metrics_history=best.results,
+        )
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([t.last_result for t in self.trials if t.last_result])
+
+    @property
+    def num_errors(self) -> int:
+        return sum(1 for t in self.trials if t.status == ERROR)
+
+    def __len__(self):
+        return len(self.trials)
+
+    def __getitem__(self, i):
+        t = self.trials[i]
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        return Result(
+            metrics=t.last_result,
+            checkpoint=Checkpoint(t.checkpoint_dir) if t.checkpoint_dir else None,
+            path=t.checkpoint_dir or "",
+            metrics_history=t.results,
+        )
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable: Callable,
+        param_space: Dict[str, Any],
+        tune_config: TuneConfig,
+        experiment_dir: str,
+        restore_state: Optional[dict] = None,
+    ):
+        self._fn_blob = serialize_function(trainable)
+        self._cfg = tune_config
+        self._dir = experiment_dir
+        os.makedirs(experiment_dir, exist_ok=True)
+        self._searcher = tune_config.search_alg or BasicVariantGenerator(
+            param_space, tune_config.num_samples, seed=tune_config.seed
+        )
+        self._searcher.set_search_properties(tune_config.metric, tune_config.mode)
+        self._scheduler = tune_config.scheduler or FIFOScheduler()
+        self._scheduler.set_search_properties(tune_config.metric, tune_config.mode)
+        self._trials: List[Trial] = []
+        self._pending_result: Dict[str, Any] = {}  # trial_id -> in-flight ref
+        self._exhausted = False
+        self._next_id = 0
+        self._state_dirty = True
+        if restore_state:
+            self._load_state(restore_state)
+            # Skip searcher variants already materialized as trials before
+            # the interruption (grid positions are deterministic).
+            for _ in range(self._next_id):
+                self._searcher.suggest("__restored__")
+
+    # -- experiment state (save/resume; reference:
+    # tune/execution/experiment_state.py) ---------------------------------
+    def _save_state(self):
+        if not self._state_dirty:
+            return
+        self._state_dirty = False
+        state = {
+            "trials": [
+                dict(
+                    trial_id=t.trial_id,
+                    config=t.config,
+                    status=t.status if t.is_finished else PENDING,
+                    last_result=t.last_result,
+                    results=t.results,
+                    error=t.error,
+                    checkpoint_dir=t.checkpoint_dir,
+                    iteration=t.iteration,
+                )
+                for t in self._trials
+            ],
+            "exhausted": self._exhausted,
+            "next_id": self._next_id,
+        }
+        tmp = os.path.join(self._dir, ".tuner_state.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, os.path.join(self._dir, "tuner_state.json"))
+
+    def _load_state(self, state: dict):
+        for td in state["trials"]:
+            t = Trial(trial_id=td["trial_id"], config=td["config"])
+            t.status = td["status"]
+            t.last_result = td["last_result"]
+            t.results = td["results"]
+            t.error = td["error"]
+            t.checkpoint_dir = td["checkpoint_dir"]
+            t.iteration = td["iteration"]
+            self._trials.append(t)
+        self._exhausted = state["exhausted"]
+        self._next_id = state["next_id"]
+
+    # -- trial lifecycle ---------------------------------------------------
+    def _max_concurrent(self) -> int:
+        if self._cfg.max_concurrent_trials:
+            return self._cfg.max_concurrent_trials
+        cpus_per = self._cfg.resources_per_trial.get("num_cpus", 1) or 1
+        total = ray_tpu.cluster_resources().get("CPU", 1)
+        return max(1, int(total // cpus_per))
+
+    def _start_trial(self, t: Trial, restore: bool = False):
+        res = self._cfg.resources_per_trial
+        runner_cls = ray_tpu.remote(
+            num_cpus=res.get("num_cpus", 1),
+            num_tpus=res.get("num_tpus", 0),
+            resources={k: v for k, v in res.items() if k not in ("num_cpus", "num_tpus")},
+            max_restarts=0,
+        )(TrialRunner)
+        new_cfg = self._scheduler.choose_config(t)
+        if new_cfg is not None:
+            t.config = new_cfg
+        t.actor = runner_cls.remote(
+            self._fn_blob,
+            t.config,
+            os.path.join(self._dir, t.trial_id),
+            t.checkpoint_dir if restore else None,
+        )
+        t.status = RUNNING
+        self._state_dirty = True
+        self._pending_result[t.trial_id] = t.actor.next_result.remote()
+
+    def _stop_trial(self, t: Trial, status: str, error: Optional[str] = None):
+        if t.actor is not None:
+            try:
+                ray_tpu.kill(t.actor)
+            except Exception:
+                pass
+            t.actor = None
+        self._pending_result.pop(t.trial_id, None)
+        t.status = status
+        t.error = error
+        self._state_dirty = True
+        if t.is_finished:
+            self._searcher.on_trial_complete(t.trial_id, t.last_result, error=status == ERROR)
+            self._scheduler.on_trial_complete(t, t.last_result)
+
+    def _maybe_create_trials(self):
+        live = sum(1 for t in self._trials if t.status == RUNNING)
+        cap = self._max_concurrent()
+        # resume paused/pending-restored trials first
+        for t in self._trials:
+            if live >= cap:
+                return
+            if t.status == PAUSED or (t.status == PENDING and t.actor is None and t.results):
+                self._start_trial(t, restore=True)
+                live += 1
+        for t in self._trials:
+            if live >= cap:
+                return
+            if t.status == PENDING and t.actor is None:
+                self._start_trial(t, restore=t.checkpoint_dir is not None)
+                live += 1
+        while not self._exhausted and live < cap:
+            tid = f"trial_{self._next_id:05d}"
+            cfg = self._searcher.suggest(tid)
+            if cfg is None:
+                self._exhausted = True
+                return
+            if cfg == "__pending__":
+                return
+            self._next_id += 1
+            t = Trial(trial_id=tid, config=cfg)
+            self._trials.append(t)
+            self._start_trial(t)
+            live += 1
+
+    def _process_result(self, t: Trial, payload: Optional[dict]):
+        if payload is None:  # trainable returned
+            self._stop_trial(t, TERMINATED)
+            return
+        metrics = payload["metrics"]
+        t.iteration += 1
+        metrics.setdefault("training_iteration", t.iteration)
+        metrics.setdefault("trial_id", t.trial_id)
+        metrics.setdefault("config", t.config)
+        t.last_result = metrics
+        t.results.append(metrics)
+        self._state_dirty = True
+        if payload.get("checkpoint"):
+            t.checkpoint_dir = payload["checkpoint"]
+        decision = self._scheduler.on_trial_result(t, metrics)
+        if decision == STOP:
+            self._stop_trial(t, TERMINATED)
+        elif decision == PAUSE:
+            t.paused_at_iteration = t.iteration
+            self._stop_trial(t, PAUSED)
+        else:
+            self._pending_result[t.trial_id] = t.actor.next_result.remote()
+
+    def _handle_failure(self, t: Trial, err: Exception):
+        t.num_failures += 1
+        if t.num_failures <= self._cfg.max_failures:
+            # retry, restoring from the last checkpoint (reference:
+            # tune_controller.py:1791 trial restore)
+            self._pending_result.pop(t.trial_id, None)
+            if t.actor is not None:
+                try:
+                    ray_tpu.kill(t.actor)
+                except Exception:
+                    pass
+                t.actor = None
+            self._start_trial(t, restore=t.checkpoint_dir is not None)
+        else:
+            self._stop_trial(t, ERROR, error=str(err))
+
+    def step(self) -> bool:
+        """One controller tick; True when the experiment is done."""
+        self._maybe_create_trials()
+        if self._pending_result:
+            refs = list(self._pending_result.values())
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0.1)
+            ready_set = set(ready)
+            for t in list(self._trials):
+                ref = self._pending_result.get(t.trial_id)
+                if ref is None or ref not in ready_set:
+                    continue
+                del self._pending_result[t.trial_id]
+                try:
+                    payload = ray_tpu.get(ref)
+                except Exception as e:  # trial actor died / fn raised
+                    self._handle_failure(t, e)
+                    continue
+                self._process_result(t, payload)
+        else:
+            time.sleep(0.05)
+        self._save_state()
+        return self._exhausted and all(
+            t.is_finished for t in self._trials
+        ) and not self._pending_result
+
+    def run(self) -> List[Trial]:
+        while not self.step():
+            pass
+        return self._trials
+
+
+class Tuner:
+    """Reference: python/ray/tune/tuner.py:44."""
+
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[Any] = None,
+        _experiment_dir: Optional[str] = None,
+        _restore_state: Optional[dict] = None,
+    ):
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        name = getattr(run_config, "name", None) or f"tune_{int(time.time())}"
+        storage = getattr(run_config, "storage_path", None) or os.path.expanduser(
+            "~/ray_tpu_results"
+        )
+        self._dir = _experiment_dir or os.path.join(storage, name)
+        self._restore_state = _restore_state
+
+    def fit(self) -> ResultGrid:
+        ctrl = TuneController(
+            self._trainable,
+            self._param_space,
+            self._tune_config,
+            self._dir,
+            restore_state=self._restore_state,
+        )
+        trials = ctrl.run()
+        return ResultGrid(trials, self._tune_config.metric, self._tune_config.mode)
+
+    @classmethod
+    def restore(
+        cls,
+        path: str,
+        trainable: Callable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+    ) -> "Tuner":
+        """Resume an interrupted experiment from its directory (reference:
+        Tuner.restore). Unfinished trials restart (from their last
+        checkpoint when one was reported). ``param_space`` must be re-passed
+        when the search was not yet exhausted, so remaining variants can
+        still be generated."""
+        with open(os.path.join(path, "tuner_state.json")) as f:
+            state = json.load(f)
+        return cls(
+            trainable,
+            param_space=param_space,
+            tune_config=tune_config,
+            _experiment_dir=path,
+            _restore_state=state,
+        )

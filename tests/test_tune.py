@@ -1,0 +1,230 @@
+"""ray_tpu.tune tests (reference test model: python/ray/tune/tests/
+test_tune_controller.py, test_trial_scheduler.py, test_tuner_restore.py)."""
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import CONTINUE, STOP
+from ray_tpu.tune.trial import Trial
+
+
+def test_grid_search_expansion():
+    gen = tune.BasicVariantGenerator(
+        {"a": tune.grid_search([1, 2, 3]), "b": tune.grid_search([10, 20]), "c": 5},
+        num_samples=2,
+    )
+    assert gen.total_trials == 12
+    cfgs = [gen.suggest(f"t{i}") for i in range(12)]
+    assert all(c["c"] == 5 for c in cfgs)
+    assert {(c["a"], c["b"]) for c in cfgs} == {(a, b) for a in (1, 2, 3) for b in (10, 20)}
+
+
+def test_sample_domains():
+    gen = tune.BasicVariantGenerator(
+        {
+            "u": tune.uniform(0, 1),
+            "l": tune.loguniform(1e-4, 1e-1),
+            "r": tune.randint(0, 10),
+            "ch": tune.choice(["x", "y"]),
+        },
+        num_samples=20,
+        seed=0,
+    )
+    for i in range(20):
+        c = gen.suggest(f"t{i}")
+        assert 0 <= c["u"] <= 1
+        assert 1e-4 <= c["l"] <= 1e-1
+        assert 0 <= c["r"] < 10
+        assert c["ch"] in ("x", "y")
+
+
+def test_basic_tune_run(ray_start_regular, tmp_path):
+    def objective(config):
+        score = -((config["x"] - 3) ** 2)
+        tune.report({"score": score, "x": config["x"]})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search(list(range(7)))},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        _experiment_dir=str(tmp_path / "exp"),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 7
+    best = grid.get_best_result()
+    assert best.metrics["x"] == 3
+
+
+def test_multi_report_and_iterations(ray_start_regular, tmp_path):
+    def objective(config):
+        for i in range(5):
+            tune.report({"score": i * config["m"]})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"m": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        _experiment_dir=str(tmp_path / "exp"),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 8
+    assert best.metrics["training_iteration"] == 5
+
+
+def test_asha_stops_bad_trials(ray_start_regular, tmp_path):
+    def objective(config):
+        for i in range(20):
+            tune.report({"score": config["q"] * (i + 1)})
+
+    sched = tune.AsyncHyperBandScheduler(grace_period=2, max_t=20, reduction_factor=2)
+    # Descending grid + serial execution: the strong trial sets the rung
+    # cutoffs first, so weak trials are deterministically cut early (ASHA
+    # is asynchronous — a weak trial arriving at an empty rung survives it).
+    grid = tune.Tuner(
+        objective,
+        param_space={"q": tune.grid_search([4, 3, 2, 1])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", scheduler=sched, max_concurrent_trials=1
+        ),
+        _experiment_dir=str(tmp_path / "exp"),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.metrics["config"]["q"] == 4
+    # at least one weak trial must have been cut before max_t
+    iters = [t.iteration for t in grid.trials]
+    assert min(iters) < 20
+    assert max(iters) == 20
+
+
+def test_asha_rung_math():
+    sched = tune.AsyncHyperBandScheduler(grace_period=1, max_t=16, reduction_factor=4)
+    sched.set_search_properties("score", "max")
+    t1 = Trial("a", {})
+    # first trial at a rung always continues
+    assert sched.on_trial_result(t1, {"training_iteration": 1, "score": 10}) == CONTINUE
+    t2 = Trial("b", {})
+    # much worse trial at same rung gets cut once cutoff exists
+    assert sched.on_trial_result(t2, {"training_iteration": 1, "score": 1}) == STOP
+    # reaching max_t stops
+    assert sched.on_trial_result(t1, {"training_iteration": 16, "score": 99}) == STOP
+
+
+def test_trial_failure_retry(ray_start_regular, tmp_path):
+    marker = str(tmp_path / "fail_once")
+
+    def objective(config):
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            raise RuntimeError("boom")
+        tune.report({"score": 1})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="score", mode="max", max_failures=2),
+        _experiment_dir=str(tmp_path / "exp"),
+    ).fit()
+    assert grid.num_errors == 0
+    assert grid.get_best_result().metrics["score"] == 1
+
+
+def test_trial_failure_exhausted(ray_start_regular, tmp_path):
+    def objective(config):
+        raise RuntimeError("always fails")
+
+    grid = tune.Tuner(
+        objective,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="score", mode="max", max_failures=0),
+        _experiment_dir=str(tmp_path / "exp"),
+    ).fit()
+    assert grid.num_errors == 1
+
+
+def test_checkpoint_and_restore_experiment(ray_start_regular, tmp_path):
+    exp_dir = str(tmp_path / "exp")
+
+    def objective(config):
+        start = 0
+        ck = tune.get_checkpoint_dir()
+        if ck:
+            with open(os.path.join(ck, "state.json")) as f:
+                start = json.load(f)["iter"] + 1
+        for i in range(start, 6):
+            d = tune.make_checkpoint_dir()
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"iter": i}, f)
+            tune.report({"score": i}, checkpoint_dir=d)
+
+    grid = tune.Tuner(
+        objective,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        _experiment_dir=exp_dir,
+    ).fit()
+    assert grid.get_best_result().metrics["score"] == 5
+    assert os.path.exists(os.path.join(exp_dir, "tuner_state.json"))
+
+    # restore: finished trials are not re-run
+    tuner2 = tune.Tuner.restore(
+        exp_dir, objective, tune_config=tune.TuneConfig(metric="score", mode="max")
+    )
+    grid2 = tuner2.fit()
+    assert grid2.get_best_result().metrics["score"] == 5
+
+
+def test_pbt_exploit_explore(ray_start_regular, tmp_path):
+    # Trials with bad lr stagnate; PBT should clone from the good trial and
+    # end with every surviving trial near the top score.
+    def objective(config):
+        lr = config["lr"]
+        ck = tune.get_checkpoint_dir()
+        value = 0.0
+        if ck:
+            with open(os.path.join(ck, "v.json")) as f:
+                value = json.load(f)["v"]
+        for i in range(12):
+            value += lr
+            d = tune.make_checkpoint_dir()
+            with open(os.path.join(d, "v.json"), "w") as f:
+                json.dump({"v": value}, f)
+            tune.report({"score": value, "lr": lr}, checkpoint_dir=d)
+
+    sched = tune.PopulationBasedTraining(
+        perturbation_interval=3,
+        hyperparam_mutations={"lr": [0.1, 1.0]},
+        quantile_fraction=0.34,
+        seed=0,
+    )
+    grid = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.01, 0.02, 1.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", scheduler=sched, max_concurrent_trials=3
+        ),
+        _experiment_dir=str(tmp_path / "exp"),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.metrics["score"] >= 10  # the lr=1.0 lineage
+    # at least one trial must have been perturbed off its original lr
+    lrs = [t.metric("lr") for t in grid.trials]
+    assert any(lr not in (0.01, 0.02, 1.0) for lr in lrs) or best.metrics["score"] > 11.9
+
+
+def test_concurrency_limiter(ray_start_regular, tmp_path):
+    def objective(config):
+        tune.report({"score": config["i"]})
+
+    searcher = tune.ConcurrencyLimiter(
+        tune.BasicVariantGenerator({"i": tune.grid_search(list(range(6)))}), max_concurrent=2
+    )
+    grid = tune.Tuner(
+        objective,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="score", mode="max", search_alg=searcher),
+        _experiment_dir=str(tmp_path / "exp"),
+    ).fit()
+    assert len(grid) == 6
