@@ -51,7 +51,13 @@ class RangeDatasource(Datasource):
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
         if self._n == 0:
-            return [ReadTask(lambda: iter([{"id": np.empty(0, np.int64)}]), BlockMetadata(0, 0))]
+            shape = self._shape
+            empty = (
+                {"data": np.empty((0,) + shape, np.int64)}
+                if shape
+                else {"id": np.empty(0, np.int64)}
+            )
+            return [ReadTask(lambda e=empty: iter([e]), BlockMetadata(0, 0))]
         tasks = []
         parallelism = max(1, min(parallelism, self._n))
         chunk = -(-self._n // parallelism)

@@ -1,0 +1,32 @@
+"""ray_tpu.rllib: reinforcement learning — JAX modules, TPU learners.
+
+Reference: rllib/ (new API stack: Algorithm/EnvRunner/RLModule/Learner).
+"""
+from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, EnvRunnerGroup
+from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.episodes import SingleAgentEpisode, compute_gae, episodes_to_batch
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace_returns
+from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "EnvRunnerGroup",
+    "FaultTolerantActorManager",
+    "SingleAgentEnvRunner",
+    "SingleAgentEpisode",
+    "compute_gae",
+    "episodes_to_batch",
+    "RLModule",
+    "RLModuleSpec",
+    "Learner",
+    "LearnerGroup",
+    "PPO",
+    "PPOConfig",
+    "IMPALA",
+    "IMPALAConfig",
+    "vtrace_returns",
+]

@@ -1,0 +1,80 @@
+"""FaultTolerantActorManager: restart-and-resync failed actors.
+
+Reference: rllib/utils/actor_manager.py (FaultTolerantActorManager —
+foreach with error collection, health probing, restart) as used by
+EnvRunnerGroup (rllib/env/env_runner_group.py:833 foreach_worker,
+restart-and-resync at :357).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import ray_tpu
+
+logger = logging.getLogger("ray_tpu.rllib")
+
+
+class FaultTolerantActorManager:
+    def __init__(self, make_actor: Callable[[int], Any], num_actors: int):
+        """``make_actor(index)`` returns a fresh remote actor handle."""
+        self._make_actor = make_actor
+        self._actors: Dict[int, Any] = {i: make_actor(i) for i in range(num_actors)}
+        self._healthy: Dict[int, bool] = {i: True for i in self._actors}
+        self.num_restarts = 0
+
+    @property
+    def actors(self) -> Dict[int, Any]:
+        return dict(self._actors)
+
+    def num_healthy(self) -> int:
+        return sum(self._healthy.values())
+
+    def foreach_actor(
+        self,
+        fn_name: str,
+        *args,
+        timeout: Optional[float] = None,
+        restart_failed: bool = True,
+        **kwargs,
+    ) -> List[Tuple[int, Any]]:
+        """Call ``fn_name(*args)`` on every healthy actor; failed actors are
+        marked unhealthy (and optionally restarted). Returns
+        [(index, result)] for the successes."""
+        refs = {}
+        for i, actor in self._actors.items():
+            if not self._healthy[i]:
+                continue
+            refs[i] = getattr(actor, fn_name).remote(*args, **kwargs)
+        results: List[Tuple[int, Any]] = []
+        for i, ref in refs.items():
+            try:
+                results.append((i, ray_tpu.get(ref, timeout=timeout)))
+            except Exception as e:  # actor died / task failed
+                logger.warning("env-runner %d failed %s: %s", i, fn_name, e)
+                self._healthy[i] = False
+                if restart_failed:
+                    self.restart_actor(i)
+        return results
+
+    def restart_actor(self, i: int):
+        """Reference: env_runner_group.py restart-and-resync."""
+        try:
+            ray_tpu.kill(self._actors[i])
+        except Exception:
+            pass
+        self._actors[i] = self._make_actor(i)
+        self._healthy[i] = True
+        self.num_restarts += 1
+
+    def probe_health(self) -> List[int]:
+        """Ping everyone; returns indices that failed (now restarted)."""
+        failed = []
+        for i, actor in list(self._actors.items()):
+            try:
+                ray_tpu.get(actor.ping.remote(), timeout=10)
+            except Exception:
+                failed.append(i)
+                self._healthy[i] = False
+                self.restart_actor(i)
+        return failed

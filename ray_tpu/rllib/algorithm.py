@@ -1,0 +1,237 @@
+"""Algorithm + AlgorithmConfig + EnvRunnerGroup.
+
+Reference: rllib/algorithms/algorithm.py:596 (setup builds
+EnvRunnerGroup + LearnerGroup; step :896 → training_step :1680) and
+rllib/algorithms/algorithm_config.py (fluent config), env/env_runner_group.py:71.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.env_runner import SingleAgentEnvRunner, _make_env
+from ray_tpu.rllib.episodes import SingleAgentEpisode
+from ray_tpu.rllib.learner import LearnerGroup
+from ray_tpu.rllib.rl_module import RLModuleSpec
+
+
+class AlgorithmConfig:
+    """Fluent config (reference: algorithm_config.py — env_runners/
+    training/learners/evaluation sections)."""
+
+    def __init__(self):
+        self.env_spec: Any = None
+        self.num_env_runners: int = 0
+        self.num_envs_per_runner: int = 1
+        self.rollout_fragment_length: int = 200
+        self.train_batch_size: int = 4000
+        self.minibatch_size: int = 128
+        self.num_epochs: int = 4
+        self.lr: float = 3e-4
+        self.gamma: float = 0.99
+        self.lam: float = 0.95
+        self.grad_clip: float = 0.5
+        self.num_learners: int = 0
+        self.num_cpus_per_learner: float = 1
+        self.num_tpus_per_learner: float = 0
+        self.hidden: tuple = (64, 64)
+        self.seed: int = 0
+        self.extra: Dict[str, Any] = {}
+
+    # fluent setters ------------------------------------------------------
+    def environment(self, env: Any) -> "AlgorithmConfig":
+        self.env_spec = env
+        return self
+
+    def env_runners(
+        self, num_env_runners: int = 0, num_envs_per_env_runner: int = 1, rollout_fragment_length: int = 200
+    ) -> "AlgorithmConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kw) -> "AlgorithmConfig":
+        for k, v in kw.items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+        return self
+
+    def learners(
+        self, num_learners: int = 0, num_cpus_per_learner: float = 1, num_tpus_per_learner: float = 0
+    ) -> "AlgorithmConfig":
+        self.num_learners = num_learners
+        self.num_cpus_per_learner = num_cpus_per_learner
+        self.num_tpus_per_learner = num_tpus_per_learner
+        return self
+
+    def debugging(self, seed: int = 0) -> "AlgorithmConfig":
+        self.seed = seed
+        return self
+
+    def rl_module(self, hidden: tuple = (64, 64)) -> "AlgorithmConfig":
+        self.hidden = hidden
+        return self
+
+    def module_spec(self) -> RLModuleSpec:
+        env = _make_env(self.env_spec)
+        obs_dim = int(np.prod(env.observation_space.shape))
+        act_dim = int(env.action_space.n)
+        env.close()
+        return RLModuleSpec(observation_dim=obs_dim, action_dim=act_dim, hidden=tuple(self.hidden))
+
+    def build(self) -> "Algorithm":
+        raise NotImplementedError("use PPOConfig/IMPALAConfig")
+
+
+class EnvRunnerGroup:
+    """Local runner + N fault-tolerant remote runners (reference:
+    env/env_runner_group.py:71, sync_weights :499)."""
+
+    def __init__(self, config: AlgorithmConfig, module_spec: RLModuleSpec):
+        self._cfg = config
+        self._spec = module_spec
+        self.local_runner = SingleAgentEnvRunner(
+            config.env_spec, module_spec, num_envs=config.num_envs_per_runner, seed=config.seed
+        )
+        if config.num_env_runners > 0:
+            runner_cls = ray_tpu.remote(num_cpus=1, max_restarts=0)(SingleAgentEnvRunner)
+
+            def make(i: int):
+                return runner_cls.remote(
+                    config.env_spec,
+                    module_spec,
+                    num_envs=config.num_envs_per_runner,
+                    seed=config.seed,
+                    worker_index=i + 1,
+                )
+
+            self._manager = FaultTolerantActorManager(make, config.num_env_runners)
+        else:
+            self._manager = None
+        self._weights_version = 0
+
+    @property
+    def num_remote_runners(self) -> int:
+        return len(self._manager.actors) if self._manager else 0
+
+    @property
+    def num_restarts(self) -> int:
+        return self._manager.num_restarts if self._manager else 0
+
+    def sync_weights(self, params):
+        """Ship learner weights to every runner via one object-store put
+        (reference: sync_weights' broadcast-by-ref)."""
+        self._weights_version += 1
+        self.local_runner.set_state(params, self._weights_version)
+        if self._manager:
+            ref = ray_tpu.put(params)
+            self._manager.foreach_actor(
+                "set_state", ref, self._weights_version, timeout=60
+            )
+
+    def sample(self, total_env_steps: int) -> List[SingleAgentEpisode]:
+        """Synchronous parallel sampling (reference:
+        execution/rollout_ops.py synchronous_parallel_sample)."""
+        if not self._manager:
+            return self.local_runner.sample(total_env_steps)
+        n = max(1, self._manager.num_healthy())
+        per = max(1, total_env_steps // n)
+        results = self._manager.foreach_actor("sample", per, timeout=300)
+        episodes: List[SingleAgentEpisode] = []
+        for _, eps in results:
+            episodes.extend(eps)
+        if not episodes:  # every remote failed this round — fall back local
+            episodes = self.local_runner.sample(total_env_steps)
+        return episodes
+
+    def pop_metrics(self) -> List[float]:
+        returns = self.local_runner.pop_metrics()
+        if self._manager:
+            for _, r in self._manager.foreach_actor("pop_metrics", timeout=60):
+                returns.extend(r)
+        return returns
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        return self.local_runner.evaluate(num_episodes)
+
+
+class Algorithm:
+    """Reference: rllib/algorithms/algorithm.py (Trainable-style:
+    setup in __init__, train() per iteration, save/restore)."""
+
+    loss_fn = None  # set by subclass
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.module_spec = config.module_spec()
+        self.env_runner_group = EnvRunnerGroup(config, self.module_spec)
+        self.learner_group = LearnerGroup(
+            self.module_spec,
+            type(self).loss_fn,
+            loss_cfg=self._loss_cfg(),
+            num_learners=config.num_learners,
+            lr=config.lr,
+            grad_clip=config.grad_clip,
+            seed=config.seed,
+            num_cpus_per_learner=config.num_cpus_per_learner,
+            num_tpus_per_learner=config.num_tpus_per_learner,
+        )
+        self.iteration = 0
+        self._total_env_steps = 0
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def _loss_cfg(self) -> dict:
+        return {}
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.time()
+        result = self.training_step()
+        self.iteration += 1
+        result.setdefault("training_iteration", self.iteration)
+        result["time_this_iter_s"] = time.time() - t0
+        result["num_env_steps_sampled_lifetime"] = self._total_env_steps
+        result["env_steps_per_sec"] = result.get("env_steps_this_iter", 0) / max(
+            1e-9, result["time_this_iter_s"]
+        )
+        return result
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        return self.env_runner_group.evaluate(num_episodes)
+
+    # -- checkpointing (reference: Checkpointable mixin,
+    # rllib/utils/checkpoints.py; Algorithm.from_checkpoint) -------------
+    def save(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "learner_state.pkl"), "wb") as f:
+            pickle.dump(self.learner_group.get_state(), f)
+        with open(os.path.join(path, "algo_state.json"), "w") as f:
+            json.dump(
+                {"iteration": self.iteration, "total_env_steps": self._total_env_steps},
+                f,
+            )
+        return path
+
+    def restore(self, path: str):
+        with open(os.path.join(path, "learner_state.pkl"), "rb") as f:
+            self.learner_group.set_state(pickle.load(f))
+        with open(os.path.join(path, "algo_state.json")) as f:
+            st = json.load(f)
+        self.iteration = st["iteration"]
+        self._total_env_steps = st["total_env_steps"]
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def stop(self):
+        self.learner_group.shutdown()

@@ -1,0 +1,160 @@
+"""SingleAgentEnvRunner: vectorized gymnasium sampling actor.
+
+Reference: rllib/env/single_agent_env_runner.py:61 (``sample`` :131 —
+vector env stepping with an inference-only module + connectors). Runs as
+a CPU actor; the policy forward is jitted once (CPU backend) and stepped
+over the vector env.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.episodes import SingleAgentEpisode
+from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
+
+
+def _make_env(env_spec):
+    import gymnasium as gym
+
+    if callable(env_spec):
+        return env_spec()
+    return gym.make(env_spec)
+
+
+class SingleAgentEnvRunner:
+    """Samples episodes with the current policy weights.
+
+    Runs standalone (local mode) or as a remote actor in an
+    EnvRunnerGroup.
+    """
+
+    def __init__(
+        self,
+        env_spec: Any,
+        module_spec: RLModuleSpec,
+        num_envs: int = 1,
+        seed: int = 0,
+        worker_index: int = 0,
+    ):
+        import jax
+
+        self._envs = [_make_env(env_spec) for _ in range(num_envs)]
+        self._num_envs = num_envs
+        self.module = RLModule(module_spec)
+        self.params = self.module.init_params(jax.random.PRNGKey(seed))
+        self._key = jax.random.PRNGKey(seed * 100003 + worker_index)
+        self._explore = jax.jit(self.module.forward_exploration)
+        self._obs = [env.reset(seed=seed + worker_index * 1000 + i)[0] for i, env in enumerate(self._envs)]
+        self._episodes = [SingleAgentEpisode(observations=[o]) for o in self._obs]
+        self.worker_index = worker_index
+        self._weights_version = 0
+        # true per-episode returns across fragment cuts (metrics only)
+        self._return_acc = [0.0] * num_envs
+        self._completed_returns: List[float] = []
+
+    # -- weight sync (reference: env_runner_group.sync_weights) ----------
+    def set_state(self, params, weights_version: int = 0):
+        import jax
+
+        self.params = jax.tree.map(lambda x: x, params)
+        self._weights_version = weights_version
+
+    def get_state(self):
+        return {"params": self.params, "weights_version": self._weights_version}
+
+    def ping(self) -> str:
+        return "pong"
+
+    def sample(self, num_env_steps: int, explore: bool = True) -> List[SingleAgentEpisode]:
+        """Step all envs until ``num_env_steps`` total steps are collected;
+        returns completed episodes plus truncated in-progress chunks (each
+        with a bootstrap value)."""
+        import jax
+        import jax.numpy as jnp
+
+        done_eps: List[SingleAgentEpisode] = []
+        steps = 0
+        while steps < num_env_steps:
+            obs_batch = np.stack(self._obs).astype(np.float32)
+            self._key, sub = jax.random.split(self._key)
+            out = self._explore(self.params, jnp.asarray(obs_batch), sub)
+            actions = np.asarray(out["action"])
+            logps = np.asarray(out["logp"])
+            values = np.asarray(out["vf"])
+            for i, env in enumerate(self._envs):
+                act = int(actions[i])
+                nobs, rew, term, trunc, _ = env.step(act)
+                ep = self._episodes[i]
+                ep.actions.append(act)
+                ep.rewards.append(float(rew))
+                ep.logps.append(float(logps[i]))
+                ep.values.append(float(values[i]))
+                ep.observations.append(nobs)
+                steps += 1
+                self._return_acc[i] += float(rew)
+                if term or trunc:
+                    self._completed_returns.append(self._return_acc[i])
+                    self._return_acc[i] = 0.0
+                if term or trunc:
+                    ep.terminated = bool(term)
+                    ep.truncated = bool(trunc)
+                    if trunc:
+                        ep.final_value = float(
+                            np.asarray(
+                                self.module.forward_train(
+                                    self.params, jnp.asarray(nobs[None].astype(np.float32))
+                                )["vf"]
+                            )[0]
+                        )
+                    done_eps.append(ep)
+                    nobs = env.reset()[0]
+                    self._episodes[i] = SingleAgentEpisode(observations=[nobs])
+                self._obs[i] = nobs
+        # cut in-progress episodes, bootstrapping their final value
+        for i in range(self._num_envs):
+            ep = self._episodes[i]
+            if len(ep) > 0:
+                import jax.numpy as jnp
+
+                ep.truncated = True
+                ep.final_value = float(
+                    np.asarray(
+                        self.module.forward_train(
+                            self.params, jnp.asarray(self._obs[i][None].astype(np.float32))
+                        )["vf"]
+                    )[0]
+                )
+                done_eps.append(ep)
+                self._episodes[i] = SingleAgentEpisode(observations=[self._obs[i]])
+        return done_eps
+
+    def pop_metrics(self) -> List[float]:
+        """Completed-episode returns since the last call (true returns,
+        unaffected by fragment cuts)."""
+        out = self._completed_returns
+        self._completed_returns = []
+        return out
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        """Mean greedy-policy return (deterministic eval)."""
+        import jax
+        import jax.numpy as jnp
+
+        infer = jax.jit(self.module.forward_inference)
+        total = 0.0
+        env = self._envs[0]
+        for e in range(num_episodes):
+            obs, _ = env.reset(seed=10_000 + e)
+            done = False
+            while not done:
+                act = int(np.asarray(infer(self.params, jnp.asarray(obs[None].astype(np.float32))))[0])
+                obs, rew, term, trunc, _ = env.step(act)
+                total += float(rew)
+                done = term or trunc
+        # runner state was clobbered; reset in-progress episodes
+        self._obs = [env.reset(seed=i)[0] for i, env in enumerate(self._envs)]
+        self._episodes = [SingleAgentEpisode(observations=[o]) for o in self._obs]
+        return total / num_episodes

@@ -1,0 +1,91 @@
+"""Episode containers + advantage estimation connectors.
+
+Reference: rllib/env/single_agent_episode.py (SingleAgentEpisode) and the
+learner connector pipeline (rllib/connectors/learner/
+general_advantage_estimation.py). GAE/V-trace are pure numpy/jax
+functions here — they run inside the learner's jit on TPU or on the CPU
+path in env runners.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class SingleAgentEpisode:
+    observations: List[np.ndarray] = field(default_factory=list)  # T+1
+    actions: List[int] = field(default_factory=list)  # T
+    rewards: List[float] = field(default_factory=list)  # T
+    logps: List[float] = field(default_factory=list)  # T
+    values: List[float] = field(default_factory=list)  # T
+    terminated: bool = False
+    truncated: bool = False
+    final_value: float = 0.0  # bootstrap V(s_T) when truncated
+
+    def __len__(self) -> int:
+        return len(self.actions)
+
+    @property
+    def total_reward(self) -> float:
+        return float(sum(self.rewards))
+
+
+def compute_gae(
+    rewards: np.ndarray,
+    values: np.ndarray,
+    final_value: float,
+    terminated: bool,
+    gamma: float = 0.99,
+    lam: float = 0.95,
+):
+    """Generalized Advantage Estimation over one episode (reference:
+    rllib/connectors/learner/general_advantage_estimation.py +
+    rllib/evaluation/postprocessing.py compute_advantages)."""
+    T = len(rewards)
+    adv = np.zeros(T, dtype=np.float32)
+    next_v = 0.0 if terminated else float(final_value)
+    gae = 0.0
+    for t in range(T - 1, -1, -1):
+        delta = rewards[t] + gamma * next_v - values[t]
+        gae = delta + gamma * lam * gae
+        adv[t] = gae
+        next_v = values[t]
+    returns = adv + values
+    return adv, returns
+
+
+def episodes_to_batch(
+    episodes: List[SingleAgentEpisode],
+    gamma: float = 0.99,
+    lam: float = 0.95,
+    normalize_advantages: bool = True,
+) -> Dict[str, np.ndarray]:
+    """Learner-connector: episodes → flat train batch with GAE targets."""
+    obs, acts, logps, advs, rets, vals = [], [], [], [], [], []
+    for ep in episodes:
+        if len(ep) == 0:
+            continue
+        r = np.asarray(ep.rewards, dtype=np.float32)
+        v = np.asarray(ep.values, dtype=np.float32)
+        a, ret = compute_gae(r, v, ep.final_value, ep.terminated, gamma, lam)
+        obs.append(np.asarray(ep.observations[: len(ep)], dtype=np.float32))
+        acts.append(np.asarray(ep.actions, dtype=np.int32))
+        logps.append(np.asarray(ep.logps, dtype=np.float32))
+        advs.append(a)
+        rets.append(ret)
+        vals.append(v)
+    batch = {
+        "obs": np.concatenate(obs),
+        "actions": np.concatenate(acts),
+        "logp_old": np.concatenate(logps),
+        "advantages": np.concatenate(advs),
+        "returns": np.concatenate(rets),
+        "values_old": np.concatenate(vals),
+    }
+    if normalize_advantages:
+        a = batch["advantages"]
+        batch["advantages"] = (a - a.mean()) / (a.std() + 1e-8)
+    return batch

@@ -1,0 +1,276 @@
+"""Learner + LearnerGroup: the gradient-update side of the RL stack.
+
+Reference: rllib/core/learner/learner.py:117 (Learner — owns optimizer +
+loss, ``update_from_batch`` :954) and learner_group.py:79 (local or N
+remote learner actors). The reference syncs gradients with torch DDP
+across learner actors (torch_rl_module.py:160); here the TPU-native
+replacements are:
+
+- single learner, N local devices: the update step is one jit over the
+  device mesh — batch sharded on the 'dp' axis, params replicated, and
+  XLA inserts the psum for the gradient mean (in-graph, rides ICI).
+- N learner actors (multi-host): each actor runs the jitted update on its
+  shard and gradients are allreduced through ray_tpu.collective's host
+  group (the torch-DDP-across-actors analogue).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.rl_module import Params, RLModule, RLModuleSpec
+
+LossFn = Callable[..., Any]  # (module, params, batch, **cfg) -> (loss, metrics)
+
+
+class Learner:
+    """Owns params + optax optimizer + a jitted, mesh-aware update."""
+
+    def __init__(
+        self,
+        module_spec: RLModuleSpec,
+        loss_fn: LossFn,
+        loss_cfg: Optional[dict] = None,
+        lr: float = 3e-4,
+        grad_clip: float = 0.5,
+        seed: int = 0,
+        use_device_mesh: bool = True,
+        collective_group: Optional[str] = None,
+        world_size: int = 1,
+        rank: int = 0,
+    ):
+        import jax
+        import optax
+
+        self.module = RLModule(module_spec)
+        self.params = self.module.init_params(jax.random.PRNGKey(seed))
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(grad_clip), optax.adam(lr)
+        )
+        self.opt_state = self.optimizer.init(self.params)
+        self._loss_fn = loss_fn
+        self._loss_cfg = loss_cfg or {}
+        self._collective_group = collective_group
+        self._world_size = world_size
+        self._rank = rank
+        self._build_update(use_device_mesh)
+
+    # -- the TPU-native "DDP": in-graph psum over the device mesh --------
+    def _build_update(self, use_device_mesh: bool):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        module, loss_fn, cfg = self.module, self._loss_fn, self._loss_cfg
+
+        def update(params, opt_state, batch):
+            def scalar_loss(p):
+                loss, metrics = loss_fn(module, p, batch, **cfg)
+                return loss, metrics
+
+            (loss, metrics), grads = jax.value_and_grad(scalar_loss, has_aux=True)(
+                params
+            )
+            updates, new_opt = self.optimizer.update(grads, opt_state, params)
+            import optax
+
+            new_params = optax.apply_updates(params, updates)
+            metrics = dict(metrics)
+            metrics["loss"] = loss
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return new_params, new_opt, metrics, grads
+
+        devs = jax.local_devices()
+        if use_device_mesh and len(devs) > 1:
+            # Batch rows sharded over 'dp'; params replicated. XLA emits the
+            # gradient-mean psum inside the compiled program (ICI path).
+            self.mesh = Mesh(np.array(devs), ("dp",))
+            batch_sharding = NamedSharding(self.mesh, P("dp"))
+            repl = NamedSharding(self.mesh, P())
+            self._update = jax.jit(
+                update,
+                in_shardings=(repl, repl, batch_sharding),
+                out_shardings=(repl, repl, repl, repl),
+            )
+        else:
+            self.mesh = None
+            self._update = jax.jit(update)
+
+    # -- API -------------------------------------------------------------
+    def update_from_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        if self.mesh is not None:
+            # pad batch rows to a multiple of the mesh size
+            n = len(jax.local_devices())
+            rows = len(next(iter(batch.values())))
+            pad = (-rows) % n
+            if pad:
+                batch = {
+                    k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+                    for k, v in batch.items()
+                }
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        new_params, new_opt, metrics, grads = self._update(
+            self.params, self.opt_state, batch
+        )
+        if self._collective_group is not None and self._world_size > 1:
+            # Cross-actor gradient sync (the torch-DDP analogue): average
+            # grads over the host collective, then re-apply locally so all
+            # learner replicas stay bit-identical.
+            from ray_tpu import collective
+            from ray_tpu.collective.types import ReduceOp
+            import optax
+
+            flat, treedef = jax.tree.flatten(grads)
+            avg = []
+            for g in flat:
+                arr = np.asarray(g, dtype=np.float32) / self._world_size
+                arr = collective.allreduce(
+                    arr, group_name=self._collective_group, op=ReduceOp.SUM
+                )
+                avg.append(jnp.asarray(arr))
+            grads = jax.tree.unflatten(treedef, avg)
+            updates, self.opt_state = self.optimizer.update(
+                grads, self.opt_state, self.params
+            )
+            self.params = optax.apply_updates(self.params, updates)
+        else:
+            self.params, self.opt_state = new_params, new_opt
+        return {k: float(np.asarray(v)) for k, v in metrics.items()}
+
+    def get_weights(self) -> Params:
+        return self.params
+
+    def set_weights(self, params: Params):
+        self.params = params
+
+    def get_state(self) -> dict:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def set_state(self, state: dict):
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+
+    def ping(self) -> str:
+        return "pong"
+
+
+class _RemoteLearner(Learner):
+    """Actor wrapper that joins the gradient-sync collective group."""
+
+    def __init__(self, group_name: str, world_size: int, rank: int, **kw):
+        from ray_tpu import collective
+
+        collective.init_collective_group(
+            world_size=world_size, rank=rank, group_name=group_name
+        )
+        super().__init__(
+            collective_group=group_name, world_size=world_size, rank=rank, **kw
+        )
+
+
+class LearnerGroup:
+    """Reference: rllib/core/learner/learner_group.py:79 — local mode (one
+    in-process learner, mesh-parallel over local devices) or remote mode
+    (N learner actors with collective grad sync)."""
+
+    def __init__(
+        self,
+        module_spec: RLModuleSpec,
+        loss_fn: LossFn,
+        loss_cfg: Optional[dict] = None,
+        num_learners: int = 0,
+        lr: float = 3e-4,
+        grad_clip: float = 0.5,
+        seed: int = 0,
+        num_cpus_per_learner: float = 1,
+        num_tpus_per_learner: float = 0,
+    ):
+        self._num = num_learners
+        if num_learners <= 0:
+            self._local = Learner(
+                module_spec, loss_fn, loss_cfg, lr=lr, grad_clip=grad_clip, seed=seed
+            )
+            self._actors = []
+        else:
+            import ray_tpu
+            import time
+
+            self._local = None
+            group_name = f"learners_{int(time.time()*1e6)}"
+            cls = ray_tpu.remote(
+                num_cpus=num_cpus_per_learner, num_tpus=num_tpus_per_learner
+            )(_RemoteLearner)
+            self._actors = [
+                cls.remote(
+                    group_name,
+                    num_learners,
+                    rank,
+                    module_spec=module_spec,
+                    loss_fn=loss_fn,
+                    loss_cfg=loss_cfg,
+                    lr=lr,
+                    grad_clip=grad_clip,
+                    seed=seed,
+                    use_device_mesh=False,
+                )
+                for rank in range(num_learners)
+            ]
+            for a in self._actors:
+                ray_tpu.wait_actor_ready(a)
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        if self._local is not None:
+            return self._local.update_from_batch(batch)
+        import ray_tpu
+
+        n = len(self._actors)
+        rows = len(next(iter(batch.values())))
+        shard = max(1, rows // n)
+        refs = []
+        for i, a in enumerate(self._actors):
+            lo = i * shard
+            hi = rows if i == n - 1 else (i + 1) * shard
+            refs.append(
+                a.update_from_batch.remote({k: v[lo:hi] for k, v in batch.items()})
+            )
+        all_metrics = ray_tpu.get(refs)
+        out = {}
+        for k in all_metrics[0]:
+            out[k] = float(np.mean([m[k] for m in all_metrics]))
+        return out
+
+    def get_weights(self) -> Params:
+        if self._local is not None:
+            return self._local.get_weights()
+        import ray_tpu
+
+        return ray_tpu.get(self._actors[0].get_weights.remote())
+
+    def get_state(self) -> dict:
+        if self._local is not None:
+            return self._local.get_state()
+        import ray_tpu
+
+        return ray_tpu.get(self._actors[0].get_state.remote())
+
+    def set_state(self, state: dict):
+        if self._local is not None:
+            self._local.set_state(state)
+        else:
+            import ray_tpu
+
+            ray_tpu.get([a.set_state.remote(state) for a in self._actors])
+
+    def shutdown(self):
+        import ray_tpu
+
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

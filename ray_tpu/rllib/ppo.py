@@ -1,0 +1,109 @@
+"""PPO — clipped surrogate objective on GAE advantages.
+
+Reference: rllib/algorithms/ppo/ppo.py:378 (training_step :413 — sample →
+learner update → sync weights) and ppo_learner's loss
+(rllib/algorithms/ppo/torch/ppo_torch_learner.py): ratio clip, value-loss
+clip, entropy bonus, KL early-stop.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.episodes import episodes_to_batch
+
+
+def ppo_loss(
+    module,
+    params,
+    batch,
+    clip_param: float = 0.2,
+    vf_clip_param: float = 10.0,
+    vf_loss_coeff: float = 0.5,
+    entropy_coeff: float = 0.0,
+):
+    import jax.numpy as jnp
+
+    out = module.logp_entropy(params, batch["obs"], batch["actions"])
+    ratio = jnp.exp(out["logp"] - batch["logp_old"])
+    adv = batch["advantages"]
+    surrogate = jnp.minimum(
+        ratio * adv, jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv
+    )
+    policy_loss = -jnp.mean(surrogate)
+    # clipped value loss (reference: ppo_torch_learner vf_clip)
+    vf_err = (out["vf"] - batch["returns"]) ** 2
+    vf_clipped = batch["values_old"] + jnp.clip(
+        out["vf"] - batch["values_old"], -vf_clip_param, vf_clip_param
+    )
+    vf_err_clipped = (vf_clipped - batch["returns"]) ** 2
+    vf_loss = 0.5 * jnp.mean(jnp.maximum(vf_err, vf_err_clipped))
+    entropy = jnp.mean(out["entropy"])
+    total = policy_loss + vf_loss_coeff * vf_loss - entropy_coeff * entropy
+    approx_kl = jnp.mean(batch["logp_old"] - out["logp"])
+    return total, {
+        "policy_loss": policy_loss,
+        "vf_loss": vf_loss,
+        "entropy": entropy,
+        "approx_kl": approx_kl,
+    }
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.kl_target = 0.02
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO(Algorithm):
+    loss_fn = staticmethod(ppo_loss)
+
+    def _loss_cfg(self) -> dict:
+        c = self.config
+        return dict(
+            clip_param=c.clip_param,
+            vf_clip_param=c.vf_clip_param,
+            vf_loss_coeff=c.vf_loss_coeff,
+            entropy_coeff=c.entropy_coeff,
+        )
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        # 1. sample (reference: ppo.py:418 synchronous_parallel_sample)
+        episodes = self.env_runner_group.sample(cfg.train_batch_size)
+        env_steps = sum(len(e) for e in episodes)
+        self._total_env_steps += env_steps
+        batch = episodes_to_batch(episodes, gamma=cfg.gamma, lam=cfg.lam)
+        # 2. minibatch-epoch updates (reference: learner minibatch cycle)
+        rows = len(batch["obs"])
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        metrics: Dict[str, float] = {}
+        for _ in range(cfg.num_epochs):
+            order = rng.permutation(rows)
+            for lo in range(0, rows, cfg.minibatch_size):
+                idx = order[lo : lo + cfg.minibatch_size]
+                mb = {k: v[idx] for k, v in batch.items()}
+                metrics = self.learner_group.update_from_batch(mb)
+            if metrics.get("approx_kl", 0.0) > 1.5 * self.config.kl_target:
+                break  # KL early-stop (reference: ppo kl coeff logic)
+        # 3. sync weights to runners (reference: ppo.py:500)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        returns = self.env_runner_group.pop_metrics()
+        if returns:
+            self._recent_returns = (getattr(self, "_recent_returns", []) + returns)[-100:]
+        mean_ret = float(np.mean(self._recent_returns)) if getattr(self, "_recent_returns", None) else 0.0
+        return {
+            "env_steps_this_iter": env_steps,
+            "episode_return_mean": mean_ret,
+            "num_episodes": len(returns),
+            **{f"learner/{k}": v for k, v in metrics.items()},
+        }

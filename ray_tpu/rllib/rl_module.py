@@ -1,0 +1,105 @@
+"""RLModule: the framework-neutral model abstraction, JAX-native.
+
+Reference: rllib/core/rl_module/rl_module.py (RLModule,
+forward_inference/forward_exploration/forward_train, inference-only
+state) — re-designed for TPU: params are pytrees, forwards are pure
+functions jitted by the caller, so the same module runs vmapped in env
+runners (CPU) and pjit-sharded in learners (TPU mesh).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, Any]
+
+
+@dataclass
+class RLModuleSpec:
+    """Reference: rllib/core/rl_module/rl_module.py RLModuleSpec."""
+
+    observation_dim: int
+    action_dim: int
+    hidden: Tuple[int, ...] = (64, 64)
+    free_log_std: bool = False  # continuous-action stddev as free params
+    discrete: bool = True
+
+
+class RLModule:
+    """Policy + value function over flat observations.
+
+    Subclasses override ``init_params`` / ``forward_train``; the base class
+    implements an MLP torso with separate policy and value heads (the
+    reference's default FC net, rllib/models/catalog defaults).
+    """
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+
+    # -- params ----------------------------------------------------------
+    def init_params(self, key: jax.Array) -> Params:
+        sizes = (self.spec.observation_dim,) + tuple(self.spec.hidden)
+        params: Params = {"pi": {}, "vf": {}}
+        keys = jax.random.split(key, 2 * len(sizes) + 2)
+        ki = 0
+        for head, out_dim in (("pi", self.spec.action_dim), ("vf", 1)):
+            layers = {}
+            for i in range(len(sizes) - 1):
+                layers[f"w{i}"] = (
+                    jax.random.normal(keys[ki], (sizes[i], sizes[i + 1]))
+                    * np.sqrt(2.0 / sizes[i])
+                ).astype(jnp.float32)
+                layers[f"b{i}"] = jnp.zeros(sizes[i + 1])
+                ki += 1
+            layers["w_out"] = (
+                jax.random.normal(keys[ki], (sizes[-1], out_dim)) * 0.01
+            ).astype(jnp.float32)
+            layers["b_out"] = jnp.zeros(out_dim)
+            ki += 1
+            params[head] = layers
+        if not self.spec.discrete and self.spec.free_log_std:
+            params["log_std"] = jnp.zeros(self.spec.action_dim)
+        return params
+
+    def _mlp(self, layers: Params, x: jax.Array) -> jax.Array:
+        n = len(self.spec.hidden)
+        for i in range(n):
+            x = jnp.tanh(x @ layers[f"w{i}"] + layers[f"b{i}"])
+        return x @ layers["w_out"] + layers["b_out"]
+
+    # -- forwards (pure; caller jits) ------------------------------------
+    def forward_train(self, params: Params, obs: jax.Array) -> Dict[str, jax.Array]:
+        """Both heads: action logits + value estimates."""
+        logits = self._mlp(params["pi"], obs)
+        values = self._mlp(params["vf"], obs)[..., 0]
+        return {"logits": logits, "vf": values}
+
+    def forward_inference(self, params: Params, obs: jax.Array) -> jax.Array:
+        """Greedy action (deterministic serving path)."""
+        return jnp.argmax(self._mlp(params["pi"], obs), axis=-1)
+
+    def forward_exploration(
+        self, params: Params, obs: jax.Array, key: jax.Array
+    ) -> Dict[str, jax.Array]:
+        """Sampled action + logp + value (rollout path)."""
+        out = self.forward_train(params, obs)
+        logits = out["logits"]
+        action = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), action
+        ]
+        return {"action": action, "logp": logp, "vf": out["vf"]}
+
+    def logp_entropy(
+        self, params: Params, obs: jax.Array, actions: jax.Array
+    ) -> Dict[str, jax.Array]:
+        out = self.forward_train(params, obs)
+        logits = out["logits"]
+        logsm = jax.nn.log_softmax(logits)
+        logp = logsm[jnp.arange(logits.shape[0]), actions]
+        entropy = -jnp.sum(jnp.exp(logsm) * logsm, axis=-1)
+        return {"logp": logp, "entropy": entropy, "vf": out["vf"], "logits": logits}

@@ -54,7 +54,6 @@ class AsyncHyperBandScheduler(TrialScheduler):
         grace_period: int = 1,
         max_t: int = 100,
         reduction_factor: float = 4,
-        brackets: int = 1,
     ):
         self._time_attr = time_attr
         self._grace = grace_period

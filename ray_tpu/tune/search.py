@@ -91,6 +91,11 @@ def grid_search(values) -> GridSearch:
     return GridSearch(values)
 
 
+# Sentinel returned by back-pressuring searchers when no slot is free
+# (compare by identity: ``cfg is PENDING_SUGGESTION``).
+PENDING_SUGGESTION = "__pending__"
+
+
 class Searcher:
     """Pluggable suggestion interface (reference: tune/search/searcher.py)."""
 
@@ -153,9 +158,9 @@ class ConcurrencyLimiter(Searcher):
 
     def suggest(self, trial_id: str):
         if len(self._live) >= self.max_concurrent:
-            return "__pending__"
+            return PENDING_SUGGESTION
         cfg = self.searcher.suggest(trial_id)
-        if cfg is not None and cfg != "__pending__":
+        if cfg is not None and cfg is not PENDING_SUGGESTION:
             self._live.add(trial_id)
         return cfg
 

@@ -18,7 +18,7 @@ import ray_tpu
 from ray_tpu.train.trainer import Result
 from ray_tpu.tune import trial as trial_mod
 from ray_tpu.tune.schedulers import CONTINUE, PAUSE, STOP, FIFOScheduler, TrialScheduler
-from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.search import PENDING_SUGGESTION, BasicVariantGenerator, Searcher
 from ray_tpu.tune.trial import ERROR, PAUSED, PENDING, RUNNING, TERMINATED, Trial, TrialRunner
 from ray_tpu.utils.serialization import serialize_function
 
@@ -92,6 +92,17 @@ class ResultGrid:
         )
 
 
+def _json_np(o):
+    """numpy scalars/arrays in metrics/configs must not kill _save_state."""
+    import numpy as _np
+
+    if isinstance(o, _np.generic):
+        return o.item()
+    if isinstance(o, _np.ndarray):
+        return o.tolist()
+    return repr(o)
+
+
 class TuneController:
     def __init__(
         self,
@@ -119,9 +130,19 @@ class TuneController:
         if restore_state:
             self._load_state(restore_state)
             # Skip searcher variants already materialized as trials before
-            # the interruption (grid positions are deterministic).
-            for _ in range(self._next_id):
-                self._searcher.suggest("__restored__")
+            # the interruption (grid positions are deterministic). Complete
+            # each suggestion so stateful searchers (ConcurrencyLimiter)
+            # don't leak live slots.
+            for t in self._trials:
+                self._searcher.suggest(t.trial_id)
+                self._searcher.on_trial_complete(t.trial_id, t.last_result)
+                # A trial interrupted without a checkpoint restarts from
+                # scratch — stale history would feed schedulers an inflated
+                # time_attr and duplicate metrics_history.
+                if not t.is_finished and t.checkpoint_dir is None:
+                    t.iteration = 0
+                    t.results = []
+                    t.last_result = None
 
     # -- experiment state (save/resume; reference:
     # tune/execution/experiment_state.py) ---------------------------------
@@ -148,7 +169,7 @@ class TuneController:
         }
         tmp = os.path.join(self._dir, ".tuner_state.json.tmp")
         with open(tmp, "w") as f:
-            json.dump(state, f)
+            json.dump(state, f, default=_json_np)
         os.replace(tmp, os.path.join(self._dir, "tuner_state.json"))
 
     def _load_state(self, state: dict):
@@ -230,7 +251,7 @@ class TuneController:
             if cfg is None:
                 self._exhausted = True
                 return
-            if cfg == "__pending__":
+            if cfg is PENDING_SUGGESTION:
                 return
             self._next_id += 1
             t = Trial(trial_id=tid, config=cfg)

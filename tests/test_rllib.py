@@ -1,0 +1,243 @@
+"""RL stack tests (reference test model: rllib/algorithms/ppo/tests/
+test_ppo.py learning thresholds on CartPole, rllib/utils/tests/
+test_actor_manager.py)."""
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (
+    FaultTolerantActorManager,
+    PPOConfig,
+    IMPALAConfig,
+    RLModule,
+    RLModuleSpec,
+    SingleAgentEnvRunner,
+    compute_gae,
+    episodes_to_batch,
+    vtrace_returns,
+)
+
+
+def test_gae_math():
+    # hand-checkable: gamma=1, lam=1 → advantage = sum(future r) - V(s)
+    r = np.array([1.0, 1.0, 1.0])
+    v = np.array([0.5, 0.5, 0.5])
+    adv, ret = compute_gae(r, v, final_value=0.0, terminated=True, gamma=1.0, lam=1.0)
+    np.testing.assert_allclose(ret, [3.0, 2.0, 1.0])
+    np.testing.assert_allclose(adv, [2.5, 1.5, 0.5])
+
+
+def test_gae_bootstrap_truncated():
+    r = np.array([0.0])
+    v = np.array([0.0])
+    adv, ret = compute_gae(r, v, final_value=10.0, terminated=False, gamma=0.5, lam=1.0)
+    np.testing.assert_allclose(ret, [5.0])
+
+
+def test_vtrace_on_policy_equals_discounted():
+    # on-policy (ratios=1), c/rho caps inactive → vs = n-step returns
+    T = 4
+    logp = np.zeros(T, dtype=np.float32)
+    r = np.ones(T, dtype=np.float32)
+    v = np.zeros(T, dtype=np.float32)
+    vs, pg = vtrace_returns(logp, logp, r, v, 0.0, True, gamma=1.0)
+    np.testing.assert_allclose(vs, [4, 3, 2, 1], atol=1e-5)
+    np.testing.assert_allclose(pg, [4, 3, 2, 1], atol=1e-5)
+
+
+def test_rl_module_shapes():
+    import jax
+
+    spec = RLModuleSpec(observation_dim=4, action_dim=2, hidden=(8,))
+    m = RLModule(spec)
+    params = m.init_params(jax.random.PRNGKey(0))
+    obs = np.zeros((5, 4), dtype=np.float32)
+    out = m.forward_train(params, obs)
+    assert out["logits"].shape == (5, 2)
+    assert out["vf"].shape == (5,)
+    ex = m.forward_exploration(params, obs, jax.random.PRNGKey(1))
+    assert ex["action"].shape == (5,)
+    le = m.logp_entropy(params, obs, np.asarray(ex["action"]))
+    assert le["entropy"].shape == (5,)
+    assert (np.asarray(le["entropy"]) > 0).all()
+
+
+def test_env_runner_sampling():
+    spec = RLModuleSpec(observation_dim=4, action_dim=2)
+    runner = SingleAgentEnvRunner("CartPole-v1", spec, num_envs=2, seed=0)
+    eps = runner.sample(100)
+    assert sum(len(e) for e in eps) >= 100
+    for e in eps:
+        assert len(e.observations) == len(e.actions) + 1
+        assert e.terminated or e.truncated
+    batch = episodes_to_batch(eps)
+    assert batch["obs"].shape[0] == batch["actions"].shape[0]
+    assert abs(float(batch["advantages"].mean())) < 1e-5  # normalized
+
+
+def test_episode_return_metrics():
+    spec = RLModuleSpec(observation_dim=4, action_dim=2)
+    runner = SingleAgentEnvRunner("CartPole-v1", spec, num_envs=1, seed=0)
+    runner.sample(300)
+    returns = runner.pop_metrics()
+    assert returns, "at least one episode should finish in 300 steps"
+    assert all(r >= 8 for r in returns)  # CartPole episodes last >=8 steps
+    assert runner.pop_metrics() == []
+
+
+def test_actor_manager_restarts(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Flaky:
+        def __init__(self, idx):
+            self.idx = idx
+
+        def ping(self):
+            return "pong"
+
+        def work(self):
+            return self.idx
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    mgr = FaultTolerantActorManager(lambda i: Flaky.remote(i), 3)
+    results = mgr.foreach_actor("work", timeout=30)
+    assert sorted(r for _, r in results) == [0, 1, 2]
+    # kill one actor; foreach marks it unhealthy and restarts it
+    try:
+        import ray_tpu as rt
+
+        rt.get(mgr.actors[1].die.remote(), timeout=10)
+    except Exception:
+        pass
+    results = mgr.foreach_actor("work", timeout=30)
+    assert mgr.num_restarts >= 0
+    # after restart everyone answers again
+    results = mgr.foreach_actor("work", timeout=30)
+    assert sorted(r for _, r in results) == [0, 1, 2]
+
+
+def test_ppo_learns_cartpole_local():
+    """Learning-threshold test (reference: tuned_examples cartpole-ppo:
+    reward >=150 — scaled down for CI wall-clock)."""
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=4)
+        .training(train_batch_size=1024, minibatch_size=256, num_epochs=6, lr=3e-3,
+                  entropy_coeff=0.01)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    best = 0.0
+    for i in range(15):
+        result = algo.train()
+        best = max(best, result["episode_return_mean"])
+        if best >= 120:
+            break
+    assert best >= 120, f"PPO failed to learn CartPole: best={best}"
+    algo.stop()
+
+
+def test_ppo_distributed_runners(ray_start_regular):
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=2)
+        .training(train_batch_size=512, minibatch_size=128, num_epochs=3, lr=1e-3)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    r1 = algo.train()
+    r2 = algo.train()
+    assert r2["num_env_steps_sampled_lifetime"] >= 1000
+    assert "learner/loss" in r2
+    algo.stop()
+
+
+def test_ppo_checkpoint_restore(tmp_path):
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .training(train_batch_size=256, minibatch_size=128, num_epochs=1)
+    )
+    algo = config.build()
+    algo.train()
+    path = algo.save(str(tmp_path / "ckpt"))
+    w_before = algo.learner_group.get_weights()
+
+    algo2 = config.build()
+    algo2.restore(path)
+    w_after = algo2.learner_group.get_weights()
+    import jax
+
+    for a, b in zip(jax.tree.leaves(w_before), jax.tree.leaves(w_after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert algo2.iteration == 1
+    algo.stop(), algo2.stop()
+
+
+def test_impala_local_smoke():
+    config = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=2,
+                     rollout_fragment_length=200)
+        .training(lr=1e-3)
+    )
+    algo = config.build()
+    for _ in range(3):
+        result = algo.train()
+    assert result["num_env_steps_sampled_lifetime"] >= 600
+    assert "learner/loss" in result
+    algo.stop()
+
+
+def test_impala_async_distributed(ray_start_regular):
+    config = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=1,
+                     rollout_fragment_length=100)
+        .training(lr=1e-3)
+    )
+    algo = config.build()
+    for _ in range(4):
+        result = algo.train()
+    assert result["num_env_steps_sampled_lifetime"] >= 400
+    algo.stop()
+
+
+def test_learner_group_remote_grad_sync(ray_start_regular):
+    """Two learner actors with collective allreduce must track the
+    single-learner trajectory (DDP-equivalence)."""
+    from ray_tpu.rllib.learner import LearnerGroup
+    from ray_tpu.rllib.ppo import ppo_loss
+
+    spec = RLModuleSpec(observation_dim=4, action_dim=2, hidden=(8,))
+    rng = np.random.default_rng(0)
+    batch = {
+        "obs": rng.normal(size=(64, 4)).astype(np.float32),
+        "actions": rng.integers(0, 2, size=64).astype(np.int32),
+        "logp_old": np.full(64, -0.69, dtype=np.float32),
+        "advantages": rng.normal(size=64).astype(np.float32),
+        "returns": rng.normal(size=64).astype(np.float32),
+        "values_old": np.zeros(64, dtype=np.float32),
+    }
+    local = LearnerGroup(spec, ppo_loss, num_learners=0, seed=7, lr=1e-2)
+    remote = LearnerGroup(spec, ppo_loss, num_learners=2, seed=7, lr=1e-2)
+    try:
+        for _ in range(3):
+            local.update_from_batch(batch)
+            remote.update_from_batch(batch)
+        import jax
+
+        w_l = jax.tree.leaves(local.get_weights())
+        w_r = jax.tree.leaves(remote.get_weights())
+        for a, b in zip(w_l, w_r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-3)
+    finally:
+        remote.shutdown()
