@@ -7,21 +7,22 @@ store):
 
 - *Inline tier*: objects at or below ``max_inline_object_size`` travel by
   value through the control plane and live in the controller's memory store.
-- *Shared-memory tier* (``PlasmaStore``): large objects are written to
-  mmap-able files under ``/dev/shm`` by the creating process and mapped
-  read-only (zero-copy) by readers on the same host. Eviction spills sealed
-  objects to a disk directory and restores them on access (reference:
-  src/ray/raylet/local_object_manager.cc spilling + restore;
-  python/ray/_private/external_storage.py).
+- *Shared-memory tier* (``PlasmaStore``): large objects land in the node's
+  native C++ **arena** — one mmap'd file on /dev/shm with a boundary-tag
+  allocator and process-shared object table (ray_tpu/native/src/arena.cc;
+  reference: object_manager/plasma/store.cc + plasma_allocator.cc +
+  dlmalloc.cc). Every process on the node maps the same arena, so reads
+  are zero-copy with no per-object file opens. Objects that don't fit the
+  arena (or when the native toolchain is unavailable) fall back to
+  file-per-object on tmpfs behind the same interface.
 
-The plasma arena itself is intentionally file-per-object on tmpfs rather
-than a dlmalloc arena: on TPU hosts the kernel's tmpfs already provides the
-shared mapping + lazy page allocation the reference built dlmalloc-over-mmap
-for (reference: object_manager/plasma/dlmalloc.cc). A C++ slab allocator can
-replace this behind the same interface if file-per-object overhead shows up.
+Eviction spills sealed objects to a disk directory and restores them on
+access (reference: src/ray/raylet/local_object_manager.cc spilling;
+python/ray/_private/external_storage.py).
 """
 from __future__ import annotations
 
+import logging
 import mmap
 import os
 import shutil
@@ -32,6 +33,22 @@ from typing import Dict, Optional
 
 from ray_tpu.utils.ids import ObjectID
 
+logger = logging.getLogger("ray_tpu.object_store")
+
+_ARENA_DISABLED = os.environ.get("RAY_TPU_DISABLE_NATIVE_ARENA") == "1"
+
+
+def _try_arena():
+    if _ARENA_DISABLED:
+        return None
+    try:
+        from ray_tpu.native import arena as arena_mod
+
+        return arena_mod if arena_mod.available() else None
+    except Exception as e:  # pragma: no cover - toolchain missing
+        logger.warning("native arena unavailable, using file-per-object: %s", e)
+        return None
+
 
 @dataclass
 class PlasmaEntry:
@@ -40,10 +57,11 @@ class PlasmaEntry:
     pinned: int = 0
     last_access: float = field(default_factory=time.monotonic)
     spilled: bool = False
+    in_arena: bool = False
 
 
 class PlasmaBuffer:
-    """A writable or readable mmap view of a stored object."""
+    """A writable or readable mmap view of a file-tier object."""
 
     def __init__(self, path: str, size: int, writable: bool):
         flags = os.O_RDWR | (os.O_CREAT if writable else 0)
@@ -66,12 +84,11 @@ class PlasmaBuffer:
 
 
 class PlasmaStore:
-    """Per-node shared-memory object store.
+    """Per-node shared-memory object store (the arena's owner).
 
-    Thread-safe; used directly by every process on the node (the creating
-    process writes, readers map read-only). Capacity accounting and
-    spill/evict decisions live here in the node agent's instance; worker
-    processes use lightweight :class:`PlasmaClient` views.
+    Thread-safe. Worker processes use :class:`PlasmaClient` views over the
+    same arena file; this instance (in the node agent) owns capacity
+    accounting and spill/evict decisions.
     """
 
     def __init__(self, session_dir: str, capacity: int, spill_dir: Optional[str] = None, name: str = "head"):
@@ -82,9 +99,22 @@ class PlasmaStore:
         self.spill_dir = spill_dir or os.path.join(session_dir, f"spilled_objects_{name}")
         os.makedirs(self.spill_dir, exist_ok=True)
         self.capacity = capacity
-        self.used = 0
+        self.used = 0  # file-tier bytes only; the arena self-accounts
         self._entries: Dict[ObjectID, PlasmaEntry] = {}
         self._lock = threading.Lock()
+        self._arena = None
+        arena_mod = _try_arena()
+        if arena_mod is not None:
+            try:
+                self._arena = arena_mod.Arena.create(
+                    self.arena_path, max(capacity, 16 * 1024 * 1024)
+                )
+            except Exception as e:
+                logger.warning("arena create failed (%s); file-per-object mode", e)
+
+    @property
+    def arena_path(self) -> str:
+        return os.path.join(self.shm_dir, "arena")
 
     # -- paths -------------------------------------------------------------
     def _shm_path(self, oid: ObjectID) -> str:
@@ -94,20 +124,50 @@ class PlasmaStore:
         return os.path.join(self.spill_dir, oid.hex())
 
     # -- write path --------------------------------------------------------
-    def create(self, oid: ObjectID, size: int) -> PlasmaBuffer:
+    def create(self, oid: ObjectID, size: int):
         with self._lock:
             if oid in self._entries:
                 raise FileExistsError(f"object {oid.hex()} already exists")
+            if self._arena is not None:
+                buf = self._arena_alloc_evicting(oid.binary(), size)
+                if buf is not None:
+                    self._entries[oid] = PlasmaEntry(size=size, in_arena=True)
+                    return buf
             self._maybe_evict(size)
             self._entries[oid] = PlasmaEntry(size=size)
             self.used += size
         return PlasmaBuffer(self._shm_path(oid), size, writable=True)
+
+    def _arena_alloc_evicting(self, oid_bytes: bytes, size: int):
+        """Arena alloc, spilling LRU victims to disk until it fits (the
+        reference's eviction-on-create, plasma/eviction_policy.cc)."""
+        while True:
+            buf = self._arena.create_object(oid_bytes, size)
+            if buf is not None:
+                return buf
+            victim = self._arena.lru_victim()
+            if victim is None:
+                return None  # nothing evictable; caller falls back
+            vid_bytes, vsize = victim
+            vid = ObjectID(vid_bytes)
+            ve = self._entries.get(vid)
+            vbuf = self._arena.get(vid_bytes)
+            if vbuf is not None:
+                with open(self._spill_path(vid), "wb") as f:
+                    f.write(vbuf.view())
+                vbuf.close()
+            self._arena.delete(vid_bytes)
+            if ve is not None:
+                ve.spilled = True
+                ve.in_arena = False
 
     def seal(self, oid: ObjectID):
         with self._lock:
             e = self._entries.get(oid)
             if e is not None:
                 e.sealed = True
+                if e.in_arena and self._arena is not None:
+                    self._arena.seal(oid.binary())
 
     def put_bytes(self, oid: ObjectID, data: bytes | memoryview) -> int:
         buf = self.create(oid, len(data))
@@ -117,21 +177,27 @@ class PlasmaStore:
         return len(data)
 
     def adopt(self, oid: ObjectID, size: int):
-        """Account for an object another process wrote directly into the shm
-        dir (workers write via PlasmaClient; the store owner is told after —
-        the reference's seal notification, plasma/store.cc SealObjects)."""
+        """Account for an object another process wrote directly (workers
+        write via PlasmaClient; the store owner is told after — the
+        reference's seal notification, plasma/store.cc SealObjects)."""
         with self._lock:
             if oid in self._entries:
                 return
-            self._maybe_evict(size)
-            self._entries[oid] = PlasmaEntry(size=size, sealed=True)
-            self.used += size
+            in_arena = (
+                self._arena is not None and self._arena.contains(oid.binary())
+            )
+            if not in_arena:
+                self._maybe_evict(size)
+                self.used += size
+            self._entries[oid] = PlasmaEntry(size=size, sealed=True, in_arena=in_arena)
 
     def ensure_local(self, oid: ObjectID) -> bool:
-        """Restore a spilled object into shm; True if readable there."""
+        """Restore a spilled object; True if readable on this node."""
         with self._lock:
             e = self._entries.get(oid)
             if e is None or not e.sealed:
+                if self._arena is not None and self._arena.contains(oid.binary()):
+                    return True
                 return os.path.exists(self._shm_path(oid))
             if e.spilled:
                 self._restore_locked(oid, e)
@@ -143,7 +209,7 @@ class PlasmaStore:
         with self._lock:
             return oid in self._entries
 
-    def get(self, oid: ObjectID) -> Optional[PlasmaBuffer]:
+    def get(self, oid: ObjectID):
         with self._lock:
             e = self._entries.get(oid)
             if e is None or not e.sealed:
@@ -151,6 +217,8 @@ class PlasmaStore:
             e.last_access = time.monotonic()
             if e.spilled:
                 self._restore_locked(oid, e)
+            if e.in_arena and self._arena is not None:
+                return self._arena.get(oid.binary())
         return PlasmaBuffer(self._shm_path(oid), e.size, writable=False)
 
     def size_of(self, oid: ObjectID) -> Optional[int]:
@@ -164,19 +232,25 @@ class PlasmaStore:
             e = self._entries.get(oid)
             if e:
                 e.pinned += 1
+                if e.in_arena and self._arena is not None:
+                    self._arena.pin(oid.binary(), 1)
 
     def unpin(self, oid: ObjectID):
         with self._lock:
             e = self._entries.get(oid)
             if e and e.pinned > 0:
                 e.pinned -= 1
+                if e.in_arena and self._arena is not None:
+                    self._arena.pin(oid.binary(), -1)
 
     def delete(self, oid: ObjectID):
         with self._lock:
             e = self._entries.pop(oid, None)
             if e is None:
                 return
-            if not e.spilled:
+            if e.in_arena and self._arena is not None:
+                self._arena.delete(oid.binary())
+            elif not e.spilled:
                 self.used -= e.size
             for p in (self._shm_path(oid), self._spill_path(oid)):
                 try:
@@ -184,16 +258,17 @@ class PlasmaStore:
                 except FileNotFoundError:
                     pass
 
-    # -- eviction / spilling ----------------------------------------------
+    # -- eviction / spilling (file tier) -----------------------------------
     def _maybe_evict(self, incoming: int):
-        """Spill LRU sealed, unpinned objects until ``incoming`` fits."""
+        """Spill LRU sealed, unpinned file-tier objects until ``incoming``
+        fits."""
         if self.capacity <= 0 or self.used + incoming <= self.capacity:
             return
         victims = sorted(
             (
                 (e.last_access, oid, e)
                 for oid, e in self._entries.items()
-                if e.sealed and e.pinned == 0 and not e.spilled
+                if e.sealed and e.pinned == 0 and not e.spilled and not e.in_arena
             ),
         )
         for _, oid, e in victims:
@@ -204,6 +279,17 @@ class PlasmaStore:
             self.used -= e.size
 
     def _restore_locked(self, oid: ObjectID, e: PlasmaEntry):
+        if self._arena is not None:
+            buf = self._arena_alloc_evicting(oid.binary(), e.size)
+            if buf is not None:
+                with open(self._spill_path(oid), "rb") as f:
+                    buf.view()[:] = f.read()
+                buf.close()
+                self._arena.seal(oid.binary())
+                os.unlink(self._spill_path(oid))
+                e.spilled = False
+                e.in_arena = True
+                return
         self._maybe_evict(e.size)
         shutil.move(self._spill_path(oid), self._shm_path(oid))
         e.spilled = False
@@ -211,14 +297,23 @@ class PlasmaStore:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "capacity": self.capacity,
                 "used": self.used,
                 "num_objects": len(self._entries),
                 "num_spilled": sum(1 for e in self._entries.values() if e.spilled),
+                "native_arena": self._arena is not None,
             }
+            if self._arena is not None:
+                a = self._arena.stats()
+                out["used"] += a["used"]
+                out["arena"] = a
+            return out
 
     def destroy(self):
+        if self._arena is not None:
+            self._arena.close()
+            self._arena = None
         shutil.rmtree(self.shm_dir, ignore_errors=True)
         shutil.rmtree(self.spill_dir, ignore_errors=True)
 
@@ -228,13 +323,38 @@ class PlasmaClient:
 
     def __init__(self, shm_dir: str):
         self.shm_dir = shm_dir
+        self._arena = None
+        self._arena_tried = False
+
+    def _get_arena(self):
+        if not self._arena_tried:
+            self._arena_tried = True
+            arena_mod = _try_arena()
+            path = os.path.join(self.shm_dir, "arena")
+            if arena_mod is not None and os.path.exists(path):
+                try:
+                    self._arena = arena_mod.Arena.open(path)
+                except Exception as e:
+                    logger.warning("arena open failed (%s); file mode", e)
+        return self._arena
 
     def _path(self, oid: ObjectID) -> str:
         return os.path.join(self.shm_dir, oid.hex())
 
     def put_bytes(self, oid: ObjectID, data: bytes | memoryview) -> int:
-        # Writes directly into the node's shm dir; the node agent is told of
+        # Writes directly into the node's arena; the node agent is told of
         # the new object afterwards (seal notification) and does accounting.
+        arena = self._get_arena()
+        if arena is not None:
+            try:
+                buf = arena.create_object(oid.binary(), len(data))
+            except FileExistsError:
+                return len(data)  # another writer beat us; content identical
+            if buf is not None:
+                buf.view()[:] = data
+                buf.close()
+                arena.seal(oid.binary())
+                return len(data)
         path = self._path(oid)
         fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
         try:
@@ -245,5 +365,10 @@ class PlasmaClient:
             os.close(fd)
         return len(data)
 
-    def get_buffer(self, oid: ObjectID, size: int) -> PlasmaBuffer:
+    def get_buffer(self, oid: ObjectID, size: int):
+        arena = self._get_arena()
+        if arena is not None:
+            buf = arena.get(oid.binary())
+            if buf is not None:
+                return buf
         return PlasmaBuffer(self._path(oid), size, writable=False)

@@ -1,0 +1,122 @@
+"""ctypes wrapper over the C++ shared-memory arena.
+
+Zero-copy: readers get a memoryview directly into the mapped arena at the
+object's offset (reference: plasma's fd-passing + client mmap —
+src/ray/object_manager/plasma/client.cc — collapsed here into one shared
+mapping per process).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+from ray_tpu.native import build as _build
+
+
+def available() -> bool:
+    return _build.load() is not None
+
+
+class ArenaBuffer:
+    """View into the arena; same interface as object_store.PlasmaBuffer."""
+
+    def __init__(self, view: memoryview, size: int):
+        self._view = view
+        self.size = size
+
+    def view(self) -> memoryview:
+        return self._view
+
+    def close(self):
+        # The arena mapping is process-lifetime; releasing the memoryview
+        # is enough (no fd per object — that's the point).
+        self._view.release()
+
+
+class Arena:
+    def __init__(self, handle, lib):
+        self._h = handle
+        self._lib = lib
+        self._base = lib.rt_arena_base(handle)
+
+    # -- constructors -----------------------------------------------------
+    @classmethod
+    def create(cls, path: str, capacity: int, table_slots: int = 0) -> "Arena":
+        lib = _build.load()
+        if lib is None:
+            raise RuntimeError(f"native arena unavailable: {_build.build_error()}")
+        if table_slots <= 0:
+            # ~1 slot per 256KB of capacity, at least 4096
+            table_slots = max(4096, capacity // (256 * 1024))
+        h = lib.rt_arena_create(path.encode(), capacity, table_slots)
+        if not h:
+            raise OSError(f"failed to create arena at {path}")
+        return cls(h, lib)
+
+    @classmethod
+    def open(cls, path: str) -> "Arena":
+        lib = _build.load()
+        if lib is None:
+            raise RuntimeError(f"native arena unavailable: {_build.build_error()}")
+        h = lib.rt_arena_open(path.encode())
+        if not h:
+            raise OSError(f"failed to open arena at {path}")
+        return cls(h, lib)
+
+    def close(self):
+        if self._h:
+            self._lib.rt_arena_close(self._h)
+            self._h = None
+
+    # -- object lifecycle -------------------------------------------------
+    def _mv(self, offset: int, size: int, writable: bool) -> memoryview:
+        buf = (ctypes.c_ubyte * size).from_address(self._base + offset)
+        mv = memoryview(buf).cast("B")
+        return mv if writable else mv.toreadonly()
+
+    def create_object(self, oid: bytes, size: int) -> Optional[ArenaBuffer]:
+        """None when the arena is out of space (caller evicts/falls back);
+        FileExistsError on duplicate create (matches PlasmaStore.create)."""
+        off = self._lib.rt_arena_alloc(self._h, oid, size)
+        if off == -2:
+            raise FileExistsError(f"object {oid.hex()} already in arena")
+        if off < 0:
+            return None
+        return ArenaBuffer(self._mv(off, size, writable=True), size)
+
+    def seal(self, oid: bytes) -> bool:
+        return self._lib.rt_arena_seal(self._h, oid) == 0
+
+    def get(self, oid: bytes) -> Optional[ArenaBuffer]:
+        size = ctypes.c_uint64()
+        off = self._lib.rt_arena_lookup(self._h, oid, ctypes.byref(size))
+        if off < 0:
+            return None
+        return ArenaBuffer(self._mv(off, size.value, writable=False), size.value)
+
+    def contains(self, oid: bytes) -> bool:
+        size = ctypes.c_uint64()
+        return self._lib.rt_arena_lookup(self._h, oid, ctypes.byref(size)) >= 0
+
+    def delete(self, oid: bytes) -> bool:
+        return self._lib.rt_arena_delete(self._h, oid) == 0
+
+    def pin(self, oid: bytes, delta: int = 1) -> int:
+        return self._lib.rt_arena_pin(self._h, oid, delta)
+
+    def lru_victim(self) -> Optional[Tuple[bytes, int]]:
+        out = (ctypes.c_uint8 * 16)()
+        size = ctypes.c_uint64()
+        if self._lib.rt_arena_lru_victim(self._h, out, ctypes.byref(size)) != 0:
+            return None
+        return bytes(out), size.value
+
+    def stats(self) -> dict:
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        n = ctypes.c_uint64()
+        self._lib.rt_arena_stats(
+            self._h, ctypes.byref(used), ctypes.byref(cap), ctypes.byref(n)
+        )
+        return {"used": used.value, "heap_capacity": cap.value, "num_objects": n.value}

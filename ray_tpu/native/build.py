@@ -1,0 +1,95 @@
+"""Build + load the native library (g++ → .so, cached by source hash).
+
+The image has no pybind11; the C++ exposes a C ABI consumed via ctypes
+(per-environment constraint). The .so is rebuilt only when the source
+changes, cached under ~/.cache/ray_tpu_native.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_CACHE_DIR = os.path.expanduser(os.environ.get("RAY_TPU_NATIVE_CACHE", "~/.cache/ray_tpu_native"))
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _source_hash() -> str:
+    h = hashlib.blake2b(digest_size=12)
+    for name in sorted(os.listdir(_SRC_DIR)):
+        if name.endswith((".cc", ".h")):
+            with open(os.path.join(_SRC_DIR, name), "rb") as f:
+                h.update(name.encode())
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def build() -> str:
+    """Compile (if needed) and return the .so path."""
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    so_path = os.path.join(_CACHE_DIR, f"libray_tpu_{_source_hash()}.so")
+    if os.path.exists(so_path):
+        return so_path
+    srcs = [
+        os.path.join(_SRC_DIR, n)
+        for n in sorted(os.listdir(_SRC_DIR))
+        if n.endswith(".cc")
+    ]
+    tmp = so_path + f".tmp.{os.getpid()}"
+    cmd = [
+        "g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC",
+        "-o", tmp, *srcs, "-lpthread",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+    return so_path
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The loaded library, or None if the toolchain is unavailable."""
+    global _lib, _build_error
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            lib = ctypes.CDLL(build())
+        except Exception as e:  # no g++ / build failure → Python fallback
+            _build_error = str(e)
+            return None
+        u64, i64, p = ctypes.c_uint64, ctypes.c_int64, ctypes.c_void_p
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.rt_arena_create.restype = p
+        lib.rt_arena_create.argtypes = [ctypes.c_char_p, u64, u64]
+        lib.rt_arena_open.restype = p
+        lib.rt_arena_open.argtypes = [ctypes.c_char_p]
+        lib.rt_arena_close.argtypes = [p]
+        lib.rt_arena_base.restype = ctypes.c_void_p
+        lib.rt_arena_base.argtypes = [p]
+        lib.rt_arena_alloc.restype = i64
+        lib.rt_arena_alloc.argtypes = [p, ctypes.c_char_p, u64]
+        lib.rt_arena_seal.restype = ctypes.c_int
+        lib.rt_arena_seal.argtypes = [p, ctypes.c_char_p]
+        lib.rt_arena_lookup.restype = i64
+        lib.rt_arena_lookup.argtypes = [p, ctypes.c_char_p, ctypes.POINTER(u64)]
+        lib.rt_arena_pin.restype = ctypes.c_int
+        lib.rt_arena_pin.argtypes = [p, ctypes.c_char_p, ctypes.c_int]
+        lib.rt_arena_delete.restype = ctypes.c_int
+        lib.rt_arena_delete.argtypes = [p, ctypes.c_char_p]
+        lib.rt_arena_lru_victim.restype = ctypes.c_int
+        lib.rt_arena_lru_victim.argtypes = [p, u8p, ctypes.POINTER(u64)]
+        lib.rt_arena_stats.argtypes = [p, ctypes.POINTER(u64), ctypes.POINTER(u64), ctypes.POINTER(u64)]
+        _lib = lib
+    return _lib
+
+
+def build_error() -> Optional[str]:
+    return _build_error

@@ -1,0 +1,459 @@
+// Shared-memory object arena: the native core of the plasma-equivalent
+// store.
+//
+// Reference (structure, not code): src/ray/object_manager/plasma/store.cc
+// (object lifecycle created->sealed->evictable), plasma_allocator.cc +
+// dlmalloc.cc (arena allocator over mmap), eviction_policy.cc (LRU).
+//
+// Design: one mmap'd file on /dev/shm per node. Every process maps the
+// same file; readers get zero-copy views at (base + offset). Layout:
+//
+//   [ Header | object table (open addressing) | data heap ]
+//
+// The data heap uses a boundary-tag first-fit allocator with coalescing
+// (dlmalloc-lite), and the object table keys are 16-byte binary ids. A
+// robust process-shared pthread mutex guards table + allocator: if a
+// worker dies holding the lock, EOWNERDEAD recovery keeps the node alive
+// (the reference restarts workers, not the store, on crash).
+//
+// Exposed as a C ABI for ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <cerrno>
+#include <cstdio>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545455504c4153ull;  // "RTTUPLAS"
+constexpr uint64_t kAlign = 64;                     // cacheline
+constexpr uint64_t kMinSplit = 128;
+constexpr uint32_t kIdBytes = 16;
+
+enum SlotState : uint32_t {
+  kEmpty = 0,
+  kCreated = 1,
+  kSealed = 2,
+  kTombstone = 3,
+};
+
+struct Slot {
+  uint8_t id[kIdBytes];
+  uint64_t offset;  // data offset from arena base
+  uint64_t size;
+  uint32_t state;
+  uint32_t pinned;
+  uint64_t lru_tick;
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;      // whole file size
+  uint64_t table_slots;
+  uint64_t table_off;
+  uint64_t heap_off;
+  uint64_t heap_size;
+  uint64_t used;          // bytes allocated to live objects
+  uint64_t num_objects;
+  uint64_t lru_clock;
+  uint64_t free_head;     // offset of first free block (0 = none)
+  pthread_mutex_t mutex;
+};
+
+// Every heap block, free or allocated, carries boundary tags so free()
+// can coalesce both directions in O(1).
+struct BlockHeader {
+  uint64_t size;       // payload size (excluding header)
+  uint64_t prev_size;  // payload size of the physically previous block
+  uint32_t free;
+  uint32_t has_prev;
+  uint64_t next_free;  // offset of next free block (free blocks only)
+};
+
+constexpr uint64_t kBlockHdr = sizeof(BlockHeader);
+
+struct Arena {
+  uint8_t* base;
+  uint64_t mapped;
+  Header* hdr;
+  Slot* table;
+};
+
+inline BlockHeader* block_at(Arena* a, uint64_t off) {
+  return reinterpret_cast<BlockHeader*>(a->base + off);
+}
+
+inline uint64_t align_up(uint64_t v, uint64_t align) {
+  return (v + align - 1) & ~(align - 1);
+}
+
+void lock(Arena* a) {
+  int rc = pthread_mutex_lock(&a->hdr->mutex);
+  if (rc == EOWNERDEAD) {
+    // A process died mid-critical-section. State is still structurally
+    // consistent for our operations (single-word updates dominate);
+    // mark recovered and continue — matches the reference's stance that
+    // the store outlives worker crashes.
+    pthread_mutex_consistent(&a->hdr->mutex);
+  }
+}
+
+void unlock(Arena* a) { pthread_mutex_unlock(&a->hdr->mutex); }
+
+uint64_t hash_id(const uint8_t* id) {
+  // FNV-1a over the 16-byte id
+  uint64_t h = 1469598103934665603ull;
+  for (uint32_t i = 0; i < kIdBytes; i++) {
+    h ^= id[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+// Find slot for id; if absent and want_insert, returns an insertable slot.
+Slot* find_slot(Arena* a, const uint8_t* id, bool want_insert) {
+  uint64_t n = a->hdr->table_slots;
+  uint64_t idx = hash_id(id) % n;
+  Slot* first_tomb = nullptr;
+  for (uint64_t probe = 0; probe < n; probe++) {
+    Slot* s = &a->table[(idx + probe) % n];
+    if (s->state == kEmpty) {
+      if (!want_insert) return nullptr;
+      return first_tomb ? first_tomb : s;
+    }
+    if (s->state == kTombstone) {
+      if (want_insert && !first_tomb) first_tomb = s;
+      continue;
+    }
+    if (memcmp(s->id, id, kIdBytes) == 0) return s;
+  }
+  return first_tomb;  // table full (nullptr if no tombstone either)
+}
+
+// -- allocator ------------------------------------------------------------
+
+int64_t heap_alloc(Arena* a, uint64_t want) {
+  want = align_up(want, kAlign);
+  uint64_t prev_off = 0;
+  uint64_t off = a->hdr->free_head;
+  while (off != 0) {
+    BlockHeader* b = block_at(a, off);
+    if (b->size >= want) {
+      uint64_t remainder = b->size - want;
+      if (remainder >= kBlockHdr + kMinSplit) {
+        // split: allocate the front, keep the tail free
+        uint64_t tail_off = off + kBlockHdr + want;
+        BlockHeader* tail = block_at(a, tail_off);
+        tail->size = remainder - kBlockHdr;
+        tail->prev_size = want;
+        tail->has_prev = 1;
+        tail->free = 1;
+        tail->next_free = b->next_free;
+        // fix the next physical block's prev_size
+        uint64_t after = off + kBlockHdr + b->size + kBlockHdr;
+        if (after < a->hdr->heap_off + a->hdr->heap_size) {
+          block_at(a, after)->prev_size = tail->size;
+        }
+        b->size = want;
+        if (prev_off)
+          block_at(a, prev_off)->next_free = tail_off;
+        else
+          a->hdr->free_head = tail_off;
+      } else {
+        if (prev_off)
+          block_at(a, prev_off)->next_free = b->next_free;
+        else
+          a->hdr->free_head = b->next_free;
+      }
+      b->free = 0;
+      b->next_free = 0;
+      return static_cast<int64_t>(off + kBlockHdr);
+    }
+    prev_off = off;
+    off = b->next_free;
+  }
+  return -1;  // no block fits
+}
+
+void freelist_remove(Arena* a, uint64_t target) {
+  uint64_t prev = 0, off = a->hdr->free_head;
+  while (off != 0) {
+    if (off == target) {
+      BlockHeader* b = block_at(a, off);
+      if (prev)
+        block_at(a, prev)->next_free = b->next_free;
+      else
+        a->hdr->free_head = b->next_free;
+      return;
+    }
+    prev = off;
+    off = block_at(a, off)->next_free;
+  }
+}
+
+void heap_free(Arena* a, uint64_t payload_off) {
+  uint64_t off = payload_off - kBlockHdr;
+  BlockHeader* b = block_at(a, off);
+  uint64_t heap_end = a->hdr->heap_off + a->hdr->heap_size;
+
+  // coalesce with next block if free
+  uint64_t next_off = off + kBlockHdr + b->size;
+  if (next_off < heap_end) {
+    BlockHeader* next = block_at(a, next_off);
+    if (next->free) {
+      freelist_remove(a, next_off);
+      b->size += kBlockHdr + next->size;
+    }
+  }
+  // coalesce with previous block if free
+  if (b->has_prev) {
+    uint64_t prev_off = off - kBlockHdr - b->prev_size;
+    BlockHeader* prev = block_at(a, prev_off);
+    if (prev->free) {
+      freelist_remove(a, prev_off);
+      prev->size += kBlockHdr + b->size;
+      b = prev;
+      off = prev_off;
+    }
+  }
+  // fix next physical block's prev tag
+  uint64_t after = off + kBlockHdr + b->size;
+  if (after < heap_end) {
+    BlockHeader* an = block_at(a, after);
+    an->prev_size = b->size;
+    an->has_prev = 1;
+  }
+  b->free = 1;
+  b->next_free = a->hdr->free_head;
+  a->hdr->free_head = off;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a new arena file of `capacity` bytes with `table_slots` object
+// slots. Returns an opaque handle or null.
+void* rt_arena_create(const char* path, uint64_t capacity, uint64_t table_slots) {
+  int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, static_cast<off_t>(capacity)) != 0) {
+    close(fd);
+    unlink(path);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    unlink(path);
+    return nullptr;
+  }
+  Arena* a = new Arena;
+  a->base = static_cast<uint8_t*>(mem);
+  a->mapped = capacity;
+  a->hdr = reinterpret_cast<Header*>(a->base);
+
+  Header* h = a->hdr;
+  memset(h, 0, sizeof(Header));
+  h->capacity = capacity;
+  h->table_slots = table_slots;
+  h->table_off = align_up(sizeof(Header), kAlign);
+  uint64_t table_bytes = table_slots * sizeof(Slot);
+  h->heap_off = align_up(h->table_off + table_bytes, kAlign);
+  h->heap_size = capacity - h->heap_off;
+  h->used = 0;
+  h->num_objects = 0;
+  h->lru_clock = 1;
+
+  a->table = reinterpret_cast<Slot*>(a->base + h->table_off);
+  memset(a->table, 0, table_bytes);
+
+  // one giant free block spanning the heap
+  BlockHeader* b = reinterpret_cast<BlockHeader*>(a->base + h->heap_off);
+  b->size = h->heap_size - kBlockHdr;
+  b->prev_size = 0;
+  b->has_prev = 0;
+  b->free = 1;
+  b->next_free = 0;
+  h->free_head = h->heap_off;
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+
+  h->magic = kMagic;  // written last: open() validates this
+  return a;
+}
+
+void* rt_arena_open(const char* path) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Arena* a = new Arena;
+  a->base = static_cast<uint8_t*>(mem);
+  a->mapped = st.st_size;
+  a->hdr = reinterpret_cast<Header*>(a->base);
+  if (a->hdr->magic != kMagic) {
+    munmap(mem, st.st_size);
+    delete a;
+    return nullptr;
+  }
+  a->table = reinterpret_cast<Slot*>(a->base + a->hdr->table_off);
+  return a;
+}
+
+void rt_arena_close(void* handle) {
+  Arena* a = static_cast<Arena*>(handle);
+  munmap(a->base, a->mapped);
+  delete a;
+}
+
+uint8_t* rt_arena_base(void* handle) {
+  return static_cast<Arena*>(handle)->base;
+}
+
+// Allocate space for object `id`. Returns payload offset, or
+// -1 = out of space, -2 = already exists, -3 = table full.
+int64_t rt_arena_alloc(void* handle, const uint8_t* id, uint64_t size) {
+  Arena* a = static_cast<Arena*>(handle);
+  lock(a);
+  Slot* s = find_slot(a, id, true);
+  if (s == nullptr) {
+    unlock(a);
+    return -3;
+  }
+  if (s->state == kCreated || s->state == kSealed) {
+    unlock(a);
+    return -2;
+  }
+  int64_t off = heap_alloc(a, size ? size : 1);
+  if (off < 0) {
+    unlock(a);
+    return -1;
+  }
+  memcpy(s->id, id, kIdBytes);
+  s->offset = static_cast<uint64_t>(off);
+  s->size = size;
+  s->state = kCreated;
+  s->pinned = 0;
+  s->lru_tick = a->hdr->lru_clock++;
+  a->hdr->used += size;
+  a->hdr->num_objects++;
+  unlock(a);
+  return off;
+}
+
+int rt_arena_seal(void* handle, const uint8_t* id) {
+  Arena* a = static_cast<Arena*>(handle);
+  lock(a);
+  Slot* s = find_slot(a, id, false);
+  int rc = -1;
+  if (s && s->state == kCreated) {
+    s->state = kSealed;
+    rc = 0;
+  } else if (s && s->state == kSealed) {
+    rc = 0;
+  }
+  unlock(a);
+  return rc;
+}
+
+// Look up a sealed object; touches LRU. Returns payload offset or -1.
+int64_t rt_arena_lookup(void* handle, const uint8_t* id, uint64_t* size_out) {
+  Arena* a = static_cast<Arena*>(handle);
+  lock(a);
+  Slot* s = find_slot(a, id, false);
+  if (s == nullptr || s->state != kSealed) {
+    unlock(a);
+    return -1;
+  }
+  s->lru_tick = a->hdr->lru_clock++;
+  if (size_out) *size_out = s->size;
+  int64_t off = static_cast<int64_t>(s->offset);
+  unlock(a);
+  return off;
+}
+
+int rt_arena_pin(void* handle, const uint8_t* id, int delta) {
+  Arena* a = static_cast<Arena*>(handle);
+  lock(a);
+  Slot* s = find_slot(a, id, false);
+  int rc = -1;
+  if (s && (s->state == kSealed || s->state == kCreated)) {
+    if (delta > 0)
+      s->pinned += delta;
+    else if (s->pinned >= static_cast<uint32_t>(-delta))
+      s->pinned += delta;
+    else
+      s->pinned = 0;
+    rc = static_cast<int>(s->pinned);
+  }
+  unlock(a);
+  return rc;
+}
+
+int rt_arena_delete(void* handle, const uint8_t* id) {
+  Arena* a = static_cast<Arena*>(handle);
+  lock(a);
+  Slot* s = find_slot(a, id, false);
+  if (s == nullptr || s->state == kEmpty || s->state == kTombstone) {
+    unlock(a);
+    return -1;
+  }
+  heap_free(a, s->offset);
+  a->hdr->used -= s->size;
+  a->hdr->num_objects--;
+  s->state = kTombstone;
+  unlock(a);
+  return 0;
+}
+
+// Least-recently-used sealed, unpinned object (eviction candidate).
+// Writes its id and size; returns 0, or -1 if none.
+int rt_arena_lru_victim(void* handle, uint8_t* id_out, uint64_t* size_out) {
+  Arena* a = static_cast<Arena*>(handle);
+  lock(a);
+  Slot* best = nullptr;
+  for (uint64_t i = 0; i < a->hdr->table_slots; i++) {
+    Slot* s = &a->table[i];
+    if (s->state == kSealed && s->pinned == 0) {
+      if (best == nullptr || s->lru_tick < best->lru_tick) best = s;
+    }
+  }
+  int rc = -1;
+  if (best) {
+    memcpy(id_out, best->id, kIdBytes);
+    if (size_out) *size_out = best->size;
+    rc = 0;
+  }
+  unlock(a);
+  return rc;
+}
+
+void rt_arena_stats(void* handle, uint64_t* used, uint64_t* capacity,
+                    uint64_t* num_objects) {
+  Arena* a = static_cast<Arena*>(handle);
+  lock(a);
+  if (used) *used = a->hdr->used;
+  if (capacity) *capacity = a->hdr->heap_size;
+  if (num_objects) *num_objects = a->hdr->num_objects;
+  unlock(a);
+}
+
+}  // extern "C"
