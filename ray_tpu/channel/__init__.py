@@ -1,0 +1,38 @@
+"""Channels: low-latency, reusable pipes between DAG participants.
+
+Reference: python/ray/experimental/channel/ — ``ChannelInterface`` with
+``SharedMemoryChannel`` (mutable plasma objects + semaphores) and
+``IntraProcessChannel``. The TPU-native rebuild keeps the same roles:
+
+- :class:`ShmChannel` — a single-writer / N-reader ring over one mmap'd
+  file on /dev/shm. Instead of re-sealing plasma objects per message
+  (the reference's mutable-object path,
+  src/ray/core_worker/experimental_mutable_object_manager.h), the ring
+  publishes a monotonically increasing write sequence number; readers ack
+  via per-reader counters in the same mapping. No locks, no fds passed
+  around, no per-message allocation.
+- :class:`IntraProcessChannel` — queue for same-process edges.
+- Oversized payloads overflow into the object store transparently
+  (kind=REF messages), the analog of the reference's resize-on-overflow.
+
+Device arrays: jax.Arrays cross as host numpy views (device→host once on
+write, host→device on read). On-TPU steady-state pipelines should keep
+tensors *inside* one compiled program (shard_map + ppermute collectives,
+see ray_tpu.parallel.pipeline); channels are the host-level MPMD transport
+between separately-compiled programs.
+"""
+from ray_tpu.channel.shm_channel import (
+    Channel,
+    ChannelClosedError,
+    IntraProcessChannel,
+    ReaderHandle,
+    ShmChannel,
+)
+
+__all__ = [
+    "Channel",
+    "ShmChannel",
+    "IntraProcessChannel",
+    "ReaderHandle",
+    "ChannelClosedError",
+]

@@ -1,0 +1,287 @@
+"""Shared-memory ring channel.
+
+Layout of the backing file (one page header + ring):
+
+    [ magic u64 | num_slots u64 | slot_size u64 | num_readers u64 |
+      closed u64 | write_seq u64 | reader_acks u64 * num_readers ]
+    slot 0: [ size u64 | kind u64 | payload ... ]
+    slot 1: ...
+
+Single writer, ``num_readers`` fixed at creation. The writer may publish
+message ``s`` once every reader has acked ``s - num_slots`` (ring never
+wraps unread data); reader ``r`` may consume message ``s`` once
+``write_seq > s``. Publication order (payload store before seq store) is
+what makes the seqlock safe on x86 TSO; on weaker memory models the GIL +
+mmap write syscalls in CPython serialize enough in practice.
+
+Reference: python/ray/experimental/channel/shared_memory_channel.py and
+src/ray/core_worker/experimental_mutable_object_manager.h (writer/reader
+headers + semaphores over mutable plasma objects). This rebuild uses one
+mapping and counters instead of per-message object seal/release.
+"""
+from __future__ import annotations
+
+import mmap
+import os
+import queue
+import struct
+import time
+import uuid
+from typing import Any, List, Optional
+
+from ray_tpu.exceptions import ChannelError
+from ray_tpu.utils.serialization import deserialize, serialize
+
+MAGIC = 0x52545043  # "RTPC"
+HEADER_BASE = 48  # bytes before reader_acks
+_U64 = struct.Struct("<Q")
+_SLOT_HDR = struct.Struct("<QQ")
+
+KIND_DATA = 0
+KIND_ERROR = 1
+KIND_REF = 2
+KIND_SENTINEL = 3
+KIND_REF_ERROR = 4  # oversized error: payload is an ObjectRef to the exception
+
+
+class ChannelClosedError(ChannelError):
+    pass
+
+
+def _channels_dir() -> str:
+    d = os.path.join("/dev/shm", "ray_tpu", "channels")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class _Waiter:
+    """Adaptive spin-then-sleep poll loop."""
+
+    def __init__(self, timeout: Optional[float]):
+        self.deadline = None if timeout is None else time.monotonic() + timeout
+        self.spins = 0
+
+    def wait(self, what: str):
+        if self.deadline is not None and time.monotonic() > self.deadline:
+            raise TimeoutError(f"timed out waiting for {what}")
+        self.spins += 1
+        if self.spins < 100:
+            return  # pure spin: latency-critical fast path
+        time.sleep(min(0.001, 0.00005 * (self.spins - 99)))
+
+
+class Channel:
+    """Abstract interface (reference: channel/common.py ChannelInterface)."""
+
+    def write(self, value: Any, timeout: Optional[float] = None):
+        raise NotImplementedError
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        raise NotImplementedError
+
+    def close(self):
+        raise NotImplementedError
+
+
+class ShmChannel(Channel):
+    """Writer-side handle; use :meth:`reader` for reader handles."""
+
+    def __init__(
+        self,
+        num_readers: int = 1,
+        slot_size: int = 1024 * 1024,
+        num_slots: int = 2,
+        path: Optional[str] = None,
+        _create: bool = True,
+    ):
+        self.num_readers = num_readers
+        self.slot_size = slot_size
+        self.num_slots = num_slots
+        self.path = path or os.path.join(_channels_dir(), uuid.uuid4().hex)
+        self._total = 4096 + num_slots * (_SLOT_HDR.size + slot_size)
+        if _create:
+            fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o600)
+            try:
+                os.ftruncate(fd, self._total)
+                self._mm = mmap.mmap(fd, self._total)
+            finally:
+                os.close(fd)
+            _U64.pack_into(self._mm, 0, MAGIC)
+            _U64.pack_into(self._mm, 8, num_slots)
+            _U64.pack_into(self._mm, 16, slot_size)
+            _U64.pack_into(self._mm, 24, num_readers)
+        else:
+            fd = os.open(self.path, os.O_RDWR)
+            try:
+                self._mm = mmap.mmap(fd, self._total)
+            finally:
+                os.close(fd)
+            if _U64.unpack_from(self._mm, 0)[0] != MAGIC:
+                raise ChannelError(f"not a channel file: {self.path}")
+
+    # -- header accessors ---------------------------------------------------
+    def _get(self, off: int) -> int:
+        return _U64.unpack_from(self._mm, off)[0]
+
+    def _set(self, off: int, v: int):
+        _U64.pack_into(self._mm, off, v)
+
+    @property
+    def closed(self) -> bool:
+        return self._get(32) != 0
+
+    @property
+    def write_seq(self) -> int:
+        return self._get(40)
+
+    def _ack(self, r: int) -> int:
+        return self._get(HEADER_BASE + 8 * r)
+
+    def _min_ack(self) -> int:
+        return min(self._ack(r) for r in range(self.num_readers))
+
+    def _slot_off(self, seq: int) -> int:
+        return 4096 + (seq % self.num_slots) * (_SLOT_HDR.size + self.slot_size)
+
+    # -- writer -------------------------------------------------------------
+    def write(self, value: Any, timeout: Optional[float] = None, kind: int = KIND_DATA):
+        data = serialize(value) if kind != KIND_SENTINEL else b""
+        if len(data) > self.slot_size:
+            # Overflow to the object store (reference: channel resize path).
+            from ray_tpu.core import api
+
+            ref = api.put(value)
+            data = serialize(ref)
+            kind = KIND_REF if kind == KIND_DATA else KIND_REF_ERROR
+            if len(data) > self.slot_size:
+                raise ChannelError("channel slot too small even for an ObjectRef")
+        seq = self.write_seq
+        w = _Waiter(timeout)
+        while seq - self._min_ack() >= self.num_slots:
+            if self.closed:
+                raise ChannelClosedError(self.path)
+            w.wait("channel space")
+        off = self._slot_off(seq)
+        _SLOT_HDR.pack_into(self._mm, off, len(data), kind)
+        self._mm[off + _SLOT_HDR.size : off + _SLOT_HDR.size + len(data)] = data
+        self._set(40, seq + 1)  # publish
+
+    def write_error(self, exc: BaseException, timeout: Optional[float] = None):
+        self.write(exc, timeout=timeout, kind=KIND_ERROR)
+
+    def write_sentinel(self, timeout: Optional[float] = None):
+        self.write(None, timeout=timeout, kind=KIND_SENTINEL)
+
+    def close(self):
+        self._set(32, 1)
+
+    def destroy(self):
+        self.close()  # unblock any writer/reader still spinning on the ring
+        try:
+            self._mm.close()
+        except BufferError:
+            pass
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+    def reader(self, reader_id: int) -> "ReaderHandle":
+        return ReaderHandle(self.path, self.num_readers, self.slot_size, self.num_slots, reader_id)
+
+    def __reduce__(self):
+        # Reconnect (not recreate) on unpickle — lets the compile step build
+        # writers on the driver and ship them to the owning actor.
+        return (
+            ShmChannel,
+            (self.num_readers, self.slot_size, self.num_slots, self.path, False),
+        )
+
+
+class ReaderHandle(Channel):
+    """Reader ``reader_id``'s view; picklable, reconnects on unpickle."""
+
+    def __init__(self, path: str, num_readers: int, slot_size: int, num_slots: int, reader_id: int):
+        self._args = (path, num_readers, slot_size, num_slots, reader_id)
+        self._ch = ShmChannel(
+            num_readers=num_readers,
+            slot_size=slot_size,
+            num_slots=num_slots,
+            path=path,
+            _create=False,
+        )
+        self.reader_id = reader_id
+
+    def __reduce__(self):
+        return (ReaderHandle, self._args)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        value, kind = self.read_raw(timeout)
+        if kind == KIND_ERROR:
+            raise value
+        if kind == KIND_SENTINEL:
+            raise ChannelClosedError("channel shut down")
+        return value
+
+    def read_raw(self, timeout: Optional[float] = None):
+        """(value, kind) — compiled-DAG loops use this to forward errors and
+        sentinels instead of dying on them."""
+        ch = self._ch
+        seq = ch._ack(self.reader_id)
+        w = _Waiter(timeout)
+        while ch.write_seq <= seq:
+            if ch.closed:
+                raise ChannelClosedError(ch.path)
+            w.wait("channel data")
+        off = ch._slot_off(seq)
+        size, kind = _SLOT_HDR.unpack_from(ch._mm, off)
+        data = bytes(ch._mm[off + _SLOT_HDR.size : off + _SLOT_HDR.size + size])
+        ch._set(HEADER_BASE + 8 * self.reader_id, seq + 1)
+        if kind == KIND_SENTINEL:
+            return None, kind
+        value = deserialize(data)
+        if kind in (KIND_REF, KIND_REF_ERROR):
+            from ray_tpu.core import api
+
+            try:
+                value = api.get(value)
+            except Exception as e:  # noqa: BLE001 — surface as the message itself
+                return e, KIND_ERROR
+            kind = KIND_DATA if kind == KIND_REF else KIND_ERROR
+        return value, kind
+
+    def close(self):
+        self._ch.close()
+
+
+class IntraProcessChannel(Channel):
+    """Same-process edge (reference: channel/intra_process_channel.py)."""
+
+    def __init__(self, maxsize: int = 2):
+        self._q: queue.Queue = queue.Queue(maxsize=maxsize)
+
+    def write(self, value: Any, timeout: Optional[float] = None, kind: int = KIND_DATA):
+        self._q.put((value, kind), timeout=timeout)
+
+    def write_error(self, exc: BaseException, timeout: Optional[float] = None):
+        self.write(exc, timeout, KIND_ERROR)
+
+    def write_sentinel(self, timeout: Optional[float] = None):
+        self.write(None, timeout, KIND_SENTINEL)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        value, kind = self.read_raw(timeout)
+        if kind == KIND_ERROR:
+            raise value
+        if kind == KIND_SENTINEL:
+            raise ChannelClosedError("channel shut down")
+        return value
+
+    def read_raw(self, timeout: Optional[float] = None):
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError("timed out waiting for channel data") from None
+
+    def close(self):
+        pass

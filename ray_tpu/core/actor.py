@@ -15,6 +15,7 @@ from ray_tpu.core.remote_function import (
     build_resource_set,
     normalize_strategy,
 )
+from ray_tpu.core.resources import ResourceSet
 from ray_tpu.core.task_spec import TaskSpec, TaskType
 from ray_tpu.utils.ids import ActorID, TaskID
 from ray_tpu.utils.serialization import serialize_function
@@ -87,6 +88,12 @@ class ActorClass:
         core.create_actor(spec)
         return ActorHandle(actor_id, max_task_retries=opts["max_task_retries"])
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG class node (reference: actor.py bind → dag ClassNode)."""
+        from ray_tpu.dag.node import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Actors cannot be instantiated directly. Use {self._cls.__name__}.remote() instead."
@@ -102,6 +109,32 @@ class ActorHandle:
         if item.startswith("_"):
             raise AttributeError(item)
         return ActorMethod(self, item)
+
+    def _call_fn(self, fn, *args, _name: Optional[str] = None, **kwargs):
+        """Run ``fn(actor_instance, *args, **kwargs)`` on the actor — the
+        reference's ``__ray_call__`` escape hatch (actor.py), used by
+        compiled DAGs and worker groups."""
+        from ray_tpu.core.api import _require_worker
+
+        core = _require_worker()
+        blob = serialize_function(fn)
+        digest = hashlib.blake2b(blob, digest_size=16).digest()
+        args_blob, deps = core.build_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            task_type=TaskType.ACTOR_TASK,
+            name=_name or f"actor.{getattr(fn, '__name__', 'fn')}",
+            func_digest=digest,
+            func_blob=blob,
+            args_blob=args_blob,
+            dependencies=deps,
+            num_returns=1,
+            resources=ResourceSet.from_dict({}),
+            owner_id=core.worker_id,
+            actor_id=self._actor_id,
+            actor_method_name=None,
+        )
+        return core.submit_actor_task(spec)[0]
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id.hex()})"
@@ -124,6 +157,13 @@ class ActorMethod:
 
     def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
         return ActorMethod(self._handle, self._name, num_returns)
+
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node on a live actor (reference: actor method bind —
+        required form for compiled DAGs)."""
+        from ray_tpu.dag.node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
 
     def remote(self, *args, **kwargs):
         from ray_tpu.core.api import _require_worker

@@ -9,7 +9,6 @@ shared-memory store.
 from __future__ import annotations
 
 import itertools
-import mmap
 import os
 import threading
 from concurrent.futures import Future
@@ -24,16 +23,6 @@ from ray_tpu.utils.ids import NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.utils.serialization import deserialize, serialize
 
 INLINE_LIMIT_FALLBACK = 100 * 1024
-
-
-def _read_shm(path: str, size: int) -> memoryview:
-    """Map an object file; the mmap stays alive as long as views into it do."""
-    fd = os.open(path, os.O_RDONLY)
-    try:
-        mm = mmap.mmap(fd, size, access=mmap.ACCESS_READ)
-    finally:
-        os.close(fd)
-    return memoryview(mm)
 
 
 class CoreWorker:
@@ -70,6 +59,7 @@ class CoreWorker:
         self.config = info["config"]
         self.inline_limit = self.config.get("max_inline_object_size", INLINE_LIMIT_FALLBACK)
         self.plasma = PlasmaClient(self.local_shm_dir)
+        self._plasma_clients: dict[str, PlasmaClient] = {}
 
     # ------------------------------------------------------------------
     def _call(self, method: str, *args, timeout: Optional[float] = None, **kwargs):
@@ -135,15 +125,27 @@ class CoreWorker:
             out.append(value)
         return out
 
+    def _plasma_for(self, shm_dir: str) -> PlasmaClient:
+        if shm_dir == self.local_shm_dir:
+            return self.plasma
+        with self._lock:
+            client = self._plasma_clients.get(shm_dir)
+            if client is None:
+                client = self._plasma_clients[shm_dir] = PlasmaClient(shm_dir)
+            return client
+
     def _read_object(self, oid: ObjectID, size: int, node_hex: str, shm_dir: str) -> memoryview:
-        path = os.path.join(shm_dir, oid.hex())
-        try:
-            return _read_shm(path, size)
-        except FileNotFoundError:
-            # Possibly spilled to disk — ask the owning node to restore it.
-            if not self._call("object_ensure_local", oid, node_hex):
-                raise ObjectLostError(oid.hex(), "object missing from store")
-            return _read_shm(path, size)
+        plasma = self._plasma_for(shm_dir)
+        view = plasma.try_view(oid, size)
+        if view is not None:
+            return view
+        # Possibly spilled to disk — ask the owning node to restore it.
+        if not self._call("object_ensure_local", oid, node_hex):
+            raise ObjectLostError(oid.hex(), "object missing from store")
+        view = plasma.try_view(oid, size)
+        if view is None:
+            raise ObjectLostError(oid.hex(), "object missing from store")
+        return view
 
     def get_raw(self, oid: ObjectID) -> tuple[Any, bool]:
         """(value, is_error) without raising — used by arg resolution."""

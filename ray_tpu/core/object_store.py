@@ -372,3 +372,22 @@ class PlasmaClient:
             if buf is not None:
                 return buf
         return PlasmaBuffer(self._path(oid), size, writable=False)
+
+    def try_view(self, oid: ObjectID, size: int) -> Optional[memoryview]:
+        """Zero-copy read view of a sealed object, or None if it is neither
+        in the arena nor on the file tier (e.g. spilled to disk)."""
+        arena = self._get_arena()
+        if arena is not None:
+            buf = arena.get(oid.binary())
+            if buf is not None:
+                return buf.view()
+        path = self._path(oid)
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except FileNotFoundError:
+            return None
+        try:
+            mm = mmap.mmap(fd, size, access=mmap.ACCESS_READ)
+        finally:
+            os.close(fd)
+        return memoryview(mm)

@@ -112,6 +112,12 @@ class RemoteFunction:
         refs = core.submit_task(spec)
         return refs[0] if opts["num_returns"] == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference: dag API, remote_function bind)."""
+        from ray_tpu.dag.node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             "Remote functions cannot be called directly. "

@@ -154,6 +154,12 @@ class TaskExecutor:
                 n = max(1, spec.max_concurrency)
                 self.actor_pool = ThreadPoolExecutor(n, thread_name_prefix="actor-exec")
                 result = None
+            elif spec.func_blob is not None:
+                # Function-on-actor (reference: __ray_call__): compiled-DAG
+                # loops and worker-group utilities execute arbitrary fns
+                # against the actor instance.
+                fn = self._load_func(spec)
+                result = _maybe_async(fn(self.actor_instance, *args, **kwargs))
             else:  # actor_task
                 method = getattr(self.actor_instance, spec.actor_method_name)
                 result = _maybe_async(method(*args, **kwargs))

@@ -1,0 +1,37 @@
+"""Lazy DAG API + compiled DAGs.
+
+Reference: python/ray/dag/ — ``.bind()`` builds a DAG of ``FunctionNode`` /
+``ClassNode`` / ``ClassMethodNode`` / ``InputNode`` / ``MultiOutputNode``;
+``dag.execute(...)`` runs it as ordinary tasks; ``dag.experimental_compile()``
+turns an all-actor DAG into static per-actor executable loops connected by
+channels (python/ray/dag/compiled_dag_node.py:516 CompiledDAG,
+ExecutableTask :281).
+
+TPU-native notes: the compiled path is the host-level MPMD engine — it is
+what schedules pipeline-parallel stages whose bodies are separately
+pjit-compiled programs (ray_tpu.parallel.pipeline holds the in-graph SPMD
+alternative). Channel transport is the shm ring (ray_tpu.channel) instead of
+NCCL/mutable-plasma.
+"""
+from ray_tpu.dag.node import (
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+from ray_tpu.dag.compiled import CompiledDAG, CompiledDAGRef
+
+__all__ = [
+    "DAGNode",
+    "InputNode",
+    "InputAttributeNode",
+    "FunctionNode",
+    "ClassNode",
+    "ClassMethodNode",
+    "MultiOutputNode",
+    "CompiledDAG",
+    "CompiledDAGRef",
+]

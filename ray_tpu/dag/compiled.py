@@ -1,0 +1,415 @@
+"""Compiled DAGs: static per-actor executable loops over channels.
+
+Reference: python/ray/dag/compiled_dag_node.py (CompiledDAG :516,
+ExecutableTask :281, execute :1923, buffered in-flight executions :1864)
+and dag/dag_node_operation.py (per-actor op ordering). The rebuild keeps
+the architecture — compile once, then every ``execute()`` is pure channel
+traffic with zero task-submission overhead — with the shm ring channel as
+transport.
+
+Per actor we submit ONE long-running "loop" task (the analog of the
+reference's ``do_exec_tasks`` worker loop). Each iteration it:
+  1. reads the driver input channel once if any of its ops consume it,
+  2. runs its ops in topo order (cross-actor args arrive via channels,
+     same-actor args via locals),
+  3. writes each op's result into that op's output channel (readers =
+     downstream actors and/or the driver).
+Errors are forwarded as poisoned messages so the driver's ``get`` re-raises
+them; a sentinel through the input channel tears the whole pipeline down.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.channel.shm_channel import (
+    KIND_DATA,
+    KIND_ERROR,
+    KIND_SENTINEL,
+    ChannelClosedError,
+    ReaderHandle,
+    ShmChannel,
+)
+from ray_tpu.dag.node import (
+    ClassMethodNode,
+    DAGNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+
+@dataclass
+class _OpSpec:
+    """One method execution inside an actor's loop (ExecutableTask)."""
+
+    node_idx: int
+    method_name: str
+    arg_specs: List[Tuple] = field(default_factory=list)
+    kwarg_specs: Dict[str, Tuple] = field(default_factory=dict)
+    writer: Optional[ShmChannel] = None  # None → result stays actor-local
+
+
+@dataclass
+class _LoopSpec:
+    ops: List[_OpSpec]
+    input_reader: Optional[ReaderHandle]  # driver input, if consumed
+    chan_readers: Dict[int, ReaderHandle]  # producer node_idx → reader
+
+
+def _compiled_loop(actor_self, loop: _LoopSpec):
+    """Runs on the actor; its thread is dedicated until teardown.
+
+    Channel reads are LAZY (at the op that needs them, cached per
+    iteration): reading everything upfront would deadlock on
+    A.f → B.h → A.g shapes where A must publish f before B can feed g.
+    This is the rebuild's equivalent of the reference's per-op READ/
+    COMPUTE/WRITE schedule (dag/dag_node_operation.py).
+    """
+    while True:
+        st = _IterState(loop)
+        try:
+            if loop.input_reader is not None:
+                value, kind = loop.input_reader.read_raw()
+                if kind == KIND_SENTINEL:
+                    raise _Shutdown
+                if kind == KIND_ERROR:
+                    st.input_err = value
+                else:
+                    st.inp = value
+            for op in loop.ops:
+                err = None
+                args, kwargs = [], {}
+                try:
+                    for spec in op.arg_specs:
+                        args.append(st.resolve(spec))
+                    for k, spec in op.kwarg_specs.items():
+                        kwargs[k] = st.resolve(spec)
+                except _Poisoned as p:
+                    err = p.exc
+                if err is None:
+                    try:
+                        st.local_vals[op.node_idx] = getattr(
+                            actor_self, op.method_name
+                        )(*args, **kwargs)
+                    except BaseException as e:  # noqa: BLE001 — forwarded, not fatal
+                        st.local_errs[op.node_idx] = e
+                else:
+                    st.local_errs[op.node_idx] = err
+                if op.writer is not None:
+                    if op.node_idx in st.local_errs:
+                        op.writer.write_error(st.local_errs[op.node_idx])
+                    else:
+                        op.writer.write(st.local_vals[op.node_idx])
+            # Drain channels skipped by error short-circuits — every reader
+            # must consume exactly one message per iteration or the rings
+            # desynchronize.
+            for idx, rd in loop.chan_readers.items():
+                if idx not in st.chan_vals and idx not in st.chan_errs:
+                    _, k = rd.read_raw()
+                    if k == KIND_SENTINEL:
+                        raise _Shutdown
+        except (_Shutdown, ChannelClosedError):
+            for op in loop.ops:
+                if op.writer is not None:
+                    try:
+                        op.writer.write_sentinel(timeout=1)
+                    except (TimeoutError, ChannelClosedError):
+                        pass
+            return "shutdown"
+
+
+class _Shutdown(Exception):
+    pass
+
+
+class _Poisoned(Exception):
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class _IterState:
+    def __init__(self, loop: _LoopSpec):
+        self.loop = loop
+        self.inp = None
+        self.input_err: Optional[BaseException] = None
+        self.chan_vals: Dict[int, Any] = {}
+        self.chan_errs: Dict[int, BaseException] = {}
+        self.local_vals: Dict[int, Any] = {}
+        self.local_errs: Dict[int, BaseException] = {}
+
+    def resolve(self, spec):
+        kind = spec[0]
+        if kind == "const":
+            return spec[1]
+        if kind in ("input", "input_attr"):
+            if self.input_err is not None:
+                raise _Poisoned(self.input_err)
+            args, kwargs = self.inp
+            if kind == "input":
+                if kwargs or len(args) != 1:
+                    raise _Poisoned(
+                        ValueError("whole-input DAGs take exactly one positional arg")
+                    )
+                return args[0]
+            key = spec[1]
+            return args[key] if isinstance(key, int) else kwargs[key]
+        if kind == "local":
+            idx = spec[1]
+            if idx in self.local_errs:
+                raise _Poisoned(self.local_errs[idx])
+            return self.local_vals[idx]
+        if kind == "chan":
+            idx = spec[1]
+            if idx not in self.chan_vals and idx not in self.chan_errs:
+                value, k = self.loop.chan_readers[idx].read_raw()
+                if k == KIND_SENTINEL:
+                    raise _Shutdown
+                if k == KIND_ERROR:
+                    self.chan_errs[idx] = value
+                else:
+                    self.chan_vals[idx] = value
+            if idx in self.chan_errs:
+                raise _Poisoned(self.chan_errs[idx])
+            return self.chan_vals[idx]
+        raise AssertionError(spec)
+
+
+class CompiledDAGRef:
+    """Result handle for one ``execute()`` (reference: CompiledDAGRef)."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int, output_idx: Optional[int]):
+        self._dag = dag
+        self._seq = seq
+        self._output_idx = output_idx
+
+    def get(self, timeout: Optional[float] = None):
+        value = self._dag._result_for(self._seq, self._output_idx or 0, timeout)
+        if isinstance(value, _WrappedError):
+            raise value.exc
+        return value
+
+
+class _WrappedError:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, buffer_size_bytes: int = 1024 * 1024, max_inflight: int = 2):
+        self._root = root
+        self._buffer_size = buffer_size_bytes
+        self._slots = max(2, max_inflight)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._read_seq = 0
+        self._results: Dict[int, list] = {}
+        self._partial_row: list = []
+        self._torn_down = False
+        self._node_chans: List[ShmChannel] = []
+        self._build()
+
+    # ------------------------------------------------------------------
+    def _build(self):
+        order = self._root.topo_sort()
+        nodes: List[DAGNode] = []
+        self._multi_output = isinstance(self._root, MultiOutputNode)
+        for n in order:
+            if isinstance(n, (InputNode, InputAttributeNode)):
+                continue
+            if isinstance(n, MultiOutputNode):
+                if n is not self._root:
+                    raise ValueError("MultiOutputNode must be the DAG root")
+                continue
+            if not isinstance(n, ClassMethodNode) or n.actor_handle is None:
+                raise ValueError(
+                    "compiled DAGs support only actor-method nodes on live "
+                    "actors (reference: compiled_dag_node.py restriction); "
+                    f"got {type(n).__name__}"
+                )
+            nodes.append(n)
+        if not any(isinstance(n, InputNode) for n in order):
+            raise ValueError("compiled DAG needs an InputNode")
+        self._node_idx = {id(n): i for i, n in enumerate(nodes)}
+        self._nodes = nodes
+
+        outputs = (
+            list(self._root._bound_args) if self._multi_output else [self._root]
+        )
+        for o in outputs:
+            if not isinstance(o, ClassMethodNode):
+                raise ValueError("DAG outputs must be actor-method nodes")
+        self._num_outputs = len(outputs)
+        out_ids = {id(o) for o in outputs}
+
+        # Consumers: node -> set of consumer actor handles; + driver for outputs.
+        consumers: Dict[int, list] = {id(n): [] for n in nodes}
+        input_consumers: list = []
+        for n in nodes:
+            actor = n.actor_handle
+            for up, _spec in _iter_arg_nodes(n):
+                if isinstance(up, (InputNode, InputAttributeNode)):
+                    if actor not in input_consumers:
+                        input_consumers.append(actor)
+                elif isinstance(up, ClassMethodNode):
+                    if up.actor_handle is not actor and actor not in consumers[id(up)]:
+                        consumers[id(up)].append(actor)
+
+        # Channels.
+        if not input_consumers:
+            raise ValueError("no actor consumes the InputNode")
+        self._input_chan = ShmChannel(
+            num_readers=len(input_consumers),
+            slot_size=self._buffer_size,
+            num_slots=self._slots,
+        )
+        input_reader_of = {
+            a: self._input_chan.reader(i) for i, a in enumerate(input_consumers)
+        }
+
+        node_chan: Dict[int, ShmChannel] = {}
+        node_reader_of: Dict[int, Dict[Any, ReaderHandle]] = {}
+        self._out_readers: List[Optional[ReaderHandle]] = [None] * self._num_outputs
+        for n in nodes:
+            readers = list(consumers[id(n)])
+            n_driver = 1 if id(n) in out_ids else 0
+            if not readers and not n_driver:
+                continue
+            ch = ShmChannel(
+                num_readers=len(readers) + n_driver,
+                slot_size=self._buffer_size,
+                num_slots=self._slots,
+            )
+            self._node_chans.append(ch)
+            node_chan[self._node_idx[id(n)]] = ch
+            node_reader_of[self._node_idx[id(n)]] = {
+                a: ch.reader(i) for i, a in enumerate(readers)
+            }
+            if n_driver:
+                rd = ch.reader(len(readers))
+                for oi, o in enumerate(outputs):
+                    if o is n:
+                        self._out_readers[oi] = rd
+
+        # Per-actor loop specs.
+        per_actor: Dict[Any, _LoopSpec] = {}
+        for n in nodes:
+            actor = n.actor_handle
+            loop = per_actor.get(actor)
+            if loop is None:
+                loop = per_actor[actor] = _LoopSpec(
+                    ops=[], input_reader=input_reader_of.get(actor), chan_readers={}
+                )
+            idx = self._node_idx[id(n)]
+            op = _OpSpec(node_idx=idx, method_name=n._method_name, writer=node_chan.get(idx))
+            for up, spec in _iter_arg_nodes(n, with_consts=True):
+                tgt = op.kwarg_specs if spec[0] == "kw" else op.arg_specs
+                key = spec[1]
+                resolved = _arg_spec_for(up, actor, self._node_idx, loop)
+                if spec[0] == "kw":
+                    tgt[key] = resolved
+                else:
+                    tgt.append(resolved)
+            # Wire chan readers for cross-actor deps.
+            for up, _spec in _iter_arg_nodes(n):
+                if isinstance(up, ClassMethodNode) and up.actor_handle is not actor:
+                    uidx = self._node_idx[id(up)]
+                    if uidx not in loop.chan_readers:
+                        loop.chan_readers[uidx] = node_reader_of[uidx][actor]
+            loop.ops.append(op)
+
+        # Launch the loops (one dedicated long-running actor task each).
+        self._loop_refs = [
+            actor._call_fn(_compiled_loop, loop, _name="__compiled_dag_loop__")
+            for actor, loop in per_actor.items()
+        ]
+
+    # ------------------------------------------------------------------
+    def execute(self, *args, **kwargs) -> CompiledDAGRef | List[CompiledDAGRef]:
+        with self._lock:
+            if self._torn_down:
+                raise ChannelClosedError("compiled DAG was torn down")
+            seq = self._seq
+            self._seq += 1
+            self._input_chan.write((args, kwargs))
+        if self._multi_output:
+            return [CompiledDAGRef(self, seq, i) for i in range(self._num_outputs)]
+        return CompiledDAGRef(self, seq, None)
+
+    def _result_for(self, seq: int, output_idx: int, timeout: Optional[float]):
+        with self._lock:
+            while seq not in self._results:
+                # _partial_row persists across a TimeoutError mid-row so a
+                # retry resumes at the reader that timed out instead of
+                # re-reading (and desynchronizing) earlier readers.
+                row = self._partial_row
+                while len(row) < self._num_outputs:
+                    value, kind = self._out_readers[len(row)].read_raw(timeout)
+                    if kind == KIND_ERROR:
+                        value = _WrappedError(value)
+                    elif kind == KIND_SENTINEL:
+                        raise ChannelClosedError("compiled DAG torn down mid-get")
+                    row.append(value)
+                self._results[self._read_seq] = [row, set()]
+                self._partial_row = []
+                self._read_seq += 1
+            row, consumed = self._results[seq]
+            value = row[output_idx]
+            consumed.add(output_idx)
+            if len(consumed) == self._num_outputs:
+                del self._results[seq]
+            return value
+
+    def teardown(self):
+        with self._lock:
+            if self._torn_down:
+                return
+            self._torn_down = True
+            try:
+                self._input_chan.write_sentinel(timeout=5)
+            except (TimeoutError, ChannelClosedError):
+                pass
+            # Close everything: wakes loops blocked writing into full rings
+            # (e.g. results the driver never read) so they can exit.
+            self._input_chan.close()
+            for ch in self._node_chans:
+                ch.close()
+        from ray_tpu.core import api
+
+        try:
+            api.get(self._loop_refs, timeout=10)
+        except Exception:
+            pass
+        self._input_chan.destroy()
+        for ch in self._node_chans:
+            ch.destroy()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass
+
+
+def _iter_arg_nodes(n: ClassMethodNode, with_consts: bool = False):
+    """Yield (upstream_or_const, ("pos", i) | ("kw", k)) for bound args."""
+    for i, a in enumerate(n._bound_args):
+        if isinstance(a, DAGNode) or with_consts:
+            yield a, ("pos", i)
+    for k, v in n._bound_kwargs.items():
+        if isinstance(v, DAGNode) or with_consts:
+            yield v, ("kw", k)
+
+
+def _arg_spec_for(up, actor, node_idx, loop: _LoopSpec):
+    if isinstance(up, InputNode):
+        return ("input",)
+    if isinstance(up, InputAttributeNode):
+        return ("input_attr", up._key)
+    if isinstance(up, ClassMethodNode):
+        idx = node_idx[id(up)]
+        if up.actor_handle is actor:
+            return ("local", idx)
+        return ("chan", idx)
+    return ("const", up)

@@ -1,0 +1,224 @@
+"""DAG node types and interpreted execution.
+
+Reference: python/ray/dag/dag_node.py, function_node.py, class_node.py,
+input_node.py, output_node.py. ``execute()`` here submits ordinary
+tasks/actor tasks bottom-up, passing ObjectRefs along the edges — lineage,
+retries and scheduling all come for free from the core.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional
+
+_anon = itertools.count()
+
+
+class DAGNode:
+    """Base: a lazily-bound call with upstream ``DAGNode`` args."""
+
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    # -- traversal ----------------------------------------------------------
+    def _upstream(self) -> List["DAGNode"]:
+        ups = [a for a in self._bound_args if isinstance(a, DAGNode)]
+        ups += [v for v in self._bound_kwargs.values() if isinstance(v, DAGNode)]
+        return ups
+
+    def topo_sort(self) -> List["DAGNode"]:
+        order: List[DAGNode] = []
+        seen = set()
+
+        def visit(n: DAGNode):
+            if id(n) in seen:
+                return
+            seen.add(id(n))
+            for u in n._upstream():
+                visit(u)
+            order.append(n)
+
+        visit(self)
+        return order
+
+    def find_input_node(self) -> Optional["InputNode"]:
+        for n in self.topo_sort():
+            if isinstance(n, InputNode):
+                return n
+        return None
+
+    # -- execution ----------------------------------------------------------
+    def execute(self, *args, **kwargs):
+        """Run interpreted: one task graph submission per call."""
+        ctx = _ExecContext(args, kwargs)
+        return self._resolve(ctx)
+
+    def _resolve(self, ctx: "_ExecContext"):
+        cached = ctx.results.get(id(self))
+        if cached is None:
+            cached = ctx.results[id(self)] = self._execute_impl(ctx)
+        return cached
+
+    def _resolved_args(self, ctx: "_ExecContext"):
+        args = tuple(
+            a._resolve(ctx) if isinstance(a, DAGNode) else a for a in self._bound_args
+        )
+        kwargs = {
+            k: (v._resolve(ctx) if isinstance(v, DAGNode) else v)
+            for k, v in self._bound_kwargs.items()
+        }
+        return args, kwargs
+
+    def _execute_impl(self, ctx: "_ExecContext"):
+        raise NotImplementedError
+
+    def experimental_compile(self, buffer_size_bytes: int = 1024 * 1024, max_inflight: int = 2):
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self, buffer_size_bytes=buffer_size_bytes, max_inflight=max_inflight)
+
+
+class _ExecContext:
+    def __init__(self, args: tuple, kwargs: dict):
+        self.args = args
+        self.kwargs = kwargs
+        self.results: Dict[int, Any] = {}
+
+
+class InputNode(DAGNode):
+    """The DAG's runtime input. ``with InputNode() as inp:`` (reference
+    requires the context-manager form too, dag/input_node.py)."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __getattr__(self, key: str):
+        if key.startswith("_"):
+            raise AttributeError(key)
+        return InputAttributeNode(self, key)
+
+    def __getitem__(self, key):
+        return InputAttributeNode(self, key)
+
+    def _execute_impl(self, ctx: _ExecContext):
+        if ctx.kwargs or len(ctx.args) != 1:
+            raise ValueError(
+                "a DAG whose InputNode is used whole takes exactly one "
+                "positional execute() arg; use inp[i] / inp.key for more"
+            )
+        return ctx.args[0]
+
+
+class InputAttributeNode(DAGNode):
+    def __init__(self, parent: InputNode, key):
+        super().__init__((parent,), {})
+        self._key = key
+
+    def _execute_impl(self, ctx: _ExecContext):
+        if isinstance(self._key, int):
+            return ctx.args[self._key]
+        return ctx.kwargs[self._key]
+
+
+class FunctionNode(DAGNode):
+    """``fn.bind(...)`` (reference: dag/function_node.py)."""
+
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_impl(self, ctx: _ExecContext):
+        args, kwargs = self._resolved_args(ctx)
+        return self._remote_fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    """``ActorClass.bind(...)`` — actor instantiated per DAG (cached across
+    executions of the same DAG object; reference: dag/class_node.py)."""
+
+    def __init__(self, actor_cls, args: tuple, kwargs: dict):
+        super().__init__(args, kwargs)
+        self._actor_cls = actor_cls
+        self._cached_handle = None
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _UnboundClassMethod(self, name)
+
+    def _get_handle(self, ctx: _ExecContext):
+        if self._cached_handle is None:
+            args, kwargs = self._resolved_args(ctx)
+            args = tuple(_get_if_ref(a) for a in args)
+            kwargs = {k: _get_if_ref(v) for k, v in kwargs.items()}
+            self._cached_handle = self._actor_cls.remote(*args, **kwargs)
+        return self._cached_handle
+
+    def _execute_impl(self, ctx: _ExecContext):
+        return self._get_handle(ctx)
+
+
+def _get_if_ref(v):
+    from ray_tpu.core.object_ref import ObjectRef
+
+    if isinstance(v, ObjectRef):
+        from ray_tpu.core import api
+
+        return api.get(v)
+    return v
+
+
+class _UnboundClassMethod:
+    def __init__(self, class_node: ClassNode, name: str):
+        self._class_node = class_node
+        self._name = name
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(None, self._name, args, kwargs, class_node=self._class_node)
+
+
+class ClassMethodNode(DAGNode):
+    """``actor.method.bind(...)`` on a live handle, or via a ClassNode.
+
+    Reference: dag/class_node.py ClassMethodNode; the live-handle form is
+    what compiled DAGs require (compiled_dag_node.py asserts actors exist).
+    """
+
+    def __init__(self, handle, method_name: str, args: tuple, kwargs: dict, class_node=None):
+        ups = args, kwargs
+        if class_node is not None:
+            ups = (class_node, *args), kwargs
+        super().__init__(*ups)
+        self._handle = handle
+        self._class_node = class_node
+        self._method_name = method_name
+
+    @property
+    def actor_handle(self):
+        return self._handle
+
+    def _execute_impl(self, ctx: _ExecContext):
+        args, kwargs = self._resolved_args(ctx)
+        handle = self._handle
+        if handle is None:
+            handle = self._class_node._get_handle(ctx)
+            args = args[1:]  # drop the class-node placeholder
+        return getattr(handle, self._method_name).remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Root collecting several outputs (reference: dag/output_node.py)."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(tuple(outputs), {})
+
+    def _execute_impl(self, ctx: _ExecContext):
+        return [
+            a._resolve(ctx) if isinstance(a, DAGNode) else a for a in self._bound_args
+        ]

@@ -1,0 +1,271 @@
+"""DAG API + compiled DAGs + channels.
+
+Mirrors the reference's python/ray/dag/tests (test_function_dag.py,
+test_class_dag.py, tests/experimental/test_accelerated_dag.py).
+"""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.channel import ChannelClosedError, IntraProcessChannel, ShmChannel
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+# ---------------------------------------------------------------------------
+# Channels (no cluster needed)
+# ---------------------------------------------------------------------------
+def test_shm_channel_roundtrip():
+    ch = ShmChannel(num_readers=1)
+    rd = ch.reader(0)
+    ch.write({"a": 1})
+    assert rd.read() == {"a": 1}
+    ch.write([1, 2, 3])
+    assert rd.read() == [1, 2, 3]
+    ch.destroy()
+
+
+def test_shm_channel_ring_backpressure():
+    ch = ShmChannel(num_readers=1, num_slots=2)
+    rd = ch.reader(0)
+    ch.write(1)
+    ch.write(2)
+    with pytest.raises(TimeoutError):
+        ch.write(3, timeout=0.1)
+    assert rd.read() == 1
+    ch.write(3, timeout=1)
+    assert rd.read() == 2
+    assert rd.read() == 3
+    ch.destroy()
+
+
+def test_shm_channel_multi_reader():
+    ch = ShmChannel(num_readers=2, num_slots=2)
+    r0, r1 = ch.reader(0), ch.reader(1)
+    for i in range(5):
+        ch.write(i, timeout=2)
+        assert r0.read(timeout=2) == i
+        assert r1.read(timeout=2) == i
+    ch.destroy()
+
+
+def test_shm_channel_numpy_and_error():
+    ch = ShmChannel(num_readers=1)
+    rd = ch.reader(0)
+    arr = np.arange(100, dtype=np.float32)
+    ch.write(arr)
+    np.testing.assert_array_equal(rd.read(), arr)
+    ch.write_error(ValueError("boom"))
+    with pytest.raises(ValueError, match="boom"):
+        rd.read()
+    ch.write_sentinel()
+    with pytest.raises(ChannelClosedError):
+        rd.read()
+    ch.destroy()
+
+
+def test_intra_process_channel():
+    ch = IntraProcessChannel()
+    ch.write(42)
+    assert ch.read() == 42
+
+
+# ---------------------------------------------------------------------------
+# Interpreted DAG
+# ---------------------------------------------------------------------------
+def test_function_dag(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), 10)
+    ref = dag.execute(5)
+    assert ray_tpu.get(ref) == 20
+    assert ray_tpu.get(dag.execute(1)) == 12
+
+
+def test_multi_output_dag(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = MultiOutputNode([double.bind(inp), inc.bind(inp)])
+    refs = dag.execute(7)
+    assert ray_tpu.get(refs) == [14, 8]
+
+
+def test_class_node_dag(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    with InputNode() as inp:
+        c = Counter.bind(100)
+        dag = c.add.bind(inp)
+    assert ray_tpu.get(dag.execute(5)) == 105
+    # Same DAG object reuses the actor: state persists.
+    assert ray_tpu.get(dag.execute(5)) == 110
+
+
+def test_input_attr_dag(ray_start_regular):
+    @ray_tpu.remote
+    def combine(a, b, c):
+        return a + b + c
+
+    with InputNode() as inp:
+        dag = combine.bind(inp[0], inp[1], inp.c)
+    assert ray_tpu.get(dag.execute(1, 2, c=3)) == 6
+
+
+# ---------------------------------------------------------------------------
+# Compiled DAG
+# ---------------------------------------------------------------------------
+@ray_tpu.remote
+class Worker:
+    def __init__(self):
+        self.calls = 0
+
+    def echo(self, x):
+        self.calls += 1
+        return x
+
+    def double(self, x):
+        return 2 * x
+
+    def add(self, a, b):
+        return a + b
+
+    def fail(self, x):
+        raise RuntimeError("deliberate")
+
+
+def test_compiled_single_actor(ray_start_regular):
+    a = Worker.remote()
+    with InputNode() as inp:
+        dag = a.double.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        for i in range(10):
+            assert compiled.execute(i).get(timeout=10) == 2 * i
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_chain_two_actors(ray_start_regular):
+    a, b = Worker.remote(), Worker.remote()
+    with InputNode() as inp:
+        dag = b.double.bind(a.double.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(3).get(timeout=10) == 12
+        assert compiled.execute(5).get(timeout=10) == 20
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_fan_out_fan_in(ray_start_regular):
+    a, b, c = Worker.remote(), Worker.remote(), Worker.remote()
+    with InputNode() as inp:
+        left = a.double.bind(inp)
+        right = b.echo.bind(inp)
+        dag = c.add.bind(left, right)
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(4).get(timeout=10) == 12  # 8 + 4
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_multi_output(ray_start_regular):
+    a, b = Worker.remote(), Worker.remote()
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.double.bind(inp), b.echo.bind(inp)])
+    compiled = dag.experimental_compile()
+    try:
+        r1, r2 = compiled.execute(6)
+        assert r1.get(timeout=10) == 12
+        assert r2.get(timeout=10) == 6
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_pipelined_executions(ray_start_regular):
+    """Submit several executions before getting any (buffered in-flight,
+    reference: compiled_dag_node.py:1864)."""
+    a = Worker.remote()
+    with InputNode() as inp:
+        dag = a.double.bind(inp)
+    compiled = dag.experimental_compile(max_inflight=4)
+    try:
+        refs = [compiled.execute(i) for i in range(4)]
+        assert [r.get(timeout=10) for r in refs] == [0, 2, 4, 6]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_error_propagation(ray_start_regular):
+    a, b = Worker.remote(), Worker.remote()
+    with InputNode() as inp:
+        dag = b.double.bind(a.fail.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        with pytest.raises(RuntimeError, match="deliberate"):
+            compiled.execute(1).get(timeout=10)
+        # Pipeline survives the error.
+        with pytest.raises(RuntimeError, match="deliberate"):
+            compiled.execute(2).get(timeout=10)
+    finally:
+        compiled.teardown()
+
+
+def test_shm_channel_oversized_error_preserved(ray_start_regular):
+    ch = ShmChannel(num_readers=1, slot_size=512)
+    rd = ch.reader(0)
+    try:
+        ch.write_error(ValueError("x" * 10000))
+        with pytest.raises(ValueError):
+            rd.read()
+    finally:
+        ch.destroy()
+
+
+def test_compiled_teardown_with_unread_results(ray_start_regular):
+    """Teardown must not wedge the actor when results were never read
+    (loops blocked writing into a full output ring)."""
+    a = Worker.remote()
+    with InputNode() as inp:
+        dag = a.double.bind(inp)
+    compiled = dag.experimental_compile(max_inflight=2)
+    compiled.execute(1)
+    compiled.execute(2)
+    t0 = time.monotonic()
+    compiled.teardown()
+    assert time.monotonic() - t0 < 8
+    assert ray_tpu.get(a.echo.remote("alive")) == "alive"
+
+
+def test_compiled_actor_usable_after_teardown(ray_start_regular):
+    a = Worker.remote()
+    with InputNode() as inp:
+        dag = a.echo.bind(inp)
+    compiled = dag.experimental_compile()
+    assert compiled.execute("hi").get(timeout=10) == "hi"
+    compiled.teardown()
+    # The loop released the actor thread; normal tasks work again.
+    assert ray_tpu.get(a.echo.remote("back")) == "back"
