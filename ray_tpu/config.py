@@ -71,6 +71,13 @@ class Config:
     task_event_buffer_size: int = 100000
     event_flush_period_s: float = 1.0
 
+    # --- observability ---
+    # App-metric flush cadence (reference: metrics_report_interval_ms).
+    metrics_report_interval_ms: int = 2000
+    # 0 = pick a free port for the controller's HTTP observability endpoint
+    # (/metrics Prometheus text + /api/v0/* state JSON); -1 disables it.
+    dashboard_port: int = 0
+
     # --- misc ---
     temp_dir: str = field(default_factory=lambda: os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu"))
     log_to_driver: bool = True

@@ -71,6 +71,21 @@ def init(
             return {"address": _global_worker.address}
         raise RuntimeError("ray_tpu.init() called twice; use ignore_reinit_error=True")
 
+    if address == "auto":
+        # Reference: ray.init("auto") resolves the running cluster from the
+        # env (set for job drivers) or the address file `ray start` wrote.
+        address = os.environ.get("RAY_TPU_ADDRESS")
+        if address is None:
+            addr_file = os.path.join(get_config().temp_dir, "ray_current_cluster")
+            if os.path.exists(addr_file):
+                with open(addr_file) as f:
+                    address = f.read().strip() or None
+        if address is None:
+            raise ConnectionError(
+                "address='auto' but no running cluster found (no RAY_TPU_ADDRESS "
+                "env var and no address file)"
+            )
+
     if address is None:
         head_resources = dict(resources or {})
         head_resources.setdefault("CPU", num_cpus if num_cpus is not None else os.cpu_count() or 1)

@@ -142,6 +142,8 @@ class Controller:
         self._shutdown = asyncio.Event()
         self.events: List[dict] = []  # task event ring buffer
         self.finished_specs: Dict[TaskID, TaskSpec] = {}  # lineage for reconstruction
+        self.metrics: Dict[str, dict] = {}  # aggregated app metrics
+        self.dashboard_port: Optional[int] = None
 
         # Head node: controller doubles as its node agent.
         self.head_node_id = NodeID.from_random()
@@ -971,6 +973,37 @@ class Controller:
     async def rpc_list_events(self, peer, limit: int = 10000):
         return self.events[-limit:]
 
+    # =================================================================
+    # App metrics (reference: metrics agent, _private/metrics_agent.py:119;
+    # workers flush deltas, the controller aggregates)
+    # =================================================================
+    async def rpc_metrics_report(self, peer, records: list):
+        for name, mtype, desc, tags, payload in records:
+            entry = self.metrics.setdefault(
+                name, {"type": mtype, "description": desc, "series": {}}
+            )
+            series = entry["series"]
+            if mtype == "counter":
+                series[tags] = series.get(tags, 0.0) + payload
+            elif mtype == "gauge":
+                series[tags] = payload
+            elif mtype == "histogram":
+                cur = series.get(tags)
+                if cur is None:
+                    series[tags] = payload
+                else:
+                    cur["state"] = [a + b for a, b in zip(cur["state"], payload["state"])]
+
+    async def rpc_metrics_snapshot(self, peer):
+        return {
+            name: {
+                "type": e["type"],
+                "description": e["description"],
+                "series": [(list(k), v) for k, v in e["series"].items()],
+            }
+            for name, e in self.metrics.items()
+        }
+
     async def rpc_ping(self, peer):
         return "pong"
 
@@ -995,6 +1028,14 @@ class Controller:
     # =================================================================
     async def run(self, port: int = 0):
         server, self.port = await rpc.serve(self, port=port)
+        if self.config.dashboard_port >= 0:
+            from ray_tpu.core.http_gateway import start_http_gateway
+
+            self.dashboard_port = start_http_gateway(
+                self, asyncio.get_running_loop(), self.config.dashboard_port
+            )
+            with open(os.path.join(self.session_dir, "dashboard_port"), "w") as f:
+                f.write(str(self.dashboard_port))
         with open(os.path.join(self.session_dir, "controller_port"), "w") as f:
             f.write(str(self.port))
         if self._head_prestart:

@@ -1,0 +1,80 @@
+"""HTTP observability gateway on the controller.
+
+Reference: the dashboard head (python/ray/dashboard/head.py:61) serving the
+state API (dashboard/modules/state/state_head.py) and Prometheus metrics
+endpoints. This rebuild keeps the head tiny: a stdlib ThreadingHTTPServer
+bridging into the controller's asyncio loop.
+
+Routes:
+  GET /metrics              Prometheus text exposition of app metrics
+  GET /api/v0/<what>        state JSON: nodes|workers|tasks|actors|objects|
+                            events|placement_groups|cluster_resources|
+                            available_resources
+  GET /healthz              liveness probe
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_STATE_ROUTES = {
+    "nodes": "rpc_list_nodes",
+    "workers": "rpc_list_workers",
+    "tasks": "rpc_list_tasks",
+    "actors": "rpc_list_actors",
+    "objects": "rpc_list_objects",
+    "events": "rpc_list_events",
+    "placement_groups": "rpc_pg_table",
+    "cluster_resources": "rpc_cluster_resources",
+    "available_resources": "rpc_available_resources",
+}
+
+
+def start_http_gateway(controller, loop: asyncio.AbstractEventLoop, port: int) -> int:
+    def call(method_name):
+        coro = getattr(controller, method_name)(None)
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=10)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _send(self, code: int, body: bytes, ctype: str):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            try:
+                path = self.path.split("?")[0].rstrip("/")
+                if path == "/healthz":
+                    self._send(200, b"ok", "text/plain")
+                elif path == "/metrics":
+                    from ray_tpu.util.metrics import prometheus_text
+
+                    snap = call("rpc_metrics_snapshot")
+                    snap = {
+                        k: {**v, "series": [(tuple(map(tuple, t)), val) for t, val in v["series"]]}
+                        for k, v in snap.items()
+                    }
+                    self._send(200, prometheus_text(snap).encode(), "text/plain; version=0.0.4")
+                elif path.startswith("/api/v0/"):
+                    what = path[len("/api/v0/") :]
+                    method = _STATE_ROUTES.get(what)
+                    if method is None:
+                        self._send(404, b'{"error": "unknown resource"}', "application/json")
+                        return
+                    data = call(method)
+                    self._send(200, json.dumps(data, default=str).encode(), "application/json")
+                else:
+                    self._send(404, b"not found", "text/plain")
+            except Exception as e:  # noqa: BLE001 — HTTP surface must not crash
+                self._send(500, str(e).encode(), "text/plain")
+
+    server = ThreadingHTTPServer(("127.0.0.1", max(port, 0)), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True, name="http-gateway").start()
+    return server.server_address[1]

@@ -31,6 +31,7 @@ from ray_tpu.channel.shm_channel import (
     ReaderHandle,
     ShmChannel,
 )
+from ray_tpu.exceptions import ChannelError
 from ray_tpu.dag.node import (
     ClassMethodNode,
     DAGNode,
@@ -206,6 +207,7 @@ class CompiledDAG:
         self._read_seq = 0
         self._results: Dict[int, list] = {}
         self._partial_row: list = []
+        self._max_buffered_results = 1000
         self._torn_down = False
         self._node_chans: List[ShmChannel] = []
         self._build()
@@ -330,6 +332,11 @@ class CompiledDAG:
         with self._lock:
             if self._torn_down:
                 raise ChannelClosedError("compiled DAG was torn down")
+            # In-flight cap: past ring capacity, drain a result row into the
+            # buffer before submitting more — otherwise the input write and
+            # the actors' output writes deadlock against each other.
+            while self._seq - self._read_seq >= self._slots:
+                self._read_row(None)
             seq = self._seq
             self._seq += 1
             self._input_chan.write((args, kwargs))
@@ -337,23 +344,38 @@ class CompiledDAG:
             return [CompiledDAGRef(self, seq, i) for i in range(self._num_outputs)]
         return CompiledDAGRef(self, seq, None)
 
+    def _read_row(self, timeout: Optional[float]):
+        """Read one full output row into _results (lock held by caller).
+        _partial_row persists across a TimeoutError mid-row so a retry
+        resumes at the reader that timed out instead of re-reading (and
+        desynchronizing) earlier readers."""
+        row = self._partial_row
+        while len(row) < self._num_outputs:
+            value, kind = self._out_readers[len(row)].read_raw(timeout)
+            if kind == KIND_ERROR:
+                value = _WrappedError(value)
+            elif kind == KIND_SENTINEL:
+                raise ChannelClosedError("compiled DAG torn down mid-get")
+            row.append(value)
+        self._results[self._read_seq] = [row, set()]
+        self._partial_row = []
+        self._read_seq += 1
+        # Unread-result backstop: without it, a caller that never gets some
+        # outputs grows _results forever (reference caps buffered results).
+        while len(self._results) > self._max_buffered_results:
+            evicted = min(self._results)
+            del self._results[evicted]
+
     def _result_for(self, seq: int, output_idx: int, timeout: Optional[float]):
         with self._lock:
             while seq not in self._results:
-                # _partial_row persists across a TimeoutError mid-row so a
-                # retry resumes at the reader that timed out instead of
-                # re-reading (and desynchronizing) earlier readers.
-                row = self._partial_row
-                while len(row) < self._num_outputs:
-                    value, kind = self._out_readers[len(row)].read_raw(timeout)
-                    if kind == KIND_ERROR:
-                        value = _WrappedError(value)
-                    elif kind == KIND_SENTINEL:
-                        raise ChannelClosedError("compiled DAG torn down mid-get")
-                    row.append(value)
-                self._results[self._read_seq] = [row, set()]
-                self._partial_row = []
-                self._read_seq += 1
+                if seq < self._read_seq:
+                    raise ChannelError(
+                        f"result for execution {seq} was evicted (more than "
+                        f"{self._max_buffered_results} unread results buffered); "
+                        "call get() on refs promptly"
+                    )
+                self._read_row(timeout)
             row, consumed = self._results[seq]
             value = row[output_idx]
             consumed.add(output_idx)

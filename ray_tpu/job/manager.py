@@ -1,0 +1,202 @@
+"""JobManager actor + JobSubmissionClient.
+
+Reference: python/ray/dashboard/modules/job/job_manager.py:58 (JobManager),
+job_head.py:143 (REST head), common.py (JobStatus/JobInfo).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import ray_tpu
+
+JOB_MANAGER_NAME = "__job_manager__"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = {SUCCEEDED, FAILED, STOPPED}
+
+
+@ray_tpu.remote
+class JobManager:
+    def __init__(self, session_dir: str, address: str):
+        self._session_dir = session_dir
+        self._address = address
+        self._jobs: Dict[str, dict] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def submit(
+        self,
+        entrypoint: str,
+        submission_id: Optional[str] = None,
+        runtime_env: Optional[dict] = None,
+        metadata: Optional[dict] = None,
+    ) -> str:
+        job_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:16]}"
+        with self._lock:
+            if job_id in self._jobs:
+                raise ValueError(f"job {job_id} already exists")
+            self._jobs[job_id] = {
+                "job_id": job_id,
+                "entrypoint": entrypoint,
+                "status": JobStatus.PENDING,
+                "submission_time": time.time(),
+                "start_time": None,
+                "end_time": None,
+                "metadata": metadata or {},
+                "message": "",
+                "log_path": os.path.join(self._session_dir, "logs", f"job-{job_id}.log"),
+            }
+        threading.Thread(
+            target=self._supervise, args=(job_id, runtime_env or {}), daemon=True
+        ).start()
+        return job_id
+
+    def _supervise(self, job_id: str, runtime_env: dict):
+        """The reference's JobSupervisor actor, as a thread (job_manager.py
+        JobSupervisor.run — subprocess + status tracking)."""
+        info = self._jobs[job_id]
+        with self._lock:
+            if info["status"] == JobStatus.STOPPED:
+                return  # stopped while still PENDING
+        env = dict(os.environ)
+        env.update(runtime_env.get("env_vars") or {})
+        env["RAY_TPU_ADDRESS"] = self._address
+        env["RAY_TPU_JOB_ID"] = job_id
+        cwd = runtime_env.get("working_dir") or None
+        log = open(info["log_path"], "ab")
+        try:
+            proc = subprocess.Popen(
+                info["entrypoint"],
+                shell=True,
+                env=env,
+                cwd=cwd,
+                stdout=log,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        except Exception as e:  # noqa: BLE001 — bad entrypoints must not kill the manager
+            with self._lock:
+                info["status"] = JobStatus.FAILED
+                info["message"] = f"failed to start: {e}"
+                info["end_time"] = time.time()
+            return
+        with self._lock:
+            info["status"] = JobStatus.RUNNING
+            info["start_time"] = time.time()
+            self._procs[job_id] = proc
+        rc = proc.wait()
+        with self._lock:
+            self._procs.pop(job_id, None)
+            if info["status"] == JobStatus.STOPPED:
+                pass
+            elif rc == 0:
+                info["status"] = JobStatus.SUCCEEDED
+            else:
+                info["status"] = JobStatus.FAILED
+                info["message"] = f"exit code {rc}"
+            info["end_time"] = time.time()
+
+    def stop(self, job_id: str) -> bool:
+        with self._lock:
+            info = self._jobs.get(job_id)
+            proc = self._procs.get(job_id)
+            if info is None:
+                raise ValueError(f"no such job: {job_id}")
+            if proc is None:
+                if info["status"] == JobStatus.PENDING:
+                    # Not launched yet: mark stopped so _supervise won't start it.
+                    info["status"] = JobStatus.STOPPED
+                    info["end_time"] = time.time()
+                    return True
+                return False
+            info["status"] = JobStatus.STOPPED
+        try:
+            os.killpg(os.getpgid(proc.pid), 15)
+        except ProcessLookupError:
+            pass
+        return True
+
+    def get_info(self, job_id: str) -> dict:
+        with self._lock:
+            info = self._jobs.get(job_id)
+            if info is None:
+                raise ValueError(f"no such job: {job_id}")
+            return dict(info)
+
+    def list_jobs(self) -> List[dict]:
+        with self._lock:
+            return [dict(v) for v in self._jobs.values()]
+
+    def get_logs(self, job_id: str) -> str:
+        info = self.get_info(job_id)
+        try:
+            with open(info["log_path"], errors="replace") as f:
+                return f.read()
+        except FileNotFoundError:
+            return ""
+
+
+class JobSubmissionClient:
+    """Driver-side client (reference: python/ray/job_submission/
+    JobSubmissionClient — REST there, named-actor RPC here)."""
+
+    def __init__(self):
+        from ray_tpu.core.api import _require_worker
+
+        core = _require_worker()
+        try:
+            self._mgr = ray_tpu.get_actor(JOB_MANAGER_NAME)
+        except ValueError:
+            self._mgr = JobManager.options(name=JOB_MANAGER_NAME, num_cpus=0).remote(
+                core.session_dir, core.address
+            )
+            ray_tpu.wait_actor_ready(self._mgr)
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        submission_id: Optional[str] = None,
+        runtime_env: Optional[dict] = None,
+        metadata: Optional[dict] = None,
+    ) -> str:
+        return ray_tpu.get(
+            self._mgr.submit.remote(entrypoint, submission_id, runtime_env, metadata)
+        )
+
+    def get_job_status(self, job_id: str) -> str:
+        return ray_tpu.get(self._mgr.get_info.remote(job_id))["status"]
+
+    def get_job_info(self, job_id: str) -> dict:
+        return ray_tpu.get(self._mgr.get_info.remote(job_id))
+
+    def list_jobs(self) -> List[dict]:
+        return ray_tpu.get(self._mgr.list_jobs.remote())
+
+    def stop_job(self, job_id: str) -> bool:
+        return ray_tpu.get(self._mgr.stop.remote(job_id))
+
+    def get_job_logs(self, job_id: str) -> str:
+        return ray_tpu.get(self._mgr.get_logs.remote(job_id))
+
+    def wait_until_finished(self, job_id: str, timeout: float = 120.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(job_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(0.2)
+        raise TimeoutError(f"job {job_id} did not finish in {timeout}s")

@@ -1,0 +1,301 @@
+"""``ray-tpu`` CLI.
+
+Reference: python/ray/scripts/scripts.py (:2548-2579 — start/stop/status/
+submit/memory/timeline/logs/microbenchmark). argparse instead of click;
+subcommands connect to the running cluster via the address file
+``<temp_dir>/ray_current_cluster`` that ``start --head`` writes.
+
+Usage:
+  ray-tpu start --head [--num-cpus N] [--resources JSON] [--block]
+  ray-tpu start --address HOST:PORT [--num-cpus N]   # join as a node
+  ray-tpu stop
+  ray-tpu status
+  ray-tpu submit -- python my_script.py              # run as a job
+  ray-tpu job list | job logs ID | job stop ID
+  ray-tpu summary tasks|actors|objects
+  ray-tpu timeline [--output FILE]
+  ray-tpu memory
+  ray-tpu logs [FILENAME]
+  ray-tpu microbenchmark
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _addr_file() -> str:
+    from ray_tpu.config import get_config
+
+    return os.path.join(get_config().temp_dir, "ray_current_cluster")
+
+
+def _connect():
+    import ray_tpu
+
+    ray_tpu.init(address="auto")
+    return ray_tpu
+
+
+# ---------------------------------------------------------------------------
+def cmd_start(args):
+    from ray_tpu.core import api
+
+    if args.head:
+        resources = json.loads(args.resources) if args.resources else {}
+        resources.setdefault("CPU", args.num_cpus or os.cpu_count() or 1)
+        if args.num_tpus:
+            resources["TPU"] = args.num_tpus
+        address, proc, session_dir = api._start_controller(resources, {}, owned=False)
+        os.makedirs(os.path.dirname(_addr_file()), exist_ok=True)
+        with open(_addr_file(), "w") as f:
+            f.write(address)
+        print(f"started head at {address} (session: {session_dir})")
+        print(f"connect with ray_tpu.init(address='auto') or --address {address}")
+        if args.block:
+            try:
+                proc.wait()
+            except KeyboardInterrupt:
+                pass
+        return 0
+    if not args.address:
+        print("either --head or --address is required", file=sys.stderr)
+        return 1
+    import subprocess
+
+    from ray_tpu.core.node_agent import child_env
+
+    res = json.loads(args.resources) if args.resources else {}
+    res.setdefault("CPU", args.num_cpus or os.cpu_count() or 1)
+    if args.num_tpus:
+        res["TPU"] = args.num_tpus
+    cmd = [
+        sys.executable,
+        "-m",
+        "ray_tpu.core.node_agent",
+        "--controller",
+        args.address,
+        "--session-dir",
+        args.session_dir or "/tmp/ray_tpu/cli_node",
+        "--resources",
+        json.dumps(res),
+    ]
+    os.makedirs(os.path.join(args.session_dir or "/tmp/ray_tpu/cli_node", "logs"), exist_ok=True)
+    proc = subprocess.Popen(cmd, env=child_env(needs_tpu=bool(args.num_tpus)))
+    print(f"node agent joining {args.address} (pid {proc.pid})")
+    if args.block:
+        proc.wait()
+    return 0
+
+
+def cmd_stop(args):
+    import ray_tpu
+
+    try:
+        ray_tpu.init(address="auto")
+    except ConnectionError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    from ray_tpu.core.api import _require_worker
+
+    try:
+        _require_worker()._call("shutdown_cluster", timeout=5)
+    except Exception:
+        pass
+    try:
+        os.unlink(_addr_file())
+    except FileNotFoundError:
+        pass
+    print("cluster stopped")
+    return 0
+
+
+def cmd_status(args):
+    rt = _connect()
+    total = rt.cluster_resources()
+    avail = rt.available_resources()
+    nodes = rt.nodes()
+    print(f"nodes: {len(nodes)} ({sum(1 for n in nodes if n['state'] == 'ALIVE')} alive)")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0):g}/{total[k]:g} available")
+    return 0
+
+
+def cmd_submit(args):
+    from ray_tpu.job import JobSubmissionClient
+
+    _connect()
+    client = JobSubmissionClient()
+    entrypoint = " ".join(args.entrypoint)
+    job_id = client.submit_job(entrypoint=entrypoint)
+    print(f"submitted {job_id}")
+    if args.no_wait:
+        return 0
+    status = client.wait_until_finished(job_id, timeout=args.timeout)
+    print(client.get_job_logs(job_id), end="")
+    print(f"job {job_id}: {status}")
+    return 0 if status == "SUCCEEDED" else 1
+
+
+def cmd_job(args):
+    from ray_tpu.job import JobSubmissionClient
+
+    _connect()
+    client = JobSubmissionClient()
+    if args.action == "list":
+        for j in client.list_jobs():
+            print(f"{j['job_id']}  {j['status']:10s}  {j['entrypoint']}")
+    elif args.action == "logs":
+        print(client.get_job_logs(args.job_id), end="")
+    elif args.action == "stop":
+        print(client.stop_job(args.job_id))
+    return 0
+
+
+def cmd_summary(args):
+    from ray_tpu.util import state
+
+    _connect()
+    fn = {"tasks": state.summarize_tasks, "actors": state.summarize_actors, "objects": state.summarize_objects}[args.what]
+    print(json.dumps(fn(), indent=2))
+    return 0
+
+
+def cmd_timeline(args):
+    from ray_tpu.util import state
+
+    _connect()
+    out = args.output or f"timeline-{int(time.time())}.json"
+    trace = state.timeline_chrome(out)
+    print(f"wrote {len(trace)} spans to {out} (load in chrome://tracing or perfetto)")
+    return 0
+
+
+def cmd_memory(args):
+    from ray_tpu.util import state
+
+    _connect()
+    print(json.dumps(state.summarize_objects(), indent=2))
+    return 0
+
+
+def cmd_logs(args):
+    from ray_tpu.util import state
+
+    _connect()
+    if args.filename:
+        print(state.get_log(args.filename, tail=args.tail), end="")
+    else:
+        for name in state.list_logs():
+            print(name)
+    return 0
+
+
+def cmd_microbenchmark(args):
+    """Core perf smoke (reference: `ray microbenchmark`,
+    python/ray/_private/ray_perf.py:93)."""
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    results = {}
+
+    @ray_tpu.remote
+    def noop():
+        return 0
+
+    # warm the worker pool
+    ray_tpu.get([noop.remote() for _ in range(20)])
+    t0 = time.perf_counter()
+    n = 300
+    ray_tpu.get([noop.remote() for _ in range(n)])
+    results["tasks_per_s"] = n / (time.perf_counter() - t0)
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 0
+
+    a = A.remote()
+    ray_tpu.wait_actor_ready(a)
+    t0 = time.perf_counter()
+    for _ in range(100):
+        ray_tpu.get(a.ping.remote())
+    results["sync_actor_calls_per_s"] = 100 / (time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    ray_tpu.get([a.ping.remote() for _ in range(500)])
+    results["async_actor_calls_per_s"] = 500 / (time.perf_counter() - t0)
+
+    data = np.zeros(16 * 1024 * 1024, dtype=np.uint8)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        ref = ray_tpu.put(data)
+        ray_tpu.get(ref)
+    gib = 10 * data.nbytes / (1 << 30)
+    results["put_get_GiB_per_s"] = gib / (time.perf_counter() - t0)
+
+    ray_tpu.shutdown()
+    print(json.dumps({k: round(v, 1) for k, v in results.items()}, indent=2))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray-tpu", description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head node or join as a worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address")
+    sp.add_argument("--num-cpus", type=int)
+    sp.add_argument("--num-tpus", type=int)
+    sp.add_argument("--resources")
+    sp.add_argument("--session-dir")
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sub.add_parser("stop", help="stop the running cluster").set_defaults(fn=cmd_stop)
+    sub.add_parser("status", help="cluster resource status").set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("submit", help="submit a job: ray-tpu submit -- python x.py")
+    sp.add_argument("--no-wait", action="store_true")
+    sp.add_argument("--timeout", type=float, default=600.0)
+    sp.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_submit)
+
+    sp = sub.add_parser("job", help="manage jobs")
+    sp.add_argument("action", choices=["list", "logs", "stop"])
+    sp.add_argument("job_id", nargs="?")
+    sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("summary", help="state summaries")
+    sp.add_argument("what", choices=["tasks", "actors", "objects"])
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("timeline", help="dump chrome trace of task events")
+    sp.add_argument("--output", "-o")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sub.add_parser("memory", help="object store summary").set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("logs", help="list/tail session logs")
+    sp.add_argument("filename", nargs="?")
+    sp.add_argument("--tail", type=int, default=1000)
+    sp.set_defaults(fn=cmd_logs)
+
+    sub.add_parser("microbenchmark", help="core perf smoke").set_defaults(fn=cmd_microbenchmark)
+
+    args = p.parse_args(argv)
+    entry = getattr(args, "entrypoint", None)
+    if entry and entry[0] == "--":
+        args.entrypoint = entry[1:]
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

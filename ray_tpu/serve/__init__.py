@@ -1,0 +1,36 @@
+"""Serve: scalable model serving on the actor core.
+
+Reference: python/ray/serve/ (§2.7 of SURVEY.md) — controller actor
+reconciling DeploymentState (serve/_private/deployment_state.py:1232),
+per-node HTTP proxy (proxy.py), power-of-two-choices router
+(replica_scheduler/pow_2_scheduler.py:51), replica actors (replica.py:231),
+request-based autoscaling (autoscaling_policy.py), DeploymentHandle
+composition.
+
+The serving data plane is hardware-agnostic (SURVEY §2.7); on TPU hosts the
+replicas hold jitted JAX callables and the router keeps batches flowing into
+them. Architecture kept, sizes trimmed: one controller actor + N replica
+actors + an HTTP proxy actor, with client-side p2c routing in the handle.
+"""
+from ray_tpu.serve.api import (
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "deployment",
+    "run",
+    "start",
+    "shutdown",
+    "delete",
+    "status",
+    "get_deployment_handle",
+    "DeploymentHandle",
+    "DeploymentResponse",
+]

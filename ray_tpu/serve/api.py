@@ -1,0 +1,163 @@
+"""serve public API: deployment decorator, run, handles.
+
+Reference: python/ray/serve/api.py (serve.deployment, serve.run,
+serve.start/shutdown) and deployment.py (Deployment.bind → Application
+graph). Composition mirrors the reference: ``Parent.bind(Child.bind())``
+deploys Child first and injects Parent's init arg as a DeploymentHandle.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.utils.serialization import serialize_function
+
+_lock = threading.Lock()
+_controller = None
+_proxy = None
+
+_DEPLOYMENT_DEFAULTS = dict(
+    num_replicas=None,  # None + min/max set → autoscaling
+    min_replicas=None,
+    max_replicas=None,
+    target_ongoing_requests=2.0,
+    max_ongoing_requests=8,
+    num_cpus=0.1,
+    num_tpus=0,
+    resources=None,
+    route_prefix=None,
+    name=None,
+)
+
+
+class Application:
+    """A bound deployment graph node (reference: serve Application)."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, target: Union[type, Callable], config: Dict[str, Any]):
+        self._target = target
+        self._config = dict(_DEPLOYMENT_DEFAULTS)
+        self._config.update(config)
+        self.name = self._config["name"] or getattr(target, "__name__", "deployment")
+
+    def options(self, **opts) -> "Deployment":
+        return Deployment(self._target, {**self._config, **opts})
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    @property
+    def config(self) -> Dict[str, Any]:
+        return dict(self._config)
+
+
+def deployment(_target=None, **config):
+    """``@serve.deployment`` / ``@serve.deployment(num_replicas=2)``."""
+    if _target is not None:
+        return Deployment(_target, {})
+    return lambda target: Deployment(target, config)
+
+
+# ---------------------------------------------------------------------------
+def _get_controller():
+    global _controller
+    with _lock:
+        if _controller is not None:
+            return _controller
+        try:
+            _controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        except ValueError:
+            ctrl_cls = ray_tpu.remote(ServeController)
+            _controller = ctrl_cls.options(name=CONTROLLER_NAME, num_cpus=0.1).remote()
+            ray_tpu.wait_actor_ready(_controller)
+        return _controller
+
+
+def start(http_port: Optional[int] = None):
+    """Start serve system actors (controller + optional HTTP proxy).
+
+    Reference: serve.start (api.py). Called implicitly by serve.run.
+    """
+    global _proxy
+    ctrl = _get_controller()
+    if http_port is not None:
+        with _lock:
+            if _proxy is None:
+                from ray_tpu.serve.proxy import ProxyActor
+
+                _proxy = ProxyActor.options(name="__serve_proxy__").remote(http_port)
+                ray_tpu.wait_actor_ready(_proxy)
+    return ctrl
+
+
+def run(app: Application, name: Optional[str] = None, http_port: Optional[int] = None) -> DeploymentHandle:
+    """Deploy an application graph; returns the ingress handle."""
+    ctrl = start(http_port)
+    ingress = _deploy_app(ctrl, app)
+    return get_deployment_handle(ingress)
+
+
+def _deploy_app(ctrl, app: Application) -> str:
+    """Post-order deploy: children become DeploymentHandles in init args."""
+
+    def resolve(v):
+        if isinstance(v, Application):
+            child = _deploy_app(ctrl, v)
+            return DeploymentHandle(child, ctrl)
+        return v
+
+    args = tuple(resolve(a) for a in app.args)
+    kwargs = {k: resolve(v) for k, v in app.kwargs.items()}
+    d = app.deployment
+    blob = serialize_function(d._target)
+    ray_tpu.get(ctrl.deploy.remote(d.name, blob, args, kwargs, d.config))
+    if not ray_tpu.get(ctrl.ready.remote(d.name, 60.0)):
+        raise RuntimeError(f"deployment {d.name} failed to reach target replicas")
+    return d.name
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name, _get_controller())
+
+
+def status() -> dict:
+    return ray_tpu.get(_get_controller().status.remote())
+
+
+def delete(name: str):
+    ray_tpu.get(_get_controller().delete_deployment.remote(name))
+
+
+def get_proxy_port() -> Optional[int]:
+    with _lock:
+        proxy = _proxy
+    if proxy is None:
+        return None
+    return ray_tpu.get(proxy.port.remote())
+
+
+def shutdown():
+    global _controller, _proxy
+    with _lock:
+        ctrl, _controller = _controller, None
+        proxy, _proxy = _proxy, None
+    if proxy is not None:
+        try:
+            ray_tpu.kill(proxy)
+        except Exception:  # noqa: BLE001
+            pass
+    if ctrl is not None:
+        try:
+            ray_tpu.get(ctrl.shutdown.remote())
+            ray_tpu.kill(ctrl)
+        except Exception:  # noqa: BLE001
+            pass

@@ -1,0 +1,154 @@
+"""DeploymentHandle: the client-side router.
+
+Reference: python/ray/serve/handle.py (DeploymentHandle/DeploymentResponse)
+and the power-of-two-choices replica scheduler
+(serve/_private/replica_scheduler/pow_2_scheduler.py:51). Routing state is
+client-side: the handle caches the replica list by controller version and
+tracks its own in-flight counts; each call samples two replicas and picks
+the less loaded (p2c), the same algorithm the reference router runs.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference: handle.py
+    DeploymentResponse). Passable as an argument to further handle calls —
+    it degrades to its underlying ObjectRef so the value flows worker-to-
+    worker without driver roundtrips (reference: response passing)."""
+
+    def __init__(self, ref, on_done):
+        self._ref = ref
+        fut = ref.future()
+        fut.add_done_callback(lambda _f: on_done())
+        self._fut = fut
+
+    def result(self, timeout: Optional[float] = None):
+        values = self._fut.result(timeout)
+        return values[0]
+
+    def _to_object_ref(self):
+        return self._ref
+
+    def __reduce__(self):
+        # Crossing a process boundary: ship the plain ref.
+        from ray_tpu.core.object_ref import ObjectRef
+
+        return (ObjectRef, (self._ref.id,))
+
+
+class _Router:
+    def __init__(self, deployment_name: str, controller):
+        import uuid
+
+        self._name = deployment_name
+        self._id = uuid.uuid4().hex[:12]
+        self._controller = controller
+        self._lock = threading.Lock()
+        self._replicas: list = []
+        self._version = -1
+        self._inflight: Dict[Any, int] = {}
+        self._last_report = 0.0
+        self._last_refresh = 0.0
+
+    def _refresh(self, force: bool = False):
+        import ray_tpu
+
+        now = time.monotonic()
+        if not force and self._replicas and now - self._last_refresh < 0.5:
+            return
+        self._last_refresh = now
+        version = ray_tpu.get(self._controller.get_version.remote())
+        if version != self._version:
+            v, replicas = ray_tpu.get(self._controller.get_replicas.remote(self._name))
+            if replicas is None:
+                raise RuntimeError(f"deployment {self._name} does not exist")
+            with self._lock:
+                self._version = v
+                self._replicas = replicas
+                self._inflight = {r: self._inflight.get(r, 0) for r in replicas}
+
+    def pick(self):
+        """p2c: sample two, take the one with fewer in-flight requests."""
+        deadline = time.monotonic() + 30
+        force = False
+        while True:
+            self._refresh(force)
+            force = True  # empty replica list → poll the controller directly
+            with self._lock:
+                if self._replicas:
+                    if len(self._replicas) == 1:
+                        chosen = self._replicas[0]
+                    else:
+                        a, b = random.sample(self._replicas, 2)
+                        chosen = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+                    self._inflight[chosen] = self._inflight.get(chosen, 0) + 1
+                    return chosen
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"no replicas for {self._name}")
+            time.sleep(0.05)
+
+    def done(self, replica):
+        with self._lock:
+            if replica in self._inflight and self._inflight[replica] > 0:
+                self._inflight[replica] -= 1
+        self._maybe_report()
+
+    def _maybe_report(self):
+        now = time.monotonic()
+        if now - self._last_report < 1.0:
+            return
+        self._last_report = now
+        with self._lock:
+            n = max(len(self._replicas), 1)
+            avg = sum(self._inflight.values()) / n
+        try:
+            self._controller.report_load.remote(self._name, self._id, avg)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller, method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self._controller = controller
+        self._method = method_name
+        self._router = _Router(deployment_name, controller)
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        h = DeploymentHandle.__new__(DeploymentHandle)
+        h.deployment_name = self.deployment_name
+        h._controller = self._controller
+        h._method = name
+        h._router = self._router  # share routing state across method handles
+        return h
+
+    def options(self, method_name: str = "__call__") -> "DeploymentHandle":
+        return getattr(self, method_name) if method_name != "__call__" else self
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        args = tuple(_unwrap(a) for a in args)
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+        replica = self._router.pick()
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+        return DeploymentResponse(ref, on_done=lambda r=replica: self._router.done(r))
+
+    def __reduce__(self):
+        return (_rebuild_handle, (self.deployment_name, self._method))
+
+
+def _rebuild_handle(name: str, method: str):
+    from ray_tpu.serve.api import get_deployment_handle
+
+    h = get_deployment_handle(name)
+    return getattr(h, method) if method != "__call__" else h
+
+
+def _unwrap(v):
+    return v._to_object_ref() if isinstance(v, DeploymentResponse) else v

@@ -1,0 +1,87 @@
+"""HTTP ingress proxy actor.
+
+Reference: python/ray/serve/_private/proxy.py (ProxyActor, HTTP :766) —
+one actor running an HTTP server that resolves the route table from the
+controller and forwards requests through DeploymentHandles.
+
+Protocol: ``POST /<route>`` with a JSON (or raw) body calls the
+deployment's ``__call__`` with the parsed body; the JSON-serialized result
+comes back. ``GET /-/routes`` lists routes, ``GET /-/healthz`` probes.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ProxyActor:
+    def __init__(self, http_port: int = 0):
+        from ray_tpu.serve.api import _get_controller, get_deployment_handle
+
+        self._controller = _get_controller()
+        self._handles: Dict[str, object] = {}
+        self._get_handle = get_deployment_handle
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code, body: bytes, ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/-/healthz":
+                    self._send(200, b'"ok"')
+                elif self.path == "/-/routes":
+                    self._send(200, json.dumps(proxy._routes()).encode())
+                else:
+                    self._handle(b"")
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self._handle(self.rfile.read(n))
+
+            def _handle(self, body: bytes):
+                try:
+                    result = proxy._dispatch(self.path, body)
+                    self._send(200, json.dumps(result, default=str).encode())
+                except KeyError:
+                    self._send(404, b'{"error": "no such route"}')
+                except Exception as e:  # noqa: BLE001 — user errors → 500
+                    self._send(500, json.dumps({"error": str(e)}).encode())
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", http_port), Handler)
+        self._port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    def _routes(self) -> Dict[str, str]:
+        return ray_tpu.get(self._controller.routes.remote())
+
+    def _dispatch(self, path: str, body: bytes):
+        routes = self._routes()
+        route = path.split("?")[0].rstrip("/") or "/"
+        name = routes.get(route)
+        if name is None:
+            raise KeyError(route)
+        handle = self._handles.get(name)
+        if handle is None:
+            handle = self._handles[name] = self._get_handle(name)
+        try:
+            payload = json.loads(body) if body else None
+        except json.JSONDecodeError:
+            payload = body.decode(errors="replace")
+        resp = handle.remote(payload) if payload is not None else handle.remote()
+        return resp.result(timeout=60)
+
+    def port(self) -> int:
+        return self._port

@@ -1,0 +1,34 @@
+"""Replica actor: hosts one instance of a deployment's user class.
+
+Reference: python/ray/serve/_private/replica.py:231 (ReplicaActor) — user
+callable construction, request dispatch by method name, health checks.
+"""
+from __future__ import annotations
+
+import ray_tpu
+from ray_tpu.utils.serialization import deserialize_function
+
+
+@ray_tpu.remote
+class Replica:
+    def __init__(self, deployment_name: str, cls_blob: bytes, init_args: tuple, init_kwargs: dict):
+        self.deployment_name = deployment_name
+        target = deserialize_function(cls_blob)
+        if isinstance(target, type):
+            self.instance = target(*init_args, **init_kwargs)
+        else:
+            # Function deployment: the "instance" is the function itself.
+            self.instance = target
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        if method_name == "__call__":
+            return self.instance(*args, **kwargs)
+        return getattr(self.instance, method_name)(*args, **kwargs)
+
+    def check_health(self) -> str:
+        # User classes may define their own probe (reference:
+        # replica.py check_health passthrough).
+        probe = getattr(self.instance, "check_health", None)
+        if callable(probe):
+            probe()
+        return "ok"

@@ -1,0 +1,212 @@
+"""Application metrics: Counter / Gauge / Histogram.
+
+Reference: python/ray/util/metrics.py (Cython Metric over the C++
+OpenCensus stats, src/ray/stats/metric.h) and the per-node metrics agent
+(python/ray/_private/metrics_agent.py:119) that proxies to Prometheus.
+
+Rebuild shape: metrics record locally (lock-free per-process dicts) and a
+daemon thread flushes deltas to the controller every
+``metrics_report_interval_ms``; the controller aggregates and serves both a
+JSON snapshot (state API) and the Prometheus text exposition on its HTTP
+observability port (reference: dashboard metrics module + `ray metrics`).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_lock = threading.Lock()
+_registry: List["Metric"] = []
+_flusher_started = False
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    """Base class (reference: util/metrics.py Metric)."""
+
+    TYPE = "untyped"
+
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+        if not name:
+            raise ValueError("metric name is required")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        with _lock:
+            _registry.append(self)
+        _ensure_flusher()
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]):
+        if self._default_tags:
+            out = dict(self._default_tags)
+            out.update(tags or {})
+            return out
+        return tags
+
+    # -- flush protocol -----------------------------------------------------
+    def _drain(self) -> List[tuple]:
+        """Return (name, type, desc, tags, payload) records and reset deltas."""
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    TYPE = "counter"
+
+    def __init__(self, name, description="", tag_keys=()):
+        self._deltas: Dict[tuple, float] = {}
+        super().__init__(name, description, tag_keys)
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("Counter.inc() requires a non-negative value")
+        key = _tags_key(self._merged(tags))
+        with _lock:
+            self._deltas[key] = self._deltas.get(key, 0.0) + value
+
+    def _drain(self):
+        with _lock:
+            out, self._deltas = self._deltas, {}
+        return [(self.name, self.TYPE, self.description, k, v) for k, v in out.items()]
+
+
+class Gauge(Metric):
+    TYPE = "gauge"
+
+    def __init__(self, name, description="", tag_keys=()):
+        self._values: Dict[tuple, float] = {}
+        super().__init__(name, description, tag_keys)
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with _lock:
+            self._values[_tags_key(self._merged(tags))] = float(value)
+
+    def _drain(self):
+        with _lock:
+            out = dict(self._values)
+        return [(self.name, self.TYPE, self.description, k, v) for k, v in out.items()]
+
+
+class Histogram(Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name, description="", boundaries: Sequence[float] = (), tag_keys=()):
+        if not boundaries:
+            raise ValueError("Histogram requires boundaries")
+        self.boundaries = sorted(float(b) for b in boundaries)
+        self._state: Dict[tuple, list] = {}  # tags -> [bucket_counts..., sum, count]
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tags_key(self._merged(tags))
+        with _lock:
+            st = self._state.get(key)
+            if st is None:
+                st = self._state[key] = [0] * (len(self.boundaries) + 1) + [0.0, 0]
+            i = 0
+            while i < len(self.boundaries) and value > self.boundaries[i]:
+                i += 1
+            st[i] += 1
+            st[-2] += value
+            st[-1] += 1
+
+    def _drain(self):
+        with _lock:
+            out, self._state = self._state, {}
+        return [
+            (self.name, self.TYPE, self.description, k, {"boundaries": self.boundaries, "state": v})
+            for k, v in out.items()
+        ]
+
+
+_unflushed: List[tuple] = []  # drained records a failed report must not lose
+
+
+def _flush_once() -> bool:
+    global _unflushed
+    from ray_tpu.core import api
+
+    core = api._global_worker
+    if core is None:
+        return False
+    with _lock:
+        metrics = list(_registry)
+        records, _unflushed = _unflushed, []
+    for m in metrics:
+        records.extend(m._drain())
+    if records:
+        try:
+            core._call("metrics_report", records)
+        except Exception:
+            # Re-queue so counter deltas survive transient controller
+            # hiccups (bounded: keep the newest ~10k records).
+            with _lock:
+                _unflushed = (records + _unflushed)[-10000:]
+            return False
+    return True
+
+
+def _ensure_flusher():
+    global _flusher_started
+    with _lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+
+    def loop():
+        from ray_tpu.config import get_config
+
+        interval = get_config().metrics_report_interval_ms / 1000.0
+        while True:
+            time.sleep(interval)
+            _flush_once()
+
+    threading.Thread(target=loop, daemon=True, name="metrics-flush").start()
+
+
+def flush():
+    """Force a synchronous flush (tests / process exit)."""
+    _flush_once()
+
+
+# ---------------------------------------------------------------------------
+def prometheus_text(snapshot: Dict) -> str:
+    """Render a controller metrics snapshot in Prometheus exposition format."""
+    lines = []
+    for name, entry in sorted(snapshot.items()):
+        mtype, desc, series = entry["type"], entry["description"], entry["series"]
+        if desc:
+            lines.append(f"# HELP {name} {desc}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for tags, value in series:
+            label = (
+                "{" + ",".join(f'{k}="{v}"' for k, v in tags) + "}" if tags else ""
+            )
+            if mtype == "histogram":
+                bounds = value["boundaries"]
+                st = value["state"]
+                cum = 0
+                for i, b in enumerate(bounds):
+                    cum += st[i]
+                    ltags = dict(tags)
+                    ltags["le"] = str(b)
+                    lab = "{" + ",".join(f'{k}="{v}"' for k, v in sorted(ltags.items())) + "}"
+                    lines.append(f"{name}_bucket{lab} {cum}")
+                cum += st[len(bounds)]
+                inf = dict(tags)
+                inf["le"] = "+Inf"
+                lab = "{" + ",".join(f'{k}="{v}"' for k, v in sorted(inf.items())) + "}"
+                lines.append(f"{name}_bucket{lab} {cum}")
+                lines.append(f"{name}_sum{label} {st[-2]}")
+                lines.append(f"{name}_count{label} {st[-1]}")
+            else:
+                lines.append(f"{name}{label} {value}")
+    return "\n".join(lines) + "\n"

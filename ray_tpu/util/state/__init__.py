@@ -1,0 +1,183 @@
+"""State API: cluster introspection for users and tools.
+
+Reference: python/ray/util/state/api.py (:551-1431 — list_*/get_*/
+summarize_*/get_log/list_logs) backed by the dashboard StateHead; here the
+queries go straight to the controller (which is also reachable over HTTP at
+``/api/v0/<resource>`` — ray_tpu/core/http_gateway.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter as _Counter
+from typing import List, Optional
+
+from ray_tpu.core.api import _require_worker
+
+
+def _list(what: str, **kwargs) -> List[dict]:
+    return _require_worker().list_state(what, **kwargs)
+
+
+def list_nodes() -> List[dict]:
+    return _list("nodes")
+
+
+def list_workers() -> List[dict]:
+    return _list("workers")
+
+
+def list_tasks(limit: int = 1000) -> List[dict]:
+    return _list("tasks", limit=limit)
+
+
+def list_actors() -> List[dict]:
+    return _list("actors")
+
+
+def list_objects(limit: int = 1000) -> List[dict]:
+    return _list("objects", limit=limit)
+
+
+def list_placement_groups() -> List[dict]:
+    return _require_worker().pg_table()
+
+
+def list_cluster_events(limit: int = 10000) -> List[dict]:
+    return _list("events", limit=limit)
+
+
+def get_task(task_id: str) -> Optional[dict]:
+    for t in list_tasks(limit=100000):
+        if t["task_id"] == task_id:
+            return t
+    return None
+
+
+def get_actor(actor_id: str) -> Optional[dict]:
+    for a in list_actors():
+        if a["actor_id"] == actor_id:
+            return a
+    return None
+
+
+def get_node(node_id: str) -> Optional[dict]:
+    for n in list_nodes():
+        if n["node_id"] == node_id:
+            return n
+    return None
+
+
+def get_worker(worker_id: str) -> Optional[dict]:
+    for w in list_workers():
+        if w["worker_id"] == worker_id:
+            return w
+    return None
+
+
+def get_placement_group(pg_id: str) -> Optional[dict]:
+    for pg in list_placement_groups():
+        if pg.get("placement_group_id") == pg_id or pg.get("id") == pg_id:
+            return pg
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Summaries (reference: api.py summarize_tasks/actors/objects)
+# ---------------------------------------------------------------------------
+def summarize_tasks() -> dict:
+    by = _Counter()
+    for t in list_tasks(limit=100000):
+        by[(t["name"], t["state"])] += 1
+    out: dict = {}
+    for (name, state), n in sorted(by.items()):
+        out.setdefault(name, {})[state] = n
+    return out
+
+
+def summarize_actors() -> dict:
+    by = _Counter()
+    for a in list_actors():
+        by[a["state"]] += 1
+    return dict(by)
+
+
+def summarize_objects() -> dict:
+    objs = list_objects(limit=100000)
+    return {
+        "total": len(objs),
+        "total_size": sum(o["size"] or 0 for o in objs),
+        "by_state": dict(_Counter(o["state"] for o in objs)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Logs (reference: api.py get_log :1262 / list_logs)
+# ---------------------------------------------------------------------------
+def _logs_dir() -> str:
+    return os.path.join(_require_worker().session_dir, "logs")
+
+
+def list_logs() -> List[str]:
+    d = _logs_dir()
+    return sorted(os.listdir(d)) if os.path.isdir(d) else []
+
+
+def get_log(filename: str, tail: int = 1000) -> str:
+    path = os.path.join(_logs_dir(), filename)
+    root = os.path.realpath(_logs_dir())
+    if os.path.commonpath([os.path.realpath(path), root]) != root:
+        raise ValueError("log path escapes the session log dir")
+    with open(path, errors="replace") as f:
+        lines = f.readlines()
+    return "".join(lines[-tail:])
+
+
+# ---------------------------------------------------------------------------
+# Metrics + timeline
+# ---------------------------------------------------------------------------
+def metrics_snapshot() -> dict:
+    return _require_worker()._call("metrics_snapshot")
+
+
+def dashboard_url() -> Optional[str]:
+    port_file = os.path.join(_require_worker().session_dir, "dashboard_port")
+    if not os.path.exists(port_file):
+        return None
+    with open(port_file) as f:
+        return f"http://127.0.0.1:{f.read().strip()}"
+
+
+def timeline_chrome(filename: Optional[str] = None) -> list:
+    """Chrome-trace (catapult) JSON from the task event buffer.
+
+    Reference: `ray timeline` → chrome_tracing_dump
+    (python/ray/_private/state.py:438). Pair RUNNING→FINISHED/FAILED
+    transitions into complete ("ph":"X") events, bucketed by node/worker.
+    """
+    events = list_cluster_events(limit=1000000)
+    open_spans: dict = {}
+    trace = []
+    for ev in events:
+        key = ev["task_id"]
+        state = ev["state"]
+        if state == "RUNNING":
+            open_spans[key] = ev
+        elif state in ("FINISHED", "FAILED") and key in open_spans:
+            start = open_spans.pop(key)
+            trace.append(
+                {
+                    "cat": "task",
+                    "name": ev["name"],
+                    "ph": "X",
+                    "ts": start["ts"] * 1e6,
+                    "dur": (ev["ts"] - start["ts"]) * 1e6,
+                    "pid": ev.get("node_id", "cluster"),
+                    "tid": ev.get("worker_id", ev["task_id"][:8]),
+                    "args": {"task_id": key, "outcome": state},
+                }
+            )
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

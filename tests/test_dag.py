@@ -219,6 +219,20 @@ def test_compiled_pipelined_executions(ray_start_regular):
         compiled.teardown()
 
 
+def test_compiled_execute_past_ring_capacity(ray_start_regular):
+    """More in-flight executes than ring slots must not deadlock: execute()
+    drains finished rows into the result buffer."""
+    a = Worker.remote()
+    with InputNode() as inp:
+        dag = a.double.bind(inp)
+    compiled = dag.experimental_compile(max_inflight=2)
+    try:
+        refs = [compiled.execute(i) for i in range(10)]
+        assert [r.get(timeout=10) for r in refs] == [2 * i for i in range(10)]
+    finally:
+        compiled.teardown()
+
+
 def test_compiled_error_propagation(ray_start_regular):
     a, b = Worker.remote(), Worker.remote()
     with InputNode() as inp:

@@ -1,0 +1,109 @@
+"""Job submission + CLI.
+
+Reference test models: python/ray/dashboard/modules/job/tests/,
+python/ray/tests/test_cli.py.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job import JobStatus, JobSubmissionClient
+
+
+def test_job_lifecycle(ray_start_regular, tmp_path):
+    script = tmp_path / "driver.py"
+    script.write_text(
+        "import ray_tpu\n"
+        "ray_tpu.init(address='auto')\n"
+        "@ray_tpu.remote\n"
+        "def f(x):\n"
+        "    return 2 * x\n"
+        "print('RESULT', ray_tpu.get(f.remote(21)))\n"
+    )
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"{sys.executable} {script}")
+    assert client.wait_until_finished(job_id, timeout=120) == JobStatus.SUCCEEDED
+    logs = client.get_job_logs(job_id)
+    assert "RESULT 42" in logs
+    jobs = client.list_jobs()
+    assert any(j["job_id"] == job_id for j in jobs)
+
+
+def test_job_failure_and_env(ray_start_regular, tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import os, sys\nprint('VAR', os.environ.get('MY_VAR'))\nsys.exit(3)\n")
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} {script}",
+        runtime_env={"env_vars": {"MY_VAR": "hello"}},
+    )
+    assert client.wait_until_finished(job_id, timeout=60) == JobStatus.FAILED
+    info = client.get_job_info(job_id)
+    assert "exit code 3" in info["message"]
+    assert "VAR hello" in client.get_job_logs(job_id)
+
+
+def test_job_stop(ray_start_regular):
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+    deadline = time.monotonic() + 30
+    while client.get_job_status(job_id) == JobStatus.PENDING:
+        assert time.monotonic() < deadline
+        time.sleep(0.1)
+    assert client.stop_job(job_id)
+    assert client.wait_until_finished(job_id, timeout=30) == JobStatus.STOPPED
+
+
+def _cli(*args, env=None):
+    e = dict(os.environ)
+    e["JAX_PLATFORMS"] = "cpu"
+    if env:
+        e.update(env)
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", *args],
+        capture_output=True,
+        text=True,
+        env=e,
+        timeout=180,
+        cwd="/root/repo",
+    )
+
+
+def test_cli_start_status_submit_stop(tmp_path):
+    tmp = str(tmp_path / "rt")
+    env = {"RAY_TPU_TMPDIR": tmp}
+    r = _cli("start", "--head", "--num-cpus", "2", env=env)
+    assert r.returncode == 0, r.stderr
+    assert "started head at" in r.stdout
+    try:
+        r = _cli("status", env=env)
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert "CPU" in r.stdout
+
+        script = tmp_path / "ok.py"
+        script.write_text("print('ran fine')\n")
+        r = _cli("submit", "--", sys.executable, str(script), env=env)
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert "ran fine" in r.stdout
+        assert "SUCCEEDED" in r.stdout
+
+        r = _cli("summary", "tasks", env=env)
+        assert r.returncode == 0
+        json.loads(r.stdout)
+    finally:
+        r = _cli("stop", env=env)
+    assert r.returncode == 0
+    assert "cluster stopped" in r.stdout
+
+
+def test_cli_microbenchmark_smoke():
+    r = _cli("microbenchmark")
+    assert r.returncode == 0, r.stderr + r.stdout
+    results = json.loads(r.stdout[r.stdout.index("{") :])
+    assert results["tasks_per_s"] > 10
+    assert results["put_get_GiB_per_s"] > 0.1

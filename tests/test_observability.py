@@ -1,0 +1,150 @@
+"""State API, app metrics, Prometheus endpoint, chrome timeline.
+
+Reference test models: python/ray/tests/test_state_api.py,
+test_metrics_agent.py.
+"""
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state as state_api
+from ray_tpu.util.metrics import Counter, Gauge, Histogram, flush, prometheus_text
+
+
+def _http_get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read()
+
+
+def test_list_state(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    ray_tpu.get([f.remote(i) for i in range(3)] + [a.ping.remote()])
+
+    nodes = state_api.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["is_head"]
+    workers = state_api.list_workers()
+    assert len(workers) >= 1
+    tasks = state_api.list_tasks()
+    assert sum(1 for t in tasks if t["name"] == "f") == 3
+    actors = state_api.list_actors()
+    assert len(actors) == 1 and actors[0]["state"] == "ALIVE"
+    assert state_api.get_actor(actors[0]["actor_id"])["actor_id"] == actors[0]["actor_id"]
+
+    summary = state_api.summarize_tasks()
+    assert summary["f"]["FINISHED"] == 3
+    assert state_api.summarize_actors()["ALIVE"] == 1
+    objs = state_api.summarize_objects()
+    assert objs["total"] >= 1
+
+    logs = state_api.list_logs()
+    assert any("controller" in l for l in logs)
+    assert isinstance(state_api.get_log("controller.log"), str)
+    with pytest.raises(ValueError):
+        state_api.get_log("../../etc/passwd")
+
+
+def test_metrics_flow(ray_start_regular):
+    c = Counter("test_requests_total", "requests", ("method",))
+    c.inc(3, {"method": "GET"})
+    c.inc(2, {"method": "POST"})
+    g = Gauge("test_queue_depth")
+    g.set(7)
+    h = Histogram("test_latency_s", boundaries=[0.01, 0.1, 1.0])
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    flush()
+    snap = state_api.metrics_snapshot()
+    assert snap["test_requests_total"]["type"] == "counter"
+    series = dict((tuple(map(tuple, k)), v) for k, v in snap["test_requests_total"]["series"])
+    assert series[(("method", "GET"),)] == 3
+    assert snap["test_queue_depth"]["series"][0][1] == 7
+    hseries = snap["test_latency_s"]["series"][0][1]
+    assert hseries["state"][-1] == 4  # count
+    # Counters accumulate across flushes.
+    c.inc(1, {"method": "GET"})
+    flush()
+    snap = state_api.metrics_snapshot()
+    series = dict((tuple(map(tuple, k)), v) for k, v in snap["test_requests_total"]["series"])
+    assert series[(("method", "GET"),)] == 4
+
+
+def test_metrics_from_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def work():
+        from ray_tpu.util.metrics import Counter, flush
+
+        c = Counter("task_side_total")
+        c.inc(5)
+        flush()
+        return True
+
+    assert ray_tpu.get(work.remote())
+    snap = state_api.metrics_snapshot()
+    assert snap["task_side_total"]["series"][0][1] == 5
+
+
+def test_http_gateway(ray_start_regular):
+    url = state_api.dashboard_url()
+    assert url is not None
+    assert _http_get(url + "/healthz") == b"ok"
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    nodes = json.loads(_http_get(url + "/api/v0/nodes"))
+    assert nodes[0]["is_head"]
+    tasks = json.loads(_http_get(url + "/api/v0/tasks"))
+    assert any(t["name"] == "f" for t in tasks)
+
+    Counter("gw_metric_total").inc(2)
+    flush()
+    text = _http_get(url + "/metrics").decode()
+    assert "# TYPE gw_metric_total counter" in text
+    assert "gw_metric_total 2" in text.replace("{} ", " ")
+
+
+def test_prometheus_text_histogram():
+    snap = {
+        "lat": {
+            "type": "histogram",
+            "description": "d",
+            "series": [
+                ((), {"boundaries": [1.0, 2.0], "state": [1, 2, 3, 9.5, 6]}),
+            ],
+        }
+    }
+    text = prometheus_text(snap)
+    assert 'lat_bucket{le="1.0"} 1' in text
+    assert 'lat_bucket{le="2.0"} 3' in text
+    assert 'lat_bucket{le="+Inf"} 6' in text
+    assert "lat_sum 9.5" in text
+    assert "lat_count 6" in text
+
+
+def test_timeline_chrome(ray_start_regular, tmp_path):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([slow.remote() for _ in range(3)])
+    out = tmp_path / "trace.json"
+    trace = state_api.timeline_chrome(str(out))
+    spans = [t for t in trace if t["name"] == "slow"]
+    assert len(spans) == 3
+    assert all(t["ph"] == "X" and t["dur"] > 0 for t in spans)
+    assert json.loads(out.read_text())
