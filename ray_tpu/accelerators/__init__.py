@@ -1,0 +1,25 @@
+"""Accelerator managers: detection, isolation, TPU pod topology.
+
+Reference: python/ray/_private/accelerators/ — per-vendor
+``AcceleratorManager`` subclasses; the rebuild keeps the registry but TPU
+is the first-class citizen (reference: accelerators/tpu.py:71
+TPUAcceleratorManager).
+"""
+from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+_managers = {"TPU": TPUAcceleratorManager()}
+
+
+def get_accelerator_manager(resource_name: str):
+    return _managers.get(resource_name)
+
+
+def register_accelerator_manager(resource_name: str, manager):
+    _managers[resource_name] = manager
+
+
+__all__ = [
+    "TPUAcceleratorManager",
+    "get_accelerator_manager",
+    "register_accelerator_manager",
+]

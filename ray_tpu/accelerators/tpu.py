@@ -1,0 +1,112 @@
+"""TPUAcceleratorManager.
+
+Reference: python/ray/_private/accelerators/tpu.py:71 —
+- chip detection via /dev/accel* and vfio (:98-117)
+- ``TPU_VISIBLE_CHIPS`` isolation (:155-195) with valid per-host chip
+  counts {1, 2, 4, 8} (:14 TPU_VALID_CHIP_OPTIONS)
+- GCE/GKE metadata pod-type lookup (:198-228)
+- pod-slice resources: ``TPU-<pod_type>-head`` on worker 0 and a
+  ``TPU-<pod_type>`` name resource on every pod host (:334-397) so
+  STRICT_PACK placement groups gang-schedule whole slices.
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Optional
+
+TPU_VALID_CHIP_OPTIONS = (1, 2, 4, 8)
+TPU_VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+TPU_ACCELERATOR_TYPE_ENV = "TPU_ACCELERATOR_TYPE"  # e.g. "v5p-64"
+TPU_WORKER_ID_ENV = "TPU_WORKER_ID"
+GCE_METADATA_URL = "http://metadata.google.internal/computeMetadata/v1/instance/attributes/"
+
+
+class TPUAcceleratorManager:
+    resource_name = "TPU"
+
+    # -- detection ----------------------------------------------------------
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        """Count local chips (reference: tpu.py:98-117)."""
+        n = len(glob.glob("/dev/accel*"))
+        if n == 0:
+            entries = glob.glob("/dev/vfio/*")
+            n = max(len([e for e in entries if not e.endswith("/vfio")]), 0)
+        return n
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> Optional[str]:
+        """Pod type, e.g. "v5p-64": env override first, then GCE metadata
+        (reference: tpu.py:198-228 — metadata lookup with env fallbacks)."""
+        env = os.environ.get(TPU_ACCELERATOR_TYPE_ENV)
+        if env:
+            return env
+        try:
+            import urllib.request
+
+            req = urllib.request.Request(
+                GCE_METADATA_URL + "accelerator-type",
+                headers={"Metadata-Flavor": "Google"},
+            )
+            with urllib.request.urlopen(req, timeout=1) as r:
+                return r.read().decode().strip()
+        except Exception:  # noqa: BLE001 — not on GCE
+            return None
+
+    @staticmethod
+    def get_current_node_tpu_worker_id() -> int:
+        return int(os.environ.get(TPU_WORKER_ID_ENV, "0"))
+
+    # -- isolation ----------------------------------------------------------
+    @staticmethod
+    def validate_resource_request_quantity(quantity: float) -> tuple[bool, str]:
+        """Per-host chip requests must be 1/2/4/8 (reference: tpu.py:140)."""
+        if quantity in TPU_VALID_CHIP_OPTIONS or quantity % 8 == 0:
+            return True, ""
+        return False, (
+            f"num_tpus must be one of {TPU_VALID_CHIP_OPTIONS} per host "
+            f"(or a multiple of 8 for multi-host slices); got {quantity}"
+        )
+
+    @staticmethod
+    def set_current_process_visible_accelerators(chip_ids: List[int]):
+        """TPU_VISIBLE_CHIPS must be set before the first jax import in the
+        process (libtpu reads it at initialization)."""
+        os.environ[TPU_VISIBLE_CHIPS_ENV] = ",".join(str(i) for i in chip_ids)
+
+    @staticmethod
+    def get_current_process_visible_accelerator_ids() -> Optional[List[int]]:
+        raw = os.environ.get(TPU_VISIBLE_CHIPS_ENV)
+        if raw is None or raw == "":
+            return None
+        return [int(x) for x in raw.split(",")]
+
+    # -- pod topology resources --------------------------------------------
+    @staticmethod
+    def get_current_node_additional_resources() -> Dict[str, float]:
+        """Slice-topology resources for this host (reference: tpu.py:334-397).
+
+        Every host of pod slice "v5p-64" gets ``TPU-v5p-64: 1``; host 0
+        additionally gets ``TPU-v5p-64-head: 1``. A STRICT_PACK PG on the
+        head resource + per-host name resources gang-reserves the slice.
+        """
+        pod_type = TPUAcceleratorManager.get_current_node_accelerator_type()
+        if not pod_type:
+            return {}
+        out = {f"TPU-{pod_type}": 1.0}
+        if TPUAcceleratorManager.get_current_node_tpu_worker_id() == 0:
+            out[f"TPU-{pod_type}-head"] = 1.0
+        return out
+
+    @staticmethod
+    def num_hosts_in_slice(pod_type: str) -> int:
+        """e.g. v5p-64 → 64 chips / 4 chips-per-host = 16... chips-per-host
+        varies by generation; v5e=8 (1 host unit), v4/v5p=4."""
+        try:
+            gen, chips = pod_type.split("-")
+            chips = int(chips)
+        except ValueError:
+            return 1
+        per_host = 8 if gen in ("v5litepod", "v5e", "v6e") else 4
+        return max(1, chips // per_host)

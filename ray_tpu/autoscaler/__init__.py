@@ -1,0 +1,26 @@
+"""Autoscaler: demand-driven node provisioning.
+
+Reference: python/ray/autoscaler/_private/ — ``StandardAutoscaler``
+(autoscaler.py:172) + ``Monitor`` loop (monitor.py:126), ``NodeProvider``
+plugins, bin-packing ``resource_demand_scheduler.py``, and the
+``FakeMultiNodeProvider`` (fake_multi_node/node_provider.py:236) that tests
+the whole loop without a cloud.
+
+Rebuild: the same three pieces — a :class:`NodeProvider` interface, a
+:class:`FakeMultiNodeProvider` that spawns real node-agent processes on
+localhost (so the "provisioned" nodes actually join the cluster), and a
+:class:`StandardAutoscaler` loop that reads unmet demand from the
+controller (``rpc_resource_demand``), bin-packs it onto node types, and
+launches/terminates nodes. TPU slices are node types whose resources carry
+``TPU`` + a slice-head marker, so STRICT_PACK TPU placement groups drive
+whole-slice scale-up (SURVEY §7 step 3).
+"""
+from ray_tpu.autoscaler.node_provider import FakeMultiNodeProvider, NodeProvider
+from ray_tpu.autoscaler.autoscaler import AutoscalingCluster, StandardAutoscaler
+
+__all__ = [
+    "NodeProvider",
+    "FakeMultiNodeProvider",
+    "StandardAutoscaler",
+    "AutoscalingCluster",
+]

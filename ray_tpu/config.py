@@ -59,6 +59,12 @@ class Config:
     idle_worker_killing_time_s: float = 300.0
     maximum_startup_concurrency: int = 8
 
+    # --- memory / OOM (reference: memory_monitor.h, ray_config_def.h
+    # memory_usage_threshold / memory_monitor_refresh_ms) ---
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_ms: int = 250  # 0 disables the monitor
+    worker_killing_policy: str = "retriable_fifo"  # or "group_by_owner"
+
     # --- fault tolerance ---
     health_check_period_s: float = 1.0
     health_check_failure_threshold: int = 5

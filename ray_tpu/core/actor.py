@@ -59,9 +59,15 @@ class ActorClass:
         actor_id = ActorID.from_random()
         args_blob, deps = core.build_args(args, kwargs)
         res_opts = dict(opts)
+        # Explicit resource requests are held while the actor lives; the
+        # default 1 CPU is for scheduling only (reference: actor.py).
+        hold = (
+            res_opts["num_cpus"] is not None
+            or bool(res_opts["num_tpus"])
+            or bool(res_opts["memory"])
+            or bool(res_opts["resources"])
+        )
         if res_opts["num_cpus"] is None:
-            # Default: 1 CPU for scheduling, 0 held while alive (reference:
-            # actor.py default num_cpus semantics).
             res_opts["num_cpus"] = 1
         runtime_env = dict(opts.get("runtime_env") or {})
         if opts.get("name"):
@@ -84,6 +90,7 @@ class ActorClass:
             max_task_retries=opts["max_task_retries"],
             max_concurrency=opts["max_concurrency"],
             runtime_env=runtime_env,
+            hold_resources_while_alive=hold,
         )
         core.create_actor(spec)
         return ActorHandle(actor_id, max_task_retries=opts["max_task_retries"])
@@ -169,6 +176,7 @@ class ActorMethod:
         from ray_tpu.core.api import _require_worker
 
         core = _require_worker()
+        streaming = self._num_returns == "streaming"
         args_blob, deps = core.build_args(args, kwargs)
         spec = TaskSpec(
             task_id=TaskID.from_random(),
@@ -178,7 +186,7 @@ class ActorMethod:
             func_blob=None,
             args_blob=args_blob,
             dependencies=deps,
-            num_returns=self._num_returns,
+            num_returns=TaskSpec.STREAMING if streaming else self._num_returns,
             resources=build_resource_set({}),
             owner_id=core.worker_id,
             max_retries=self._handle._max_task_retries,
@@ -186,6 +194,10 @@ class ActorMethod:
             actor_method_name=self._name,
         )
         refs = core.submit_actor_task(spec)
+        if streaming:
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id)
         return refs[0] if self._num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

@@ -82,7 +82,7 @@ class CoreWorker:
             self._call("object_put_inline", oid, data, is_error)
         else:
             self.plasma.put_bytes(oid, data)
-            self._call("object_put_shm", oid, len(data), self.node_id)
+            self._call("object_put_shm", oid, len(data), self.node_id, is_error)
 
     def get(self, refs: Sequence[ObjectRef] | ObjectRef, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)

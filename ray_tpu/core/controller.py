@@ -38,9 +38,11 @@ from ray_tpu.core.task_spec import SchedulingStrategy, TaskSpec, TaskType
 from ray_tpu.exceptions import (
     ActorDiedError,
     ObjectLostError,
+    OutOfMemoryError,
     TaskCancelledError,
     WorkerCrashedError,
 )
+from ray_tpu.runtime_env import env_hash as _env_hash
 from ray_tpu.utils import rpc
 from ray_tpu.utils.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
 
@@ -78,6 +80,10 @@ class WorkerRecord:
     state: str = "IDLE"  # STARTING | IDLE | LEASED | ACTOR | DEAD
     running: Set[TaskID] = field(default_factory=set)
     actor_id: Optional[ActorID] = None
+    oom_marked: bool = False  # killed by the memory monitor
+    # Runtime-env hash this worker is locked to ("" = pristine). Reference:
+    # worker_pool keys idle workers by runtime-env hash (worker_pool.h:174).
+    env_hash: str = ""
 
 
 @dataclass
@@ -90,6 +96,10 @@ class NodeRecord:
     workers: Set[WorkerID] = field(default_factory=set)
     num_starting: int = 0
     max_workers: int = 32
+    # Free TPU chip indices on this host; actors holding TPU resources get
+    # concrete chips via TPU_VISIBLE_CHIPS (reference: accelerators/tpu.py
+    # :155-195 isolation + resource_instance_set.cc per-instance accounting).
+    tpu_free: List[int] = field(default_factory=list)
 
 
 @dataclass
@@ -101,6 +111,11 @@ class TaskRecord:
     retries_left: int = 0
     acquired: Optional[ResourceSet] = None
     submitted_at: float = field(default_factory=time.time)
+    # Streaming-generator progress (reference: ObjectRefStream,
+    # src/ray/core_worker/task_manager.cc streaming-generator returns).
+    stream_count: int = 0
+    stream_done: bool = False
+    stream_waiters: List[asyncio.Future] = field(default_factory=list)
 
 
 @dataclass
@@ -114,6 +129,11 @@ class ActorRecord:
     restarts_left: int = 0
     num_restarts: int = 0
     death_reason: str = ""
+    tpu_chips: List[int] = field(default_factory=list)
+    tpu_node: Optional[NodeID] = None
+    # Resources held for the actor's lifetime (explicit requests only).
+    held_resources: Optional[ResourceSet] = None
+    held_node: Optional[NodeID] = None
     # Tasks queued while the actor is not ALIVE.
     pending_tasks: List[TaskSpec] = field(default_factory=list)
     ready_waiters: List[asyncio.Future] = field(default_factory=list)
@@ -156,6 +176,9 @@ class Controller:
         )
         ncpu = int(head_resources.get("CPU", 1))
         self.nodes[self.head_node_id].max_workers = max(4 * max(ncpu, 1), 16)
+        self.nodes[self.head_node_id].tpu_free = list(
+            range(int(head_resources.get("TPU", 0)))
+        )
         self._head_prestart = max(ncpu, 1) if config.prestart_workers else 0
 
     # =================================================================
@@ -207,6 +230,7 @@ class Controller:
         ncpu = int(resources.get("CPU", 1))
         rec = NodeRecord(node_id=node_id, shm_dir=shm_dir, peer=peer)
         rec.max_workers = max(4 * max(ncpu, 1), 16)
+        rec.tpu_free = list(range(int(resources.get("TPU", 0))))
         self.nodes[node_id] = rec
         self.pg_manager.retry_pending()
         self._schedule_pump()
@@ -231,15 +255,33 @@ class Controller:
         else:
             await node.peer.notify("start_workers", n)
 
-    def _idle_worker_on(self, node_id: NodeID) -> Optional[WorkerRecord]:
+    async def _recycle_idle_worker(self, node: NodeRecord, wanted_hash: str):
+        """Retire one idle worker whose env differs from ``wanted_hash`` so
+        a replacement (pristine) worker can be spawned."""
+        for wid in list(node.workers):
+            w = self.workers.get(wid)
+            if w is not None and w.state == "IDLE" and w.env_hash != wanted_hash:
+                w.state = "DEAD"
+                try:
+                    await w.peer.notify("exit")
+                except Exception:  # noqa: BLE001
+                    pass
+                return
+
+    def _idle_worker_on(self, node_id: NodeID, env_hash: str = "") -> Optional[WorkerRecord]:
         node = self.nodes.get(node_id)
         if node is None:
             return None
+        fallback = None
         for wid in node.workers:
             w = self.workers.get(wid)
-            if w is not None and w.state == "IDLE":
-                return w
-        return None
+            if w is None or w.state != "IDLE":
+                continue
+            if w.env_hash == env_hash:
+                return w  # exact env match (incl. pristine↔pristine)
+            if env_hash and w.env_hash == "" and fallback is None:
+                fallback = w  # pristine worker can adopt the env
+        return fallback
 
     # =================================================================
     # Task submission / scheduling pump
@@ -364,10 +406,16 @@ class Controller:
             if result.node_id is None:
                 still_pending.append(tid)
                 continue
-            # 3. idle worker?
-            worker = self._idle_worker_on(result.node_id)
+            # 3. idle worker (env-affine)?
+            ehash = _env_hash(spec.runtime_env)
+            worker = self._idle_worker_on(result.node_id, ehash)
             if worker is None:
                 node = self.nodes[result.node_id]
+                if len(node.workers) + node.num_starting >= node.max_workers:
+                    # Pool full of env-mismatched idle workers: recycle one
+                    # so this env can get a worker (reference: idle worker
+                    # killing frees pool slots for other runtime envs).
+                    await self._recycle_idle_worker(node, ehash)
                 spawn_requests[result.node_id] = spawn_requests.get(result.node_id, 0) + 1
                 still_pending.append(tid)
                 continue
@@ -381,12 +429,14 @@ class Controller:
             rec.worker_id = worker.worker_id
             rec.state = "DISPATCHED"
             worker.running.add(tid)
+            worker.env_hash = ehash or worker.env_hash
             if spec.task_type == TaskType.ACTOR_CREATION_TASK:
                 worker.state = "ACTOR"
                 worker.actor_id = spec.actor_id
                 actor = self.actors[spec.actor_id]
                 actor.worker_id = worker.worker_id
                 actor.node_id = result.node_id
+                self._assign_tpu_chips(actor, spec, self.nodes[result.node_id])
                 self._event("actor", spec, "CREATING")
                 await worker.peer.notify("create_actor", spec)
             else:
@@ -424,8 +474,24 @@ class Controller:
         worker = self.workers.get(rec.worker_id) if rec.worker_id else None
         if worker is not None:
             worker.running.discard(task_id)
-        # release resources
-        self._release_task(rec)
+        # Release resources — EXCEPT a successful creation of an actor with
+        # explicit resource requests, whose acquisition transfers to the
+        # actor until it dies (reference: actors hold requested resources).
+        if (
+            error is None
+            and spec.task_type == TaskType.ACTOR_CREATION_TASK
+            and spec.hold_resources_while_alive
+            and rec.acquired is not None
+        ):
+            actor = self.actors.get(spec.actor_id)
+            if actor is not None:
+                actor.held_resources = rec.acquired
+                actor.held_node = rec.node_id
+                rec.acquired = None
+            else:
+                self._release_task(rec)
+        else:
+            self._release_task(rec)
         if error is not None:
             retriable = rec.retries_left > 0 and (
                 spec.retry_exceptions or isinstance(error, (WorkerCrashedError,))
@@ -476,6 +542,14 @@ class Controller:
         # Return worker to pool.
         if worker is not None and worker.state == "LEASED":
             worker.state = "IDLE"
+        if rec.state in ("FINISHED", "FAILED"):
+            # End-of-stream only on terminal states — a retried streaming
+            # task must not signal a premature end to its consumers.
+            rec.stream_done = True
+            for fut in rec.stream_waiters:
+                if not fut.done():
+                    fut.set_result(True)
+            rec.stream_waiters.clear()
         self._schedule_pump()
         return True
 
@@ -501,6 +575,25 @@ class Controller:
         from ray_tpu.utils.serialization import serialize
 
         blob = serialize(error)
+        if spec.is_streaming:
+            # Streaming failure: the error becomes the stream's final item
+            # (reference: streaming generators surface mid-stream errors as
+            # the next yielded ref).
+            rec = self.tasks.get(spec.task_id)
+            if rec is not None:
+                oid = ObjectID.for_task_return(spec.task_id, rec.stream_count)
+                orec = self._object(oid)
+                orec.inline = blob
+                orec.is_error = True
+                orec.state = "READY"
+                self._wake(orec)
+                rec.stream_count += 1
+                rec.stream_done = True
+                for fut in rec.stream_waiters:
+                    if not fut.done():
+                        fut.set_result(True)
+                rec.stream_waiters.clear()
+            return
         for oid in spec.return_ids():
             orec = self._object(oid)
             orec.inline = blob
@@ -561,21 +654,65 @@ class Controller:
                     self.pending_tasks.append(tid)
                 else:
                     rec.state = "FAILED"
-                    self._fail_task_objects(
-                        spec,
-                        WorkerCrashedError(
+                    if worker.oom_marked:
+                        err = OutOfMemoryError(
+                            f"task killed by the memory monitor (node over "
+                            f"{self.config.memory_usage_threshold:.0%} memory)"
+                        )
+                    else:
+                        err = WorkerCrashedError(
                             f"worker {worker_id.hex()[:8]} died while running task ({reason})"
-                        ),
-                    )
+                        )
+                    self._fail_task_objects(spec, err)
         if worker.actor_id is not None:
             await self._on_actor_death(worker.actor_id, f"worker died: {reason}")
         self._schedule_pump()
+
+    def _assign_tpu_chips(self, actor: ActorRecord, spec: TaskSpec, node: NodeRecord):
+        """Give a TPU actor concrete chip indices via TPU_VISIBLE_CHIPS
+        (reference: tpu.py:155-195; per-instance accounting,
+        resource_instance_set.cc). Applied in-worker before jax loads."""
+        from ray_tpu.core.resources import from_fp
+
+        n = int(from_fp(spec.resources.get("TPU")))
+        if n <= 0:
+            return
+        if len(node.tpu_free) < n:
+            logger.warning(
+                "TPU accounting drift: actor wants %d chips, node %s has %d free",
+                n,
+                node.node_id.hex()[:8],
+                len(node.tpu_free),
+            )
+            return
+        chips, node.tpu_free = node.tpu_free[:n], node.tpu_free[n:]
+        actor.tpu_chips = chips
+        actor.tpu_node = node.node_id
+        renv = dict(spec.runtime_env or {})
+        env_vars = dict(renv.get("env_vars") or {})
+        env_vars["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in chips)
+        renv["env_vars"] = env_vars
+        spec.runtime_env = renv
+
+    def _release_tpu_chips(self, actor: ActorRecord):
+        if actor.tpu_chips and actor.tpu_node is not None:
+            node = self.nodes.get(actor.tpu_node)
+            if node is not None:
+                node.tpu_free.extend(actor.tpu_chips)
+        actor.tpu_chips = []
+        actor.tpu_node = None
 
     async def _on_actor_death(self, actor_id: ActorID, reason: str):
         actor = self.actors.get(actor_id)
         if actor is None or actor.state == "DEAD":
             return
         actor.worker_id = None
+        self._release_tpu_chips(actor)
+        if actor.held_resources is not None:
+            if actor.held_node in self.cluster.nodes:
+                self.cluster.nodes[actor.held_node].release(actor.held_resources)
+            actor.held_resources = None
+            actor.held_node = None
         if actor.restarts_left > 0:
             actor.restarts_left -= 1
             actor.num_restarts += 1
@@ -668,9 +805,12 @@ class Controller:
         self._wake(orec)
         return True
 
-    async def rpc_object_put_shm(self, peer: rpc.Peer, oid: ObjectID, size: int, node_id: NodeID):
+    async def rpc_object_put_shm(
+        self, peer: rpc.Peer, oid: ObjectID, size: int, node_id: NodeID, is_error: bool = False
+    ):
         orec = self._object(oid)
         orec.size = size
+        orec.is_error = is_error
         orec.locations.add(node_id)
         await self._account_object(node_id, oid, size)
         orec.state = "READY"
@@ -1004,6 +1144,52 @@ class Controller:
             for name, e in self.metrics.items()
         }
 
+    async def rpc_resource_demand(self, peer):
+        """Unmet demand for the autoscaler: resource sets of tasks that are
+        waiting for placement plus bundles of pending placement groups
+        (reference: SchedulerResourceReporter feeding the autoscaler via
+        GcsAutoscalerStateManager)."""
+        demand = []
+        for tid in self.pending_tasks:
+            rec = self.tasks.get(tid)
+            if rec is not None and rec.state == "PENDING":
+                demand.append(rec.spec.resources.to_dict())
+        pg_demand = []
+        for pg in self.pg_manager.pending_records():
+            pg_demand.append(
+                {"strategy": pg.strategy, "bundles": [b.to_dict() for b in pg.bundles]}
+            )
+        return {"tasks": demand, "placement_groups": pg_demand}
+
+    # =================================================================
+    # Streaming generators
+    # =================================================================
+    async def rpc_stream_item(self, peer, task_id: TaskID, index: int):
+        rec = self.tasks.get(task_id)
+        if rec is None:
+            return False
+        rec.stream_count = max(rec.stream_count, index + 1)
+        for fut in rec.stream_waiters:
+            if not fut.done():
+                fut.set_result(True)
+        rec.stream_waiters.clear()
+        return True
+
+    async def rpc_stream_next(self, peer, task_id: TaskID, index: int):
+        """Block until item `index` exists; "item" when available, None at
+        end-of-stream."""
+        while True:
+            rec = self.tasks.get(task_id)
+            if rec is None:
+                return None
+            if index < rec.stream_count:
+                return "item"
+            if rec.stream_done or rec.state in ("FAILED", "FINISHED"):
+                return "item" if index < rec.stream_count else None
+            fut = asyncio.get_running_loop().create_future()
+            rec.stream_waiters.append(fut)
+            await fut
+
     async def rpc_ping(self, peer):
         return "pong"
 
@@ -1026,8 +1212,94 @@ class Controller:
             del self.events[: len(self.events) // 2]
 
     # =================================================================
+    async def _memory_monitor_loop(self):
+        """Kill workers when host memory crosses the threshold (reference:
+        memory_monitor.h polling + worker_killing_policy victim choice).
+        All simulated nodes share this host, so one monitor here covers the
+        cluster; real multi-host deployments run this in each node agent."""
+        from ray_tpu.core.memory_monitor import POLICIES, KillCandidate, MemoryMonitor
+
+        monitor = MemoryMonitor(threshold=self.config.memory_usage_threshold)
+        policy = POLICIES.get(self.config.worker_killing_policy)
+        if policy is None:
+            logger.error(
+                "unknown worker_killing_policy %r; using retriable_fifo",
+                self.config.worker_killing_policy,
+            )
+            policy = POLICIES["retriable_fifo"]
+        interval = self.config.memory_monitor_refresh_ms / 1000.0
+        while not self._shutdown.is_set():
+            await asyncio.sleep(interval)
+            if not monitor.should_kill():
+                continue
+            candidates = []
+            for w in self.workers.values():
+                # This monitor measures THIS host's memory: only head-node
+                # workers (whose pids are local) are valid victims. Remote
+                # hosts run their own monitor in the node agent.
+                node = self.nodes.get(w.node_id)
+                if node is None or node.peer is not None:
+                    continue
+                if w.state == "LEASED" and w.running:
+                    tid = next(iter(w.running))
+                    rec = self.tasks.get(tid)
+                    if rec is None:
+                        continue
+                    candidates.append(
+                        KillCandidate(
+                            worker_id=w.worker_id.hex(),
+                            pid=w.pid,
+                            is_retriable=rec.retries_left > 0,
+                            start_time=rec.submitted_at,
+                            owner_id=rec.spec.owner_id.hex() if rec.spec.owner_id else "",
+                        )
+                    )
+                elif w.state == "ACTOR" and w.actor_id is not None:
+                    actor = self.actors.get(w.actor_id)
+                    if actor is None:
+                        continue
+                    candidates.append(
+                        KillCandidate(
+                            worker_id=w.worker_id.hex(),
+                            pid=w.pid,
+                            is_retriable=actor.restarts_left > 0,
+                            # Actors rank as oldest: tasks die before actors.
+                            start_time=0.0,
+                            owner_id=actor.creation_spec.owner_id.hex()
+                            if actor.creation_spec.owner_id
+                            else "",
+                        )
+                    )
+            victim = policy(candidates)
+            if victim is None:
+                continue
+            wid = WorkerID.from_hex(victim.worker_id)
+            w = self.workers.get(wid)
+            if w is None:
+                continue
+            logger.warning(
+                "memory monitor killing worker %s (pid %s, policy %s)",
+                victim.worker_id[:8],
+                victim.pid,
+                self.config.worker_killing_policy,
+            )
+            w.oom_marked = True
+            try:
+                os.kill(victim.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    await w.peer.notify("exit")
+                except Exception:
+                    pass
+
     async def run(self, port: int = 0):
         server, self.port = await rpc.serve(self, port=port)
+        if self.config.memory_monitor_refresh_ms > 0:
+            # Keep a strong ref: the loop holds tasks weakly and an
+            # unreferenced monitor could be garbage-collected mid-run.
+            self._monitor_task = asyncio.get_running_loop().create_task(
+                self._memory_monitor_loop()
+            )
         if self.config.dashboard_port >= 0:
             from ray_tpu.core.http_gateway import start_http_gateway
 

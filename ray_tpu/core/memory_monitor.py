@@ -1,0 +1,119 @@
+"""Memory monitor + OOM worker-killing policies.
+
+Reference: src/ray/common/memory_monitor.h:52 (cgroup/system usage
+polling) and src/ray/raylet/worker_killing_policy*.h — retriable-FIFO
+(default: prefer retriable work, newest first, so long-running
+non-retriable work survives) and group-by-owner (kill from the largest
+group of same-owner tasks to preserve diversity of progress).
+
+The node agent polls; on pressure it asks the controller (which knows
+task retriability) to nominate a victim, then SIGKILLs the worker. The
+controller marks the worker OOM so its task failure surfaces as
+``OutOfMemoryError`` rather than a generic crash.
+"""
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+_CGROUP_V2_CUR = "/sys/fs/cgroup/memory.current"
+_CGROUP_V2_MAX = "/sys/fs/cgroup/memory.max"
+_CGROUP_V1_CUR = "/sys/fs/cgroup/memory/memory.usage_in_bytes"
+_CGROUP_V1_MAX = "/sys/fs/cgroup/memory/memory.limit_in_bytes"
+
+
+def _read_int(path: str) -> Optional[int]:
+    try:
+        with open(path) as f:
+            raw = f.read().strip()
+        return None if raw == "max" else int(raw)
+    except (FileNotFoundError, ValueError, PermissionError):
+        return None
+
+
+def system_memory() -> Tuple[int, int]:
+    """(used_bytes, total_bytes), preferring cgroup limits (containers)."""
+    for cur_p, max_p in ((_CGROUP_V2_CUR, _CGROUP_V2_MAX), (_CGROUP_V1_CUR, _CGROUP_V1_MAX)):
+        cur, cap = _read_int(cur_p), _read_int(max_p)
+        if cur is not None and cap is not None and cap < (1 << 60):
+            return cur, cap
+    total = avail = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1]) * 1024
+    except FileNotFoundError:  # pragma: no cover - non-linux
+        return 0, 1
+    return total - avail, max(total, 1)
+
+
+class MemoryMonitor:
+    def __init__(
+        self,
+        threshold: float = 0.95,
+        reader: Callable[[], Tuple[int, int]] = system_memory,
+        min_kill_interval_s: float = 2.0,
+    ):
+        self.threshold = threshold
+        self.reader = reader
+        self.min_kill_interval_s = min_kill_interval_s
+        self._last_kill = 0.0
+
+    def usage_fraction(self) -> float:
+        used, total = self.reader()
+        return used / max(total, 1)
+
+    def should_kill(self) -> bool:
+        """True when above threshold and outside the kill cooldown."""
+        if self.usage_fraction() < self.threshold:
+            return False
+        now = time.monotonic()
+        if now - self._last_kill < self.min_kill_interval_s:
+            return False
+        self._last_kill = now
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Killing policies
+# ---------------------------------------------------------------------------
+@dataclass
+class KillCandidate:
+    worker_id: str
+    pid: int
+    is_retriable: bool
+    start_time: float
+    owner_id: str = ""
+
+
+def retriable_fifo_policy(candidates: List[KillCandidate]) -> Optional[KillCandidate]:
+    """Prefer retriable work; among equals kill the newest (reference:
+    worker_killing_policy_retriable_fifo.h:31 — last-in-first-killed so the
+    oldest, most-progressed work survives)."""
+    if not candidates:
+        return None
+    return max(candidates, key=lambda c: (c.is_retriable, c.start_time))
+
+
+def group_by_owner_policy(candidates: List[KillCandidate]) -> Optional[KillCandidate]:
+    """Kill the newest retriable task from the LARGEST owner group
+    (reference: worker_killing_policy_group_by_owner.h:85) — preserves
+    at least one task per owner making progress."""
+    if not candidates:
+        return None
+    groups: dict = {}
+    for c in candidates:
+        groups.setdefault(c.owner_id, []).append(c)
+    biggest = max(groups.values(), key=len)
+    return max(biggest, key=lambda c: (c.is_retriable, c.start_time))
+
+
+POLICIES = {
+    "retriable_fifo": retriable_fifo_policy,
+    "group_by_owner": group_by_owner_policy,
+}

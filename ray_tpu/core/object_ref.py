@@ -44,6 +44,42 @@ class ObjectRef:
         return _require_worker().get_async([self])
 
 
+class ObjectRefGenerator:
+    """Iterator over a streaming task's return refs, yielding each ref as
+    the producer yields (reference: _raylet.pyx:1077/:1206 streaming
+    generators + ObjectRefGenerator in python/ray/_raylet.pyx).
+
+    next() blocks until the producer has yielded the next item (or the
+    stream ends → StopIteration). Works from the driver or inside tasks.
+    """
+
+    def __init__(self, task_id):
+        self.task_id = task_id
+        self._index = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        from ray_tpu.core.api import _require_worker
+
+        status = _require_worker()._call("stream_next", self.task_id, self._index)
+        if status is None:
+            raise StopIteration
+        ref = ObjectRef(ObjectID.for_task_return(self.task_id, self._index))
+        self._index += 1
+        return ref
+
+    def __reduce__(self):
+        return (_rebuild_generator, (self.task_id, self._index))
+
+
+def _rebuild_generator(task_id, index):
+    g = ObjectRefGenerator(task_id)
+    g._index = index
+    return g
+
+
 class _RefMarker:
     """Placeholder substituted for top-level ObjectRef args in a task's
     serialized arguments; the executing worker replaces it with the

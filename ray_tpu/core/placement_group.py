@@ -134,6 +134,13 @@ class PlacementGroupManager:
             if rec.state in (PGState.PENDING, PGState.RESCHEDULING):
                 self.try_place(rec)
 
+    def pending_records(self) -> List[PlacementGroupRecord]:
+        return [
+            rec
+            for rec in self.groups.values()
+            if rec.state in (PGState.PENDING, PGState.RESCHEDULING)
+        ]
+
     def is_ready(self, pg_id: PlacementGroupID) -> bool:
         rec = self.groups.get(pg_id)
         return rec is not None and rec.state == PGState.CREATED

@@ -92,6 +92,7 @@ class RemoteFunction:
         core = _require_worker()
         self._ensure_exported()
         opts = self._options
+        streaming = opts["num_returns"] == "streaming"
         args_blob, deps = core.build_args(args, kwargs)
         spec = TaskSpec(
             task_id=TaskID.from_random(),
@@ -101,7 +102,7 @@ class RemoteFunction:
             func_blob=self._blob,
             args_blob=args_blob,
             dependencies=deps,
-            num_returns=opts["num_returns"],
+            num_returns=TaskSpec.STREAMING if streaming else opts["num_returns"],
             resources=build_resource_set(opts),
             owner_id=core.worker_id,
             scheduling_strategy=normalize_strategy(opts.get("scheduling_strategy")),
@@ -110,6 +111,10 @@ class RemoteFunction:
             runtime_env=opts.get("runtime_env"),
         )
         refs = core.submit_task(spec)
+        if streaming:
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id)
         return refs[0] if opts["num_returns"] == 1 else refs
 
     def bind(self, *args, **kwargs):

@@ -65,8 +65,23 @@ class TaskSpec:
     max_concurrency: int = 1
     # Runtime env (env vars only in v0; reference has full plugin system).
     runtime_env: Optional[dict] = None
+    # Actor creation: hold the acquired resources until the actor dies
+    # (reference semantics: explicitly-requested actor resources are held
+    # for the actor's lifetime; the default 1 CPU is scheduling-only and
+    # released once __init__ completes — python/ray/actor.py).
+    hold_resources_while_alive: bool = False
+
+    # num_returns == -1 ⇒ streaming generator (reference: num_returns=
+    # "streaming", _raylet.pyx:1077 streaming generator returns).
+    STREAMING = -1
+
+    @property
+    def is_streaming(self) -> bool:
+        return self.num_returns == TaskSpec.STREAMING
 
     def return_ids(self) -> List[ObjectID]:
+        if self.is_streaming:
+            return []
         return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
 
     def scheduling_class(self) -> Tuple:

@@ -144,6 +144,10 @@ class TaskExecutor:
             spec.task_id.hex(), spec.actor_id.hex() if spec.actor_id else None
         )
         try:
+            if spec.runtime_env:
+                from ray_tpu import runtime_env as _renv
+
+                _renv.ensure_applied(spec.runtime_env)
             args, kwargs = self._resolve_args(spec)
             if kind == "task":
                 fn = self._load_func(spec)
@@ -171,6 +175,9 @@ class TaskExecutor:
         self._report(spec, result, None)
 
     def _report(self, spec: TaskSpec, result, error):
+        if spec.is_streaming and error is None:
+            self._report_stream(spec, result)
+            return
         results = []
         if error is None:
             try:
@@ -195,6 +202,31 @@ class TaskExecutor:
                 error = TaskError(spec.name, traceback.format_exc(), None)
         try:
             self.core._call("task_done", spec.task_id, results, error)
+        except rpc.ConnectionLost:
+            os._exit(1)
+
+    def _report_stream(self, spec: TaskSpec, result):
+        """Stream generator items as they are produced: each yield becomes
+        its own object, published immediately (reference: streaming
+        generator execution, _raylet.pyx:1077)."""
+        from ray_tpu.utils.ids import ObjectID
+
+        index = 0
+        error = None
+        try:
+            for item in result:
+                oid = ObjectID.for_task_return(spec.task_id, index)
+                self.core.put_serialized(oid, serialize(item))
+                self.core._call("stream_item", spec.task_id, index)
+                index += 1
+        except Exception as e:  # noqa: BLE001 — mid-stream error → final item
+            tb = traceback.format_exc()
+            err_item = e if isinstance(e, TaskError) else TaskError(spec.name, tb, None)
+            oid = ObjectID.for_task_return(spec.task_id, index)
+            self.core.put_serialized(oid, serialize(err_item), is_error=True)
+            self.core._call("stream_item", spec.task_id, index)
+        try:
+            self.core._call("task_done", spec.task_id, [], error)
         except rpc.ConnectionLost:
             os._exit(1)
 

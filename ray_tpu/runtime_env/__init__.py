@@ -1,0 +1,173 @@
+"""Runtime environments: per-task/actor execution environments.
+
+Reference: python/ray/_private/runtime_env/ — a plugin system (env_vars,
+working_dir, py_modules, pip, conda, container, mpi, nsight) applied by a
+per-node agent; the raylet keys idle workers by runtime-env hash so
+environments never cross-contaminate (src/ray/raylet/worker_pool.h:174).
+
+Rebuild: the same two pieces, trimmed to what a TPU pod needs —
+
+- a **plugin registry** (:func:`register_plugin`): each key in the env dict
+  maps to a setup function applied inside the worker process before the
+  first task of that env runs. Built-ins: ``env_vars``, ``working_dir``,
+  ``py_modules``, ``config``. ``pip``/``conda`` raise
+  :class:`RuntimeEnvSetupError` — workers share the host interpreter and
+  the fleet has no package egress; bake deps into the image (the TPU-pod
+  deployment model) or use ``py_modules`` with local paths.
+- **worker affinity by env hash**: the controller only dispatches an
+  env-tagged task to a worker already in that env or to a pristine worker
+  (which then becomes env-tagged) — reference behavior, collapsed into the
+  central scheduler.
+
+Env application is sticky per worker (the reference dedicates workers the
+same way); a worker never switches between two non-empty envs.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.exceptions import RuntimeEnvSetupError
+
+_INTERNAL_KEYS = {"__actor_name__"}
+
+_plugins: Dict[str, Callable[[Any], None]] = {}
+
+
+def register_plugin(key: str, setup: Callable[[Any], None]):
+    """Register a runtime-env key handler (reference: RuntimeEnvPlugin)."""
+    _plugins[key] = setup
+
+
+class RuntimeEnv(dict):
+    """Validated runtime-env mapping (reference: ray.runtime_env.RuntimeEnv)."""
+
+    def __init__(
+        self,
+        *,
+        env_vars: Optional[Dict[str, str]] = None,
+        working_dir: Optional[str] = None,
+        py_modules: Optional[list] = None,
+        config: Optional[dict] = None,
+        **extra,
+    ):
+        super().__init__()
+        if env_vars is not None:
+            if not all(isinstance(k, str) and isinstance(v, str) for k, v in env_vars.items()):
+                raise ValueError("env_vars must be a str→str mapping")
+            self["env_vars"] = dict(env_vars)
+        if working_dir is not None:
+            self["working_dir"] = working_dir
+        if py_modules is not None:
+            self["py_modules"] = list(py_modules)
+        if config is not None:
+            self["config"] = dict(config)
+        for k, v in extra.items():
+            if k not in _plugins and k not in ("pip", "conda"):
+                raise ValueError(f"unknown runtime_env key: {k!r}")
+            self[k] = v
+
+
+def strip_internal(env: Optional[dict]) -> dict:
+    return {k: v for k, v in (env or {}).items() if k not in _INTERNAL_KEYS}
+
+
+def env_hash(env: Optional[dict]) -> str:
+    """Stable hash keying worker reuse (reference: worker_pool runtime-env
+    hash in the lease request)."""
+    e = strip_internal(env)
+    if not e:
+        return ""
+    blob = json.dumps(e, sort_keys=True, default=str).encode()
+    return hashlib.blake2b(blob, digest_size=8).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Built-in plugins (applied inside the worker process)
+# ---------------------------------------------------------------------------
+def _setup_env_vars(value: Dict[str, str]):
+    os.environ.update(value)
+
+
+def _setup_working_dir(value: str):
+    # Local-path working dirs only: in the single-image TPU-pod deployment
+    # all hosts share the filesystem layout, so there is no URI
+    # upload/download step (reference's GCS packaging,
+    # _private/runtime_env/working_dir.py, is an artifact of heterogeneous
+    # clusters). Zip archives are extracted beside the session.
+    path = value
+    if path.endswith(".zip"):
+        import tempfile
+        import zipfile
+
+        dest = tempfile.mkdtemp(prefix="rt_env_wd_")
+        with zipfile.ZipFile(path) as z:
+            z.extractall(dest)
+        path = dest
+    if not os.path.isdir(path):
+        raise RuntimeEnvSetupError(f"working_dir does not exist: {value}")
+    os.chdir(path)
+    sys.path.insert(0, path)
+
+
+def _setup_py_modules(value: list):
+    for mod in value:
+        if not os.path.exists(mod):
+            raise RuntimeEnvSetupError(f"py_modules path does not exist: {mod}")
+        parent = mod if os.path.isdir(mod) else os.path.dirname(mod)
+        if parent not in sys.path:
+            sys.path.insert(0, parent)
+
+
+def _setup_config(value: dict):
+    pass  # setup-timeout etc.; carried for API parity
+
+
+def _setup_unsupported(kind: str):
+    def fail(value):
+        raise RuntimeEnvSetupError(
+            f"runtime_env[{kind!r}] is not supported: workers share the host "
+            "interpreter and TPU fleets run hermetic images with no package "
+            "egress. Bake dependencies into the image, or ship local code "
+            "with py_modules/working_dir."
+        )
+
+    return fail
+
+
+register_plugin("env_vars", _setup_env_vars)
+register_plugin("working_dir", _setup_working_dir)
+register_plugin("py_modules", _setup_py_modules)
+register_plugin("config", _setup_config)
+register_plugin("pip", _setup_unsupported("pip"))
+register_plugin("conda", _setup_unsupported("conda"))
+
+# ---------------------------------------------------------------------------
+# Worker-side application
+# ---------------------------------------------------------------------------
+_applied_hash: Optional[str] = None
+
+
+def ensure_applied(env: Optional[dict]):
+    """Apply ``env`` in this worker once; sticky thereafter.
+
+    The controller's env-affinity dispatch guarantees we are only ever
+    asked to apply one non-empty env per worker lifetime.
+    """
+    global _applied_hash
+    h = env_hash(env)
+    if not h or h == _applied_hash:
+        return
+    if _applied_hash is not None and _applied_hash != h:
+        raise RuntimeEnvSetupError(
+            "worker already holds a different runtime env (scheduler bug)"
+        )
+    for key, value in strip_internal(env).items():
+        plugin = _plugins.get(key)
+        if plugin is None:
+            raise RuntimeEnvSetupError(f"no plugin for runtime_env key {key!r}")
+        plugin(value)
+    _applied_hash = h

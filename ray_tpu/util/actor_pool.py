@@ -1,0 +1,86 @@
+"""ActorPool: round-robin work distribution over a fixed set of actors.
+
+Reference: python/ray/util/actor_pool.py (map/map_unordered/submit/
+get_next/get_next_unordered).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        if not actors:
+            raise ValueError("ActorPool needs at least one actor")
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+
+    def submit(self, fn: Callable, value: Any):
+        """fn(actor, value) -> ObjectRef; blocks only if no actor is idle."""
+        import ray_tpu
+
+        if not self._idle:
+            # Wait for any in-flight call to finish, then reuse its actor.
+            refs = list(self._future_to_actor)
+            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=None)
+            self._reclaim(ready[0])
+        actor = self._idle.pop()
+        ref = fn(actor, value)
+        self._future_to_actor[ref] = actor
+        self._index_to_future[self._next_task_index] = ref
+        self._next_task_index += 1
+
+    def _reclaim(self, ref):
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is not None:
+            self._idle.append(actor)
+
+    def has_next(self) -> bool:
+        return self._next_return_index < self._next_task_index
+
+    def get_next(self, timeout=None):
+        """Results in submission order."""
+        import ray_tpu
+
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        ref = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        value = ray_tpu.get(ref, timeout=timeout)
+        self._reclaim(ref)
+        return value
+
+    def get_next_unordered(self, timeout=None):
+        """Whichever result lands first."""
+        import ray_tpu
+
+        refs = list(self._index_to_future.values())
+        if not refs:
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=timeout)
+        ref = ready[0]
+        for idx, r in list(self._index_to_future.items()):
+            if r == ref:
+                del self._index_to_future[idx]
+                if idx == self._next_return_index:
+                    while self._next_return_index not in self._index_to_future and self._next_return_index < self._next_task_index:
+                        self._next_return_index += 1
+                break
+        value = ray_tpu.get(ref)
+        self._reclaim(ref)
+        return value
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self._index_to_future:
+            yield self.get_next_unordered()

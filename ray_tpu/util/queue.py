@@ -1,0 +1,113 @@
+"""Distributed FIFO queue backed by an actor.
+
+Reference: python/ray/util/queue.py (Queue over an _QueueActor).
+"""
+from __future__ import annotations
+
+import queue as _stdqueue
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class _QueueActor:
+    """Methods run on the actor's thread pool (max_concurrency > 1), so a
+    blocked get() must not starve puts — stdlib queue.Queue is the right
+    thread-safe blocking primitive here."""
+
+    def __init__(self, maxsize: int):
+        self._q = _stdqueue.Queue(maxsize=maxsize if maxsize > 0 else 0)
+
+    def put(self, item, timeout: Optional[float] = None):
+        try:
+            self._q.put(item, timeout=timeout)
+            return True
+        except _stdqueue.Full:
+            return False
+
+    def get(self, timeout: Optional[float] = None):
+        try:
+            return True, self._q.get(timeout=timeout)
+        except _stdqueue.Empty:
+            return False, None
+
+    def put_nowait(self, item) -> bool:
+        try:
+            self._q.put_nowait(item)
+            return True
+        except _stdqueue.Full:
+            return False
+
+    def get_nowait(self):
+        try:
+            return True, self._q.get_nowait()
+        except _stdqueue.Empty:
+            return False, None
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    def full(self) -> bool:
+        return self._q.full()
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class Queue:
+    """Sharable FIFO queue; pass the Queue object into tasks/actors freely."""
+
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("max_concurrency", 8)
+        self.actor = _QueueActor.options(**opts).remote(maxsize)
+
+    def put(self, item: Any, timeout: Optional[float] = None):
+        if not ray_tpu.get(self.actor.put.remote(item, timeout)):
+            raise Full("queue full")
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        ok, value = ray_tpu.get(self.actor.get.remote(timeout))
+        if not ok:
+            raise Empty("queue empty")
+        return value
+
+    def put_nowait(self, item: Any):
+        if not ray_tpu.get(self.actor.put_nowait.remote(item)):
+            raise Full("queue full")
+
+    def get_nowait(self) -> Any:
+        ok, value = ray_tpu.get(self.actor.get_nowait.remote())
+        if not ok:
+            raise Empty("queue empty")
+        return value
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self.actor.empty.remote())
+
+    def full(self) -> bool:
+        return ray_tpu.get(self.actor.full.remote())
+
+    def shutdown(self):
+        ray_tpu.kill(self.actor)
+
+    def __reduce__(self):
+        return (_rebuild_queue, (self.actor,))
+
+
+def _rebuild_queue(actor):
+    q = Queue.__new__(Queue)
+    q.actor = actor
+    return q

@@ -1,0 +1,35 @@
+"""Workflows: durable DAG execution with per-step checkpointing.
+
+Reference: python/ray/workflow/ (api.py — ``workflow.run(dag_node,
+workflow_id=...)`` over the Ray DAG API, per-step checkpoints to workflow
+storage, ``resume``/``resume_all``, status tracking). Virtual actors are
+deliberately omitted (deprecated upstream).
+
+Rebuild: workflows execute ray_tpu DAGs (``fn.bind(...)``) where every
+step runs as a normal task wrapped in a checkpointing shim — the worker
+writes the step's result to ``<storage>/<workflow_id>/steps/<key>`` before
+returning, so a crashed/resumed workflow skips completed steps and only
+re-executes the frontier. Storage is a shared filesystem directory (on TPU
+pods: NFS/GCS-fuse), set via :func:`init` or ``RAY_TPU_WORKFLOW_STORAGE``.
+"""
+from ray_tpu.workflow.execution import (
+    delete,
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+
+__all__ = [
+    "init",
+    "run",
+    "run_async",
+    "resume",
+    "get_status",
+    "get_output",
+    "list_all",
+    "delete",
+]

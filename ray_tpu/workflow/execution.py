@@ -1,0 +1,233 @@
+"""Workflow execution engine.
+
+Reference: python/ray/workflow/workflow_executor.py + workflow_storage.py
+(step checkpoints, deterministic step keys, status records).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.utils.serialization import deserialize, serialize
+from ray_tpu.dag.node import (
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+_storage_dir: Optional[str] = None
+
+
+def init(storage: Optional[str] = None):
+    """Set the workflow storage root (shared filesystem path)."""
+    global _storage_dir
+    _storage_dir = storage or os.environ.get(
+        "RAY_TPU_WORKFLOW_STORAGE", "/tmp/ray_tpu/workflows"
+    )
+    os.makedirs(_storage_dir, exist_ok=True)
+    return _storage_dir
+
+
+def _storage() -> str:
+    if _storage_dir is None:
+        init()
+    return _storage_dir
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(_storage(), workflow_id)
+
+
+def _meta_path(workflow_id: str) -> str:
+    return os.path.join(_wf_dir(workflow_id), "meta.json")
+
+
+def _write_meta(wf_id: str, /, **updates):
+    path = _meta_path(wf_id)
+    meta = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            meta = json.load(f)
+    meta.update(updates)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, path)
+    return meta
+
+
+def _read_meta(workflow_id: str) -> dict:
+    with open(_meta_path(workflow_id)) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Step checkpointing shim (runs on workers)
+# ---------------------------------------------------------------------------
+def _ckpt_path(wf_dir: str, key: str) -> str:
+    return os.path.join(wf_dir, "steps", key)
+
+
+def _run_step_with_checkpoint(fn, wf_dir: str, key: str, *args, **kwargs):
+    """Wrapper executed as the task body: compute, checkpoint, return."""
+    result = fn(*args, **kwargs)
+    path = _ckpt_path(wf_dir, key)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{uuid.uuid4().hex[:6]}"
+    with open(tmp, "wb") as f:
+        f.write(serialize(result))
+    os.replace(tmp, path)  # atomic: readers never see partial checkpoints
+    return result
+
+
+# ---------------------------------------------------------------------------
+# DAG walk
+# ---------------------------------------------------------------------------
+def _step_key(idx: int, node: DAGNode) -> str:
+    name = getattr(getattr(node, "_remote_fn", None), "_fn", None)
+    name = getattr(name, "__name__", type(node).__name__)
+    return f"{idx:04d}_{name}"
+
+
+def _execute_workflow(dag: DAGNode, workflow_id: str, args: tuple, kwargs: dict):
+    import ray_tpu
+
+    wf_dir = _wf_dir(workflow_id)
+    order = dag.topo_sort()
+    results: Dict[int, Any] = {}
+
+    def resolve(v):
+        if isinstance(v, DAGNode):
+            return results[id(v)]
+        return v
+
+    for idx, node in enumerate(order):
+        if isinstance(node, InputNode):
+            if kwargs or len(args) != 1:
+                results[id(node)] = args  # accessed via inp[i]
+            else:
+                results[id(node)] = args[0]
+        elif isinstance(node, InputAttributeNode):
+            key = node._key
+            results[id(node)] = args[key] if isinstance(key, int) else kwargs[key]
+        elif isinstance(node, MultiOutputNode):
+            results[id(node)] = [resolve(a) for a in node._bound_args]
+        elif isinstance(node, FunctionNode):
+            key = _step_key(idx, node)
+            ckpt = _ckpt_path(wf_dir, key)
+            if os.path.exists(ckpt):
+                with open(ckpt, "rb") as f:
+                    results[id(node)] = deserialize(f.read())
+                continue
+            rargs = tuple(resolve(a) for a in node._bound_args)
+            rkwargs = {k: resolve(v) for k, v in node._bound_kwargs.items()}
+            rf = node._remote_fn
+            rf._ensure_exported()
+            shim = ray_tpu.remote(_run_step_with_checkpoint).options(
+                num_cpus=rf._options.get("num_cpus", 1),
+                max_retries=rf._options.get("max_retries", 3),
+            )
+            results[id(node)] = shim.remote(rf._fn, wf_dir, key, *rargs, **rkwargs)
+        else:
+            raise ValueError(
+                f"workflows support function DAGs; got {type(node).__name__} "
+                "(actors hold process state, which durable re-execution "
+                "cannot replay — reference drops virtual actors too)"
+            )
+        # Submitted steps return ObjectRefs; downstream tasks take refs as
+        # args (dependency resolution fetches them worker-side). But
+        # checkpoint-skip needs VALUES for args of re-run steps, so refs are
+        # fine either way.
+    out = results[id(order[-1])]
+    return out
+
+
+def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs):
+    """Start (or restart) a workflow; returns the output ObjectRef(s)."""
+    import ray_tpu
+
+    workflow_id = workflow_id or f"wf_{uuid.uuid4().hex[:12]}"
+    os.makedirs(os.path.join(_wf_dir(workflow_id), "steps"), exist_ok=True)
+    _write_meta(
+        workflow_id,
+        **{"workflow_id": workflow_id, "status": "RUNNING", "start_time": time.time()},
+    )
+    blob = serialize((dag, args, kwargs))
+    with open(os.path.join(_wf_dir(workflow_id), "dag.pkl"), "wb") as f:
+        f.write(blob)
+    try:
+        out = _execute_workflow(dag, workflow_id, args, kwargs)
+    except Exception:
+        _write_meta(workflow_id, status="FAILED", end_time=time.time())
+        raise
+    return workflow_id, out
+
+
+def run(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs):
+    """Run to completion; returns the final value(s)."""
+    import ray_tpu
+
+    workflow_id, out = run_async(dag, *args, workflow_id=workflow_id, **kwargs)
+    try:
+        from ray_tpu.core.object_ref import ObjectRef
+
+        if isinstance(out, list):
+            value = [ray_tpu.get(o) if isinstance(o, ObjectRef) else o for o in out]
+        elif isinstance(out, ObjectRef):
+            value = ray_tpu.get(out)
+        else:
+            value = out
+    except Exception:
+        _write_meta(workflow_id, status="RESUMABLE", end_time=time.time())
+        raise
+    _write_meta(workflow_id, status="SUCCEEDED", end_time=time.time())
+    # The final value doubles as the workflow output checkpoint.
+    with open(os.path.join(_wf_dir(workflow_id), "output.pkl"), "wb") as f:
+        f.write(serialize(value))
+    return value
+
+
+def resume(workflow_id: str):
+    """Re-run a failed/interrupted workflow; completed steps are skipped
+    via their checkpoints."""
+    dag_path = os.path.join(_wf_dir(workflow_id), "dag.pkl")
+    if not os.path.exists(dag_path):
+        raise ValueError(f"no stored workflow {workflow_id!r}")
+    with open(dag_path, "rb") as f:
+        dag, args, kwargs = deserialize(f.read())
+    return run(dag, *args, workflow_id=workflow_id, **kwargs)
+
+
+def get_status(workflow_id: str) -> str:
+    return _read_meta(workflow_id)["status"]
+
+
+def get_output(workflow_id: str):
+    path = os.path.join(_wf_dir(workflow_id), "output.pkl")
+    if not os.path.exists(path):
+        raise ValueError(f"workflow {workflow_id!r} has no output (status: "
+                         f"{get_status(workflow_id)})")
+    with open(path, "rb") as f:
+        return deserialize(f.read())
+
+
+def list_all() -> List[dict]:
+    root = _storage()
+    out = []
+    for wid in sorted(os.listdir(root)):
+        meta = _meta_path(wid)
+        if os.path.exists(meta):
+            with open(meta) as f:
+                out.append(json.load(f))
+    return out
+
+
+def delete(workflow_id: str):
+    shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)

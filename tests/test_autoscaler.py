@@ -1,0 +1,70 @@
+"""Autoscaler: bin-packing, fake provider, scale-up/down against demand.
+
+Reference test models: python/ray/tests/test_autoscaler_fake_multinode.py,
+test_resource_demand_scheduler.py.
+"""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import AutoscalingCluster
+from ray_tpu.autoscaler.autoscaler import bin_pack_new_nodes
+
+
+def test_bin_pack_basic():
+    types = {
+        "cpu4": {"resources": {"CPU": 4}},
+        "tpu_v5e_8": {"resources": {"CPU": 8, "TPU": 8}},
+    }
+    launchable = {"cpu4": 10, "tpu_v5e_8": 2}
+    # 6 single-CPU tasks → 2 cpu4 nodes.
+    out = bin_pack_new_nodes([{"CPU": 1}] * 6, types, launchable)
+    assert out == {"cpu4": 2}
+    # A TPU slice demand → the TPU node type.
+    out = bin_pack_new_nodes([{"TPU": 8, "CPU": 1}], types, launchable)
+    assert out == {"tpu_v5e_8": 1}
+    # Infeasible demand launches nothing.
+    assert bin_pack_new_nodes([{"GPU": 1}], types, launchable) == {}
+
+
+def test_bin_pack_respects_max():
+    types = {"cpu2": {"resources": {"CPU": 2}}}
+    out = bin_pack_new_nodes([{"CPU": 2}] * 5, types, {"cpu2": 3})
+    assert out == {"cpu2": 3}
+
+
+@pytest.mark.slow
+def test_autoscaling_cluster_scales_up_and_down():
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1},
+        worker_node_types={
+            "cpu2": {"resources": {"CPU": 2}, "min_workers": 0, "max_workers": 3},
+        },
+        interval_s=0.5,
+        idle_timeout_s=4.0,
+    )
+    try:
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(num_cpus=2)
+        def heavy(x):
+            time.sleep(1.0)
+            return x
+
+        # Head has 1 CPU; each task needs 2 → must autoscale.
+        refs = [heavy.remote(i) for i in range(4)]
+        assert sorted(ray_tpu.get(refs, timeout=90)) == [0, 1, 2, 3]
+        n_nodes = len([n for n in ray_tpu.nodes() if n["state"] == "ALIVE"])
+        assert n_nodes >= 2  # head + at least one autoscaled node
+
+        # Idle long enough → scale back down.
+        deadline = time.monotonic() + 40
+        while time.monotonic() < deadline:
+            if not cluster.provider.non_terminated_nodes():
+                break
+            time.sleep(0.5)
+        assert not cluster.provider.non_terminated_nodes(), "idle nodes never reaped"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()

@@ -1,0 +1,97 @@
+"""Runtime environments: env_vars, working_dir, py_modules, worker affinity.
+
+Reference test models: python/ray/tests/test_runtime_env.py,
+test_runtime_env_env_vars.py, test_runtime_env_working_dir.py.
+"""
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import RuntimeEnvSetupError, TaskError
+from ray_tpu.runtime_env import RuntimeEnv, env_hash
+
+
+def test_runtime_env_validation():
+    e = RuntimeEnv(env_vars={"A": "1"}, working_dir="/tmp")
+    assert e["env_vars"] == {"A": "1"}
+    with pytest.raises(ValueError):
+        RuntimeEnv(env_vars={"A": 1})
+    with pytest.raises(ValueError):
+        RuntimeEnv(bogus_key=1)
+    assert env_hash({}) == ""
+    assert env_hash({"env_vars": {"A": "1"}}) == env_hash({"env_vars": {"A": "1"}})
+    assert env_hash({"env_vars": {"A": "1"}}) != env_hash({"env_vars": {"A": "2"}})
+    assert env_hash({"__actor_name__": "x"}) == ""
+
+
+def test_env_vars_applied(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_TEST_VAR": "hello"}})
+    def read_var():
+        return os.environ.get("MY_TEST_VAR")
+
+    assert ray_tpu.get(read_var.remote()) == "hello"
+
+
+def test_env_isolation_across_envs(ray_start_regular):
+    """Tasks in different envs must not share a worker."""
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"WHICH": "a"}})
+    def env_a():
+        return os.environ.get("WHICH"), os.getpid()
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"WHICH": "b"}})
+    def env_b():
+        return os.environ.get("WHICH"), os.getpid()
+
+    @ray_tpu.remote
+    def vanilla():
+        return os.environ.get("WHICH"), os.getpid()
+
+    a = [ray_tpu.get(env_a.remote()) for _ in range(3)]
+    b = [ray_tpu.get(env_b.remote()) for _ in range(3)]
+    v = [ray_tpu.get(vanilla.remote()) for _ in range(3)]
+    assert all(x[0] == "a" for x in a)
+    assert all(x[0] == "b" for x in b)
+    # Vanilla tasks never observe either env.
+    assert all(x[0] is None for x in v)
+    # Envs never share a worker pid.
+    assert {x[1] for x in a}.isdisjoint({x[1] for x in b})
+    assert {x[1] for x in v}.isdisjoint({x[1] for x in a} | {x[1] for x in b})
+
+
+def test_working_dir_and_py_modules(ray_start_regular, tmp_path):
+    pkg = tmp_path / "mypkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("MAGIC = 77\n")
+    (tmp_path / "data.txt").write_text("payload")
+
+    @ray_tpu.remote(
+        runtime_env={"working_dir": str(tmp_path), "py_modules": [str(pkg)]}
+    )
+    def use_env():
+        import mypkg
+
+        with open("data.txt") as f:
+            return mypkg.MAGIC, f.read()
+
+    assert ray_tpu.get(use_env.remote()) == (77, "payload")
+
+
+def test_actor_runtime_env(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_VAR": "yes"}})
+    class A:
+        def read(self):
+            return os.environ.get("ACTOR_VAR")
+
+    a = A.remote()
+    assert ray_tpu.get(a.read.remote()) == "yes"
+
+
+def test_pip_rejected(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    def f():
+        return 1
+
+    with pytest.raises((RuntimeEnvSetupError, TaskError)):
+        ray_tpu.get(f.remote())

@@ -1,0 +1,100 @@
+"""Workflows: durable execution, checkpoint skip, resume.
+
+Reference test models: python/ray/workflow/tests/test_basic_workflows.py,
+test_recovery.py.
+"""
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture
+def wf_storage(tmp_path):
+    workflow.init(str(tmp_path / "wf"))
+    yield str(tmp_path / "wf")
+
+
+def test_workflow_run(ray_start_regular, wf_storage):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), 10)
+    value = workflow.run(dag, 5, workflow_id="wf1")
+    assert value == 20
+    assert workflow.get_status("wf1") == "SUCCEEDED"
+    assert workflow.get_output("wf1") == 20
+    assert any(w["workflow_id"] == "wf1" for w in workflow.list_all())
+
+
+def test_workflow_checkpoints_skip_completed_steps(ray_start_regular, wf_storage, tmp_path):
+    marker = tmp_path / "count"
+    marker.write_text("0")
+
+    @ray_tpu.remote
+    def counted(x, marker_path):
+        n = int(open(marker_path).read())
+        open(marker_path, "w").write(str(n + 1))
+        return x + 1
+
+    with InputNode() as inp:
+        dag = counted.bind(inp, str(marker))
+    assert workflow.run(dag, 1, workflow_id="wf2") == 2
+    assert marker.read_text() == "1"
+    # Second run with the same id: step checkpoint short-circuits execution.
+    assert workflow.run(dag, 1, workflow_id="wf2") == 2
+    assert marker.read_text() == "1"
+
+
+def test_workflow_resume_after_failure(ray_start_regular, wf_storage, tmp_path):
+    flag = tmp_path / "ok"
+    ran = tmp_path / "first_ran"
+
+    @ray_tpu.remote
+    def first(x, ran_path):
+        open(ran_path, "a").write("x")
+        return x * 10
+
+    @ray_tpu.remote(max_retries=0)
+    def flaky(x, flag_path):
+        if not os.path.exists(flag_path):
+            raise RuntimeError("transient outage")
+        return x + 5
+
+    with InputNode() as inp:
+        dag = flaky.bind(first.bind(inp, str(ran)), str(flag))
+
+    with pytest.raises(Exception):
+        workflow.run(dag, 3, workflow_id="wf3")
+    assert workflow.get_status("wf3") == "RESUMABLE"
+    assert ran.read_text() == "x"  # first step completed + checkpointed
+
+    flag.write_text("ok")
+    assert workflow.resume("wf3") == 35
+    assert workflow.get_status("wf3") == "SUCCEEDED"
+    assert ran.read_text() == "x"  # first step NOT re-executed
+
+
+def test_workflow_multi_output_and_delete(ray_start_regular, wf_storage):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def dec(x):
+        return x - 1
+
+    with InputNode() as inp:
+        dag = MultiOutputNode([inc.bind(inp), dec.bind(inp)])
+    assert workflow.run(dag, 7, workflow_id="wf4") == [8, 6]
+    workflow.delete("wf4")
+    assert all(w["workflow_id"] != "wf4" for w in workflow.list_all())
