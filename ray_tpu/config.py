@@ -50,6 +50,9 @@ class Config:
     # Max tasks a single lease dispatch round hands to one worker.
     max_tasks_in_flight_per_worker: int = 10
     worker_lease_timeout_s: float = 30.0
+    # Kill switch for the native C++ scheduling core (falls back to the
+    # pure-Python policy path). Env override: RAY_TPU_DISABLE_NATIVE_SCHED.
+    disable_native_sched: bool = False
 
     # --- workers ---
     # Prestarted workers per node (reference prestarts 1/CPU:

@@ -112,6 +112,21 @@ class PlacementGroupManager:
                 node.remove_total(_group_resources(rec.pg_id, idx, bundle))
                 node.release(bundle)
         rec.state = PGState.REMOVED
+        self._forget_group_ids(rec)
+
+    def _forget_group_ids(self, rec):
+        """Recycle the PG's interned resource ids in the native scheduling
+        core — group names are unique per PG, so without this the dense
+        id space grows by O(#PGs-ever)."""
+        native = getattr(self.state, "native", None)
+        if native is None:
+            return
+        names = set()
+        for idx, bundle in enumerate(rec.bundles):
+            for k, _ in _group_resources(rec.pg_id, idx, bundle).items_fp():
+                names.add(k)
+        for name in names:
+            native.forget(name)
 
     # ------------------------------------------------------------------
     def on_node_removed(self, node_id: NodeID):

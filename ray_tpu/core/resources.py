@@ -13,7 +13,10 @@ layered on top by the node's accelerator detection.
 """
 from __future__ import annotations
 
+import logging
 from typing import Dict, Iterable
+
+logger = logging.getLogger(__name__)
 
 PRECISION = 10000
 
@@ -91,6 +94,15 @@ class NodeResources:
         self.total = total
         self.available = ResourceSet(dict(total.items_fp()))
         self.labels = dict(labels or {})
+        # Optional native mirror (ray_tpu/native/sched.py): every mutation
+        # is written through so the C++ core can make scheduling decisions
+        # over its own dense view. Python stays the source of truth.
+        self._native = None
+        self._native_id = None
+
+    def bind_native(self, sched, node_id):
+        self._native = sched
+        self._native_id = node_id
 
     def fits(self, demand: ResourceSet) -> bool:
         return self.available.fits(demand)
@@ -103,9 +115,23 @@ class NodeResources:
         if not self.available.fits(demand):
             return False
         self.available = self.available - demand
+        if self._native is not None:
+            ok = self._native.acquire(self._native_id, demand.items_fp())
+            if not ok:
+                # The mirror disagreed with the Python source of truth —
+                # repair it in place rather than letting the C++ view
+                # drive placement off stale numbers.
+                logger.warning(
+                    "native scheduler mirror desync on %s; resyncing", self._native_id
+                )
+                self._native.sync_node(
+                    self._native_id, self.total.items_fp(), self.available.items_fp()
+                )
         return True
 
     def release(self, demand: ResourceSet):
+        if self._native is not None:
+            self._native.release(self._native_id, demand.items_fp())
         self.available = self.available + demand
         # Clamp: releasing more than total indicates a bug elsewhere, but
         # never let availability exceed capacity for dynamic resources.
@@ -129,10 +155,14 @@ class NodeResources:
     def add_total(self, extra: ResourceSet):
         self.total = self.total + extra
         self.available = self.available + extra
+        if self._native is not None:
+            self._native.add_total(self._native_id, extra.items_fp())
 
     def remove_total(self, extra: ResourceSet):
         self.total = self.total - extra
         self.available = self.available - extra
+        if self._native is not None:
+            self._native.remove_total(self._native_id, extra.items_fp())
 
     def to_dict(self):
         return {

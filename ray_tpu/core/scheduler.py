@@ -15,6 +15,7 @@ scheduling can be reintroduced when nodes own their local view.
 from __future__ import annotations
 
 import itertools
+import logging
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -22,6 +23,8 @@ from ray_tpu.config import get_config
 from ray_tpu.core.resources import NodeResources, ResourceSet
 from ray_tpu.core.task_spec import SchedulingStrategy
 from ray_tpu.utils.ids import NodeID, PlacementGroupID
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -32,21 +35,48 @@ class ScheduleResult:
 
 class ClusterState:
     """Authoritative view of node resources (reference:
-    ClusterResourceManager, cluster_resource_data.h)."""
+    ClusterResourceManager, cluster_resource_data.h).
+
+    When the native toolchain is available the C++ scheduling core
+    (ray_tpu/native/src/sched.cc) holds a write-through mirror and makes
+    the hybrid/spread placement decisions over dense fixed-point arrays —
+    the reference keeps this exact layer in C++ for the same reason.
+    """
 
     def __init__(self):
         self.nodes: Dict[NodeID, NodeResources] = {}
         # Stable ordering for deterministic pack behavior.
         self._order: List[NodeID] = []
         self._spread_rr = itertools.count()
+        self.native = None
+        if not get_config().disable_native_sched:
+            try:
+                from ray_tpu.native import sched as _nsched
+
+                if _nsched.available():
+                    self.native = _nsched.NativeSched()
+            except Exception:
+                # available() already covers the no-toolchain case, so an
+                # exception here is a real regression — say so instead of
+                # silently dropping to the Python policy path.
+                logger.warning("native scheduling core failed to load", exc_info=True)
+                self.native = None
 
     def add_node(self, node_id: NodeID, resources: NodeResources):
         self.nodes[node_id] = resources
-        self._order.append(node_id)
+        if node_id not in self._order:  # re-registration keeps pack order
+            self._order.append(node_id)
+        if self.native is not None:
+            self.native.add_node(node_id, resources.total.items_fp())
+            resources.bind_native(self.native, node_id)
 
     def remove_node(self, node_id: NodeID):
-        self.nodes.pop(node_id, None)
+        res = self.nodes.pop(node_id, None)
+        if res is not None:
+            res.bind_native(None, None)
         self._order = [n for n in self._order if n != node_id]
+        if self.native is not None:
+            self.native.remove_node(node_id)
 
     def ordered_nodes(self) -> List[NodeID]:
         return [n for n in self._order if n in self.nodes]
@@ -81,6 +111,11 @@ class ClusterResourceScheduler:
         least-utilized available node (reference:
         hybrid_scheduling_policy.cc HybridPolicyWithFilter)."""
         threshold = get_config().scheduler_spread_threshold
+        if self.state.native is not None:
+            node_id, infeasible = self.state.native.schedule_hybrid(
+                demand.items_fp(), threshold
+            )
+            return ScheduleResult(node_id, infeasible=infeasible)
         feasible = self._feasible_nodes(demand)
         if not feasible:
             return ScheduleResult(None, infeasible=True)
@@ -94,6 +129,9 @@ class ClusterResourceScheduler:
         return ScheduleResult(best)
 
     def _spread(self, demand: ResourceSet) -> ScheduleResult:
+        if self.state.native is not None:
+            node_id, infeasible = self.state.native.schedule_spread(demand.items_fp())
+            return ScheduleResult(node_id, infeasible=infeasible)
         feasible = self._feasible_nodes(demand)
         if not feasible:
             return ScheduleResult(None, infeasible=True)

@@ -87,6 +87,33 @@ def load() -> Optional[ctypes.CDLL]:
         lib.rt_arena_lru_victim.restype = ctypes.c_int
         lib.rt_arena_lru_victim.argtypes = [p, u8p, ctypes.POINTER(u64)]
         lib.rt_arena_stats.argtypes = [p, ctypes.POINTER(u64), ctypes.POINTER(u64), ctypes.POINTER(u64)]
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        i64p = ctypes.POINTER(i64)
+        lib.rt_sched_create.restype = p
+        lib.rt_sched_destroy.argtypes = [p]
+        lib.rt_sched_intern.restype = ctypes.c_uint32
+        lib.rt_sched_intern.argtypes = [p, ctypes.c_char_p]
+        lib.rt_sched_add_node.restype = ctypes.c_int
+        lib.rt_sched_add_node.argtypes = [p, u64, u32p, i64p, ctypes.c_int]
+        lib.rt_sched_remove_node.restype = ctypes.c_int
+        lib.rt_sched_remove_node.argtypes = [p, u64]
+        lib.rt_sched_acquire.restype = ctypes.c_int
+        lib.rt_sched_acquire.argtypes = [p, u64, u32p, i64p, ctypes.c_int]
+        lib.rt_sched_release.argtypes = [p, u64, u32p, i64p, ctypes.c_int]
+        lib.rt_sched_add_total.argtypes = [p, u64, u32p, i64p, ctypes.c_int]
+        lib.rt_sched_remove_total.argtypes = [p, u64, u32p, i64p, ctypes.c_int]
+        lib.rt_sched_schedule_hybrid.restype = ctypes.c_int
+        lib.rt_sched_schedule_hybrid.argtypes = [p, u32p, i64p, ctypes.c_int, ctypes.c_double, ctypes.POINTER(u64)]
+        lib.rt_sched_schedule_spread.restype = ctypes.c_int
+        lib.rt_sched_schedule_spread.argtypes = [p, u32p, i64p, ctypes.c_int, ctypes.POINTER(u64)]
+        lib.rt_sched_utilization.restype = ctypes.c_double
+        lib.rt_sched_utilization.argtypes = [p, u64]
+        lib.rt_sched_forget.restype = ctypes.c_int
+        lib.rt_sched_forget.argtypes = [p, ctypes.c_char_p]
+        lib.rt_sched_sync_node.restype = ctypes.c_int
+        lib.rt_sched_sync_node.argtypes = [p, u64, u32p, i64p, i64p, ctypes.c_int]
+        lib.rt_sched_get_avail.restype = i64
+        lib.rt_sched_get_avail.argtypes = [p, u64, ctypes.c_uint32]
         _lib = lib
     return _lib
 

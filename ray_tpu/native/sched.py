@@ -1,0 +1,184 @@
+"""ctypes wrapper over the native scheduling core.
+
+Reference: src/ray/raylet/scheduling/cluster_resource_scheduler.cc — the
+C++ half of scheduling, consumed here by ray_tpu.core.scheduler. Keeps
+interned resource-id mapping on the Python side so call sites pass dense
+uint32 ids + int64 fixed-point amounts.
+"""
+from __future__ import annotations
+
+import ctypes
+import itertools
+from typing import Dict, Iterable, Optional, Tuple
+
+from ray_tpu.native import build as _build
+
+
+def available() -> bool:
+    lib = _build.load()
+    return lib is not None and hasattr(lib, "rt_sched_create")
+
+
+class NativeSched:
+    """One authoritative native cluster view (controller-owned)."""
+
+    def __init__(self):
+        self._lib = _build.load()
+        if self._lib is None:
+            raise RuntimeError(f"native lib unavailable: {_build.build_error()}")
+        self._h = self._lib.rt_sched_create()
+        self._ids: Dict[str, int] = {}
+        self._node_keys = itertools.count(1)
+        self._key_of: Dict[object, int] = {}
+        self._node_of: Dict[int, object] = {}
+        # Group-resource names whose interned id could not be recycled yet
+        # (still held by a running task at PG-removal time).
+        self._deferred_forgets: set = set()
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.rt_sched_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover — interpreter teardown
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- id plumbing --------------------------------------------------------
+    def _rid(self, name: str) -> int:
+        rid = self._ids.get(name)
+        if rid is None:
+            rid = self._ids[name] = self._lib.rt_sched_intern(self._h, name.encode())
+        return rid
+
+    def _arrays(self, items: Iterable[Tuple[str, int]]):
+        pairs = [(self._rid(k), v) for k, v in items]
+        n = len(pairs)
+        rids = (ctypes.c_uint32 * n)(*(r for r, _ in pairs))
+        amts = (ctypes.c_int64 * n)(*(v for _, v in pairs))
+        return rids, amts, n
+
+    def _key(self, node_id) -> Optional[int]:
+        return self._key_of.get(node_id)
+
+    # -- node lifecycle -----------------------------------------------------
+    def add_node(self, node_id, totals_fp: Iterable[Tuple[str, int]]):
+        # Re-registration (agent reconnect): overwrite the existing native
+        # node in place — no ghost entry, and the node keeps its pack-order
+        # slot exactly like the Python ``ClusterState._order`` does.
+        if node_id in self._key_of:
+            totals = list(totals_fp)
+            self.sync_node(node_id, totals, totals)
+            return
+        key = next(self._node_keys)
+        self._key_of[node_id] = key
+        self._node_of[key] = node_id
+        rids, amts, n = self._arrays(totals_fp)
+        self._lib.rt_sched_add_node(self._h, key, rids, amts, n)
+
+    def remove_node(self, node_id):
+        key = self._key_of.pop(node_id, None)
+        if key is not None:
+            self._node_of.pop(key, None)
+            self._lib.rt_sched_remove_node(self._h, key)
+
+    # -- accounting (write-through from NodeResources) ----------------------
+    def acquire(self, node_id, items_fp) -> bool:
+        key = self._key(node_id)
+        if key is None:
+            return False
+        rids, amts, n = self._arrays(items_fp)
+        return self._lib.rt_sched_acquire(self._h, key, rids, amts, n) == 0
+
+    def sync_node(self, node_id, totals_fp, avails_fp):
+        """Overwrite the native mirror for one node from the Python
+        source of truth (desync repair)."""
+        key = self._key(node_id)
+        if key is None:
+            return
+        totals = dict(totals_fp)
+        avails = dict(avails_fp)
+        names = sorted(set(totals) | set(avails))
+        items = [(k, 0) for k in names]
+        rids, _, n = self._arrays(items)
+        tot = (ctypes.c_int64 * n)(*(totals.get(k, 0) for k in names))
+        av = (ctypes.c_int64 * n)(*(avails.get(k, 0) for k in names))
+        self._lib.rt_sched_sync_node(self._h, key, rids, tot, av, n)
+
+    def forget(self, name: str) -> bool:
+        """Recycle an interned resource id (e.g. after PG removal).
+        Only succeeds when no live node holds capacity under it; refusals
+        are queued and retried on later forget/release calls so ids held
+        by still-running tasks are reclaimed once they finish."""
+        rc = self._lib.rt_sched_forget(self._h, name.encode())
+        if rc == -2:
+            self._deferred_forgets.add(name)
+        else:
+            self._deferred_forgets.discard(name)
+            self._ids.pop(name, None)
+        self._drain_deferred()
+        return rc == 0
+
+    def _drain_deferred(self):
+        if not self._deferred_forgets:
+            return
+        for name in list(self._deferred_forgets):
+            rc = self._lib.rt_sched_forget(self._h, name.encode())
+            if rc != -2:  # recycled now, or already gone
+                self._deferred_forgets.discard(name)
+                self._ids.pop(name, None)
+
+    def release(self, node_id, items_fp):
+        key = self._key(node_id)
+        if key is None:
+            return
+        rids, amts, n = self._arrays(items_fp)
+        self._lib.rt_sched_release(self._h, key, rids, amts, n)
+        # A release may be the moment a deferred PG-id recycle becomes safe.
+        self._drain_deferred()
+
+    def add_total(self, node_id, items_fp):
+        key = self._key(node_id)
+        if key is None:
+            return
+        rids, amts, n = self._arrays(items_fp)
+        self._lib.rt_sched_add_total(self._h, key, rids, amts, n)
+
+    def remove_total(self, node_id, items_fp):
+        key = self._key(node_id)
+        if key is None:
+            return
+        rids, amts, n = self._arrays(items_fp)
+        self._lib.rt_sched_remove_total(self._h, key, rids, amts, n)
+
+    # -- decisions ----------------------------------------------------------
+    def schedule_hybrid(self, demand_fp, threshold: float):
+        """(node_id, infeasible): node_id None when nothing fits now."""
+        rids, amts, n = self._arrays(demand_fp)
+        out = ctypes.c_uint64()
+        rc = self._lib.rt_sched_schedule_hybrid(
+            self._h, rids, amts, n, threshold, ctypes.byref(out)
+        )
+        if rc == 0:
+            return self._node_of.get(out.value), False
+        return None, rc == -2
+
+    def schedule_spread(self, demand_fp):
+        rids, amts, n = self._arrays(demand_fp)
+        out = ctypes.c_uint64()
+        rc = self._lib.rt_sched_schedule_spread(self._h, rids, amts, n, ctypes.byref(out))
+        if rc == 0:
+            return self._node_of.get(out.value), False
+        return None, rc == -2
+
+    def utilization(self, node_id) -> float:
+        key = self._key(node_id)
+        return self._lib.rt_sched_utilization(self._h, key) if key is not None else 0.0
+
+    def get_avail(self, node_id, name: str) -> int:
+        key = self._key(node_id)
+        if key is None:
+            return 0
+        return self._lib.rt_sched_get_avail(self._h, key, self._rid(name))

@@ -1,0 +1,333 @@
+// Native cluster-resource scheduling core.
+//
+// Reference: src/ray/raylet/scheduling/ — ClusterResourceScheduler /
+// ClusterResourceManager over fixed-point resources
+// (common/scheduling/fixed_point.h, cluster_resource_data.h) with interned
+// resource ids (scheduling_ids.cc) and the hybrid pack/spread policy
+// (policy/hybrid_scheduling_policy.cc).
+//
+// The controller's scheduling pump is the control-plane hot loop: every
+// pending task scans nodes for feasibility/availability each tick. This
+// core keeps the authoritative {total, available} vectors per node as
+// dense int64 fixed-point arrays keyed by interned resource ids, so one
+// schedule() call is a few linear scans with no allocation — the same
+// reason the reference keeps this in C++.
+//
+// C ABI (ctypes): all quantities are fixed-point (caller scales by 1e4).
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Node {
+  uint64_t key = 0;
+  bool alive = false;
+  // Dense by interned resource id; size grows lazily.
+  std::vector<int64_t> total;
+  std::vector<int64_t> avail;
+
+  int64_t get_total(size_t rid) const {
+    return rid < total.size() ? total[rid] : 0;
+  }
+  int64_t get_avail(size_t rid) const {
+    return rid < avail.size() ? avail[rid] : 0;
+  }
+  void ensure(size_t rid) {
+    if (rid >= total.size()) {
+      total.resize(rid + 1, 0);
+      avail.resize(rid + 1, 0);
+    }
+  }
+};
+
+struct Sched {
+  std::mutex mu;
+  std::unordered_map<std::string, uint32_t> intern;
+  std::vector<uint32_t> free_rids;              // recycled interned ids
+  uint32_t next_rid = 0;
+  std::vector<Node> nodes;                      // insertion order == pack order
+  std::unordered_map<uint64_t, size_t> by_key;  // node key -> index
+  uint64_t spread_rr = 0;
+  size_t dead = 0;
+
+  Node* find(uint64_t key) {
+    auto it = by_key.find(key);
+    if (it == by_key.end()) return nullptr;
+    Node* n = &nodes[it->second];
+    return n->alive ? n : nullptr;
+  }
+
+  // Drop tombstones once they outnumber live nodes; preserves insertion
+  // (pack) order, amortized O(1) per removal.
+  void maybe_compact() {
+    if (dead == 0 || dead * 2 < nodes.size()) return;
+    std::vector<Node> live;
+    live.reserve(nodes.size() - dead);
+    by_key.clear();
+    for (auto& n : nodes) {
+      if (!n.alive) continue;
+      by_key[n.key] = live.size();
+      live.push_back(std::move(n));
+    }
+    nodes.swap(live);
+    dead = 0;
+  }
+};
+
+// Drop trailing zero-capacity slots so vectors do not stay grown to the
+// max resource id ever touched (PG group-resources churn).
+void shrink(Node& n) {
+  size_t sz = n.total.size();
+  while (sz > 0 && n.total[sz - 1] == 0 && n.avail[sz - 1] == 0) sz--;
+  if (sz < n.total.size()) {
+    n.total.resize(sz);
+    n.avail.resize(sz);
+  }
+}
+
+bool fits(const Node& n, const uint32_t* rid, const int64_t* amt, int cnt) {
+  for (int i = 0; i < cnt; i++) {
+    if (amt[i] > 0 && n.get_avail(rid[i]) < amt[i]) return false;
+  }
+  return true;
+}
+
+bool feasible(const Node& n, const uint32_t* rid, const int64_t* amt, int cnt) {
+  for (int i = 0; i < cnt; i++) {
+    if (amt[i] > 0 && n.get_total(rid[i]) < amt[i]) return false;
+  }
+  return true;
+}
+
+// Max utilization across resource kinds (reference:
+// hybrid_scheduling_policy.cc node scoring).
+double utilization(const Node& n) {
+  double best = 0.0;
+  for (size_t r = 0; r < n.total.size(); r++) {
+    if (n.total[r] <= 0) continue;
+    double used = double(n.total[r] - n.get_avail(r)) / double(n.total[r]);
+    if (used > best) best = used;
+  }
+  return best;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rt_sched_create() { return new Sched(); }
+
+void rt_sched_destroy(void* h) { delete static_cast<Sched*>(h); }
+
+// Intern a resource name -> dense id.
+uint32_t rt_sched_intern(void* h, const char* name) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->intern.find(name);
+  if (it != s->intern.end()) return it->second;
+  uint32_t id;
+  if (!s->free_rids.empty()) {
+    id = s->free_rids.back();
+    s->free_rids.pop_back();
+  } else {
+    id = s->next_rid++;
+  }
+  s->intern.emplace(name, id);
+  return id;
+}
+
+// Recycle an interned name (e.g. a removed placement group's renamed
+// resources). Safe only when no node holds capacity under the id; returns
+// 0 on success, -1 if unknown, -2 if still in use.
+int rt_sched_forget(void* h, const char* name) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->intern.find(name);
+  if (it == s->intern.end()) return -1;
+  uint32_t rid = it->second;
+  for (auto& n : s->nodes) {
+    if (n.alive && (n.get_total(rid) != 0 || n.get_avail(rid) != 0)) return -2;
+  }
+  s->intern.erase(it);
+  s->free_rids.push_back(rid);
+  return 0;
+}
+
+int rt_sched_add_node(void* h, uint64_t key, const uint32_t* rid,
+                      const int64_t* amt, int cnt) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (s->by_key.count(key)) return -1;
+  Node n;
+  n.key = key;
+  n.alive = true;
+  for (int i = 0; i < cnt; i++) {
+    n.ensure(rid[i]);
+    n.total[rid[i]] = amt[i];
+    n.avail[rid[i]] = amt[i];
+  }
+  s->by_key[key] = s->nodes.size();
+  s->nodes.push_back(std::move(n));
+  return 0;
+}
+
+int rt_sched_remove_node(void* h, uint64_t key) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Node* n = s->find(key);
+  if (!n) return -1;
+  n->alive = false;
+  n->total.clear();
+  n->total.shrink_to_fit();
+  n->avail.clear();
+  n->avail.shrink_to_fit();
+  s->by_key.erase(key);
+  s->dead++;
+  s->maybe_compact();
+  return 0;
+}
+
+// Atomic fit-check + subtract. Returns 0 on success, -1 when it does not fit.
+int rt_sched_acquire(void* h, uint64_t key, const uint32_t* rid,
+                     const int64_t* amt, int cnt) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Node* n = s->find(key);
+  if (!n || !fits(*n, rid, amt, cnt)) return -1;
+  for (int i = 0; i < cnt; i++) {
+    n->ensure(rid[i]);
+    n->avail[rid[i]] -= amt[i];
+  }
+  return 0;
+}
+
+void rt_sched_release(void* h, uint64_t key, const uint32_t* rid,
+                      const int64_t* amt, int cnt) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Node* n = s->find(key);
+  if (!n) return;
+  for (int i = 0; i < cnt; i++) {
+    n->ensure(rid[i]);
+    n->avail[rid[i]] += amt[i];
+    // Clamp dynamic resources to capacity (mirrors NodeResources.release).
+    if (n->total[rid[i]] > 0 && n->avail[rid[i]] > n->total[rid[i]])
+      n->avail[rid[i]] = n->total[rid[i]];
+  }
+}
+
+// PG bundle commit/return: grow/shrink a node's capacity (renamed group
+// resources; reference: placement_group_resource_manager.h).
+void rt_sched_add_total(void* h, uint64_t key, const uint32_t* rid,
+                        const int64_t* amt, int cnt) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Node* n = s->find(key);
+  if (!n) return;
+  for (int i = 0; i < cnt; i++) {
+    n->ensure(rid[i]);
+    n->total[rid[i]] += amt[i];
+    n->avail[rid[i]] += amt[i];
+  }
+}
+
+void rt_sched_remove_total(void* h, uint64_t key, const uint32_t* rid,
+                           const int64_t* amt, int cnt) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Node* n = s->find(key);
+  if (!n) return;
+  for (int i = 0; i < cnt; i++) {
+    n->ensure(rid[i]);
+    n->total[rid[i]] -= amt[i];
+    n->avail[rid[i]] -= amt[i];
+  }
+  shrink(*n);
+}
+
+// Overwrite one node's vectors from the Python source of truth (mirror
+// repair after a detected write-through disagreement).
+int rt_sched_sync_node(void* h, uint64_t key, const uint32_t* rid,
+                       const int64_t* total, const int64_t* avail, int cnt) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Node* n = s->find(key);
+  if (!n) return -1;
+  n->total.assign(n->total.size(), 0);
+  n->avail.assign(n->avail.size(), 0);
+  for (int i = 0; i < cnt; i++) {
+    n->ensure(rid[i]);
+    n->total[rid[i]] = total[i];
+    n->avail[rid[i]] = avail[i];
+  }
+  shrink(*n);
+  return 0;
+}
+
+// Hybrid policy: pack (insertion order) while utilization < threshold,
+// else least-utilized available node. Returns node key via *out.
+//   0 = placed, -1 = feasible but currently full, -2 = infeasible.
+int rt_sched_schedule_hybrid(void* h, const uint32_t* rid, const int64_t* amt,
+                             int cnt, double threshold, uint64_t* out) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  bool any_feasible = false;
+  Node* best = nullptr;
+  double best_util = 2.0;
+  for (auto& n : s->nodes) {
+    if (!n.alive || !feasible(n, rid, amt, cnt)) continue;
+    any_feasible = true;
+    if (!fits(n, rid, amt, cnt)) continue;
+    double u = utilization(n);
+    if (u < threshold) {  // pack: first node under threshold wins
+      *out = n.key;
+      return 0;
+    }
+    if (u < best_util) {
+      best_util = u;
+      best = &n;
+    }
+  }
+  if (best) {
+    *out = best->key;
+    return 0;
+  }
+  return any_feasible ? -1 : -2;
+}
+
+int rt_sched_schedule_spread(void* h, const uint32_t* rid, const int64_t* amt,
+                             int cnt, uint64_t* out) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  std::vector<Node*> avail;
+  bool any_feasible = false;
+  for (auto& n : s->nodes) {
+    if (!n.alive || !feasible(n, rid, amt, cnt)) continue;
+    any_feasible = true;
+    if (fits(n, rid, amt, cnt)) avail.push_back(&n);
+  }
+  if (avail.empty()) return any_feasible ? -1 : -2;
+  *out = avail[s->spread_rr++ % avail.size()]->key;
+  return 0;
+}
+
+double rt_sched_utilization(void* h, uint64_t key) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Node* n = s->find(key);
+  return n ? utilization(*n) : 0.0;
+}
+
+int64_t rt_sched_get_avail(void* h, uint64_t key, uint32_t rid) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Node* n = s->find(key);
+  return n ? n->get_avail(rid) : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,171 @@
+"""Native C++ scheduling core: parity with the Python policy.
+
+Reference test model: src/ray/raylet/scheduling/cluster_task_manager_test.cc
++ policy/hybrid_scheduling_policy_test.cc.
+"""
+import pytest
+
+from ray_tpu.core.resources import NodeResources, ResourceSet
+from ray_tpu.core.scheduler import ClusterResourceScheduler, ClusterState
+from ray_tpu.core.task_spec import SchedulingStrategy
+from ray_tpu.native import sched as nsched
+from ray_tpu.utils.ids import NodeID
+
+pytestmark = pytest.mark.skipif(not nsched.available(), reason="native toolchain unavailable")
+
+
+def _mk_state(native: bool, node_cpus):
+    state = ClusterState()
+    if not native:
+        state.native = None
+    nodes = []
+    for cpus in node_cpus:
+        nid = NodeID.from_random()
+        state.add_node(nid, NodeResources(ResourceSet.from_dict({"CPU": cpus})))
+        nodes.append(nid)
+    return state, nodes
+
+
+def _demand(d):
+    return ResourceSet.from_dict(d)
+
+
+def test_native_vs_python_hybrid_parity():
+    for native in (True, False):
+        state, nodes = _mk_state(native, [4, 4, 4])
+        sched = ClusterResourceScheduler(state)
+        picks = []
+        for _ in range(6):
+            r = sched.schedule(_demand({"CPU": 2}), SchedulingStrategy())
+            assert r.node_id is not None
+            assert state.nodes[r.node_id].acquire(_demand({"CPU": 2}))
+            picks.append(nodes.index(r.node_id))
+        # One 2/4-CPU task puts a node exactly AT the 0.5 spread threshold,
+        # so hybrid advances; second round falls to least-utilized order.
+        assert picks == [0, 1, 2, 0, 1, 2], (native, picks)
+        r = sched.schedule(_demand({"CPU": 2}), SchedulingStrategy())
+        assert r.node_id is None and not r.infeasible
+        r = sched.schedule(_demand({"CPU": 100}), SchedulingStrategy())
+        assert r.node_id is None and r.infeasible
+
+
+def test_native_release_and_total_updates():
+    state, nodes = _mk_state(True, [4])
+    assert state.native is not None
+    nres = state.nodes[nodes[0]]
+    assert nres.acquire(_demand({"CPU": 3}))
+    assert state.native.get_avail(nodes[0], "CPU") == 1 * 10000
+    nres.release(_demand({"CPU": 3}))
+    assert state.native.get_avail(nodes[0], "CPU") == 4 * 10000
+    # PG-style capacity grow/shrink.
+    nres.add_total(_demand({"CPU_group_abc": 2}))
+    assert state.native.get_avail(nodes[0], "CPU_group_abc") == 2 * 10000
+    nres.remove_total(_demand({"CPU_group_abc": 2}))
+    assert state.native.get_avail(nodes[0], "CPU_group_abc") == 0
+
+
+def test_native_spread_round_robin():
+    state, nodes = _mk_state(True, [8, 8])
+    sched = ClusterResourceScheduler(state)
+    picks = set()
+    for _ in range(4):
+        r = sched.schedule(_demand({"CPU": 1}), SchedulingStrategy(kind="SPREAD"))
+        picks.add(nodes.index(r.node_id))
+    assert picks == {0, 1}
+
+
+def test_native_node_removal():
+    state, nodes = _mk_state(True, [2, 2])
+    sched = ClusterResourceScheduler(state)
+    state.remove_node(nodes[0])
+    for _ in range(2):
+        r = sched.schedule(_demand({"CPU": 1}), SchedulingStrategy())
+        assert r.node_id == nodes[1]
+        state.nodes[nodes[1]].acquire(_demand({"CPU": 1}))
+
+
+def test_native_reregistration_no_ghost():
+    """Agent reconnect re-adds the same node id — the old native entry
+    must not linger with stale availability."""
+    state, nodes = _mk_state(True, [4])
+    sched = ClusterResourceScheduler(state)
+    nid = nodes[0]
+    assert state.nodes[nid].acquire(_demand({"CPU": 4}))
+    # Re-register the node fresh (reconnect path).
+    state.add_node(nid, NodeResources(ResourceSet.from_dict({"CPU": 4})))
+    assert state.ordered_nodes().count(nid) == 1
+    r = sched.schedule(_demand({"CPU": 4}), SchedulingStrategy())
+    assert r.node_id == nid
+    assert state.nodes[nid].acquire(_demand({"CPU": 4}))
+    # Now genuinely full: native must agree.
+    r = sched.schedule(_demand({"CPU": 1}), SchedulingStrategy())
+    assert r.node_id is None and not r.infeasible
+
+
+def test_native_churn_compaction():
+    """Node add/remove churn must not degrade scheduling (tombstones are
+    compacted away)."""
+    state, nodes = _mk_state(True, [2])
+    for _ in range(200):
+        nid = NodeID.from_random()
+        state.add_node(nid, NodeResources(ResourceSet.from_dict({"CPU": 2})))
+        state.remove_node(nid)
+    sched = ClusterResourceScheduler(state)
+    r = sched.schedule(_demand({"CPU": 2}), SchedulingStrategy())
+    assert r.node_id == nodes[0]
+
+
+def test_native_forget_recycles_ids():
+    state, nodes = _mk_state(True, [4])
+    native = state.native
+    nres = state.nodes[nodes[0]]
+    nres.add_total(_demand({"CPU_group_0_x": 2}))
+    # In use → refused.
+    assert not native.forget("CPU_group_0_x")
+    nres.remove_total(_demand({"CPU_group_0_x": 2}))
+    assert native.forget("CPU_group_0_x")
+    # Recycled id is reused for the next interned name.
+    rid = native._rid("CPU_group_0_y")
+    assert rid == native._rid("CPU_group_0_y")
+
+
+def test_native_reregistration_preserves_pack_order():
+    """Re-registered node keeps its pack slot — native must agree with the
+    Python ``_order`` semantics."""
+    for native in (True, False):
+        state, nodes = _mk_state(native, [4, 4])
+        sched = ClusterResourceScheduler(state)
+        # Node 0 reconnects fresh; it must still be preferred for packing.
+        state.add_node(nodes[0], NodeResources(ResourceSet.from_dict({"CPU": 4})))
+        r = sched.schedule(_demand({"CPU": 1}), SchedulingStrategy())
+        assert r.node_id == nodes[0], native
+
+
+def test_native_deferred_forget():
+    """A PG id that can't be recycled while a task holds group resources is
+    reclaimed once those resources are released."""
+    state, nodes = _mk_state(True, [4])
+    native = state.native
+    nres = state.nodes[nodes[0]]
+    nres.add_total(_demand({"CPU_group_0_z": 2}))
+    # Task inside the PG holds the group resource.
+    assert nres.acquire(_demand({"CPU_group_0_z": 2}))
+    # PG removed while the task is running.
+    nres.remove_total(_demand({"CPU_group_0_z": 2}))
+    assert not native.forget("CPU_group_0_z")
+    assert "CPU_group_0_z" in native._deferred_forgets
+    # Task finishes → release drains the deferred recycle.
+    nres.release(_demand({"CPU_group_0_z": 2}))
+    assert "CPU_group_0_z" not in native._deferred_forgets
+    assert "CPU_group_0_z" not in native._ids
+
+
+def test_native_sync_node_repairs_desync():
+    state, nodes = _mk_state(True, [8])
+    native, nid = state.native, nodes[0]
+    # Manufacture a desync: native thinks 2 CPUs are gone.
+    native.acquire(nid, _demand({"CPU": 2}).items_fp())
+    assert native.get_avail(nid, "CPU") == 6 * 10000
+    nres = state.nodes[nid]
+    native.sync_node(nid, nres.total.items_fp(), nres.available.items_fp())
+    assert native.get_avail(nid, "CPU") == 8 * 10000
