@@ -4,11 +4,12 @@ The reference framework has no attention kernels (it orchestrates external
 libraries); on TPU the kernel must be native (SURVEY.md §2.9). Design:
 
 - ``flash_attention``: blocked online-softmax forward as a Pallas kernel
-  (MXU-shaped 128-tiles, fp32 accumulation), with a custom VJP whose
-  backward recomputes via the XLA reference path (flash backward kernel is a
-  later optimization; recompute keeps memory O(seq·d) instead of O(seq²)).
+  (MXU-shaped 128-tiles, fp32 accumulation) that also emits the per-row
+  logsumexp, with a custom VJP running the flash *backward* as two Pallas
+  kernels (dQ over q-blocks; dK/dV over k-blocks) — memory stays
+  O(seq·d), no seq² materialization in either direction.
 - ``reference_attention``: straight jnp implementation used for CPU tests,
-  as the VJP recompute path, and as the numerical oracle.
+  as the non-TPU VJP path, and as the numerical oracle.
 
 Layouts: q, k, v are [batch, heads, seq, head_dim]; GQA is handled by the
 caller (kv heads repeated before the call or via q head grouping).
@@ -45,7 +46,8 @@ def reference_attention(q, k, v, causal: bool = True, scale: Optional[float] = N
 
 
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, scale: float, k_len_actual: int
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, scale: float,
+    k_len_actual: int
 ):
     """One (batch·head, q-block) program: online softmax over k blocks.
 
@@ -99,8 +101,11 @@ def _flash_fwd_kernel(
         jnp.full((block_q,), -jnp.inf, jnp.float32),
         jnp.zeros((block_q,), jnp.float32),
     )
-    acc, _, l = jax.lax.fori_loop(0, num_k_blocks_needed, body, init)
+    acc, m, l = jax.lax.fori_loop(0, num_k_blocks_needed, body, init)
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    # logsumexp of the scaled scores — the backward kernels rebuild
+    # P = exp(S - lse) from it instead of re-running the softmax.
+    lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int, block_k: int, interpret: bool):
@@ -118,7 +123,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int, block_k: i
         vr = jnp.pad(vr, ((0, 0), (0, k_pad), (0, 0)))
     k_len_padded = k_len + k_pad
     grid = (batch * heads, pl.cdiv(q_len, bq))
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(
             _flash_fwd_kernel, block_k=bk, causal=causal, scale=scale, k_len_actual=k_len
         ),
@@ -128,11 +133,223 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int, block_k: i
             pl.BlockSpec((1, k_len_padded, head_dim), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, k_len_padded, head_dim), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((batch * heads, q_len, head_dim), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0)),
+            # [bh, 1, q_len] with a unit middle dim keeps the (8,128) TPU
+            # tile constraint satisfied: block dims (1, bq).
+            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch * heads, q_len, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((batch * heads, 1, q_len), jnp.float32),
+        ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(batch, heads, q_len, head_dim)
+    return (
+        out.reshape(batch, heads, q_len, head_dim),
+        lse.reshape(batch, heads, q_len),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+    block_k: int, causal: bool, scale: float, k_len_actual: int
+):
+    """One (batch·head, q-block) program: dQ = scale · Σ_k dS·K over k
+    blocks, with dS = P ∘ (dO·Vᵀ − Δ) and P rebuilt from the saved lse."""
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0].astype(jnp.float32)
+    delta = delta_ref[0, 0].astype(jnp.float32)
+    block_q, head_dim = q.shape
+    k_len = k_ref.shape[1]
+    q_start = pl.program_id(1) * block_q
+    num_k_blocks = k_len // block_k
+    if causal:
+        num_k_blocks_needed = jax.lax.div(q_start + block_q - 1, block_k) + 1
+    else:
+        num_k_blocks_needed = num_k_blocks
+
+    def body(kb, acc):
+        k_start = kb * block_k
+        kblk = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        needs_pad_mask = k_len_actual < k_len
+        if causal or needs_pad_mask:
+            k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            valid = (k_ids < k_len_actual) if needs_pad_mask else True
+            if causal:
+                q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                valid = valid & (q_ids >= k_ids)
+            s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse[:, None])  # masked entries underflow to 0
+        dp = jax.lax.dot_general(
+            do, vblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None])
+        return acc + jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    acc = jax.lax.fori_loop(
+        0, num_k_blocks_needed, body, jnp.zeros((block_q, head_dim), jnp.float32)
+    )
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
+    block_q: int, causal: bool, scale: float
+):
+    """One (batch·head, k-block) program: dK/dV accumulated over q blocks.
+
+    Padded q rows (q/do/delta zero-padded, lse zero) contribute nothing:
+    dO = 0 kills the dV term and dP − Δ = 0 kills the dK term.
+    """
+    k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+    v = v_ref[0].astype(jnp.float32)
+    block_k, head_dim = k.shape
+    q_len = q_ref.shape[1]  # padded, multiple of block_q
+    k_start = pl.program_id(1) * block_k
+    num_q_blocks = q_len // block_q
+    # Causal: q blocks strictly before this k block see none of it.
+    start_qb = jax.lax.div(k_start, block_q) if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_start = qb * block_q
+        qblk = q_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        doblk = do_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(q_start, block_q)].astype(jnp.float32)
+        delta = delta_ref[0, 0, pl.ds(q_start, block_q)].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qblk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jax.lax.dot_general(
+            p, doblk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            doblk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, qblk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        start_qb,
+        num_q_blocks,
+        body,
+        (
+            jnp.zeros((block_k, head_dim), jnp.float32),
+            jnp.zeros((block_k, head_dim), jnp.float32),
+        ),
+    )
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
+                    block_q: int, block_k: int, interpret: bool):
+    batch, heads, q_len, head_dim = q.shape
+    k_len = k.shape[2]
+    bq = min(block_q, q_len)
+    bk = min(block_k, k_len)
+    bh = batch * heads
+
+    qr = q.reshape(bh, q_len, head_dim)
+    kr = k.reshape(bh, k_len, head_dim)
+    vr = v.reshape(bh, k_len, head_dim)
+    dor = do.reshape(bh, q_len, head_dim)
+    lser = lse.reshape(bh, 1, q_len)
+    # Δ = rowsum(dO ∘ O): one fused elementwise+reduce, cheap in XLA.
+    delta = jnp.sum(
+        dor.astype(jnp.float32) * o.reshape(bh, q_len, head_dim).astype(jnp.float32),
+        axis=-1,
+    ).reshape(bh, 1, q_len)
+
+    k_pad = (-k_len) % bk
+    if k_pad:
+        kr = jnp.pad(kr, ((0, 0), (0, k_pad), (0, 0)))
+        vr = jnp.pad(vr, ((0, 0), (0, k_pad), (0, 0)))
+    k_len_p = k_len + k_pad
+
+    # dQ: grid over q blocks, K/V resident.
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, block_k=bk, causal=causal, scale=scale,
+            k_len_actual=k_len,
+        ),
+        grid=(bh, pl.cdiv(q_len, bq)),
+        in_specs=[
+            pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, k_len_p, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, k_len_p, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, q_len, head_dim), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    # dK/dV: grid over k blocks, Q-side streamed in the kernel loop —
+    # q-side arrays must be padded to a block_q multiple for the dynamic
+    # slices (padded rows are harmless per the kernel docstring).
+    q_pad = (-q_len) % bq
+    if q_pad:
+        qr = jnp.pad(qr, ((0, 0), (0, q_pad), (0, 0)))
+        dor = jnp.pad(dor, ((0, 0), (0, q_pad), (0, 0)))
+        lser = jnp.pad(lser, ((0, 0), (0, 0), (0, q_pad)))
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, q_pad)))
+    q_len_p = q_len + q_pad
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, block_q=bq, causal=causal, scale=scale
+        ),
+        grid=(bh, k_len_p // bk),
+        in_specs=[
+            pl.BlockSpec((1, q_len_p, head_dim), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, head_dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, head_dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, q_len_p, head_dim), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, q_len_p), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, q_len_p), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, head_dim), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, head_dim), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, k_len_p, head_dim), k.dtype),
+            jax.ShapeDtypeStruct((bh, k_len_p, head_dim), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+    if k_pad:
+        dk = dk[:, :k_len]
+        dv = dv[:, :k_len]
+    return (
+        dq.reshape(batch, heads, q_len, head_dim),
+        dk.reshape(batch, heads, k_len, head_dim),
+        dv.reshape(batch, heads, k_len, head_dim),
+    )
 
 
 def _use_pallas() -> bool:
@@ -144,22 +361,27 @@ def _use_pallas() -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
-    """Flash attention: Pallas kernel on TPU, jnp reference elsewhere."""
-    s = scale if scale is not None else q.shape[-1] ** -0.5
-    if _use_pallas():
-        return _flash_forward(q, k, v, causal, s, block_q=256, block_k=256, interpret=False)
-    return reference_attention(q, k, v, causal=causal, scale=s)
+    """Flash attention: Pallas kernels on TPU, jnp reference elsewhere."""
+    return _fwd(q, k, v, causal, scale)[0]
 
 
 def _fwd(q, k, v, causal, scale):
-    return flash_attention(q, k, v, causal, scale), (q, k, v)
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    if _use_pallas():
+        out, lse = _flash_forward(q, k, v, causal, s, block_q=256, block_k=256, interpret=False)
+        return out, (q, k, v, out, lse)
+    return reference_attention(q, k, v, causal=causal, scale=s), (q, k, v, None, None)
 
 
 def _bwd(causal, scale, res, g):
-    # Recompute-based backward: O(seq·d) memory, XLA fuses the softmax chain.
-    q, k, v = res
+    q, k, v, o, lse = res
     s = scale if scale is not None else q.shape[-1] ** -0.5
+    if o is not None:
+        return _flash_backward(
+            q, k, v, o, lse, g, causal, s, block_q=256, block_k=256, interpret=False
+        )
 
+    # Non-TPU: recompute via the reference path; XLA fuses the softmax chain.
     def ref(q, k, v):
         return reference_attention(q, k, v, causal=causal, scale=s)
 

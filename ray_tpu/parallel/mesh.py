@@ -41,6 +41,16 @@ class MeshPlan:
     pp: int = 1
     sp: int = 1
     tp: int = 1
+    # Sequence-parallel strategy when sp > 1: "ring" (ppermute K/V rotation,
+    # ray_tpu/parallel/ring.py) or "ulysses" (all-to-all head/seq swap,
+    # ray_tpu/parallel/ulysses.py). Ulysses needs heads % (sp*tp) == 0.
+    sp_mode: str = "ring"
+
+    def __post_init__(self):
+        if self.sp_mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sp_mode must be 'ring' or 'ulysses', got {self.sp_mode!r}"
+            )
 
     @property
     def num_devices(self) -> int:

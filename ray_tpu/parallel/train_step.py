@@ -21,6 +21,7 @@ from ray_tpu.parallel import mesh as mesh_lib
 from ray_tpu.parallel.mesh import MeshPlan
 from ray_tpu.parallel.pipeline import pipeline_apply, split_stages
 from ray_tpu.parallel.ring import make_ring_attn_fn
+from ray_tpu.parallel.ulysses import make_ulysses_attn_fn
 
 
 def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1, warmup: int = 100, grad_clip: float = 1.0):
@@ -32,9 +33,12 @@ def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1, warmup: int = 10
 
 
 def build_loss_fn(cfg: tf.TransformerConfig, plan: MeshPlan, mesh: Mesh, num_microbatches: int = 4):
-    """Loss with the plan's parallelism baked in (ring attention for sp>1,
-    GPipe for pp>1)."""
-    attn_fn = make_ring_attn_fn(mesh) if plan.sp > 1 else None
+    """Loss with the plan's parallelism baked in (ring or Ulysses
+    attention for sp>1 per ``plan.sp_mode``, GPipe for pp>1)."""
+    attn_fn = None
+    if plan.sp > 1:
+        make = {"ring": make_ring_attn_fn, "ulysses": make_ulysses_attn_fn}[plan.sp_mode]
+        attn_fn = make(mesh)
 
     if plan.pp == 1:
         def loss(params, batch):

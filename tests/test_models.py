@@ -78,13 +78,13 @@ def test_flash_attention_matches_reference_interpret():
         for kk in jax.random.split(key, 3)
     )
     ref = reference_attention(q, k, v, causal=True)
-    out = att._flash_forward(q, k, v, causal=True, scale=64**-0.5, block_q=64, block_k=64, interpret=True)
+    out, _ = att._flash_forward(q, k, v, causal=True, scale=64**-0.5, block_q=64, block_k=64, interpret=True)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-2, atol=2e-2)
     # Structural causality check is exact: a change in future keys/values
     # must not perturb earlier rows at all.
     k2 = k.at[:, :, 100:].add(1.0)
     v2 = v.at[:, :, 100:].add(1.0)
-    out2 = att._flash_forward(q, k2, v2, causal=True, scale=64**-0.5, block_q=64, block_k=64, interpret=True)
+    out2, _ = att._flash_forward(q, k2, v2, causal=True, scale=64**-0.5, block_q=64, block_k=64, interpret=True)
     np.testing.assert_array_equal(np.asarray(out[:, :, :100]), np.asarray(out2[:, :, :100]))
 
 
@@ -97,7 +97,7 @@ def test_flash_attention_noncausal_interpret():
         for kk in jax.random.split(key, 3)
     )
     ref = reference_attention(q, k, v, causal=False)
-    out = att._flash_forward(q, k, v, causal=False, scale=64**-0.5, block_q=64, block_k=64, interpret=True)
+    out, _ = att._flash_forward(q, k, v, causal=False, scale=64**-0.5, block_q=64, block_k=64, interpret=True)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-2, atol=2e-2)
 
 
@@ -118,3 +118,32 @@ def test_flash_attention_grad_matches():
     g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal,q_len,k_len", [(True, 128, 128), (False, 96, 160)],
+                         ids=["causal", "noncausal_ragged"])
+def test_flash_backward_kernels_match_reference(causal, q_len, k_len):
+    """Pallas dQ/dKV kernels (interpret mode) vs the reference VJP,
+    including ragged lengths that exercise both pad paths."""
+    from ray_tpu.ops import attention as att
+
+    key = jax.random.PRNGKey(7)
+    kq, kk_, kv, kg = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (2, 2, q_len, 64), jnp.float32)
+    k = jax.random.normal(kk_, (2, 2, k_len, 64), jnp.float32)
+    v = jax.random.normal(kv, (2, 2, k_len, 64), jnp.float32)
+    g = jax.random.normal(kg, (2, 2, q_len, 64), jnp.float32)
+    scale = 64**-0.5
+
+    o, lse = att._flash_forward(q, k, v, causal=causal, scale=scale,
+                                block_q=64, block_k=64, interpret=True)
+    dq, dk, dv = att._flash_backward(q, k, v, o, lse, g, causal=causal, scale=scale,
+                                     block_q=64, block_k=64, interpret=True)
+
+    def f_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=causal, scale=scale) * g).sum()
+
+    rq, rk, rv = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), rtol=2e-2, atol=2e-2)

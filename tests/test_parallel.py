@@ -115,6 +115,29 @@ def test_ring_attention_matches_reference_directly():
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-5, atol=2e-5)
 
 
+def test_ulysses_attention_matches_reference_directly():
+    from ray_tpu.ops.attention import reference_attention
+    from ray_tpu.parallel.ulysses import make_ulysses_attn_fn
+
+    plan = MeshPlan(sp=4)  # 4-way SP, 4 heads → 1 head/device after swap
+    mesh = build_mesh(plan, devices=jax.devices()[:4])
+    key = jax.random.PRNGKey(0)
+    q, k, v = (
+        jax.random.normal(kk, (2, 4, 64, 16), jnp.float32)
+        for kk in jax.random.split(key, 3)
+    )
+    with jax.default_matmul_precision("highest"):
+        ref = jax.jit(lambda q, k, v: reference_attention(q, k, v, causal=True))(q, k, v)
+        out = jax.jit(make_ulysses_attn_fn(mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-5, atol=2e-5)
+
+
+def test_sequence_parallel_ulysses(ref_setup):
+    plan = MeshPlan(dp=2, sp=4, sp_mode="ulysses")
+    loss, ref = _plan_loss(plan, ref_setup)
+    assert abs(loss - ref) < 2e-4, (loss, ref)
+
+
 def test_train_state_and_step_fsdp():
     """Full sharded train loop: loss decreases, params stay sharded."""
     plan = MeshPlan(fsdp=4, tp=2)
