@@ -4,12 +4,16 @@ Reference: rllib/ (new API stack: Algorithm/EnvRunner/RLModule/Learner).
 """
 from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, EnvRunnerGroup
+from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.episodes import SingleAgentEpisode, compute_gae, episodes_to_batch
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace_returns
 from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.offline import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
-from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.rl_module import QRLModule, RLModule, RLModuleSpec, SACRLModule, make_module
+from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm",
@@ -21,6 +25,9 @@ __all__ = [
     "compute_gae",
     "episodes_to_batch",
     "RLModule",
+    "QRLModule",
+    "SACRLModule",
+    "make_module",
     "RLModuleSpec",
     "Learner",
     "LearnerGroup",
@@ -29,4 +36,14 @@ __all__ = [
     "IMPALA",
     "IMPALAConfig",
     "vtrace_returns",
+    "DQN",
+    "DQNConfig",
+    "SAC",
+    "SACConfig",
+    "BC",
+    "BCConfig",
+    "MARWIL",
+    "MARWILConfig",
+    "ReplayBuffer",
+    "PrioritizedReplayBuffer",
 ]

@@ -1,0 +1,88 @@
+"""DQN — double Q-learning with target network and (optionally
+prioritized) replay.
+
+Reference: rllib/algorithms/dqn/dqn.py (training_step: sample → store →
+replay updates → target sync) and dqn_rainbow_learner's TD loss. The
+TPU-native differences: the update is one jitted step (double-DQN target
+computed in-graph), and ε-greedy exploration ships inside the params
+tree so runner sync is a single object-store put.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.off_policy import OffPolicyAlgorithm, OffPolicyConfig
+from ray_tpu.rllib.rl_module import RLModuleSpec
+
+
+def dqn_loss(module, params, batch, gamma: float = 0.99, use_huber: bool = True):
+    import jax
+    import jax.numpy as jnp
+
+    obs, actions = batch["obs"], batch["actions"]
+    n = obs.shape[0]
+    ar = jnp.arange(n)
+    q_all = module.q_values(params["q"], obs)
+    q_sel = q_all[ar, actions]
+
+    # Double DQN: online net picks a*, target net evaluates it.
+    q_next_online = module.q_values(params["q"], batch["next_obs"])
+    a_star = jnp.argmax(q_next_online, axis=-1)
+    target_head = jax.tree.map(jax.lax.stop_gradient, params["target"])
+    q_next_target = module.q_values(target_head, batch["next_obs"])
+    target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * q_next_target[ar, a_star]
+
+    td = q_sel - jax.lax.stop_gradient(target)
+    if use_huber:
+        err = jnp.where(jnp.abs(td) <= 1.0, 0.5 * td * td, jnp.abs(td) - 0.5)
+    else:
+        err = 0.5 * td * td
+    loss = jnp.mean(batch["weights"] * err)
+    return loss, {
+        "mean_q": jnp.mean(q_sel),
+        "td_error_mean": jnp.mean(jnp.abs(td)),
+        "td_errors": td,  # per-sample, consumed by prioritized replay
+    }
+
+
+class DQNConfig(OffPolicyConfig):
+    def __init__(self):
+        super().__init__()
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 10_000
+        self.use_huber = True
+
+    def module_spec(self) -> RLModuleSpec:
+        spec = super().module_spec()
+        spec.kind = "q"
+        return spec
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN(OffPolicyAlgorithm):
+    loss_fn = staticmethod(dqn_loss)
+    target_pairs = (("q", "target"),)
+
+    def _loss_cfg(self) -> dict:
+        return dict(gamma=self.config.gamma, use_huber=self.config.use_huber)
+
+    def current_epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self._total_env_steps / max(1, c.epsilon_decay_steps))
+        return float(c.epsilon_initial + frac * (c.epsilon_final - c.epsilon_initial))
+
+    def _explore_hook(self, weights: Dict[str, Any]) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        weights["epsilon"] = jnp.asarray(self.current_epsilon(), jnp.float32)
+        return weights
+
+    def training_step(self) -> Dict[str, Any]:
+        out = super().training_step()
+        out["epsilon"] = self.current_epsilon()
+        return out

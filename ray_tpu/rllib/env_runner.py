@@ -13,7 +13,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ray_tpu.rllib.episodes import SingleAgentEpisode
-from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
+from ray_tpu.rllib.rl_module import RLModuleSpec, make_module
 
 
 def _make_env(env_spec):
@@ -43,7 +43,7 @@ class SingleAgentEnvRunner:
 
         self._envs = [_make_env(env_spec) for _ in range(num_envs)]
         self._num_envs = num_envs
-        self.module = RLModule(module_spec)
+        self.module = make_module(module_spec)
         self.params = self.module.init_params(jax.random.PRNGKey(seed))
         self._key = jax.random.PRNGKey(seed * 100003 + worker_index)
         self._explore = jax.jit(self.module.forward_exploration)
@@ -102,13 +102,7 @@ class SingleAgentEnvRunner:
                     ep.terminated = bool(term)
                     ep.truncated = bool(trunc)
                     if trunc:
-                        ep.final_value = float(
-                            np.asarray(
-                                self.module.forward_train(
-                                    self.params, jnp.asarray(nobs[None].astype(np.float32))
-                                )["vf"]
-                            )[0]
-                        )
+                        ep.final_value = self._bootstrap_value(nobs)
                     done_eps.append(ep)
                     nobs = env.reset()[0]
                     self._episodes[i] = SingleAgentEpisode(observations=[nobs])
@@ -117,19 +111,24 @@ class SingleAgentEnvRunner:
         for i in range(self._num_envs):
             ep = self._episodes[i]
             if len(ep) > 0:
-                import jax.numpy as jnp
-
                 ep.truncated = True
-                ep.final_value = float(
-                    np.asarray(
-                        self.module.forward_train(
-                            self.params, jnp.asarray(self._obs[i][None].astype(np.float32))
-                        )["vf"]
-                    )[0]
-                )
+                ep.final_value = self._bootstrap_value(self._obs[i])
                 done_eps.append(ep)
                 self._episodes[i] = SingleAgentEpisode(observations=[self._obs[i]])
         return done_eps
+
+    def _bootstrap_value(self, obs) -> float:
+        """V(s) for truncation bootstrap; value-less module families
+        (DQN/SAC) return 0 — their losses bootstrap through next_obs in
+        the replay buffer instead."""
+        import jax.numpy as jnp
+
+        out = self.module.forward_train(
+            self.params, jnp.asarray(obs[None].astype(np.float32))
+        )
+        if "vf" not in out:
+            return 0.0
+        return float(np.asarray(out["vf"])[0])
 
     def pop_metrics(self) -> List[float]:
         """Completed-episode returns since the last call (true returns,

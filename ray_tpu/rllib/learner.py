@@ -20,7 +20,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ray_tpu.rllib.rl_module import Params, RLModule, RLModuleSpec
+from ray_tpu.rllib.rl_module import Params, RLModule, RLModuleSpec, make_module
 
 LossFn = Callable[..., Any]  # (module, params, batch, **cfg) -> (loss, metrics)
 
@@ -44,7 +44,7 @@ class Learner:
         import jax
         import optax
 
-        self.module = RLModule(module_spec)
+        self.module = make_module(module_spec)
         self.params = self.module.init_params(jax.random.PRNGKey(seed))
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(grad_clip), optax.adam(lr)
@@ -140,7 +140,13 @@ class Learner:
             self.params = optax.apply_updates(self.params, updates)
         else:
             self.params, self.opt_state = new_params, new_opt
-        return {k: float(np.asarray(v)) for k, v in metrics.items()}
+        out = {}
+        for k, v in metrics.items():
+            arr = np.asarray(v)
+            # Scalars become floats; per-sample arrays (e.g. td_errors for
+            # prioritized replay) pass through.
+            out[k] = float(arr) if arr.ndim == 0 else arr
+        return out
 
     def get_weights(self) -> Params:
         return self.params
@@ -241,7 +247,13 @@ class LearnerGroup:
         all_metrics = ray_tpu.get(refs)
         out = {}
         for k in all_metrics[0]:
-            out[k] = float(np.mean([m[k] for m in all_metrics]))
+            vals = [m[k] for m in all_metrics]
+            if np.ndim(vals[0]) == 0:
+                out[k] = float(np.mean(vals))
+            else:
+                # Per-sample arrays: shards were contiguous row ranges in
+                # order, so concatenation restores batch order.
+                out[k] = np.concatenate(vals)
         return out
 
     def get_weights(self) -> Params:

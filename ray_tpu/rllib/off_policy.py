@@ -1,0 +1,108 @@
+"""Shared off-policy training loop (DQN/SAC).
+
+Reference: rllib/algorithms/dqn/dqn.py training_step — sample →
+replay-buffer add → N replay updates → periodic target-net sync →
+weight sync to runners. The loop is algorithm-agnostic; the loss and the
+module family differ.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+
+
+class OffPolicyConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.buffer_size = 50_000
+        self.prioritized_replay = False
+        self.per_alpha = 0.6
+        self.per_beta = 0.4
+        self.learning_starts = 1000
+        self.target_update_freq = 200  # in learner updates
+        self.num_updates_per_iter = 32
+        self.train_batch_size = 64
+        self.rollout_fragment_length = 4
+        self.lr = 1e-3
+        self.gamma = 0.99
+
+
+class OffPolicyAlgorithm(Algorithm):
+    # Names of param subtrees to copy online → target on sync.
+    target_pairs = ()  # e.g. (("q", "target"),)
+
+    def __init__(self, config: OffPolicyConfig):
+        super().__init__(config)
+        if config.prioritized_replay:
+            self.buffer = PrioritizedReplayBuffer(
+                config.buffer_size, config.per_alpha, config.per_beta, seed=config.seed
+            )
+        else:
+            self.buffer = ReplayBuffer(config.buffer_size, seed=config.seed)
+        self._num_updates = 0
+
+    # -- target networks -------------------------------------------------
+    def _sync_target(self):
+        """Hard-copy online → target subtrees (reference: DQN
+        target_network_update_freq)."""
+        import jax
+
+        state = self.learner_group.get_state()
+        params = state["params"]
+        for online, target in type(self).target_pairs:
+            params[target] = jax.tree.map(lambda x: x, params[online])
+        self.learner_group.set_state(state)
+
+    def _explore_hook(self, weights: Dict[str, Any]) -> Dict[str, Any]:
+        """Subclass hook: mutate the weights shipped to runners (e.g. set
+        the ε-greedy schedule value)."""
+        return weights
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        n_sources = max(1, self.env_runner_group.num_remote_runners)
+        episodes = self.env_runner_group.sample(
+            cfg.rollout_fragment_length * n_sources * cfg.num_envs_per_runner
+        )
+        env_steps = sum(len(e) for e in episodes)
+        self._total_env_steps += env_steps
+        self.buffer.add_episodes(episodes)
+
+        metrics: Dict[str, float] = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iter):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                idx = mb.pop("idx")
+                metrics = self.learner_group.update_from_batch(mb)
+                td = metrics.pop("td_errors", None)
+                if td is not None:
+                    # The learner may pad the batch to its device-mesh size;
+                    # padded rows carry no buffer slot.
+                    self.buffer.update_priorities(idx, np.asarray(td)[: len(idx)])
+                self._num_updates += 1
+                if self._num_updates % cfg.target_update_freq == 0:
+                    self._sync_target()
+
+        weights = dict(self.learner_group.get_weights())
+        self.env_runner_group.sync_weights(self._explore_hook(weights))
+
+        returns = self.env_runner_group.pop_metrics()
+        if returns:
+            self._recent_returns = (getattr(self, "_recent_returns", []) + returns)[-100:]
+        mean_ret = (
+            float(np.mean(self._recent_returns))
+            if getattr(self, "_recent_returns", None)
+            else 0.0
+        )
+        return {
+            "env_steps_this_iter": env_steps,
+            "episode_return_mean": mean_ret,
+            "num_episodes": len(returns),
+            "buffer_size": len(self.buffer),
+            "num_learner_updates": self._num_updates,
+            **{f"learner/{k}": v for k, v in metrics.items() if np.ndim(v) == 0},
+        }

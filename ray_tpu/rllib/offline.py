@@ -1,0 +1,155 @@
+"""Offline RL: BC and MARWIL.
+
+Reference: rllib/algorithms/bc (plain imitation; the reference implements
+BC as MARWIL with beta=0) and rllib/algorithms/marwil
+(advantage-weighted imitation, offline_data.py / offline_prelearner.py
+for the data path). Data here is a list of episodes or a flat batch —
+the streaming ingest path (ray_tpu.data.Dataset.iter_batches) plugs in
+by producing the same dict layout.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.episodes import SingleAgentEpisode
+
+
+def episodes_to_offline_batch(
+    episodes: List[SingleAgentEpisode], gamma: float = 0.99
+) -> Dict[str, np.ndarray]:
+    """Episodes → {obs, actions, returns} with discounted returns-to-go
+    (bootstrapped through truncation)."""
+    obs, acts, rets = [], [], []
+    for ep in episodes:
+        T = len(ep)
+        if T == 0:
+            continue
+        r = np.asarray(ep.rewards, dtype=np.float32)
+        R = np.zeros(T, dtype=np.float32)
+        acc = 0.0 if ep.terminated else float(ep.final_value)
+        for t in range(T - 1, -1, -1):
+            acc = r[t] + gamma * acc
+            R[t] = acc
+        obs.append(np.asarray(ep.observations[:T], dtype=np.float32))
+        acts.append(np.asarray(ep.actions, dtype=np.int32))
+        rets.append(R)
+    return {
+        "obs": np.concatenate(obs),
+        "actions": np.concatenate(acts),
+        "returns": np.concatenate(rets),
+    }
+
+
+def marwil_loss(
+    module,
+    params,
+    batch,
+    beta: float = 1.0,
+    vf_coeff: float = 1.0,
+    entropy_coeff: float = 0.0,
+):
+    """MARWIL objective: exp(β·Â)-weighted log-likelihood + value
+    regression; β=0 reduces to plain BC (reference: marwil_learner)."""
+    import jax
+    import jax.numpy as jnp
+
+    out = module.logp_entropy(params, batch["obs"], batch["actions"])
+    logp, vf = out["logp"], out["vf"]
+    if beta > 0:
+        adv = batch["returns"] - vf
+        # Per-batch moving-free normalization (reference keeps a running
+        # MA of the squared advantage; a batch estimate is the same
+        # quantity without cross-step state).
+        norm = jnp.sqrt(jnp.mean(jax.lax.stop_gradient(adv) ** 2) + 1e-8)
+        weights = jnp.exp(jnp.clip(beta * jax.lax.stop_gradient(adv) / norm, -5.0, 5.0))
+        vf_loss = jnp.mean(adv**2)
+    else:
+        weights = jnp.ones_like(logp)
+        vf_loss = jnp.asarray(0.0)
+    policy_loss = -jnp.mean(weights * logp)
+    entropy = jnp.mean(out["entropy"])
+    loss = policy_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+    return loss, {
+        "policy_loss": policy_loss,
+        "vf_loss": vf_loss,
+        "entropy": entropy,
+    }
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.beta = 1.0
+        self.vf_coeff = 1.0
+        self.entropy_coeff = 0.0
+        self.train_batch_size = 256
+        self.num_updates_per_iter = 16
+        self._offline_episodes: Optional[List[SingleAgentEpisode]] = None
+        self._offline_batch: Optional[Dict[str, np.ndarray]] = None
+
+    def offline_data(
+        self,
+        episodes: Optional[List[SingleAgentEpisode]] = None,
+        batch: Optional[Dict[str, np.ndarray]] = None,
+    ) -> "MARWILConfig":
+        self._offline_episodes = episodes
+        self._offline_batch = batch
+        return self
+
+    def build(self) -> "MARWIL":
+        return MARWIL(self)
+
+
+class BCConfig(MARWILConfig):
+    """BC = MARWIL with beta=0 (exactly the reference's relationship)."""
+
+    def __init__(self):
+        super().__init__()
+        self.beta = 0.0
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class MARWIL(Algorithm):
+    loss_fn = staticmethod(marwil_loss)
+
+    def __init__(self, config: MARWILConfig):
+        super().__init__(config)
+        if config._offline_batch is not None:
+            self._data = dict(config._offline_batch)
+        elif config._offline_episodes is not None:
+            self._data = episodes_to_offline_batch(
+                config._offline_episodes, gamma=config.gamma
+            )
+        else:
+            raise ValueError("MARWIL/BC requires .offline_data(...)")
+        if "returns" not in self._data:
+            self._data["returns"] = np.zeros(len(self._data["obs"]), np.float32)
+        self._rng = np.random.default_rng(config.seed)
+
+    def _loss_cfg(self) -> dict:
+        c = self.config
+        return dict(beta=c.beta, vf_coeff=c.vf_coeff, entropy_coeff=c.entropy_coeff)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        rows = len(self._data["obs"])
+        metrics: Dict[str, float] = {}
+        for _ in range(cfg.num_updates_per_iter):
+            idx = self._rng.integers(0, rows, cfg.train_batch_size)
+            mb = {k: v[idx] for k, v in self._data.items()}
+            metrics = self.learner_group.update_from_batch(mb)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        return {
+            "env_steps_this_iter": 0,
+            "offline_samples_trained": cfg.num_updates_per_iter * cfg.train_batch_size,
+            **{f"learner/{k}": v for k, v in metrics.items()},
+        }
+
+
+class BC(MARWIL):
+    pass

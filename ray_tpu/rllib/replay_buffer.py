@@ -1,0 +1,128 @@
+"""Replay buffers for off-policy algorithms.
+
+Reference: rllib/utils/replay_buffers/ (EpisodeReplayBuffer,
+PrioritizedEpisodeReplayBuffer). Re-designed around flat numpy transition
+arrays instead of episode lists: the learner consumes fixed-shape
+minibatches, which keeps the jitted TPU update static-shaped, and numpy
+ring buffers make sampling O(batch) with no per-episode bookkeeping.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.episodes import SingleAgentEpisode
+
+
+def episodes_to_transitions(
+    episodes: List[SingleAgentEpisode],
+) -> Dict[str, np.ndarray]:
+    """Flatten episodes into (obs, action, reward, next_obs, done) arrays.
+
+    ``done`` is 1 only on a *terminated* final transition — truncation
+    (fragment cut or time limit) still bootstraps through next_obs.
+    """
+    obs, acts, rews, nobs, dones = [], [], [], [], []
+    for ep in episodes:
+        T = len(ep)
+        if T == 0:
+            continue
+        o = np.asarray(ep.observations, dtype=np.float32)  # [T+1, d]
+        obs.append(o[:T])
+        nobs.append(o[1 : T + 1])
+        acts.append(np.asarray(ep.actions, dtype=np.int32))
+        rews.append(np.asarray(ep.rewards, dtype=np.float32))
+        d = np.zeros(T, dtype=np.float32)
+        if ep.terminated:
+            d[-1] = 1.0
+        dones.append(d)
+    return {
+        "obs": np.concatenate(obs),
+        "actions": np.concatenate(acts),
+        "rewards": np.concatenate(rews),
+        "next_obs": np.concatenate(nobs),
+        "dones": np.concatenate(dones),
+    }
+
+
+class ReplayBuffer:
+    """Uniform ring buffer over flat transitions."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._store: Optional[Dict[str, np.ndarray]] = None
+        self._size = 0
+        self._next = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_episodes(self, episodes: List[SingleAgentEpisode]):
+        batch = episodes_to_transitions(episodes)
+        n = len(batch["obs"])
+        if n == 0:
+            return
+        if self._store is None:
+            self._store = {
+                k: np.zeros((self.capacity,) + v.shape[1:], v.dtype)
+                for k, v in batch.items()
+            }
+        for ofs in range(0, n, self.capacity):
+            chunk = {k: v[ofs : ofs + self.capacity] for k, v in batch.items()}
+            m = len(chunk["obs"])
+            idx = (self._next + np.arange(m)) % self.capacity
+            for k, v in chunk.items():
+                self._store[k][idx] = v
+            self._next = int((self._next + m) % self.capacity)
+            self._size = min(self.capacity, self._size + m)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._size, batch_size)
+        out = {k: v[idx] for k, v in self._store.items()}
+        out["weights"] = np.ones(batch_size, np.float32)
+        out["idx"] = idx.astype(np.int64)
+        return out
+
+    def update_priorities(self, idx: np.ndarray, priorities: np.ndarray):
+        pass  # uniform buffer: no-op
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized replay (reference:
+    PrioritizedEpisodeReplayBuffer; Schaul et al. PER). Priorities are
+    kept as a flat array and sampling normalizes on the fly — at the
+    transition counts an RL learner on one host sees, the O(n) normalize
+    is cheaper than maintaining a sum-tree in Python."""
+
+    def __init__(self, capacity: int, alpha: float = 0.6, beta: float = 0.4, seed: int = 0):
+        super().__init__(capacity, seed)
+        self.alpha = alpha
+        self.beta = beta
+        self._prios = np.zeros(capacity, np.float32)
+        self._max_prio = 1.0
+
+    def add_episodes(self, episodes: List[SingleAgentEpisode]):
+        before_next = self._next
+        n = min(sum(len(ep) for ep in episodes), self.capacity)
+        super().add_episodes(episodes)
+        # New transitions enter at max priority so they are seen at least once.
+        idx = (before_next + np.arange(n)) % self.capacity
+        self._prios[idx] = self._max_prio
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        p = self._prios[: self._size] ** self.alpha
+        p = p / p.sum()
+        idx = self._rng.choice(self._size, batch_size, p=p)
+        out = {k: v[idx] for k, v in self._store.items()}
+        # Importance weights, normalized by the max for stability.
+        w = (self._size * p[idx]) ** (-self.beta)
+        out["weights"] = (w / w.max()).astype(np.float32)
+        out["idx"] = idx.astype(np.int64)
+        return out
+
+    def update_priorities(self, idx: np.ndarray, priorities: np.ndarray):
+        prios = np.abs(priorities) + 1e-6
+        self._prios[idx] = prios
+        self._max_prio = max(self._max_prio, float(prios.max()))

@@ -27,6 +27,9 @@ class RLModuleSpec:
     hidden: Tuple[int, ...] = (64, 64)
     free_log_std: bool = False  # continuous-action stddev as free params
     discrete: bool = True
+    # Module family: "pg" (policy+value, PPO/IMPALA/BC), "q" (value-based,
+    # DQN), "sac" (policy + twin Q + temperature).
+    kind: str = "pg"
 
 
 class RLModule:
@@ -41,26 +44,30 @@ class RLModule:
         self.spec = spec
 
     # -- params ----------------------------------------------------------
-    def init_params(self, key: jax.Array) -> Params:
+    def _head(self, key: jax.Array, out_dim: Optional[int] = None) -> Params:
+        """One MLP head: He-scaled hidden layers, 0.01-scaled output."""
+        out_dim = self.spec.action_dim if out_dim is None else out_dim
         sizes = (self.spec.observation_dim,) + tuple(self.spec.hidden)
-        params: Params = {"pi": {}, "vf": {}}
-        keys = jax.random.split(key, 2 * len(sizes) + 2)
-        ki = 0
-        for head, out_dim in (("pi", self.spec.action_dim), ("vf", 1)):
-            layers = {}
-            for i in range(len(sizes) - 1):
-                layers[f"w{i}"] = (
-                    jax.random.normal(keys[ki], (sizes[i], sizes[i + 1]))
-                    * np.sqrt(2.0 / sizes[i])
-                ).astype(jnp.float32)
-                layers[f"b{i}"] = jnp.zeros(sizes[i + 1])
-                ki += 1
-            layers["w_out"] = (
-                jax.random.normal(keys[ki], (sizes[-1], out_dim)) * 0.01
+        keys = jax.random.split(key, len(sizes))
+        layers: Params = {}
+        for i in range(len(sizes) - 1):
+            layers[f"w{i}"] = (
+                jax.random.normal(keys[i], (sizes[i], sizes[i + 1]))
+                * np.sqrt(2.0 / sizes[i])
             ).astype(jnp.float32)
-            layers["b_out"] = jnp.zeros(out_dim)
-            ki += 1
-            params[head] = layers
+            layers[f"b{i}"] = jnp.zeros(sizes[i + 1])
+        layers["w_out"] = (
+            jax.random.normal(keys[-1], (sizes[-1], out_dim)) * 0.01
+        ).astype(jnp.float32)
+        layers["b_out"] = jnp.zeros(out_dim)
+        return layers
+
+    def init_params(self, key: jax.Array) -> Params:
+        k_pi, k_vf = jax.random.split(key)
+        params: Params = {
+            "pi": self._head(k_pi, self.spec.action_dim),
+            "vf": self._head(k_vf, 1),
+        }
         if not self.spec.discrete and self.spec.free_log_std:
             params["log_std"] = jnp.zeros(self.spec.action_dim)
         return params
@@ -103,3 +110,89 @@ class RLModule:
         logp = logsm[jnp.arange(logits.shape[0]), actions]
         entropy = -jnp.sum(jnp.exp(logsm) * logsm, axis=-1)
         return {"logp": logp, "entropy": entropy, "vf": out["vf"], "logits": logits}
+
+
+class QRLModule(RLModule):
+    """Value-based module: one MLP mapping obs → Q(s, ·), plus a target
+    copy (reference: rllib/algorithms/dqn — DefaultDQNRLModule with
+    target network). Exploration is ε-greedy; ε rides in the params tree
+    so weight sync (learner → runner) carries the schedule with it."""
+
+    def init_params(self, key: jax.Array) -> Params:
+        q = self._head(key)
+        return {
+            "q": q,
+            "target": jax.tree.map(jnp.copy, q),
+            "epsilon": jnp.asarray(1.0, jnp.float32),
+        }
+
+    def q_values(self, head: Params, obs: jax.Array) -> jax.Array:
+        return self._mlp(head, obs)
+
+    def forward_train(self, params: Params, obs: jax.Array) -> Dict[str, jax.Array]:
+        return {"q": self.q_values(params["q"], obs)}
+
+    def forward_inference(self, params: Params, obs: jax.Array) -> jax.Array:
+        return jnp.argmax(self.q_values(params["q"], obs), axis=-1)
+
+    def forward_exploration(
+        self, params: Params, obs: jax.Array, key: jax.Array
+    ) -> Dict[str, jax.Array]:
+        q = self.q_values(params["q"], obs)
+        greedy = jnp.argmax(q, axis=-1)
+        k_u, k_a = jax.random.split(key)
+        n = obs.shape[0]
+        random_a = jax.random.randint(k_a, (n,), 0, self.spec.action_dim)
+        explore = jax.random.uniform(k_u, (n,)) < params["epsilon"]
+        action = jnp.where(explore, random_a, greedy)
+        zeros = jnp.zeros((n,), jnp.float32)
+        # logp/vf filled for the runner's episode bookkeeping; unused by DQN.
+        return {"action": action, "logp": zeros, "vf": zeros}
+
+
+class SACRLModule(RLModule):
+    """Discrete soft actor-critic module: categorical policy, twin Q heads
+    with target copies, and a learnable temperature (reference:
+    rllib/algorithms/sac — DefaultSACRLModule; discrete variant computes
+    exact expectations over the action set instead of reparameterized
+    samples)."""
+
+    def init_params(self, key: jax.Array) -> Params:
+        k_pi, k_q1, k_q2 = jax.random.split(key, 3)
+        pi = self._head(k_pi)
+        q1 = self._head(k_q1)
+        q2 = self._head(k_q2)
+        return {
+            "pi": pi,
+            "q1": q1,
+            "q2": q2,
+            "q1_target": jax.tree.map(jnp.copy, q1),
+            "q2_target": jax.tree.map(jnp.copy, q2),
+            "log_alpha": jnp.asarray(0.0, jnp.float32),
+        }
+
+    def forward_train(self, params: Params, obs: jax.Array) -> Dict[str, jax.Array]:
+        return {
+            "logits": self._mlp(params["pi"], obs),
+            "q1": self._mlp(params["q1"], obs),
+            "q2": self._mlp(params["q2"], obs),
+        }
+
+    def forward_inference(self, params: Params, obs: jax.Array) -> jax.Array:
+        return jnp.argmax(self._mlp(params["pi"], obs), axis=-1)
+
+    def forward_exploration(
+        self, params: Params, obs: jax.Array, key: jax.Array
+    ) -> Dict[str, jax.Array]:
+        logits = self._mlp(params["pi"], obs)
+        action = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)[jnp.arange(logits.shape[0]), action]
+        zeros = jnp.zeros((obs.shape[0],), jnp.float32)
+        return {"action": action, "logp": logp, "vf": zeros}
+
+
+def make_module(spec: RLModuleSpec) -> RLModule:
+    """Module factory keyed on ``spec.kind`` (reference analogue:
+    RLModuleSpec.build resolving the module class)."""
+    cls = {"pg": RLModule, "q": QRLModule, "sac": SACRLModule}[spec.kind]
+    return cls(spec)
