@@ -20,6 +20,7 @@ from ray_tpu.tune.search import (
     sample_from,
     uniform,
 )
+from ray_tpu.tune.suggest import BayesOptSearcher, Repeater, TPESearcher
 from ray_tpu.tune.trial import (
     Trial,
     get_checkpoint_dir,
@@ -60,6 +61,9 @@ __all__ = [
     "Searcher",
     "BasicVariantGenerator",
     "ConcurrencyLimiter",
+    "TPESearcher",
+    "BayesOptSearcher",
+    "Repeater",
     "TrialScheduler",
     "FIFOScheduler",
     "AsyncHyperBandScheduler",

@@ -1,0 +1,348 @@
+"""Model-based search algorithms: TPE and GP-based Bayesian optimization.
+
+Reference: python/ray/tune/search/ ships these as external-library
+wrappers (hyperopt → TPE, bayesopt → GP-EI, optuna → TPE by default).
+There is no external dependency to wrap here, so the algorithms are
+implemented natively in numpy against the same ``Searcher`` interface —
+functionally covering the hyperopt/optuna/bayesopt searcher family.
+
+Both searchers optimize over the same search-space primitives
+(Uniform/LogUniform/Randint/Choice) by mapping every dimension to a unit
+hypercube internally; Choice dimensions are one-hot-scored (TPE) or
+indicator-embedded (GP).
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.tune.search import (
+    Choice,
+    Domain,
+    GridSearch,
+    LogUniform,
+    Randint,
+    Searcher,
+    Uniform,
+)
+
+
+class _Space:
+    """Search space ↔ unit-hypercube codec."""
+
+    def __init__(self, param_space: Dict[str, Any]):
+        self.fixed: Dict[str, Any] = {}
+        self.dims: List[Tuple[str, Any]] = []
+        for k, v in param_space.items():
+            if isinstance(v, GridSearch):
+                raise ValueError("grid_search is not supported by model-based searchers")
+            if isinstance(v, (Uniform, LogUniform, Randint, Choice)):
+                self.dims.append((k, v))
+            elif isinstance(v, Domain):
+                raise ValueError(f"unsupported domain for model-based search: {k}")
+            else:
+                self.fixed[k] = v
+
+    @property
+    def ndim(self) -> int:
+        return len(self.dims)
+
+    def decode(self, u: np.ndarray) -> Dict[str, Any]:
+        cfg = dict(self.fixed)
+        for x, (k, dom) in zip(u, self.dims):
+            if isinstance(dom, Uniform):
+                cfg[k] = dom.low + x * (dom.high - dom.low)
+            elif isinstance(dom, LogUniform):
+                cfg[k] = math.exp(dom.lo + x * (dom.hi - dom.lo))
+            elif isinstance(dom, Randint):
+                cfg[k] = min(dom.high - 1, int(dom.low + x * (dom.high - dom.low)))
+            elif isinstance(dom, Choice):
+                n = len(dom.categories)
+                cfg[k] = dom.categories[min(n - 1, int(x * n))]
+        return cfg
+
+    def encode(self, cfg: Dict[str, Any]) -> Optional[np.ndarray]:
+        """Inverse of decode (experiment-restore path): a concrete config
+        back to unit-cube coordinates. None if any dimension is absent."""
+        u = np.zeros(len(self.dims))
+        for i, (k, dom) in enumerate(self.dims):
+            if k not in cfg:
+                return None
+            v = cfg[k]
+            if isinstance(dom, Uniform):
+                span = dom.high - dom.low
+                u[i] = (v - dom.low) / span if span else 0.5
+            elif isinstance(dom, LogUniform):
+                span = dom.hi - dom.lo
+                u[i] = (math.log(v) - dom.lo) / span if span else 0.5
+            elif isinstance(dom, Randint):
+                span = dom.high - dom.low
+                u[i] = (v - dom.low + 0.5) / span if span else 0.5
+            elif isinstance(dom, Choice):
+                try:
+                    idx = dom.categories.index(v)
+                except ValueError:
+                    return None
+                u[i] = (idx + 0.5) / len(dom.categories)
+        return np.clip(u, 0.0, 1.0)
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (the hyperopt/optuna-default
+    algorithm; Bergstra et al. 2011). Observations are split into good/bad
+    by the γ-quantile; candidates maximize the density ratio l(x)/g(x)
+    under per-dimension Parzen (KDE) estimates in the unit cube."""
+
+    def __init__(
+        self,
+        param_space: Dict[str, Any],
+        metric: str = "loss",
+        mode: str = "min",
+        n_startup: int = 10,
+        n_candidates: int = 24,
+        gamma: float = 0.25,
+        num_samples: int = 64,
+        seed: Optional[int] = None,
+    ):
+        self._space = _Space(param_space)
+        self.metric, self.mode = metric, mode
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.gamma = gamma
+        self.num_samples = num_samples
+        self._suggested = 0
+        self._rng = np.random.default_rng(seed)
+        self._live: Dict[str, np.ndarray] = {}
+        self._obs: List[Tuple[np.ndarray, float]] = []
+
+    def set_search_properties(self, metric: Optional[str], mode: Optional[str]):
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+
+    # -- KDE machinery ----------------------------------------------------
+    def _kde_logpdf(self, pts: np.ndarray, x: np.ndarray) -> float:
+        """Sum over dims of log Parzen density at x given points [n, d].
+
+        Per-dimension Scott's-rule bandwidth (σ_j · n^{-1/5}): a tight good
+        set gets a sharp density while a spread-out bad set stays broad —
+        fixed bandwidths let boundary effects dominate the l/g ratio."""
+        n, d = pts.shape
+        total = 0.0
+        for j in range(d):
+            sd = float(pts[:, j].std())
+            bw = max(1e-2, (sd if sd > 1e-6 else 0.1) * max(1, n) ** -0.2)
+            z = (x[j] - pts[:, j]) / bw
+            comp = np.exp(-0.5 * z * z) / (bw * math.sqrt(2 * math.pi))
+            total += math.log(max(float(comp.mean()), 1e-12))
+        return total
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        d = self._space.ndim
+        if len(self._obs) < self.n_startup or d == 0:
+            u = self._rng.uniform(size=d)
+        else:
+            scores = np.array([s for _, s in self._obs])
+            order = np.argsort(scores)  # ascending; minimize internally
+            n_good = max(1, min(int(self.gamma * len(order)), len(order) - 1))
+            if n_good < 1 or len(order) - n_good < 1:
+                # Not enough observations to form both densities.
+                u = self._rng.uniform(size=d)
+            else:
+                good = np.stack([self._obs[i][0] for i in order[:n_good]])
+                bad = np.stack([self._obs[i][0] for i in order[n_good:]])
+                # Candidates drawn around good points; pick max l(x)/g(x).
+                best_u, best_score = None, -np.inf
+                for _ in range(self.n_candidates):
+                    center = good[self._rng.integers(len(good))]
+                    cand = np.clip(
+                        center + self._rng.normal(0, 0.2, size=d), 0.0, 1.0
+                    )
+                    score = self._kde_logpdf(good, cand) - self._kde_logpdf(bad, cand)
+                    if score > best_score:
+                        best_u, best_score = cand, score
+                u = best_u
+        self._live[trial_id] = u
+        return self._space.decode(u)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict] = None, error: bool = False):
+        u = self._live.pop(trial_id, None)
+        if u is None or error or not result or self.metric not in result:
+            return
+        val = float(result[self.metric])
+        self._obs.append((u, val if self.mode == "min" else -val))
+
+    def observe(self, trial_id: str, config: Dict[str, Any], result: Optional[dict]):
+        """Experiment-restore path: feed a restored (config, metric) pair
+        into the model without generating a suggestion."""
+        self._suggested += 1
+        if not result or self.metric not in result:
+            return
+        u = self._space.encode(config)
+        if u is not None:
+            val = float(result[self.metric])
+            self._obs.append((u, val if self.mode == "min" else -val))
+
+
+class BayesOptSearcher(Searcher):
+    """GP + Expected Improvement (reference: tune/search/bayesopt wraps
+    bayes_opt; same algorithm natively). Squared-exponential kernel GP
+    posterior over the unit cube; suggestions maximize EI over random
+    candidates."""
+
+    def __init__(
+        self,
+        param_space: Dict[str, Any],
+        metric: str = "loss",
+        mode: str = "min",
+        n_startup: int = 8,
+        n_candidates: int = 256,
+        length_scale: float = 0.25,
+        noise: float = 1e-4,
+        num_samples: int = 64,
+        seed: Optional[int] = None,
+    ):
+        self._space = _Space(param_space)
+        self.metric, self.mode = metric, mode
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.num_samples = num_samples
+        self._suggested = 0
+        self.ls = length_scale
+        self.noise = noise
+        self._rng = np.random.default_rng(seed)
+        self._live: Dict[str, np.ndarray] = {}
+        self._X: List[np.ndarray] = []
+        self._y: List[float] = []
+
+    def set_search_properties(self, metric: Optional[str], mode: Optional[str]):
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+
+    def _kernel(self, A: np.ndarray, B: np.ndarray) -> np.ndarray:
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (self.ls**2))
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        d = self._space.ndim
+        if len(self._y) < self.n_startup or d == 0:
+            u = self._rng.uniform(size=d)
+        else:
+            X = np.stack(self._X)
+            y = np.asarray(self._y)
+            mu_y, sd_y = y.mean(), max(y.std(), 1e-8)
+            yn = (y - mu_y) / sd_y
+            K = self._kernel(X, X) + self.noise * np.eye(len(X))
+            try:
+                L = np.linalg.cholesky(K)
+                alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+            except np.linalg.LinAlgError:
+                alpha = np.linalg.lstsq(K, yn, rcond=None)[0]
+                L = None
+            cand = self._rng.uniform(size=(self.n_candidates, d))
+            Ks = self._kernel(cand, X)  # [c, n]
+            mu = Ks @ alpha
+            if L is not None:
+                v = np.linalg.solve(L, Ks.T)
+                var = np.clip(1.0 - (v**2).sum(0), 1e-12, None)
+            else:
+                var = np.full(len(cand), 1e-2)
+            sd = np.sqrt(var)
+            best = yn.min()
+            z = (best - mu) / sd
+            # EI for minimization of the normalized objective.
+            from math import erf
+
+            cdf = 0.5 * (1.0 + np.vectorize(erf)(z / math.sqrt(2)))
+            pdf = np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+            ei = sd * (z * cdf + pdf)
+            u = cand[int(np.argmax(ei))]
+        self._live[trial_id] = u
+        return self._space.decode(u)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict] = None, error: bool = False):
+        u = self._live.pop(trial_id, None)
+        if u is None or error or not result or self.metric not in result:
+            return
+        val = float(result[self.metric])
+        self._X.append(u)
+        self._y.append(val if self.mode == "min" else -val)
+
+    def observe(self, trial_id: str, config: Dict[str, Any], result: Optional[dict]):
+        """Experiment-restore path (see TPESearcher.observe)."""
+        self._suggested += 1
+        if not result or self.metric not in result:
+            return
+        u = self._space.encode(config)
+        if u is not None:
+            val = float(result[self.metric])
+            self._X.append(u)
+            self._y.append(val if self.mode == "min" else -val)
+
+
+class Repeater(Searcher):
+    """Runs each underlying suggestion ``repeat`` times and reports the
+    averaged metric to the wrapped searcher (reference:
+    tune/search/repeater.py)."""
+
+    def __init__(self, searcher: Searcher, repeat: int = 3, metric: str = "loss"):
+        self.searcher = searcher
+        self.repeat = repeat
+        self.metric = metric
+        self._group_of: Dict[str, str] = {}
+        self._groups: Dict[str, dict] = {}
+
+    def set_search_properties(self, metric: Optional[str], mode: Optional[str]):
+        if metric:
+            self.metric = metric
+        self.searcher.set_search_properties(metric, mode)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        for gid, g in self._groups.items():
+            if len(g["members"]) < self.repeat:
+                g["members"].append(trial_id)
+                self._group_of[trial_id] = gid
+                return dict(g["config"])
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is None or not isinstance(cfg, dict):
+            return cfg
+        gid = trial_id
+        self._groups[gid] = {
+            "config": dict(cfg),
+            "members": [trial_id],
+            "results": [],
+            "finished": 0,
+        }
+        self._group_of[trial_id] = gid
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict] = None, error: bool = False):
+        gid = self._group_of.pop(trial_id, None)
+        if gid is None or gid not in self._groups:
+            return
+        g = self._groups[gid]
+        g["finished"] += 1
+        if not error and result and self.metric in result:
+            g["results"].append(float(result[self.metric]))
+        if g["finished"] >= self.repeat:
+            # Report once every member is accounted for, even if some
+            # errored; all-errored groups report an error so the wrapped
+            # searcher can release the suggestion.
+            if g["results"]:
+                avg = {self.metric: float(np.mean(g["results"]))}
+                self.searcher.on_trial_complete(gid, avg, error=False)
+            else:
+                self.searcher.on_trial_complete(gid, None, error=True)
+            del self._groups[gid]

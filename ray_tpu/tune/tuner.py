@@ -130,12 +130,16 @@ class TuneController:
         if restore_state:
             self._load_state(restore_state)
             # Skip searcher variants already materialized as trials before
-            # the interruption (grid positions are deterministic). Complete
-            # each suggestion so stateful searchers (ConcurrencyLimiter)
-            # don't leak live slots.
+            # the interruption. Searchers that model the config→metric
+            # relationship (TPE/BayesOpt) take the real restored pair via
+            # observe(); for positional searchers the suggest/complete
+            # replay keeps their counters and live-slot accounting right.
             for t in self._trials:
-                self._searcher.suggest(t.trial_id)
-                self._searcher.on_trial_complete(t.trial_id, t.last_result)
+                if hasattr(self._searcher, "observe"):
+                    self._searcher.observe(t.trial_id, t.config, t.last_result)
+                else:
+                    self._searcher.suggest(t.trial_id)
+                    self._searcher.on_trial_complete(t.trial_id, t.last_result)
                 # A trial interrupted without a checkpoint restarts from
                 # scratch — stale history would feed schedulers an inflated
                 # time_attr and duplicate metrics_history.

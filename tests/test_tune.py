@@ -3,6 +3,8 @@ test_tune_controller.py, test_trial_scheduler.py, test_tuner_restore.py)."""
 import json
 import os
 
+import numpy as np
+
 import pytest
 
 import ray_tpu
@@ -228,3 +230,174 @@ def test_concurrency_limiter(ray_start_regular, tmp_path):
         _experiment_dir=str(tmp_path / "exp"),
     ).fit()
     assert len(grid) == 6
+
+
+# ---------------------------------------------------------------------------
+# Model-based searchers (reference: tune/search/{hyperopt,bayesopt,repeater})
+# ---------------------------------------------------------------------------
+
+
+def _drive_searcher(searcher, objective, n):
+    """Sequentially optimize a pure function with a searcher."""
+    best = float("inf")
+    for i in range(n):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        if cfg is None:
+            break
+        val = objective(cfg)
+        best = min(best, val)
+        searcher.on_trial_complete(tid, {"loss": val})
+    return best
+
+
+def _quadratic(cfg):
+    return (cfg["x"] - 0.3) ** 2 + (cfg["y"] - 0.7) ** 2
+
+
+def test_tpe_beats_random():
+    space = {"x": tune.uniform(0, 1), "y": tune.uniform(0, 1)}
+    tpe_best = _drive_searcher(
+        tune.TPESearcher(space, n_startup=8, num_samples=60, seed=1), _quadratic, 60
+    )
+    import random as _r
+
+    rng = _r.Random(1)
+    rand_best = min(
+        _quadratic({"x": rng.random(), "y": rng.random()}) for _ in range(60)
+    )
+    assert tpe_best < 0.02, tpe_best
+    assert tpe_best <= rand_best * 1.5  # model-based at least matches random
+
+
+def test_bayesopt_converges():
+    space = {"x": tune.uniform(0, 1), "y": tune.uniform(0, 1)}
+    best = _drive_searcher(
+        tune.BayesOptSearcher(space, n_startup=6, num_samples=40, seed=2), _quadratic, 40
+    )
+    assert best < 0.01, best
+
+
+def test_searcher_space_decoding():
+    space = {
+        "lr": tune.loguniform(1e-5, 1e-1),
+        "layers": tune.randint(1, 5),
+        "act": tune.choice(["relu", "tanh"]),
+        "fixed": 7,
+    }
+    s = tune.TPESearcher(space, num_samples=30, seed=0)
+    for i in range(30):
+        cfg = s.suggest(f"t{i}")
+        assert 1e-5 <= cfg["lr"] <= 1e-1
+        assert cfg["layers"] in (1, 2, 3, 4)
+        assert cfg["act"] in ("relu", "tanh")
+        assert cfg["fixed"] == 7
+    assert s.suggest("t_extra") is None  # num_samples respected
+
+
+def test_repeater_averages():
+    class Recorder(tune.Searcher):
+        def __init__(self):
+            self.completed = []
+            self._i = 0
+
+        def suggest(self, tid):
+            self._i += 1
+            return {"x": self._i}
+
+        def on_trial_complete(self, tid, result=None, error=False):
+            self.completed.append(result["loss"])
+
+    rec = Recorder()
+    rep = tune.Repeater(rec, repeat=3, metric="loss")
+    cfgs = [rep.suggest(f"t{i}") for i in range(6)]
+    # 2 underlying configs, each repeated 3x
+    assert [c["x"] for c in cfgs] == [1, 1, 1, 2, 2, 2]
+    for i, v in enumerate([1.0, 2.0, 3.0, 10.0, 20.0, 30.0]):
+        rep.on_trial_complete(f"t{i}", {"loss": v})
+    assert rec.completed == [2.0, 20.0]
+
+
+def test_tpe_in_tuner(ray_start_regular, tmp_path):
+    def trainable(config):
+        tune.report({"score": -((config["x"] - 0.5) ** 2)})
+
+    searcher = tune.TPESearcher(
+        {"x": tune.uniform(0, 1)}, metric="score", mode="max",
+        n_startup=4, num_samples=12, seed=0,
+    )
+    tuner = tune.Tuner(
+        trainable,
+        tune_config=tune.TuneConfig(metric="score", mode="max", search_alg=searcher),
+        _experiment_dir=str(tmp_path / "exp"),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["score"] > -0.05
+    assert len(grid.trials) == 12
+
+
+def test_repeater_error_accounting():
+    class Recorder(tune.Searcher):
+        def __init__(self):
+            self.completed = []
+            self._i = 0
+
+        def suggest(self, tid):
+            self._i += 1
+            return {"x": self._i}
+
+        def on_trial_complete(self, tid, result=None, error=False):
+            self.completed.append((result, error))
+
+    rec = Recorder()
+    rep = tune.Repeater(rec, repeat=3, metric="loss")
+    for i in range(3):
+        rep.suggest(f"t{i}")
+    # One member errors; the group must still complete with the other two.
+    rep.on_trial_complete("t0", None, error=True)
+    rep.on_trial_complete("t1", {"loss": 2.0})
+    rep.on_trial_complete("t2", {"loss": 4.0})
+    assert rec.completed == [({"loss": 3.0}, False)]
+    assert not rep._groups  # no leak
+    # All-error group reports an error through.
+    for i in range(3, 6):
+        rep.suggest(f"t{i}")
+    for i in range(3, 6):
+        rep.on_trial_complete(f"t{i}", None, error=True)
+    assert rec.completed[-1] == (None, True)
+
+
+def test_repeater_propagates_search_properties():
+    inner = tune.TPESearcher({"x": tune.uniform(0, 1)}, num_samples=8)
+    rep = tune.Repeater(inner, repeat=2)
+    rep.set_search_properties("score", "max")
+    assert rep.metric == "score" and inner.metric == "score" and inner.mode == "max"
+
+
+def test_tpe_tiny_startup_no_crash():
+    s = tune.TPESearcher({"x": tune.uniform(0, 1)}, n_startup=1, num_samples=6, seed=0)
+    for i in range(6):
+        cfg = s.suggest(f"t{i}")
+        assert cfg is not None
+        s.on_trial_complete(f"t{i}", {"loss": cfg["x"] ** 2})
+
+
+def test_searcher_observe_restores_model():
+    space = {"x": tune.uniform(0, 1)}
+    s = tune.TPESearcher(space, n_startup=2, num_samples=50, seed=0)
+    # Restored experiment: real (config, metric) pairs observed directly.
+    for i, x in enumerate(np.linspace(0, 1, 20)):
+        s.observe(f"old{i}", {"x": float(x)}, {"loss": (x - 0.3) ** 2})
+    # The model should now suggest near the optimum.
+    sugg = [s.suggest(f"new{i}")["x"] for i in range(8)]
+    assert min(abs(x - 0.3) for x in sugg) < 0.15, sugg
+    # encode/decode round trip across all domain kinds
+    from ray_tpu.tune.suggest import _Space
+
+    sp = _Space({"lr": tune.loguniform(1e-4, 1e-1), "n": tune.randint(2, 9),
+                 "act": tune.choice(["a", "b", "c"]), "fixed": 1})
+    cfg = sp.decode(np.array([0.5, 0.5, 0.5]))
+    u = sp.encode(cfg)
+    cfg2 = sp.decode(u)
+    assert cfg == cfg2
