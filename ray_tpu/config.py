@@ -73,6 +73,11 @@ class Config:
     health_check_failure_threshold: int = 5
     task_retry_delay_s: float = 0.05
     actor_restart_delay_s: float = 0.1
+    # fsync the GCS journal on every append (reference analogue: Redis
+    # persistence guarantees for GCS FT). Off by default: a torn tail is
+    # detected and dropped on replay, and the journal is for whole-process
+    # crashes, not host power loss.
+    gcs_journal_fsync: bool = False
 
     # --- control plane ---
     raylet_heartbeat_period_s: float = 0.5

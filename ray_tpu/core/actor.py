@@ -51,6 +51,10 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> "ActorHandle":
         from ray_tpu.core.api import _require_worker
 
+        if self._options.get("lifetime") not in (None, "detached"):
+            raise ValueError(
+                f"lifetime must be None or 'detached', got {self._options['lifetime']!r}"
+            )
         core = _require_worker()
         if self._blob is None:
             self._blob = serialize_function(self._cls)
@@ -91,6 +95,7 @@ class ActorClass:
             max_concurrency=opts["max_concurrency"],
             runtime_env=runtime_env,
             hold_resources_while_alive=hold,
+            lifetime=opts.get("lifetime"),
         )
         core.create_actor(spec)
         return ActorHandle(actor_id, max_task_retries=opts["max_task_retries"])

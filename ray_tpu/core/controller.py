@@ -154,6 +154,22 @@ class Controller:
         self.actors: Dict[ActorID, ActorRecord] = {}
         self.named_actors: Dict[str, ActorID] = {}
         self.kv: Dict[str, Dict[bytes, bytes]] = {}
+        # GCS fault tolerance (reference: gcs/store_client/ Redis FT): an
+        # append-only journal of {KV, detached actors, PGs}; a restarting
+        # controller on the same session dir replays it.
+        from ray_tpu.core.persistence import GcsJournal
+
+        self.journal = GcsJournal(session_dir, sync=config.gcs_journal_fsync)
+        self._restored = self.journal.replay()
+        if not self._restored.empty:
+            self.kv = self._restored.kv
+            # Compact on every restart: bounds replay cost for long-lived
+            # clusters that overwrite the same KV keys repeatedly.
+            self.journal.compact(self._restored)
+            logger.info(
+                "journal replay: %d kv namespaces, %d detached actors, %d PGs",
+                len(self._restored.kv), len(self._restored.actors), len(self._restored.pgs),
+            )
         self.pending_tasks: List[TaskID] = []
         self.drivers: Set[rpc.Peer] = set()
         self._pump_scheduled = False
@@ -299,7 +315,7 @@ class Controller:
             self._schedule_pump()
         return True
 
-    async def rpc_create_actor(self, peer: rpc.Peer, spec: TaskSpec):
+    async def rpc_create_actor(self, peer: rpc.Peer, spec: TaskSpec, _journal: bool = True):
         actor = ActorRecord(
             actor_id=spec.actor_id,
             creation_spec=spec,
@@ -313,6 +329,8 @@ class Controller:
                 raise ValueError(f"Actor with name {name!r} already exists")
             self.named_actors[name] = spec.actor_id
         self.actors[spec.actor_id] = actor
+        if _journal and spec.lifetime == "detached":
+            self.journal.actor_register(spec)
         rec = TaskRecord(spec=spec, retries_left=0)
         self.tasks[spec.task_id] = rec
         self.pending_tasks.append(spec.task_id)
@@ -728,6 +746,8 @@ class Controller:
             actor.state = "DEAD"
             actor.death_reason = reason
             self._event("actor", actor.creation_spec, "DEAD")
+            if actor.creation_spec.lifetime == "detached":
+                self.journal.actor_dead(actor_id.hex())
             if actor.name:
                 self.named_actors.pop(actor.name, None)
             err = ActorDiedError(actor_id.hex(), reason)
@@ -981,13 +1001,17 @@ class Controller:
         if not overwrite and key in table:
             return False
         table[key] = value
+        self.journal.kv_put(ns, key, value)
         return True
 
     async def rpc_kv_get(self, peer, ns: str, key: bytes):
         return self.kv.get(ns, {}).get(key)
 
     async def rpc_kv_del(self, peer, ns: str, key: bytes):
-        return self.kv.get(ns, {}).pop(key, None) is not None
+        existed = self.kv.get(ns, {}).pop(key, None) is not None
+        if existed:
+            self.journal.kv_del(ns, key)
+        return existed
 
     async def rpc_kv_keys(self, peer, ns: str, prefix: bytes):
         return [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]
@@ -999,6 +1023,7 @@ class Controller:
         pg_id = PlacementGroupID.from_random()
         rs = [ResourceSet.from_dict(b) for b in bundles]
         self.pg_manager.create(pg_id, rs, strategy, name)
+        self.journal.pg_create(pg_id.hex(), bundles, strategy, name)
         self._schedule_pump()
         return pg_id
 
@@ -1015,6 +1040,7 @@ class Controller:
 
     async def rpc_pg_remove(self, peer, pg_id: PlacementGroupID):
         self.pg_manager.remove(pg_id)
+        self.journal.pg_remove(pg_id.hex())
         self._schedule_pump()
         return True
 
@@ -1292,8 +1318,28 @@ class Controller:
                 except Exception:
                     pass
 
+    async def _restore_persisted(self):
+        """Re-create journaled PGs and detached actors after a restart
+        (reference: GCS restart restores actor/PG tables, then the actor
+        manager reschedules; gcs_actor_manager.cc restart path)."""
+        for pg_hex, pg in self._restored.pgs.items():
+            pg_id = PlacementGroupID.from_hex(pg_hex)
+            rs = [ResourceSet.from_dict(b) for b in pg["bundles"]]
+            self.pg_manager.create(pg_id, rs, pg["strategy"], pg["name"])
+        for actor_hex, spec in self._restored.actors.items():
+            if spec.dependencies:
+                # Arg objects died with the old cluster; without lineage for
+                # them the actor cannot be re-created faithfully.
+                logger.warning("cannot restore detached actor %s: has object deps", actor_hex)
+                self.journal.actor_dead(actor_hex)
+                continue
+            await self.rpc_create_actor(None, spec, _journal=False)
+        if self._restored.pgs or self._restored.actors:
+            self._schedule_pump()
+
     async def run(self, port: int = 0):
         server, self.port = await rpc.serve(self, port=port)
+        await self._restore_persisted()
         if self.config.memory_monitor_refresh_ms > 0:
             # Keep a strong ref: the loop holds tasks weakly and an
             # unreferenced monitor could be garbage-collected mid-run.

@@ -1,0 +1,161 @@
+"""Controller state persistence — the GCS fault-tolerance store.
+
+Reference: src/ray/gcs/store_client/ — the GCS persists its tables
+through a ``StoreClient`` (in-memory by default, Redis for FT;
+redis_store_client.h:88) and restores them on restart, after which
+clients resubscribe. This image has no Redis, so the durable backend is
+an append-only JSONL journal with periodic compaction — same recovery
+contract, file-backed: every mutation to a persisted table is appended
+synchronously, and a restarting controller replays the journal to
+rebuild {KV store, detached-actor specs, placement-group specs}.
+
+Binary values are hex-encoded; TaskSpecs travel as pickled blobs (they
+carry their own function payloads, so a restored spec is
+self-contained).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+JOURNAL_NAME = "gcs_journal.jsonl"
+
+
+class GcsJournal:
+    """Append-only journal of controller table mutations."""
+
+    def __init__(self, session_dir: str, sync: bool = True):
+        self.path = os.path.join(session_dir, JOURNAL_NAME)
+        self._sync = sync
+        self._f = None
+
+    # -- write path -------------------------------------------------------
+    def _file(self):
+        if self._f is None:
+            self._f = open(self.path, "a", encoding="utf-8")
+        return self._f
+
+    def append(self, op: str, **fields: Any):
+        rec = {"op": op, **fields}
+        f = self._file()
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        if self._sync:
+            os.fsync(f.fileno())
+
+    # table-specific helpers (hex/pickle encoding in one place) ----------
+    def kv_put(self, ns: str, key: bytes, value: bytes):
+        self.append("kv_put", ns=ns, key=key.hex(), value=value.hex())
+
+    def kv_del(self, ns: str, key: bytes):
+        self.append("kv_del", ns=ns, key=key.hex())
+
+    def actor_register(self, spec) -> None:
+        self.append("actor_register", actor_id=spec.actor_id.hex(),
+                    spec=pickle.dumps(spec).hex())
+
+    def actor_dead(self, actor_id_hex: str):
+        self.append("actor_dead", actor_id=actor_id_hex)
+
+    def pg_create(self, pg_id_hex: str, bundles: List[Dict[str, float]],
+                  strategy: str, name: str):
+        self.append("pg_create", pg_id=pg_id_hex, bundles=bundles,
+                    strategy=strategy, name=name)
+
+    def pg_remove(self, pg_id_hex: str):
+        self.append("pg_remove", pg_id=pg_id_hex)
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    # -- read path --------------------------------------------------------
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def replay(self) -> "RestoredState":
+        """Replay the journal into the latest table state.
+
+        A torn tail (crash mid-append) is dropped AND physically truncated
+        — otherwise the next append would merge into the partial line and
+        poison every later record for the following replay."""
+        state = RestoredState()
+        if not self.exists():
+            return state
+        good_bytes = 0
+        torn = False
+        with open(self.path, "rb") as f:
+            for line_no, raw in enumerate(f):
+                line = raw.decode("utf-8", errors="replace").strip()
+                if not line:
+                    good_bytes += len(raw)
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    logger.warning("journal: torn record at line %d; truncating", line_no)
+                    torn = True
+                    break
+                good_bytes += len(raw)
+                op = rec.get("op")
+                if op == "kv_put":
+                    state.kv.setdefault(rec["ns"], {})[bytes.fromhex(rec["key"])] = (
+                        bytes.fromhex(rec["value"])
+                    )
+                elif op == "kv_del":
+                    state.kv.get(rec["ns"], {}).pop(bytes.fromhex(rec["key"]), None)
+                elif op == "actor_register":
+                    try:
+                        spec = pickle.loads(bytes.fromhex(rec["spec"]))
+                        state.actors[rec["actor_id"]] = spec
+                    except Exception:
+                        logger.warning("journal: undeserializable actor spec %s", rec["actor_id"])
+                elif op == "actor_dead":
+                    state.actors.pop(rec["actor_id"], None)
+                elif op == "pg_create":
+                    state.pgs[rec["pg_id"]] = {
+                        "bundles": rec["bundles"],
+                        "strategy": rec["strategy"],
+                        "name": rec["name"],
+                    }
+                elif op == "pg_remove":
+                    state.pgs.pop(rec["pg_id"], None)
+        if torn:
+            with open(self.path, "rb+") as f:
+                f.truncate(good_bytes)
+        return state
+
+    def compact(self, state: "RestoredState"):
+        """Rewrite the journal as the current state (bounds replay cost)."""
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for ns, table in state.kv.items():
+                for k, v in table.items():
+                    f.write(json.dumps({"op": "kv_put", "ns": ns, "key": k.hex(),
+                                        "value": v.hex()}) + "\n")
+            for aid, spec in state.actors.items():
+                f.write(json.dumps({"op": "actor_register", "actor_id": aid,
+                                    "spec": pickle.dumps(spec).hex()}) + "\n")
+            for pgid, pg in state.pgs.items():
+                f.write(json.dumps({"op": "pg_create", "pg_id": pgid, **pg}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self.close()
+        os.replace(tmp, self.path)
+
+
+class RestoredState:
+    def __init__(self):
+        self.kv: Dict[str, Dict[bytes, bytes]] = {}
+        self.actors: Dict[str, Any] = {}  # actor_id hex -> creation TaskSpec
+        self.pgs: Dict[str, dict] = {}  # pg_id hex -> {bundles, strategy, name}
+
+    @property
+    def empty(self) -> bool:
+        return not (self.kv or self.actors or self.pgs)

@@ -65,6 +65,10 @@ class TaskSpec:
     max_concurrency: int = 1
     # Runtime env (env vars only in v0; reference has full plugin system).
     runtime_env: Optional[dict] = None
+    # Actor lifetime: None (owner-scoped) or "detached" — detached actors
+    # survive their creator and are journaled for controller-restart
+    # recovery (reference: actor.py lifetime="detached" + GCS FT restore).
+    lifetime: Optional[str] = None
     # Actor creation: hold the acquired resources until the actor dies
     # (reference semantics: explicitly-requested actor resources are held
     # for the actor's lifetime; the default 1 CPU is scheduling-only and

@@ -1,0 +1,1 @@
+"""ray_tpu.experimental: semi-public APIs (reference: ray.experimental)."""

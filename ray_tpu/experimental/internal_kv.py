@@ -1,0 +1,42 @@
+"""Internal KV over the controller's namespaced KV store.
+
+Reference: python/ray/experimental/internal_kv.py — thin module-level
+functions over the GCS KV table (gcs_kv_manager.cc). Entries persist
+across controller restarts via the GCS journal
+(ray_tpu/core/persistence.py).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ray_tpu.core.api import _require_worker
+
+_NS = "default"
+
+
+def _internal_kv_initialized() -> bool:
+    from ray_tpu.core import api
+
+    return api._global_worker is not None
+
+
+def _internal_kv_put(key: bytes, value: bytes, overwrite: bool = True, namespace: str = _NS) -> bool:
+    """Returns True if the key was newly written (reference returns whether
+    it already existed — inverted there; we follow kv_put semantics)."""
+    return _require_worker().kv_put(namespace, bytes(key), bytes(value), overwrite)
+
+
+def _internal_kv_get(key: bytes, namespace: str = _NS) -> Optional[bytes]:
+    return _require_worker().kv_get(namespace, bytes(key))
+
+
+def _internal_kv_exists(key: bytes, namespace: str = _NS) -> bool:
+    return _internal_kv_get(key, namespace) is not None
+
+
+def _internal_kv_del(key: bytes, namespace: str = _NS) -> bool:
+    return _require_worker().kv_del(namespace, bytes(key))
+
+
+def _internal_kv_list(prefix: bytes, namespace: str = _NS) -> List[bytes]:
+    return _require_worker().kv_keys(namespace, bytes(prefix))

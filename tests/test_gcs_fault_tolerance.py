@@ -1,0 +1,175 @@
+"""Controller (GCS) fault tolerance: journal persistence + restart
+recovery.
+
+Reference test model: python/ray/tests/test_gcs_fault_tolerance.py —
+kill the GCS, restart it against persistent storage, verify KV /
+named-detached-actor / PG state survives.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import api as core_api
+from ray_tpu.core.persistence import GcsJournal, RestoredState
+
+
+# ---------------------------------------------------------------------------
+# Journal unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_journal_roundtrip(tmp_path):
+    j = GcsJournal(str(tmp_path))
+    j.kv_put("ns1", b"k1", b"v1")
+    j.kv_put("ns1", b"k2", b"v2")
+    j.kv_del("ns1", b"k1")
+    j.pg_create("aa" * 8, [{"CPU": 1}], "PACK", "mypg")
+    j.pg_create("bb" * 8, [{"CPU": 2}], "SPREAD", "gone")
+    j.pg_remove("bb" * 8)
+    j.close()
+
+    state = GcsJournal(str(tmp_path)).replay()
+    assert state.kv == {"ns1": {b"k2": b"v2"}}
+    assert list(state.pgs) == ["aa" * 8]
+    assert state.pgs["aa" * 8]["strategy"] == "PACK"
+
+
+def test_journal_torn_tail(tmp_path):
+    j = GcsJournal(str(tmp_path))
+    j.kv_put("ns", b"a", b"1")
+    j.close()
+    # Simulate a crash mid-append: garbage partial line at the tail.
+    with open(j.path, "a") as f:
+        f.write('{"op": "kv_put", "ns": "ns", "key"')
+    j2 = GcsJournal(str(tmp_path))
+    state = j2.replay()
+    assert state.kv == {"ns": {b"a": b"1"}}
+    # Replay truncated the torn bytes: post-restart appends must not merge
+    # into the partial line and must survive the NEXT replay.
+    j2.kv_put("ns", b"b", b"2")
+    j2.close()
+    state2 = GcsJournal(str(tmp_path)).replay()
+    assert state2.kv == {"ns": {b"a": b"1", b"b": b"2"}}
+
+
+def test_invalid_lifetime_rejected(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        pass
+
+    with pytest.raises(ValueError, match="lifetime"):
+        A.options(lifetime="Detached").remote()
+
+
+def test_journal_compact(tmp_path):
+    j = GcsJournal(str(tmp_path))
+    for i in range(50):
+        j.kv_put("ns", b"key", str(i).encode())  # 50 overwrites
+    state = j.replay()
+    j.compact(state)
+    with open(j.path) as f:
+        lines = [l for l in f if l.strip()]
+    assert len(lines) == 1  # collapsed to latest value
+    assert GcsJournal(str(tmp_path)).replay().kv == {"ns": {b"key": b"49"}}
+
+
+# ---------------------------------------------------------------------------
+# Controller restart integration
+# ---------------------------------------------------------------------------
+
+
+def _start_controller(session_dir, port=0):
+    from ray_tpu.core.node_agent import child_env
+
+    os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+    log = open(os.path.join(session_dir, "logs", "controller.log"), "ab")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_tpu.core.controller",
+            "--session-dir", session_dir,
+            "--port", str(port),
+            "--resources", json.dumps({"CPU": 4}),
+            "--config", "{}",
+        ],
+        env=child_env(needs_tpu=False),
+        stdout=log,
+        stderr=subprocess.STDOUT,
+    )
+    port_file = os.path.join(session_dir, "controller_port")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if os.path.exists(port_file):
+            with open(port_file) as f:
+                txt = f.read().strip()
+            if txt:
+                return proc, int(txt)
+        time.sleep(0.05)
+    raise TimeoutError("controller did not start")
+
+
+def test_controller_restart_recovers_state(tmp_path):
+    """Kill -9 the controller; a restart on the same session dir restores
+    KV entries, the PG table, and re-creates the named detached actor."""
+    session = str(tmp_path / "session")
+    os.makedirs(session, exist_ok=True)
+    proc, port = _start_controller(session)
+    try:
+        ray_tpu.init(address=f"127.0.0.1:{port}")
+        from ray_tpu.experimental import internal_kv
+
+        internal_kv._internal_kv_put(b"persist_me", b"value1")
+
+        @ray_tpu.remote
+        class Keeper:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        k = Keeper.options(name="keeper", lifetime="detached").remote()
+        assert ray_tpu.get(k.bump.remote(), timeout=30) == 1
+
+        from ray_tpu.util.placement_group import placement_group
+        pg = placement_group([{"CPU": 1}], strategy="PACK", name="ft_pg")
+        assert pg.ready(timeout=30)
+
+        # Hard-kill the control plane.
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        ray_tpu.shutdown()
+
+        # Restart on the same session dir (same port so nothing cached
+        # points at a stale address). Drop the dead controller's port file
+        # first or the wait loop below would see the stale one.
+        os.remove(os.path.join(session, "controller_port"))
+        proc, port2 = _start_controller(session, port=port)
+        ray_tpu.init(address=f"127.0.0.1:{port2}")
+        from ray_tpu.experimental import internal_kv as kv2
+
+        assert kv2._internal_kv_get(b"persist_me") == b"value1"
+
+        # Detached actor was re-created from its journaled spec (fresh
+        # state — the old process died with its memory).
+        k2 = ray_tpu.get_actor("keeper")
+        assert ray_tpu.get(k2.bump.remote(), timeout=60) == 1
+
+        from ray_tpu.util.placement_group import placement_group_table
+        table = placement_group_table()
+        assert any(rec.get("name") == "ft_pg" for rec in table.values()), table
+    finally:
+        try:
+            proc.send_signal(signal.SIGKILL)
+        except Exception:
+            pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
