@@ -73,9 +73,12 @@ class ActorClass:
         )
         if res_opts["num_cpus"] is None:
             res_opts["num_cpus"] = 1
+        from ray_tpu.util import tracing as _tracing
+
         runtime_env = dict(opts.get("runtime_env") or {})
         if opts.get("name"):
             runtime_env["__actor_name__"] = opts["name"]
+        runtime_env = _tracing.inject_runtime_env(runtime_env) or runtime_env
         spec = TaskSpec(
             task_id=TaskID.for_actor_creation(actor_id),
             task_type=TaskType.ACTOR_CREATION_TASK,
@@ -183,6 +186,8 @@ class ActorMethod:
         core = _require_worker()
         streaming = self._num_returns == "streaming"
         args_blob, deps = core.build_args(args, kwargs)
+        from ray_tpu.util import tracing as _tracing
+
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             task_type=TaskType.ACTOR_TASK,
@@ -197,6 +202,7 @@ class ActorMethod:
             max_retries=self._handle._max_task_retries,
             actor_id=self._handle._actor_id,
             actor_method_name=self._name,
+            runtime_env=_tracing.inject_runtime_env(None),
         )
         refs = core.submit_actor_task(spec)
         if streaming:

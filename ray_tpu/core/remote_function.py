@@ -94,6 +94,11 @@ class RemoteFunction:
         opts = self._options
         streaming = opts["num_returns"] == "streaming"
         args_blob, deps = core.build_args(args, kwargs)
+        # Trace-context propagation (reference: tracing_helper.py:88 —
+        # context rides in task metadata when tracing is on).
+        from ray_tpu.util import tracing as _tracing
+
+        runtime_env = _tracing.inject_runtime_env(opts.get("runtime_env"))
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             task_type=TaskType.NORMAL_TASK,
@@ -108,7 +113,7 @@ class RemoteFunction:
             scheduling_strategy=normalize_strategy(opts.get("scheduling_strategy")),
             max_retries=opts["max_retries"],
             retry_exceptions=bool(opts["retry_exceptions"]),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=runtime_env,
         )
         refs = core.submit_task(spec)
         if streaming:

@@ -143,11 +143,28 @@ class TaskExecutor:
         runtime_context._set_task(
             spec.task_id.hex(), spec.actor_id.hex() if spec.actor_id else None
         )
+        trace_span_cm = None
         try:
             if spec.runtime_env:
                 from ray_tpu import runtime_env as _renv
 
                 _renv.ensure_applied(spec.runtime_env)
+                ctx = spec.runtime_env.get("__trace_ctx__")
+                if ctx:
+                    # Caller traced this call: record the execution span
+                    # under its context (reference: tracing_helper's
+                    # _inject_tracing_into_function execution wrapper).
+                    from ray_tpu.util import tracing as _tracing
+
+                    if not _tracing.tracing_enabled():
+                        _tracing.enable_tracing(
+                            os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
+                        )
+                    _tracing.attach_context(ctx)
+                    trace_span_cm = _tracing.start_span(
+                        f"execute:{spec.name}", {"task_id": spec.task_id.hex()}
+                    )
+                    trace_span_cm.__enter__()
             args, kwargs = self._resolve_args(spec)
             if kind == "task":
                 fn = self._load_func(spec)
@@ -167,12 +184,19 @@ class TaskExecutor:
             else:  # actor_task
                 method = getattr(self.actor_instance, spec.actor_method_name)
                 result = _maybe_async(method(*args, **kwargs))
+            # Report inside the span: for streaming tasks the generator
+            # body runs during _report, which must be attributed.
+            self._report(spec, result, None)
         except Exception as e:  # noqa: BLE001 — user errors cross the wire
             tb = traceback.format_exc()
             err = e if isinstance(e, TaskError) else TaskError(spec.name, tb, None)
             self._report(spec, None, err)
-            return
-        self._report(spec, result, None)
+        finally:
+            if trace_span_cm is not None:
+                from ray_tpu.util import tracing as _tracing
+
+                trace_span_cm.__exit__(None, None, None)
+                _tracing.detach_context()
 
     def _report(self, spec: TaskSpec, result, error):
         if spec.is_streaming and error is None:

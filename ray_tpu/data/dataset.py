@@ -230,6 +230,42 @@ class Dataset:
             return {}
         return {k: np.concatenate([np.asarray(b[k]) for b in batches]) for k in batches[0]}
 
+    # Writes ------------------------------------------------------------
+    def write_datasink(self, sink) -> List[Any]:
+        """Distributed write: one remote task per output block, running
+        where the block lives (reference: Dataset.write_datasink /
+        datasource/datasink.py). Returns the per-block write results."""
+        import ray_tpu
+        from ray_tpu.data.datasink import _write_block_task
+
+        refs = [
+            _write_block_task.remote(b.ref, sink, i)
+            for i, b in enumerate(self._execute_bundles())
+        ]
+        results = ray_tpu.get(refs)
+        sink.on_write_complete(results)
+        return results
+
+    def write_parquet(self, path: str) -> List[str]:
+        from ray_tpu.data.datasink import ParquetDatasink
+
+        return self.write_datasink(ParquetDatasink(path))
+
+    def write_csv(self, path: str) -> List[str]:
+        from ray_tpu.data.datasink import CSVDatasink
+
+        return self.write_datasink(CSVDatasink(path))
+
+    def write_json(self, path: str) -> List[str]:
+        from ray_tpu.data.datasink import JSONDatasink
+
+        return self.write_datasink(JSONDatasink(path))
+
+    def write_numpy(self, path: str, *, column: Optional[str] = None) -> List[str]:
+        from ray_tpu.data.datasink import NumpyDatasink
+
+        return self.write_datasink(NumpyDatasink(path, column))
+
     # Global aggregates -------------------------------------------------
     def aggregate(self, *aggs: AggregateFn) -> Dict[str, Any]:
         rows = self.groupby(None)._aggregate_rows(*aggs)

@@ -1,0 +1,103 @@
+"""Datasinks: distributed block writes.
+
+Reference: python/ray/data/datasource/datasink.py (Datasink.write per
+block, on_write_complete) and the per-format file datasinks
+(_internal/datasource/parquet_datasink.py etc.). Each output block is
+written by a remote task where the block lives — the driver only
+collects the written paths.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def _write_block_task(block, sink, idx):
+    """Module-level so its serialized form is digest-cached once per
+    process instead of re-shipped on every write call."""
+    return sink.write(block, {"task_index": idx})
+
+
+class Datasink:
+    """Write interface: ``write`` runs remotely once per block."""
+
+    def write(self, block: Block, ctx: Dict[str, Any]) -> Any:
+        raise NotImplementedError
+
+    def on_write_complete(self, results: List[Any]) -> None:
+        pass
+
+
+class _FileDatasink(Datasink):
+    def __init__(self, path: str, file_format: str):
+        import uuid
+
+        self.path = path
+        self.file_format = file_format
+        # Per-write token in every filename so re-writing a directory never
+        # silently mixes in stale parts from a previous, larger write
+        # (reference datasinks embed a write UUID for the same reason).
+        self.write_token = uuid.uuid4().hex[:8]
+
+    def _filename(self, ctx: Dict[str, Any]) -> str:
+        return os.path.join(
+            self.path,
+            f"part-{self.write_token}-{ctx['task_index']:06d}.{self.file_format}",
+        )
+
+    def write(self, block: Block, ctx: Dict[str, Any]) -> str:
+        os.makedirs(self.path, exist_ok=True)
+        out = self._filename(ctx)
+        self._write_block(block, out)
+        return out
+
+    def _write_block(self, block: Block, out: str):
+        raise NotImplementedError
+
+
+class ParquetDatasink(_FileDatasink):
+    def __init__(self, path: str):
+        super().__init__(path, "parquet")
+
+    def _write_block(self, block: Block, out: str):
+        BlockAccessor.for_block(block).to_pandas().to_parquet(out, index=False)
+
+
+class CSVDatasink(_FileDatasink):
+    def __init__(self, path: str):
+        super().__init__(path, "csv")
+
+    def _write_block(self, block: Block, out: str):
+        BlockAccessor.for_block(block).to_pandas().to_csv(out, index=False)
+
+
+class JSONDatasink(_FileDatasink):
+    def __init__(self, path: str):
+        super().__init__(path, "json")
+
+    def _write_block(self, block: Block, out: str):
+        BlockAccessor.for_block(block).to_pandas().to_json(
+            out, orient="records", lines=True
+        )
+
+
+class NumpyDatasink(_FileDatasink):
+    def __init__(self, path: str, column: Optional[str] = None):
+        super().__init__(path, "npy")
+        self.column = column
+
+    def _write_block(self, block: Block, out: str):
+        batch = BlockAccessor.for_block(block).to_batch()
+        if not batch:  # empty block (e.g. everything filtered out)
+            np.save(out, np.empty(0))
+            return
+        col = self.column or next(iter(batch))
+        np.save(out, np.asarray(batch[col]))

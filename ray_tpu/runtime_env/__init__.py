@@ -32,7 +32,7 @@ from typing import Any, Callable, Dict, Optional
 
 from ray_tpu.exceptions import RuntimeEnvSetupError
 
-_INTERNAL_KEYS = {"__actor_name__"}
+_INTERNAL_KEYS = {"__actor_name__", "__trace_ctx__"}
 
 _plugins: Dict[str, Callable[[Any], None]] = {}
 

@@ -1,0 +1,168 @@
+"""Distributed tracing: spans with cross-task context propagation.
+
+Reference: python/ray/util/tracing/tracing_helper.py — opt-in tracing
+wraps task/actor invocation and execution with spans and propagates the
+trace context inside task metadata (:88-100). Re-designed without the
+OpenTelemetry dependency: spans are written as Chrome-trace events to a
+per-process JSONL file in the session log dir, and ``collect_spans``
+merges them — the same file-based path the task timeline uses, so one
+``chrome://tracing`` load shows both.
+
+Propagation: when a span is active in the submitting process, a
+``__trace_ctx__`` entry rides in the task's runtime_env; the executing
+worker re-parents its spans under it (ambient context, like OTel's
+context attach).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_state = threading.local()
+_enabled = False
+_sink_path: Optional[str] = None
+_sink_lock = threading.Lock()
+
+TRACE_CTX_KEY = "__trace_ctx__"
+
+
+def enable_tracing(session_dir: Optional[str] = None):
+    """Turn on span recording in this process (reference:
+    ``ray.init(_tracing_startup_hook=...)`` opt-in)."""
+    global _enabled, _sink_path
+    _enabled = True
+    if session_dir is None:
+        from ray_tpu.core import api
+
+        session_dir = getattr(api, "_session_dir", None) or "/tmp/ray_tpu"
+    logs = os.path.join(session_dir, "logs")
+    os.makedirs(logs, exist_ok=True)
+    _sink_path = os.path.join(logs, f"spans-{os.getpid()}.jsonl")
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def _write(rec: Dict[str, Any]):
+    if _sink_path is None:
+        return
+    with _sink_lock:
+        with open(_sink_path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    """The active trace context, for injection into task metadata."""
+    span = getattr(_state, "span", None)
+    if span is None:
+        return None
+    return {"trace_id": span["trace_id"], "parent_id": span["span_id"]}
+
+
+def attach_context(ctx: Optional[Dict[str, str]]):
+    """Adopt a propagated context as the ambient parent (worker side)."""
+    if ctx:
+        _state.span = {
+            "trace_id": ctx["trace_id"],
+            "span_id": ctx["parent_id"],
+            "name": "<remote-parent>",
+        }
+
+
+def inject_runtime_env(runtime_env: Optional[dict]) -> Optional[dict]:
+    """Return runtime_env with the active trace context injected (no-op
+    when tracing is off or no span is active)."""
+    if not _enabled:
+        return runtime_env
+    ctx = current_context()
+    if ctx is None:
+        return runtime_env
+    runtime_env = dict(runtime_env or {})
+    runtime_env[TRACE_CTX_KEY] = ctx
+    return runtime_env
+
+
+def detach_context():
+    """Clear the ambient context (end of a traced task execution) so a
+    long-lived worker thread doesn't mis-parent later unrelated work."""
+    _state.span = None
+
+
+@contextlib.contextmanager
+def start_span(name: str, attributes: Optional[Dict[str, Any]] = None):
+    """Record one span; nested spans parent automatically."""
+    if not _enabled:
+        yield None
+        return
+    parent = getattr(_state, "span", None)
+    span = {
+        "trace_id": parent["trace_id"] if parent else uuid.uuid4().hex[:16],
+        "span_id": uuid.uuid4().hex[:16],
+        "parent_id": parent["span_id"] if parent else None,
+        "name": name,
+    }
+    _state.span = span
+    t0 = time.time()
+    try:
+        yield span
+    finally:
+        _write(
+            {
+                "name": name,
+                "cat": "span",
+                "ph": "X",  # Chrome trace "complete" event
+                "ts": t0 * 1e6,
+                "dur": (time.time() - t0) * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % 100000,
+                "args": {
+                    **(attributes or {}),
+                    "trace_id": span["trace_id"],
+                    "span_id": span["span_id"],
+                    "parent_id": span["parent_id"],
+                },
+            }
+        )
+        _state.span = parent
+
+
+def trace_span(name: Optional[str] = None):
+    """Decorator form of ``start_span``."""
+
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with start_span(name or fn.__qualname__):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def collect_spans(session_dir: str) -> List[dict]:
+    """Merge every process's span file into one Chrome-trace event list."""
+    events: List[dict] = []
+    logs = os.path.join(session_dir, "logs")
+    if not os.path.isdir(logs):
+        return events
+    for fname in sorted(os.listdir(logs)):
+        if not (fname.startswith("spans-") and fname.endswith(".jsonl")):
+            continue
+        with open(os.path.join(logs, fname), encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        events.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
+    return events

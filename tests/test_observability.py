@@ -148,3 +148,60 @@ def test_timeline_chrome(ray_start_regular, tmp_path):
     assert len(spans) == 3
     assert all(t["ph"] == "X" and t["dur"] > 0 for t in spans)
     assert json.loads(out.read_text())
+
+
+def test_tracing_spans_and_propagation(ray_start_regular):
+    """Spans propagate across task submission (reference:
+    util/tracing/tracing_helper.py context-in-metadata)."""
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    session = ray_start_regular if isinstance(ray_start_regular, str) else None
+    from ray_tpu.core import api
+
+    tracing.enable_tracing(api._session_dir)
+
+    @ray_tpu.remote
+    def traced_child(x):
+        return x + 1
+
+    with tracing.start_span("driver-op", {"phase": "test"}) as span:
+        ref = traced_child.remote(1)
+        assert ray_tpu.get(ref, timeout=30) == 2
+        trace_id = span["trace_id"]
+
+    import time
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        events = tracing.collect_spans(api._session_dir)
+        exec_spans = [e for e in events if e["name"].startswith("execute:")]
+        if exec_spans:
+            break
+        time.sleep(0.2)
+    names = [e["name"] for e in events]
+    assert "driver-op" in names, names
+    assert exec_spans, names
+    # The worker-side execution span carries the driver's trace id.
+    assert any(e["args"].get("trace_id") == trace_id for e in exec_spans)
+
+    # Actor boundaries propagate too (reference covers both paths).
+    @ray_tpu.remote
+    class TracedActor:
+        def work(self):
+            return "done"
+
+    with tracing.start_span("actor-op") as span2:
+        a = TracedActor.remote()
+        assert ray_tpu.get(a.work.remote(), timeout=30) == "done"
+        trace_id2 = span2["trace_id"]
+    deadline = time.time() + 10
+    found = False
+    while time.time() < deadline and not found:
+        events = tracing.collect_spans(api._session_dir)
+        found = any(
+            e["name"] == "execute:actor.work"
+            and e["args"].get("trace_id") == trace_id2
+            for e in events
+        )
+        time.sleep(0.2)
+    assert found, [e["name"] for e in events]
