@@ -24,6 +24,13 @@ from ray_tpu.data.datasource import (
     RangeDatasource,
     TextDatasource,
 )
+from ray_tpu.data.datasink import (
+    CSVDatasink,
+    Datasink,
+    JSONDatasink,
+    NumpyDatasink,
+    ParquetDatasink,
+)
 from ray_tpu.data.logical import Read
 
 
@@ -110,4 +117,19 @@ __all__ = [
     "read_numpy",
     "read_parquet",
     "read_datasource",
+    "Datasink",
+    "ParquetDatasink",
+    "CSVDatasink",
+    "JSONDatasink",
+    "NumpyDatasink",
+    "token_loader",
 ]
+
+
+def token_loader(paths, batch_size: int, seq_len: int, **kw):
+    """Native C++ prefetching token-batch loader for TPU pretraining
+    ingest (ray_tpu/native/src/loader.cc — mmap + worker threads filling
+    a bounded ring of fixed-shape uint32 batches)."""
+    from ray_tpu.native.loader import TokenLoader
+
+    return TokenLoader(paths, batch_size, seq_len, **kw)

@@ -112,6 +112,16 @@ def load() -> Optional[ctypes.CDLL]:
         lib.rt_sched_forget.argtypes = [p, ctypes.c_char_p]
         lib.rt_sched_sync_node.restype = ctypes.c_int
         lib.rt_sched_sync_node.argtypes = [p, u64, u32p, i64p, i64p, ctypes.c_int]
+        lib.rt_loader_create.restype = p
+        lib.rt_loader_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, u64, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.rt_loader_stop.argtypes = [p]
+        lib.rt_loader_destroy.argtypes = [p]
+        lib.rt_loader_total_tokens.restype = u64
+        lib.rt_loader_total_tokens.argtypes = [p]
+        lib.rt_loader_next.restype = ctypes.c_int
+        lib.rt_loader_next.argtypes = [p, u32p]
         lib.rt_sched_get_avail.restype = i64
         lib.rt_sched_get_avail.argtypes = [p, u64, ctypes.c_uint32]
         _lib = lib

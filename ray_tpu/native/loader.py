@@ -1,0 +1,109 @@
+"""ctypes wrapper over the native token-batch loader.
+
+Reference analogue: the training ingest hot path that the reference
+delegates to Arrow C++ / torch DataLoader workers. ``TokenLoader``
+streams fixed-shape uint32 token batches from raw binary files through a
+C++ prefetch ring (mmap + worker threads, zero GIL in the fill path) —
+the host-side input pipeline for TPU pretraining loops, where static
+batch shapes keep the jitted step cache-stable.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.native import build as _build
+
+
+def available() -> bool:
+    lib = _build.load()
+    return lib is not None and hasattr(lib, "rt_loader_create")
+
+
+class LoaderClosedError(RuntimeError):
+    """The loader was closed (or is shutting down)."""
+
+
+class TokenLoader:
+    """Infinite sampler of ``[batch, seq]`` uint32 windows from raw
+    token files (little-endian uint32 concatenated documents)."""
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        batch_size: int,
+        seq_len: int,
+        *,
+        seed: int = 0,
+        num_threads: int = 2,
+        queue_depth: int = 4,
+    ):
+        self._lib = _build.load()
+        if self._lib is None:
+            raise RuntimeError(f"native lib unavailable: {_build.build_error()}")
+        joined = "\n".join(paths).encode()
+        self._h = self._lib.rt_loader_create(
+            joined, batch_size, seq_len, seed, num_threads, queue_depth
+        )
+        if not self._h:
+            raise ValueError(
+                f"rt_loader_create failed: check paths exist and hold >= "
+                f"{seq_len} uint32 tokens total: {list(paths)!r}"
+            )
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        import threading
+
+        # Serializes next()/close(): destroy must never race a blocked
+        # rt_loader_next (condvar destruction with waiters is UB).
+        self._lock = threading.Lock()
+
+    @property
+    def total_tokens(self) -> int:
+        if not getattr(self, "_h", None):
+            raise LoaderClosedError("loader is closed")
+        return int(self._lib.rt_loader_total_tokens(self._h))
+
+    def next(self) -> np.ndarray:
+        """Next prefetched batch — a fresh array, filled directly by the
+        native side (one copy total)."""
+        out = np.empty((self.batch_size, self.seq_len), dtype=np.uint32)
+        with self._lock:
+            if not getattr(self, "_h", None):
+                raise LoaderClosedError("loader is closed")
+            rc = self._lib.rt_loader_next(
+                self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+            )
+        if rc != 0:
+            raise LoaderClosedError("loader shut down")
+        return out
+
+    def __iter__(self) -> Iterable[np.ndarray]:
+        while True:
+            try:
+                yield self.next()
+            except LoaderClosedError:
+                return
+
+    def close(self):
+        if getattr(self, "_h", None):
+            # Wake any blocked consumer first; then destroy under the lock
+            # so no thread is inside rt_loader_next during delete.
+            self._lib.rt_loader_stop(self._h)
+            with self._lock:
+                if self._h:
+                    self._lib.rt_loader_destroy(self._h)
+                    self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def write_token_file(path: str, tokens: np.ndarray):
+    """Write a uint32 token array in the loader's file format."""
+    np.asarray(tokens, dtype=np.uint32).tofile(path)

@@ -1,0 +1,229 @@
+// Native token-batch data loader.
+//
+// Reference analogue: the reference ships native data-path components
+// (object_manager chunked transfer, plasma block IO) and Ray Data's hot
+// block ops run in Arrow's C++ — here the training-ingest hot loop is
+// native: mmap'd token files, worker threads assembling fixed-shape
+// [batch, seq+1] uint32 batches into a bounded ring, consumer copies one
+// slot per next() call. The fixed shapes keep the jitted TPU train step
+// static; the threads keep the host input pipeline off the GIL.
+//
+// File format: raw little-endian uint32 tokens, concatenated documents.
+// Sampling: each worker draws random windows (seeded, per-thread RNG) —
+// the standard infinite-stream LM pretraining sampler.
+//
+// C ABI (ctypes): see rt_loader_* below.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <condition_variable>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct MappedFile {
+  const uint32_t* data = nullptr;
+  size_t n_tokens = 0;
+  size_t bytes = 0;
+  int fd = -1;
+};
+
+struct Loader {
+  std::vector<MappedFile> files;
+  size_t total_tokens = 0;
+  int batch = 0;
+  int seqlen = 0;  // tokens per row = seqlen (caller includes +1 if wanted)
+  size_t row_elems = 0;
+
+  // Ring of filled batch buffers.
+  std::vector<std::vector<uint32_t>> slots;
+  std::vector<int> ready;  // indices of filled slots
+  std::vector<int> free_;  // indices of empty slots
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> workers;
+  uint64_t seed = 0;
+
+  ~Loader() {
+    stop.store(true);
+    cv_free.notify_all();
+    cv_ready.notify_all();
+    for (auto& t : workers) {
+      if (t.joinable()) t.join();
+    }
+    for (auto& f : files) {
+      if (f.data) munmap(const_cast<uint32_t*>(f.data), f.bytes);
+      if (f.fd >= 0) close(f.fd);
+    }
+  }
+};
+
+// xorshift64* — deterministic per-thread stream.
+inline uint64_t next_rand(uint64_t& s) {
+  s ^= s >> 12;
+  s ^= s << 25;
+  s ^= s >> 27;
+  return s * 0x2545F4914F6CDD1DULL;
+}
+
+void worker_fill(Loader* L, int tid) {
+  uint64_t rng = L->seed * 0x9E3779B97F4A7C15ULL + tid + 1;
+  // Precompute cumulative token counts for file pick.
+  std::vector<size_t> cum;
+  cum.reserve(L->files.size());
+  size_t acc = 0;
+  for (auto& f : L->files) {
+    acc += f.n_tokens;
+    cum.push_back(acc);
+  }
+  while (!L->stop.load(std::memory_order_relaxed)) {
+    int slot;
+    {
+      std::unique_lock<std::mutex> lk(L->mu);
+      L->cv_free.wait(lk, [&] { return L->stop.load() || !L->free_.empty(); });
+      if (L->stop.load()) return;
+      slot = L->free_.back();
+      L->free_.pop_back();
+    }
+    uint32_t* out = L->slots[slot].data();
+    for (int b = 0; b < L->batch; b++) {
+      // Pick a file weighted by token count, then a window inside it.
+      size_t target = next_rand(rng) % L->total_tokens;
+      size_t fi = 0;
+      while (cum[fi] <= target) fi++;
+      const MappedFile& f = L->files[fi];
+      size_t span = (size_t)L->seqlen;
+      // Files smaller than one window were rejected at create time, so
+      // n_tokens >= span always; +1 makes the final window reachable.
+      size_t start = next_rand(rng) % (f.n_tokens - span + 1);
+      std::memcpy(out + (size_t)b * L->row_elems, f.data + start,
+                  span * sizeof(uint32_t));
+    }
+    {
+      std::lock_guard<std::mutex> lk(L->mu);
+      L->ready.push_back(slot);
+    }
+    L->cv_ready.notify_one();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// paths: '\n'-separated file list. Returns nullptr on failure.
+void* rt_loader_create(const char* paths, int batch, int seqlen,
+                       uint64_t seed, int n_threads, int queue_depth) {
+  auto* L = new Loader();
+  L->batch = batch;
+  L->seqlen = seqlen;
+  L->row_elems = (size_t)seqlen;
+  L->seed = seed ? seed : 1;
+
+  std::string all(paths);
+  size_t pos = 0;
+  while (pos < all.size()) {
+    size_t nl = all.find('\n', pos);
+    if (nl == std::string::npos) nl = all.size();
+    std::string p = all.substr(pos, nl - pos);
+    pos = nl + 1;
+    if (p.empty()) continue;
+    int fd = open(p.c_str(), O_RDONLY);
+    if (fd < 0) {
+      delete L;
+      return nullptr;
+    }
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(uint32_t)) {
+      close(fd);
+      delete L;
+      return nullptr;
+    }
+    void* m = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+    if (m == MAP_FAILED) {
+      close(fd);
+      delete L;
+      return nullptr;
+    }
+    MappedFile mf;
+    mf.data = static_cast<const uint32_t*>(m);
+    mf.bytes = st.st_size;
+    mf.n_tokens = st.st_size / sizeof(uint32_t);
+    mf.fd = fd;
+    if (mf.n_tokens < (size_t)seqlen) {
+      // A file shorter than one window can never produce a full batch
+      // row; admitting it would read past its mapping (SIGBUS).
+      munmap(const_cast<uint32_t*>(mf.data), mf.bytes);
+      close(fd);
+      delete L;
+      return nullptr;
+    }
+    L->total_tokens += mf.n_tokens;
+    L->files.push_back(mf);
+  }
+  if (L->files.empty()) {
+    delete L;
+    return nullptr;
+  }
+
+  if (queue_depth < 2) queue_depth = 2;
+  L->slots.resize(queue_depth);
+  for (int i = 0; i < queue_depth; i++) {
+    L->slots[i].resize((size_t)batch * L->row_elems);
+    L->free_.push_back(i);
+  }
+  if (n_threads < 1) n_threads = 1;
+  for (int t = 0; t < n_threads; t++) {
+    L->workers.emplace_back(worker_fill, L, t);
+  }
+  return L;
+}
+
+// Wake any blocked rt_loader_next callers (they return -1) and stop the
+// workers. Call before destroy when another thread may be consuming —
+// deleting with live waiters would destroy a condvar in use (UB).
+void rt_loader_stop(void* h) {
+  Loader* L = static_cast<Loader*>(h);
+  L->stop.store(true);
+  L->cv_ready.notify_all();
+  L->cv_free.notify_all();
+}
+
+void rt_loader_destroy(void* h) { delete static_cast<Loader*>(h); }
+
+uint64_t rt_loader_total_tokens(void* h) {
+  return static_cast<Loader*>(h)->total_tokens;
+}
+
+// Copy the next ready batch into out ([batch * seqlen] uint32).
+// Returns 0 on success, -1 on shutdown.
+int rt_loader_next(void* h, uint32_t* out) {
+  Loader* L = static_cast<Loader*>(h);
+  int slot;
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_ready.wait(lk, [&] { return L->stop.load() || !L->ready.empty(); });
+    if (L->stop.load() && L->ready.empty()) return -1;
+    slot = L->ready.front();
+    L->ready.erase(L->ready.begin());
+  }
+  std::memcpy(out, L->slots[slot].data(),
+              (size_t)L->batch * L->row_elems * sizeof(uint32_t));
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->free_.push_back(slot);
+  }
+  L->cv_free.notify_one();
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,106 @@
+"""Native token-batch loader tests.
+
+Reference test model: data-loader correctness + determinism checks
+(torch DataLoader / Ray Data ingest tests).
+"""
+import numpy as np
+import pytest
+
+from ray_tpu.native import loader as nloader
+
+pytestmark = pytest.mark.skipif(not nloader.available(), reason="native toolchain unavailable")
+
+
+@pytest.fixture
+def token_files(tmp_path):
+    paths = []
+    rng = np.random.default_rng(0)
+    for i, n in enumerate([10_000, 5_000]):
+        toks = rng.integers(0, 32000, n, dtype=np.uint32)
+        # Tag each file's tokens with a distinct high bit pattern so we can
+        # verify windows never straddle files.
+        toks = toks + np.uint32(100_000 * (i + 1))
+        p = str(tmp_path / f"shard{i}.bin")
+        nloader.write_token_file(p, toks)
+        paths.append((p, toks))
+    return paths
+
+
+def test_loader_batches_are_real_windows(token_files):
+    paths = [p for p, _ in token_files]
+    arrays = {p: t for p, t in token_files}
+    ld = nloader.TokenLoader(paths, batch_size=4, seq_len=128, seed=7)
+    assert ld.total_tokens == 15_000
+    seen_files = set()
+    for _ in range(20):
+        batch = ld.next()
+        assert batch.shape == (4, 128) and batch.dtype == np.uint32
+        for row in batch:
+            # Every row must be a contiguous window of exactly one file.
+            fid = row[0] // 100_000
+            seen_files.add(int(fid))
+            src = arrays[paths[int(fid) - 1]]
+            # Locate the window by its first 4 tokens, then compare fully.
+            starts = np.where(src == row[0])[0]
+            assert any(
+                np.array_equal(src[s : s + 128], row)
+                for s in starts
+                if s + 128 <= len(src)
+            )
+    assert seen_files == {1, 2}  # both files sampled (weighted pick)
+    ld.close()
+
+
+def test_loader_deterministic_seed(token_files):
+    paths = [p for p, _ in token_files]
+    a = nloader.TokenLoader(paths, batch_size=2, seq_len=64, seed=42, num_threads=1)
+    b = nloader.TokenLoader(paths, batch_size=2, seq_len=64, seed=42, num_threads=1)
+    for _ in range(5):
+        np.testing.assert_array_equal(a.next(), b.next())
+    a.close()
+    b.close()
+
+
+def test_loader_bad_paths(tmp_path):
+    with pytest.raises(ValueError):
+        nloader.TokenLoader([str(tmp_path / "missing.bin")], 2, 16)
+    # A file smaller than one window is rejected too.
+    small = str(tmp_path / "small.bin")
+    nloader.write_token_file(small, np.arange(4, dtype=np.uint32))
+    with pytest.raises(ValueError):
+        nloader.TokenLoader([small], 2, 16)
+    # ...even when mixed with a large-enough file (a window from the small
+    # file would read past its mapping).
+    big = str(tmp_path / "big.bin")
+    nloader.write_token_file(big, np.arange(1000, dtype=np.uint32))
+    with pytest.raises(ValueError):
+        nloader.TokenLoader([big, small], 2, 16)
+
+
+def test_loader_close_semantics(token_files):
+    paths = [p for p, _ in token_files]
+    ld = nloader.TokenLoader(paths, batch_size=2, seq_len=32)
+    ld.next()
+    ld.close()
+    with pytest.raises(nloader.LoaderClosedError):
+        ld.next()
+    with pytest.raises(nloader.LoaderClosedError):
+        _ = ld.total_tokens
+    ld.close()  # idempotent
+    # Iteration ends cleanly (no PEP-479 RuntimeError) on a closed loader.
+    assert list(iter(ld)) == []
+
+
+def test_loader_throughput_smoke(token_files):
+    """The ring keeps producing under rapid consumption."""
+    import time
+
+    paths = [p for p, _ in token_files]
+    ld = nloader.TokenLoader(paths, batch_size=8, seq_len=256, num_threads=4)
+    t0 = time.time()
+    n = 0
+    while time.time() - t0 < 0.5:
+        ld.next()
+        n += 1
+    assert n > 50, n  # comfortably >100 MB/s on any host
+    ld.close()
