@@ -1,0 +1,162 @@
+"""Autoregressive inference: KV-cache prefill + single-token decode.
+
+The reference delegates LLM serving to external engines running as Ray
+actors (SURVEY.md §2.9 — vLLM/TGI on Ray); on TPU the decode loop must
+be native. Design:
+
+- Cache layout ``[L, b, max_len, kv_heads, head_dim]`` — the layer axis
+  leads so the per-step layer loop is one ``lax.scan`` over stacked
+  params+cache (same O(1)-compile structure as training's decoder_stack).
+- ``prefill`` runs the normal full-attention forward while collecting
+  each layer's roped K/V into the cache (one pass, MXU-shaped).
+- ``decode_step`` is a fixed-shape single-token step: roped q/k at the
+  scalar position, ``dynamic_update_slice`` into the cache, grouped-GQA
+  einsum attention against the full cache with a position mask — all
+  static shapes, so the jitted step is compiled once for a given
+  ``max_len``.
+- ``generate`` = prefill + ``lax.scan`` of decode steps with greedy or
+  temperature sampling; jit the whole thing for serving.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import (
+    Params,
+    TransformerConfig,
+    attention_block,
+    embed,
+    mlp_block,
+    project_qkv,
+    rms_norm,
+    unembed,
+)
+
+Cache = Dict[str, jax.Array]
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int) -> Cache:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _attend_cache(q, ck, cv, pos, cfg: TransformerConfig):
+    """q: [b, 1, H, HD]; ck/cv: [b, max_len, KV, HD]; pos: scalar.
+
+    Grouped-GQA einsum keeps the cache at kv-head width (no repeat)."""
+    b, _, H, HD = q.shape
+    KV = cfg.n_kv_heads
+    G = H // KV
+    qg = q.reshape(b, 1, KV, G, HD)
+    scores = jnp.einsum(
+        "bqkgd,bmkd->bqkgm", qg.astype(jnp.float32), ck.astype(jnp.float32)
+    ) * (HD**-0.5)
+    m = ck.shape[1]
+    valid = jnp.arange(m) <= pos  # causal over the filled prefix
+    scores = jnp.where(valid[None, None, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    og = jnp.einsum("bqkgm,bmkd->bqkgd", probs, cv.astype(jnp.float32))
+    return og.reshape(b, 1, H * HD).astype(q.dtype)
+
+
+def _decoder_layer_step(x, lp: Params, cfg: TransformerConfig, ck, cv, pos):
+    """One layer, one token. x: [b, 1, d]; returns (x, ck, cv) updated."""
+    b = x.shape[0]
+    h = rms_norm(x, lp["attn_norm"])
+    positions = jnp.full((b, 1), pos, jnp.int32)
+    q, k, v = project_qkv(h, lp, cfg, positions)
+    ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+    o = _attend_cache(q, ck, cv, pos, cfg)
+    x = x + o @ lp["wo"].astype(o.dtype)
+    x = mlp_block(x, lp, cfg)
+    return x, ck, cv
+
+
+def decode_step(
+    params: Params, cfg: TransformerConfig, tokens: jax.Array, cache: Cache, pos
+) -> Tuple[jax.Array, Cache]:
+    """tokens: [b] int32 (the tokens AT position ``pos``) → (logits [b, V]
+    fp32 for the next position, updated cache)."""
+    x = embed(params, tokens[:, None], cfg)
+
+    def body(carry, xs):
+        lp, ck, cv = xs
+        x, ck, cv = _decoder_layer_step(carry, lp, cfg, ck, cv, pos)
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    logits = unembed(params, x, cfg)[:, 0]
+    return logits, {"k": ks, "v": vs}
+
+
+def prefill(
+    params: Params, cfg: TransformerConfig, tokens: jax.Array, max_len: int
+) -> Tuple[jax.Array, Cache]:
+    """Full-attention prefill. tokens: [b, s] → (logits [b, s, V], cache
+    with positions [0, s) filled)."""
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+    h = embed(params, tokens, cfg)
+
+    def body(carry, lp):
+        # Exactly the training layer, with the pre-repeat roped K/V
+        # captured for the cache.
+        x, k, v = attention_block(carry, lp, cfg, positions, return_kv=True)
+        x = mlp_block(x, lp, cfg)
+        return x, (k, v)
+
+    h, (ks, vs) = jax.lax.scan(body, h, params["layers"])
+    logits = unembed(params, h, cfg)
+    cache = init_kv_cache(cfg, b, max_len)
+    cache = {
+        "k": jax.lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, 0, 0)),
+    }
+    return logits, cache
+
+
+def generate(
+    params: Params,
+    cfg: TransformerConfig,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Greedy (temperature=0) or sampled continuation. prompt: [b, s] →
+    generated tokens [b, max_new_tokens]. Jit-friendly end to end."""
+    b, s = prompt.shape
+    if max_new_tokens <= 0:
+        return jnp.zeros((b, 0), jnp.int32)
+    if temperature > 0 and key is None:
+        raise ValueError("temperature > 0 requires an explicit PRNG key")
+    max_len = s + max_new_tokens
+    logits, cache = prefill(params, cfg, prompt, max_len)
+    key = key if key is not None else jax.random.PRNGKey(0)
+
+    def sample(logits, k):
+        if temperature > 0:
+            return jax.random.categorical(k, logits / temperature).astype(jnp.int32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    key, sub = jax.random.split(key)
+    first = sample(logits[:, -1], sub)
+
+    def body(carry, _):
+        tok, cache, pos, key = carry
+        logits, cache = decode_step(params, cfg, tok, cache, pos)
+        key, sub = jax.random.split(key)
+        nxt = sample(logits, sub)
+        return (nxt, cache, pos + 1, key), tok
+
+    (last, *_), toks = jax.lax.scan(
+        body, (first, cache, jnp.int32(s), key), None, length=max_new_tokens - 1
+    )
+    # toks collects the fed tokens (first..n-2); append the final sample.
+    out = jnp.concatenate([jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+    return out

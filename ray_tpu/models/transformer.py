@@ -128,32 +128,46 @@ def _rope(x, positions, theta: float):
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
 
+def project_qkv(h, lp: Params, cfg: TransformerConfig, positions):
+    """Normed hidden → (roped q [b,s,H,hd], roped k [b,s,KV,hd], v) — the
+    single source of the projection/rope math for training AND the
+    KV-cache decode path (models/generate.py)."""
+    b, s, _ = h.shape
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    q = (h @ lp["wq"].astype(h.dtype)).reshape(b, s, H, HD)
+    k = (h @ lp["wk"].astype(h.dtype)).reshape(b, s, KV, HD)
+    v = (h @ lp["wv"].astype(h.dtype)).reshape(b, s, KV, HD)
+    return _rope(q, positions, cfg.rope_theta), _rope(k, positions, cfg.rope_theta), v
+
+
 def attention_block(
     x,
     lp: Params,
     cfg: TransformerConfig,
     positions,
     attn_fn: Optional[Callable] = None,
+    return_kv: bool = False,
 ):
     """x: [b, s, d]. attn_fn overrides the core attention (ring attention
-    under sequence parallelism)."""
+    under sequence parallelism). With ``return_kv`` also returns the
+    pre-repeat roped (k, v) for KV-cache prefill."""
     b, s, d = x.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = rms_norm(x, lp["attn_norm"])
-    q = (h @ lp["wq"].astype(h.dtype)).reshape(b, s, H, HD)
-    k = (h @ lp["wk"].astype(h.dtype)).reshape(b, s, KV, HD)
-    v = (h @ lp["wv"].astype(h.dtype)).reshape(b, s, KV, HD)
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    q, k, v = project_qkv(h, lp, cfg, positions)
+    kr, vr = k, v
     if KV != H:
         rep = H // KV
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [b,h,s,hd]
+        kr = jnp.repeat(k, rep, axis=2)
+        vr = jnp.repeat(v, rep, axis=2)
+    qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, kr, vr))  # [b,h,s,hd]
     fn = attn_fn or (lambda q, k, v: flash_attention(q, k, v, True, None))
-    o = fn(q, k, v)
+    o = fn(qt, kt, vt)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, H * HD)
-    return x + o @ lp["wo"].astype(o.dtype)
+    out = x + o @ lp["wo"].astype(o.dtype)
+    if return_kv:
+        return out, k, v
+    return out
 
 
 def mlp_block(x, lp: Params, cfg: TransformerConfig):
