@@ -100,7 +100,11 @@ def init(
         )
 
     loop_runner = rpc.EventLoopThread("driver-io")
-    _global_worker = CoreWorker(address, mode="driver", loop_runner=loop_runner)
+    from ray_tpu.core.client import DriverHandler
+
+    _global_worker = CoreWorker(
+        address, mode="driver", loop_runner=loop_runner, handler=DriverHandler()
+    )
     atexit.register(shutdown)
     return {"address": address, "session_dir": _global_worker.session_dir}
 

@@ -262,3 +262,13 @@ class CoreWorker:
 class _NullHandler:
     def on_disconnect(self, peer):
         pass
+
+
+class DriverHandler(_NullHandler):
+    """Driver-side handlers for controller pushes (reference: the driver
+    prints worker log lines — worker.py print_to_stdstream)."""
+
+    def rpc_log_batch(self, peer, batch):
+        from ray_tpu.core.log_monitor import print_to_driver
+
+        print_to_driver(batch)

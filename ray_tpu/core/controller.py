@@ -1337,8 +1337,35 @@ class Controller:
         if self._restored.pgs or self._restored.actors:
             self._schedule_pump()
 
+    def _broadcast_logs(self, batch):
+        """Thread→loop bridge: fan worker-log lines out to drivers
+        (reference: log_monitor publish + driver print_to_stdstream)."""
+        if not self.drivers or self._loop is None:
+            return
+
+        async def send():
+            for peer in list(self.drivers):
+                try:
+                    await peer.notify("log_batch", batch)
+                except Exception:
+                    pass
+
+        asyncio.run_coroutine_threadsafe(send(), self._loop)
+
     async def run(self, port: int = 0):
         server, self.port = await rpc.serve(self, port=port)
+        self._loop = asyncio.get_running_loop()
+        self._log_tailer = None
+        if self.config.log_to_driver:
+            from ray_tpu.core.log_monitor import LogTailer
+
+            # One tailer on the session log dir covers every worker that
+            # logs into this session (all nodes are host-local processes;
+            # a true multi-host deployment runs a tailer per node agent).
+            self._log_tailer = LogTailer(
+                os.path.join(self.session_dir, "logs"), self._broadcast_logs
+            )
+            self._log_tailer.start()
         await self._restore_persisted()
         if self.config.memory_monitor_refresh_ms > 0:
             # Keep a strong ref: the loop holds tasks weakly and an
@@ -1359,6 +1386,8 @@ class Controller:
         if self._head_prestart:
             await self._request_workers(self.nodes[self.head_node_id], self._head_prestart)
         await self._shutdown.wait()
+        if self._log_tailer is not None:
+            self._log_tailer.stop()
         # Teardown: tell everyone to exit.
         for w in list(self.workers.values()):
             try:

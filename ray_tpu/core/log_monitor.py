@@ -1,0 +1,117 @@
+"""Per-node log tailer: stream worker stdout/stderr to the driver.
+
+Reference: python/ray/_private/log_monitor.py — one monitor per node
+tails worker log files and publishes new lines; the driver prints them
+(worker.py:1924 print_to_stdstream). Here the tailer runs inside the
+node agent (and the controller for head-node workers), forwards line
+batches over the existing control connection, and the controller fans
+them out to connected drivers.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+# A batch is a list of (source, line) tuples; source is the log file's
+# basename (e.g. "worker-ab12cd34.log") which encodes the worker id.
+LogBatch = List[Tuple[str, str]]
+
+
+class LogTailer:
+    """Polls ``worker-*.log`` files under a log dir for appended lines."""
+
+    def __init__(
+        self,
+        log_dir: str,
+        publish: Callable[[LogBatch], None],
+        poll_interval: float = 0.25,
+        pattern: str = "worker-*.log",
+        max_batch_lines: int = 1000,
+    ):
+        self.log_dir = log_dir
+        self.pattern = pattern
+        self.publish = publish
+        self.poll_interval = poll_interval
+        self.max_batch_lines = max_batch_lines
+        self._offsets: Dict[str, int] = {}
+        self._partials: Dict[str, str] = {}
+        # Lines read but not yet emitted (batch-cap overflow carry-over).
+        self._pending: LogBatch = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, name="log-tailer", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    # ------------------------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self.poll_interval):
+            try:
+                batch = self.poll_once()
+                if batch:
+                    self.publish(batch)
+            except Exception:  # pragma: no cover — keep tailing
+                pass
+        # Final sweep so lines written just before shutdown still arrive.
+        try:
+            batch = self.poll_once()
+            if batch:
+                self.publish(batch)
+        except Exception:
+            pass
+
+    def poll_once(self) -> LogBatch:
+        # Overflow from the previous poll goes out first — the offset has
+        # already advanced past those bytes, so dropping them would lose
+        # lines permanently.
+        batch: LogBatch = self._pending[: self.max_batch_lines]
+        self._pending = self._pending[self.max_batch_lines :]
+        if len(batch) >= self.max_batch_lines:
+            return batch
+        for path in sorted(glob.glob(os.path.join(self.log_dir, self.pattern))):
+            name = os.path.basename(path)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            offset = self._offsets.get(name, 0)
+            if size <= offset:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(size - offset)
+            except OSError:
+                continue
+            self._offsets[name] = size
+            text = self._partials.pop(name, "") + data.decode("utf-8", errors="replace")
+            lines = text.split("\n")
+            # Trailing element is a partial line (or "" after a newline).
+            if lines and lines[-1]:
+                self._partials[name] = lines[-1]
+            for line in lines[:-1]:
+                # Blank lines are preserved — the driver should reproduce
+                # worker output faithfully.
+                if len(batch) < self.max_batch_lines:
+                    batch.append((name, line))
+                else:
+                    self._pending.append((name, line))
+        return batch
+
+
+def print_to_driver(batch: LogBatch):
+    """Driver-side sink (reference: print_to_stdstream — prefix lines with
+    their source worker)."""
+    import sys
+
+    for source, line in batch:
+        tag = source.replace("worker-", "").replace(".log", "")
+        print(f"({tag}) {line}", file=sys.stderr)

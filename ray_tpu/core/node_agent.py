@@ -57,6 +57,9 @@ def spawn_worker(session_dir: str, controller_addr: str, node_id: NodeID, shm_di
         RAY_TPU_WORKER_ID=worker_id.hex(),
         RAY_TPU_SHM_DIR=shm_dir,
         RAY_TPU_SESSION_DIR=session_dir,
+        # Log-to-driver streaming tails the redirected stdout file; block
+        # buffering would hold prints back until process exit.
+        PYTHONUNBUFFERED="1",
     )
     log_dir = os.path.join(session_dir, "logs")
     os.makedirs(log_dir, exist_ok=True)

@@ -205,3 +205,50 @@ def test_tracing_spans_and_propagation(ray_start_regular):
         )
         time.sleep(0.2)
     assert found, [e["name"] for e in events]
+
+
+def test_worker_prints_stream_to_driver(ray_start_regular, capfd):
+    """print() inside a task reaches the driver's stderr (reference:
+    log_monitor tail + print_to_stdstream)."""
+    import time
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def chatty():
+        print("HELLO-FROM-WORKER-12345")
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=30) == 1
+    deadline = time.time() + 10
+    seen = ""
+    while time.time() < deadline:
+        seen += capfd.readouterr().err
+        if "HELLO-FROM-WORKER-12345" in seen:
+            break
+        time.sleep(0.2)
+    assert "HELLO-FROM-WORKER-12345" in seen
+    # Lines carry a worker-id prefix.
+    line = next(l for l in seen.splitlines() if "HELLO-FROM-WORKER-12345" in l)
+    assert line.startswith("(")
+
+
+def test_log_tailer_overflow_and_blank_lines(tmp_path):
+    """Unit: batch-cap overflow carries to the next poll; blank lines are
+    preserved."""
+    from ray_tpu.core.log_monitor import LogTailer
+
+    log = tmp_path / "worker-abc.log"
+    log.write_text("\n".join(f"line{i}" for i in range(25)) + "\n\npartial")
+    tailer = LogTailer(str(tmp_path), publish=lambda b: None, max_batch_lines=10)
+    b1 = tailer.poll_once()
+    assert [l for _, l in b1] == [f"line{i}" for i in range(10)]
+    b2 = tailer.poll_once()
+    b3 = tailer.poll_once()
+    lines = [l for _, l in b2 + b3]
+    assert lines == [f"line{i}" for i in range(10, 25)] + [""]  # blank kept
+    # the trailing "partial" (no newline yet) is withheld...
+    assert tailer.poll_once() == []
+    with open(log, "a") as f:
+        f.write(" done\n")
+    assert [l for _, l in tailer.poll_once()] == ["partial done"]
