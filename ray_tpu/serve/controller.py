@@ -23,6 +23,13 @@ class ServeController:
         self._lock = threading.RLock()
         self._reconcile_lock = threading.Lock()
         self._deployments: Dict[str, dict] = {}
+        # Replica startup tracking: birth time per actor id, and the set
+        # that have answered a health check (confirmed). A replica still
+        # inside __init__ (model load / jit compile) gets an
+        # initialization grace instead of the 5s ping kill (reference:
+        # deployment_state initialization timeout).
+        self._birth: Dict[Any, float] = {}
+        self._confirmed: set = set()
         self._version = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._reconcile_loop, daemon=True)
@@ -171,6 +178,7 @@ class ServeController:
                     num_tpus=cfg.get("num_tpus", 0),
                     resources=cfg.get("resources"),
                 ).remote(name, d["cls_blob"], d["init_args"], d["init_kwargs"])
+                self._birth[replica._actor_id] = time.time()
                 alive.append(replica)
             if missing < 0:
                 for r in alive[d["target"] :]:
@@ -201,17 +209,48 @@ class ServeController:
                     d["target"] = want
                     self._version += 1
 
-    def _healthy(self, replica) -> bool:
+    INIT_GRACE_S = 120.0  # reference: deployment initialization timeout
+
+    def _replica_state(self, key) -> str:
         try:
-            return self._ray.get(replica.check_health.remote(), timeout=5) == "ok"
+            from ray_tpu.util import state as state_api
+
+            rec = state_api.get_actor(key.hex())
+            return rec["state"] if rec else "DEAD"
         except Exception:  # noqa: BLE001
-            try:
+            return "UNKNOWN"
+
+    def _healthy(self, replica) -> bool:
+        key = replica._actor_id
+        in_grace = (
+            key not in self._confirmed
+            and time.time() - self._birth.get(key, time.time()) < self.INIT_GRACE_S
+        )
+        if in_grace:
+            # Don't burn a 5s ping timeout on a replica still inside
+            # __init__ — ask the cluster's actor table instead. ALIVE but
+            # unconfirmed also stays in grace: the first requests may be
+            # holding every actor thread through a long jit warmup.
+            state = self._replica_state(key)
+            if state == "DEAD":
                 self._kill(replica)
-            except Exception:
-                pass
+                return False
+            if state != "ALIVE":
+                return True  # PENDING / RESTARTING / UNKNOWN: keep waiting
+        try:
+            ok = self._ray.get(replica.check_health.remote(), timeout=5) == "ok"
+            if ok:
+                self._confirmed.add(key)
+            return ok
+        except Exception:  # noqa: BLE001
+            if in_grace:
+                return True
+            self._kill(replica)
             return False
 
     def _kill(self, replica):
+        self._birth.pop(replica._actor_id, None)
+        self._confirmed.discard(replica._actor_id)
         try:
             self._ray.kill(replica)
         except Exception:  # noqa: BLE001
