@@ -242,3 +242,30 @@ def test_generate_gqa_and_moe():
                      key=jax.random.PRNGKey(9))
     )
     assert out2.shape == (1, 4)
+
+
+def test_flash_block_q_gt_block_k_ragged():
+    """Causal with block_q > block_k and a partial final q-block: the
+    k-block loop must clamp instead of issuing a clamped (row-shifting)
+    slice past the padded K length."""
+    from ray_tpu.ops import attention as att
+
+    key = jax.random.PRNGKey(11)
+    q, k, v = (
+        jax.random.normal(kk, (1, 2, 192, 32), jnp.float32)
+        for kk in jax.random.split(key, 3)
+    )
+    ref = reference_attention(q, k, v, causal=True)
+    out, lse = att._flash_forward(q, k, v, causal=True, scale=32**-0.5,
+                                  block_q=128, block_k=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-2, atol=2e-2)
+    g = jax.random.normal(key, (1, 2, 192, 32), jnp.float32)
+    dq, dk, dv = att._flash_backward(q, k, v, out, lse, g, causal=True,
+                                     scale=32**-0.5, block_q=128, block_k=64,
+                                     interpret=True)
+    def f_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=True, scale=32**-0.5) * g).sum()
+    rq, rk, rv = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), rtol=2e-2, atol=2e-2)
