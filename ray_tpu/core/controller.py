@@ -92,6 +92,7 @@ class NodeRecord:
     shm_dir: str
     peer: Optional[rpc.Peer]  # None for the head node (controller-managed)
     hostname: str = "localhost"
+    agent_pid: int = 0  # node agent process (0 for the head)
     state: str = "ALIVE"
     workers: Set[WorkerID] = field(default_factory=set)
     num_starting: int = 0
@@ -187,8 +188,13 @@ class Controller:
         self.head_store = PlasmaStore(session_dir, cap)
         head_total = ResourceSet.from_dict(head_resources)
         self.cluster.add_node(self.head_node_id, NodeResources(head_total, labels={"node_type": "head"}))
+        import socket
+
         self.nodes[self.head_node_id] = NodeRecord(
-            node_id=self.head_node_id, shm_dir=self.head_store.shm_dir, peer=None
+            node_id=self.head_node_id,
+            shm_dir=self.head_store.shm_dir,
+            peer=None,
+            hostname=socket.gethostname(),
         )
         ncpu = int(head_resources.get("CPU", 1))
         self.nodes[self.head_node_id].max_workers = max(4 * max(ncpu, 1), 16)
@@ -239,12 +245,13 @@ class Controller:
         self._schedule_pump()
         return {"session_dir": self.session_dir, "config": self.config.to_dict()}
 
-    async def rpc_register_node(self, peer: rpc.Peer, node_id: NodeID, resources: Dict[str, float], shm_dir: str, hostname: str = "localhost"):
+    async def rpc_register_node(self, peer: rpc.Peer, node_id: NodeID, resources: Dict[str, float], shm_dir: str, hostname: str = "localhost", pid: int = 0):
         peer.meta.update(kind="agent", node_id=node_id)
         total = ResourceSet.from_dict(resources)
         self.cluster.add_node(node_id, NodeResources(total))
         ncpu = int(resources.get("CPU", 1))
-        rec = NodeRecord(node_id=node_id, shm_dir=shm_dir, peer=peer)
+        rec = NodeRecord(node_id=node_id, shm_dir=shm_dir, peer=peer, hostname=hostname)
+        rec.agent_pid = pid
         rec.max_workers = max(4 * max(ncpu, 1), 16)
         rec.tpu_free = list(range(int(resources.get("TPU", 0))))
         self.nodes[node_id] = rec
@@ -1078,6 +1085,8 @@ class Controller:
                     "state": node.state,
                     "is_head": node.peer is None,
                     "num_workers": len(node.workers),
+                    "agent_pid": node.agent_pid,
+                    "hostname": node.hostname,
                     "resources": res.to_dict() if res else {},
                 }
             )
@@ -1090,6 +1099,7 @@ class Controller:
                 "node_id": w.node_id.hex(),
                 "state": w.state,
                 "pid": w.pid,
+                "hostname": self.nodes[w.node_id].hostname if w.node_id in self.nodes else "localhost",
                 "actor_id": w.actor_id.hex() if w.actor_id else None,
             }
             for w in self.workers.values()

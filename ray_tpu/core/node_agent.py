@@ -124,8 +124,11 @@ class NodeAgent:
     async def run(self):
         host, port = self.controller_addr.rsplit(":", 1)
         peer = await rpc.connect(host, int(port), self)
+        import socket
+
         await peer.call(
-            "register_node", self.node_id, self.resources, self.store.shm_dir
+            "register_node", self.node_id, self.resources, self.store.shm_dir,
+            hostname=socket.gethostname(), pid=os.getpid()
         )
         try:
             while not self._exit.is_set():

@@ -1,0 +1,132 @@
+"""Chaos / fault-injection utilities.
+
+Reference: python/ray/_private/test_utils.py — ResourceKillerActor
+(:1433), NodeKillerBase (:1500), WorkerKillerActor (:1597) — reusable
+killer actors that randomly destroy cluster components while a workload
+runs, and release/nightly_tests/setup_chaos.py which installs them for
+chaos suites. Same shape here: killer actors driven by an interval loop,
+started/stopped around a workload, reporting what they killed.
+"""
+from __future__ import annotations
+
+import os
+import random
+import signal
+import socket
+import threading
+import time
+from typing import List, Optional
+
+import ray_tpu
+
+
+class _KillerBase:
+    """Interval loop calling ``_kill_one`` until stopped."""
+
+    def __init__(self, kill_interval_s: float = 1.0, max_kills: int = 0, seed: int = 0):
+        self._interval = kill_interval_s
+        self._max = max_kills  # 0 = unlimited
+        self._rng = random.Random(seed)
+        self._killed: List[str] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def run(self):
+        """Start killing in the background (call via .remote())."""
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return True
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            if self._max and len(self._killed) >= self._max:
+                return
+            try:
+                victim = self._kill_one()
+                if victim:
+                    self._killed.append(victim)
+            except Exception:  # noqa: BLE001 — chaos must not kill itself
+                pass
+
+    def stop_run(self) -> List[str]:
+        """Stop and report the kill log."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        return list(self._killed)
+
+    def get_total_killed(self) -> List[str]:
+        return list(self._killed)
+
+    def _kill_one(self) -> Optional[str]:
+        raise NotImplementedError
+
+
+@ray_tpu.remote(num_cpus=0)
+class WorkerKillerActor(_KillerBase):
+    """SIGKILLs random busy workers (reference: WorkerKillerActor —
+    exercises task retry / actor restart paths)."""
+
+    def _kill_one(self) -> Optional[str]:
+        from ray_tpu.util import state as state_api
+
+        me = os.getpid()
+        host = socket.gethostname()
+        victims = [
+            w
+            for w in state_api.list_workers()
+            if w.get("state") in ("LEASED", "ACTOR")
+            and w.get("pid")
+            and w["pid"] != me
+            # pids are only meaningful on this host (same rule the memory
+            # monitor applies; a true multi-host chaos run needs a killer
+            # per host).
+            and w.get("hostname", host) == host
+        ]
+        if not victims:
+            return None
+        v = self._rng.choice(victims)
+        try:
+            os.kill(v["pid"], signal.SIGKILL)
+        except ProcessLookupError:
+            return None
+        return f"worker:{v['worker_id'][:8]}:pid={v['pid']}"
+
+
+@ray_tpu.remote(num_cpus=0)
+class NodeKillerActor(_KillerBase):
+    """SIGKILLs random non-head node agents (reference: NodeKillerBase —
+    exercises node-death rescheduling, PG rescheduling, lineage
+    reconstruction)."""
+
+    def _kill_one(self) -> Optional[str]:
+        from ray_tpu.util import state as state_api
+
+        host = socket.gethostname()
+        my_node = os.environ.get("RAY_TPU_NODE_ID", "")
+        nodes = [
+            n
+            for n in state_api.list_nodes()
+            if n.get("state") == "ALIVE"
+            and not n.get("is_head")
+            and n.get("agent_pid")
+            and n.get("hostname", host) == host  # local pids only
+            and n["node_id"] != my_node  # never saw off our own branch
+        ]
+        if not nodes:
+            return None
+        n = self._rng.choice(nodes)
+        try:
+            os.kill(n["agent_pid"], signal.SIGKILL)
+        except ProcessLookupError:
+            return None
+        return f"node:{n['node_id'][:8]}"
+
+
+def get_and_run_worker_killer(
+    kill_interval_s: float = 1.0, max_kills: int = 0, seed: int = 0
+):
+    """Convenience mirroring setup_chaos.py's get_chaos_killer."""
+    killer = WorkerKillerActor.remote(kill_interval_s, max_kills, seed)
+    ray_tpu.get(killer.run.remote())
+    return killer
