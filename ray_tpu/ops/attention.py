@@ -72,40 +72,56 @@ def _flash_fwd_kernel(
     else:
         num_k_blocks_needed = num_k_blocks
 
-    def body(kb, carry):
-        acc, m_prev, l_prev = carry
-        k_start = kb * block_k
-        kblk = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [block_q, block_k]
-        # k_len/k_len_actual are trace-time ints: unpadded non-causal runs
-        # skip masking entirely.
-        needs_pad_mask = k_len_actual < k_len
-        if causal or needs_pad_mask:
-            k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            valid = (k_ids < k_len_actual) if needs_pad_mask else True
-            if causal:
-                q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-                valid = valid & (q_ids >= k_ids)
-            s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
-        m_cur = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new[:, None])
-        correction = jnp.exp(m_prev - m_new)
-        l_new = l_prev * correction + jnp.sum(p, axis=-1)
-        acc = acc * correction[:, None] + jax.lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return acc, m_new, l_new
+    def make_body(masked: bool):
+        def body(kb, carry):
+            acc, m_prev, l_prev = carry
+            k_start = kb * block_k
+            kblk = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+            vblk = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )  # [block_q, block_k]
+            needs_pad_mask = k_len_actual < k_len
+            if masked and (causal or needs_pad_mask):
+                k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                valid = (k_ids < k_len_actual) if needs_pad_mask else True
+                if causal:
+                    q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                    valid = valid & (q_ids >= k_ids)
+                s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
+            m_cur = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new[:, None])
+            correction = jnp.exp(m_prev - m_new)
+            l_new = l_prev * correction + jnp.sum(p, axis=-1)
+            acc = acc * correction[:, None] + jax.lax.dot_general(
+                p, vblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            return acc, m_new, l_new
+
+        return body
 
     init = (
         jnp.zeros((block_q, head_dim), jnp.float32),
         jnp.full((block_q,), -jnp.inf, jnp.float32),
         jnp.zeros((block_q,), jnp.float32),
     )
-    acc, m, l = jax.lax.fori_loop(0, num_k_blocks_needed, body, init)
+    if causal:
+        # Two phases: k blocks fully below the diagonal need no mask (the
+        # mask's iota/compare/select is VPU work comparable to the MXU
+        # matmul at these block shapes); only diagonal-crossing blocks pay
+        # for it. The clamp to whole real-K blocks keeps the unmasked
+        # phase off the zero padding AND in-bounds when q_len > k_len
+        # (self-attention never hits either, cross-length causal does).
+        num_full = jnp.minimum(
+            jax.lax.div(q_start, block_k), k_len_actual // block_k
+        )
+        carry = jax.lax.fori_loop(0, num_full, make_body(False), init)
+        acc, m, l = jax.lax.fori_loop(
+            num_full, num_k_blocks_needed, make_body(True), carry
+        )
+    else:
+        acc, m, l = jax.lax.fori_loop(0, num_k_blocks_needed, make_body(True), init)
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
     # logsumexp of the scaled scores — the backward kernels rebuild
     # P = exp(S - lse) from it instead of re-running the softmax.
@@ -181,33 +197,43 @@ def _flash_bwd_dq_kernel(
     else:
         num_k_blocks_needed = num_k_blocks
 
-    def body(kb, acc):
-        k_start = kb * block_k
-        kblk = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        needs_pad_mask = k_len_actual < k_len
-        if causal or needs_pad_mask:
-            k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            valid = (k_ids < k_len_actual) if needs_pad_mask else True
-            if causal:
-                q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-                valid = valid & (q_ids >= k_ids)
-            s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
-        p = jnp.exp(s - lse[:, None])  # masked entries underflow to 0
-        dp = jax.lax.dot_general(
-            do, vblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta[:, None])
-        return acc + jax.lax.dot_general(
-            ds, kblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
+    def make_body(masked: bool):
+        def body(kb, acc):
+            k_start = kb * block_k
+            kblk = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+            vblk = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale
+            needs_pad_mask = k_len_actual < k_len
+            if masked and (causal or needs_pad_mask):
+                k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                valid = (k_ids < k_len_actual) if needs_pad_mask else True
+                if causal:
+                    q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                    valid = valid & (q_ids >= k_ids)
+                s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
+            p = jnp.exp(s - lse[:, None])  # masked entries underflow to 0
+            dp = jax.lax.dot_general(
+                do, vblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            ds = p * (dp - delta[:, None])
+            return acc + jax.lax.dot_general(
+                ds, kblk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
 
-    acc = jax.lax.fori_loop(
-        0, num_k_blocks_needed, body, jnp.zeros((block_q, head_dim), jnp.float32)
-    )
+        return body
+
+    init = jnp.zeros((block_q, head_dim), jnp.float32)
+    if causal:
+        # Same two-phase split + clamp as the forward kernel (see there).
+        num_full = jnp.minimum(
+            jax.lax.div(q_start, block_k), k_len_actual // block_k
+        )
+        acc = jax.lax.fori_loop(0, num_full, make_body(False), init)
+        acc = jax.lax.fori_loop(num_full, num_k_blocks_needed, make_body(True), acc)
+    else:
+        acc = jax.lax.fori_loop(0, num_k_blocks_needed, make_body(True), init)
     dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
 
 
@@ -229,42 +255,51 @@ def _flash_bwd_dkv_kernel(
     # Causal: q blocks strictly before this k block see none of it.
     start_qb = jax.lax.div(k_start, block_q) if causal else 0
 
-    def body(qb, carry):
-        dk, dv = carry
-        q_start = qb * block_q
-        qblk = q_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
-        doblk = do_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(q_start, block_q)].astype(jnp.float32)
-        delta = delta_ref[0, 0, pl.ds(q_start, block_q)].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            qblk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, DEFAULT_MASK_VALUE)
-        p = jnp.exp(s - lse[:, None])
-        dv = dv + jax.lax.dot_general(
-            p, doblk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        dp = jax.lax.dot_general(
-            doblk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta[:, None])
-        dk = dk + jax.lax.dot_general(
-            ds, qblk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return dk, dv
+    def make_body(masked: bool):
+        def body(qb, carry):
+            dk, dv = carry
+            q_start = qb * block_q
+            qblk = q_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+            doblk = do_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+            lse = lse_ref[0, 0, pl.ds(q_start, block_q)].astype(jnp.float32)
+            delta = delta_ref[0, 0, pl.ds(q_start, block_q)].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                qblk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale
+            if masked and causal:
+                q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(q_ids >= k_ids, s, DEFAULT_MASK_VALUE)
+            p = jnp.exp(s - lse[:, None])
+            dv = dv + jax.lax.dot_general(
+                p, doblk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            dp = jax.lax.dot_general(
+                doblk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            ds = p * (dp - delta[:, None])
+            dk = dk + jax.lax.dot_general(
+                ds, qblk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            return dk, dv
 
-    dk, dv = jax.lax.fori_loop(
-        start_qb,
-        num_q_blocks,
-        body,
-        (
-            jnp.zeros((block_k, head_dim), jnp.float32),
-            jnp.zeros((block_k, head_dim), jnp.float32),
-        ),
+        return body
+
+    init = (
+        jnp.zeros((block_k, head_dim), jnp.float32),
+        jnp.zeros((block_k, head_dim), jnp.float32),
     )
+    if causal:
+        # Masked head phase: q blocks overlapping this k block's diagonal
+        # span; everything after q_start >= k_start + block_k is fully
+        # above the diagonal and needs no mask.
+        first_full = jnp.minimum(
+            jax.lax.div(k_start + block_k + block_q - 1, block_q), num_q_blocks
+        )
+        carry = jax.lax.fori_loop(start_qb, first_full, make_body(True), init)
+        dk, dv = jax.lax.fori_loop(first_full, num_q_blocks, make_body(False), carry)
+    else:
+        dk, dv = jax.lax.fori_loop(start_qb, num_q_blocks, make_body(True), init)
     dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 

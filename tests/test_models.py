@@ -269,3 +269,45 @@ def test_flash_block_q_gt_block_k_ragged():
     np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), rtol=2e-2, atol=2e-2)
     np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), rtol=2e-2, atol=2e-2)
     np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("k_len", [128, 96], ids=["q_gt_k", "q_gt_k_padded"])
+def test_flash_causal_cross_length(k_len):
+    """Causal with q_len > k_len (top-left convention): the unmasked
+    phase must stay off K padding and in bounds."""
+    from ray_tpu.ops import attention as att
+
+    q_len, d = 320, 32
+    key = jax.random.PRNGKey(13)
+    kq, kk_, kv, kg = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (1, 2, q_len, d), jnp.float32)
+    k = jax.random.normal(kk_, (1, 2, k_len, d), jnp.float32)
+    v = jax.random.normal(kv, (1, 2, k_len, d), jnp.float32)
+    g = jax.random.normal(kg, (1, 2, q_len, d), jnp.float32)
+    scale = d**-0.5
+
+    # Oracle with the kernel's q_ids >= k_ids convention.
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    qi = jnp.arange(q_len)[:, None]
+    ki = jnp.arange(k_len)[None, :]
+    logits = jnp.where(qi >= ki, logits, att.DEFAULT_MASK_VALUE)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(logits, axis=-1), v)
+
+    out, lse = att._flash_forward(q, k, v, causal=True, scale=scale,
+                                  block_q=64, block_k=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-2, atol=2e-2)
+
+    dq, dk, dv = att._flash_backward(q, k, v, out, lse, g, causal=True,
+                                     scale=scale, block_q=64, block_k=64,
+                                     interpret=True)
+
+    def f_ref(q, k, v):
+        lg = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        lg = jnp.where(qi >= ki, lg, att.DEFAULT_MASK_VALUE)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(lg, axis=-1), v)
+        return (o * g).sum()
+
+    rq, rk, rv = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), rtol=2e-2, atol=2e-2)
