@@ -19,6 +19,7 @@ from ray_tpu.core.api import (
     init,
     is_initialized,
     kill,
+    drain_node,
     nodes,
     put,
     remote,
@@ -49,6 +50,7 @@ __all__ = [
     "cluster_resources",
     "available_resources",
     "nodes",
+    "drain_node",
     "timeline",
     "ObjectRef",
     "ActorClass",

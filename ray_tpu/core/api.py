@@ -243,6 +243,16 @@ def nodes() -> list:
     return _require_worker().list_state("nodes")
 
 
+def drain_node(node_id, timeout_s: float = 300.0) -> bool:
+    """Gracefully drain a node: no new placements, running work finishes,
+    then the node retires (reference: `ray drain-node` / rpc::DrainNode)."""
+    from ray_tpu.utils.ids import NodeID
+
+    if isinstance(node_id, str):
+        node_id = NodeID.from_hex(node_id)
+    return _require_worker().drain_node(node_id, timeout_s)
+
+
 def timeline() -> list:
     """Task state-transition events (reference: `ray timeline` CLI →
     chrome_tracing_dump, python/ray/_private/state.py:438)."""

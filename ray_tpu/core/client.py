@@ -226,6 +226,9 @@ class CoreWorker:
     def kv_keys(self, ns: str, prefix: bytes) -> List[bytes]:
         return self._call("kv_keys", ns, prefix)
 
+    def drain_node(self, node_id: NodeID, timeout_s: float = 300.0) -> bool:
+        return self._call("drain_node", node_id, timeout_s)
+
     # PGs
     def pg_create(self, bundles, strategy: str, name: str):
         return self._call("pg_create", bundles, strategy, name)

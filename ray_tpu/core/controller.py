@@ -173,6 +173,7 @@ class Controller:
             )
         self.pending_tasks: List[TaskID] = []
         self.drivers: Set[rpc.Peer] = set()
+        self._drain_tasks: Set[asyncio.Task] = set()
         self._pump_scheduled = False
         self._pump_running = False
         self._pump_rerun = False
@@ -1225,6 +1226,64 @@ class Controller:
             fut = asyncio.get_running_loop().create_future()
             rec.stream_waiters.append(fut)
             await fut
+
+    async def rpc_drain_node(self, peer, node_id: NodeID, timeout_s: float = 300.0):
+        """Graceful drain (reference: NodeManager drain / rpc::DrainNode +
+        `ray drain-node`): stop placing work on the node, let running work
+        finish (up to ``timeout_s``), then retire it. Actors with
+        max_restarts left restart elsewhere through the normal death path.
+        Returns immediately; drain progresses in the background."""
+        node = self.nodes.get(node_id)
+        if node is None or node.state != "ALIVE":
+            raise ValueError(f"node {node_id.hex()} not alive")
+        if node.peer is None:
+            raise ValueError("cannot drain the head node")
+        node.state = "DRAINING"
+        self.cluster.set_draining(node_id, True)
+
+        # Preempt restartable actors right away (reference: preemption
+        # flagging, actor_task_submitter.h:67): their death path restarts
+        # them on schedulable nodes and max_task_retries resubmits
+        # in-flight methods. Non-restartable actors ride out the drain.
+        for wid in list(node.workers):
+            w = self.workers.get(wid)
+            if w is not None and w.state == "ACTOR" and w.actor_id is not None:
+                actor = self.actors.get(w.actor_id)
+                if actor is not None and actor.restarts_left > 0:
+                    try:
+                        await w.peer.notify("exit")
+                    except Exception:
+                        pass
+
+        async def finish_drain():
+            # Wait for in-flight plain-task work to finish (actor-method
+            # streams can arrive indefinitely and must not starve the
+            # drain; their actors were preempted above or accept the cut).
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                busy = [
+                    w
+                    for wid in node.workers
+                    if (w := self.workers.get(wid)) is not None
+                    and w.state == "LEASED"
+                    and w.running
+                ]
+                if not busy:
+                    break
+                await asyncio.sleep(0.2)
+            rec = self.nodes.get(node_id)
+            if rec is not None and rec.state == "DRAINING":
+                try:
+                    await rec.peer.notify("exit")
+                except Exception:
+                    pass
+
+        # Keep a strong ref: the loop holds tasks weakly (same pitfall the
+        # memory-monitor task documents below).
+        task = asyncio.get_running_loop().create_task(finish_drain())
+        self._drain_tasks.add(task)
+        task.add_done_callback(self._drain_tasks.discard)
+        return True
 
     async def rpc_ping(self, peer):
         return "pong"

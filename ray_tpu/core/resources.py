@@ -99,6 +99,8 @@ class NodeResources:
         # over its own dense view. Python stays the source of truth.
         self._native = None
         self._native_id = None
+        # Graceful drain: excluded from placement, accounting kept live.
+        self.draining = False
 
     def bind_native(self, sched, node_id):
         self._native = sched

@@ -78,8 +78,22 @@ class ClusterState:
         if self.native is not None:
             self.native.remove_node(node_id)
 
+    def set_draining(self, node_id: NodeID, draining: bool = True):
+        """Graceful drain (reference: NodeManager drain / rpc::DrainNode):
+        a draining node keeps its accounting (running work still releases
+        correctly) but receives no new placements."""
+        res = self.nodes.get(node_id)
+        if res is not None:
+            res.draining = draining
+        if self.native is not None:
+            self.native.set_draining(node_id, draining)
+
     def ordered_nodes(self) -> List[NodeID]:
-        return [n for n in self._order if n in self.nodes]
+        return [
+            n
+            for n in self._order
+            if n in self.nodes and not getattr(self.nodes[n], "draining", False)
+        ]
 
 
 class ClusterResourceScheduler:
@@ -145,7 +159,7 @@ class ClusterResourceScheduler:
     def _node_affinity(self, demand: ResourceSet, strategy: SchedulingStrategy) -> ScheduleResult:
         nid = NodeID.from_hex(strategy.node_id) if isinstance(strategy.node_id, str) else strategy.node_id
         node = self.state.nodes.get(nid)
-        if node is not None and node.fits(demand):
+        if node is not None and not node.draining and node.fits(demand):
             return ScheduleResult(nid)
         if strategy.soft:
             return self._hybrid(demand)

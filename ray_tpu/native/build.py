@@ -106,6 +106,8 @@ def load() -> Optional[ctypes.CDLL]:
         lib.rt_sched_schedule_hybrid.argtypes = [p, u32p, i64p, ctypes.c_int, ctypes.c_double, ctypes.POINTER(u64)]
         lib.rt_sched_schedule_spread.restype = ctypes.c_int
         lib.rt_sched_schedule_spread.argtypes = [p, u32p, i64p, ctypes.c_int, ctypes.POINTER(u64)]
+        lib.rt_sched_set_draining.restype = ctypes.c_int
+        lib.rt_sched_set_draining.argtypes = [p, u64, ctypes.c_int]
         lib.rt_sched_utilization.restype = ctypes.c_double
         lib.rt_sched_utilization.argtypes = [p, u64]
         lib.rt_sched_forget.restype = ctypes.c_int

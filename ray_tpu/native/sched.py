@@ -71,6 +71,9 @@ class NativeSched:
         if node_id in self._key_of:
             totals = list(totals_fp)
             self.sync_node(node_id, totals, totals)
+            # Fresh registration means a fresh (non-draining) NodeResources
+            # on the Python side — the native flag must match.
+            self.set_draining(node_id, False)
             return
         key = next(self._node_keys)
         self._key_of[node_id] = key
@@ -172,6 +175,11 @@ class NativeSched:
         if rc == 0:
             return self._node_of.get(out.value), False
         return None, rc == -2
+
+    def set_draining(self, node_id, draining: bool = True):
+        key = self._key(node_id)
+        if key is not None:
+            self._lib.rt_sched_set_draining(self._h, key, 1 if draining else 0)
 
     def utilization(self, node_id) -> float:
         key = self._key(node_id)

@@ -27,6 +27,7 @@ namespace {
 struct Node {
   uint64_t key = 0;
   bool alive = false;
+  bool draining = false;  // excluded from placement, accounting live
   // Dense by interned resource id; size grows lazily.
   std::vector<int64_t> total;
   std::vector<int64_t> avail;
@@ -280,7 +281,7 @@ int rt_sched_schedule_hybrid(void* h, const uint32_t* rid, const int64_t* amt,
   Node* best = nullptr;
   double best_util = 2.0;
   for (auto& n : s->nodes) {
-    if (!n.alive || !feasible(n, rid, amt, cnt)) continue;
+    if (!n.alive || n.draining || !feasible(n, rid, amt, cnt)) continue;
     any_feasible = true;
     if (!fits(n, rid, amt, cnt)) continue;
     double u = utilization(n);
@@ -307,12 +308,21 @@ int rt_sched_schedule_spread(void* h, const uint32_t* rid, const int64_t* amt,
   std::vector<Node*> avail;
   bool any_feasible = false;
   for (auto& n : s->nodes) {
-    if (!n.alive || !feasible(n, rid, amt, cnt)) continue;
+    if (!n.alive || n.draining || !feasible(n, rid, amt, cnt)) continue;
     any_feasible = true;
     if (fits(n, rid, amt, cnt)) avail.push_back(&n);
   }
   if (avail.empty()) return any_feasible ? -1 : -2;
   *out = avail[s->spread_rr++ % avail.size()]->key;
+  return 0;
+}
+
+int rt_sched_set_draining(void* h, uint64_t key, int draining) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Node* n = s->find(key);
+  if (!n) return -1;
+  n->draining = draining != 0;
   return 0;
 }
 

@@ -182,6 +182,14 @@ def cmd_memory(args):
     return 0
 
 
+def cmd_drain_node(args):
+    import ray_tpu
+
+    ray_tpu.init(address="auto")
+    ray_tpu.drain_node(args.node_id, timeout_s=args.timeout)
+    print(f"draining {args.node_id}")
+
+
 def cmd_logs(args):
     from ray_tpu.util import state
 
@@ -282,6 +290,11 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_timeline)
 
     sub.add_parser("memory", help="object store summary").set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("drain-node", help="gracefully drain a node")
+    sp.add_argument("node_id", help="node id (hex, from `ray-tpu status`)")
+    sp.add_argument("--timeout", type=float, default=300.0)
+    sp.set_defaults(fn=cmd_drain_node)
 
     sp = sub.add_parser("logs", help="list/tail session logs")
     sp.add_argument("filename", nargs="?")

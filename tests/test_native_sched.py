@@ -169,3 +169,20 @@ def test_native_sync_node_repairs_desync():
     nres = state.nodes[nid]
     native.sync_node(nid, nres.total.items_fp(), nres.available.items_fp())
     assert native.get_avail(nid, "CPU") == 8 * 10000
+
+
+def test_native_draining_excluded_from_placement():
+    for native in (True, False):
+        state, nodes = _mk_state(native, [4, 4])
+        sched = ClusterResourceScheduler(state)
+        state.set_draining(nodes[0], True)
+        for _ in range(3):
+            r = sched.schedule(_demand({"CPU": 1}), SchedulingStrategy())
+            assert r.node_id == nodes[1], native
+        # Accounting still works on the draining node (running releases).
+        assert state.nodes[nodes[0]].acquire(_demand({"CPU": 1}))
+        state.nodes[nodes[0]].release(_demand({"CPU": 1}))
+        # Un-drain restores placement eligibility.
+        state.set_draining(nodes[0], False)
+        r = sched.schedule(_demand({"CPU": 4}), SchedulingStrategy())
+        assert r.node_id == nodes[0], native
