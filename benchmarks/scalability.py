@@ -1,0 +1,135 @@
+"""Control-plane scalability benchmarks.
+
+Reference: release/benchmarks/ (many_tasks / many_actors / many_pgs,
+README.md:1-34) and release/microbenchmark — nightly suites whose JSON
+results are archived per release (release_logs/<version>/). Same shape
+here: each scenario prints one JSON line; run the module for the full
+suite. Numbers are single-host (the reference's headline numbers use
+64-node clusters; see BASELINE.md for the targets).
+
+Usage: python benchmarks/scalability.py [--tasks N] [--actors N] [--pgs N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def bench_many_tasks(n: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    def noop():
+        return 0
+
+    ray_tpu.get([noop.remote() for _ in range(50)])  # warm worker pool
+    t0 = time.perf_counter()
+    ray_tpu.get([noop.remote() for _ in range(n)])
+    dt = time.perf_counter() - t0
+    return {"benchmark": "many_tasks", "n": n, "tasks_per_s": round(n / dt, 1)}
+
+
+def bench_many_actors(n: int) -> dict:
+    import ray_tpu
+
+    # Fractional CPUs so actor count isn't capped by cores; the node's
+    # worker-process cap (4x cores) is the real single-host ceiling.
+    @ray_tpu.remote(num_cpus=0.05)
+    class A:
+        def ping(self):
+            return 0
+
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(n)]
+    ray_tpu.get([a.ping.remote() for a in actors])  # all alive + one call
+    dt = time.perf_counter() - t0
+    rate = n / dt
+    for a in actors:
+        ray_tpu.kill(a)
+    return {"benchmark": "many_actors", "n": n, "actors_per_s": round(rate, 1)}
+
+
+def bench_actor_call_throughput(calls: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote(max_concurrency=8)
+    class A:
+        def ping(self):
+            return 0
+
+    a = A.remote()
+    ray_tpu.wait_actor_ready(a)
+    ray_tpu.get([a.ping.remote() for _ in range(50)])
+    t0 = time.perf_counter()
+    ray_tpu.get([a.ping.remote() for _ in range(calls)])
+    dt = time.perf_counter() - t0
+    ray_tpu.kill(a)
+    return {
+        "benchmark": "async_actor_calls",
+        "n": calls,
+        "calls_per_s": round(calls / dt, 1),
+    }
+
+
+def bench_many_pgs(n: int) -> dict:
+    import ray_tpu
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pg = placement_group([{"CPU": 0.01}], strategy="PACK")
+        assert pg.ready(timeout=30)
+        remove_placement_group(pg)
+    dt = time.perf_counter() - t0
+    return {"benchmark": "many_pgs", "n": n, "pg_create_remove_per_s": round(n / dt, 1)}
+
+
+def bench_object_store(mb: int = 64, iters: int = 10) -> dict:
+    import numpy as np
+
+    import ray_tpu
+
+    data = np.zeros(mb * 1024 * 1024, dtype=np.uint8)
+    ref = ray_tpu.put(data)  # warm
+    ray_tpu.get(ref)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ray_tpu.get(ray_tpu.put(data))
+    dt = time.perf_counter() - t0
+    return {
+        "benchmark": "object_store_put_get",
+        "mb": mb,
+        "gib_per_s": round(2 * mb * iters / 1024 / dt, 2),
+    }
+
+
+def main():
+    import ray_tpu
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--tasks", type=int, default=1000)
+    p.add_argument("--actors", type=int, default=24)
+    p.add_argument("--calls", type=int, default=1000)
+    p.add_argument("--pgs", type=int, default=50)
+    p.add_argument("--object-mb", type=int, default=64)
+    args = p.parse_args()
+
+    ray_tpu.init(num_cpus=8)
+    try:
+        # Stream each result as it completes — a hang mid-suite must not
+        # discard the lines already earned.
+        for fn, arg in (
+            (bench_many_tasks, args.tasks),
+            (bench_many_actors, args.actors),
+            (bench_actor_call_throughput, args.calls),
+            (bench_many_pgs, args.pgs),
+            (bench_object_store, args.object_mb),
+        ):
+            print(json.dumps(fn(arg)), flush=True)
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
