@@ -90,6 +90,30 @@ def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:
     return _read(ds, parallelism)
 
 
+def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.tfrecord import TFRecordDatasource
+
+    return _read(TFRecordDatasource(paths), parallelism)
+
+
+def read_webdataset(paths, *, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.extra_datasources import WebDatasetDatasource
+
+    return _read(WebDatasetDatasource(paths), parallelism)
+
+
+def read_sql(sql: str, connection_factory, *, parallelism: int = -1, parallelism_column: Optional[str] = None) -> Dataset:
+    from ray_tpu.data.extra_datasources import SQLDatasource
+
+    return _read(SQLDatasource(sql, connection_factory, parallelism_column), parallelism)
+
+
+def read_images(paths, *, size: Optional[tuple] = None, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.extra_datasources import ImageDatasource
+
+    return _read(ImageDatasource(paths, size=size), parallelism)
+
+
 __all__ = [
     "Dataset",
     "DataIterator",
@@ -116,6 +140,10 @@ __all__ = [
     "read_binary_files",
     "read_numpy",
     "read_parquet",
+    "read_tfrecords",
+    "read_webdataset",
+    "read_sql",
+    "read_images",
     "read_datasource",
     "Datasink",
     "ParquetDatasink",

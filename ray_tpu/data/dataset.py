@@ -124,6 +124,15 @@ class Dataset:
             LUnion(name="Union", input=self._dag, others=[o._dag for o in others])
         )
 
+    def zip(self, *others: "Dataset") -> "Dataset":
+        """Row-aligned column concatenation (reference: Dataset.zip);
+        duplicate column names from the right side get a ``_1`` suffix."""
+        from ray_tpu.data.logical import Zip as LZip
+
+        return Dataset(
+            LZip(name="Zip", input=self._dag, others=[o._dag for o in others])
+        )
+
     def groupby(self, key: Optional[str]) -> "GroupedData":
         return GroupedData(self, key)
 
@@ -265,6 +274,16 @@ class Dataset:
         from ray_tpu.data.datasink import NumpyDatasink
 
         return self.write_datasink(NumpyDatasink(path, column))
+
+    def write_tfrecords(self, path: str) -> List[str]:
+        from ray_tpu.data.tfrecord import TFRecordDatasink
+
+        return self.write_datasink(TFRecordDatasink(path))
+
+    def write_webdataset(self, path: str) -> List[str]:
+        from ray_tpu.data.extra_datasources import WebDatasetDatasink
+
+        return self.write_datasink(WebDatasetDatasink(path))
 
     # Global aggregates -------------------------------------------------
     def aggregate(self, *aggs: AggregateFn) -> Dict[str, Any]:

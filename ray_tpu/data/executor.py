@@ -22,6 +22,7 @@ from ray_tpu.data.logical import (
     MapLike,
     Read,
     Union as LUnion,
+    Zip as LZip,
 )
 from ray_tpu.data.operators import (
     ActorPoolMapOperator,
@@ -80,6 +81,11 @@ def plan_to_operators(plan: LogicalPlan, concurrency: int = 8) -> List[PhysicalO
                 plan_to_operators(LogicalPlan(o), concurrency) for o in lop.others
             ]
             ops = [UnionOperator(chains)]
+        elif isinstance(lop, LZip):
+            chains = [ops] + [
+                plan_to_operators(LogicalPlan(o), concurrency) for o in lop.others
+            ]
+            ops = [ZipOperator(chains)]
         else:
             raise NotImplementedError(f"cannot lower {lop}")
     return ops
@@ -194,6 +200,117 @@ class UnionOperator(PhysicalOperator):
 
     def _finished_extra(self) -> bool:
         return self._emit_branch >= len(self._chains)
+
+    def shutdown(self):
+        for ch in self._chains:
+            for op in ch:
+                op.shutdown()
+
+
+class ZipOperator(PhysicalOperator):
+    """Row-aligned zip of N branch chains (reference: Ray Data's
+    ZipOperator). Streams: per-branch column buffers fill as branch
+    blocks materialize; whenever every branch has rows pending, a merged
+    block of ``min(pending)`` rows is emitted — no full materialization,
+    and uneven block boundaries across branches are re-aligned here."""
+
+    def __init__(self, chains: List[PhysicalOperator]):
+        super().__init__(f"Zip[{len(chains)}]")
+        self._chains = chains
+        self._inputs_done = True
+        # per-branch: list of (batch dict, row offset)
+        self._buffers: List[list] = [[] for _ in chains]
+        self._drained = [False] * len(chains)
+
+    def num_active_tasks(self) -> int:
+        return sum(op.num_active_tasks() for ch in self._chains for op in ch)
+
+    def _pull_branches(self):
+        import ray_tpu
+        from ray_tpu.data.block import BlockAccessor
+
+        for i, ch in enumerate(self._chains):
+            # Backpressure: stop stepping/pulling a branch that is already
+            # MAX_BUFFERED blocks ahead — otherwise a fast branch zipped
+            # with a slow one materializes entirely into driver memory.
+            if len(self._buffers[i]) >= MAX_BUFFERED:
+                continue
+            _step_chain(ch)
+            last = ch[-1]
+            while last.has_next() and len(self._buffers[i]) < MAX_BUFFERED:
+                bundle = last.get_next()
+                batch = BlockAccessor.for_block(ray_tpu.get(bundle.ref)).to_batch()
+                n = len(next(iter(batch.values()))) if batch else 0
+                if n:
+                    self._buffers[i].append([batch, 0])
+            if all(op.completed() for op in ch) and not last.has_next():
+                self._drained[i] = True
+
+    def _rows_buffered(self, i: int) -> int:
+        return sum(
+            len(next(iter(b.values()))) - off for b, off in self._buffers[i]
+        )
+
+    def _take_rows(self, i: int, n: int) -> dict:
+        """Consume n rows from branch i's buffer as one column batch."""
+        import numpy as np
+
+        parts: List[dict] = []
+        need = n
+        while need > 0:
+            batch, off = self._buffers[i][0]
+            avail = len(next(iter(batch.values()))) - off
+            take = min(avail, need)
+            parts.append({k: np.asarray(v)[off : off + take] for k, v in batch.items()})
+            if take == avail:
+                self._buffers[i].pop(0)
+            else:
+                self._buffers[i][0][1] = off + take
+            need -= take
+        if len(parts) == 1:
+            return parts[0]
+        return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+
+    def poll(self):
+        import ray_tpu
+        from ray_tpu.data.block import BlockMetadata
+
+        self._pull_branches()
+        while len(self._out_queue) < MAX_BUFFERED:
+            counts = [self._rows_buffered(i) for i in range(len(self._chains))]
+            n = min(counts)
+            if n == 0:
+                # A fully-drained empty branch while another still holds
+                # rows means the datasets have unequal row counts — an
+                # error, exactly as the reference's zip treats it.
+                if any(
+                    self._drained[i] and counts[i] == 0 and max(counts) > 0
+                    for i in range(len(counts))
+                ):
+                    raise ValueError(
+                        "Dataset.zip requires equal row counts across all "
+                        f"datasets; got a drained branch with {counts} rows "
+                        "still buffered elsewhere"
+                    )
+                break
+            merged: dict = {}
+            for i in range(len(self._chains)):
+                part = self._take_rows(i, n)
+                for k, v in part.items():
+                    key = k
+                    while key in merged:
+                        key = key + "_1"  # collision suffix (reference: zip renames dupes)
+                    merged[key] = v
+            size = sum(v.nbytes if hasattr(v, "nbytes") else 64 for v in merged.values())
+            self._out_queue.append(
+                RefBundle(ray_tpu.put(merged), BlockMetadata(num_rows=n, size_bytes=size))
+            )
+
+    def _finished_extra(self) -> bool:
+        if not all(self._drained):
+            return False
+        # Done once no further aligned rows can be produced.
+        return min(self._rows_buffered(i) for i in range(len(self._chains))) == 0
 
     def shutdown(self):
         for ch in self._chains:

@@ -1,0 +1,154 @@
+"""Additional datasources: WebDataset (tar shards), SQL (DB-API), images.
+
+Reference: python/ray/data/_internal/datasource/webdataset_datasource.py
+(tar shards with samples grouped by key prefix),
+sql_datasource.py (connection-factory + query sharding),
+image_datasource.py (PIL decode to HWC arrays).
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import tarfile
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.datasink import _FileDatasink
+from ray_tpu.data.datasource import Datasource, FileBasedDatasource, ReadTask
+from ray_tpu.data.block import BlockMetadata
+
+
+def _decode_component(ext: str, data: bytes):
+    """WebDataset convention: decode by extension; unknown stays bytes."""
+    ext = ext.split(".")[-1]  # "cls.json" decodes by its final suffix
+    if ext in ("txt", "text"):
+        return data.decode()
+    if ext in ("json",):
+        return json.loads(data)
+    if ext in ("cls", "index", "id"):
+        try:
+            return int(data.decode().strip())
+        except ValueError:
+            return data.decode()
+    if ext in ("npy",):
+        return np.load(io.BytesIO(data), allow_pickle=False)
+    return data
+
+
+class WebDatasetDatasource(FileBasedDatasource):
+    """Tar shards where ``key.ext`` members with a shared key form one
+    sample (the WebDataset layout)."""
+
+    def _read_file(self, path: str) -> Iterable[Block]:
+        samples: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        with tarfile.open(path) as tar:
+            for member in tar:
+                if not member.isfile():
+                    continue
+                name = member.name
+                key, _, ext = name.partition(".")
+                data = tar.extractfile(member).read()
+                if key not in samples:
+                    samples[key] = {"__key__": key}
+                    order.append(key)
+                samples[key][ext] = _decode_component(ext.lower(), data)
+        yield [samples[k] for k in order]
+
+
+class WebDatasetDatasink(_FileDatasink):
+    """One ``.tar`` shard per block; row dict values become members named
+    ``{key}.{column}``."""
+
+    def __init__(self, path: str):
+        super().__init__(path, "tar")
+
+    def _write_block(self, block: Block, out: str):
+        with tarfile.open(out, "w") as tar:
+            for i, row in enumerate(BlockAccessor.for_block(block).iter_rows()):
+                if not isinstance(row, dict):
+                    row = {"data": row}
+                key = str(row.get("__key__", f"{i:08d}"))
+                for col, value in row.items():
+                    if col == "__key__":
+                        continue
+                    if isinstance(value, bytes):
+                        payload = value
+                    elif isinstance(value, str):
+                        payload = value.encode()
+                    elif isinstance(value, np.ndarray):
+                        buf = io.BytesIO()
+                        np.save(buf, value)
+                        payload = buf.getvalue()
+                        col = col + ".npy" if not col.endswith(".npy") else col
+                    else:
+                        payload = json.dumps(value).encode()
+                        col = col + ".json" if "." not in col else col
+                    info = tarfile.TarInfo(f"{key}.{col}")
+                    info.size = len(payload)
+                    tar.addfile(info, io.BytesIO(payload))
+
+
+class SQLDatasource(Datasource):
+    """DB-API 2.0 reads: ``connection_factory`` must be a serializable
+    zero-arg callable (it runs inside read tasks on workers)."""
+
+    def __init__(self, sql: str, connection_factory: Callable[[], Any], parallelism_column: Optional[str] = None):
+        self._sql = sql
+        self._factory = connection_factory
+        self._shard_col = parallelism_column
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        sql, factory = self._sql, self._factory
+
+        if not self._shard_col or parallelism <= 1:
+            def read() -> Iterable[Block]:
+                conn = factory()
+                try:
+                    cur = conn.cursor()
+                    cur.execute(sql)
+                    cols = [d[0] for d in cur.description]
+                    yield [dict(zip(cols, row)) for row in cur.fetchall()]
+                finally:
+                    conn.close()
+
+            return [ReadTask(read, BlockMetadata(0, 0))]
+
+        shard_col = self._shard_col
+        tasks = []
+        for i in range(parallelism):
+            def read(i=i) -> Iterable[Block]:
+                conn = factory()
+                try:
+                    cur = conn.cursor()
+                    cur.execute(
+                        f"SELECT * FROM ({sql}) WHERE ({shard_col}) % {parallelism} = {i}"
+                    )
+                    cols = [d[0] for d in cur.description]
+                    yield [dict(zip(cols, row)) for row in cur.fetchall()]
+                finally:
+                    conn.close()
+
+            tasks.append(ReadTask(read, BlockMetadata(0, 0)))
+        return tasks
+
+
+class ImageDatasource(FileBasedDatasource):
+    """Decode images to HWC uint8 arrays (requires PIL; gated import)."""
+
+    def __init__(self, paths, size: Optional[tuple] = None):
+        super().__init__(paths)
+        self._size = size
+
+    def _read_file(self, path: str) -> Iterable[Block]:
+        try:
+            from PIL import Image
+        except ImportError as e:  # pragma: no cover - PIL is present in CI
+            raise ImportError("read_images requires pillow") from e
+        img = Image.open(path)
+        if self._size is not None:
+            img = img.resize(self._size)
+        yield [{"image": np.asarray(img), "path": path}]

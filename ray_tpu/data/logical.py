@@ -88,6 +88,14 @@ class Union(LogicalOp):
 
 
 @dataclass
+class Zip(LogicalOp):
+    """Row-aligned column concatenation of N datasets (reference:
+    python/ray/data/dataset.py Dataset.zip / _internal ZipOperator)."""
+
+    others: List[LogicalOp] = field(default_factory=list)
+
+
+@dataclass
 class LogicalPlan:
     dag: LogicalOp
 
@@ -100,7 +108,7 @@ def _fuse(op: LogicalOp) -> LogicalOp:
     if op is None:
         return None
     inp = _fuse(op.input)
-    if isinstance(op, Union):
+    if isinstance(op, (Union, Zip)):
         op = replace(op, others=[_fuse(o) for o in op.others])
     op = replace(op, input=inp)
     if (

@@ -1,0 +1,268 @@
+"""TFRecord datasource/datasink — dependency-free implementation.
+
+Reference: python/ray/data/_internal/datasource/tfrecords_datasource.py
+(reads tf.train.Example records into columnar batches) and
+python/ray/data/dataset.py write_tfrecords. The reference leans on
+tensorflow/protobuf; here both layers are implemented directly:
+
+- TFRecord framing: ``[len:uint64le][masked-crc32c(len):uint32le]
+  [data][masked-crc32c(data):uint32le]`` per record.
+- ``tf.train.Example`` protobuf wire format (features { feature { map
+  entry -> bytes_list/float_list/int64_list } }) encoded/decoded with a
+  minimal varint codec — no protobuf runtime needed.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.datasink import _FileDatasink
+from ray_tpu.data.datasource import FileBasedDatasource
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli), table-driven; TFRecord masks it as
+# ((crc >> 15 | crc << 17) + 0xa282ead8) & 0xffffffff.
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE = []
+
+
+def _crc_table():
+    global _CRC_TABLE
+    if _CRC_TABLE:
+        return _CRC_TABLE
+    poly = 0x82F63B78  # reflected Castagnoli polynomial
+    table = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        table.append(c)
+    _CRC_TABLE = table
+    return table
+
+
+def crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Protobuf wire helpers (varint + length-delimited fields).
+# ---------------------------------------------------------------------------
+
+
+def _write_varint(out: bytearray, v: int):
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_varint(buf: bytes, pos: int):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _field(out: bytearray, number: int, wire_type: int, payload: bytes):
+    _write_varint(out, (number << 3) | wire_type)
+    if wire_type == 2:
+        _write_varint(out, len(payload))
+    out.extend(payload)
+
+
+def _encode_feature(value) -> bytes:
+    """One ``tf.train.Feature``: field 1 bytes_list, 2 float_list,
+    3 int64_list."""
+    inner = bytearray()
+    if isinstance(value, bytes):
+        vals = [value]
+        kind = 1
+    elif isinstance(value, str):
+        vals = [value.encode()]
+        kind = 1
+    elif isinstance(value, (list, tuple, np.ndarray)):
+        vals = list(value)
+        if not vals:
+            kind = 3
+        elif isinstance(vals[0], (bytes, str)):
+            vals = [v.encode() if isinstance(v, str) else v for v in vals]
+            kind = 1
+        elif isinstance(vals[0], (float, np.floating)):
+            kind = 2
+        else:
+            kind = 3
+    elif isinstance(value, (float, np.floating)):
+        vals, kind = [value], 2
+    else:
+        vals, kind = [int(value)], 3
+
+    if kind == 1:
+        for v in vals:
+            _field(inner, 1, 2, bytes(v))
+    elif kind == 2:
+        packed = struct.pack(f"<{len(vals)}f", *[float(v) for v in vals])
+        _field(inner, 1, 2, packed)
+    else:
+        packed = bytearray()
+        for v in vals:
+            _write_varint(packed, int(v) & 0xFFFFFFFFFFFFFFFF)
+        _field(inner, 1, 2, bytes(packed))
+
+    feat = bytearray()
+    _field(feat, kind, 2, bytes(inner))
+    return bytes(feat)
+
+
+def encode_example(row: Dict[str, Any]) -> bytes:
+    """Dict row → serialized ``tf.train.Example``."""
+    features = bytearray()
+    for key, value in row.items():
+        entry = bytearray()
+        _field(entry, 1, 2, key.encode())
+        _field(entry, 2, 2, _encode_feature(value))
+        _field(features, 1, 2, bytes(entry))  # map<string,Feature> entry
+    example = bytearray()
+    _field(example, 1, 2, bytes(features))
+    return bytes(example)
+
+
+def _decode_feature(buf: bytes):
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        number, wt = tag >> 3, tag & 7
+        ln, pos = _read_varint(buf, pos)
+        inner = buf[pos : pos + ln]
+        pos += ln
+        # inner is a BytesList/FloatList/Int64List message: field 1 repeated
+        values: List[Any] = []
+        ipos = 0
+        while ipos < len(inner):
+            itag, ipos = _read_varint(inner, ipos)
+            iwt = itag & 7
+            if iwt == 2:
+                iln, ipos = _read_varint(inner, ipos)
+                payload = inner[ipos : ipos + iln]
+                ipos += iln
+                if number == 1:  # bytes_list
+                    values.append(payload)
+                elif number == 2:  # packed floats
+                    values.extend(struct.unpack(f"<{len(payload)//4}f", payload))
+                else:  # packed varints
+                    vpos = 0
+                    while vpos < len(payload):
+                        v, vpos = _read_varint(payload, vpos)
+                        if v >= 1 << 63:
+                            v -= 1 << 64
+                        values.append(v)
+            elif iwt == 5:  # unpacked float
+                values.append(struct.unpack("<f", inner[ipos : ipos + 4])[0])
+                ipos += 4
+            else:  # unpacked varint
+                v, ipos = _read_varint(inner, ipos)
+                if number == 3 and v >= 1 << 63:
+                    v -= 1 << 64
+                values.append(v)
+        return values
+    return []
+
+
+def decode_example(data: bytes) -> Dict[str, Any]:
+    row: Dict[str, Any] = {}
+    pos = 0
+    while pos < len(data):
+        tag, pos = _read_varint(data, pos)
+        ln, pos = _read_varint(data, pos)
+        features = data[pos : pos + ln]
+        pos += ln
+        fpos = 0
+        while fpos < len(features):
+            ftag, fpos = _read_varint(features, fpos)
+            fln, fpos = _read_varint(features, fpos)
+            entry = features[fpos : fpos + fln]
+            fpos += fln
+            # map entry: 1=key, 2=Feature
+            epos = 0
+            key, feat = "", b""
+            while epos < len(entry):
+                etag, epos = _read_varint(entry, epos)
+                eln, epos = _read_varint(entry, epos)
+                payload = entry[epos : epos + eln]
+                epos += eln
+                if etag >> 3 == 1:
+                    key = payload.decode()
+                else:
+                    feat = payload
+            values = _decode_feature(feat)
+            row[key] = values[0] if len(values) == 1 else values
+    return row
+
+
+# ---------------------------------------------------------------------------
+# Record-level IO.
+# ---------------------------------------------------------------------------
+
+
+def write_tfrecords_file(path: str, rows: Iterable[Dict[str, Any]]):
+    with open(path, "wb") as f:
+        for row in rows:
+            data = encode_example(row)
+            header = struct.pack("<Q", len(data))
+            f.write(header)
+            f.write(struct.pack("<I", _masked_crc(header)))
+            f.write(data)
+            f.write(struct.pack("<I", _masked_crc(data)))
+
+
+def read_tfrecords_file(path: str) -> List[Dict[str, Any]]:
+    rows = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            if hcrc != _masked_crc(header):
+                raise ValueError(f"corrupt TFRecord length CRC in {path}")
+            data = f.read(length)
+            (dcrc,) = struct.unpack("<I", f.read(4))
+            if dcrc != _masked_crc(data):
+                raise ValueError(f"corrupt TFRecord data CRC in {path}")
+            rows.append(decode_example(data))
+    return rows
+
+
+class TFRecordDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Iterable[Block]:
+        yield read_tfrecords_file(path)
+
+
+class TFRecordDatasink(_FileDatasink):
+    def __init__(self, path: str):
+        super().__init__(path, "tfrecords")
+
+    def _write_block(self, block: Block, out: str):
+        write_tfrecords_file(out, BlockAccessor.for_block(block).iter_rows())

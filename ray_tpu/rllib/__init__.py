@@ -4,6 +4,8 @@ Reference: rllib/ (new API stack: Algorithm/EnvRunner/RLModule/Learner).
 """
 from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, EnvRunnerGroup
+from ray_tpu.rllib.appo import APPO, APPOConfig
+from ray_tpu.rllib.cql import CQL, CQLConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.episodes import SingleAgentEpisode, compute_gae, episodes_to_batch
@@ -36,6 +38,10 @@ __all__ = [
     "IMPALA",
     "IMPALAConfig",
     "vtrace_returns",
+    "APPO",
+    "APPOConfig",
+    "CQL",
+    "CQLConfig",
     "DQN",
     "DQNConfig",
     "SAC",

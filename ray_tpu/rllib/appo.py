@@ -1,0 +1,96 @@
+"""APPO — asynchronous PPO: IMPALA-style async sampling with the PPO
+clipped-surrogate objective on V-trace-corrected advantages.
+
+Reference: rllib/algorithms/appo/appo.py (APPOConfig: use_kl_loss,
+kl_coeff/kl_target, clip_param, target-network update cadence) and
+rllib/algorithms/appo/torch/appo_torch_learner.py (surrogate clip on the
+behavior/target ratio, V-trace advantages, optional KL penalty toward
+the behavior policy). The async control loop is inherited from our
+IMPALA (saturated in-flight sample() calls, harvest-whichever-finished).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.episodes import SingleAgentEpisode
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+
+
+def appo_loss(
+    module,
+    params,
+    batch,
+    clip_param: float = 0.2,
+    vf_loss_coeff: float = 0.5,
+    entropy_coeff: float = 0.005,
+    use_kl_loss: bool = True,
+    kl_coeff: float = 0.2,
+):
+    import jax.numpy as jnp
+
+    out = module.logp_entropy(params, batch["obs"], batch["actions"])
+    ratio = jnp.exp(out["logp"] - batch["logp_old"])
+    adv = batch["pg_advantages"]
+    surrogate = jnp.minimum(
+        ratio * adv, jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv
+    )
+    policy_loss = -jnp.mean(surrogate)
+    vf_loss = 0.5 * jnp.mean((out["vf"] - batch["vtrace_targets"]) ** 2)
+    entropy = jnp.mean(out["entropy"])
+    # KL(behavior ‖ target) estimated from sampled actions (reference:
+    # appo_torch_learner mean-KL penalty; keeps the target policy near
+    # the behavior policy that generated the stale trajectories).
+    approx_kl = jnp.mean(batch["logp_old"] - out["logp"])
+    total = policy_loss + vf_loss_coeff * vf_loss - entropy_coeff * entropy
+    if use_kl_loss:
+        total = total + kl_coeff * approx_kl
+    return total, {
+        "policy_loss": policy_loss,
+        "vf_loss": vf_loss,
+        "entropy": entropy,
+        "approx_kl": approx_kl,
+    }
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.005
+        self.use_kl_loss = True
+        self.kl_coeff = 0.2
+        self.num_epochs = 1  # async: one pass over each harvested batch
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(IMPALA):
+    loss_fn = staticmethod(appo_loss)
+
+    def _loss_cfg(self) -> dict:
+        c = self.config
+        return dict(
+            clip_param=c.clip_param,
+            vf_loss_coeff=c.vf_loss_coeff,
+            entropy_coeff=c.entropy_coeff,
+            use_kl_loss=c.use_kl_loss,
+            kl_coeff=c.kl_coeff,
+        )
+
+    def _episodes_to_vtrace_batch(self, episodes: List[SingleAgentEpisode]):
+        """V-trace batch plus the behavior logps the surrogate ratio
+        needs (IMPALA's plain PG loss does not use them)."""
+        batch = super()._episodes_to_vtrace_batch(episodes)
+        logps = [
+            np.asarray(ep.logps, dtype=np.float32)
+            for ep in episodes
+            if len(ep) > 0
+        ]
+        batch["logp_old"] = (
+            np.concatenate(logps) if logps else np.zeros(0, np.float32)
+        )
+        return batch

@@ -1,0 +1,133 @@
+"""CQL — conservative Q-learning for offline RL (discrete).
+
+Reference: rllib/algorithms/cql/cql.py + cql_torch_learner.py: SAC's
+twin-Q soft-Bellman machinery plus the conservative regularizer
+``E_s[logsumexp_a Q(s,a)] - E_{(s,a)~D}[Q(s,a)]`` that pushes down
+out-of-distribution action values; trained purely from a fixed dataset
+(offline_data.py path), evaluated by rolling out the learned policy.
+
+TPU shape: one fused jitted update (critics + actor + temperature +
+conservative term in a single loss) rather than the reference's separate
+optimizer passes — the whole update is one XLA program.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.off_policy import OffPolicyConfig
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.rl_module import RLModuleSpec
+from ray_tpu.rllib.sac import sac_loss
+from ray_tpu.rllib.episodes import SingleAgentEpisode
+
+
+def cql_loss(
+    module,
+    params,
+    batch,
+    gamma: float = 0.99,
+    target_entropy: float = -1.0,
+    cql_alpha: float = 1.0,
+):
+    """SAC loss + conservative penalty on both critics."""
+    import jax.numpy as jnp
+
+    base, metrics = sac_loss(
+        module, params, batch, gamma=gamma, target_entropy=target_entropy
+    )
+    out = module.forward_train(params, batch["obs"])
+    q1, q2 = out["q1"], out["q2"]
+    ar = jnp.arange(batch["obs"].shape[0])
+    data_q1 = q1[ar, batch["actions"]]
+    data_q2 = q2[ar, batch["actions"]]
+    # logsumexp over the action set = soft-maximum of OOD action values
+    # (discrete CQL(H); reference: cql_torch_learner's cql_loss term).
+    gap1 = jnp.mean(_logsumexp(q1) - data_q1)
+    gap2 = jnp.mean(_logsumexp(q2) - data_q2)
+    penalty = cql_alpha * (gap1 + gap2)
+    loss = base + penalty
+    metrics = dict(metrics)
+    metrics["cql_penalty"] = penalty
+    return loss, metrics
+
+
+def _logsumexp(q):
+    from jax import nn
+
+    return nn.logsumexp(q, axis=-1)
+
+
+class CQLConfig(OffPolicyConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.cql_alpha = 1.0
+        self.target_entropy = -1.0
+        self.target_update_freq = 100
+        self.num_updates_per_iter = 64
+        self.train_batch_size = 128
+        self._offline_episodes: Optional[List[SingleAgentEpisode]] = None
+
+    def offline_data(self, episodes: List[SingleAgentEpisode]) -> "CQLConfig":
+        self._offline_episodes = episodes
+        return self
+
+    def module_spec(self) -> RLModuleSpec:
+        spec = super().module_spec()
+        spec.kind = "sac"
+        return spec
+
+    def build(self) -> "CQL":
+        return CQL(self)
+
+
+class CQL(Algorithm):
+    loss_fn = staticmethod(cql_loss)
+    target_pairs = (("q1", "q1_target"), ("q2", "q2_target"))
+
+    def __init__(self, config: CQLConfig):
+        if config._offline_episodes is None:
+            raise ValueError("CQL requires .offline_data(episodes)")
+        super().__init__(config)
+        self.buffer = ReplayBuffer(
+            max(config.buffer_size, sum(len(e) for e in config._offline_episodes)),
+            seed=config.seed,
+        )
+        self.buffer.add_episodes(config._offline_episodes)
+        self._num_updates = 0
+
+    def _loss_cfg(self) -> dict:
+        c = self.config
+        return dict(
+            gamma=c.gamma, target_entropy=c.target_entropy, cql_alpha=c.cql_alpha
+        )
+
+    def _sync_target(self):
+        import jax
+
+        state = self.learner_group.get_state()
+        params = state["params"]
+        for online, target in type(self).target_pairs:
+            params[target] = jax.tree.map(lambda x: x, params[online])
+        self.learner_group.set_state(state)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        metrics: Dict[str, float] = {}
+        for _ in range(cfg.num_updates_per_iter):
+            mb = self.buffer.sample(cfg.train_batch_size)
+            mb.pop("idx", None)
+            metrics = self.learner_group.update_from_batch(mb)
+            metrics.pop("td_errors", None)
+            self._num_updates += 1
+            if self._num_updates % cfg.target_update_freq == 0:
+                self._sync_target()
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        return {
+            "env_steps_this_iter": 0,
+            "num_learner_updates": self._num_updates,
+            **{f"learner/{k}": v for k, v in metrics.items() if np.ndim(v) == 0},
+        }

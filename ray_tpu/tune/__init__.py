@@ -5,7 +5,9 @@ Reference: python/ray/tune (Tuner/tune.run, search spaces, schedulers).
 from ray_tpu.tune.schedulers import (
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -67,6 +69,8 @@ __all__ = [
     "TrialScheduler",
     "FIFOScheduler",
     "AsyncHyperBandScheduler",
+    "HyperBandScheduler",
+    "PB2",
     "MedianStoppingRule",
     "PopulationBasedTraining",
 ]

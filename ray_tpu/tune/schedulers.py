@@ -37,6 +37,16 @@ class TrialScheduler:
         """PBT hook: new config for a resuming trial (None = unchanged)."""
         return None
 
+    def on_trial_pending_resume(self, trial: Trial) -> str:
+        """Gate for PAUSED trials: CONTINUE = resume now, PAUSE = keep
+        waiting (synchronous bracket not filled yet), STOP = terminate
+        without resuming. Default: resume immediately."""
+        return CONTINUE
+
+    def on_search_exhausted(self):
+        """The searcher will produce no more trials — synchronous
+        schedulers close any partially-filled brackets."""
+
 
 class FIFOScheduler(TrialScheduler):
     pass
@@ -89,6 +99,172 @@ class AsyncHyperBandScheduler(TrialScheduler):
                     return STOP
                 break
         return CONTINUE
+
+
+class _Bracket:
+    """One synchronous successive-halving bracket: rungs at
+    r, r*eta, r*eta^2, ... <= max_t; each rung keeps the top 1/eta."""
+
+    def __init__(self, r0: int, max_t: int, eta: float, size: int):
+        self.size = size  # trials this bracket admits
+        self.eta = eta
+        self.max_t = max_t
+        self.rungs: List[int] = []
+        r = max(1, int(r0))
+        while r < max_t:
+            self.rungs.append(r)
+            r = int(math.ceil(r * eta))
+        self.members: List[str] = []
+        self.rung_idx: Dict[str, int] = {}  # trial -> next rung index
+        self.recorded: Dict[int, Dict[str, float]] = {i: {} for i in range(len(self.rungs))}
+        self.resumable: set = set()
+        self.doomed: set = set()
+        self.done: set = set()  # completed/errored trials
+        self.closed = False  # no more members will join
+        self.decided: set = set()  # rung indices already cut
+        self.cutoffs: Dict[int, float] = {}  # rung -> lowest promoted score
+
+    @property
+    def full(self) -> bool:
+        return self.closed or len(self.members) >= self.size
+
+    def milestone_for(self, trial_id: str) -> Optional[int]:
+        i = self.rung_idx.get(trial_id, 0)
+        return self.rungs[i] if i < len(self.rungs) else None
+
+    def record(self, trial_id: str, score: float):
+        i = self.rung_idx.get(trial_id, 0)
+        self.resumable.discard(trial_id)
+        if i in self.decided:
+            # Late arrival at an already-cut rung (restored trial): judge
+            # against the cutoff that the original cut established.
+            if score >= self.cutoffs.get(i, float("-inf")):
+                self.rung_idx[trial_id] = i + 1
+                self.resumable.add(trial_id)
+            else:
+                self.doomed.add(trial_id)
+            return
+        self.recorded[i][trial_id] = score
+
+    def try_promote(self):
+        """If the lowest undecided rung has every live member recorded,
+        promote its top 1/eta and doom the rest. Each rung is cut exactly
+        once (``decided``); doomed trials never re-enter the pool."""
+        for i in range(len(self.rungs)):
+            if i in self.decided:
+                continue
+            waiting = {
+                t: s for t, s in self.recorded[i].items()
+                if t not in self.done and t not in self.doomed
+                and self.rung_idx.get(t, 0) == i
+            }
+            expected = [
+                t for t in self.members
+                if t not in self.done and t not in self.doomed
+                and self.rung_idx.get(t, 0) == i
+            ]
+            if not expected:
+                continue
+            if not self.full or len(waiting) < len(expected):
+                return  # rung not decidable yet
+            ranked = sorted(waiting, key=waiting.get, reverse=True)
+            keep = max(1, int(len(ranked) / self.eta))
+            self.decided.add(i)
+            self.cutoffs[i] = waiting[ranked[keep - 1]]
+            for t in ranked[:keep]:
+                self.rung_idx[t] = i + 1
+                self.resumable.add(t)
+            for t in ranked[keep:]:
+                self.doomed.add(t)
+            return
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (reference: tune/schedulers/hyperband.py).
+
+    Brackets s = s_max..0 trade off #configs vs budget: bracket s starts
+    n = ceil((s_max+1)/(s+1) * eta^s) trials at r = max_t * eta^-s.
+    Unlike ASHA, halving waits for the whole rung (trials PAUSE at
+    milestones; the resume gate releases winners once the rung fills)."""
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        max_t: int = 81,
+        reduction_factor: float = 3,
+    ):
+        self._time_attr = time_attr
+        self._max_t = max_t
+        self._eta = reduction_factor
+        s_max = int(math.log(max_t) / math.log(reduction_factor))
+        self._brackets: List[_Bracket] = []
+        for s in range(s_max, -1, -1):
+            n = int(math.ceil((s_max + 1) / (s + 1) * reduction_factor**s))
+            r0 = max_t * reduction_factor**-s
+            self._brackets.append(_Bracket(int(math.ceil(r0)), max_t, reduction_factor, n))
+        self._trial_bracket: Dict[str, _Bracket] = {}
+
+    def _bracket_of(self, trial: Trial) -> _Bracket:
+        b = self._trial_bracket.get(trial.trial_id)
+        if b is None:
+            b = next((bk for bk in self._brackets if not bk.full), self._brackets[-1])
+            b.members.append(trial.trial_id)
+            self._trial_bracket[trial.trial_id] = b
+        return b
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        b = self._bracket_of(trial)
+        if trial.trial_id in b.doomed:
+            return STOP
+        t = result.get(self._time_attr, trial.iteration)
+        if t >= self._max_t:
+            return STOP
+        milestone = b.milestone_for(trial.trial_id)
+        if milestone is None:
+            return CONTINUE
+        if t >= milestone:
+            b.record(trial.trial_id, self._score(result))
+            b.try_promote()
+            if trial.trial_id in b.doomed:
+                return STOP
+            if trial.trial_id in b.resumable:
+                b.resumable.discard(trial.trial_id)
+                return CONTINUE  # promoted instantly, keep running
+            # Pausing kills the actor; without a checkpoint the trial
+            # would restart from step 0 with a stale iteration count
+            # (reference: HyperBand requires checkpointable trainables).
+            # Keep unchecked trials running — they are reaped via the
+            # doomed fast-path on their next report once the rung is cut.
+            if trial.checkpoint_dir is None:
+                return CONTINUE
+            return PAUSE
+        return CONTINUE
+
+    def on_trial_pending_resume(self, trial: Trial) -> str:
+        known = trial.trial_id in self._trial_bracket
+        b = self._bracket_of(trial)
+        if not known and trial.results:
+            # Restored experiment: this scheduler instance never scored the
+            # trial — resume it and let it re-enter at its next milestone.
+            return CONTINUE
+        b.try_promote()
+        if trial.trial_id in b.doomed:
+            return STOP
+        if trial.trial_id in b.resumable:
+            b.resumable.discard(trial.trial_id)
+            return CONTINUE
+        return PAUSE
+
+    def on_trial_complete(self, trial: Trial, result: Optional[dict]):
+        b = self._trial_bracket.get(trial.trial_id)
+        if b is not None:
+            b.done.add(trial.trial_id)
+            b.try_promote()
+
+    def on_search_exhausted(self):
+        for b in self._brackets:
+            b.closed = True
+            b.try_promote()
 
 
 class MedianStoppingRule(TrialScheduler):
@@ -176,4 +352,112 @@ class PopulationBasedTraining(TrialScheduler):
                 cfg[k] = cfg[k] * factor
                 if isinstance(donor.config[k], int):
                     cfg[k] = max(1, int(round(cfg[k])))
+        return cfg
+
+
+class PB2(PopulationBasedTraining):
+    """PBT with a GP-bandit explore step (reference:
+    tune/schedulers/pb2.py, Parker-Holder et al. 2020): instead of
+    random perturbation, new hyperparameters maximize a GP-UCB
+    acquisition fit on (config -> score delta) from population history.
+    ``hyperparam_bounds`` maps names to (low, high) continuous bounds."""
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        perturbation_interval: int = 5,
+        hyperparam_bounds: Optional[Dict[str, tuple]] = None,
+        quantile_fraction: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(
+            time_attr=time_attr,
+            perturbation_interval=perturbation_interval,
+            hyperparam_mutations={},
+            quantile_fraction=quantile_fraction,
+            seed=seed,
+        )
+        self._bounds = hyperparam_bounds or {}
+        # (normalized hyperparam vector, observed score) history
+        self._observations: List[tuple] = []
+
+    def _normalize(self, cfg: Dict[str, Any]):
+        xs = []
+        for k, (lo, hi) in self._bounds.items():
+            v = float(cfg.get(k, lo))
+            xs.append((v - lo) / max(hi - lo, 1e-12))
+        return xs
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        if all(k in trial.config for k in self._bounds):
+            self._observations.append(
+                (self._normalize(trial.config), self._score(result))
+            )
+            self._observations = self._observations[-256:]
+        return super().on_trial_result(trial, result)
+
+    _ELL = 0.3  # RBF length scale
+
+    def _gp_fit(self, X, y):
+        """Candidate-independent part of the GP posterior: kernel Cholesky
+        + weights, computed once per exploit step (not per candidate)."""
+        import numpy as np
+
+        X = np.asarray(X, dtype=float)
+        y = np.asarray(y, dtype=float)
+        if len(y) == 0:
+            return None
+        y = (y - y.mean()) / (y.std() + 1e-9)
+        K = np.exp(
+            -0.5 * ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1) / self._ELL**2
+        )
+        K += 1e-3 * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        # alpha = K^-1 y via two triangular solves
+        from numpy.linalg import solve
+
+        alpha = solve(L.T, solve(L, y))
+        return X, L, alpha
+
+    def _gp_ucb_fit(self, cand, fit, beta: float = 2.0) -> float:
+        import numpy as np
+        from numpy.linalg import solve
+
+        if fit is None:
+            return 0.0
+        X, L, alpha = fit
+        c = np.asarray(cand, dtype=float)
+        k_star = np.exp(-0.5 * ((X - c[None, :]) ** 2).sum(-1) / self._ELL**2)
+        mu = k_star @ alpha
+        v = solve(L, k_star)
+        var = max(1e-9, 1.0 - v @ v)
+        return float(mu + beta * math.sqrt(var))
+
+    def _gp_ucb(self, cand, X, y, beta: float = 2.0) -> float:
+        """GP posterior UCB with an RBF kernel (pure numpy; the reference
+        uses a time-varying kernel — the stationary RBF is the core)."""
+        return self._gp_ucb_fit(cand, self._gp_fit(X, y), beta)
+
+    def choose_config(self, trial: Trial) -> Optional[Dict[str, Any]]:
+        donor = self._exploit_from.pop(trial.trial_id, None)
+        if donor is None:
+            return None
+        cfg = dict(donor.config)
+        trial.checkpoint_dir = donor.checkpoint_dir
+        if self._bounds:
+            fit = self._gp_fit(
+                [o[0] for o in self._observations],
+                [o[1] for o in self._observations],
+            )
+            best, best_ucb = None, float("-inf")
+            for _ in range(64):  # random-search acquisition maximization
+                cand = [self._rng.random() for _ in self._bounds]
+                ucb = self._gp_ucb_fit(cand, fit)
+                if ucb > best_ucb:
+                    best, best_ucb = cand, ucb
+            for (k, (lo, hi)), v in zip(self._bounds.items(), best):
+                val = lo + v * (hi - lo)
+                if isinstance(donor.config.get(k), int):
+                    val = max(1, int(round(val)))
+                cfg[k] = val
         return cfg

@@ -236,11 +236,19 @@ class TuneController:
     def _maybe_create_trials(self):
         live = sum(1 for t in self._trials if t.status == RUNNING)
         cap = self._max_concurrent()
-        # resume paused/pending-restored trials first
+        # resume paused/pending-restored trials first; synchronous
+        # schedulers (HyperBand) can hold a paused trial until its bracket
+        # rung fills, or terminate it without resuming
         for t in self._trials:
             if live >= cap:
                 return
             if t.status == PAUSED or (t.status == PENDING and t.actor is None and t.results):
+                verdict = self._scheduler.on_trial_pending_resume(t)
+                if verdict == STOP:
+                    self._stop_trial(t, TERMINATED)
+                    continue
+                if verdict == PAUSE:
+                    continue
                 self._start_trial(t, restore=True)
                 live += 1
         for t in self._trials:
@@ -254,6 +262,9 @@ class TuneController:
             cfg = self._searcher.suggest(tid)
             if cfg is None:
                 self._exhausted = True
+                # synchronous schedulers stop waiting for bracket mates
+                # that will never arrive
+                self._scheduler.on_search_exhausted()
                 return
             if cfg is PENDING_SUGGESTION:
                 return

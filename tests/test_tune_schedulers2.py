@@ -1,0 +1,203 @@
+"""HyperBand (synchronous) + PB2 schedulers (reference test model:
+python/ray/tune/tests/test_trial_scheduler.py HyperBand section,
+test_trial_scheduler_pbt.py PB2 cases)."""
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import CONTINUE, PAUSE, STOP, HyperBandScheduler, _Bracket
+from ray_tpu.tune.trial import Trial
+
+
+def test_bracket_sizes():
+    sched = HyperBandScheduler(max_t=9, reduction_factor=3)
+    # s_max=2 → brackets s=2,1,0 with n=9,5(ceil 4.5... reference rounding),3
+    sizes = [b.size for b in sched._brackets]
+    assert sizes[0] == 9
+    assert sizes[-1] == 3
+    rungs0 = sched._brackets[0].rungs
+    assert rungs0[0] == 1  # bracket s=2 starts at r=max_t/eta^2=1
+
+
+def test_bracket_promotion_math():
+    b = _Bracket(r0=1, max_t=9, eta=3, size=3)
+    for tid in ("a", "b", "c"):
+        b.members.append(tid)
+    b.record("a", 3.0)
+    b.try_promote()
+    assert not b.resumable and not b.doomed  # rung not full yet
+    b.record("b", 1.0)
+    b.record("c", 2.0)
+    b.try_promote()
+    assert "a" in b.resumable  # top 1/3 of 3 = 1 trial promoted
+    assert {"b", "c"} == b.doomed
+
+
+def test_hyperband_sync_unit():
+    sched = HyperBandScheduler(max_t=9, reduction_factor=3)
+    sched.set_search_properties("score", "max")
+    # checkpointed trials — only these may PAUSE at a milestone
+    trials = [Trial(f"t{i}", {}, checkpoint_dir="ck") for i in range(9)]
+    # All 9 land in bracket 0 (size 9, first rung at r=1).
+    decisions = {}
+    for q, t in enumerate(trials[:-1]):
+        decisions[t.trial_id] = sched.on_trial_result(
+            t, {"training_iteration": 1, "score": float(q)}
+        )
+    # rung incomplete → everyone so far paused
+    assert all(d == PAUSE for d in decisions.values())
+    # last report fills the rung: top 3 of 9 promoted
+    last = sched.on_trial_result(trials[-1], {"training_iteration": 1, "score": 8.0})
+    assert last == CONTINUE  # best trial is promoted immediately
+    verdicts = {t.trial_id: sched.on_trial_pending_resume(t) for t in trials[:-1]}
+    promoted = [tid for tid, v in verdicts.items() if v == CONTINUE]
+    stopped = [tid for tid, v in verdicts.items() if v == STOP]
+    assert len(promoted) == 2  # t6, t7 (t8 already continued)
+    assert set(promoted) == {"t6", "t7"}
+    assert len(stopped) == 6
+
+
+def test_hyperband_end_to_end(ray_start_regular, tmp_path):
+    # Checkpointed trainable — synchronous HyperBand pauses trials at rung
+    # milestones, so progress must survive the pause/resume cycle.
+    def objective(config):
+        step = 0
+        ck = tune.get_checkpoint_dir()
+        if ck:
+            with open(os.path.join(ck, "s.json")) as f:
+                step = json.load(f)["step"]
+        for i in range(step, 10):
+            d = tune.make_checkpoint_dir()
+            with open(os.path.join(d, "s.json"), "w") as f:
+                json.dump({"step": i + 1}, f)
+            tune.report({"score": config["q"] * (i + 1)}, checkpoint_dir=d)
+
+    sched = HyperBandScheduler(max_t=9, reduction_factor=3)
+    grid = tune.Tuner(
+        objective,
+        param_space={"q": tune.grid_search(list(range(1, 10)))},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", scheduler=sched, max_concurrent_trials=3
+        ),
+        _experiment_dir=str(tmp_path / "exp"),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.metrics["config"]["q"] == 9
+    # successive halving must have cut most trials before max_t
+    iters = sorted(t.iteration for t in grid.trials)
+    assert iters[0] <= 2
+    assert sum(1 for i in iters if i >= 9) <= 4
+
+
+def test_hyperband_uncheckpointed_never_pauses():
+    """Without a checkpoint, pausing would silently restart the trainable
+    from step 0 — the scheduler must keep such trials running and reap
+    losers via the doomed fast-path on their next report."""
+    sched = HyperBandScheduler(max_t=9, reduction_factor=3)
+    sched.set_search_properties("score", "max")
+    trials = [Trial(f"t{i}", {}) for i in range(9)]  # no checkpoint_dir
+    decisions = [
+        sched.on_trial_result(t, {"training_iteration": 1, "score": float(i)})
+        for i, t in enumerate(trials)
+    ]
+    assert PAUSE not in decisions
+    # rung is now cut: the losers' next report must STOP them
+    verdict = sched.on_trial_result(
+        trials[0], {"training_iteration": 2, "score": 0.0}
+    )
+    assert verdict == STOP
+    winner = sched.on_trial_result(
+        trials[8], {"training_iteration": 2, "score": 8.0}
+    )
+    assert winner == CONTINUE
+
+
+def test_hyperband_restored_trial_resumes():
+    """A fresh scheduler (after Tuner.restore) must not PAUSE-gate trials
+    it has never scored — that would hang the experiment forever."""
+    sched = HyperBandScheduler(max_t=9, reduction_factor=3)
+    sched.set_search_properties("score", "max")
+    t = Trial("old", {}, checkpoint_dir="ck")
+    t.results = [{"score": 5.0, "training_iteration": 3}]
+    assert sched.on_trial_pending_resume(t) == CONTINUE
+
+
+def test_bracket_decided_rung_not_recut():
+    b = _Bracket(r0=1, max_t=9, eta=3, size=3)
+    for tid in ("a", "b", "c"):
+        b.members.append(tid)
+    b.record("a", 3.0)
+    b.record("b", 1.0)
+    b.record("c", 2.0)
+    b.try_promote()
+    assert b.doomed == {"b", "c"} and 0 in b.decided
+    # a second promote pass must not resurrect doomed trials
+    b.try_promote()
+    assert "b" not in b.resumable and "c" not in b.resumable
+    # late arrival at the decided rung is judged against the stored cutoff
+    b.members.append("late_hi")
+    b.record("late_hi", 9.0)
+    assert "late_hi" in b.resumable and b.rung_idx["late_hi"] == 1
+    b.members.append("late_lo")
+    b.record("late_lo", 0.5)
+    assert "late_lo" in b.doomed
+
+
+def test_zip_unequal_counts_raises(ray_start_regular):
+    import ray_tpu
+    from ray_tpu import data
+
+    a = data.range(20)
+    b = data.range(15)
+    with pytest.raises(Exception, match="equal row counts"):
+        a.zip(b).take_all()
+
+
+def test_pb2_gp_explore(ray_start_regular, tmp_path):
+    def objective(config):
+        lr = config["lr"]
+        ck = tune.get_checkpoint_dir()
+        value = 0.0
+        if ck:
+            with open(os.path.join(ck, "v.json")) as f:
+                value = json.load(f)["v"]
+        for i in range(12):
+            value += lr
+            d = tune.make_checkpoint_dir()
+            with open(os.path.join(d, "v.json"), "w") as f:
+                json.dump({"v": value}, f)
+            tune.report({"score": value, "lr": lr}, checkpoint_dir=d)
+
+    sched = tune.PB2(
+        perturbation_interval=3,
+        hyperparam_bounds={"lr": (0.01, 1.0)},
+        quantile_fraction=0.34,
+        seed=0,
+    )
+    grid = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.02, 0.05, 0.9])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", scheduler=sched, max_concurrent_trials=3
+        ),
+        _experiment_dir=str(tmp_path / "exp"),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.metrics["score"] >= 9  # the strong lineage keeps compounding
+    # GP explore must have proposed an off-grid lr for some exploited trial
+    lrs = {round(t.metric("lr", 0), 6) for t in grid.trials}
+    assert lrs - {0.02, 0.05, 0.9}
+
+
+def test_pb2_ucb_prefers_high_region():
+    sched = tune.PB2(hyperparam_bounds={"x": (0.0, 1.0)}, seed=1)
+    sched.set_search_properties("score", "max")
+    # score increases with x → UCB at x=0.9 should beat x=0.1
+    X = [[i / 10] for i in range(10)]
+    y = [i / 10 for i in range(10)]
+    hi = sched._gp_ucb([0.9], X, y, beta=0.0)
+    lo = sched._gp_ucb([0.1], X, y, beta=0.0)
+    assert hi > lo
