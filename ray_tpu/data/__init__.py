@@ -108,10 +108,10 @@ def read_sql(sql: str, connection_factory, *, parallelism: int = -1, parallelism
     return _read(SQLDatasource(sql, connection_factory, parallelism_column), parallelism)
 
 
-def read_images(paths, *, size: Optional[tuple] = None, parallelism: int = -1) -> Dataset:
+def read_images(paths, *, size: Optional[tuple] = None, mode: Optional[str] = "RGB", parallelism: int = -1) -> Dataset:
     from ray_tpu.data.extra_datasources import ImageDatasource
 
-    return _read(ImageDatasource(paths, size=size), parallelism)
+    return _read(ImageDatasource(paths, size=size, mode=mode), parallelism)
 
 
 __all__ = [

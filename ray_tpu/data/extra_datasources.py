@@ -55,7 +55,15 @@ class WebDatasetDatasource(FileBasedDatasource):
                 if key not in samples:
                     samples[key] = {"__key__": key}
                     order.append(key)
-                samples[key][ext] = _decode_component(ext.lower(), data)
+                # Schema stability across write→read: the sink appends a
+                # codec suffix ("cls" → "cls.json"); strip it from the
+                # column name when the extension is multi-part so the
+                # original column comes back. Plain single-part extensions
+                # (standard WebDataset: "json", "txt", "cls") are kept.
+                col = ext
+                if "." in ext and ext.rsplit(".", 1)[-1].lower() in ("json", "npy"):
+                    col = ext.rsplit(".", 1)[0]
+                samples[key][col] = _decode_component(ext.lower(), data)
         yield [samples[k] for k in order]
 
 
@@ -85,7 +93,12 @@ class WebDatasetDatasink(_FileDatasink):
                         payload = buf.getvalue()
                         col = col + ".npy" if not col.endswith(".npy") else col
                     else:
-                        payload = json.dumps(value).encode()
+                        # numpy scalars (columnar blocks yield np.int64 etc.)
+                        # are not JSON-serializable; .item() unwraps them
+                        payload = json.dumps(
+                            value,
+                            default=lambda o: o.item() if hasattr(o, "item") else str(o),
+                        ).encode()
                         col = col + ".json" if "." not in col else col
                     info = tarfile.TarInfo(f"{key}.{col}")
                     info.size = len(payload)
@@ -124,8 +137,14 @@ class SQLDatasource(Datasource):
                 conn = factory()
                 try:
                     cur = conn.cursor()
+                    # Subquery alias: required by Postgres/MySQL (SQLite
+                    # accepts it too). Double modulo keeps negative shard
+                    # columns in [0, p); COALESCE routes NULLs to shard 0
+                    # instead of silently dropping them.
                     cur.execute(
-                        f"SELECT * FROM ({sql}) WHERE ({shard_col}) % {parallelism} = {i}"
+                        f"SELECT * FROM ({sql}) AS _rt_shard WHERE "
+                        f"COALESCE((({shard_col}) % {parallelism} + {parallelism})"
+                        f" % {parallelism}, 0) = {i}"
                     )
                     cols = [d[0] for d in cur.description]
                     yield [dict(zip(cols, row)) for row in cur.fetchall()]
@@ -137,11 +156,16 @@ class SQLDatasource(Datasource):
 
 
 class ImageDatasource(FileBasedDatasource):
-    """Decode images to HWC uint8 arrays (requires PIL; gated import)."""
+    """Decode images to HWC uint8 arrays (requires PIL; gated import).
 
-    def __init__(self, paths, size: Optional[tuple] = None):
+    ``mode`` normalizes every file to one PIL mode (default RGB) so mixed
+    grayscale/RGBA/palette inputs produce a uniform (H, W, 3) column
+    (reference: image_datasource.py's mode conversion)."""
+
+    def __init__(self, paths, size: Optional[tuple] = None, mode: Optional[str] = "RGB"):
         super().__init__(paths)
         self._size = size
+        self._mode = mode
 
     def _read_file(self, path: str) -> Iterable[Block]:
         try:
@@ -149,6 +173,8 @@ class ImageDatasource(FileBasedDatasource):
         except ImportError as e:  # pragma: no cover - PIL is present in CI
             raise ImportError("read_images requires pillow") from e
         img = Image.open(path)
+        if self._mode is not None:
+            img = img.convert(self._mode)
         if self._size is not None:
             img = img.resize(self._size)
         yield [{"image": np.asarray(img), "path": path}]

@@ -4,5 +4,6 @@ from ray_tpu.models.transformer import (
     forward,
     loss_fn,
 )
+from ray_tpu.models.vit import ViTConfig
 
-__all__ = ["TransformerConfig", "init_params", "forward", "loss_fn"]
+__all__ = ["TransformerConfig", "init_params", "forward", "loss_fn", "ViTConfig"]

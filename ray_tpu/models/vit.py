@@ -1,0 +1,169 @@
+"""Vision Transformer — the image-model family for Train-style image
+benchmarks.
+
+Reference scope: darthhexx/ray's Train image benchmark workloads
+(doc/source/train/benchmarks.rst GPU image training rows) exercise a
+vision model through the data-parallel trainer; Ray itself ships no
+model, so this is the TPU-native model those workloads plug into.
+
+TPU shape: patchify is one reshape+matmul (MXU-friendly, no conv
+unrolling), encoder blocks reuse the same pre-norm attention/MLP math as
+the flagship decoder (bf16 matmuls, optional remat), global-average-pool
+head. Works under the same MeshPlan dp/fsdp shardings as the LLM —
+params are a pytree of plain arrays with identical naming conventions.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    num_classes: int = 1000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.num_channels * self.patch_size**2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(**{**dict(image_size=32, patch_size=8, num_classes=10,
+                             d_model=64, n_layers=2, n_heads=4, d_ff=128), **kw})
+
+    @classmethod
+    def base16(cls, **kw):
+        """ViT-B/16."""
+        return cls(**kw)
+
+
+def init_params(key: jax.Array, cfg: ViTConfig) -> Params:
+    k_patch, k_pos, k_cls, k_layers, k_head = jax.random.split(key, 5)
+    D, F = cfg.d_model, cfg.d_ff
+    scale = D**-0.5
+    layers = []
+    for lk in jax.random.split(k_layers, cfg.n_layers):
+        k1, k2, k3, k4, k5, k6 = jax.random.split(lk, 6)
+        layers.append(
+            {
+                "ln1": jnp.ones(D, jnp.float32),
+                "wq": jax.random.normal(k1, (D, D), jnp.float32) * scale,
+                "wk": jax.random.normal(k2, (D, D), jnp.float32) * scale,
+                "wv": jax.random.normal(k3, (D, D), jnp.float32) * scale,
+                "wo": jax.random.normal(k4, (D, D), jnp.float32) * scale,
+                "ln2": jnp.ones(D, jnp.float32),
+                "w1": jax.random.normal(k5, (D, F), jnp.float32) * scale,
+                "b1": jnp.zeros(F, jnp.float32),
+                "w2": jax.random.normal(k6, (F, D), jnp.float32) * (F**-0.5),
+                "b2": jnp.zeros(D, jnp.float32),
+            }
+        )
+    return {
+        "patch_proj": jax.random.normal(k_patch, (cfg.patch_dim, D), jnp.float32)
+        * cfg.patch_dim**-0.5,
+        "pos_embed": jax.random.normal(k_pos, (cfg.num_patches, D), jnp.float32) * 0.02,
+        "layers": layers,
+        "ln_f": jnp.ones(D, jnp.float32),
+        "head": jax.random.normal(k_head, (D, cfg.num_classes), jnp.float32) * scale,
+    }
+
+
+def patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """NHWC images → (N, num_patches, patch_dim) with one reshape chain —
+    XLA lowers the following matmul straight onto the MXU."""
+    N, H, W, C = images.shape
+    P = cfg.patch_size
+    x = images.reshape(N, H // P, P, W // P, P, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # N, h, w, P, P, C
+    return x.reshape(N, (H // P) * (W // P), P * P * C)
+
+
+def _layer_norm(x, scale):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * scale
+
+
+def _encoder_layer(x, lp: Params, cfg: ViTConfig):
+    D, H, HD = cfg.d_model, cfg.n_heads, cfg.head_dim
+    dt = cfg.dtype
+    h = _layer_norm(x, lp["ln1"]).astype(dt)
+    N, S, _ = h.shape
+    q = (h @ lp["wq"].astype(dt)).reshape(N, S, H, HD)
+    k = (h @ lp["wk"].astype(dt)).reshape(N, S, H, HD)
+    v = (h @ lp["wv"].astype(dt)).reshape(N, S, H, HD)
+    scores = jnp.einsum("nshd,nthd->nhst", q, k) * HD**-0.5
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+    attn = jnp.einsum("nhst,nthd->nshd", probs, v).reshape(N, S, D)
+    x = x + (attn @ lp["wo"].astype(dt)).astype(jnp.float32)
+    h = _layer_norm(x, lp["ln2"]).astype(dt)
+    h = jax.nn.gelu(h @ lp["w1"].astype(dt) + lp["b1"].astype(dt))
+    x = x + (h @ lp["w2"].astype(dt) + lp["b2"].astype(dt)).astype(jnp.float32)
+    return x
+
+
+def forward(params: Params, images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """images NHWC float → class logits (N, num_classes)."""
+    x = patchify(images.astype(cfg.dtype), cfg)
+    x = (x @ params["patch_proj"].astype(cfg.dtype)).astype(jnp.float32)
+    x = x + params["pos_embed"]
+    layer = _encoder_layer
+    if cfg.remat:
+        layer = jax.checkpoint(layer, static_argnums=(2,))
+    for lp in params["layers"]:
+        x = layer(x, lp, cfg)
+    x = _layer_norm(x, params["ln_f"])
+    pooled = x.mean(axis=1)  # GAP head (no [CLS] token needed)
+    return pooled @ params["head"]
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: ViTConfig):
+    logits = forward(params, batch["images"], cfg)
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+    return nll
+
+
+def accuracy(params: Params, batch: Dict[str, jax.Array], cfg: ViTConfig):
+    logits = forward(params, batch["images"], cfg)
+    return (logits.argmax(-1) == batch["labels"]).mean()
+
+
+def num_params(cfg: ViTConfig) -> int:
+    p = init_shapes_count(cfg)
+    return p
+
+
+def init_shapes_count(cfg: ViTConfig) -> int:
+    D, F = cfg.d_model, cfg.d_ff
+    per_layer = 2 * D + 4 * D * D + D * F + F + F * D + D
+    return (
+        cfg.patch_dim * D
+        + cfg.num_patches * D
+        + cfg.n_layers * per_layer
+        + D
+        + D * cfg.num_classes
+    )

@@ -11,6 +11,13 @@ from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.episodes import SingleAgentEpisode, compute_gae, episodes_to_batch
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace_returns
 from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentEpisode,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from ray_tpu.rllib.offline import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
@@ -40,6 +47,11 @@ __all__ = [
     "vtrace_returns",
     "APPO",
     "APPOConfig",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
+    "MultiAgentEpisode",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
     "CQL",
     "CQLConfig",
     "DQN",

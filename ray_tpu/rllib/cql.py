@@ -16,9 +16,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ray_tpu.rllib.algorithm import Algorithm
-from ray_tpu.rllib.off_policy import OffPolicyConfig
-from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.off_policy import OffPolicyAlgorithm, OffPolicyConfig
 from ray_tpu.rllib.rl_module import RLModuleSpec
 from ray_tpu.rllib.sac import sac_loss
 from ray_tpu.rllib.episodes import SingleAgentEpisode
@@ -84,35 +82,30 @@ class CQLConfig(OffPolicyConfig):
         return CQL(self)
 
 
-class CQL(Algorithm):
+class CQL(OffPolicyAlgorithm):
+    """Offline variant of the off-policy loop: the replay buffer is seeded
+    once from the dataset and training_step never samples the env
+    (_sync_target and the target machinery are inherited)."""
+
     loss_fn = staticmethod(cql_loss)
     target_pairs = (("q1", "q1_target"), ("q2", "q2_target"))
 
     def __init__(self, config: CQLConfig):
         if config._offline_episodes is None:
             raise ValueError("CQL requires .offline_data(episodes)")
-        super().__init__(config)
-        self.buffer = ReplayBuffer(
-            max(config.buffer_size, sum(len(e) for e in config._offline_episodes)),
-            seed=config.seed,
+        # Size the buffer to hold the full dataset before the base class
+        # builds it.
+        config.buffer_size = max(
+            config.buffer_size, sum(len(e) for e in config._offline_episodes)
         )
+        super().__init__(config)
         self.buffer.add_episodes(config._offline_episodes)
-        self._num_updates = 0
 
     def _loss_cfg(self) -> dict:
         c = self.config
         return dict(
             gamma=c.gamma, target_entropy=c.target_entropy, cql_alpha=c.cql_alpha
         )
-
-    def _sync_target(self):
-        import jax
-
-        state = self.learner_group.get_state()
-        params = state["params"]
-        for online, target in type(self).target_pairs:
-            params[target] = jax.tree.map(lambda x: x, params[online])
-        self.learner_group.set_state(state)
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config

@@ -1,0 +1,388 @@
+"""Multi-agent RL: env API, episodes, env runner, MultiRLModule, MA-PPO.
+
+Reference: rllib/env/multi_agent_env.py (dict-keyed step/reset protocol
+with the ``__all__`` termination key), rllib/env/multi_agent_env_runner.py
+(per-agent episode accounting while agents join/leave), and
+rllib/core/rl_module/multi_rl_module.py (module_id -> RLModule with a
+policy_mapping_fn routing agents onto shared or private policies).
+
+TPU shape: each policy's update is an independent jitted step; agents
+mapped to the same module batch together, so shared policies see one
+large MXU-friendly batch instead of per-agent fragments.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.episodes import SingleAgentEpisode, episodes_to_batch
+from ray_tpu.rllib.learner import LearnerGroup
+from ray_tpu.rllib.ppo import PPOConfig, ppo_loss
+from ray_tpu.rllib.rl_module import RLModuleSpec, make_module
+
+
+class MultiAgentEnv:
+    """Reference: rllib/env/multi_agent_env.py. Subclasses define
+    ``possible_agents``, ``observation_spaces``/``action_spaces`` (dicts)
+    and the dict-keyed reset/step protocol; terminateds/truncateds carry
+    the ``__all__`` aggregate key."""
+
+    possible_agents: List[str] = []
+
+    def reset(self, *, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, int]):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class MultiAgentEpisode:
+    """Per-agent SingleAgentEpisodes sharing one env rollout (reference:
+    rllib/env/multi_agent_episode.py)."""
+
+    def __init__(self):
+        self.agent_episodes: Dict[str, SingleAgentEpisode] = {}
+
+    def add_reset(self, agent_id: str, obs):
+        self.agent_episodes[agent_id] = SingleAgentEpisode(observations=[obs])
+
+    def total_reward(self) -> float:
+        return sum(ep.total_reward for ep in self.agent_episodes.values())
+
+
+class MultiAgentEnvRunner:
+    """Samples a multi-agent env with one inference module per policy.
+
+    Reference: rllib/env/multi_agent_env_runner.py:  sample() steps the
+    env with the joint action dict; episodes are cut per agent; the
+    policy_mapping_fn routes each agent onto its module's params."""
+
+    def __init__(
+        self,
+        env_spec: Any,
+        module_specs: Dict[str, RLModuleSpec],
+        policy_mapping_fn: Callable[[str], str],
+        seed: int = 0,
+        worker_index: int = 0,
+    ):
+        import zlib
+
+        import jax
+
+        self._env = env_spec() if callable(env_spec) else env_spec
+        self.modules = {mid: make_module(spec) for mid, spec in module_specs.items()}
+        # crc32, not hash(): str hash is randomized per process, which would
+        # make param init nondeterministic despite an explicit seed.
+        self.params = {
+            mid: m.init_params(
+                jax.random.PRNGKey(seed + zlib.crc32(mid.encode()) % 10000)
+            )
+            for mid, m in self.modules.items()
+        }
+        self._mapping = policy_mapping_fn
+        self._explore = {
+            mid: jax.jit(m.forward_exploration) for mid, m in self.modules.items()
+        }
+        self._key = jax.random.PRNGKey(seed * 100003 + worker_index + 17)
+        self._seed = seed + worker_index * 1000
+        self._reset_env()
+        self.worker_index = worker_index
+        self._weights_version = 0
+        self._completed_returns: List[float] = []
+        self._return_acc = 0.0
+
+    def _reset_env(self):
+        obs, _ = self._env.reset(seed=self._seed)
+        self._seed += 1
+        self._obs: Dict[str, Any] = dict(obs)
+        self._ma_episode = MultiAgentEpisode()
+        for aid, o in obs.items():
+            self._ma_episode.add_reset(aid, o)
+
+    def set_state(self, params: Dict[str, Any], weights_version: int = 0):
+        import jax
+
+        self.params = jax.tree.map(lambda x: x, params)
+        self._weights_version = weights_version
+
+    def ping(self) -> str:
+        return "pong"
+
+    def _act(self, obs_by_agent: Dict[str, Any]):
+        """Joint action via per-module batched inference: agents sharing a
+        module are stacked into one forward pass."""
+        import jax
+        import jax.numpy as jnp
+
+        by_module: Dict[str, List[str]] = {}
+        for aid in obs_by_agent:
+            by_module.setdefault(self._mapping(aid), []).append(aid)
+        actions, logps, values = {}, {}, {}
+        for mid, aids in by_module.items():
+            batch = np.stack([np.asarray(obs_by_agent[a], dtype=np.float32) for a in aids])
+            self._key, sub = jax.random.split(self._key)
+            out = self._explore[mid](self.params[mid], jnp.asarray(batch), sub)
+            for j, a in enumerate(aids):
+                actions[a] = int(np.asarray(out["action"])[j])
+                logps[a] = float(np.asarray(out["logp"])[j])
+                values[a] = float(np.asarray(out["vf"])[j])
+        return actions, logps, values
+
+    def _bootstrap(self, mid: str, obs) -> float:
+        import jax.numpy as jnp
+
+        module = self.modules[mid]
+        out = module.forward_train(self.params[mid], jnp.asarray(np.asarray(obs, dtype=np.float32))[None])
+        return float(np.asarray(out["vf"])[0])
+
+    def sample(self, num_env_steps: int) -> List[tuple]:
+        """Returns [(module_id, SingleAgentEpisode), ...] fragments — the
+        learner groups them by module."""
+        done: List[tuple] = []
+        steps = 0
+        while steps < num_env_steps:
+            acting = dict(self._obs)
+            actions, logps, values = self._act(acting)
+            obs, rewards, terms, truncs, _ = self._env.step(actions)
+            for aid in acting:
+                ep = self._ma_episode.agent_episodes[aid]
+                ep.actions.append(actions[aid])
+                ep.rewards.append(float(rewards.get(aid, 0.0)))
+                ep.logps.append(logps[aid])
+                ep.values.append(values[aid])
+                self._return_acc += float(rewards.get(aid, 0.0))
+            steps += 1
+            all_done = terms.get("__all__", False) or truncs.get("__all__", False)
+            for aid in acting:
+                ep = self._ma_episode.agent_episodes[aid]
+                a_term = terms.get(aid, False)
+                a_trunc = truncs.get(aid, False)
+                if aid in obs:
+                    ep.observations.append(obs[aid])
+                else:
+                    ep.observations.append(ep.observations[-1])
+                if a_term or a_trunc or all_done:
+                    ep.terminated = bool(a_term)
+                    ep.truncated = not a_term
+                    mid = self._mapping(aid)
+                    if not a_term:
+                        ep.final_value = self._bootstrap(mid, ep.observations[-1])
+                    done.append((mid, ep))
+                    # An individually-finished agent leaves the episode; the
+                    # tail cut below must not re-emit (and re-bootstrap) it.
+                    # If the env hands it obs again, the late-join path
+                    # starts a fresh episode.
+                    del self._ma_episode.agent_episodes[aid]
+            if all_done:
+                self._completed_returns.append(self._return_acc)
+                self._return_acc = 0.0
+                self._reset_env()
+            else:
+                self._obs = {aid: obs[aid] for aid in obs}
+                for aid in obs:
+                    if aid not in self._ma_episode.agent_episodes:
+                        # late-joining agent (reference: agents may enter
+                        # mid-episode)
+                        self._ma_episode.add_reset(aid, obs[aid])
+        # cut in-progress per-agent episodes with bootstrap values
+        for aid, ep in list(self._ma_episode.agent_episodes.items()):
+            if len(ep) > 0:
+                mid = self._mapping(aid)
+                ep.truncated = True
+                ep.final_value = self._bootstrap(mid, ep.observations[-1])
+                done.append((mid, ep))
+                last_obs = ep.observations[-1]
+                self._ma_episode.agent_episodes[aid] = SingleAgentEpisode(
+                    observations=[last_obs]
+                )
+        return done
+
+    def pop_metrics(self) -> List[float]:
+        out = self._completed_returns
+        self._completed_returns = []
+        return out
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        """Greedy joint-policy rollouts; returns mean summed return."""
+        import jax.numpy as jnp
+
+        totals = []
+        for e in range(num_episodes):
+            obs, _ = self._env.reset(seed=10_000 + e)
+            total, done_all = 0.0, False
+            while not done_all:
+                actions = {}
+                for aid, o in obs.items():
+                    mid = self._mapping(aid)
+                    a = self.modules[mid].forward_inference(
+                        self.params[mid], jnp.asarray(np.asarray(o, dtype=np.float32))[None]
+                    )
+                    actions[aid] = int(np.asarray(a)[0])
+                obs, rewards, terms, truncs, _ = self._env.step(actions)
+                total += sum(float(r) for r in rewards.values())
+                done_all = terms.get("__all__", False) or truncs.get("__all__", False)
+            totals.append(total)
+        return float(np.mean(totals))
+
+
+class MultiAgentPPOConfig(PPOConfig):
+    """PPO over a MultiRLModule (reference: PPO + MultiRLModule new-stack
+    path; ``multi_agent()`` mirrors AlgorithmConfig.multi_agent)."""
+
+    def __init__(self):
+        super().__init__()
+        self._module_specs: Dict[str, RLModuleSpec] = {}
+        self._policy_mapping_fn: Callable[[str], str] = lambda aid: "default"
+        self._policies_to_train: Optional[List[str]] = None
+
+    def multi_agent(
+        self,
+        module_specs: Dict[str, RLModuleSpec],
+        policy_mapping_fn: Callable[[str], str],
+        policies_to_train: Optional[List[str]] = None,
+    ) -> "MultiAgentPPOConfig":
+        self._module_specs = module_specs
+        self._policy_mapping_fn = policy_mapping_fn
+        self._policies_to_train = policies_to_train
+        return self
+
+    def build(self) -> "MultiAgentPPO":
+        return MultiAgentPPO(self)
+
+
+class MultiAgentPPO:
+    """One LearnerGroup per trainable policy; agents sharing a policy are
+    batched together (reference: MultiRLModule learner update where each
+    module's loss runs over its own agents' sub-batch)."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        if not config._module_specs:
+            raise ValueError("use .multi_agent(module_specs=..., policy_mapping_fn=...)")
+        self.config = config
+        self.local_runner = MultiAgentEnvRunner(
+            config.env_spec,
+            config._module_specs,
+            config._policy_mapping_fn,
+            seed=config.seed,
+        )
+        if config.num_env_runners > 0:
+            runner_cls = ray_tpu.remote(num_cpus=1, max_restarts=0)(MultiAgentEnvRunner)
+
+            def make(i: int):
+                return runner_cls.remote(
+                    config.env_spec,
+                    config._module_specs,
+                    config._policy_mapping_fn,
+                    seed=config.seed,
+                    worker_index=i + 1,
+                )
+
+            self._manager = FaultTolerantActorManager(make, config.num_env_runners)
+        else:
+            self._manager = None
+        trainable = config._policies_to_train or list(config._module_specs)
+        self.learner_groups: Dict[str, LearnerGroup] = {
+            mid: LearnerGroup(
+                spec,
+                ppo_loss,
+                loss_cfg=dict(
+                    clip_param=config.clip_param,
+                    vf_clip_param=config.vf_clip_param,
+                    vf_loss_coeff=config.vf_loss_coeff,
+                    entropy_coeff=config.entropy_coeff,
+                ),
+                num_learners=0,
+                lr=config.lr,
+                grad_clip=config.grad_clip,
+                seed=config.seed,
+            )
+            for mid, spec in config._module_specs.items()
+            if mid in trainable
+        }
+        self.iteration = 0
+        self._total_env_steps = 0
+        self._recent_returns: List[float] = []
+        self._sync_weights()
+
+    def _weights(self) -> Dict[str, Any]:
+        w = dict(self.local_runner.params)
+        for mid, lg in self.learner_groups.items():
+            w[mid] = lg.get_weights()
+        return w
+
+    def _sync_weights(self):
+        params = self._weights()
+        self.local_runner.set_state(params)
+        if self._manager:
+            ref = ray_tpu.put(params)
+            self._manager.foreach_actor("set_state", ref, timeout=60)
+
+    def _sample(self) -> List[tuple]:
+        cfg = self.config
+        if not self._manager:
+            return self.local_runner.sample(cfg.train_batch_size)
+        n = max(1, self._manager.num_healthy())
+        per = max(1, cfg.train_batch_size // n)
+        out: List[tuple] = []
+        for _, frags in self._manager.foreach_actor("sample", per, timeout=300):
+            out.extend(frags)
+        return out or self.local_runner.sample(cfg.train_batch_size)
+
+    def train(self) -> Dict[str, Any]:
+        import time
+
+        t0 = time.time()
+        cfg = self.config
+        frags = self._sample()
+        env_steps = sum(len(ep) for _, ep in frags)
+        self._total_env_steps += env_steps
+        by_module: Dict[str, List[SingleAgentEpisode]] = {}
+        for mid, ep in frags:
+            if len(ep) > 0:
+                by_module.setdefault(mid, []).append(ep)
+        metrics: Dict[str, Any] = {}
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        for mid, lg in self.learner_groups.items():
+            eps = by_module.get(mid)
+            if not eps:
+                continue
+            batch = episodes_to_batch(eps, gamma=cfg.gamma, lam=cfg.lam)
+            rows = len(batch["obs"])
+            for _ in range(cfg.num_epochs):
+                order = rng.permutation(rows)
+                for lo in range(0, rows, cfg.minibatch_size):
+                    idx = order[lo : lo + cfg.minibatch_size]
+                    mb = {k: v[idx] for k, v in batch.items()}
+                    m = lg.update_from_batch(mb)
+                metrics.update({f"learner/{mid}/{k}": v for k, v in m.items()})
+        self._sync_weights()
+        returns = self.local_runner.pop_metrics()
+        if self._manager:
+            for _, r in self._manager.foreach_actor("pop_metrics", timeout=60):
+                returns.extend(r)
+        if returns:
+            self._recent_returns = (self._recent_returns + returns)[-100:]
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "env_steps_this_iter": env_steps,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            "episode_return_mean": float(np.mean(self._recent_returns))
+            if self._recent_returns
+            else 0.0,
+            "time_this_iter_s": time.time() - t0,
+            **metrics,
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        return self.local_runner.evaluate(num_episodes)
+
+    def stop(self):
+        for lg in self.learner_groups.values():
+            lg.shutdown()

@@ -378,8 +378,11 @@ class PB2(PopulationBasedTraining):
             seed=seed,
         )
         self._bounds = hyperparam_bounds or {}
-        # (normalized hyperparam vector, observed score) history
+        # (normalized hyperparam vector, score *improvement*) history —
+        # PB2 models the per-interval delta, not the raw score, so late
+        # observations don't dominate just because training ran longer
         self._observations: List[tuple] = []
+        self._prev_score: Dict[str, float] = {}
 
     def _normalize(self, cfg: Dict[str, Any]):
         xs = []
@@ -390,10 +393,14 @@ class PB2(PopulationBasedTraining):
 
     def on_trial_result(self, trial: Trial, result: dict) -> str:
         if all(k in trial.config for k in self._bounds):
-            self._observations.append(
-                (self._normalize(trial.config), self._score(result))
-            )
-            self._observations = self._observations[-256:]
+            score = self._score(result)
+            prev = self._prev_score.get(trial.trial_id)
+            self._prev_score[trial.trial_id] = score
+            if prev is not None:
+                self._observations.append(
+                    (self._normalize(trial.config), score - prev)
+                )
+                self._observations = self._observations[-256:]
         return super().on_trial_result(trial, result)
 
     _ELL = 0.3  # RBF length scale
@@ -442,6 +449,9 @@ class PB2(PopulationBasedTraining):
         donor = self._exploit_from.pop(trial.trial_id, None)
         if donor is None:
             return None
+        # The trial restarts from the donor's checkpoint: its next score is
+        # discontinuous, so the first post-exploit delta must not be recorded.
+        self._prev_score.pop(trial.trial_id, None)
         cfg = dict(donor.config)
         trial.checkpoint_dir = donor.checkpoint_dir
         if self._bounds:

@@ -1,0 +1,143 @@
+"""Multi-agent RL tests (reference test model:
+rllib/env/tests/test_multi_agent_env_runner.py, multi-agent learning
+tests on simple cooperative envs)."""
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPOConfig,
+    RLModuleSpec,
+)
+
+
+class ContextMatchEnv(MultiAgentEnv):
+    """Cooperative contextual bandit chain: each agent sees a one-hot
+    context and earns +1 for choosing the context's index. Episode runs
+    ``length`` steps; contexts resample every step. Agent 'b' joins with
+    a different context stream than 'a' so shared-vs-separate policies
+    are distinguishable."""
+
+    possible_agents = ["a", "b"]
+
+    def __init__(self, dim: int = 4, length: int = 10):
+        self.dim = dim
+        self.length = length
+        self._rng = np.random.default_rng(0)
+        self._t = 0
+        self._ctx = {}
+
+    def _sample_obs(self):
+        self._ctx = {
+            aid: int(self._rng.integers(self.dim)) for aid in self.possible_agents
+        }
+        return {
+            aid: np.eye(self.dim, dtype=np.float32)[c]
+            for aid, c in self._ctx.items()
+        }
+
+    def reset(self, *, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        return self._sample_obs(), {}
+
+    def step(self, action_dict):
+        rewards = {
+            aid: float(action_dict.get(aid, -1) == self._ctx[aid])
+            for aid in self.possible_agents
+        }
+        self._t += 1
+        done = self._t >= self.length
+        obs = self._sample_obs() if not done else {}
+        terms = {aid: done for aid in self.possible_agents}
+        terms["__all__"] = done
+        truncs = {aid: False for aid in self.possible_agents}
+        truncs["__all__"] = False
+        return obs, rewards, terms, truncs, {}
+
+
+def _specs(shared: bool):
+    spec = RLModuleSpec(observation_dim=4, action_dim=4, hidden=(16,))
+    if shared:
+        return {"shared": spec}, (lambda aid: "shared")
+    return (
+        {"pol_a": spec, "pol_b": RLModuleSpec(observation_dim=4, action_dim=4, hidden=(16,))},
+        (lambda aid: f"pol_{aid}"),
+    )
+
+
+def test_ma_env_runner_sampling():
+    specs, mapping = _specs(shared=False)
+    runner = MultiAgentEnvRunner(ContextMatchEnv, specs, mapping, seed=0)
+    frags = runner.sample(40)
+    assert frags
+    mids = {mid for mid, _ in frags}
+    assert mids == {"pol_a", "pol_b"}
+    for mid, ep in frags:
+        assert len(ep.observations) == len(ep.actions) + 1
+        assert len(ep.rewards) == len(ep.actions)
+    # env steps counted per joint step; both agents act each step
+    total = sum(len(ep) for _, ep in frags)
+    assert total >= 80  # 40 joint steps x 2 agents
+
+
+def test_ma_ppo_learns_separate_policies():
+    specs, mapping = _specs(shared=False)
+    config = (
+        MultiAgentPPOConfig()
+        .environment(ContextMatchEnv)
+        .training(train_batch_size=200, minibatch_size=64, num_epochs=4, lr=3e-3)
+        .debugging(seed=0)
+    )
+    config.multi_agent(module_specs=specs, policy_mapping_fn=mapping)
+    algo = config.build()
+    best = 0.0
+    for _ in range(25):
+        result = algo.train()
+        best = max(best, result["episode_return_mean"])
+        if best >= 16:
+            break
+    # 10 steps x 2 agents → max 20/episode; random ≈ 5
+    assert best >= 14, f"MA-PPO failed to learn: best={best}"
+    assert any(k.startswith("learner/pol_a/") for k in result)
+    assert any(k.startswith("learner/pol_b/") for k in result)
+    algo.stop()
+
+
+def test_ma_ppo_shared_policy():
+    specs, mapping = _specs(shared=True)
+    config = (
+        MultiAgentPPOConfig()
+        .environment(ContextMatchEnv)
+        .training(train_batch_size=160, minibatch_size=64, num_epochs=4, lr=3e-3)
+        .debugging(seed=1)
+    )
+    config.multi_agent(module_specs=specs, policy_mapping_fn=mapping)
+    algo = config.build()
+    for _ in range(10):
+        result = algo.train()
+    assert "learner/shared/loss" in result or any(
+        k.startswith("learner/shared/") for k in result
+    )
+    score = algo.evaluate(num_episodes=3)
+    assert score >= 5.0  # better than nothing; learning signal present
+    algo.stop()
+
+
+def test_ma_ppo_distributed_runners(ray_start_regular):
+    specs, mapping = _specs(shared=True)
+    config = (
+        MultiAgentPPOConfig()
+        .environment(ContextMatchEnv)
+        .env_runners(num_env_runners=2)
+        .training(train_batch_size=120, minibatch_size=64, num_epochs=2, lr=3e-3)
+        .debugging(seed=2)
+    )
+    config.multi_agent(module_specs=specs, policy_mapping_fn=mapping)
+    algo = config.build()
+    for _ in range(3):
+        result = algo.train()
+    assert result["num_env_steps_sampled_lifetime"] >= 300
+    algo.stop()
