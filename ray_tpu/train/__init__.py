@@ -11,6 +11,7 @@ from ray_tpu.train.config import (
 )
 from ray_tpu.train.session import get_checkpoint, get_context, report
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
+from ray_tpu.train.torch import TorchTrainer
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
 from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
 
@@ -26,6 +27,7 @@ __all__ = [
     "get_checkpoint",
     "DataParallelTrainer",
     "JaxTrainer",
+    "TorchTrainer",
     "Result",
     "TrainWorker",
     "WorkerGroup",

@@ -133,6 +133,16 @@ def _get_session() -> _TrainSession:
     return _session
 
 
+def _call_train_fn(train_fn, config: Optional[dict]):
+    """The loop-arity convention (loop(config) vs loop()), in one place —
+    used by TrainWorker.run_train_fn and trainer wrappers alike."""
+    import inspect
+
+    if len(inspect.signature(train_fn).parameters) >= 1:
+        return train_fn(config if config is not None else {})
+    return train_fn()
+
+
 # ---------------------------------------------------------------------------
 # Public API (reference: ray.train.report / get_context / get_checkpoint)
 # ---------------------------------------------------------------------------

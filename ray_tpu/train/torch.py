@@ -1,0 +1,192 @@
+"""TorchTrainer — torch.distributed data-parallel training on the gang.
+
+Reference: python/ray/train/torch/config.py (_TorchBackend picks
+MASTER_ADDR/PORT from worker 0 and calls dist.init_process_group on every
+worker, :153/:66) and train/torch/train_loop_utils.py (prepare_model :162
+DDP wrap, get_devices :115). CPU/gloo is the supported fabric here —
+torch-on-TPU is out of scope (the TPU path is JaxTrainer); TorchTrainer
+exists for capability parity and for CPU-side torch workloads riding the
+same gang scheduler, checkpointing, and report() machinery.
+
+Rendezvous: rank 0 publishes host:port through the cluster KV (the same
+role the reference gives worker 0's env vars), everyone else polls —
+exactly the Rendezvous shape of nccl_collective_group.py:29.
+"""
+from __future__ import annotations
+
+import socket
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.session import get_context
+from ray_tpu.train.trainer import DataParallelTrainer
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _rendezvous_key() -> bytes:
+    # Keyed by the gang's unique group name (fresh per (re)start), not the
+    # experiment name — an elastic restart must not read the previous
+    # incarnation's stale rank-0 address.
+    from ray_tpu.train.session import _get_session
+
+    return f"torch_dist/{_get_session().group_name}".encode()
+
+
+def init_torch_process_group(timeout_s: float = 60.0) -> bool:
+    """Gloo process-group init inside a train worker; returns False when
+    world_size == 1 (no group needed)."""
+    import datetime
+
+    import torch.distributed as dist
+
+    from ray_tpu.collective.host_group import _multi_host
+    from ray_tpu.experimental import internal_kv
+
+    ctx = get_context()
+    if ctx.get_world_size() <= 1:
+        return False
+    key = _rendezvous_key()
+    if ctx.get_world_rank() == 0:
+        # Same address policy as the collective host group: only advertise
+        # the resolved hostname across hosts (it is often 127.0.1.1 via
+        # /etc/hosts); single-host gangs rendezvous on loopback.
+        addr = (
+            socket.gethostbyname(socket.gethostname())
+            if _multi_host()
+            else "127.0.0.1"
+        )
+        port = _free_port()
+        internal_kv._internal_kv_put(key, f"{addr}:{port}".encode())
+        master = f"{addr}:{port}"
+    else:
+        deadline = time.time() + timeout_s
+        master = None
+        while time.time() < deadline:
+            v = internal_kv._internal_kv_get(key)
+            if v:
+                master = v.decode()
+                break
+            time.sleep(0.05)
+        if master is None:
+            raise TimeoutError("torch rendezvous: rank-0 address never appeared")
+    dist.init_process_group(
+        backend="gloo",
+        init_method=f"tcp://{master}",
+        rank=ctx.get_world_rank(),
+        world_size=ctx.get_world_size(),
+        # Bound the store handshake too — otherwise a dead peer stalls the
+        # gang for torch's 30-minute default, far past the elastic-restart
+        # budget.
+        timeout=datetime.timedelta(seconds=timeout_s),
+    )
+    if ctx.get_world_rank() == 0:
+        # init returning on rank 0 means every rank has joined the store;
+        # the advertised address is no longer needed.
+        internal_kv._internal_kv_del(key)
+    return True
+
+
+def prepare_model(model):
+    """DDP-wrap when a process group is live (reference:
+    train_loop_utils.py:162 prepare_model)."""
+    import torch.distributed as dist
+
+    if dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model
+
+
+def get_device():
+    """Reference: train_loop_utils.py:115 get_devices — CPU fabric here."""
+    import torch
+
+    return torch.device("cpu")
+
+
+class _EpochSteppingLoader:
+    """DataLoader proxy that calls ``sampler.set_epoch`` on every
+    ``__iter__`` so shuffled loaders reshuffle each epoch (the reference's
+    prepare_data_loader does the same inside its wrapper)."""
+
+    def __init__(self, loader, sampler):
+        self._loader = loader
+        self._sampler = sampler
+        self._epoch = 0
+
+    def __iter__(self):
+        self._sampler.set_epoch(self._epoch)
+        self._epoch += 1
+        return iter(self._loader)
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __getattr__(self, name):
+        return getattr(self._loader, name)
+
+
+def prepare_data_loader(data_loader):
+    """Shard a DataLoader across ranks with DistributedSampler (reference:
+    train_loop_utils.py prepare_data_loader). Preserves the original
+    loader's shuffle semantics and worker/pinning configuration; loaders
+    this can't shard faithfully (IterableDataset, custom batch_sampler)
+    are returned unchanged."""
+    import logging
+
+    import torch.distributed as dist
+    import torch.utils.data as tud
+
+    if not (dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1):
+        return data_loader
+    if isinstance(data_loader.dataset, tud.IterableDataset) or data_loader.batch_size is None:
+        logging.getLogger("ray_tpu.train").warning(
+            "prepare_data_loader: cannot shard an IterableDataset or a "
+            "batch_sampler loader; returning it unsharded"
+        )
+        return data_loader
+    shuffle = isinstance(data_loader.sampler, tud.RandomSampler)
+    sampler = tud.distributed.DistributedSampler(
+        data_loader.dataset, shuffle=shuffle, drop_last=data_loader.drop_last
+    )
+    loader = tud.DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=data_loader.num_workers,
+        collate_fn=data_loader.collate_fn,
+        pin_memory=data_loader.pin_memory,
+        drop_last=data_loader.drop_last,
+        worker_init_fn=data_loader.worker_init_fn,
+        generator=data_loader.generator,
+        persistent_workers=getattr(data_loader, "persistent_workers", False),
+    )
+    return _EpochSteppingLoader(loader, sampler)
+
+
+class TorchTrainer(DataParallelTrainer):
+    """DataParallelTrainer whose workers run inside an initialized gloo
+    process group (reference: TorchTrainer + _TorchBackend.on_start)."""
+
+    def __init__(self, train_loop_per_worker: Callable, **kw):
+        def bootstrap(config: Optional[Dict[str, Any]] = None):
+            import torch.distributed as dist
+
+            from ray_tpu.train.session import _call_train_fn
+
+            inited = init_torch_process_group()
+            try:
+                _call_train_fn(train_loop_per_worker, config)
+            finally:
+                if inited and dist.is_initialized():
+                    dist.destroy_process_group()
+
+        super().__init__(bootstrap, **kw)

@@ -57,15 +57,12 @@ class TrainWorker:
 
     def run_train_fn(self, train_fn: Callable, config: Optional[dict]):
         """Runs the user loop to completion; reports stream via the session."""
-        import inspect
+        from ray_tpu.train.session import _call_train_fn
 
         session = self._session
         assert session is not None, "setup_session must run first"
         try:
-            if len(inspect.signature(train_fn).parameters) >= 1:
-                train_fn(config if config is not None else {})
-            else:
-                train_fn()
+            _call_train_fn(train_fn, config)
         except BaseException as e:  # noqa: BLE001 — surfaced to the driver
             session.error = e
             session.finished.set()
