@@ -85,6 +85,16 @@ class Config:
     task_event_buffer_size: int = 100000
     event_flush_period_s: float = 1.0
 
+    # --- distributed ref counting / object GC ---
+    # Free objects no process references (reference: reference_count.cc
+    # ownership GC). Off → objects live for the session (freed only by
+    # ray_tpu.internal.free or store eviction).
+    object_auto_gc: bool = True
+    # Worker-side batch flush cadence for local-ref zero crossings.
+    ref_flush_interval_ms: int = 200
+    # Controller GC sweep debounce after a ref update arrives.
+    gc_sweep_interval_ms: int = 1000
+
     # --- observability ---
     # App-metric flush cadence (reference: metrics_report_interval_ms).
     metrics_report_interval_ms: int = 2000

@@ -61,7 +61,7 @@ class ActorClass:
             self._digest = hashlib.blake2b(self._blob, digest_size=16).digest()
         opts = self._options
         actor_id = ActorID.from_random()
-        args_blob, deps = core.build_args(args, kwargs)
+        args_blob, deps, captures = core.build_args(args, kwargs)
         res_opts = dict(opts)
         # Explicit resource requests are held while the actor lives; the
         # default 1 CPU is for scheduling only (reference: actor.py).
@@ -134,7 +134,7 @@ class ActorHandle:
         core = _require_worker()
         blob = serialize_function(fn)
         digest = hashlib.blake2b(blob, digest_size=16).digest()
-        args_blob, deps = core.build_args(args, kwargs)
+        args_blob, deps, captures = core.build_args(args, kwargs)
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             task_type=TaskType.ACTOR_TASK,
@@ -149,7 +149,7 @@ class ActorHandle:
             actor_id=self._actor_id,
             actor_method_name=None,
         )
-        return core.submit_actor_task(spec)[0]
+        return core.submit_actor_task(spec, captures)[0]
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id.hex()})"
@@ -185,7 +185,7 @@ class ActorMethod:
 
         core = _require_worker()
         streaming = self._num_returns == "streaming"
-        args_blob, deps = core.build_args(args, kwargs)
+        args_blob, deps, captures = core.build_args(args, kwargs)
         from ray_tpu.util import tracing as _tracing
 
         spec = TaskSpec(
@@ -204,7 +204,7 @@ class ActorMethod:
             actor_method_name=self._name,
             runtime_env=_tracing.inject_runtime_env(None),
         )
-        refs = core.submit_actor_task(spec)
+        refs = core.submit_actor_task(spec, captures)
         if streaming:
             from ray_tpu.core.object_ref import ObjectRefGenerator
 

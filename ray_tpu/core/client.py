@@ -14,7 +14,7 @@ import threading
 from concurrent.futures import Future
 from typing import Any, List, Optional, Sequence
 
-from ray_tpu.core.object_ref import ObjectRef, _RefMarker
+from ray_tpu.core.object_ref import ObjectRef, _RefMarker, _capture, set_ref_tracker
 from ray_tpu.core.object_store import PlasmaClient
 from ray_tpu.core.task_spec import SchedulingStrategy, TaskSpec, TaskType
 from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
@@ -23,6 +23,74 @@ from ray_tpu.utils.ids import NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.utils.serialization import deserialize, serialize
 
 INLINE_LIMIT_FALLBACK = 100 * 1024
+
+
+class RefTracker:
+    """Per-process local ref table (reference: ReferenceCounter's local
+    refs, src/ray/core_worker/reference_count.h:142). Zero-crossings are
+    collected and batch-flushed; ids touched-and-dropped within one flush
+    window still flush as drops so the controller learns the object was
+    once held (transient refs must not leak)."""
+
+    def __init__(self):
+        import collections
+
+        self._lock = threading.Lock()
+        self._counts: dict[bytes, int] = {}
+        self._touched: set[bytes] = set()
+        # dec() is called from ObjectRef.__del__, which the cyclic GC may
+        # run on ANY thread — including one currently inside inc()/drain()
+        # holding the (non-reentrant) lock. dec therefore never locks: it
+        # appends to a thread-safe deque that drain/inc fold in later.
+        self._pending_decs = collections.deque()
+
+    def inc(self, oid):
+        key = oid.binary()
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+            self._touched.add(key)
+
+    def dec(self, oid):
+        self._pending_decs.append(oid.binary())  # lock-free (see __init__)
+
+    def _fold_decs_locked(self):
+        while True:
+            try:
+                key = self._pending_decs.popleft()
+            except IndexError:
+                return
+            n = self._counts.get(key, 0) - 1
+            if n <= 0:
+                self._counts.pop(key, None)
+            else:
+                self._counts[key] = n
+            self._touched.add(key)
+
+    def drain(self) -> tuple[list[bytes], list[bytes]]:
+        """(held, dropped) among ids touched since the last drain."""
+        with self._lock:
+            self._fold_decs_locked()
+            touched, self._touched = self._touched, set()
+            held = [k for k in touched if self._counts.get(k, 0) > 0]
+            dropped = [k for k in touched if self._counts.get(k, 0) <= 0]
+        return held, dropped
+
+    def snapshot(self) -> list[bytes]:
+        with self._lock:
+            self._fold_decs_locked()
+            return [k for k, n in self._counts.items() if n > 0]
+
+
+def _serialize_capturing(value: Any) -> tuple[bytes, list]:
+    """serialize() while recording every ObjectRef pickled into the blob
+    (nested refs → containment pins on the controller)."""
+    token = _capture.set([])
+    try:
+        data = serialize(value)
+        contained = _capture.get()
+    finally:
+        _capture.reset(token)
+    return data, contained
 
 
 class CoreWorker:
@@ -60,6 +128,29 @@ class CoreWorker:
         self.inline_limit = self.config.get("max_inline_object_size", INLINE_LIMIT_FALLBACK)
         self.plasma = PlasmaClient(self.local_shm_dir)
         self._plasma_clients: dict[str, PlasmaClient] = {}
+        # Distributed ref counting: local ref table + periodic flush of
+        # held/dropped transitions to the controller.
+        self.refs = RefTracker()
+        self._refs_closed = threading.Event()
+        self._ref_flush_task = None
+        self._async_errors: list = []
+        set_ref_tracker(self.refs)
+        if self.config.get("object_auto_gc", True):
+            self._ref_flush_task = self.loop_runner.submit(self._ref_flush_loop())
+
+    async def _ref_flush_loop(self):
+        import asyncio
+
+        interval = self.config.get("ref_flush_interval_ms", 200) / 1000.0
+        me = self.worker_id.hex()
+        while not self._refs_closed.is_set():
+            await asyncio.sleep(interval)
+            held, dropped = self.refs.drain()
+            if held or dropped:
+                try:
+                    await self.peer.notify("ref_update", me, held, dropped)
+                except Exception:
+                    return  # connection gone; controller reaps us on disconnect
 
     # ------------------------------------------------------------------
     def _call(self, method: str, *args, timeout: Optional[float] = None, **kwargs):
@@ -73,16 +164,18 @@ class CoreWorker:
     # ------------------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.worker_id, next(self._put_counter))
-        data = serialize(value)
-        self.put_serialized(oid, data)
+        data, contained = _serialize_capturing(value)
+        self.put_serialized(oid, data, contained=contained)
         return ObjectRef(oid)
 
-    def put_serialized(self, oid: ObjectID, data: bytes, is_error: bool = False):
+    def put_serialized(
+        self, oid: ObjectID, data: bytes, is_error: bool = False, contained: Optional[list] = None
+    ):
         if len(data) <= self.inline_limit:
-            self._call("object_put_inline", oid, data, is_error)
+            self._call("object_put_inline", oid, data, is_error, contained or [])
         else:
             self.plasma.put_bytes(oid, data)
-            self._call("object_put_shm", oid, len(data), self.node_id, is_error)
+            self._call("object_put_shm", oid, len(data), self.node_id, is_error, contained or [])
 
     def get(self, refs: Sequence[ObjectRef] | ObjectRef, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -104,6 +197,7 @@ class CoreWorker:
         return fut
 
     def _get_values(self, oids: List[ObjectID], timeout: Optional[float] = None) -> List[Any]:
+        self._check_async_errors()
         resp = self._call("object_get", oids, timeout)
         if resp["timeout"]:
             raise GetTimeoutError(f"get() timed out after {timeout}s")
@@ -159,6 +253,7 @@ class CoreWorker:
         return deserialize(self._read_object(oid, size, node_hex, shm_dir)), is_error
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1, timeout: Optional[float] = None):
+        self._check_async_errors()
         ready_hex = set(self._call("object_wait", [r.id for r in refs], num_returns, timeout))
         ready, not_ready = [], []
         for r in refs:
@@ -171,7 +266,12 @@ class CoreWorker:
     # ------------------------------------------------------------------
     # Tasks
     # ------------------------------------------------------------------
-    def build_args(self, args: tuple, kwargs: dict) -> tuple[bytes, List[ObjectID]]:
+    def build_args(self, args: tuple, kwargs: dict) -> "tuple[bytes, List[ObjectID], list]":
+        """Returns (blob, deps). Top-level refs become _RefMarker deps
+        (resolved before dispatch); refs *nested inside* arg values are
+        captured during serialization and pinned for the task's lifetime
+        via ``last_captures`` (the reference's submitted-task references,
+        reference_count.h UpdateSubmittedTaskReferences)."""
         deps: List[ObjectID] = []
 
         def mark(v):
@@ -182,18 +282,39 @@ class CoreWorker:
 
         margs = tuple(mark(a) for a in args)
         mkwargs = {k: mark(v) for k, v in kwargs.items()}
-        return serialize((margs, mkwargs)), deps
+        blob, contained = _serialize_capturing((margs, mkwargs))
+        return blob, deps, contained
 
-    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
-        self._call("submit_task", spec)
+    # Submission is pipelined: fire-and-forget notify, return refs
+    # immediately (reference: NormalTaskSubmitter queues without blocking
+    # the caller; return ids are deterministic). Submission-side failures
+    # surface on the next sync point via _check_async_errors; task-side
+    # failures surface through the returned refs as usual.
+    def _note_async_error(self, fut):
+        exc = fut.exception() if not fut.cancelled() else None
+        if exc is not None:
+            self._async_errors.append(exc)
+
+    def _check_async_errors(self):
+        if self._async_errors:
+            raise self._async_errors.pop(0)
+
+    def _submit_pipelined(self, spec: TaskSpec, captures: Optional[list]) -> List[ObjectRef]:
+        self._check_async_errors()
+        fut = self.loop_runner.submit(
+            self.peer.notify("submit_task", spec, captures or [])
+        )
+        fut.add_done_callback(self._note_async_error)
         return [ObjectRef(oid) for oid in spec.return_ids()]
+
+    def submit_task(self, spec: TaskSpec, captures: Optional[list] = None) -> List[ObjectRef]:
+        return self._submit_pipelined(spec, captures)
 
     def create_actor(self, spec: TaskSpec):
         self._call("create_actor", spec)
 
-    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
-        self._call("submit_task", spec)
-        return [ObjectRef(oid) for oid in spec.return_ids()]
+    def submit_actor_task(self, spec: TaskSpec, captures: Optional[list] = None) -> List[ObjectRef]:
+        return self._submit_pipelined(spec, captures)
 
     def next_task_id(self) -> TaskID:
         return TaskID.from_random()
@@ -256,6 +377,9 @@ class CoreWorker:
         return self._call(f"list_{what}", **kwargs)
 
     def disconnect(self):
+        self._refs_closed.set()
+        if self._ref_flush_task is not None:
+            self._ref_flush_task.cancel()
         try:
             self.loop_runner.run(self.peer.close(), timeout=2)
         except Exception:

@@ -27,7 +27,7 @@ import signal
 import sys
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Set
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.config import Config, set_config
 from ray_tpu.core.object_store import PlasmaStore
@@ -63,6 +63,18 @@ class ObjectRecord:
     is_error: bool = False
     creating_task: Optional[TaskID] = None
     waiters: List[asyncio.Future] = field(default_factory=list)
+    # Distributed ref counting (reference: reference_count.cc ownership):
+    # processes currently holding >=1 local ref; refs serialized inside
+    # this object (containment pins); whether any process ever held it
+    # (guards against freeing refs still in flight to a first holder).
+    holders: Set[str] = field(default_factory=set)
+    children: List[ObjectID] = field(default_factory=list)
+    ever_held: bool = False
+    # Two-phase GC: a candidate must survive one full sweep interval
+    # after being marked before it is freed — covers the window where a
+    # borrower's "held" flush (<= ref_flush_interval) is still in flight
+    # when the last known holder drops.
+    gc_marked: bool = False
 
     def meta(self, shm_dirs: Dict[NodeID, str]):
         if self.inline is not None:
@@ -117,6 +129,9 @@ class TaskRecord:
     stream_count: int = 0
     stream_done: bool = False
     stream_waiters: List[asyncio.Future] = field(default_factory=list)
+    # Refs nested inside arg values (pinned until the task is terminal —
+    # reference: submitted-task references).
+    captures: List[ObjectID] = field(default_factory=list)
 
 
 @dataclass
@@ -178,6 +193,8 @@ class Controller:
         self._pump_running = False
         self._pump_rerun = False
         self._shutdown = asyncio.Event()
+        self._gc_wanted = asyncio.Event()
+        self._live_pin_tasks: Set[TaskID] = set()
         self.events: List[dict] = []  # task event ring buffer
         self.finished_specs: Dict[TaskID, TaskSpec] = {}  # lineage for reconstruction
         self.metrics: Dict[str, dict] = {}  # aggregated app metrics
@@ -212,6 +229,9 @@ class Controller:
 
     async def on_disconnect(self, peer: rpc.Peer):
         kind = peer.meta.get("kind")
+        holder = peer.meta.get("holder_id")
+        if holder:
+            self._drop_holder(holder)
         if kind == "worker":
             await self._on_worker_death(peer.meta["worker_id"], "connection lost")
         elif kind == "agent":
@@ -310,8 +330,14 @@ class Controller:
     # =================================================================
     # Task submission / scheduling pump
     # =================================================================
-    async def rpc_submit_task(self, peer: rpc.Peer, spec: TaskSpec):
+    async def rpc_submit_task(self, peer: rpc.Peer, spec: TaskSpec, captures: Optional[list] = None):
         rec = TaskRecord(spec=spec, retries_left=spec.max_retries)
+        if captures:
+            rec.captures = [
+                c if isinstance(c, ObjectID) else ObjectID(c) for c in captures
+            ]
+        if spec.dependencies or rec.captures:
+            self._live_pin_tasks.add(spec.task_id)
         self.tasks[spec.task_id] = rec
         for oid in spec.return_ids():
             self._object(oid).creating_task = spec.task_id
@@ -350,6 +376,9 @@ class Controller:
         actor = self.actors.get(spec.actor_id)
         if actor is None or actor.state == "DEAD":
             reason = actor.death_reason if actor else "actor not found"
+            rec = self.tasks.get(spec.task_id)
+            if rec is not None:
+                rec.state = "FAILED"  # terminal → arg pins released
             self._fail_task_objects(spec, ActorDiedError(spec.actor_id.hex(), reason))
             return
         if actor.state != "ALIVE":
@@ -405,11 +434,31 @@ class Controller:
         queue, self.pending_tasks = self.pending_tasks, []
         still_pending: List[TaskID] = []
         spawn_requests: Dict[NodeID, int] = {}
+        # Head-of-line blocking per scheduling class (reference:
+        # SchedulingClass queues in cluster_task_manager.cc): once a task
+        # of a class fails to place, identical later tasks are skipped
+        # without re-running the scheduler — a deep queue of homogeneous
+        # tasks costs O(n) per pump, not O(n × schedule).
+        blocked_classes: Set[Tuple] = set()
+        class_spawn_node: Dict[Tuple, NodeID] = {}
         for tid in queue:
             rec = self.tasks.get(tid)
             if rec is None or rec.state != "PENDING":
                 continue
             spec = rec.spec
+            # Dispatch eligibility is env-affine (idle-worker match keys on
+            # the runtime-env hash), so the block key must include it —
+            # otherwise an env-B task with an idle env-B worker is skipped
+            # because an env-A task of the same class blocked first.
+            ehash = _env_hash(spec.runtime_env)
+            sclass = (spec.scheduling_class(), ehash)
+            if sclass in blocked_classes:
+                still_pending.append(tid)
+                # queued depth still drives worker ramp-up for the class
+                nid = class_spawn_node.get(sclass)
+                if nid is not None:
+                    spawn_requests[nid] = spawn_requests.get(nid, 0) + 1
+                continue
             # 1. dependencies local?
             deps_ready = True
             for dep in spec.dependencies:
@@ -431,9 +480,9 @@ class Controller:
             result = self.scheduler.schedule(spec.resources, spec.scheduling_strategy)
             if result.node_id is None:
                 still_pending.append(tid)
+                blocked_classes.add(sclass)
                 continue
-            # 3. idle worker (env-affine)?
-            ehash = _env_hash(spec.runtime_env)
+            # 3. idle worker (env-affine)? (ehash computed at the top)
             worker = self._idle_worker_on(result.node_id, ehash)
             if worker is None:
                 node = self.nodes[result.node_id]
@@ -444,11 +493,14 @@ class Controller:
                     await self._recycle_idle_worker(node, ehash)
                 spawn_requests[result.node_id] = spawn_requests.get(result.node_id, 0) + 1
                 still_pending.append(tid)
+                blocked_classes.add(sclass)
+                class_spawn_node[sclass] = result.node_id
                 continue
             # 4. acquire resources + dispatch
             node_res = self.cluster.nodes[result.node_id]
             if not node_res.acquire(demand):
                 still_pending.append(tid)
+                blocked_classes.add(sclass)
                 continue
             rec.acquired = demand
             rec.node_id = result.node_id
@@ -557,9 +609,13 @@ class Controller:
                     orec.inline = item[2]
                     orec.size = len(item[2])
                     orec.is_error = bool(item[3]) if len(item) > 3 else False
+                    if len(item) > 4 and item[4]:
+                        orec.children = list(item[4])
                 else:
                     orec.size = item[2]
                     orec.locations.add(node_id)
+                    if len(item) > 3 and item[3]:
+                        orec.children = list(item[3])
                     await self._account_object(node_id, oid, item[2])
                 orec.state = "READY"
                 self._wake(orec)
@@ -760,6 +816,9 @@ class Controller:
                 self.named_actors.pop(actor.name, None)
             err = ActorDiedError(actor_id.hex(), reason)
             for spec in actor.pending_tasks:
+                rec = self.tasks.get(spec.task_id)
+                if rec is not None:
+                    rec.state = "FAILED"
                 self._fail_task_objects(spec, err)
             actor.pending_tasks.clear()
             for fut in actor.ready_waiters:
@@ -824,22 +883,30 @@ class Controller:
     def _shm_dirs(self) -> Dict[NodeID, str]:
         return {nid: n.shm_dir for nid, n in self.nodes.items()}
 
-    async def rpc_object_put_inline(self, peer: rpc.Peer, oid: ObjectID, data: bytes, is_error: bool = False):
+    async def rpc_object_put_inline(
+        self, peer: rpc.Peer, oid: ObjectID, data: bytes, is_error: bool = False,
+        contained: Optional[list] = None,
+    ):
         orec = self._object(oid)
         orec.inline = data
         orec.size = len(data)
         orec.is_error = is_error
+        if contained:
+            orec.children = list(contained)
         orec.state = "READY"
         self._wake(orec)
         return True
 
     async def rpc_object_put_shm(
-        self, peer: rpc.Peer, oid: ObjectID, size: int, node_id: NodeID, is_error: bool = False
+        self, peer: rpc.Peer, oid: ObjectID, size: int, node_id: NodeID, is_error: bool = False,
+        contained: Optional[list] = None,
     ):
         orec = self._object(oid)
         orec.size = size
         orec.is_error = is_error
         orec.locations.add(node_id)
+        if contained:
+            orec.children = list(contained)
         await self._account_object(node_id, oid, size)
         orec.state = "READY"
         self._wake(orec)
@@ -919,18 +986,128 @@ class Controller:
 
     async def rpc_object_free(self, peer: rpc.Peer, oids: List[ObjectID]):
         for oid in oids:
-            orec = self.objects.pop(oid, None)
-            if orec is None:
-                continue
-            for nid in orec.locations:
-                node = self.nodes.get(nid)
-                if node is None:
-                    continue
-                if node.peer is None:
-                    self.head_store.delete(oid)
-                else:
-                    await node.peer.notify("delete_object", oid)
+            await self._free_object(oid)
         return True
+
+    async def _free_object(self, oid: ObjectID):
+        orec = self.objects.pop(oid, None)
+        if orec is None:
+            return
+        # Wake any in-flight long-poll gets as a loss, not a hang.
+        if orec.waiters:
+            orec.state = "FAILED"
+            self._wake(orec)
+        for nid in orec.locations:
+            node = self.nodes.get(nid)
+            if node is None:
+                continue
+            if node.peer is None:
+                self.head_store.delete(oid)
+            else:
+                await node.peer.notify("delete_object", oid)
+
+    # -- distributed ref counting (reference: reference_count.cc; the
+    # controller is the authority the way owners are in the reference) ----
+    async def rpc_ref_update(
+        self, peer: rpc.Peer, holder: str, held: List[bytes], dropped: List[bytes]
+    ):
+        peer.meta.setdefault("holder_id", holder)
+        for key in held:
+            # A held report for an already-freed object is a dangling
+            # borrow — do NOT resurrect a record (a later get would hang
+            # on an empty PENDING entry instead of failing fast).
+            orec = self.objects.get(ObjectID(key))
+            if orec is not None:
+                orec.holders.add(holder)
+                orec.ever_held = True
+                orec.gc_marked = False
+        for key in dropped:
+            orec = self.objects.get(ObjectID(key))
+            if orec is not None:
+                orec.holders.discard(holder)
+                orec.ever_held = True
+        self._gc_wanted.set()
+        return True
+
+    def _drop_holder(self, holder: str):
+        """A process died/disconnected: it no longer holds anything."""
+        touched = False
+        for orec in self.objects.values():
+            if holder in orec.holders:
+                orec.holders.discard(holder)
+                touched = True
+        if touched:
+            self._gc_wanted.set()
+
+    def _pinned_objects(self) -> Set[ObjectID]:
+        """Objects that must survive regardless of holders: args of live
+        tasks (deps + nested captures) and children contained in any live
+        object (the borrowing protocol's containment edges).
+
+        ``_live_pin_tasks`` is pruned lazily here so a sweep costs
+        O(live tasks + terminal-since-last-sweep), not O(all tasks ever)
+        — self.tasks grows monotonically (1M+ in the queueing bench)."""
+        pinned: Set[ObjectID] = set()
+        dead: List[TaskID] = []
+        for tid in self._live_pin_tasks:
+            rec = self.tasks.get(tid)
+            if rec is None or rec.state in ("FINISHED", "FAILED"):
+                dead.append(tid)
+                continue
+            pinned.update(rec.spec.dependencies)
+            pinned.update(rec.captures)
+        self._live_pin_tasks.difference_update(dead)
+        for orec in self.objects.values():
+            pinned.update(orec.children)
+        return pinned
+
+    async def _gc_sweep_loop(self):
+        interval = self.config.gc_sweep_interval_ms / 1000.0
+        while not self._shutdown.is_set():
+            try:
+                await asyncio.wait_for(self._gc_wanted.wait(), timeout=30.0)
+            except asyncio.TimeoutError:
+                continue
+            await asyncio.sleep(interval)  # batch a window of updates
+            self._gc_wanted.clear()
+            try:
+                freed = await self._gc_sweep()
+            except Exception:
+                logger.exception("gc sweep failed")
+                continue
+            if freed:
+                # Freeing a container unpins its children — cascade until
+                # a sweep frees nothing.
+                self._gc_wanted.set()
+
+    async def _gc_sweep(self) -> int:
+        candidates = [
+            orec
+            for orec in self.objects.values()
+            if orec.ever_held and not orec.holders and orec.state != "PENDING"
+        ]
+        if not candidates:
+            return 0
+        pinned = self._pinned_objects()
+        freed = marked = 0
+        for orec in candidates:
+            if orec.oid in pinned:
+                orec.gc_marked = False
+                continue
+            if not orec.gc_marked:
+                # phase 1: mark; freed only if still unreferenced at the
+                # next sweep (in-flight borrow flushes get a full interval
+                # to land and clear the mark)
+                orec.gc_marked = True
+                marked += 1
+                continue
+            await self._free_object(orec.oid)
+            freed += 1
+        if marked:
+            self._gc_wanted.set()  # guarantee a follow-up sweep
+        if freed:
+            logger.debug("gc: freed %d unreferenced objects", freed)
+        return freed
 
     async def rpc_object_sealed(self, peer: rpc.Peer, oid: ObjectID, size: int, node_id: NodeID):
         await self._account_object(node_id, oid, size)
@@ -1441,6 +1618,10 @@ class Controller:
             # unreferenced monitor could be garbage-collected mid-run.
             self._monitor_task = asyncio.get_running_loop().create_task(
                 self._memory_monitor_loop()
+            )
+        if self.config.object_auto_gc:
+            self._gc_task = asyncio.get_running_loop().create_task(
+                self._gc_sweep_loop()
             )
         if self.config.dashboard_port >= 0:
             from ray_tpu.core.http_gateway import start_http_gateway

@@ -1,23 +1,54 @@
 """ObjectRef: the distributed future handed back by task submission / put.
 
 Reference: python/ray/includes/object_ref.pxi + ownership in
-src/ray/core_worker/reference_count.cc. v0 keeps session-lifetime objects
-(no distributed refcounting yet); refs are plain ids that bind to whatever
-worker context deserializes them — exactly how the reference's refs rebind
-on deserialization in a borrowing worker.
+src/ray/core_worker/reference_count.cc. Distributed ref counting: every
+ObjectRef construction/destruction in a worker process updates a local
+ref table (the reference's AddLocalReference/RemoveLocalReference,
+reference_count.h:142); deserializing a ref in another process registers
+that process as a *borrower* the same way — the zero-crossings are
+batch-flushed to the controller, which frees objects nobody references
+(see controller._gc_sweep).
 """
 from __future__ import annotations
 
+import contextvars
 from typing import Optional
 
 from ray_tpu.utils.ids import ObjectID
 
+# Process-global local-ref tracker, installed by CoreWorker on connect
+# (None inside the controller and before init).
+_tracker = None
+
+# Active capture list: while serializing a value, every ObjectRef pickled
+# into it records its id here — how nested/contained refs become pins
+# (reference: the borrowing protocol's "contained in owned object" edges).
+_capture: contextvars.ContextVar[Optional[list]] = contextvars.ContextVar(
+    "ray_tpu_ref_capture", default=None
+)
+
+
+def set_ref_tracker(tracker) -> None:
+    global _tracker
+    _tracker = tracker
+
 
 class ObjectRef:
-    __slots__ = ("id",)
+    __slots__ = ("id", "__weakref__")
 
     def __init__(self, oid: ObjectID):
         self.id = oid
+        t = _tracker
+        if t is not None:
+            t.inc(oid)
+
+    def __del__(self):
+        t = _tracker
+        if t is not None:
+            try:
+                t.dec(self.id)
+            except Exception:  # interpreter teardown
+                pass
 
     def hex(self) -> str:
         return self.id.hex()
@@ -35,6 +66,9 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()})"
 
     def __reduce__(self):
+        lst = _capture.get()
+        if lst is not None:
+            lst.append(self.id)
         return (ObjectRef, (self.id,))
 
     def future(self):

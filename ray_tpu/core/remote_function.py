@@ -93,7 +93,7 @@ class RemoteFunction:
         self._ensure_exported()
         opts = self._options
         streaming = opts["num_returns"] == "streaming"
-        args_blob, deps = core.build_args(args, kwargs)
+        args_blob, deps, captures = core.build_args(args, kwargs)
         # Trace-context propagation (reference: tracing_helper.py:88 —
         # context rides in task metadata when tracing is on).
         from ray_tpu.util import tracing as _tracing
@@ -115,7 +115,7 @@ class RemoteFunction:
             retry_exceptions=bool(opts["retry_exceptions"]),
             runtime_env=runtime_env,
         )
-        refs = core.submit_task(spec)
+        refs = core.submit_task(spec, captures)
         if streaming:
             from ray_tpu.core.object_ref import ObjectRefGenerator
 

@@ -214,13 +214,19 @@ class TaskExecutor:
                             f"task {spec.name} returned {len(values)} values, "
                             f"expected num_returns={spec.num_returns}"
                         )
+                from ray_tpu.core.client import _serialize_capturing
+
                 for oid, value in zip(spec.return_ids(), values):
-                    data = serialize(value)
+                    # Refs nested in a return value are pinned by the
+                    # return object (containment) until it is freed —
+                    # otherwise the worker's own ref drop could GC a
+                    # ray_tpu.put() object before the caller ever sees it.
+                    data, contained = _serialize_capturing(value)
                     if len(data) <= self.core.inline_limit:
-                        results.append((oid, "inline", data, False))
+                        results.append((oid, "inline", data, False, contained))
                     else:
                         self.core.plasma.put_bytes(oid, data)
-                        results.append((oid, "shm", len(data)))
+                        results.append((oid, "shm", len(data), contained))
             except Exception:  # noqa: BLE001 — unpicklable results must not hang the caller
                 results = []
                 error = TaskError(spec.name, traceback.format_exc(), None)
@@ -235,12 +241,15 @@ class TaskExecutor:
         generator execution, _raylet.pyx:1077)."""
         from ray_tpu.utils.ids import ObjectID
 
+        from ray_tpu.core.client import _serialize_capturing
+
         index = 0
         error = None
         try:
             for item in result:
                 oid = ObjectID.for_task_return(spec.task_id, index)
-                self.core.put_serialized(oid, serialize(item))
+                data, contained = _serialize_capturing(item)
+                self.core.put_serialized(oid, data, contained=contained)
                 self.core._call("stream_item", spec.task_id, index)
                 index += 1
         except Exception as e:  # noqa: BLE001 — mid-stream error → final item
