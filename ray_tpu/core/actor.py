@@ -100,7 +100,7 @@ class ActorClass:
             hold_resources_while_alive=hold,
             lifetime=opts.get("lifetime"),
         )
-        core.create_actor(spec)
+        core.create_actor(spec, captures)
         return ActorHandle(actor_id, max_task_retries=opts["max_task_retries"])
 
     def bind(self, *args, **kwargs):

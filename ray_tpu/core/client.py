@@ -75,22 +75,26 @@ class RefTracker:
             dropped = [k for k in touched if self._counts.get(k, 0) <= 0]
         return held, dropped
 
-    def snapshot(self) -> list[bytes]:
-        with self._lock:
-            self._fold_decs_locked()
-            return [k for k, n in self._counts.items() if n > 0]
+def _serialize_parts_capturing(value: Any):
+    """serialize_parts() + captured nested refs — the zero-extra-copy path
+    for large puts/returns (nested refs → containment pins)."""
+    from ray_tpu.utils.serialization import serialize_parts
 
-
-def _serialize_capturing(value: Any) -> tuple[bytes, list]:
-    """serialize() while recording every ObjectRef pickled into the blob
-    (nested refs → containment pins on the controller)."""
     token = _capture.set([])
     try:
-        data = serialize(value)
+        meta, raws, total = serialize_parts(value)
         contained = _capture.get()
     finally:
         _capture.reset(token)
-    return data, contained
+    return meta, raws, total, contained
+
+
+def _serialize_capturing(value: Any) -> tuple[bytes, list]:
+    """Contiguous-blob variant of :func:`_serialize_parts_capturing`."""
+    from ray_tpu.utils.serialization import assemble_parts
+
+    meta, raws, _, contained = _serialize_parts_capturing(value)
+    return assemble_parts(meta, raws), contained
 
 
 class CoreWorker:
@@ -163,9 +167,18 @@ class CoreWorker:
     # Objects
     # ------------------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
+        from ray_tpu.utils.serialization import assemble_parts
+
         oid = ObjectID.for_put(self.worker_id, next(self._put_counter))
-        data, contained = _serialize_capturing(value)
-        self.put_serialized(oid, data, contained=contained)
+        meta, raws, total, contained = _serialize_parts_capturing(value)
+        if total <= self.inline_limit:
+            self._call(
+                "object_put_inline", oid, assemble_parts(meta, raws), False, contained or []
+            )
+        else:
+            # Single copy: parts go straight into the shm mapping.
+            self.plasma.put_parts(oid, meta, raws, total)
+            self._call("object_put_shm", oid, total, self.node_id, False, contained or [])
         return ObjectRef(oid)
 
     def put_serialized(
@@ -310,8 +323,8 @@ class CoreWorker:
     def submit_task(self, spec: TaskSpec, captures: Optional[list] = None) -> List[ObjectRef]:
         return self._submit_pipelined(spec, captures)
 
-    def create_actor(self, spec: TaskSpec):
-        self._call("create_actor", spec)
+    def create_actor(self, spec: TaskSpec, captures: Optional[list] = None):
+        self._call("create_actor", spec, captures or [])
 
     def submit_actor_task(self, spec: TaskSpec, captures: Optional[list] = None) -> List[ObjectRef]:
         return self._submit_pipelined(spec, captures)

@@ -195,6 +195,15 @@ class Controller:
         self._shutdown = asyncio.Event()
         self._gc_wanted = asyncio.Event()
         self._live_pin_tasks: Set[TaskID] = set()
+        # Recently-freed object ids (bounded): a get/wait/dep-check on a
+        # freed object fails fast instead of hanging on a resurrected
+        # empty PENDING record.
+        import collections as _collections
+
+        self._freed_lru: "_collections.OrderedDict[ObjectID, None]" = (
+            _collections.OrderedDict()
+        )
+        self._holder_index: Dict[str, Set[ObjectID]] = {}
         self.events: List[dict] = []  # task event ring buffer
         self.finished_specs: Dict[TaskID, TaskSpec] = {}  # lineage for reconstruction
         self.metrics: Dict[str, dict] = {}  # aggregated app metrics
@@ -331,25 +340,37 @@ class Controller:
     # Task submission / scheduling pump
     # =================================================================
     async def rpc_submit_task(self, peer: rpc.Peer, spec: TaskSpec, captures: Optional[list] = None):
-        rec = TaskRecord(spec=spec, retries_left=spec.max_retries)
-        if captures:
-            rec.captures = [
-                c if isinstance(c, ObjectID) else ObjectID(c) for c in captures
-            ]
-        if spec.dependencies or rec.captures:
-            self._live_pin_tasks.add(spec.task_id)
-        self.tasks[spec.task_id] = rec
-        for oid in spec.return_ids():
-            self._object(oid).creating_task = spec.task_id
-        if spec.task_type == TaskType.ACTOR_TASK:
-            await self._submit_actor_task(spec)
-        else:
-            self.pending_tasks.append(spec.task_id)
-            self._event("task", spec, "PENDING_SCHEDULING")
-            self._schedule_pump()
+        # Submission is a fire-and-forget notify (pipelined client): an
+        # exception here would only be logged, leaving the return objects
+        # PENDING forever — so any failure becomes the objects' error.
+        try:
+            rec = TaskRecord(spec=spec, retries_left=spec.max_retries)
+            if captures:
+                rec.captures = [
+                    c if isinstance(c, ObjectID) else ObjectID(c) for c in captures
+                ]
+            if spec.dependencies or rec.captures:
+                self._live_pin_tasks.add(spec.task_id)
+            self.tasks[spec.task_id] = rec
+            for oid in spec.return_ids():
+                self._object(oid).creating_task = spec.task_id
+            if spec.task_type == TaskType.ACTOR_TASK:
+                await self._submit_actor_task(spec)
+            else:
+                self.pending_tasks.append(spec.task_id)
+                self._event("task", spec, "PENDING_SCHEDULING")
+                self._schedule_pump()
+        except Exception as e:  # noqa: BLE001 — surfaced through the refs
+            logger.exception("submit_task failed for %s", spec.task_id.hex())
+            rec = self.tasks.get(spec.task_id)
+            if rec is not None:
+                rec.state = "FAILED"
+            self._fail_task_objects(spec, e)
         return True
 
-    async def rpc_create_actor(self, peer: rpc.Peer, spec: TaskSpec, _journal: bool = True):
+    async def rpc_create_actor(
+        self, peer: rpc.Peer, spec: TaskSpec, captures: Optional[list] = None, _journal: bool = True
+    ):
         actor = ActorRecord(
             actor_id=spec.actor_id,
             creation_spec=spec,
@@ -366,6 +387,13 @@ class Controller:
         if _journal and spec.lifetime == "detached":
             self.journal.actor_register(spec)
         rec = TaskRecord(spec=spec, retries_left=0)
+        if captures:
+            rec.captures = [
+                c if isinstance(c, ObjectID) else ObjectID(c) for c in captures
+            ]
+        if spec.dependencies or rec.captures:
+            # creation args are pinned until the creation task is terminal
+            self._live_pin_tasks.add(spec.task_id)
         self.tasks[spec.task_id] = rec
         self.pending_tasks.append(spec.task_id)
         self._event("actor", spec, "PENDING_CREATION")
@@ -462,6 +490,13 @@ class Controller:
             # 1. dependencies local?
             deps_ready = True
             for dep in spec.dependencies:
+                if dep not in self.objects and dep in self._freed_lru:
+                    self._fail_task_objects(
+                        spec, ObjectLostError(dep.hex(), "dependency was freed")
+                    )
+                    rec.state = "FAILED"
+                    deps_ready = False
+                    break
                 orec = self._object(dep)
                 if orec.state == "FAILED":
                     self._fail_task_objects(spec, ObjectLostError(dep.hex(), "dependency failed"))
@@ -857,9 +892,24 @@ class Controller:
             orec.inline = None
             self._wake(orec)
             return
+        # GC may have freed an input after the task finished — lineage is
+        # then evicted and reconstruction must fail fast, not hang on an
+        # empty recreated dep record (reference:
+        # ReconstructionFailedLineageEvictedError, exceptions.py:663-705).
+        for dep in spec.dependencies:
+            dep_rec = self.objects.get(dep)
+            if dep_rec is None or (
+                dep_rec.state != "READY" and dep_rec.creating_task is None
+            ):
+                orec.state = "FAILED"
+                orec.inline = None
+                self._wake(orec)
+                return
         orec.state = "PENDING"
         rec = TaskRecord(spec=spec, retries_left=0)
         self.tasks[spec.task_id] = rec
+        if spec.dependencies:
+            self._live_pin_tasks.add(spec.task_id)
         self.pending_tasks.append(spec.task_id)
         self._event("task", spec, "RECONSTRUCTING")
         self._schedule_pump()
@@ -939,6 +989,9 @@ class Controller:
         deadline = None if timeout is None else time.monotonic() + timeout
         metas = {}
         for oid in oids:
+            if oid not in self.objects and oid in self._freed_lru:
+                metas[oid.hex()] = ("lost", None, True)
+                continue
             orec = self._object(oid)
             while orec.state == "PENDING":
                 fut = asyncio.get_running_loop().create_future()
@@ -959,8 +1012,14 @@ class Controller:
     async def rpc_object_wait(self, peer: rpc.Peer, oids: List[ObjectID], num_returns: int, timeout: Optional[float]):
         """ray.wait semantics: return when num_returns of oids are ready."""
         deadline = None if timeout is None else time.monotonic() + timeout
+
+        def _resolved(o: ObjectID) -> bool:
+            if o not in self.objects and o in self._freed_lru:
+                return True  # freed → resolved (get will fail fast)
+            return self._object(o).state != "PENDING"
+
         while True:
-            ready = [o for o in oids if self._object(o).state != "PENDING"]
+            ready = [o for o in oids if _resolved(o)]
             if len(ready) >= num_returns:
                 return [o.hex() for o in ready]
             remain = None if deadline is None else deadline - time.monotonic()
@@ -968,6 +1027,8 @@ class Controller:
                 return [o.hex() for o in ready]
             futs = []
             for o in oids:
+                if o not in self.objects and o in self._freed_lru:
+                    continue
                 orec = self._object(o)
                 if orec.state == "PENDING":
                     fut = asyncio.get_running_loop().create_future()
@@ -976,7 +1037,7 @@ class Controller:
             if not futs:
                 # Everything resolved but fewer than num_returns exist —
                 # nothing more can become ready.
-                return [o.hex() for o in oids if self._object(o).state != "PENDING"]
+                return [o.hex() for o in oids if _resolved(o)]
             try:
                 await asyncio.wait_for(
                     asyncio.wait(futs, return_when=asyncio.FIRST_COMPLETED), remain
@@ -993,6 +1054,9 @@ class Controller:
         orec = self.objects.pop(oid, None)
         if orec is None:
             return
+        self._freed_lru[oid] = None
+        while len(self._freed_lru) > 200_000:
+            self._freed_lru.popitem(last=False)
         # Wake any in-flight long-poll gets as a loss, not a hang.
         if orec.waiters:
             orec.state = "FAILED"
@@ -1012,17 +1076,22 @@ class Controller:
         self, peer: rpc.Peer, holder: str, held: List[bytes], dropped: List[bytes]
     ):
         peer.meta.setdefault("holder_id", holder)
+        index = self._holder_index.setdefault(holder, set())
         for key in held:
             # A held report for an already-freed object is a dangling
             # borrow — do NOT resurrect a record (a later get would hang
             # on an empty PENDING entry instead of failing fast).
-            orec = self.objects.get(ObjectID(key))
+            oid = ObjectID(key)
+            orec = self.objects.get(oid)
             if orec is not None:
                 orec.holders.add(holder)
                 orec.ever_held = True
                 orec.gc_marked = False
+                index.add(oid)
         for key in dropped:
-            orec = self.objects.get(ObjectID(key))
+            oid = ObjectID(key)
+            index.discard(oid)
+            orec = self.objects.get(oid)
             if orec is not None:
                 orec.holders.discard(holder)
                 orec.ever_held = True
@@ -1030,14 +1099,16 @@ class Controller:
         return True
 
     def _drop_holder(self, holder: str):
-        """A process died/disconnected: it no longer holds anything."""
-        touched = False
-        for orec in self.objects.values():
-            if holder in orec.holders:
+        """A process died/disconnected: it no longer holds anything.
+        O(objects that process held), via the reverse index."""
+        held = self._holder_index.pop(holder, None)
+        if not held:
+            return
+        for oid in held:
+            orec = self.objects.get(oid)
+            if orec is not None:
                 orec.holders.discard(holder)
-                touched = True
-        if touched:
-            self._gc_wanted.set()
+        self._gc_wanted.set()
 
     def _pinned_objects(self) -> Set[ObjectID]:
         """Objects that must survive regardless of holders: args of live
@@ -1057,6 +1128,18 @@ class Controller:
             pinned.update(rec.spec.dependencies)
             pinned.update(rec.captures)
         self._live_pin_tasks.difference_update(dead)
+        # Actor creation args stay pinned while a restart could re-run
+        # __init__ (reference: restarts re-execute the creation task).
+        for actor in self.actors.values():
+            if actor.state == "DEAD":
+                continue
+            if actor.state == "ALIVE" and actor.restarts_left <= 0:
+                continue
+            spec = actor.creation_spec
+            pinned.update(spec.dependencies)
+            rec = self.tasks.get(spec.task_id)
+            if rec is not None:
+                pinned.update(rec.captures)
         for orec in self.objects.values():
             pinned.update(orec.children)
         return pinned

@@ -341,6 +341,32 @@ class PlasmaClient:
     def _path(self, oid: ObjectID) -> str:
         return os.path.join(self.shm_dir, oid.hex())
 
+    def put_parts(self, oid: ObjectID, meta: bytes, raws: list, total: int) -> int:
+        """Single-copy put: serialize-parts are written straight into the
+        object's mapping (no intermediate contiguous blob)."""
+        from ray_tpu.utils.serialization import write_parts
+
+        arena = self._get_arena()
+        if arena is not None:
+            try:
+                buf = arena.create_object(oid.binary(), total)
+            except FileExistsError:
+                return total
+            if buf is not None:
+                write_parts(buf.view(), meta, raws)
+                buf.close()
+                arena.seal(oid.binary())
+                return total
+        path = self._path(oid)
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+        try:
+            os.ftruncate(fd, total)
+            with mmap.mmap(fd, total, access=mmap.ACCESS_WRITE) as mm:
+                write_parts(memoryview(mm), meta, raws)
+        finally:
+            os.close(fd)
+        return total
+
     def put_bytes(self, oid: ObjectID, data: bytes | memoryview) -> int:
         # Writes directly into the node's arena; the node agent is told of
         # the new object afterwards (seal notification) and does accounting.

@@ -214,19 +214,22 @@ class TaskExecutor:
                             f"task {spec.name} returned {len(values)} values, "
                             f"expected num_returns={spec.num_returns}"
                         )
-                from ray_tpu.core.client import _serialize_capturing
+                from ray_tpu.core.client import _serialize_parts_capturing
+                from ray_tpu.utils.serialization import assemble_parts
 
                 for oid, value in zip(spec.return_ids(), values):
                     # Refs nested in a return value are pinned by the
                     # return object (containment) until it is freed —
                     # otherwise the worker's own ref drop could GC a
                     # ray_tpu.put() object before the caller ever sees it.
-                    data, contained = _serialize_capturing(value)
-                    if len(data) <= self.core.inline_limit:
-                        results.append((oid, "inline", data, False, contained))
+                    meta, raws, total, contained = _serialize_parts_capturing(value)
+                    if total <= self.core.inline_limit:
+                        results.append(
+                            (oid, "inline", assemble_parts(meta, raws), False, contained)
+                        )
                     else:
-                        self.core.plasma.put_bytes(oid, data)
-                        results.append((oid, "shm", len(data), contained))
+                        self.core.plasma.put_parts(oid, meta, raws, total)
+                        results.append((oid, "shm", total, contained))
             except Exception:  # noqa: BLE001 — unpicklable results must not hang the caller
                 results = []
                 error = TaskError(spec.name, traceback.format_exc(), None)

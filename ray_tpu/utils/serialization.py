@@ -33,8 +33,11 @@ def _dumps(obj: Any, buffer_callback=None) -> bytes:
     return pickle.dumps(obj, protocol=_PROTOCOL, buffer_callback=buffer_callback)
 
 
-def serialize(obj: Any) -> bytes:
-    """Serialize to a single contiguous byte string (with OOB buffers packed)."""
+def serialize_parts(obj: Any) -> "tuple[bytes, list, int]":
+    """(meta, raw out-of-band buffers, total wire size) WITHOUT assembling
+    a contiguous blob — large puts write the parts straight into the
+    shared-memory mapping (one copy instead of two; the reference's plasma
+    put serializes directly into the store buffer the same way)."""
     buffers: list[pickle.PickleBuffer] = []
 
     def cb(buf: pickle.PickleBuffer):
@@ -46,12 +49,36 @@ def serialize(obj: Any) -> bytes:
     payload = _dumps(obj, buffer_callback=cb)
     raws = [b.raw() for b in buffers]
     meta = pickle.dumps((payload, [r.nbytes for r in raws]), protocol=_PROTOCOL)
+    total = 4 + len(meta) + sum(r.nbytes for r in raws)
+    return meta, raws, total
+
+
+def write_parts(view: memoryview, meta: bytes, raws: list) -> None:
+    """Lay out the wire format into a writable buffer (same layout
+    ``deserialize`` reads)."""
+    view[:4] = struct.pack("<I", len(meta))
+    off = 4
+    view[off : off + len(meta)] = meta
+    off += len(meta)
+    for r in raws:  # PickleBuffer.raw() views are always flat bytes
+        n = r.nbytes
+        view[off : off + n] = r
+        off += n
+
+
+def assemble_parts(meta: bytes, raws: list) -> bytes:
     out = io.BytesIO()
     out.write(struct.pack("<I", len(meta)))
     out.write(meta)
     for r in raws:
         out.write(r)
     return out.getvalue()
+
+
+def serialize(obj: Any) -> bytes:
+    """Serialize to a single contiguous byte string (with OOB buffers packed)."""
+    meta, raws, _ = serialize_parts(obj)
+    return assemble_parts(meta, raws)
 
 
 def deserialize(data: bytes | memoryview) -> Any:

@@ -148,3 +148,54 @@ def test_auto_gc_can_be_disabled():
         assert _object_listed(hex_id), "object freed despite auto_gc off"
     finally:
         ray_tpu.shutdown()
+
+
+def test_actor_creation_args_pinned(ray_start_regular):
+    """Creation args must survive GC while the actor can (re)start —
+    restarts re-run __init__ with the same args."""
+
+    @ray_tpu.remote
+    class A:
+        def __init__(self, arr, boxed):
+            self.v = int(arr[0]) + int(ray_tpu.get(boxed[0])[0])
+
+        def read(self):
+            return self.v
+
+    top = ray_tpu.put(np.full(BIG, 2, np.uint8))
+    nested = ray_tpu.put(np.full(BIG, 3, np.uint8))
+    a = A.options(max_restarts=1).remote(top, [nested])
+    del top, nested
+    gc.collect()
+    time.sleep(2.5)  # flush + sweep cycles while creation may be pending
+    assert ray_tpu.get(a.read.remote()) == 5
+    ray_tpu.kill(a)
+
+
+def test_get_freed_object_fails_fast(ray_start_regular):
+    from ray_tpu.core.api import free
+    from ray_tpu.exceptions import ObjectLostError
+    import copy
+
+    ref = ray_tpu.put(np.zeros(BIG, np.uint8))
+    clone = copy.copy(ref)  # second local ref to the same oid
+    free([ref])
+    with pytest.raises(ObjectLostError):
+        ray_tpu.get(clone, timeout=10)
+
+
+def test_task_on_freed_dep_fails_fast(ray_start_regular):
+    from ray_tpu.core.api import free
+    from ray_tpu.exceptions import ObjectLostError
+    import copy
+
+    ref = ray_tpu.put(np.zeros(BIG, np.uint8))
+    clone = copy.copy(ref)
+    free([ref])
+
+    @ray_tpu.remote
+    def consume(x):
+        return x.shape
+
+    with pytest.raises(ObjectLostError):
+        ray_tpu.get(consume.remote(clone), timeout=15)
