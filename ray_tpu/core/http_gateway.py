@@ -11,6 +11,13 @@ Routes:
                             events|placement_groups|cluster_resources|
                             available_resources
   GET /healthz              liveness probe
+  Job submission REST (reference: dashboard/modules/job/job_head.py):
+  POST /api/jobs/           {entrypoint, submission_id?, runtime_env?,
+                            metadata?} → {submission_id}
+  GET  /api/jobs/           list job infos
+  GET  /api/jobs/<id>       job info
+  GET  /api/jobs/<id>/logs  {logs}
+  POST /api/jobs/<id>/stop  {stopped}
 """
 from __future__ import annotations
 
@@ -37,6 +44,21 @@ def start_http_gateway(controller, loop: asyncio.AbstractEventLoop, port: int) -
         coro = getattr(controller, method_name)(None)
         return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=10)
 
+    job_lock = threading.Lock()
+
+    def job_manager():
+        """Controller-hosted JobManager (reference: the JobManager lives in
+        the dashboard head process). Thread-safe lazy init; the manager
+        itself only runs subprocesses + threads, independent of the loop."""
+        with job_lock:
+            if getattr(controller, "_job_manager", None) is None:
+                from ray_tpu.job.manager import JobManager
+
+                controller._job_manager = JobManager(
+                    controller.session_dir, f"127.0.0.1:{controller.port}"
+                )
+            return controller._job_manager
+
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):  # quiet
             pass
@@ -48,11 +70,54 @@ def start_http_gateway(controller, loop: asyncio.AbstractEventLoop, port: int) -
             self.end_headers()
             self.wfile.write(body)
 
+        def _json(self, obj, code: int = 200):
+            self._send(code, json.dumps(obj, default=str).encode(), "application/json")
+
+        def do_POST(self):
+            try:
+                path = self.path.split("?")[0].rstrip("/")
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length) or b"{}") if length else {}
+                if path == "/api/jobs":
+                    job_id = job_manager().submit(
+                        body["entrypoint"],
+                        body.get("submission_id"),
+                        body.get("runtime_env"),
+                        body.get("metadata"),
+                    )
+                    self._json({"submission_id": job_id})
+                elif path.startswith("/api/jobs/") and path.endswith("/stop"):
+                    job_id = path[len("/api/jobs/") : -len("/stop")]
+                    try:
+                        self._json({"stopped": job_manager().stop(job_id)})
+                    except (KeyError, ValueError):
+                        self._json({"error": f"no job {job_id}"}, 404)
+                else:
+                    self._json({"error": "not found"}, 404)
+            except KeyError as e:
+                self._json({"error": f"missing field {e}"}, 400)
+            except Exception as e:  # noqa: BLE001 — HTTP surface must not crash
+                self._json({"error": str(e)}, 500)
+
         def do_GET(self):
             try:
                 path = self.path.split("?")[0].rstrip("/")
                 if path == "/healthz":
                     self._send(200, b"ok", "text/plain")
+                elif path == "/api/jobs":
+                    self._json(job_manager().list_jobs())
+                elif path.startswith("/api/jobs/") and path.endswith("/logs"):
+                    job_id = path[len("/api/jobs/") : -len("/logs")]
+                    try:
+                        self._json({"logs": job_manager().get_logs(job_id)})
+                    except (KeyError, ValueError):
+                        self._json({"error": f"no job {job_id}"}, 404)
+                elif path.startswith("/api/jobs/"):
+                    job_id = path[len("/api/jobs/") :]
+                    try:
+                        self._json(job_manager().get_info(job_id))
+                    except (KeyError, ValueError):
+                        self._json({"error": f"no job {job_id}"}, 404)
                 elif path == "/metrics":
                     from ray_tpu.util.metrics import prometheus_text
 

@@ -2,7 +2,8 @@
 
 Reference: python/ray/dashboard/modules/job/ — ``JobHead`` REST +
 ``JobManager`` (job_manager.py:58) + per-job ``JobSupervisor`` actor that
-subprocesses the entrypoint. Rebuild: a named ``JobManager`` actor owns job
+subprocesses the entrypoint. Rebuild: a controller-hosted ``JobManager``
+behind the dashboard gateway's REST ``/api/jobs`` routes owns job
 records and spawns one supervisor thread per job that Popens the entrypoint
 with ``RAY_TPU_ADDRESS`` injected (so the script's ``init(address="auto")``
 joins this cluster); logs stream to per-job files in the session dir.

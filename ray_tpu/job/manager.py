@@ -1,21 +1,19 @@
-"""JobManager actor + JobSubmissionClient.
+"""JobManager + REST JobSubmissionClient.
 
 Reference: python/ray/dashboard/modules/job/job_manager.py:58 (JobManager),
-job_head.py:143 (REST head), common.py (JobStatus/JobInfo).
+job_head.py:143 (REST head), common.py (JobStatus/JobInfo). Exactly the
+reference shape: the manager lives in the head process (our controller),
+the client speaks REST to the dashboard gateway (/api/jobs), and each job
+runs as a supervised driver subprocess.
 """
 from __future__ import annotations
 
 import os
 import subprocess
-import sys
 import threading
 import time
 import uuid
 from typing import Dict, List, Optional
-
-import ray_tpu
-
-JOB_MANAGER_NAME = "__job_manager__"
 
 
 class JobStatus:
@@ -28,7 +26,6 @@ class JobStatus:
     TERMINAL = {SUCCEEDED, FAILED, STOPPED}
 
 
-@ray_tpu.remote
 class JobManager:
     def __init__(self, session_dir: str, address: str):
         self._session_dir = session_dir
@@ -94,6 +91,14 @@ class JobManager:
                 info["end_time"] = time.time()
             return
         with self._lock:
+            if info["status"] == JobStatus.STOPPED:
+                # stop() won the race during the Popen window: the stop
+                # verdict stands — kill what we just launched.
+                try:
+                    os.killpg(os.getpgid(proc.pid), 15)
+                except ProcessLookupError:
+                    pass
+                return
             info["status"] = JobStatus.RUNNING
             info["start_time"] = time.time()
             self._procs[job_id] = proc
@@ -150,20 +155,43 @@ class JobManager:
 
 
 class JobSubmissionClient:
-    """Driver-side client (reference: python/ray/job_submission/
-    JobSubmissionClient — REST there, named-actor RPC here)."""
+    """REST client against the dashboard gateway's /api/jobs routes
+    (reference: python/ray/job_submission/JobSubmissionClient →
+    dashboard/modules/job/job_head.py REST endpoints)."""
 
-    def __init__(self):
-        from ray_tpu.core.api import _require_worker
+    def __init__(self, address: Optional[str] = None):
+        if address is None:
+            address = os.environ.get("RAY_TPU_DASHBOARD_ADDR")
+        if address is None:
+            from ray_tpu.util.state import dashboard_url
 
-        core = _require_worker()
+            address = dashboard_url()
+            if address is None:
+                raise RuntimeError(
+                    "job submission needs the dashboard HTTP gateway, but it "
+                    "is disabled (config.dashboard_port < 0); re-init with it "
+                    "enabled or pass an explicit address"
+                )
+        self._base = address.rstrip("/")
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        data = _json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self._base + path,
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
         try:
-            self._mgr = ray_tpu.get_actor(JOB_MANAGER_NAME)
-        except ValueError:
-            self._mgr = JobManager.options(name=JOB_MANAGER_NAME, num_cpus=0).remote(
-                core.session_dir, core.address
-            )
-            ray_tpu.wait_actor_ready(self._mgr)
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return _json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise RuntimeError(f"job API {method} {path} failed ({e.code}): {detail}")
 
     def submit_job(
         self,
@@ -173,24 +201,32 @@ class JobSubmissionClient:
         runtime_env: Optional[dict] = None,
         metadata: Optional[dict] = None,
     ) -> str:
-        return ray_tpu.get(
-            self._mgr.submit.remote(entrypoint, submission_id, runtime_env, metadata)
+        out = self._request(
+            "POST",
+            "/api/jobs/",
+            {
+                "entrypoint": entrypoint,
+                "submission_id": submission_id,
+                "runtime_env": runtime_env,
+                "metadata": metadata,
+            },
         )
+        return out["submission_id"]
 
     def get_job_status(self, job_id: str) -> str:
-        return ray_tpu.get(self._mgr.get_info.remote(job_id))["status"]
+        return self.get_job_info(job_id)["status"]
 
     def get_job_info(self, job_id: str) -> dict:
-        return ray_tpu.get(self._mgr.get_info.remote(job_id))
+        return self._request("GET", f"/api/jobs/{job_id}")
 
     def list_jobs(self) -> List[dict]:
-        return ray_tpu.get(self._mgr.list_jobs.remote())
+        return self._request("GET", "/api/jobs/")
 
     def stop_job(self, job_id: str) -> bool:
-        return ray_tpu.get(self._mgr.stop.remote(job_id))
+        return self._request("POST", f"/api/jobs/{job_id}/stop")["stopped"]
 
     def get_job_logs(self, job_id: str) -> str:
-        return ray_tpu.get(self._mgr.get_logs.remote(job_id))
+        return self._request("GET", f"/api/jobs/{job_id}/logs")["logs"]
 
     def wait_until_finished(self, job_id: str, timeout: float = 120.0) -> str:
         deadline = time.monotonic() + timeout

@@ -42,6 +42,13 @@ class TransformerConfig:
     # MoE (expert parallelism): 0 = dense MLP.
     num_experts: int = 0
     experts_per_token: int = 2
+    # Blockwise cross-entropy chunk (tokens); 0 = materialize full logits.
+    logits_chunk: int = 0
+    # Remat policy: "full" recomputes the whole layer on backward;
+    # "dots" saves matmul outputs and recomputes only cheap elementwise
+    # ops (jax.checkpoint_policies.dots_with_no_batch_dims_saveable) —
+    # far less recompute FLOPs for modestly more HBM.
+    remat_policy: str = "full"
 
     @property
     def head_dim(self) -> int:
@@ -225,7 +232,16 @@ def decoder_stack(params: Params, h, cfg: TransformerConfig, positions, attn_fn=
         return out, None
 
     if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+        if cfg.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', got {cfg.remat_policy!r}"
+            )
+        policy = (
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            if cfg.remat_policy == "dots"
+            else None
+        )
+        layer_fn = jax.checkpoint(layer_fn, prevent_cse=False, policy=policy)
     h, _ = jax.lax.scan(layer_fn, h, params["layers"])
     return h
 
@@ -235,14 +251,20 @@ def unembed(params: Params, h, cfg: TransformerConfig):
     return (h @ params["lm_head"].astype(h.dtype)).astype(jnp.float32)
 
 
-def forward(params: Params, tokens, cfg: TransformerConfig, attn_fn=None, positions=None):
-    """tokens: [b, s] int32 → logits [b, s, vocab] fp32."""
+def hidden_states(params: Params, tokens, cfg: TransformerConfig, attn_fn=None, positions=None):
+    """tokens: [b, s] int32 → final hidden states [b, s, d] (pre-norm);
+    the single embed+stack pipeline shared by forward() and the chunked
+    loss path."""
     if positions is None:
         b, s = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
     h = embed(params, tokens, cfg)
-    h = decoder_stack(params, h, cfg, positions, attn_fn)
-    return unembed(params, h, cfg)
+    return decoder_stack(params, h, cfg, positions, attn_fn)
+
+
+def forward(params: Params, tokens, cfg: TransformerConfig, attn_fn=None, positions=None):
+    """tokens: [b, s] int32 → logits [b, s, vocab] fp32."""
+    return unembed(params, hidden_states(params, tokens, cfg, attn_fn, positions), cfg)
 
 
 def token_nll(logits: jax.Array, targets: jax.Array, mask=None):
@@ -254,13 +276,55 @@ def token_nll(logits: jax.Array, targets: jax.Array, mask=None):
     return -ll.mean()
 
 
-def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig, attn_fn=None):
-    """batch: {"tokens": [b, s+1]} — next-token cross-entropy."""
+def chunked_token_nll(
+    params: Params, h: jax.Array, targets: jax.Array, cfg: TransformerConfig, mask=None, chunk: int = 256
+):
+    """Blockwise next-token NLL: the [b, s, vocab] logits tensor is never
+    materialized — sequence chunks are unembedded, reduced to per-token
+    NLL, and discarded inside a scan. At b=8, s=2048, v=32k the full fp32
+    logits are ~2.1 GB of HBM; chunking caps that at chunk/s of it, which
+    is what lets the flagship step run bigger batches (higher MXU
+    occupancy) on one chip."""
+    b, s, d = h.shape
+    pad = (-s) % chunk
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+    n_chunks = h.shape[1] // chunk
+    h_c = h.reshape(b, n_chunks, chunk, d).transpose(1, 0, 2, 3)
+    t_c = targets.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
+
+    def body(carry, xs):
+        hc, tc = xs
+        logp = jax.nn.log_softmax(unembed(params, hc, cfg), axis=-1)
+        ll = jnp.take_along_axis(logp, tc[..., None], axis=-1)[..., 0]
+        return carry, ll
+
+    _, ll = jax.lax.scan(body, 0.0, (h_c, t_c))
+    ll = ll.transpose(1, 0, 2).reshape(b, s + pad)[:, :s]
+    if mask is not None:
+        return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return -ll.mean()
+
+
+def loss_fn(
+    params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig, attn_fn=None,
+    logits_chunk: Optional[int] = None,
+):
+    """batch: {"tokens": [b, s+1]} — next-token cross-entropy.
+    ``logits_chunk`` > 0 switches to the blockwise NLL (no full logits);
+    defaults to ``cfg.logits_chunk``."""
+    if logits_chunk is None:
+        logits_chunk = cfg.logits_chunk
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inputs, cfg, attn_fn)
     mask = batch.get("mask")
-    return token_nll(logits, targets, mask[:, 1:] if mask is not None else None)
+    mask = mask[:, 1:] if mask is not None else None
+    if logits_chunk:
+        h = hidden_states(params, inputs, cfg, attn_fn)
+        return chunked_token_nll(params, h, targets, cfg, mask, chunk=logits_chunk)
+    logits = forward(params, inputs, cfg, attn_fn)
+    return token_nll(logits, targets, mask)
 
 
 def init_shapes(cfg: TransformerConfig):

@@ -107,3 +107,42 @@ def test_cli_microbenchmark_smoke():
     results = json.loads(r.stdout[r.stdout.index("{") :])
     assert results["tasks_per_s"] > 10
     assert results["put_get_GiB_per_s"] > 0.1
+
+
+def test_job_rest_api_direct(ray_start_regular):
+    """Drive the REST endpoints directly (reference: job_head.py REST)."""
+    import json
+    import os
+    import urllib.request
+
+    from ray_tpu.core import api
+
+    core = api._require_worker()
+    with open(os.path.join(core.session_dir, "dashboard_port")) as f:
+        base = f"http://127.0.0.1:{f.read().strip()}"
+
+    body = json.dumps({"entrypoint": f"{sys.executable} -c 'print(7)'"}).encode()
+    req = urllib.request.Request(
+        base + "/api/jobs/", data=body, method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        job_id = json.loads(resp.read())["submission_id"]
+    assert job_id.startswith("raysubmit_")
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        with urllib.request.urlopen(base + f"/api/jobs/{job_id}", timeout=10) as resp:
+            info = json.loads(resp.read())
+        if info["status"] in ("SUCCEEDED", "FAILED"):
+            break
+        time.sleep(0.3)
+    assert info["status"] == "SUCCEEDED", info
+    with urllib.request.urlopen(base + f"/api/jobs/{job_id}/logs", timeout=10) as resp:
+        assert "7" in json.loads(resp.read())["logs"]
+    # listing includes the job; unknown id is a 404
+    with urllib.request.urlopen(base + "/api/jobs/", timeout=10) as resp:
+        assert any(j["job_id"] == job_id for j in json.loads(resp.read()))
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(base + "/api/jobs/nope", timeout=10)

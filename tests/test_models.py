@@ -380,3 +380,60 @@ def test_vit_learns_tiny_classification():
     acc = float(vit.accuracy(params, batch, cfg))
     assert float(loss) < first * 0.5
     assert acc >= 0.9, f"acc={acc}"
+
+
+def test_chunked_nll_matches_full():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import transformer as tf
+
+    cfg = tf.TransformerConfig.tiny(dtype=jnp.float32)
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 65), 0, cfg.vocab_size)
+    batch = {"tokens": tokens}
+    l0 = float(tf.loss_fn(params, batch, cfg))
+    # dividing and non-dividing (padded) chunk sizes
+    assert abs(float(tf.loss_fn(params, batch, cfg, logits_chunk=16)) - l0) < 1e-6
+    assert abs(float(tf.loss_fn(params, batch, cfg, logits_chunk=30)) - l0) < 1e-6
+    g0 = jax.grad(lambda p: tf.loss_fn(p, batch, cfg))(params)
+    g1 = jax.grad(lambda p: tf.loss_fn(p, batch, cfg, logits_chunk=16))(params)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_chunked_nll_respects_mask():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import transformer as tf
+
+    cfg = tf.TransformerConfig.tiny(dtype=jnp.float32)
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, cfg.vocab_size)
+    mask = jnp.ones((2, 33)).at[:, 20:].set(0.0)
+    batch = {"tokens": tokens, "mask": mask}
+    l0 = float(tf.loss_fn(params, batch, cfg))
+    l1 = float(tf.loss_fn(params, batch, cfg, logits_chunk=8))
+    assert abs(l0 - l1) < 1e-6
+
+
+def test_remat_policy_dots_same_loss():
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import transformer as tf
+
+    cfg = tf.TransformerConfig.tiny(dtype=jnp.float32, remat=True)
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, cfg.vocab_size)
+    batch = {"tokens": tokens}
+    l_full = float(tf.loss_fn(params, batch, cfg))
+    cfg_dots = dataclasses.replace(cfg, remat_policy="dots")
+    l_dots = float(jax.grad(lambda p: tf.loss_fn(p, batch, cfg_dots))(params)["final_norm"][0]), float(
+        tf.loss_fn(params, batch, cfg_dots)
+    )
+    assert abs(l_dots[1] - l_full) < 1e-6
