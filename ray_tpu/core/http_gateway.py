@@ -79,12 +79,17 @@ def start_http_gateway(controller, loop: asyncio.AbstractEventLoop, port: int) -
                 length = int(self.headers.get("Content-Length") or 0)
                 body = json.loads(self.rfile.read(length) or b"{}") if length else {}
                 if path == "/api/jobs":
-                    job_id = job_manager().submit(
-                        body["entrypoint"],
-                        body.get("submission_id"),
-                        body.get("runtime_env"),
-                        body.get("metadata"),
-                    )
+                    try:
+                        job_id = job_manager().submit(
+                            body["entrypoint"],
+                            body.get("submission_id"),
+                            body.get("runtime_env"),
+                            body.get("metadata"),
+                        )
+                    except ValueError as e:
+                        # duplicate submission_id → conflict, not a server fault
+                        self._json({"error": str(e)}, 409)
+                        return
                     self._json({"submission_id": job_id})
                 elif path.startswith("/api/jobs/") and path.endswith("/stop"):
                     job_id = path[len("/api/jobs/") : -len("/stop")]

@@ -86,6 +86,30 @@ def read_parquet(paths, *, parallelism: int = -1) -> Dataset:
     return _read(ParquetDatasource(paths), parallelism)
 
 
+def read_bigquery(project_id: str, query: str, *, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.extra_datasources import BigQueryDatasource
+
+    return _read(BigQueryDatasource(project_id, query), parallelism)
+
+
+def read_mongo(uri: str, database: str, collection: str, *, pipeline=None, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.extra_datasources import MongoDatasource
+
+    return _read(MongoDatasource(uri, database, collection, pipeline), parallelism)
+
+
+def read_lance(uri: str, *, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.extra_datasources import LanceDatasource
+
+    return _read(LanceDatasource(uri), parallelism)
+
+
+def read_iceberg(table_identifier: str, *, catalog_kwargs=None, row_filter=None, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.extra_datasources import IcebergDatasource
+
+    return _read(IcebergDatasource(table_identifier, catalog_kwargs, row_filter), parallelism)
+
+
 def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:
     return _read(ds, parallelism)
 
@@ -144,6 +168,10 @@ __all__ = [
     "read_webdataset",
     "read_sql",
     "read_images",
+    "read_bigquery",
+    "read_mongo",
+    "read_lance",
+    "read_iceberg",
     "read_datasource",
     "Datasink",
     "ParquetDatasink",

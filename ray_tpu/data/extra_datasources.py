@@ -155,6 +155,113 @@ class SQLDatasource(Datasource):
         return tasks
 
 
+class BigQueryDatasource(Datasource):
+    """Reference: python/ray/data/_internal/datasource/bigquery_datasource.py.
+    Requires ``google-cloud-bigquery`` (gated import — read tasks fail
+    with a clear error if it is absent)."""
+
+    def __init__(self, project_id: str, query: str):
+        self._project = project_id
+        self._query = query
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        project, query = self._project, self._query
+
+        def read() -> Iterable[Block]:
+            try:
+                from google.cloud import bigquery  # type: ignore
+            except ImportError as e:
+                raise ImportError(
+                    "read_bigquery requires google-cloud-bigquery"
+                ) from e
+            client = bigquery.Client(project=project)
+            rows = client.query(query).result()
+            yield [dict(r) for r in rows]
+
+        return [ReadTask(read, BlockMetadata(0, 0))]
+
+
+class MongoDatasource(Datasource):
+    """Reference: mongo_datasource.py. Requires ``pymongo`` (gated)."""
+
+    def __init__(self, uri: str, database: str, collection: str, pipeline: Optional[list] = None):
+        self._uri = uri
+        self._db = database
+        self._coll = collection
+        self._pipeline = pipeline or []
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        uri, db, coll, pipeline = self._uri, self._db, self._coll, self._pipeline
+
+        def read() -> Iterable[Block]:
+            try:
+                import pymongo  # type: ignore
+            except ImportError as e:
+                raise ImportError("read_mongo requires pymongo") from e
+            client = pymongo.MongoClient(uri)
+            try:
+                cursor = client[db][coll].aggregate(pipeline) if pipeline else client[db][coll].find()
+                yield [{k: v for k, v in doc.items() if k != "_id"} for doc in cursor]
+            finally:
+                client.close()
+
+        return [ReadTask(read, BlockMetadata(0, 0))]
+
+
+class LanceDatasource(Datasource):
+    """Reference: lance_datasource.py. Requires ``lance`` (gated). Lance
+    datasets are directories, not file globs, so this is a plain
+    single-task Datasource like IcebergDatasource."""
+
+    def __init__(self, uri: str):
+        self._uri = uri
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        uri = self._uri
+
+        def read() -> Iterable[Block]:
+            try:
+                import lance  # type: ignore
+            except ImportError as e:
+                raise ImportError("read_lance requires pylance") from e
+            ds = lance.dataset(uri)
+            for batch in ds.to_batches():
+                yield {
+                    c: batch.column(c).to_numpy(zero_copy_only=False)
+                    for c in batch.schema.names
+                }
+
+        return [ReadTask(read, BlockMetadata(0, 0))]
+
+
+class IcebergDatasource(Datasource):
+    """Reference: iceberg_datasource.py. Requires ``pyiceberg`` (gated)."""
+
+    def __init__(self, table_identifier: str, catalog_kwargs: Optional[dict] = None, row_filter: Optional[str] = None):
+        self._table = table_identifier
+        self._catalog_kwargs = catalog_kwargs or {}
+        self._filter = row_filter
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        table_id, ckw, flt = self._table, self._catalog_kwargs, self._filter
+
+        def read() -> Iterable[Block]:
+            try:
+                from pyiceberg.catalog import load_catalog  # type: ignore
+            except ImportError as e:
+                raise ImportError("read_iceberg requires pyiceberg") from e
+            catalog = load_catalog(**ckw)
+            table = catalog.load_table(table_id)
+            scan = table.scan(row_filter=flt) if flt else table.scan()
+            arrow = scan.to_arrow()
+            yield {
+                c: arrow.column(c).to_numpy(zero_copy_only=False)
+                for c in arrow.column_names
+            }
+
+        return [ReadTask(read, BlockMetadata(0, 0))]
+
+
 class ImageDatasource(FileBasedDatasource):
     """Decode images to HWC uint8 arrays (requires PIL; gated import).
 

@@ -119,6 +119,24 @@ def prefill(
     return logits, cache
 
 
+def _filter_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
+    """Static-shape nucleus/top-k filtering: disallowed entries → -inf.
+    Both filters are jit-friendly (sort-based, no dynamic shapes)."""
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if 0.0 < top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p (the
+        # first token is always kept)
+        keep = cum - probs < top_p
+        cutoff = jnp.where(keep, sorted_logits, jnp.inf).min(axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
 def generate(
     params: Params,
     cfg: TransformerConfig,
@@ -126,10 +144,13 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     key: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Greedy (temperature=0) or sampled continuation. prompt: [b, s] →
-    generated tokens [b, max_new_tokens]. Jit-friendly end to end."""
+    """Greedy (temperature=0) or sampled continuation with optional
+    top-k / nucleus (top-p) filtering. prompt: [b, s] → generated tokens
+    [b, max_new_tokens]. Jit-friendly end to end."""
     b, s = prompt.shape
     if max_new_tokens <= 0:
         return jnp.zeros((b, 0), jnp.int32)
@@ -141,6 +162,7 @@ def generate(
 
     def sample(logits, k):
         if temperature > 0:
+            logits = _filter_logits(logits, top_k, top_p)
             return jax.random.categorical(k, logits / temperature).astype(jnp.int32)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 

@@ -300,6 +300,10 @@ def chunked_token_nll(
         ll = jnp.take_along_axis(logp, tc[..., None], axis=-1)[..., 0]
         return carry, ll
 
+    # Remat the chunk: without it, scan's AD stacks each chunk's softmax
+    # residuals — a [b, s, vocab] buffer, exactly what this path promises
+    # never to materialize. Recomputed per chunk on backward instead.
+    body = jax.checkpoint(body)
     _, ll = jax.lax.scan(body, 0.0, (h_c, t_c))
     ll = ll.transpose(1, 0, 2).reshape(b, s + pad)[:, :s]
     if mask is not None:

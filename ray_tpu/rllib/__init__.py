@@ -19,6 +19,12 @@ from ray_tpu.rllib.multi_agent import (
     MultiAgentPPOConfig,
 )
 from ray_tpu.rllib.offline import BC, BCConfig, MARWIL, MARWILConfig
+from ray_tpu.rllib.ope import (
+    DirectMethod,
+    DoublyRobust,
+    ImportanceSampling,
+    WeightedImportanceSampling,
+)
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rllib.rl_module import QRLModule, RLModule, RLModuleSpec, SACRLModule, make_module
@@ -60,6 +66,10 @@ __all__ = [
     "SACConfig",
     "BC",
     "BCConfig",
+    "ImportanceSampling",
+    "WeightedImportanceSampling",
+    "DirectMethod",
+    "DoublyRobust",
     "MARWIL",
     "MARWILConfig",
     "ReplayBuffer",

@@ -22,7 +22,13 @@ from ray_tpu.tune.search import (
     sample_from,
     uniform,
 )
-from ray_tpu.tune.suggest import BayesOptSearcher, Repeater, TPESearcher
+from ray_tpu.tune.suggest import (
+    BayesOptSearcher,
+    BOHBSearcher,
+    EvolutionarySearcher,
+    Repeater,
+    TPESearcher,
+)
 from ray_tpu.tune.trial import (
     Trial,
     get_checkpoint_dir,
@@ -70,6 +76,8 @@ __all__ = [
     "FIFOScheduler",
     "AsyncHyperBandScheduler",
     "HyperBandScheduler",
+    "BOHBSearcher",
+    "EvolutionarySearcher",
     "PB2",
     "MedianStoppingRule",
     "PopulationBasedTraining",

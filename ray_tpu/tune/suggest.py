@@ -346,3 +346,148 @@ class Repeater(Searcher):
             else:
                 self.searcher.on_trial_complete(gid, None, error=True)
             del self._groups[gid]
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model-based half (Falkner et al. 2018; reference:
+    tune/search/bohb wraps hpbandster): TPE/KDE models maintained *per
+    fidelity*; suggestions come from the highest budget that has enough
+    observations, so early low-fidelity results guide the search until
+    full-budget data accumulates. Pair with ``HyperBandScheduler`` for
+    the bandit half (the reference pairs TuneBOHB with HyperBandForBOHB).
+    """
+
+    def __init__(
+        self,
+        param_space: Dict[str, Any],
+        metric: str = "loss",
+        mode: str = "min",
+        time_attr: str = "training_iteration",
+        min_points_in_model: int = 6,
+        **kw,
+    ):
+        # The parent's n_startup gates model activation on len(self._obs);
+        # align it with min_points_in_model so the per-budget model turns
+        # on exactly when a budget has enough points (unless the caller
+        # overrides n_startup explicitly).
+        kw.setdefault("n_startup", min_points_in_model)
+        super().__init__(param_space, metric=metric, mode=mode, **kw)
+        self._time_attr = time_attr
+        self._min_points = min_points_in_model
+        self._obs_by_budget: Dict[int, List[Tuple[np.ndarray, float]]] = {}
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict] = None, error: bool = False):
+        u = self._live.pop(trial_id, None)
+        if u is None or error or not result or self.metric not in result:
+            return
+        budget = int(result.get(self._time_attr, 1))
+        val = float(result[self.metric])
+        self._obs_by_budget.setdefault(budget, []).append(
+            (u, val if self.mode == "min" else -val)
+        )
+
+    def observe(self, trial_id: str, config: Dict[str, Any], result: Optional[dict]):
+        self._suggested += 1
+        if not result or self.metric not in result:
+            return
+        u = self._space.encode(config)
+        if u is not None:
+            budget = int(result.get(self._time_attr, 1))
+            val = float(result[self.metric])
+            self._obs_by_budget.setdefault(budget, []).append(
+                (u, val if self.mode == "min" else -val)
+            )
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        # model = highest budget with enough points (BOHB's rule)
+        self._obs = []
+        for budget in sorted(self._obs_by_budget, reverse=True):
+            pts = self._obs_by_budget[budget]
+            if len(pts) >= self._min_points:
+                self._obs = pts
+                break
+        return super().suggest(trial_id)
+
+
+class EvolutionarySearcher(Searcher):
+    """Differential evolution in the unit cube — the native stand-in for
+    the reference's evolutionary/derivative-free wrappers (nevergrad,
+    zoopt: tune/search/nevergrad.py, zoopt.py). DE/rand/1/bin: trial
+    vector a + F·(b−c) with binomial crossover against a population
+    member; better offspring replace their targets."""
+
+    def __init__(
+        self,
+        param_space: Dict[str, Any],
+        metric: str = "loss",
+        mode: str = "min",
+        population_size: int = 10,
+        mutation: float = 0.6,
+        crossover: float = 0.8,
+        num_samples: int = 64,
+        seed: Optional[int] = None,
+    ):
+        if population_size < 3:
+            raise ValueError("EvolutionarySearcher needs population_size >= 3 (DE/rand/1)")
+        self._space = _Space(param_space)
+        self.metric, self.mode = metric, mode
+        self._pop_size = population_size
+        self._f = mutation
+        self._cr = crossover
+        self.num_samples = num_samples
+        self._rng = np.random.default_rng(seed)
+        self._suggested = 0
+        self._live: Dict[str, Tuple[np.ndarray, Optional[int]]] = {}  # u, target idx
+        self._pop: List[np.ndarray] = []
+        self._fit: List[float] = []
+        self._next_target = 0
+
+    def set_search_properties(self, metric: Optional[str], mode: Optional[str]):
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        d = self._space.ndim
+        if len(self._pop) < self._pop_size or d == 0:
+            u = self._rng.uniform(size=d)
+            self._live[trial_id] = (u, None)
+            return self._space.decode(u)
+        target = self._next_target % len(self._pop)
+        self._next_target += 1
+        a, b, c = self._rng.choice(len(self._pop), size=3, replace=False)
+        mutant = np.clip(self._pop[a] + self._f * (self._pop[b] - self._pop[c]), 0, 1)
+        cross = self._rng.uniform(size=d) < self._cr
+        cross[self._rng.integers(d)] = True  # at least one mutant dim
+        u = np.where(cross, mutant, self._pop[target])
+        self._live[trial_id] = (u, target)
+        return self._space.decode(u)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict] = None, error: bool = False):
+        entry = self._live.pop(trial_id, None)
+        if entry is None or error or not result or self.metric not in result:
+            return
+        u, target = entry
+        val = float(result[self.metric])
+        score = val if self.mode == "min" else -val
+        if len(self._pop) < self._pop_size:
+            self._pop.append(u)
+            self._fit.append(score)
+        elif target is not None and score <= self._fit[target]:
+            self._pop[target] = u
+            self._fit[target] = score
+
+    def observe(self, trial_id: str, config: Dict[str, Any], result: Optional[dict]):
+        self._suggested += 1
+        u = self._space.encode(config)
+        if u is None or not result or self.metric not in result:
+            return
+        val = float(result[self.metric])
+        score = val if self.mode == "min" else -val
+        if len(self._pop) < self._pop_size:
+            self._pop.append(u)
+            self._fit.append(score)

@@ -1,0 +1,189 @@
+"""Tests for the breadth sweep: sampling filters, BOHB/evolutionary
+searchers, OPE estimators, gated cloud datasources (reference test
+models: rllib/offline/estimators/tests, tune/tests/test_searchers.py)."""
+import numpy as np
+import pytest
+
+
+# -- generate: top-k / top-p -------------------------------------------------
+
+def test_filter_logits_topk_topp():
+    import jax.numpy as jnp
+
+    from ray_tpu.models.generate import _filter_logits
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.1]]))
+    k2 = np.asarray(_filter_logits(logits, top_k=2, top_p=1.0))
+    assert np.isfinite(k2[0, :2]).all() and np.isinf(k2[0, 2:]).all()
+    # top_p=0.7: keep 0.5 then 0.25 (cum 0.75 >= 0.7) → two survivors
+    p = np.asarray(_filter_logits(logits, top_k=0, top_p=0.7))
+    assert np.isfinite(p[0, :2]).all() and np.isinf(p[0, 2:]).all()
+    # top_p tiny: only the argmax survives
+    p1 = np.asarray(_filter_logits(logits, top_k=0, top_p=0.1))
+    assert np.isfinite(p1[0, 0]) and np.isinf(p1[0, 1:]).all()
+
+
+def test_generate_with_sampling_filters():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import generate as gen
+    from ray_tpu.models import transformer as tf
+
+    cfg = tf.TransformerConfig.tiny(dtype=jnp.float32)
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size)
+    out = gen.generate(
+        params, cfg, prompt, 6, temperature=0.8, top_k=40, top_p=0.9,
+        key=jax.random.PRNGKey(2),
+    )
+    assert out.shape == (2, 6)
+    assert bool((out >= 0).all()) and bool((out < cfg.vocab_size).all())
+
+
+# -- tune searchers ----------------------------------------------------------
+
+def _quadratic(x):
+    return (x - 0.3) ** 2
+
+
+def test_evolutionary_searcher_optimizes():
+    from ray_tpu import tune
+    from ray_tpu.tune.suggest import EvolutionarySearcher
+
+    s = EvolutionarySearcher(
+        {"x": tune.uniform(0, 1)}, metric="loss", mode="min",
+        population_size=8, num_samples=60, seed=0,
+    )
+    best = np.inf
+    for i in range(60):
+        cfg = s.suggest(f"t{i}")
+        if cfg is None:
+            break
+        loss = _quadratic(cfg["x"])
+        best = min(best, loss)
+        s.on_trial_complete(f"t{i}", {"loss": loss})
+    assert best < 1e-2, best
+    assert s.suggest("overflow") is None  # num_samples budget respected
+
+
+def test_bohb_searcher_uses_high_budget_model():
+    from ray_tpu import tune
+    from ray_tpu.tune.suggest import BOHBSearcher
+
+    s = BOHBSearcher(
+        {"x": tune.uniform(0, 1)}, metric="loss", mode="min",
+        min_points_in_model=4, n_startup=4, num_samples=200, seed=0,
+    )
+    rng = np.random.default_rng(0)
+    # low-budget observations are misleading (optimum at 0.9); high-budget
+    # ones are the truth (optimum at 0.2) — the model must prefer budget 9
+    for i in range(8):
+        x = float(rng.uniform())
+        s.observe(f"lo{i}", {"x": x}, {"loss": (x - 0.9) ** 2, "training_iteration": 1})
+    for i in range(8):
+        x = float(rng.uniform())
+        s.observe(f"hi{i}", {"x": x}, {"loss": (x - 0.2) ** 2, "training_iteration": 9})
+    xs = [s.suggest(f"s{i}")["x"] for i in range(24)]
+    # suggestions should cluster toward the high-budget optimum
+    assert np.median(np.abs(np.asarray(xs) - 0.2)) < np.median(np.abs(np.asarray(xs) - 0.9))
+
+
+def test_bohb_with_hyperband_end_to_end(ray_start_regular, tmp_path):
+    import json
+    import os
+
+    from ray_tpu import tune
+
+    def objective(config):
+        step = 0
+        ck = tune.get_checkpoint_dir()
+        if ck:
+            with open(os.path.join(ck, "s.json")) as f:
+                step = json.load(f)["step"]
+        for i in range(step, 9):
+            d = tune.make_checkpoint_dir()
+            with open(os.path.join(d, "s.json"), "w") as f:
+                json.dump({"step": i + 1}, f)
+            score = -(config["x"] - 0.7) ** 2 * (i + 1)
+            tune.report({"score": score}, checkpoint_dir=d)
+
+    searcher = tune.BOHBSearcher(
+        {"x": tune.uniform(0, 1)}, metric="score", mode="max",
+        num_samples=12, min_points_in_model=4, n_startup=4, seed=0,
+    )
+    grid = tune.Tuner(
+        objective,
+        param_space={},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", search_alg=searcher,
+            scheduler=tune.HyperBandScheduler(max_t=9, reduction_factor=3),
+            max_concurrent_trials=3,
+        ),
+        _experiment_dir=str(tmp_path / "exp"),
+    ).fit()
+    assert len(grid.trials) == 12
+    best = grid.get_best_result()
+    assert abs(best.metrics["config"]["x"] - 0.7) < 0.5  # moved toward optimum
+
+
+# -- OPE ---------------------------------------------------------------------
+
+def _make_episodes_and_module():
+    import jax
+
+    from ray_tpu.rllib import RLModule, RLModuleSpec, SingleAgentEnvRunner
+
+    spec = RLModuleSpec(observation_dim=4, action_dim=2, hidden=(8,))
+    runner = SingleAgentEnvRunner("CartPole-v1", spec, num_envs=2, seed=0)
+    episodes = [ep for ep in runner.sample(300) if ep.terminated or ep.truncated]
+    module = RLModule(spec)
+    params = runner.params  # same policy → on-policy weights == 1
+    return module, params, episodes
+
+
+def test_ope_is_wis_on_policy():
+    """On-policy data with the same target policy: IS and WIS estimates
+    must both equal the empirical discounted return (weights == 1)."""
+    module, params, episodes = _make_episodes_and_module()
+    from ray_tpu.rllib import ImportanceSampling, WeightedImportanceSampling
+
+    gamma = 0.99
+    emp = []
+    for ep in episodes:
+        r = np.asarray(ep.rewards, np.float32)
+        emp.append(float((gamma ** np.arange(len(r)) * r).sum()))
+    emp_mean = float(np.mean(emp))
+
+    est_is = ImportanceSampling(module, params, gamma=gamma).estimate(episodes)
+    est_wis = WeightedImportanceSampling(module, params, gamma=gamma).estimate(episodes)
+    assert abs(est_is["v_target"] - emp_mean) < 1e-3 * max(1, abs(emp_mean))
+    assert abs(est_wis["v_target"] - emp_mean) < 0.15 * max(1.0, abs(emp_mean))
+    assert est_is["num_episodes"] == len(episodes)
+
+
+def test_ope_dm_and_dr_finite():
+    module, params, episodes = _make_episodes_and_module()
+    from ray_tpu.rllib import DirectMethod, DoublyRobust
+
+    dm = DirectMethod(module, params).estimate(episodes)
+    dr = DoublyRobust(module, params).estimate(episodes)
+    assert np.isfinite(dm["v_target"]) and np.isfinite(dr["v_target"])
+    assert dm["num_episodes"] == dr["num_episodes"] == len(episodes)
+
+
+# -- gated cloud datasources -------------------------------------------------
+
+def test_gated_datasources_raise_cleanly(ray_start_regular):
+    """Without the optional clients installed, reads must fail with a
+    clear ImportError naming the missing package — not a crash."""
+    from ray_tpu import data
+
+    for factory, msg in [
+        (lambda: data.read_bigquery("proj", "SELECT 1"), "bigquery"),
+        (lambda: data.read_mongo("mongodb://x", "db", "coll"), "pymongo"),
+        (lambda: data.read_iceberg("db.tbl"), "pyiceberg"),
+    ]:
+        ds = factory()
+        with pytest.raises(Exception, match=msg):
+            ds.take_all()
