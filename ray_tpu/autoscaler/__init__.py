@@ -17,10 +17,15 @@ whole-slice scale-up (SURVEY §7 step 3).
 """
 from ray_tpu.autoscaler.node_provider import FakeMultiNodeProvider, NodeProvider
 from ray_tpu.autoscaler.autoscaler import AutoscalingCluster, StandardAutoscaler
+from ray_tpu.autoscaler.v2 import AutoscalerV2, Instance, InstanceManager, InstanceStatus
 
 __all__ = [
     "NodeProvider",
     "FakeMultiNodeProvider",
     "StandardAutoscaler",
     "AutoscalingCluster",
+    "AutoscalerV2",
+    "InstanceManager",
+    "Instance",
+    "InstanceStatus",
 ]

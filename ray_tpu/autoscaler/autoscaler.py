@@ -203,12 +203,18 @@ class AutoscalingCluster:
     """Test harness: a real cluster + fake provider + live autoscaler
     (reference: python/ray/cluster_utils.py:26 AutoscalingCluster)."""
 
-    def __init__(self, head_resources: Dict[str, float], worker_node_types: Dict[str, dict], **kw):
+    def __init__(
+        self,
+        head_resources: Dict[str, float],
+        worker_node_types: Dict[str, dict],
+        autoscaler_cls=None,
+        **kw,
+    ):
         from ray_tpu.core.cluster_utils import Cluster
 
         self._cluster = Cluster(head_resources=head_resources)
         self.provider = FakeMultiNodeProvider(self._cluster.address, self._cluster._session_dir)
-        self.autoscaler = StandardAutoscaler(
+        self.autoscaler = (autoscaler_cls or StandardAutoscaler)(
             self.provider,
             worker_node_types,
             admin_call=lambda m, *a: self._cluster._admin._call(m, *a),

@@ -1,0 +1,207 @@
+"""Autoscaler v2: instance-lifecycle state machine + reconciler.
+
+Reference: python/ray/autoscaler/v2/autoscaler.py:42 (Autoscaler),
+v2/instance_manager/instance_manager.py:29 (InstanceManager) and
+v2/scheduler.py — the v2 redesign tracks every instance through an
+explicit FSM (QUEUED → REQUESTED → ALLOCATED → RAY_RUNNING →
+RAY_STOPPING → TERMINATED) and reconciles that ledger against both the
+cloud provider and the cluster's live-node view each tick, instead of
+v1's stateless count-diffing. Scale-up decisions reuse the same
+demand-driven bin-packing as v1 (autoscaler.py bin_pack_new_nodes).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler, bin_pack_new_nodes
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+class InstanceStatus:
+    QUEUED = "QUEUED"              # decided, not yet requested from provider
+    REQUESTED = "REQUESTED"        # provider.create_node issued
+    ALLOCATED = "ALLOCATED"        # provider reports the node exists
+    RAY_RUNNING = "RAY_RUNNING"    # node joined the cluster
+    RAY_STOPPING = "RAY_STOPPING"  # drain/terminate requested
+    TERMINATED = "TERMINATED"
+
+    TERMINAL = {TERMINATED}
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    status: str = InstanceStatus.QUEUED
+    provider_id: Optional[str] = None
+    created_at: float = field(default_factory=time.time)
+    updated_at: float = field(default_factory=time.time)
+    history: List[str] = field(default_factory=list)
+
+    def transition(self, status: str):
+        self.history.append(f"{self.status}->{status}")
+        self.status = status
+        self.updated_at = time.time()
+
+
+class InstanceManager:
+    """The v2 ledger: every node the autoscaler ever decided to create,
+    tracked through the FSM and reconciled against reality."""
+
+    def __init__(self, provider: NodeProvider, node_types: Dict[str, dict], requested_timeout_s: float = 60.0):
+        self.provider = provider
+        self.node_types = node_types
+        self.requested_timeout_s = requested_timeout_s
+        self._instances: Dict[str, Instance] = {}
+        self._lock = threading.Lock()
+
+    # -- intents ----------------------------------------------------------
+    def queue_instances(self, node_type: str, count: int) -> List[str]:
+        out = []
+        with self._lock:
+            for _ in range(count):
+                iid = f"inst-{uuid.uuid4().hex[:12]}"
+                self._instances[iid] = Instance(instance_id=iid, node_type=node_type)
+                out.append(iid)
+        return out
+
+    def request_terminate(self, instance_id: str):
+        with self._lock:
+            inst = self._instances.get(instance_id)
+            if inst and inst.status not in InstanceStatus.TERMINAL:
+                inst.transition(InstanceStatus.RAY_STOPPING)
+
+    # -- views ------------------------------------------------------------
+    def instances(self, statuses: Optional[set] = None) -> List[Instance]:
+        with self._lock:
+            return [
+                i for i in self._instances.values()
+                if statuses is None or i.status in statuses
+            ]
+
+    def counts_by_type(self, live_only: bool = True) -> Dict[str, int]:
+        live = {
+            InstanceStatus.QUEUED, InstanceStatus.REQUESTED,
+            InstanceStatus.ALLOCATED, InstanceStatus.RAY_RUNNING,
+        }
+        out: Dict[str, int] = {}
+        for i in self.instances(live if live_only else None):
+            out[i.node_type] = out.get(i.node_type, 0) + 1
+        return out
+
+    # -- reconcile --------------------------------------------------------
+    def reconcile(self, cluster_alive_count: int):
+        """One tick: push QUEUED→REQUESTED via the provider, observe
+        provider state for ALLOCATED, match cluster membership for
+        RAY_RUNNING, and complete RAY_STOPPING terminations."""
+        provider_nodes = set(self.provider.non_terminated_nodes())
+        with self._lock:
+            for inst in self._instances.values():
+                if inst.status == InstanceStatus.QUEUED:
+                    pid = self.provider.create_node(
+                        inst.node_type, self.node_types[inst.node_type]["resources"]
+                    )
+                    inst.provider_id = pid
+                    inst.transition(InstanceStatus.REQUESTED)
+                elif inst.status == InstanceStatus.REQUESTED:
+                    if inst.provider_id in provider_nodes:
+                        inst.transition(InstanceStatus.ALLOCATED)
+                    elif time.time() - inst.updated_at > self.requested_timeout_s:
+                        # provider node vanished (preemption/launch failure)
+                        # before we ever observed it — without this, the
+                        # instance counts as live forever and permanently
+                        # eats the node type's launchable capacity
+                        inst.transition(InstanceStatus.TERMINATED)
+                elif inst.status == InstanceStatus.ALLOCATED:
+                    # Allocated instances count as running once the cluster
+                    # has at least as many live workers as non-terminal
+                    # instances ahead of them; without per-node identity the
+                    # conservative signal is provider membership + cluster
+                    # growth (the fake provider joins nodes immediately).
+                    if inst.provider_id in provider_nodes and cluster_alive_count > 0:
+                        inst.transition(InstanceStatus.RAY_RUNNING)
+                elif inst.status == InstanceStatus.RAY_STOPPING:
+                    if inst.provider_id is not None:
+                        self.provider.terminate_node(inst.provider_id)
+                    inst.transition(InstanceStatus.TERMINATED)
+                # provider-side disappearance (preemption/crash) → TERMINATED
+                if (
+                    inst.status in (InstanceStatus.ALLOCATED, InstanceStatus.RAY_RUNNING)
+                    and inst.provider_id not in provider_nodes
+                ):
+                    inst.transition(InstanceStatus.TERMINATED)
+
+
+class AutoscalerV2(StandardAutoscaler):
+    """v2 loop: same demand computation as v1, but all create/terminate
+    decisions flow through the InstanceManager ledger (reference:
+    v2/autoscaler.py wiring InstanceManager + Scheduler)."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.instance_manager = InstanceManager(self.provider, self.node_types)
+
+    def update(self):
+        im = self.instance_manager
+        alive = sum(
+            1 for n in self._call("list_nodes") if n["state"] == "ALIVE"
+        )
+        im.reconcile(alive)
+        counts = im.counts_by_type()
+        # 1. min_workers floor
+        for tname, tcfg in self.node_types.items():
+            deficit = tcfg.get("min_workers", 0) - counts.get(tname, 0)
+            if deficit > 0:
+                im.queue_instances(tname, deficit)
+                counts[tname] = counts.get(tname, 0) + deficit
+        # 2. unmet demand (persisting) → queue instances
+        unmet = self._unmet_demand()
+        if unmet:
+            self._demand_age += 1
+        else:
+            self._demand_age = 0
+        if unmet and self._demand_age >= self.upscale_ticks:
+            launchable = {
+                t: cfg.get("max_workers", 0) - counts.get(t, 0)
+                for t, cfg in self.node_types.items()
+            }
+            for tname, n in bin_pack_new_nodes(unmet, self.node_types, launchable).items():
+                im.queue_instances(tname, n)
+            self._demand_age = 0
+        im.reconcile(alive)
+        # 3. idle scale-down through the ledger
+        self._terminate_idle_v2(counts)
+        im.reconcile(alive)
+
+    def _terminate_idle_v2(self, counts: Dict[str, int]):
+        nodes = self._call("list_nodes")
+        alive_workers = [
+            n for n in nodes if n["state"] == "ALIVE" and not n["is_head"]
+        ]
+        idle_cluster = [
+            n for n in alive_workers
+            if n["resources"].get("available") == n["resources"].get("total")
+        ]
+        # The ledger has no provider↔cluster node identity, so reaping is
+        # only safe when EVERY worker node is idle — otherwise the timer
+        # could pick an instance whose node is mid-task (same conservative
+        # rule v1 uses, routed through the ledger).
+        all_idle = bool(alive_workers) and len(idle_cluster) == len(alive_workers)
+        if not all_idle or self._unmet_demand():
+            self._idle_since.clear()
+            return
+        now = time.monotonic()
+        im = self.instance_manager
+        for inst in im.instances({InstanceStatus.RAY_RUNNING, InstanceStatus.ALLOCATED}):
+            if counts.get(inst.node_type, 0) <= self.node_types[inst.node_type].get("min_workers", 0):
+                self._idle_since.pop(inst.instance_id, None)
+                continue
+            since = self._idle_since.setdefault(inst.instance_id, now)
+            if now - since > self.idle_timeout_s:
+                im.request_terminate(inst.instance_id)
+                counts[inst.node_type] = counts.get(inst.node_type, 0) - 1
+                self._idle_since.pop(inst.instance_id, None)

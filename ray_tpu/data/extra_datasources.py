@@ -158,7 +158,10 @@ class SQLDatasource(Datasource):
 class BigQueryDatasource(Datasource):
     """Reference: python/ray/data/_internal/datasource/bigquery_datasource.py.
     Requires ``google-cloud-bigquery`` (gated import — read tasks fail
-    with a clear error if it is absent)."""
+    with a clear error if it is absent). Single-task read: the query
+    result lands in one block (``parallelism`` is ignored); shard large
+    tables by issuing range-partitioned queries via ``read_sql``-style
+    WHERE clauses."""
 
     def __init__(self, project_id: str, query: str):
         self._project = project_id
@@ -182,7 +185,9 @@ class BigQueryDatasource(Datasource):
 
 
 class MongoDatasource(Datasource):
-    """Reference: mongo_datasource.py. Requires ``pymongo`` (gated)."""
+    """Reference: mongo_datasource.py. Requires ``pymongo`` (gated).
+    Single-task read (``parallelism`` ignored); shard by passing a
+    ``pipeline`` with ``$match`` partitions per call."""
 
     def __init__(self, uri: str, database: str, collection: str, pipeline: Optional[list] = None):
         self._uri = uri
@@ -235,7 +240,9 @@ class LanceDatasource(Datasource):
 
 
 class IcebergDatasource(Datasource):
-    """Reference: iceberg_datasource.py. Requires ``pyiceberg`` (gated)."""
+    """Reference: iceberg_datasource.py. Requires ``pyiceberg`` (gated).
+    Single-task read (``parallelism`` ignored); use ``row_filter`` to
+    shard by partition predicates."""
 
     def __init__(self, table_identifier: str, catalog_kwargs: Optional[dict] = None, row_filter: Optional[str] = None):
         self._table = table_identifier

@@ -122,7 +122,8 @@ def prefill(
 def _filter_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
     """Static-shape nucleus/top-k filtering: disallowed entries → -inf.
     Both filters are jit-friendly (sort-based, no dynamic shapes)."""
-    if top_k > 0:
+    vocab = logits.shape[-1]
+    if 0 < top_k < vocab:  # top_k >= vocab is a no-op, not an index error
         kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if 0.0 < top_p < 1.0:

@@ -132,6 +132,7 @@ class DoublyRobust:
         self.clip = weight_clip
 
     def estimate(self, episodes: List[SingleAgentEpisode]) -> Dict[str, float]:
+        import jax
         import jax.numpy as jnp
 
         vals = []
@@ -139,22 +140,26 @@ class DoublyRobust:
             T = len(ep)
             if T == 0:
                 continue
+            # One forward over all T+1 observations gives the target
+            # policy's logits AND values (no second pass via logp_entropy).
             obs = np.asarray(ep.observations, dtype=np.float32)
             out = self.module.forward_train(self.params, jnp.asarray(obs))
             v = np.asarray(out["vf"], dtype=np.float32)
+            logp_all = np.asarray(
+                jax.nn.log_softmax(out["logits"], axis=-1), dtype=np.float32
+            )
+            acts = np.asarray(ep.actions, np.int32)
+            target_logps = logp_all[np.arange(T), acts]
             ratios = np.exp(
-                np.clip(
-                    _target_logps(self.module, self.params, ep)
-                    - np.asarray(ep.logps, np.float32),
-                    -20,
-                    20,
-                )
+                np.clip(target_logps - np.asarray(ep.logps, np.float32), -20, 20)
             )
             if self.clip > 0:
                 ratios = np.minimum(ratios, self.clip)
             r = np.asarray(ep.rewards, np.float32)
-            # backward recursion: V_DR(t) = v(s_t) + ρ_t (r_t + γ V_DR(t+1) − v(s_t))
-            acc = 0.0 if ep.terminated else float(ep.final_value)
+            # backward recursion: V_DR(t) = v(s_t) + ρ_t (r_t + γ V_DR(t+1) − v(s_t));
+            # truncated episodes bootstrap with the TARGET policy's value
+            # of the final state, not the behavior policy's recorded one.
+            acc = 0.0 if ep.terminated else float(v[T])
             for t in range(T - 1, -1, -1):
                 acc = v[t] + ratios[t] * (r[t] + self.gamma * acc - v[t])
             vals.append(float(acc))

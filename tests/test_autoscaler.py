@@ -68,3 +68,98 @@ def test_autoscaling_cluster_scales_up_and_down():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_instance_manager_fsm():
+    """Ledger transitions with a scripted provider (reference:
+    autoscaler/v2/tests/test_instance_manager.py)."""
+    from ray_tpu.autoscaler.v2 import InstanceManager, InstanceStatus
+
+    class ScriptProvider:
+        def __init__(self):
+            self.nodes = {}
+            self.n = 0
+
+        def create_node(self, node_type, resources):
+            self.n += 1
+            pid = f"p{self.n}"
+            self.nodes[pid] = node_type
+            return pid
+
+        def terminate_node(self, pid):
+            self.nodes.pop(pid, None)
+
+        def non_terminated_nodes(self):
+            return list(self.nodes)
+
+        def node_type_of(self, pid):
+            return self.nodes.get(pid)
+
+    prov = ScriptProvider()
+    im = InstanceManager(prov, {"cpu2": {"resources": {"CPU": 2}}})
+    (iid,) = im.queue_instances("cpu2", 1)
+    assert im.instances()[0].status == InstanceStatus.QUEUED
+    # one observed transition per reconcile tick
+    im.reconcile(cluster_alive_count=1)
+    assert im.instances()[0].status == InstanceStatus.REQUESTED
+    im.reconcile(cluster_alive_count=1)
+    assert im.instances()[0].status == InstanceStatus.ALLOCATED
+    im.reconcile(cluster_alive_count=2)
+    assert im.instances()[0].status == InstanceStatus.RAY_RUNNING
+    # terminate path
+    im.request_terminate(iid)
+    im.reconcile(cluster_alive_count=2)
+    inst = im.instances({InstanceStatus.TERMINATED})
+    assert len(inst) == 1 and not prov.nodes
+    assert "QUEUED->REQUESTED" in inst[0].history[0]
+    # provider-side disappearance → TERMINATED
+    (iid2,) = im.queue_instances("cpu2", 1)
+    im.reconcile(1)
+    im.reconcile(1)
+    prov.nodes.clear()  # simulate preemption
+    im.reconcile(1)
+    inst2 = [i for i in im.instances({InstanceStatus.TERMINATED}) if i.instance_id == iid2]
+    assert len(inst2) == 1
+
+
+def test_autoscaler_v2_scales_up_and_down():
+    from ray_tpu.autoscaler.v2 import AutoscalerV2, InstanceStatus
+
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1},
+        worker_node_types={
+            "cpu2": {"resources": {"CPU": 2}, "min_workers": 0, "max_workers": 3},
+        },
+        autoscaler_cls=AutoscalerV2,
+        interval_s=0.5,
+        idle_timeout_s=4.0,
+    )
+    try:
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(num_cpus=2)
+        def heavy(x):
+            time.sleep(1.0)
+            return x
+
+        refs = [heavy.remote(i) for i in range(4)]
+        assert sorted(ray_tpu.get(refs, timeout=90)) == [0, 1, 2, 3]
+        im = cluster.autoscaler.instance_manager
+        assert im.instances()  # ledger populated
+        assert any(
+            i.status == InstanceStatus.RAY_RUNNING for i in im.instances()
+        ) or any(i.status == InstanceStatus.TERMINATED for i in im.instances(None))
+
+        deadline = time.monotonic() + 40
+        while time.monotonic() < deadline:
+            if not cluster.provider.non_terminated_nodes():
+                break
+            time.sleep(0.5)
+        assert not cluster.provider.non_terminated_nodes(), "idle nodes never reaped"
+        # every instance ends terminal, with a coherent history
+        for inst in im.instances(None):
+            assert inst.status == InstanceStatus.TERMINATED
+            assert inst.history[0].startswith("QUEUED->")
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
