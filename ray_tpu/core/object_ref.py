@@ -90,6 +90,10 @@ class ObjectRefGenerator:
     def __init__(self, task_id):
         self.task_id = task_id
         self._index = 0
+        # Optional per-item wait bound (seconds); None blocks until the
+        # producer yields. Consumers (e.g. serve streaming) set this so a
+        # stalled generator cannot hang them forever.
+        self.timeout = None
 
     def __iter__(self):
         return self
@@ -97,7 +101,9 @@ class ObjectRefGenerator:
     def __next__(self) -> "ObjectRef":
         from ray_tpu.core.api import _require_worker
 
-        status = _require_worker()._call("stream_next", self.task_id, self._index)
+        status = _require_worker()._call(
+            "stream_next", self.task_id, self._index, timeout=self.timeout
+        )
         if status is None:
             raise StopIteration
         ref = ObjectRef(ObjectID.for_task_return(self.task_id, self._index))

@@ -21,7 +21,11 @@ from ray_tpu.serve.api import (
     start,
     status,
 )
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentStreamingResponse,
+)
 
 __all__ = [
     "deployment",
@@ -33,4 +37,5 @@ __all__ = [
     "get_deployment_handle",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentStreamingResponse",
 ]

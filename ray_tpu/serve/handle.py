@@ -41,6 +41,59 @@ class DeploymentResponse:
         return (ObjectRef, (self._ref.id,))
 
 
+class DeploymentStreamingResponse:
+    """Iterator over a streaming deployment call's items (reference:
+    handle.py DeploymentResponseGenerator). Each ``next()`` blocks until
+    the replica yields the next item (bounded by ``item_timeout_s``).
+
+    The router's in-flight count is released on exhaustion, on ANY
+    error, on close(), and as a last resort on GC — an abandoned stream
+    (client disconnect, the normal LLM cancel path) must not leave a
+    phantom in-flight count biasing p2c routing and autoscaling forever.
+    """
+
+    def __init__(self, ref_gen, on_done, item_timeout_s: Optional[float] = 60.0):
+        self._gen = ref_gen
+        self._gen.timeout = item_timeout_s
+        self._on_done = on_done
+        self._finished = False
+        self._timeout = item_timeout_s
+
+    def _finish(self):
+        if not self._finished:
+            self._finished = True
+            try:
+                self._on_done()
+            except Exception:  # noqa: BLE001 — release must never raise
+                pass
+
+    def close(self):
+        self._finish()
+
+    def __del__(self):
+        self._finish()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+
+        try:
+            ref = next(self._gen)
+        except StopIteration:
+            self._finish()
+            raise
+        except BaseException:
+            self._finish()
+            raise
+        try:
+            return ray_tpu.get(ref, timeout=self._timeout)
+        except BaseException:
+            self._finish()
+            raise
+
+
 class _Router:
     def __init__(self, deployment_name: str, controller):
         import uuid
@@ -138,6 +191,20 @@ class DeploymentHandle:
         replica = self._router.pick()
         ref = replica.handle_request.remote(self._method, args, kwargs)
         return DeploymentResponse(ref, on_done=lambda r=replica: self._router.done(r))
+
+    def stream(self, *args, **kwargs) -> DeploymentStreamingResponse:
+        """Streaming call: the deployment method is a generator; items
+        arrive as they are yielded (reference: handle.options(stream=True)
+        → DeploymentResponseGenerator; the LLM token-streaming path)."""
+        args = tuple(_unwrap(a) for a in args)
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+        replica = self._router.pick()
+        gen = replica.handle_request_stream.options(num_returns="streaming").remote(
+            self._method, args, kwargs
+        )
+        return DeploymentStreamingResponse(
+            gen, on_done=lambda r=replica: self._router.done(r)
+        )
 
     def __reduce__(self):
         return (_rebuild_handle, (self.deployment_name, self._method))

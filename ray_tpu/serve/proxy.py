@@ -29,6 +29,11 @@ class ProxyActor:
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
+            # Chunked transfer encoding is an HTTP/1.1 construct; the
+            # default HTTP/1.0 status line would make strict clients
+            # (Go net/http etc.) read the raw chunk framing as the body.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *args):
                 pass
 
@@ -51,8 +56,59 @@ class ProxyActor:
                 n = int(self.headers.get("Content-Length", 0))
                 self._handle(self.rfile.read(n))
 
+            def _wants_stream(self) -> bool:
+                # NDJSON only — no text/event-stream trigger: SSE clients
+                # expect "data:" framing, which this proxy does not emit.
+                accept = self.headers.get("Accept", "")
+                return (
+                    "application/x-ndjson" in accept
+                    or self.headers.get("X-Stream") == "1"
+                )
+
+            def _send_stream(self, items):
+                """Chunked NDJSON: one JSON line per yielded item, flushed
+                as produced (reference: proxy streaming responses — the
+                LLM token-streaming path)."""
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes) -> bool:
+                    try:
+                        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                        self.wfile.flush()
+                        return True
+                    except OSError:
+                        return False  # client went away — just stop
+
+                alive = True
+                try:
+                    for item in items:
+                        alive = chunk(json.dumps(item, default=str).encode() + b"\n")
+                        if not alive:
+                            break
+                except Exception as e:  # noqa: BLE001 — replica error → error line
+                    alive = alive and chunk(
+                        json.dumps({"error": str(e)}).encode() + b"\n"
+                    )
+                finally:
+                    close = getattr(items, "close", None)
+                    if close:
+                        close()  # release the router's in-flight slot
+                if alive:
+                    try:
+                        self.wfile.write(b"0\r\n\r\n")
+                    except OSError:
+                        pass
+                else:
+                    self.close_connection = True
+
             def _handle(self, body: bytes):
                 try:
+                    if self._wants_stream():
+                        self._send_stream(proxy._dispatch_stream(self.path, body))
+                        return
                     result = proxy._dispatch(self.path, body)
                     self._send(200, json.dumps(result, default=str).encode())
                 except KeyError:
@@ -67,7 +123,7 @@ class ProxyActor:
     def _routes(self) -> Dict[str, str]:
         return ray_tpu.get(self._controller.routes.remote())
 
-    def _dispatch(self, path: str, body: bytes):
+    def _resolve(self, path: str, body: bytes):
         routes = self._routes()
         route = path.split("?")[0].rstrip("/") or "/"
         name = routes.get(route)
@@ -80,8 +136,16 @@ class ProxyActor:
             payload = json.loads(body) if body else None
         except json.JSONDecodeError:
             payload = body.decode(errors="replace")
+        return handle, payload
+
+    def _dispatch(self, path: str, body: bytes):
+        handle, payload = self._resolve(path, body)
         resp = handle.remote(payload) if payload is not None else handle.remote()
         return resp.result(timeout=60)
+
+    def _dispatch_stream(self, path: str, body: bytes):
+        handle, payload = self._resolve(path, body)
+        return handle.stream(payload) if payload is not None else handle.stream()
 
     def port(self) -> int:
         return self._port

@@ -25,6 +25,27 @@ class Replica:
             return self.instance(*args, **kwargs)
         return getattr(self.instance, method_name)(*args, **kwargs)
 
+    def handle_request_stream(self, method_name: str, args: tuple, kwargs: dict):
+        """Generator deployments: each yielded item becomes its own
+        streamed object (reference: replica.py streaming request path —
+        token streaming for LLM serving). Invoke with
+        ``num_returns="streaming"``."""
+        import inspect
+
+        target = (
+            self.instance if method_name == "__call__" else getattr(self.instance, method_name)
+        )
+        result = target(*args, **kwargs)
+        # Only genuine generators/iterators stream element-wise; plain
+        # containers (list/tuple/dict/str) are ONE response — the same
+        # value the non-streaming path would return.
+        if inspect.isgenerator(result) or (
+            hasattr(result, "__next__") and not isinstance(result, (str, bytes))
+        ):
+            yield from result
+            return
+        yield result
+
     def check_health(self) -> str:
         # User classes may define their own probe (reference:
         # replica.py check_health passthrough).
