@@ -11,6 +11,7 @@ demand-driven bin-packing as v1 (autoscaler.py bin_pack_new_nodes).
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import uuid
@@ -19,6 +20,8 @@ from typing import Dict, List, Optional
 
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler, bin_pack_new_nodes
 from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger("ray_tpu.autoscaler.v2")
 
 
 class InstanceStatus:
@@ -97,16 +100,21 @@ class InstanceManager:
     def reconcile(self, cluster_alive_count: int):
         """One tick: push QUEUED→REQUESTED via the provider, observe
         provider state for ALLOCATED, match cluster membership for
-        RAY_RUNNING, and complete RAY_STOPPING terminations."""
+        RAY_RUNNING, and complete RAY_STOPPING terminations.
+
+        Provider RPCs (create_node/terminate_node) run OUTSIDE the lock:
+        with a real cloud provider these are slow network calls that must
+        not block instances()/counts_by_type() — and a provider
+        implementation that calls back into the manager would deadlock.
+        Pattern: decide under the lock, call the provider unlocked, then
+        re-acquire to commit."""
         provider_nodes = set(self.provider.non_terminated_nodes())
+        to_create: List[Instance] = []
+        to_terminate: List[Instance] = []
         with self._lock:
             for inst in self._instances.values():
                 if inst.status == InstanceStatus.QUEUED:
-                    pid = self.provider.create_node(
-                        inst.node_type, self.node_types[inst.node_type]["resources"]
-                    )
-                    inst.provider_id = pid
-                    inst.transition(InstanceStatus.REQUESTED)
+                    to_create.append(inst)
                 elif inst.status == InstanceStatus.REQUESTED:
                     if inst.provider_id in provider_nodes:
                         inst.transition(InstanceStatus.ALLOCATED)
@@ -125,14 +133,64 @@ class InstanceManager:
                     if inst.provider_id in provider_nodes and cluster_alive_count > 0:
                         inst.transition(InstanceStatus.RAY_RUNNING)
                 elif inst.status == InstanceStatus.RAY_STOPPING:
-                    if inst.provider_id is not None:
-                        self.provider.terminate_node(inst.provider_id)
-                    inst.transition(InstanceStatus.TERMINATED)
+                    if inst.provider_id is None:
+                        inst.transition(InstanceStatus.TERMINATED)
+                    elif (
+                        inst.provider_id not in provider_nodes
+                        and time.time() - inst.updated_at > self.requested_timeout_s
+                    ):
+                        # Absent from the provider view for a full grace
+                        # period — genuinely gone (preempted while
+                        # draining); terminate_node would fail forever.
+                        # The grace period covers eventually-consistent
+                        # list APIs that lag a recent create.
+                        inst.transition(InstanceStatus.TERMINATED)
+                    else:
+                        to_terminate.append(inst)
                 # provider-side disappearance (preemption/crash) → TERMINATED
                 if (
                     inst.status in (InstanceStatus.ALLOCATED, InstanceStatus.RAY_RUNNING)
                     and inst.provider_id not in provider_nodes
                 ):
+                    inst.transition(InstanceStatus.TERMINATED)
+        # Per-call error isolation: a mid-batch create_node failure (quota,
+        # RPC error) must not discard the provider ids of creates that
+        # already succeeded — those would leak real cloud nodes and be
+        # double-created next tick. Failed creates stay QUEUED and retry.
+        created: List[tuple] = []
+        for inst in to_create:
+            try:
+                pid = self.provider.create_node(
+                    inst.node_type, self.node_types[inst.node_type]["resources"]
+                )
+            except Exception:  # noqa: BLE001 — provider errors are retryable
+                logger.exception(
+                    "create_node failed for %s (%s); instance stays QUEUED "
+                    "and retries next tick",
+                    inst.instance_id, inst.node_type,
+                )
+                continue
+            created.append((inst, pid))
+        terminated: List[Instance] = []
+        for inst in to_terminate:
+            try:
+                self.provider.terminate_node(inst.provider_id)
+            except Exception:  # noqa: BLE001 — stays RAY_STOPPING, retried
+                logger.exception(
+                    "terminate_node failed for %s (provider id %s); retrying",
+                    inst.instance_id, inst.provider_id,
+                )
+                continue
+            terminated.append(inst)
+        with self._lock:
+            for inst, pid in created:
+                # record the provider node even if the status moved while
+                # unlocked (e.g. request_terminate) so it can be reaped
+                inst.provider_id = pid
+                if inst.status == InstanceStatus.QUEUED:
+                    inst.transition(InstanceStatus.REQUESTED)
+            for inst in terminated:
+                if inst.status == InstanceStatus.RAY_STOPPING:
                     inst.transition(InstanceStatus.TERMINATED)
 
 

@@ -91,8 +91,13 @@ class Config:
     # ray_tpu.internal.free or store eviction).
     object_auto_gc: bool = True
     # Worker-side batch flush cadence for local-ref zero crossings.
+    # COUPLING: two-phase GC safety requires gc_sweep_interval_ms >=
+    # 2 * ref_flush_interval_ms — a GC-marked object must survive one full
+    # sweep so a borrower's in-flight "held" flush can land before the
+    # free. _validate() clamps the sweep interval to keep the invariant.
     ref_flush_interval_ms: int = 200
-    # Controller GC sweep debounce after a ref update arrives.
+    # Controller GC sweep debounce after a ref update arrives (see the
+    # coupling note on ref_flush_interval_ms).
     gc_sweep_interval_ms: int = 1000
 
     # --- observability ---
@@ -106,6 +111,25 @@ class Config:
     temp_dir: str = field(default_factory=lambda: os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu"))
     log_to_driver: bool = True
 
+    def __post_init__(self):
+        self._validate()
+
+    def _validate(self):
+        # Two-phase GC safety (see ref_flush_interval_ms): clamp rather
+        # than raise so a user tuning one knob can't silently break
+        # borrowed-object liveness.
+        floor = 2 * self.ref_flush_interval_ms
+        if self.gc_sweep_interval_ms < floor:
+            import logging
+
+            logging.getLogger("ray_tpu.config").warning(
+                "gc_sweep_interval_ms=%d raised to %d (must be >= 2x "
+                "ref_flush_interval_ms for two-phase GC safety)",
+                self.gc_sweep_interval_ms, floor,
+            )
+            self.gc_sweep_interval_ms = floor
+        return self
+
     def apply_overrides(self, overrides: dict[str, Any] | None):
         if not overrides:
             return self
@@ -113,14 +137,14 @@ class Config:
             if not hasattr(self, k):
                 raise ValueError(f"Unknown config key: {k}")
             setattr(self, k, v)
-        return self
+        return self._validate()
 
     @classmethod
     def from_env(cls) -> "Config":
         cfg = cls()
         for f in fields(cls):
             setattr(cfg, f.name, _env(f.name, getattr(cfg, f.name)))
-        return cfg
+        return cfg._validate()
 
     def to_dict(self) -> dict:
         return {f.name: getattr(self, f.name) for f in fields(self)}

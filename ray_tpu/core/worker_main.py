@@ -250,6 +250,15 @@ class TaskExecutor:
         error = None
         try:
             for item in result:
+                if spec.task_id in self.cancelled:
+                    # Consumer cancelled mid-stream (abandoned LLM stream):
+                    # stop producing; close() runs the generator's finally
+                    # blocks so replica-side resources are released.
+                    try:
+                        result.close()
+                    except Exception:  # noqa: BLE001 — user close errors
+                        logger.exception("stream close failed for %s", spec.name)
+                    break
                 oid = ObjectID.for_task_return(spec.task_id, index)
                 data, contained = _serialize_capturing(item)
                 self.core.put_serialized(oid, data, contained=contained)

@@ -102,6 +102,9 @@ class MultiAgentEnvRunner:
         self._seed += 1
         self._obs: Dict[str, Any] = dict(obs)
         self._ma_episode = MultiAgentEpisode()
+        # Rewards paid to an agent before its first action of the episode
+        # (reference: multi_agent_episode hanging rewards).
+        self._hanging_rewards: Dict[str, float] = {}
         for aid, o in obs.items():
             self._ma_episode.add_reset(aid, o)
 
@@ -153,15 +156,37 @@ class MultiAgentEnvRunner:
             for aid in acting:
                 ep = self._ma_episode.agent_episodes[aid]
                 ep.actions.append(actions[aid])
-                ep.rewards.append(float(rewards.get(aid, 0.0)))
+                ep.rewards.append(
+                    float(rewards.get(aid, 0.0)) + self._hanging_rewards.pop(aid, 0.0)
+                )
                 ep.logps.append(logps[aid])
                 ep.values.append(values[aid])
-                self._return_acc += float(rewards.get(aid, 0.0))
+            # Rewards paid to agents that did NOT act this step (turn-based
+            # zero-sum envs commonly reward every agent on the terminal
+            # move) are credited to the agent's LAST action — or held as
+            # hanging rewards until its first (reference: multi-agent
+            # hanging-reward accumulation).
+            for aid, r in rewards.items():
+                if aid in acting or not r:
+                    continue
+                ep = self._ma_episode.agent_episodes.get(aid)
+                if ep is not None and len(ep) > 0:
+                    ep.rewards[-1] += float(r)
+                else:
+                    self._hanging_rewards[aid] = (
+                        self._hanging_rewards.get(aid, 0.0) + float(r)
+                    )
+            self._return_acc += sum(float(r) for r in rewards.values())
             steps += 1
-            all_done = terms.get("__all__", False) or truncs.get("__all__", False)
+            all_term = terms.get("__all__", False)
+            all_done = all_term or truncs.get("__all__", False)
             for aid in acting:
                 ep = self._ma_episode.agent_episodes[aid]
-                a_term = terms.get(aid, False)
+                # An env may end the whole episode with only __all__ set:
+                # every live agent is then *terminated* (no value bootstrap),
+                # not truncated (reference: multi_agent_env_runner treats
+                # __all__-termination as terminal for all agents).
+                a_term = terms.get(aid, False) or all_term
                 a_trunc = truncs.get(aid, False)
                 if aid in obs:
                     ep.observations.append(obs[aid])
@@ -180,6 +205,23 @@ class MultiAgentEnvRunner:
                     # starts a fresh episode.
                     del self._ma_episode.agent_episodes[aid]
             if all_done:
+                # Finalize agents that did NOT act on the final step but
+                # still have in-progress episodes (turn-based envs observe
+                # one agent per step): their transitions must not be
+                # discarded by _reset_env.
+                for aid, ep in list(self._ma_episode.agent_episodes.items()):
+                    if len(ep) > 0:
+                        if aid in obs:
+                            # env supplied a real final observation —
+                            # replace the stale duplicate so a truncation
+                            # bootstrap uses it
+                            ep.observations[-1] = obs[aid]
+                        mid = self._mapping(aid)
+                        ep.terminated = bool(all_term)
+                        ep.truncated = not all_term
+                        if not all_term:
+                            ep.final_value = self._bootstrap(mid, ep.observations[-1])
+                        done.append((mid, ep))
                 self._completed_returns.append(self._return_acc)
                 self._return_acc = 0.0
                 self._reset_env()
@@ -190,6 +232,13 @@ class MultiAgentEnvRunner:
                         # late-joining agent (reference: agents may enter
                         # mid-episode)
                         self._ma_episode.add_reset(aid, obs[aid])
+                    elif aid not in acting:
+                        # Re-observed without having acted this step (turn-
+                        # based envs): its last stored observation is the
+                        # stale duplicate appended when it last acted —
+                        # replace it so the obs the agent will act on is
+                        # the one stored at index len(actions).
+                        self._ma_episode.agent_episodes[aid].observations[-1] = obs[aid]
         # cut in-progress per-agent episodes with bootstrap values
         for aid, ep in list(self._ma_episode.agent_episodes.items()):
             if len(ep) > 0:

@@ -57,11 +57,24 @@ class DeploymentStreamingResponse:
         self._gen.timeout = item_timeout_s
         self._on_done = on_done
         self._finished = False
+        self._exhausted = False
         self._timeout = item_timeout_s
 
     def _finish(self):
         if not self._finished:
             self._finished = True
+            if not self._exhausted:
+                # Abandoned before exhaustion (client disconnect — the
+                # normal LLM cancel path): cancel the replica-side
+                # generator task so it stops producing and pinning stream
+                # objects (reference: serve request cancellation →
+                # ray.cancel on the replica task).
+                try:
+                    from ray_tpu.core.api import _require_worker
+
+                    _require_worker().cancel_task(self._gen.task_id, False)
+                except Exception:  # noqa: BLE001 — best-effort on teardown
+                    pass
             try:
                 self._on_done()
             except Exception:  # noqa: BLE001 — release must never raise
@@ -82,6 +95,7 @@ class DeploymentStreamingResponse:
         try:
             ref = next(self._gen)
         except StopIteration:
+            self._exhausted = True
             self._finish()
             raise
         except BaseException:

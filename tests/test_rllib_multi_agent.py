@@ -83,6 +83,76 @@ def test_ma_env_runner_sampling():
     assert total >= 80  # 40 joint steps x 2 agents
 
 
+class TurnBasedEnv(MultiAgentEnv):
+    """Exactly one agent is observed (and acts) per step — the pattern the
+    reference multi_agent_env_runner supports. The episode ends via
+    ``terms['__all__']`` ONLY (no per-agent keys)."""
+
+    possible_agents = ["a", "b"]
+
+    def __init__(self, length: int = 6):
+        self.length = length
+        self._t = 0
+
+    def _obs_for(self, t):
+        agent = self.possible_agents[t % 2]
+        return {agent: np.eye(4, dtype=np.float32)[t % 4]}
+
+    def reset(self, *, seed=None):
+        self._t = 0
+        return self._obs_for(0), {}
+
+    def step(self, action_dict):
+        rewards = {aid: 1.0 for aid in action_dict}
+        self._t += 1
+        done = self._t >= self.length
+        if done:
+            # zero-sum terminal payout: the NON-acting agent is penalized
+            # on the final move (it did not act this step)
+            for aid in self.possible_agents:
+                if aid not in action_dict:
+                    rewards[aid] = -1.0
+        obs = {} if done else self._obs_for(self._t)
+        return obs, rewards, {"__all__": done}, {"__all__": False}, {}
+
+
+def test_ma_turn_based_all_done_finalization():
+    """Agents that did not act on the terminal step keep their episodes,
+    and __all__-terminated agents are terminated (no bootstrap)."""
+    specs, mapping = _specs(shared=False)
+    runner = MultiAgentEnvRunner(TurnBasedEnv, specs, mapping, seed=0)
+    frags = runner.sample(12)  # two full 6-step episodes, alternating turns
+    # every sampled agent-step is retained (one agent acts per joint step)
+    assert sum(len(ep) for _, ep in frags) == 12
+    by_mid = {}
+    for mid, ep in frags:
+        by_mid.setdefault(mid, []).append(ep)
+    # both agents' fragments present: 2 episodes x 2 agents
+    assert set(by_mid) == {"pol_a", "pol_b"}
+    assert len(by_mid["pol_a"]) == 2 and len(by_mid["pol_b"]) == 2
+    for eps in by_mid.values():
+        for ep in eps:
+            assert ep.terminated and not ep.truncated
+            assert ep.final_value == 0.0  # terminated => no value bootstrap
+            assert len(ep.observations) == len(ep.actions) + 1
+    # obs/action alignment: agent 'a' acts at joint steps 0,2,4 observing
+    # one-hots [0,2,0]; 'b' at 1,3,5 observing [1,3,1]. Stale duplicate
+    # observations must have been refreshed on re-observation.
+    expect = {"pol_a": [0, 2, 0], "pol_b": [1, 3, 1]}
+    for mid, eps in by_mid.items():
+        for ep in eps:
+            seen = [int(np.argmax(o)) for o in ep.observations[: len(ep)]]
+            assert seen == expect[mid], (mid, seen)
+    # terminal reward paid to the NON-acting agent ('a'; 'b' makes the
+    # final move) must be credited to a's last action, not dropped
+    for ep in by_mid["pol_a"]:
+        assert ep.rewards == [1.0, 1.0, 0.0], ep.rewards  # +1,+1,(+1-1)
+    for ep in by_mid["pol_b"]:
+        assert ep.rewards == [1.0, 1.0, 1.0], ep.rewards
+    # episode returns count every agent's rewards: 3 + 2 per episode
+    assert runner.pop_metrics() == [5.0, 5.0]
+
+
 def test_ma_ppo_learns_separate_policies():
     specs, mapping = _specs(shared=False)
     config = (
