@@ -72,6 +72,105 @@ def bench_actor_call_throughput(calls: int) -> dict:
     }
 
 
+def bench_1to1_async_calls(calls: int) -> dict:
+    """Single driver → single actor, fully pipelined (reference
+    microbenchmark '1:1 async actor calls', ray_perf.py)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 0
+
+    a = A.remote()
+    ray_tpu.wait_actor_ready(a)
+    ray_tpu.get([a.ping.remote() for _ in range(100)])
+    t0 = time.perf_counter()
+    refs = [a.ping.remote() for _ in range(calls)]
+    ray_tpu.get(refs)
+    dt = time.perf_counter() - t0
+    ray_tpu.kill(a)
+    return {
+        "benchmark": "1to1_async_actor_calls",
+        "n": calls,
+        "calls_per_s": round(calls / dt, 1),
+    }
+
+
+def bench_n_to_n_calls(n: int, calls: int) -> dict:
+    """n caller processes each hammering their own actor (reference
+    microbenchmark 'n:n async actor calls') — exercises the direct
+    caller→actor transport from worker processes."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Target:
+        def ping(self):
+            return 0
+
+    @ray_tpu.remote
+    class Caller:
+        def __init__(self, target):
+            self.target = target
+
+        def warmup(self):
+            import ray_tpu as rt
+
+            rt.get([self.target.ping.remote() for _ in range(50)])
+            return 0
+
+        def hammer(self, m: int) -> float:
+            import time as _t
+
+            import ray_tpu as rt
+
+            t0 = _t.perf_counter()
+            refs = [self.target.ping.remote() for _ in range(m)]
+            rt.get(refs)
+            return _t.perf_counter() - t0
+
+    targets = [Target.remote() for _ in range(n)]
+    callers = [Caller.remote(t) for t in targets]
+    ray_tpu.get([c.warmup.remote() for c in callers])
+    t0 = time.perf_counter()
+    ray_tpu.get([c.hammer.remote(calls) for c in callers])
+    wall = time.perf_counter() - t0
+    for a in targets + callers:
+        ray_tpu.kill(a)
+    return {
+        "benchmark": "n_to_n_async_actor_calls",
+        "n_pairs": n,
+        "calls_per_caller": calls,
+        "calls_per_s": round(n * calls / wall, 1),
+    }
+
+
+def bench_small_object_get(n: int) -> dict:
+    """Small-object get throughput (reference microbenchmark 'plasma
+    get calls' ~10.3k/s): cold = uncached controller-directory gets;
+    warm = owner-local memory-store hits."""
+    import ray_tpu
+    from ray_tpu.core.api import free
+
+    refs = [ray_tpu.put(i) for i in range(n)]
+    t0 = time.perf_counter()
+    for r in refs:
+        ray_tpu.get(r)
+    cold = n / (time.perf_counter() - t0)
+    one = refs[0]
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(one)
+    warm = n / (time.perf_counter() - t0)
+    free(refs)
+    return {
+        "benchmark": "small_object_get",
+        "n": n,
+        "cold_gets_per_s": round(cold, 1),
+        "warm_gets_per_s": round(warm, 1),
+    }
+
+
 def bench_many_pgs(n: int) -> dict:
     import ray_tpu
     from ray_tpu.util.placement_group import placement_group, remove_placement_group
@@ -121,20 +220,26 @@ def main():
     p.add_argument("--calls", type=int, default=1000)
     p.add_argument("--pgs", type=int, default=50)
     p.add_argument("--object-mb", type=int, default=64)
+    p.add_argument("--direct-calls", type=int, default=20000)
+    p.add_argument("--pairs", type=int, default=8)
+    p.add_argument("--small-gets", type=int, default=3000)
     args = p.parse_args()
 
     ray_tpu.init(num_cpus=8)
     try:
         # Stream each result as it completes — a hang mid-suite must not
         # discard the lines already earned.
-        for fn, arg in (
-            (bench_many_tasks, args.tasks),
-            (bench_many_actors, args.actors),
-            (bench_actor_call_throughput, args.calls),
-            (bench_many_pgs, args.pgs),
-            (bench_object_store, args.object_mb),
+        for fn, fnargs in (
+            (bench_many_tasks, (args.tasks,)),
+            (bench_many_actors, (args.actors,)),
+            (bench_actor_call_throughput, (args.calls,)),
+            (bench_1to1_async_calls, (args.direct_calls,)),
+            (bench_n_to_n_calls, (args.pairs, args.direct_calls // 2)),
+            (bench_small_object_get, (args.small_gets,)),
+            (bench_many_pgs, (args.pgs,)),
+            (bench_object_store, (args.object_mb,)),
         ):
-            print(json.dumps(fn(arg)), flush=True)
+            print(json.dumps(fn(*fnargs)), flush=True)
     finally:
         ray_tpu.shutdown()
 

@@ -79,6 +79,13 @@ class Config:
     # crashes, not host power loss.
     gcs_journal_fsync: bool = False
 
+    # --- direct transport ---
+    # Push actor tasks straight from the caller to the actor's worker
+    # (reference: actor_task_submitter.h caller→actor gRPC); results land
+    # in the caller's owner-local memory store. Off → every call routes
+    # through the controller (the pre-round-2 path).
+    direct_actor_calls: bool = True
+
     # --- control plane ---
     raylet_heartbeat_period_s: float = 0.5
     pubsub_batch_size: int = 1000

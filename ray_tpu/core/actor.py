@@ -136,7 +136,7 @@ class ActorHandle:
         digest = hashlib.blake2b(blob, digest_size=16).digest()
         args_blob, deps, captures = core.build_args(args, kwargs)
         spec = TaskSpec(
-            task_id=TaskID.from_random(),
+            task_id=core.next_task_id(),
             task_type=TaskType.ACTOR_TASK,
             name=_name or f"actor.{getattr(fn, '__name__', 'fn')}",
             func_digest=digest,
@@ -146,6 +146,8 @@ class ActorHandle:
             num_returns=1,
             resources=ResourceSet.from_dict({}),
             owner_id=core.worker_id,
+            max_retries=0,  # __ray_call__ has actor-task semantics: no
+            # implicit retry (the TaskSpec default of 3 is for normal tasks)
             actor_id=self._actor_id,
             actor_method_name=None,
         )
@@ -189,7 +191,7 @@ class ActorMethod:
         from ray_tpu.util import tracing as _tracing
 
         spec = TaskSpec(
-            task_id=TaskID.from_random(),
+            task_id=core.next_task_id(),
             task_type=TaskType.ACTOR_TASK,
             name=f"actor.{self._name}",
             func_digest=b"\x00" * 16,

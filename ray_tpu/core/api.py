@@ -211,7 +211,7 @@ def cancel(ref: ObjectRef, *, force: bool = False):
     # The return object id embeds the producing task id only server-side;
     # look the task up by its return object.
     core = _require_worker()
-    core._call("cancel_by_object", ref.id, force)
+    core.cancel_by_object(ref.id, force)
 
 
 def get_actor(name: str) -> ActorHandle:

@@ -86,6 +86,16 @@ def _serialize_parts_capturing(value: Any):
         contained = _capture.get()
     finally:
         _capture.reset(token)
+    if contained:
+        # serialize_parts may pickle twice (fast-path fallback) — dedupe
+        # the captured refs so pins aren't double-counted
+        seen, out = set(), []
+        for c in contained:
+            k = c.binary() if hasattr(c, "binary") else bytes(c)
+            if k not in seen:
+                seen.add(k)
+                out.append(c)
+        contained = out
     return meta, raws, total, contained
 
 
@@ -109,7 +119,10 @@ class CoreWorker:
         worker_id: Optional[WorkerID] = None,
         node_id: Optional[NodeID] = None,
         local_shm_dir: Optional[str] = None,
+        listen_addr: str = "",
     ):
+        from ray_tpu.core.memory_store import LocalMemoryStore
+
         self.mode = mode
         self.address = address
         self.loop_runner = loop_runner
@@ -125,13 +138,27 @@ class CoreWorker:
             self.node_id = NodeID.from_hex(info["head_node_id"])
             self.local_shm_dir = info["shm_dir"]
         else:
-            info = self._call("register_worker", self.worker_id, node_id, os.getpid())
+            info = self._call(
+                "register_worker", self.worker_id, node_id, os.getpid(),
+                listen_addr=listen_addr,
+            )
             self.local_shm_dir = local_shm_dir
         self.session_dir = info["session_dir"]
         self.config = info["config"]
         self.inline_limit = self.config.get("max_inline_object_size", INLINE_LIMIT_FALLBACK)
         self.plasma = PlasmaClient(self.local_shm_dir)
         self._plasma_clients: dict[str, PlasmaClient] = {}
+        # Owner-local memory store + direct actor transport (reference:
+        # memory_store.cc; actor_task_submitter.h caller→actor push).
+        self.memory_store = LocalMemoryStore()
+        self.direct_enabled = bool(self.config.get("direct_actor_calls", True))
+        self._submitters: dict = {}  # ActorID -> ActorSubmitter
+        self._direct_tasks: dict = {}  # TaskID -> ActorSubmitter (cancel routing)
+        self._direct_returns: dict = {}  # return ObjectID -> TaskID
+        # Batched caller-thread → loop handoff for direct submissions.
+        self._direct_handoff = rpc.BatchedHandoff(
+            self.loop_runner.loop, lambda item: item[0]._enqueue(item[1])
+        )
         # Distributed ref counting: local ref table + periodic flush of
         # held/dropped transitions to the controller.
         self.refs = RefTracker()
@@ -150,9 +177,21 @@ class CoreWorker:
         while not self._refs_closed.is_set():
             await asyncio.sleep(interval)
             held, dropped = self.refs.drain()
-            if held or dropped:
+            # Owner-local (never-promoted) objects don't exist in the
+            # controller's directory: their GC is a local eviction, and
+            # mentioning them to the controller would create leaked empty
+            # records (reference: memory-store objects are owner-private).
+            ms = self.memory_store
+            g_held = [k for k in held if not ms.is_local_only(k)]
+            g_dropped = []
+            for k in dropped:
+                local_only = ms.is_local_only(k)
+                ms.evict(k)
+                if not local_only:
+                    g_dropped.append(k)
+            if g_held or g_dropped:
                 try:
-                    await self.peer.notify("ref_update", me, held, dropped)
+                    await self.peer.notify("ref_update", me, g_held, g_dropped)
                 except Exception:
                     return  # connection gone; controller reaps us on disconnect
 
@@ -171,6 +210,8 @@ class CoreWorker:
 
         oid = ObjectID.for_put(self.worker_id, next(self._put_counter))
         meta, raws, total, contained = _serialize_parts_capturing(value)
+        if contained:
+            self.promote_refs(contained)  # nested refs escape via the put
         if total <= self.inline_limit:
             self._call(
                 "object_put_inline", oid, assemble_parts(meta, raws), False, contained or []
@@ -184,6 +225,8 @@ class CoreWorker:
     def put_serialized(
         self, oid: ObjectID, data: bytes, is_error: bool = False, contained: Optional[list] = None
     ):
+        if contained:
+            self.promote_refs(contained)
         if len(data) <= self.inline_limit:
             self._call("object_put_inline", oid, data, is_error, contained or [])
         else:
@@ -211,22 +254,78 @@ class CoreWorker:
 
     def _get_values(self, oids: List[ObjectID], timeout: Optional[float] = None) -> List[Any]:
         self._check_async_errors()
-        resp = self._call("object_get", oids, timeout)
-        if resp["timeout"]:
-            raise GetTimeoutError(f"get() timed out after {timeout}s")
-        metas = resp["metas"]
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        # Partition: owner-local entries resolve in-process with ZERO
+        # controller round-trips (reference: memory_store.cc Get); the
+        # rest go through the controller directory.
+        local: dict[bytes, Any] = {}
+        remote: List[ObjectID] = []
+        for oid in oids:
+            e = self.memory_store.lookup(oid.binary())
+            if e is not None and e.kind == "inline":
+                local[oid.binary()] = e
+            else:
+                remote.append(oid)
+        resp_fut = self._submit("object_get", remote, timeout) if remote else None
+        local_values: dict[bytes, tuple] = {}
+        shm_fallback: List[ObjectID] = []
+        for oid in oids:
+            e = local.get(oid.binary())
+            if e is None:
+                continue
+            remain = None if deadline is None else max(0.0, deadline - _time.monotonic())
+            try:
+                payload, is_err = e.value(remain)
+            except TimeoutError:
+                if resp_fut is not None:
+                    resp_fut.cancel()
+                raise GetTimeoutError(f"get() timed out after {timeout}s")
+            if e.kind == "shm":
+                # resolved to a large result living in the global store
+                shm_fallback.append(oid)
+            else:
+                local_values[oid.binary()] = (payload, is_err)
+        metas = {}
+        if resp_fut is not None:
+            resp = resp_fut.result()
+            if resp["timeout"]:
+                raise GetTimeoutError(f"get() timed out after {timeout}s")
+            metas = resp["metas"]
+        if shm_fallback:
+            remain = None if deadline is None else max(0.0, deadline - _time.monotonic())
+            resp = self._call("object_get", shm_fallback, remain)
+            if resp["timeout"]:
+                raise GetTimeoutError(f"get() timed out after {timeout}s")
+            metas.update(resp["metas"])
         out = []
         for oid in oids:
-            meta = metas[oid.hex()]
-            kind = meta[0]
-            if kind == "lost":
-                raise ObjectLostError(oid.hex(), "object lost and could not be reconstructed")
-            if kind == "inline":
-                _, data, is_error = meta
-                value = deserialize(data)
+            entry = local_values.get(oid.binary())
+            if entry is not None:
+                payload, is_error = entry
+                if isinstance(payload, Exception):
+                    raise payload
+                value = deserialize(payload)
             else:
-                _, size, node_hex, shm_dir, is_error = meta
-                value = deserialize(self._read_object(oid, size, node_hex, shm_dir))
+                meta = metas[oid.hex()]
+                kind = meta[0]
+                if kind == "lost":
+                    raise ObjectLostError(oid.hex(), "object lost and could not be reconstructed")
+                if kind == "inline":
+                    _, data, is_error = meta
+                    # Objects are immutable: cache the fetched value so
+                    # repeated gets are process-local (reference:
+                    # memory_store.cc caches gotten small objects).
+                    # promoted=True keeps ref flushes going to the
+                    # controller; the entry evicts when local refs drop.
+                    key = oid.binary()
+                    self.memory_store.put(key, data, is_error)
+                    self.memory_store.mark_promoted(key)
+                    value = deserialize(data)
+                else:
+                    _, size, node_hex, shm_dir, is_error = meta
+                    value = deserialize(self._read_object(oid, size, node_hex, shm_dir))
             if is_error:
                 raise value
             out.append(value)
@@ -256,6 +355,13 @@ class CoreWorker:
 
     def get_raw(self, oid: ObjectID) -> tuple[Any, bool]:
         """(value, is_error) without raising — used by arg resolution."""
+        e = self.memory_store.lookup(oid.binary())
+        if e is not None and e.kind == "inline":
+            payload, is_err = e.value()
+            if e.kind == "inline":  # may flip to shm while pending
+                if isinstance(payload, Exception):
+                    return payload, True
+                return deserialize(payload), is_err
         resp = self._call("object_get", [oid], None)
         meta = resp["metas"][oid.hex()]
         if meta[0] == "lost":
@@ -267,14 +373,72 @@ class CoreWorker:
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1, timeout: Optional[float] = None):
         self._check_async_errors()
-        ready_hex = set(self._call("object_wait", [r.id for r in refs], num_returns, timeout))
+        import time as _time
+
+        local_futs = {}  # ref -> Entry future (resolution == readiness)
+        remote = []
+        for r in refs:
+            e = self.memory_store.lookup(r.id.binary())
+            if e is not None:
+                local_futs[r] = e.ensure_future()
+            else:
+                remote.append(r)
+        if not local_futs:
+            ready_hex = set(self._call("object_wait", [r.id for r in refs], num_returns, timeout))
+            return self._split_wait(refs, ready_hex, num_returns)
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        if not remote:
+            import concurrent.futures as _cf
+
+            pending = {f for f in local_futs.values() if not f.done()}
+            while True:
+                ready_hex = {r.id.hex() for r, f in local_futs.items() if f.done()}
+                if len(ready_hex) >= num_returns or not pending:
+                    return self._split_wait(refs, ready_hex, num_returns)
+                remain = None if deadline is None else deadline - _time.monotonic()
+                if remain is not None and remain <= 0:
+                    return self._split_wait(refs, ready_hex, num_returns)
+                done, pending = _cf.wait(
+                    pending, timeout=remain, return_when=_cf.FIRST_COMPLETED
+                )
+                if not done and remain is not None:
+                    return self._split_wait(
+                        refs,
+                        {r.id.hex() for r, f in local_futs.items() if f.done()},
+                        num_returns,
+                    )
+        # Mixed local/remote: poll the controller in short slices while
+        # local futures resolve independently (rare path — a wait over
+        # both direct-call results and globally-owned objects).
+        remote_ready: set = set()
+        while True:
+            ready_hex = {r.id.hex() for r, f in local_futs.items() if f.done()} | remote_ready
+            remain = None if deadline is None else deadline - _time.monotonic()
+            need = num_returns - len(ready_hex)
+            if need <= 0 or (remain is not None and remain <= 0):
+                return self._split_wait(refs, ready_hex, num_returns)
+            slice_t = 0.05 if remain is None else max(0.0, min(0.05, remain))
+            remote_ready |= set(
+                self._call("object_wait", [r.id for r in remote], max(need, 1), slice_t)
+            )
+
+    @staticmethod
+    def _split_wait(refs, ready_hex, num_returns):
         ready, not_ready = [], []
         for r in refs:
             (ready if r.id.hex() in ready_hex and len(ready) < num_returns else not_ready).append(r)
         return ready, not_ready
 
     def free(self, refs: Sequence[ObjectRef]):
-        self._call("object_free", [r.id for r in refs])
+        remote = []
+        for r in refs:
+            key = r.id.binary()
+            local_only = self.memory_store.is_local_only(key)
+            self.memory_store.evict(key)  # drop local copy either way
+            if not local_only:
+                remote.append(r.id)
+        if remote:
+            self._call("object_free", remote)
 
     # ------------------------------------------------------------------
     # Tasks
@@ -321,16 +485,82 @@ class CoreWorker:
         return [ObjectRef(oid) for oid in spec.return_ids()]
 
     def submit_task(self, spec: TaskSpec, captures: Optional[list] = None) -> List[ObjectRef]:
+        self.promote_refs(list(spec.dependencies) + list(captures or []))
         return self._submit_pipelined(spec, captures)
 
     def create_actor(self, spec: TaskSpec, captures: Optional[list] = None):
+        self.promote_refs(list(spec.dependencies) + list(captures or []))
         self._call("create_actor", spec, captures or [])
 
     def submit_actor_task(self, spec: TaskSpec, captures: Optional[list] = None) -> List[ObjectRef]:
-        return self._submit_pipelined(spec, captures)
+        if not self.direct_enabled or spec.is_streaming:
+            self.promote_refs(list(spec.dependencies) + list(captures or []))
+            return self._submit_pipelined(spec, captures)
+        # Direct caller→actor push (reference: actor_task_submitter.h).
+        # Top-level ref deps the caller owns locally travel inline with
+        # the push; nested (captured) refs must be globally resolvable by
+        # the executing worker → promote.
+        self._check_async_errors()
+        if captures:
+            self.promote_refs(captures)
+        rids = spec.return_ids()
+        self.memory_store.register_pending([oid.binary() for oid in rids])
+        refs = [ObjectRef(oid) for oid in rids]
+        # Pin args (deps + captures) until the reply lands — the owner-side
+        # equivalent of the reference's submitted-task references.
+        if spec.dependencies or captures:
+            pins = [ObjectRef(d) for d in spec.dependencies]
+            pins += [ObjectRef(c if isinstance(c, ObjectID) else ObjectID(c)) for c in (captures or [])]
+        else:
+            pins = None
+        sub = self._submitter_for(spec.actor_id)
+        self._direct_tasks[spec.task_id] = sub
+        for oid in rids:
+            self._direct_returns[oid] = spec.task_id
+        sub.submit(spec, pins)
+        return refs
+
+    def _queue_direct(self, submitter, call):
+        self._direct_handoff.push((submitter, call))
+
+    def _submitter_for(self, actor_id):
+        with self._lock:
+            sub = self._submitters.get(actor_id)
+            if sub is None:
+                from ray_tpu.core.direct import ActorSubmitter
+
+                sub = self._submitters[actor_id] = ActorSubmitter(self, actor_id)
+            return sub
+
+    def _direct_task_done(self, spec: TaskSpec):
+        self._direct_tasks.pop(spec.task_id, None)
+        for oid in spec.return_ids():
+            self._direct_returns.pop(oid, None)
+
+    def promote_refs(self, oids: Sequence, timeout: Optional[float] = None):
+        """Publish owner-local objects whose refs are escaping this
+        process to the controller directory (promotion-on-escape — the
+        reference instead resolves owners from the ref; see
+        memory_store.py module docstring). Blocks on still-pending
+        entries: an escaping ref must be globally resolvable."""
+        from ray_tpu.utils.serialization import serialize
+
+        for oid in oids:
+            oid = oid if isinstance(oid, ObjectID) else ObjectID(oid)
+            key = oid.binary()
+            e = self.memory_store.lookup(key)
+            if e is None or e.promoted or (e.ready and e.kind == "shm"):
+                continue
+            payload, is_err = e.value(timeout)
+            if e.kind == "shm":
+                continue  # resolved to a global shm object while pending
+            if isinstance(payload, Exception):
+                payload, is_err = serialize(payload), True
+            self._call("object_put_inline", oid, bytes(payload), is_err, [])
+            self.memory_store.mark_promoted(key)
 
     def next_task_id(self) -> TaskID:
-        return TaskID.from_random()
+        return TaskID.for_index(self.worker_id, next(self._task_counter))
 
     # ------------------------------------------------------------------
     # Control
@@ -345,7 +575,18 @@ class CoreWorker:
         return self._call("get_actor_by_name", name)
 
     def cancel_task(self, task_id: TaskID, force: bool):
+        sub = self._direct_tasks.get(task_id)
+        if sub is not None:
+            sub.cancel_threadsafe(task_id)
+            return
         self._call("cancel_task", task_id, force)
+
+    def cancel_by_object(self, oid: ObjectID, force: bool):
+        tid = self._direct_returns.get(oid)
+        if tid is not None:
+            self.cancel_task(tid, force)
+            return
+        self._call("cancel_by_object", oid, force)
 
     # KV
     def kv_put(self, ns: str, key: bytes, value: bytes, overwrite: bool = True) -> bool:

@@ -96,6 +96,10 @@ class WorkerRecord:
     # Runtime-env hash this worker is locked to ("" = pristine). Reference:
     # worker_pool keys idle workers by runtime-env hash (worker_pool.h:174).
     env_hash: str = ""
+    # Direct-transport listener address ("host:port"; "" = none) —
+    # callers push actor tasks straight to this endpoint (reference:
+    # the worker's CoreWorkerService address in ActorTableData).
+    listen_addr: str = ""
 
 
 @dataclass
@@ -264,9 +268,15 @@ class Controller:
             "config": self.config.to_dict(),
         }
 
-    async def rpc_register_worker(self, peer: rpc.Peer, worker_id: WorkerID, node_id: NodeID, pid: int):
+    async def rpc_register_worker(
+        self, peer: rpc.Peer, worker_id: WorkerID, node_id: NodeID, pid: int,
+        listen_addr: str = "",
+    ):
         peer.meta.update(kind="worker", worker_id=worker_id)
-        rec = WorkerRecord(worker_id=worker_id, node_id=node_id, peer=peer, pid=pid)
+        rec = WorkerRecord(
+            worker_id=worker_id, node_id=node_id, peer=peer, pid=pid,
+            listen_addr=listen_addr,
+        )
         self.workers[worker_id] = rec
         node = self.nodes.get(node_id)
         if node is not None:
@@ -1223,6 +1233,39 @@ class Controller:
         fut = asyncio.get_running_loop().create_future()
         actor.ready_waiters.append(fut)
         return await fut
+
+    async def rpc_actor_locate(self, peer: rpc.Peer, actor_id: ActorID):
+        """Resolve an actor's direct-transport address, long-polling
+        through PENDING/RESTARTING (reference: the submitter's resolution
+        of ActorTableData updates, actor_task_submitter.cc)."""
+        actor = self.actors.get(actor_id)
+        if actor is None:
+            return {"state": "DEAD", "reason": "actor not found"}
+        while actor.state in ("PENDING", "RESTARTING"):
+            fut = asyncio.get_running_loop().create_future()
+            actor.ready_waiters.append(fut)
+            try:
+                await asyncio.shield(fut)
+            except Exception:  # noqa: BLE001 — death surfaces via state
+                break
+        if actor.state != "ALIVE":
+            return {"state": "DEAD", "reason": actor.death_reason or "actor dead"}
+        worker = self.workers.get(actor.worker_id)
+        if worker is None or not worker.listen_addr:
+            return {"state": "DEAD", "reason": "actor worker has no listener"}
+        return {
+            "state": "ALIVE",
+            "addr": worker.listen_addr,
+            "instance": actor.num_restarts,
+        }
+
+    async def rpc_task_events(self, peer: rpc.Peer, batch: List[dict]):
+        """Batched task events from workers executing direct-push tasks
+        (reference: TaskEventBuffer flushes to the GCS task manager)."""
+        self.events.extend(batch)
+        if len(self.events) > self.config.task_event_buffer_size:
+            del self.events[: len(self.events) // 2]
+        return True
 
     async def rpc_get_actor_by_name(self, peer: rpc.Peer, name: str):
         actor_id = self.named_actors.get(name)

@@ -1,0 +1,344 @@
+"""Direct caller→actor task transport.
+
+Reference: src/ray/core_worker/transport/actor_task_submitter.h:45-75 —
+the caller pushes actor tasks STRAIGHT to the actor's worker process over
+a dedicated connection (per-actor ordered queues, sequence numbers,
+retries, failover re-resolve through the control plane on death). The
+controller is only consulted to locate the actor (and again after a
+connection loss); the steady-state call path never touches it.
+
+Results come back in the push reply and land in the caller's owner-local
+memory store (reference: memory_store.cc) — a follow-up ``get`` is a
+process-local lookup.
+
+All submitter state is mutated ONLY on the CoreWorker's asyncio loop
+thread (the same single-writer discipline the controller uses).
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from collections import deque
+from typing import Dict, List, Optional
+
+from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.exceptions import ActorDiedError, TaskCancelledError
+from ray_tpu.utils import rpc
+from ray_tpu.utils.ids import ActorID
+
+logger = logging.getLogger("ray_tpu.direct")
+
+
+class _Call:
+    __slots__ = ("seq", "spec", "pins", "attempts_left", "sent_peer")
+
+    def __init__(self, seq: int, spec: TaskSpec, pins, attempts_left: int):
+        self.seq = seq
+        self.spec = spec
+        self.pins = pins  # ObjectRefs pinning args until the reply lands
+        self.attempts_left = attempts_left
+        # The connection this call is currently in flight on. None = not
+        # in flight (loss already processed; safe to resend). Guards
+        # against stale reply callbacks from a dead connection racing a
+        # resend — loss accounting happens exactly once per attempt.
+        self.sent_peer = None
+
+
+class _PeerHandler:
+    """Handler for the caller side of a direct connection (the worker may
+    push nothing back besides call replies)."""
+
+    def on_disconnect(self, peer):
+        pass
+
+
+class ActorSubmitter:
+    """Per-actor ordered submission queue (reference:
+    SequentialActorSubmitQueue, actor_task_submitter.cc)."""
+
+    def __init__(self, core, actor_id: ActorID):
+        self.core = core
+        self.actor_id = actor_id
+        self._seq = itertools.count()
+        self.queue: deque = deque()
+        self.inflight: Dict[int, _Call] = {}
+        self.peer: Optional[rpc.Peer] = None
+        self.instance = -1
+        self.dead_error: Optional[Exception] = None
+        self._draining = False
+        self._connect_failures = 0
+        self._need_resend = False
+
+    # -- caller thread --------------------------------------------------
+    def submit(self, spec: TaskSpec, pins) -> None:
+        call = _Call(next(self._seq), spec, pins, spec.max_retries)
+        spec.actor_seq_no = call.seq
+        # Batched handoff: one loop wakeup flushes every queued submit
+        # (a call_soon_threadsafe per call costs a self-pipe write each).
+        self.core._queue_direct(self, call)
+
+    def cancel_threadsafe(self, task_id) -> None:
+        self.core.loop_runner.loop.call_soon_threadsafe(self._cancel, task_id)
+
+    # -- loop thread ----------------------------------------------------
+    def _enqueue(self, call: _Call) -> None:
+        if self.dead_error is not None:
+            self._fail_call(call, self.dead_error)
+            return
+        self.queue.append(call)
+        self._ensure_drain()
+
+    def _ensure_drain(self) -> None:
+        if not self._draining:
+            self._draining = True
+            asyncio.get_running_loop().create_task(self._drain())
+
+    async def _drain(self) -> None:
+        try:
+            while True:
+                if self.dead_error is not None:
+                    self._fail_all(self.dead_error)
+                    return
+                if not self.queue and not self.inflight:
+                    return
+                if self.peer is None or self.peer.closed:
+                    if not await self._connect():
+                        continue  # next iteration fails all or retries
+                # Re-push calls whose previous attempt's loss has been
+                # PROCESSED (sent_peer reset by _on_reply), in sequence
+                # order, BEFORE new ones (reference: resend_queue on actor
+                # restart). Calls still bound to a dead peer resend (or
+                # fail) when their reply callback fires — resending them
+                # earlier would race the stale callback and could
+                # double-execute a max_task_retries=0 task. Flag-gated so
+                # the steady-state hot loop never scans inflight.
+                if self._need_resend:
+                    self._need_resend = False
+                    resend = sorted(
+                        (c for c in self.inflight.values() if c.sent_peer is None),
+                        key=lambda c: c.seq,
+                    )
+                    for call in resend:
+                        try:
+                            deps = await self._inline_deps(call)
+                        except _DepFailed as e:
+                            self.inflight.pop(call.seq, None)
+                            self._fail_call(call, None, serialized=e.payload)
+                            continue
+                        self._send(call, deps)
+                if not self.queue:
+                    return  # connected; replies drive the rest
+                call = self.queue.popleft()
+                try:
+                    inline_deps = await self._inline_deps(call)
+                except _DepFailed as e:
+                    self._fail_call(call, None, serialized=e.payload)
+                    continue
+                self.inflight[call.seq] = call
+                self._send(call, inline_deps)
+        finally:
+            self._draining = False
+            # work may have raced in while we were exiting
+            if (self.queue or (self.dead_error and self.inflight)) and not self._draining:
+                self._ensure_drain()
+
+    async def _inline_deps(self, call: _Call):
+        """Ship ready owner-local dependency values with the task so the
+        executing worker never round-trips to the controller for them
+        (reference: LocalDependencyResolver inlining small args). Waits
+        for still-pending local deps — which also gives ordered actors
+        correct submission-order execution."""
+        ms = self.core.memory_store
+        inline = None
+        for dep in call.spec.dependencies:
+            key = dep.binary()
+            e = ms.lookup(key)
+            if e is None or e.kind != "inline":
+                continue  # global object — the worker fetches it
+            if not e.ready:
+                await asyncio.wrap_future(_copy_future(e.ensure_future()))
+                if e.kind != "inline":
+                    continue  # resolved to a shm marker — global now
+            payload, is_err = e.value()
+            if isinstance(payload, Exception):
+                from ray_tpu.utils.serialization import serialize
+
+                raise _DepFailed(serialize(payload))
+            if is_err:
+                raise _DepFailed(bytes(payload))
+            if inline is None:
+                inline = {}
+            inline[key] = bytes(payload)
+        return inline
+
+    def _send(self, call: _Call, inline_deps) -> None:
+        from ray_tpu.core.task_spec import pack_actor_task
+
+        peer = self.peer
+        call.sent_peer = peer
+        fut = peer.call_nowait("push_actor_task", pack_actor_task(call.spec), inline_deps)
+        fut.add_done_callback(lambda f, p=peer, c=call: self._on_reply(p, c, f))
+
+    def _on_reply(self, peer: rpc.Peer, call: _Call, fut: asyncio.Future) -> None:
+        if call.sent_peer is not peer:
+            return  # stale callback from a superseded attempt
+        call.sent_peer = None
+        if fut.cancelled():
+            self._on_connection_loss(peer, call)
+            return
+        exc = fut.exception()
+        if exc is not None:
+            self._on_connection_loss(peer, call, exc)
+            return
+        if call.seq not in self.inflight:
+            return  # cancelled/raced
+        results, error = fut.result()
+        if (
+            error is not None
+            and call.spec.retry_exceptions
+            and call.attempts_left > 0
+        ):
+            call.attempts_left -= 1
+            self.inflight.pop(call.seq, None)
+            self.queue.appendleft(call)
+            self._ensure_drain()
+            return
+        self._complete(call, results, error)
+
+    def _on_connection_loss(self, peer: rpc.Peer, call: _Call, err: Optional[Exception] = None) -> None:
+        if self.peer is peer:
+            self.peer = None
+        if call.seq not in self.inflight:
+            return
+        if call.attempts_left > 0:
+            call.attempts_left -= 1
+            # stays in self.inflight with sent_peer=None — resent after
+            # reconnect (exactly one loss accounting per attempt: the
+            # reply callback fires once, and _on_reply cleared sent_peer)
+            self._need_resend = True
+            self._ensure_drain()
+            return
+        self.inflight.pop(call.seq, None)
+        self._fail_call(
+            call,
+            err
+            if isinstance(err, ActorDiedError)
+            else ActorDiedError(
+                self.actor_id.hex(), "actor worker died (connection lost)"
+            ),
+        )
+        self._ensure_drain()
+
+    async def _connect(self) -> bool:
+        try:
+            info = await self.core.peer.call("actor_locate", self.actor_id)
+        except Exception as e:  # noqa: BLE001 — controller gone
+            self.dead_error = ActorDiedError(self.actor_id.hex(), f"cluster down: {e}")
+            return False
+        if info["state"] != "ALIVE":
+            self.dead_error = ActorDiedError(
+                self.actor_id.hex(), info.get("reason", "actor dead")
+            )
+            return False
+        host, port = info["addr"].rsplit(":", 1)
+        try:
+            self.peer = await rpc.connect(
+                host, int(port), _PeerHandler(), retries=5, delay=0.05
+            )
+        except rpc.ConnectionLost:
+            # Actor may have died between locate and connect; loop back to
+            # locate (which observes the restart/death). Bound the spin.
+            self._connect_failures += 1
+            if self._connect_failures > 20:
+                self.dead_error = ActorDiedError(
+                    self.actor_id.hex(), "actor worker unreachable"
+                )
+            else:
+                await asyncio.sleep(0.05)
+            return False
+        self._connect_failures = 0
+        self.instance = info.get("instance", 0)
+        return True
+
+    # -- completion -----------------------------------------------------
+    def _complete(self, call: _Call, results: List[tuple], error) -> None:
+        self.inflight.pop(call.seq, None)
+        ms = self.core.memory_store
+        if error is not None:
+            from ray_tpu.utils.serialization import serialize
+
+            blob = serialize(error)
+            for oid in call.spec.return_ids():
+                ms.put(oid.binary(), blob, True)
+        else:
+            for item in results:
+                oid, kind = item[0], item[1]
+                if kind == "inline":
+                    key = oid.binary()
+                    ms.put(key, item[2], bool(item[3]))
+                    if len(item) > 4 and item[4]:
+                        # worker registered it with the controller (nested
+                        # refs) — ref flushes must go global
+                        ms.mark_promoted(key)
+                else:
+                    ms.put(oid.binary(), None, False, kind="shm")
+        self._done(call)
+
+    def _fail_call(self, call: _Call, exc: Optional[Exception], serialized: Optional[bytes] = None) -> None:
+        from ray_tpu.utils.serialization import serialize
+
+        blob = serialized if serialized is not None else serialize(exc)
+        ms = self.core.memory_store
+        for oid in call.spec.return_ids():
+            ms.put(oid.binary(), blob, True)
+        self._done(call)
+
+    def _fail_all(self, exc: Exception) -> None:
+        for call in list(self.inflight.values()):
+            self._fail_call(call, exc)
+        self.inflight.clear()
+        while self.queue:
+            self._fail_call(self.queue.popleft(), exc)
+
+    def _done(self, call: _Call) -> None:
+        call.pins = None  # releases arg pins (ObjectRef __del__ → ref decs)
+        self.core._direct_task_done(call.spec)
+
+    def _cancel(self, task_id) -> None:
+        for i, call in enumerate(self.queue):
+            if call.spec.task_id == task_id:
+                del self.queue[i]
+                self._fail_call(call, TaskCancelledError(task_id.hex()))
+                return
+        for call in self.inflight.values():
+            if call.spec.task_id == task_id and self.peer is not None:
+                asyncio.get_running_loop().create_task(
+                    self.peer.notify("cancel", task_id)
+                )
+                return
+
+
+class _DepFailed(Exception):
+    def __init__(self, payload: bytes):
+        self.payload = payload
+
+
+def _copy_future(src):
+    """A fresh concurrent Future mirroring ``src`` — asyncio.wrap_future
+    refuses to wrap the same concurrent future twice across loops."""
+    import concurrent.futures
+
+    dst = concurrent.futures.Future()
+
+    def _copy(f):
+        if dst.done():
+            return
+        exc = f.exception()
+        if exc is not None:
+            dst.set_exception(exc)
+        else:
+            dst.set_result(f.result())
+
+    src.add_done_callback(_copy)
+    return dst

@@ -1,0 +1,121 @@
+"""Owner-local in-process memory store for small task results.
+
+Reference: src/ray/core_worker/store_provider/memory_store/memory_store.cc
+(CoreWorkerMemoryStore) — small/inlined objects live in the OWNER process,
+not the control plane, so a ``get`` of a direct-call result is a local
+dictionary lookup with zero controller round-trips.
+
+Entries hold either a ready value (serialized bytes + is_error) or are
+pending until a direct call resolves them. Futures are created LAZILY —
+only when a reader actually blocks — because a threading.Condition per
+call is measurable on the hot path. Objects stay *local-only* until their
+ref escapes the process (task arg, put, return value), at which point
+CoreWorker promotes them to the controller's global directory —
+the reference's equivalent is resolving the owner address from the ref;
+promotion-on-escape keeps single-process hot paths entirely local.
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+_UNSET = object()
+
+
+class Entry:
+    __slots__ = ("_lock", "_value", "_future", "promoted", "doomed", "kind")
+
+    def __init__(self, lock):
+        self._lock = lock  # the store's lock (shared)
+        # (payload, is_error); ``payload`` is serialized bytes, or an
+        # Exception instance for transport-level failures (ActorDiedError
+        # etc.), or None when kind == "shm" (the value lives in the
+        # global store; readers fall back to the controller).
+        self._value = _UNSET
+        self._future: Optional[Future] = None
+        self.promoted = False  # registered with the controller directory
+        self.doomed = False  # all local refs dropped while still pending
+        self.kind = "inline"  # inline | shm
+
+    @property
+    def ready(self) -> bool:
+        return self._value is not _UNSET
+
+    def ensure_future(self) -> Future:
+        """A Future resolving to (payload, is_error) — created on demand."""
+        with self._lock:
+            if self._future is None:
+                self._future = Future()
+                if self._value is not _UNSET:
+                    self._future.set_result(self._value)
+            return self._future
+
+    def value(self, timeout: Optional[float] = None) -> Tuple[object, bool]:
+        v = self._value
+        if v is not _UNSET:
+            return v
+        return self.ensure_future().result(timeout)
+
+    def _resolve(self, value):  # store lock held by caller
+        if self._value is _UNSET:
+            self._value = value
+            if self._future is not None and not self._future.done():
+                self._future.set_result(value)
+
+
+class LocalMemoryStore:
+    """Thread-safe oid→Entry table (gets come from arbitrary threads; the
+    RPC loop resolves entries)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[bytes, Entry] = {}
+
+    def register_pending(self, keys: List[bytes]):
+        with self._lock:
+            for k in keys:
+                if k not in self._entries:
+                    self._entries[k] = Entry(self._lock)
+
+    def lookup(self, key: bytes) -> Optional[Entry]:
+        return self._entries.get(key)
+
+    def put(self, key: bytes, payload, is_error: bool, kind: str = "inline"):
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                e = self._entries[key] = Entry(self._lock)
+            doomed = e.doomed
+            e.kind = kind
+            e._resolve((payload, is_error))
+            if doomed:
+                del self._entries[key]
+
+    def mark_promoted(self, key: bytes):
+        e = self._entries.get(key)
+        if e is not None:
+            e.promoted = True
+
+    def evict(self, key: bytes) -> bool:
+        """Drop on last-local-ref release. A still-pending entry is only
+        marked doomed — the in-flight reply resolves (then discards) it so
+        a racing ``get`` never hangs on a deleted entry."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return False
+            if not e.ready:
+                e.doomed = True
+                return False
+            del self._entries[key]
+            return True
+
+    def is_local_only(self, key: bytes) -> bool:
+        """True for entries that exist here and were never promoted to the
+        controller (ref flushes for these stay local)."""
+        e = self._entries.get(key)
+        return e is not None and not e.promoted and e.kind == "inline"
+
+    def __len__(self):
+        return len(self._entries)

@@ -100,7 +100,7 @@ class RemoteFunction:
 
         runtime_env = _tracing.inject_runtime_env(opts.get("runtime_env"))
         spec = TaskSpec(
-            task_id=TaskID.from_random(),
+            task_id=core.next_task_id(),
             task_type=TaskType.NORMAL_TASK,
             name=opts.get("name") or getattr(self._fn, "__name__", "anonymous"),
             func_digest=self._digest,

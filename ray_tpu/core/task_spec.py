@@ -36,6 +36,12 @@ class SchedulingStrategy:
     capture_child_tasks: bool = False
     node_labels: Optional[Dict[str, Any]] = None
 
+    def __reduce__(self):
+        return (SchedulingStrategy, (
+            self.kind, self.node_id, self.soft, self.placement_group_id,
+            self.bundle_index, self.capture_child_tasks, self.node_labels,
+        ))
+
 
 @dataclass
 class TaskSpec:
@@ -84,9 +90,40 @@ class TaskSpec:
         return self.num_returns == TaskSpec.STREAMING
 
     def return_ids(self) -> List[ObjectID]:
+        # Memoized: the blake2b derivations are hot on the direct call
+        # path (computed caller-side and worker-side several times each).
+        cached = getattr(self, "_return_ids", None)
+        if cached is not None:
+            return cached
         if self.is_streaming:
-            return []
-        return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+            ids: List[ObjectID] = []
+        else:
+            ids = [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+        object.__setattr__(self, "_return_ids", ids)
+        return ids
+
+    # Compact tuple state: generic dataclass pickling (dict state, 20 keys
+    # as strings) costs ~3x more time and bytes — specs are the hottest
+    # wire object in the system.
+    _FIELDS = (
+        "task_id", "task_type", "name", "func_digest", "func_blob",
+        "args_blob", "dependencies", "num_returns", "resources", "owner_id",
+        "scheduling_strategy", "max_retries", "retry_exceptions", "actor_id",
+        "actor_method_name", "actor_seq_no", "max_restarts",
+        "max_task_retries", "max_concurrency", "runtime_env", "lifetime",
+        "hold_resources_while_alive",
+    )
+
+    def __getstate__(self):
+        return tuple(getattr(self, f) for f in TaskSpec._FIELDS)
+
+    def __setstate__(self, state):
+        if isinstance(state, dict):  # journals written pre-tuple-state
+            self.__dict__.update(state)
+            self.__dict__.pop("_return_ids", None)
+            return
+        for f, v in zip(TaskSpec._FIELDS, state):
+            object.__setattr__(self, f, v)
 
     def scheduling_class(self) -> Tuple:
         """Tasks with equal scheduling class share lease requests (reference:
@@ -98,3 +135,45 @@ class TaskSpec:
             str(self.scheduling_strategy.placement_group_id),
             self.func_digest,
         )
+
+
+_EMPTY_RESOURCES = ResourceSet()
+
+
+def pack_actor_task(spec: TaskSpec) -> tuple:
+    """Flatten an actor-task spec to primitives for the direct push path —
+    a plain tuple pickles ~5x faster and ~4x smaller than the full spec
+    (every byte/μs here is per-call overhead; reference analogue: the
+    PushTask proto carries a trimmed TaskSpec)."""
+    return (
+        spec.task_id.binary(),
+        spec.actor_id.binary(),
+        spec.name,
+        spec.actor_method_name,
+        spec.func_digest,
+        spec.func_blob,
+        spec.args_blob,
+        spec.num_returns,
+        spec.runtime_env,
+        spec.actor_seq_no,
+        spec.owner_id.binary() if spec.owner_id else None,
+    )
+
+
+def unpack_actor_task(t: tuple) -> TaskSpec:
+    return TaskSpec(
+        task_id=TaskID(t[0]),
+        task_type=TaskType.ACTOR_TASK,
+        name=t[2],
+        func_digest=t[4],
+        func_blob=t[5],
+        args_blob=t[6],
+        dependencies=[],
+        num_returns=t[7],
+        resources=_EMPTY_RESOURCES,
+        owner_id=WorkerID(t[10]) if t[10] else None,
+        actor_id=ActorID(t[1]),
+        actor_method_name=t[3],
+        actor_seq_no=t[9],
+        runtime_env=t[8],
+    )

@@ -19,6 +19,7 @@ import asyncio
 import logging
 import os
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
@@ -39,7 +40,9 @@ logger = logging.getLogger("ray_tpu.worker")
 
 
 class WorkerHandler:
-    """RPC handler for controller→worker messages.
+    """RPC handler for controller→worker messages AND the worker's direct
+    listener (caller→actor pushes arrive on separate connections —
+    reference: the worker's CoreWorkerService gRPC server).
 
     Dispatches may arrive between worker registration and executor attach
     (registration happens inside CoreWorker.__init__) — buffer until ready.
@@ -48,6 +51,7 @@ class WorkerHandler:
     def __init__(self):
         self.executor: Optional[TaskExecutor] = None
         self._buffer: list = []
+        self._controller_peer = None
 
     def attach_executor(self, executor: "TaskExecutor"):
         self.executor = executor
@@ -70,6 +74,29 @@ class WorkerHandler:
     def rpc_execute_actor_task(self, peer, spec: TaskSpec):
         self._dispatch(spec, "actor_task")
 
+    def rpc_push_actor_task(self, peer, packed: tuple, inline_deps=None):
+        """Direct caller→actor push; the returned Future resolves to the
+        reply carrying the results (reference:
+        CoreWorkerService::PushTask). Returning a Future (not awaiting)
+        keeps the hot path free of per-request task creation."""
+        from ray_tpu.core.task_spec import unpack_actor_task
+
+        spec = unpack_actor_task(packed)
+        if self.executor is None:
+            return self._push_when_ready(spec, inline_deps)
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self.executor.submit(spec, "actor_task", reply=(loop, fut), inline_deps=inline_deps)
+        return fut
+
+    async def _push_when_ready(self, spec: TaskSpec, inline_deps):
+        while self.executor is None:  # registration race (first push only)
+            await asyncio.sleep(0.002)
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self.executor.submit(spec, "actor_task", reply=(loop, fut), inline_deps=inline_deps)
+        return fut
+
     def rpc_cancel(self, peer, task_id: TaskID):
         if self.executor is not None:
             self.executor.cancelled.add(task_id)
@@ -81,35 +108,68 @@ class WorkerHandler:
         return "pong"
 
     def on_disconnect(self, peer):
-        # Controller gone — nothing useful left to do.
-        os._exit(1)
+        # Direct-caller connections come and go; only the controller
+        # connection is load-bearing.
+        if peer is self._controller_peer:
+            os._exit(1)
 
 
 class TaskExecutor:
     def __init__(self, core: CoreWorker):
+        import collections
+
         self.core = core
         self.pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="task-exec")
         self.actor_pool: Optional[ThreadPoolExecutor] = None
         self.actor_instance: Any = None
         self.cancelled: set = set()
         self._func_cache: Dict[bytes, Any] = {}
+        self._reply_handoff = None  # created lazily (needs the loop)
+        # Direct-push tasks bypass the controller, so their observability
+        # events flush in periodic batches (reference: TaskEventBuffer →
+        # GCS task manager, task_event_buffer.cc).
+        self._events = collections.deque()
+        self.core.loop_runner.submit(self._event_flush_loop())
 
-    def submit(self, spec: TaskSpec, kind: str):
+    async def _event_flush_loop(self):
+        interval = self.core.config.get("event_flush_period_s", 1.0)
+        while True:
+            await asyncio.sleep(interval)
+            if not self._events:
+                continue
+            batch = []
+            while self._events and len(batch) < 10000:
+                batch.append(self._events.popleft())
+            try:
+                await self.core.peer.notify("task_events", batch)
+            except Exception:  # noqa: BLE001 — controller gone
+                return
+
+    def submit(self, spec: TaskSpec, kind: str, reply=None, inline_deps=None):
         if kind == "actor_task":
             pool = self.actor_pool or self.pool
         else:
             pool = self.pool
-        pool.submit(self._guarded_run, spec, kind)
+        pool.submit(self._guarded_run, spec, kind, reply, inline_deps)
 
-    def _guarded_run(self, spec: TaskSpec, kind: str):
+    def _guarded_run(self, spec: TaskSpec, kind: str, reply=None, inline_deps=None):
         try:
-            self._run(spec, kind)
+            self._run(spec, kind, reply, inline_deps)
         except Exception:
             logger.exception("internal error running task %s", spec.name)
+            if reply is not None:
+                self._reply(reply, ([], TaskError(spec.name, traceback.format_exc(), None)))
         finally:
             from ray_tpu import runtime_context
 
             runtime_context._set_task(None, None)
+
+    def _reply(self, reply, payload):
+        """Batched exec-thread → loop handoff for completed replies."""
+        loop, fut = reply
+        if self._reply_handoff is None:
+            self._reply_handoff = rpc.BatchedHandoff(loop, _resolve_reply)
+        self._reply_handoff.push((fut, payload))
 
     # ------------------------------------------------------------------
     def _load_func(self, spec: TaskSpec):
@@ -119,11 +179,17 @@ class TaskExecutor:
             self._func_cache[spec.func_digest] = fn
         return fn
 
-    def _resolve_args(self, spec: TaskSpec):
+    def _resolve_args(self, spec: TaskSpec, inline_deps=None):
         args, kwargs = deserialize(spec.args_blob)
 
         def res(v):
             if isinstance(v, _RefMarker):
+                if inline_deps is not None:
+                    data = inline_deps.get(v.oid.binary())
+                    if data is not None:
+                        # caller-owned value shipped with the push
+                        # (reference: LocalDependencyResolver inlining)
+                        return deserialize(data)
                 value, is_error = self.core.get_raw(v.oid)
                 if is_error:
                     raise value
@@ -132,11 +198,15 @@ class TaskExecutor:
 
         return tuple(res(a) for a in args), {k: res(v) for k, v in kwargs.items()}
 
-    def _run(self, spec: TaskSpec, kind: str):
+    def _run(self, spec: TaskSpec, kind: str, reply=None, inline_deps=None):
         if spec.task_id in self.cancelled:
             from ray_tpu.exceptions import TaskCancelledError
 
-            self._report(spec, None, TaskCancelledError(spec.task_id.hex()))
+            err = TaskCancelledError(spec.task_id.hex())
+            if reply is not None:
+                self._reply(reply, ([], err))
+            else:
+                self._report(spec, None, err)
             return
         from ray_tpu import runtime_context
 
@@ -165,7 +235,7 @@ class TaskExecutor:
                         f"execute:{spec.name}", {"task_id": spec.task_id.hex()}
                     )
                     trace_span_cm.__enter__()
-            args, kwargs = self._resolve_args(spec)
+            args, kwargs = self._resolve_args(spec, inline_deps)
             if kind == "task":
                 fn = self._load_func(spec)
                 result = _maybe_async(fn(*args, **kwargs))
@@ -186,17 +256,82 @@ class TaskExecutor:
                 result = _maybe_async(method(*args, **kwargs))
             # Report inside the span: for streaming tasks the generator
             # body runs during _report, which must be attributed.
-            self._report(spec, result, None)
+            if reply is not None:
+                self._report_direct(spec, result, None, reply)
+            else:
+                self._report(spec, result, None)
         except Exception as e:  # noqa: BLE001 — user errors cross the wire
             tb = traceback.format_exc()
             err = e if isinstance(e, TaskError) else TaskError(spec.name, tb, None)
-            self._report(spec, None, err)
+            if reply is not None:
+                self._report_direct(spec, None, err, reply)
+            else:
+                self._report(spec, None, err)
         finally:
             if trace_span_cm is not None:
                 from ray_tpu.util import tracing as _tracing
 
                 trace_span_cm.__exit__(None, None, None)
                 _tracing.detach_context()
+
+    def _report_direct(self, spec: TaskSpec, result, error, reply):
+        """Direct-push completion: results travel back IN the push reply
+        to the caller's memory store (reference: PushTask reply carries
+        return objects). Large results go to the local shm store and are
+        registered with the controller directory; inline results with
+        nested refs are also registered so containment pins exist."""
+        results = []
+        if error is None:
+            try:
+                if spec.num_returns == 1:
+                    values = [result]
+                else:
+                    values = list(result)
+                    if len(values) != spec.num_returns:
+                        raise ValueError(
+                            f"task {spec.name} returned {len(values)} values, "
+                            f"expected num_returns={spec.num_returns}"
+                        )
+                from ray_tpu.core.client import _serialize_parts_capturing
+                from ray_tpu.utils.serialization import assemble_parts
+
+                for oid, value in zip(spec.return_ids(), values):
+                    meta, raws, total, contained = _serialize_parts_capturing(value)
+                    if contained:
+                        # nested refs escape to the caller → must be
+                        # globally resolvable + containment-pinned
+                        self.core.promote_refs(contained)
+                    if total <= self.core.inline_limit:
+                        data = assemble_parts(meta, raws)
+                        if contained:
+                            self.core._call(
+                                "object_put_inline", oid, data, False, contained
+                            )
+                        # 5th element: globally registered — the caller
+                        # must mark its entry promoted so ref flushes
+                        # reach the controller (else the record + its
+                        # containment pins leak forever)
+                        results.append((oid, "inline", data, False, bool(contained)))
+                    else:
+                        self.core.plasma.put_parts(oid, meta, raws, total)
+                        self.core._call(
+                            "object_put_shm", oid, total, self.core.node_id,
+                            False, contained or [],
+                        )
+                        results.append((oid, "shm"))
+            except Exception:  # noqa: BLE001 — unpicklable results
+                results = []
+                error = TaskError(spec.name, traceback.format_exc(), None)
+        self._events.append(
+            {
+                "ts": time.time(),
+                "kind": "task",
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "state": "FINISHED" if error is None else "FAILED",
+            }
+        )
+        self._reply(reply, (results, error))
 
     def _report(self, spec: TaskSpec, result, error):
         if spec.is_streaming and error is None:
@@ -223,6 +358,8 @@ class TaskExecutor:
                     # otherwise the worker's own ref drop could GC a
                     # ray_tpu.put() object before the caller ever sees it.
                     meta, raws, total, contained = _serialize_parts_capturing(value)
+                    if contained:
+                        self.core.promote_refs(contained)
                     if total <= self.core.inline_limit:
                         results.append(
                             (oid, "inline", assemble_parts(meta, raws), False, contained)
@@ -276,6 +413,12 @@ class TaskExecutor:
             os._exit(1)
 
 
+def _resolve_reply(item):
+    fut, payload = item
+    if not fut.done():
+        fut.set_result(payload)
+
+
 def _maybe_async(result):
     if asyncio.iscoroutine(result):
         return asyncio.run(result)
@@ -291,6 +434,9 @@ def main():
 
     handler = WorkerHandler()
     loop_runner = rpc.EventLoopThread("worker-io")
+    # Direct-transport listener: callers push actor tasks straight here
+    # (reference: each worker hosts a CoreWorkerService gRPC server).
+    _server, listen_port = loop_runner.run(rpc.serve(handler, "127.0.0.1", 0))
     core = CoreWorker(
         addr,
         mode="worker",
@@ -299,7 +445,9 @@ def main():
         worker_id=worker_id,
         node_id=node_id,
         local_shm_dir=shm_dir,
+        listen_addr=f"127.0.0.1:{listen_port}",
     )
+    handler._controller_peer = core.peer
     # Make the full public API usable from inside tasks (nested tasks,
     # ray_tpu.get/put in user code) BEFORE any buffered task can run.
     from ray_tpu.core import api

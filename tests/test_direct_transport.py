@@ -1,0 +1,216 @@
+"""Direct caller→actor transport + owner-local memory store.
+
+Reference test model: python/ray/tests/test_actor_failures.py (submitter
+retry/failover semantics, actor_task_submitter.h) and the memory-store
+unit tests (core_worker/test/memory_store_test.cc). Each test runs
+against a real multi-process cluster.
+"""
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def inc(self, d=1):
+        self.n += d
+        return self.n
+
+    def pid(self):
+        return os.getpid()
+
+    def echo(self, x):
+        return x
+
+
+def test_direct_results_are_owner_local(ray_start_regular):
+    """A direct-call result resolves from the caller's memory store."""
+    c = Counter.remote()
+    ref = c.inc.remote()
+    assert ray_tpu.get(ref) == 1
+    core = ray_tpu.core.api._require_worker()
+    entry = core.memory_store.lookup(ref.id.binary())
+    assert entry is not None and entry.ready
+    # never promoted: the controller has no record of this object
+    assert core.memory_store.is_local_only(ref.id.binary())
+
+
+def test_direct_ordering_fifo(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(200)]
+    assert ray_tpu.get(refs) == list(range(1, 201))
+
+
+def test_chained_local_dep_inlined(ray_start_regular):
+    """A pending direct-call result passed as an arg ships inline with
+    the dependent push (no controller promotion)."""
+    c = Counter.remote()
+    r1 = c.inc.remote(5)          # 5
+    r2 = c.echo.remote(r1)        # 5, dep inlined
+    assert ray_tpu.get(r2) == 5
+    core = ray_tpu.core.api._require_worker()
+    assert core.memory_store.is_local_only(r1.id.binary())
+
+
+def test_promotion_on_escape_to_normal_task(ray_start_regular):
+    c = Counter.remote()
+    r1 = c.inc.remote(7)
+
+    @ray_tpu.remote
+    def plus_one(x):
+        return x + 1
+
+    assert ray_tpu.get(plus_one.remote(r1)) == 8
+    core = ray_tpu.core.api._require_worker()
+    # escaped → promoted to the controller directory
+    assert not core.memory_store.is_local_only(r1.id.binary())
+
+
+def test_promotion_nested_ref(ray_start_regular):
+    """A direct result nested inside another task's args promotes."""
+    c = Counter.remote()
+    r1 = c.inc.remote(3)
+
+    @ray_tpu.remote
+    def deref(box):
+        return ray_tpu.get(box["ref"]) * 10
+
+    assert ray_tpu.get(deref.remote({"ref": r1})) == 30
+
+
+def test_direct_error_propagation(ray_start_regular):
+    @ray_tpu.remote
+    class Boom:
+        def go(self):
+            raise RuntimeError("kapow")
+
+    b = Boom.remote()
+    with pytest.raises(Exception, match="kapow"):
+        ray_tpu.get(b.go.remote())
+
+
+def test_actor_death_fails_direct_calls(ray_start_regular):
+    """No retries → in-flight and subsequent calls fail with
+    ActorDiedError after SIGKILL."""
+    c = Counter.remote()
+    pid = ray_tpu.get(c.pid.remote())
+    os.kill(pid, signal.SIGKILL)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(c.inc.remote(), timeout=30)
+
+
+def test_actor_restart_direct_retry(ray_start_regular):
+    """max_restarts + max_task_retries → the submitter re-resolves the
+    restarted actor and re-pushes (reference: actor_task_submitter
+    resend on restart)."""
+    A = Counter.options(max_restarts=1, max_task_retries=2)
+    c = A.remote()
+    pid = ray_tpu.get(c.pid.remote())
+    os.kill(pid, signal.SIGKILL)
+    # the restarted instance starts from n=0
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+    new_pid = ray_tpu.get(c.pid.remote())
+    assert new_pid != pid
+
+
+def test_direct_cancel_queued(ray_start_regular):
+    @ray_tpu.remote
+    class Slow:
+        def nap(self, s):
+            time.sleep(s)
+            return "done"
+
+    s = Slow.remote()
+    ray_tpu.wait_actor_ready(s)
+    first = s.nap.remote(3)
+    queued = s.nap.remote(3)
+    time.sleep(0.2)
+    ray_tpu.cancel(queued)
+    with pytest.raises(Exception):
+        ray_tpu.get(queued, timeout=30)
+    assert ray_tpu.get(first, timeout=30) == "done"
+
+
+def test_memory_store_eviction_on_ref_drop(ray_start_regular):
+    c = Counter.remote()
+    core = ray_tpu.core.api._require_worker()
+    ref = c.inc.remote()
+    ray_tpu.get(ref)
+    key = ref.id.binary()
+    assert core.memory_store.lookup(key) is not None
+    del ref
+    deadline = time.time() + 5
+    while core.memory_store.lookup(key) is not None and time.time() < deadline:
+        time.sleep(0.1)
+    assert core.memory_store.lookup(key) is None, "entry not evicted after ref drop"
+
+
+def test_worker_to_worker_direct_calls(ray_start_regular):
+    """n:n shape: a caller ACTOR drives a target actor directly."""
+    @ray_tpu.remote
+    class Caller:
+        def __init__(self, target):
+            self.target = target
+
+        def drive(self, n):
+            refs = [self.target.inc.remote() for _ in range(n)]
+            return ray_tpu.get(refs)[-1]
+
+    t = Counter.remote()
+    caller = Caller.remote(t)
+    assert ray_tpu.get(caller.drive.remote(20), timeout=60) == 20
+
+
+def test_get_mixed_local_and_global(ray_start_regular):
+    c = Counter.remote()
+    local_ref = c.inc.remote(2)          # owner-local
+    global_ref = ray_tpu.put("hello")    # controller-registered
+    vals = ray_tpu.get([local_ref, global_ref])
+    assert vals == [2, "hello"]
+
+
+def test_wait_mixed_local_and_global(ray_start_regular):
+    c = Counter.remote()
+    local_ref = c.inc.remote()
+    global_ref = ray_tpu.put(1)
+    ready, not_ready = ray_tpu.wait(
+        [local_ref, global_ref], num_returns=2, timeout=10
+    )
+    assert len(ready) == 2 and not not_ready
+
+
+def test_large_result_via_shm(ray_start_regular):
+    import numpy as np
+
+    @ray_tpu.remote
+    class Big:
+        def make(self):
+            return np.arange(1_000_000, dtype=np.float64)
+
+    b = Big.remote()
+    arr = ray_tpu.get(b.make.remote())
+    assert arr.shape == (1_000_000,) and arr[-1] == 999_999
+
+
+def test_fallback_controller_path():
+    """direct_actor_calls=False routes through the controller (the
+    pre-direct path stays supported)."""
+    ray_tpu.init(num_cpus=2, _system_config={"direct_actor_calls": False})
+    try:
+        c = Counter.remote()
+        refs = [c.inc.remote() for _ in range(20)]
+        assert ray_tpu.get(refs) == list(range(1, 21))
+        core = ray_tpu.core.api._require_worker()
+        # results were controller-registered (any local entry is just the
+        # get-side cache of a GLOBAL object, never local-only)
+        assert not core.memory_store.is_local_only(refs[0].id.binary())
+    finally:
+        ray_tpu.shutdown()
