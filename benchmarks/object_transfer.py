@@ -1,0 +1,92 @@
+"""Cross-node object data-plane benchmark.
+
+Reference: release/benchmarks/ object_store suite (1 GiB broadcast,
+release_logs/*/scalability/object_store.json). Two simulated nodes on
+one host; cross-node shm mapping is OFF, so every byte moves through the
+chunked network path (agent↔agent TCP).
+
+Usage: python benchmarks/object_transfer.py [--mb 1024] [--iters 3]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mb", type=int, default=1024)
+    p.add_argument("--iters", type=int, default=3)
+    args = p.parse_args()
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.core.api import free
+    from ray_tpu.core.cluster_utils import Cluster
+
+    cluster = Cluster({"CPU": 2})
+    cluster.add_node(num_cpus=2, resources={"remote_node": 10})
+    cluster.connect()
+    try:
+        nbytes = args.mb * 1024 * 1024
+
+        @ray_tpu.remote(resources={"remote_node": 1})
+        class Producer:
+            def make(self, n):
+                return np.ones(n, dtype=np.uint8)
+
+            def consume(self, x):
+                return int(x[0])
+
+        prod = Producer.remote()
+        ray_tpu.wait_actor_ready(prod)
+
+        # warm up (worker spawn + first transfer path)
+        r = prod.make.remote(1024 * 1024)
+        ray_tpu.get(r)
+        free([r])
+
+        # node → head pull
+        rates = []
+        for _ in range(args.iters):
+            ref = prod.make.remote(nbytes)
+            ray_tpu.wait([ref], timeout=600)  # produced (in node store)
+            t0 = time.perf_counter()
+            arr = ray_tpu.get(ref, timeout=600)
+            dt = time.perf_counter() - t0
+            assert arr.nbytes == nbytes
+            rates.append(nbytes / dt / (1024**3))
+            del arr
+            free([ref])
+        print(json.dumps({
+            "benchmark": "cross_node_pull",
+            "direction": "node_to_head",
+            "mb": args.mb,
+            "gib_per_s": round(max(rates), 2),
+        }), flush=True)
+
+        # head → node pull
+        rates = []
+        for _ in range(args.iters):
+            data = np.ones(nbytes, dtype=np.uint8)
+            ref = ray_tpu.put(data)
+            t0 = time.perf_counter()
+            assert ray_tpu.get(prod.consume.remote(ref), timeout=600) == 1
+            dt = time.perf_counter() - t0
+            rates.append(nbytes / dt / (1024**3))
+            free([ref])
+        print(json.dumps({
+            "benchmark": "cross_node_pull",
+            "direction": "head_to_node",
+            "mb": args.mb,
+            "gib_per_s": round(max(rates), 2),
+        }), flush=True)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,11 @@ class Config:
     # Chunk size for node-to-node object transfer (reference 64MB chunks:
     # object_manager.cc).
     object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+    # Allow readers to mmap ANOTHER node's shared-memory store directly —
+    # only valid when all "nodes" share one host's filesystem (the
+    # single-host simulation shortcut). Off (default) = cross-node reads
+    # go through the chunked network data plane like the reference.
+    cross_node_shm: bool = False
     # Spill to disk when store is above this fraction.
     object_spilling_threshold: float = 0.8
     spill_directory: str = ""

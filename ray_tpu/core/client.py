@@ -14,6 +14,8 @@ import threading
 from concurrent.futures import Future
 from typing import Any, List, Optional, Sequence
 
+from concurrent.futures import TimeoutError as _CfTimeout
+
 from ray_tpu.core.object_ref import ObjectRef, _RefMarker, _capture, set_ref_tracker
 from ray_tpu.core.object_store import PlasmaClient
 from ray_tpu.core.task_spec import SchedulingStrategy, TaskSpec, TaskType
@@ -278,7 +280,7 @@ class CoreWorker:
             remain = None if deadline is None else max(0.0, deadline - _time.monotonic())
             try:
                 payload, is_err = e.value(remain)
-            except TimeoutError:
+            except (TimeoutError, _CfTimeout):  # _CfTimeout: pre-3.11 alias
                 if resp_fut is not None:
                     resp_fut.cancel()
                 raise GetTimeoutError(f"get() timed out after {timeout}s")
@@ -341,7 +343,23 @@ class CoreWorker:
             return client
 
     def _read_object(self, oid: ObjectID, size: int, node_hex: str, shm_dir: str) -> memoryview:
-        plasma = self._plasma_for(shm_dir)
+        local = self.node_id is not None and node_hex == self.node_id.hex()
+        if not local and not self.config.get("cross_node_shm", False):
+            # Network data plane (reference: object_manager.cc Push/Pull):
+            # the object lives on another node — pull it into THIS node's
+            # store over the network, then map it locally. Cross-node shm
+            # path-opens only work when nodes share one host's filesystem
+            # (the cross_node_shm=True shortcut for co-located clusters).
+            view = self.plasma.try_view(oid, size)
+            if view is not None:
+                return view
+            if not self._call("object_pull", oid, self.node_id):
+                raise ObjectLostError(oid.hex(), "cross-node object pull failed")
+            view = self.plasma.try_view(oid, size)
+            if view is None:
+                raise ObjectLostError(oid.hex(), "object missing after pull")
+            return view
+        plasma = self.plasma if local else self._plasma_for(shm_dir)
         view = plasma.try_view(oid, size)
         if view is not None:
             return view
@@ -541,22 +559,30 @@ class CoreWorker:
         """Publish owner-local objects whose refs are escaping this
         process to the controller directory (promotion-on-escape — the
         reference instead resolves owners from the ref; see
-        memory_store.py module docstring). Blocks on still-pending
-        entries: an escaping ref must be globally resolvable."""
+        memory_store.py module docstring). NON-BLOCKING: ready values are
+        published via a notify on the controller connection (ordered
+        before any subsequent submit on the same connection); pending
+        entries are flagged and publish when their reply resolves them —
+        the controller's dependency wait covers the gap."""
         from ray_tpu.utils.serialization import serialize
 
         for oid in oids:
             oid = oid if isinstance(oid, ObjectID) else ObjectID(oid)
             key = oid.binary()
+            status = self.memory_store.request_promotion(key)
+            if status != "ready":
+                continue  # done / gone / deferred-to-resolve
             e = self.memory_store.lookup(key)
-            if e is None or e.promoted or (e.ready and e.kind == "shm"):
+            if e is None:
                 continue
-            payload, is_err = e.value(timeout)
+            payload, is_err = e.value(0)
             if e.kind == "shm":
-                continue  # resolved to a global shm object while pending
+                continue  # resolved to a global shm object
             if isinstance(payload, Exception):
                 payload, is_err = serialize(payload), True
-            self._call("object_put_inline", oid, bytes(payload), is_err, [])
+            self.loop_runner.submit(
+                self.peer.notify("object_put_inline", oid, bytes(payload), is_err, [])
+            )
             self.memory_store.mark_promoted(key)
 
     def next_task_id(self) -> TaskID:

@@ -30,10 +30,14 @@ class NodeHandle:
 
 
 class Cluster:
-    def __init__(self, head_resources: Optional[Dict[str, float]] = None):
+    def __init__(
+        self,
+        head_resources: Optional[Dict[str, float]] = None,
+        system_config: Optional[Dict] = None,
+    ):
         head_resources = dict(head_resources or {"CPU": 2})
         self.address, self._proc, self._session_dir = api._start_controller(
-            head_resources, {}, owned=False
+            head_resources, system_config or {}, owned=False
         )
         self._admin_runner = rpc.EventLoopThread("cluster-admin")
         self._admin = CoreWorker(self.address, mode="driver", loop_runner=self._admin_runner)

@@ -79,8 +79,12 @@ class ObjectRecord:
     def meta(self, shm_dirs: Dict[NodeID, str]):
         if self.inline is not None:
             return ("inline", self.inline, self.is_error)
-        nid = next(iter(self.locations))
-        return ("shm", self.size, nid.hex(), shm_dirs[nid], self.is_error)
+        # Prefer any LIVE replica (locations may briefly hold a node whose
+        # death is still being processed); None = no live copy.
+        for nid in self.locations:
+            if nid in shm_dirs:
+                return ("shm", self.size, nid.hex(), shm_dirs[nid], self.is_error)
+        return None
 
 
 @dataclass
@@ -110,6 +114,9 @@ class NodeRecord:
     hostname: str = "localhost"
     agent_pid: int = 0  # node agent process (0 for the head)
     state: str = "ALIVE"
+    # Agent's object-transfer listener ("host:port"; "" for the head —
+    # head objects are fetched over the controller connection).
+    fetch_addr: str = ""
     workers: Set[WorkerID] = field(default_factory=set)
     num_starting: int = 0
     max_workers: int = 32
@@ -157,6 +164,11 @@ class ActorRecord:
     # Tasks queued while the actor is not ALIVE.
     pending_tasks: List[TaskSpec] = field(default_factory=list)
     ready_waiters: List[asyncio.Future] = field(default_factory=list)
+
+
+class _NullFetchHandler:
+    def on_disconnect(self, peer):
+        pass
 
 
 class Controller:
@@ -208,6 +220,9 @@ class Controller:
             _collections.OrderedDict()
         )
         self._holder_index: Dict[str, Set[ObjectID]] = {}
+        # In-flight cross-node object pulls, deduped per (oid, dest node).
+        self._pulls: Dict[Tuple[ObjectID, NodeID], asyncio.Future] = {}
+        self._fetch_peers: Dict[str, rpc.Peer] = {}
         self.events: List[dict] = []  # task event ring buffer
         self.finished_specs: Dict[TaskID, TaskSpec] = {}  # lineage for reconstruction
         self.metrics: Dict[str, dict] = {}  # aggregated app metrics
@@ -285,12 +300,15 @@ class Controller:
         self._schedule_pump()
         return {"session_dir": self.session_dir, "config": self.config.to_dict()}
 
-    async def rpc_register_node(self, peer: rpc.Peer, node_id: NodeID, resources: Dict[str, float], shm_dir: str, hostname: str = "localhost", pid: int = 0):
+    async def rpc_register_node(self, peer: rpc.Peer, node_id: NodeID, resources: Dict[str, float], shm_dir: str, hostname: str = "localhost", pid: int = 0, fetch_addr: str = ""):
         peer.meta.update(kind="agent", node_id=node_id)
         total = ResourceSet.from_dict(resources)
         self.cluster.add_node(node_id, NodeResources(total))
         ncpu = int(resources.get("CPU", 1))
-        rec = NodeRecord(node_id=node_id, shm_dir=shm_dir, peer=peer, hostname=hostname)
+        rec = NodeRecord(
+            node_id=node_id, shm_dir=shm_dir, peer=peer, hostname=hostname,
+            fetch_addr=fetch_addr,
+        )
         rec.agent_pid = pid
         rec.max_workers = max(4 * max(ncpu, 1), 16)
         rec.tpu_free = list(range(int(resources.get("TPU", 0))))
@@ -885,11 +903,15 @@ class Controller:
                 except Exception:
                     pass
             await self._on_worker_death(wid, "node died")
-        # Objects whose only copy was there: attempt lineage reconstruction.
+        # Drop the dead node from EVERY record's location set (objects can
+        # have multiple replicas since the network data plane copies them
+        # on pull); objects left with no copy attempt lineage
+        # reconstruction.
         for orec in self.objects.values():
-            if orec.state == "READY" and orec.inline is None and orec.locations and orec.locations <= {node_id}:
+            if orec.state == "READY" and orec.inline is None and node_id in orec.locations:
                 orec.locations.discard(node_id)
-                await self._try_reconstruct(orec)
+                if not orec.locations:
+                    await self._try_reconstruct(orec)
         self.pg_manager.on_node_removed(node_id)
         self._schedule_pump()
 
@@ -994,6 +1016,90 @@ class Controller:
             return self.head_store.ensure_local(oid)
         return await node.peer.call("ensure_local", oid)
 
+    async def rpc_fetch_chunk(self, peer: rpc.Peer, oid: ObjectID, offset: int, length: int):
+        """Serve a chunk of a head-node object to a pulling agent
+        (reference: ObjectManagerService on every node — the head's
+        'agent' is the controller itself)."""
+        from ray_tpu.core.object_transfer import read_chunk
+
+        return rpc.Raw(read_chunk(self.head_store, oid, offset, length))
+
+    async def rpc_object_pull(self, peer: rpc.Peer, oid: ObjectID, dest_node_id: NodeID) -> bool:
+        """Ensure ``oid`` is readable on ``dest_node_id``, transferring it
+        over the network if needed (reference: PullManager + the
+        ownership-based object directory picking the source replica).
+        Concurrent pulls of the same (object, node) coalesce."""
+        orec = self.objects.get(oid)
+        if orec is None or orec.state != "READY" or orec.inline is not None:
+            return False
+        if dest_node_id in orec.locations:
+            return await self.rpc_object_ensure_local(peer, oid, dest_node_id.hex())
+        key = (oid, dest_node_id)
+        existing = self._pulls.get(key)
+        if existing is not None:
+            return await asyncio.shield(existing)
+        fut = asyncio.get_running_loop().create_future()
+        self._pulls[key] = fut
+        try:
+            ok = await self._do_pull(oid, orec, dest_node_id)
+            if not fut.done():
+                fut.set_result(ok)
+            return ok
+        except Exception as e:  # noqa: BLE001 — surface as pull failure
+            logger.warning("object pull %s -> %s failed: %s", oid.hex()[:8], dest_node_id.hex()[:8], e)
+            if not fut.done():
+                fut.set_result(False)
+            return False
+        finally:
+            self._pulls.pop(key, None)
+
+    async def _do_pull(self, oid: ObjectID, orec: ObjectRecord, dest_node_id: NodeID) -> bool:
+        dest = self.nodes.get(dest_node_id)
+        if dest is None:
+            return False
+        # pick a LIVE replica (locations may briefly hold a dying node)
+        src = next(
+            (self.nodes[nid] for nid in orec.locations if nid in self.nodes),
+            None,
+        )
+        if src is None:
+            return False
+        if src.peer is None:
+            src_addr = "controller"  # head objects served by rpc_fetch_chunk
+        else:
+            src_addr = src.fetch_addr
+            if not src_addr:
+                return False
+        if dest.peer is None:
+            # destination is the head: the controller pulls into its own store
+            from ray_tpu.core.object_transfer import pull_into_store
+
+            src_peer = await self._fetch_peer_for(src_addr)
+            if src_peer is None:
+                return False
+            ok = await pull_into_store(
+                self.head_store, oid, orec.size, src_peer,
+                self.config.object_transfer_chunk_bytes,
+            )
+        else:
+            ok = await dest.peer.call("pull_object", oid, orec.size, src_addr)
+        if ok:
+            orec.locations.add(dest_node_id)
+        return bool(ok)
+
+    async def _fetch_peer_for(self, addr: str) -> Optional[rpc.Peer]:
+        if addr == "controller":
+            return None  # head pulling from itself makes no sense
+        p = self._fetch_peers.get(addr)
+        if p is None or p.closed:
+            host, port = addr.rsplit(":", 1)
+            try:
+                p = await rpc.connect(host, int(port), _NullFetchHandler(), retries=3, delay=0.05)
+            except rpc.ConnectionLost:
+                return None
+            self._fetch_peers[addr] = p
+        return p
+
     async def rpc_object_get(self, peer: rpc.Peer, oids: List[ObjectID], timeout: Optional[float]):
         """Long-poll get: resolves when ALL are ready (or raises on timeout)."""
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -1016,7 +1122,22 @@ class Controller:
             if orec.state == "FAILED":
                 metas[oid.hex()] = ("lost", None, True)
             else:
-                metas[oid.hex()] = orec.meta(self._shm_dirs())
+                meta = orec.meta(self._shm_dirs())
+                if meta is None:
+                    # every replica's node died; reconstruction (queued by
+                    # _on_node_death) will re-resolve it, or it is lost
+                    await self._try_reconstruct(orec)
+                    if orec.state == "PENDING":
+                        # re-wait on the reconstructed object
+                        continue_oids = [o for o in oids if o.hex() not in metas]
+                        inner = await self.rpc_object_get(
+                            peer, continue_oids,
+                            None if deadline is None else max(0.0, deadline - time.monotonic()),
+                        )
+                        metas.update(inner["metas"])
+                        return {"timeout": inner["timeout"], "metas": metas}
+                    meta = ("lost", None, True)
+                metas[oid.hex()] = meta
         return {"timeout": False, "metas": metas}
 
     async def rpc_object_wait(self, peer: rpc.Peer, oids: List[ObjectID], num_returns: int, timeout: Optional[float]):

@@ -262,36 +262,61 @@ class ActorSubmitter:
         return True
 
     # -- completion -----------------------------------------------------
+    def _store_result(self, oid, payload, is_err: bool, kind: str, registered: bool) -> None:
+        """Resolve a return entry, honoring escapes and drops that raced
+        the in-flight call: a deferred promotion publishes now; a doomed
+        entry whose object became GLOBAL (shm, or registered by the
+        worker) reports the drop so the controller can GC it."""
+        ms = self.core.memory_store
+        key = oid.binary()
+        doomed, want_promote = ms.put(key, payload, is_err, kind=kind)
+        promoted = registered
+        if registered:
+            ms.mark_promoted(key)
+        if want_promote and kind == "inline" and not registered:
+            data, err = payload, is_err
+            if isinstance(data, Exception):
+                from ray_tpu.utils.serialization import serialize
+
+                data, err = serialize(data), True
+            asyncio.ensure_future(
+                self.core.peer.notify("object_put_inline", oid, bytes(data), err, [])
+            )
+            ms.mark_promoted(key)
+            promoted = True
+        if doomed and (kind == "shm" or promoted):
+            # global object whose local refs all dropped mid-flight — the
+            # flush loop skipped the drop (entry was pending local-only)
+            asyncio.ensure_future(
+                self.core.peer.notify(
+                    "ref_update", self.core.worker_id.hex(), [], [key]
+                )
+            )
+
     def _complete(self, call: _Call, results: List[tuple], error) -> None:
         self.inflight.pop(call.seq, None)
-        ms = self.core.memory_store
         if error is not None:
             from ray_tpu.utils.serialization import serialize
 
             blob = serialize(error)
             for oid in call.spec.return_ids():
-                ms.put(oid.binary(), blob, True)
+                self._store_result(oid, blob, True, "inline", False)
         else:
             for item in results:
                 oid, kind = item[0], item[1]
                 if kind == "inline":
-                    key = oid.binary()
-                    ms.put(key, item[2], bool(item[3]))
-                    if len(item) > 4 and item[4]:
-                        # worker registered it with the controller (nested
-                        # refs) — ref flushes must go global
-                        ms.mark_promoted(key)
+                    registered = bool(len(item) > 4 and item[4])
+                    self._store_result(oid, item[2], bool(item[3]), "inline", registered)
                 else:
-                    ms.put(oid.binary(), None, False, kind="shm")
+                    self._store_result(oid, None, False, "shm", True)
         self._done(call)
 
     def _fail_call(self, call: _Call, exc: Optional[Exception], serialized: Optional[bytes] = None) -> None:
         from ray_tpu.utils.serialization import serialize
 
         blob = serialized if serialized is not None else serialize(exc)
-        ms = self.core.memory_store
         for oid in call.spec.return_ids():
-            ms.put(oid.binary(), blob, True)
+            self._store_result(oid, blob, True, "inline", False)
         self._done(call)
 
     def _fail_all(self, exc: Exception) -> None:
@@ -311,12 +336,19 @@ class ActorSubmitter:
                 del self.queue[i]
                 self._fail_call(call, TaskCancelledError(task_id.hex()))
                 return
-        for call in self.inflight.values():
-            if call.spec.task_id == task_id and self.peer is not None:
+        for seq, call in list(self.inflight.items()):
+            if call.spec.task_id != task_id:
+                continue
+            if call.sent_peer is None:
+                # awaiting resend after a connection loss — cancel locally
+                # instead of silently re-executing on the restarted actor
+                self.inflight.pop(seq, None)
+                self._fail_call(call, TaskCancelledError(task_id.hex()))
+            elif self.peer is not None:
                 asyncio.get_running_loop().create_task(
                     self.peer.notify("cancel", task_id)
                 )
-                return
+            return
 
 
 class _DepFailed(Exception):

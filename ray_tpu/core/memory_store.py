@@ -24,7 +24,10 @@ _UNSET = object()
 
 
 class Entry:
-    __slots__ = ("_lock", "_value", "_future", "promoted", "doomed", "kind")
+    __slots__ = (
+        "_lock", "_value", "_future", "promoted", "doomed",
+        "promote_on_resolve", "kind",
+    )
 
     def __init__(self, lock):
         self._lock = lock  # the store's lock (shared)
@@ -36,6 +39,9 @@ class Entry:
         self._future: Optional[Future] = None
         self.promoted = False  # registered with the controller directory
         self.doomed = False  # all local refs dropped while still pending
+        # A ref escaped while the call was in flight: publish to the
+        # controller as soon as the reply resolves the entry.
+        self.promote_on_resolve = False
         self.kind = "inline"  # inline | shm
 
     @property
@@ -82,15 +88,37 @@ class LocalMemoryStore:
         return self._entries.get(key)
 
     def put(self, key: bytes, payload, is_error: bool, kind: str = "inline"):
+        """Resolve (or create) an entry. Returns (doomed, want_promote):
+        doomed = every local ref was dropped while pending (the entry is
+        discarded; if the object got registered globally the caller must
+        report the drop so the controller can GC it); want_promote = a
+        ref escaped while pending (the caller must publish the value)."""
         with self._lock:
             e = self._entries.get(key)
             if e is None:
                 e = self._entries[key] = Entry(self._lock)
             doomed = e.doomed
+            want_promote = e.promote_on_resolve and not e.promoted
+            e.promote_on_resolve = False
             e.kind = kind
             e._resolve((payload, is_error))
             if doomed:
                 del self._entries[key]
+        return doomed, want_promote
+
+    def request_promotion(self, key: bytes) -> str:
+        """'done' (already global), 'ready' (caller promotes now),
+        'deferred' (pending — promotion happens at resolve), 'gone'."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return "gone"
+            if e.promoted or (e.ready and e.kind == "shm"):
+                return "done"
+            if e.ready:
+                return "ready"
+            e.promote_on_resolve = True
+            return "deferred"
 
     def mark_promoted(self, key: bytes):
         e = self._entries.get(key)

@@ -97,6 +97,9 @@ class NodeAgent:
         self.node_id = NodeID.from_random()
         self.store = PlasmaStore(session_dir, capacity, name=self.node_id.hex()[:8])
         self._exit = asyncio.Event()
+        self._controller_peer = None
+        self._fetch_peers: Dict[str, rpc.Peer] = {}
+        self._chunk_bytes = 8 * 1024 * 1024
 
     # -- notifications from the controller ------------------------------
     def rpc_start_workers(self, peer, n: int):
@@ -112,6 +115,34 @@ class NodeAgent:
     def rpc_ensure_local(self, peer, oid: ObjectID) -> bool:
         return self.store.ensure_local(oid)
 
+    # -- object data plane (reference: object_manager.cc Push/Pull) -----
+    def rpc_fetch_chunk(self, peer, oid: ObjectID, offset: int, length: int):
+        from ray_tpu.core.object_transfer import read_chunk
+
+        # Raw: the chunk crosses as an out-of-band frame (no pickle copy)
+        return rpc.Raw(read_chunk(self.store, oid, offset, length))
+
+    async def rpc_pull_object(self, peer, oid: ObjectID, size: int, src_addr: str) -> bool:
+        """Pull a remote object into this node's store, chunked over the
+        network (reference: PullManager → ObjectBufferPool chunk
+        reassembly). ``src_addr`` is another agent's listener, or
+        "controller" for head-node objects (fetched over the existing
+        controller connection)."""
+        from ray_tpu.core.object_transfer import pull_into_store
+
+        src_peer = await self._peer_for(src_addr)
+        return await pull_into_store(self.store, oid, size, src_peer, self._chunk_bytes)
+
+    async def _peer_for(self, addr: str) -> rpc.Peer:
+        if addr == "controller":
+            return self._controller_peer
+        p = self._fetch_peers.get(addr)
+        if p is None or p.closed:
+            host, port = addr.rsplit(":", 1)
+            p = await rpc.connect(host, int(port), _FetchHandler(), retries=5, delay=0.05)
+            self._fetch_peers[addr] = p
+        return p
+
     def rpc_exit(self, peer):
         self._exit.set()
 
@@ -119,17 +150,28 @@ class NodeAgent:
         return "pong"
 
     def on_disconnect(self, peer):
-        self._exit.set()
+        # Only the controller connection is load-bearing; fetch peers
+        # (other agents pulling from us) come and go.
+        if peer is self._controller_peer or self._controller_peer is None:
+            self._exit.set()
 
     async def run(self):
         host, port = self.controller_addr.rsplit(":", 1)
+        # Listener for sibling agents pulling object chunks (reference:
+        # the ObjectManagerService gRPC server every node runs).
+        _server, fetch_port = await rpc.serve(self, "127.0.0.1", 0)
         peer = await rpc.connect(host, int(port), self)
+        self._controller_peer = peer
+        config = self._chunk_bytes
         import socket
 
-        await peer.call(
+        info = await peer.call(
             "register_node", self.node_id, self.resources, self.store.shm_dir,
-            hostname=socket.gethostname(), pid=os.getpid()
+            hostname=socket.gethostname(), pid=os.getpid(),
+            fetch_addr=f"127.0.0.1:{fetch_port}",
         )
+        cfg = (info or {}).get("config") or {}
+        self._chunk_bytes = int(cfg.get("object_transfer_chunk_bytes", config))
         try:
             while not self._exit.is_set():
                 reap_children()
@@ -140,6 +182,11 @@ class NodeAgent:
         finally:
             kill_children()
             self.store.destroy()
+
+
+class _FetchHandler:
+    def on_disconnect(self, peer):
+        pass
 
 
 def main():

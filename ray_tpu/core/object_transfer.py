@@ -1,0 +1,78 @@
+"""Node↔node object transfer over the RPC layer.
+
+Reference: src/ray/object_manager/object_manager.cc (chunked Push/Pull,
+812 L; 64 MB default chunks), object_buffer_pool.cc (chunk framing) and
+pull_manager.h:43-52 (pull orchestration). Shape here: the DESTINATION
+node's agent pulls chunks from the SOURCE node's agent listener into its
+own plasma store (pull-based, like the reference's PullManager), with a
+bounded window of in-flight chunks (the reference's PushManager
+rate-limits in-flight chunks the same way).
+
+The controller plays the object directory role (reference:
+ownership_based_object_directory.cc): it picks the source replica and
+records the new location when the pull completes.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ray_tpu.utils.ids import ObjectID
+
+logger = logging.getLogger("ray_tpu.object_transfer")
+
+DEFAULT_WINDOW = 4
+
+
+async def fetch_into(src_peer, oid: ObjectID, size: int, view, chunk_bytes: int,
+                     window: int = DEFAULT_WINDOW) -> None:
+    """Fill ``view`` (a writable memoryview of ``size`` bytes) with the
+    object's content fetched from ``src_peer`` in pipelined chunks."""
+    if size <= 0:
+        return
+    sem = asyncio.Semaphore(max(1, window))
+
+    async def one(off: int):
+        n = min(chunk_bytes, size - off)
+        async with sem:
+            data = await src_peer.call("fetch_chunk", oid, off, n)
+        if len(data) != n:
+            raise IOError(
+                f"short chunk for {oid.hex()} at {off}: got {len(data)}, want {n}"
+            )
+        view[off : off + n] = data
+
+    await asyncio.gather(*(one(off) for off in range(0, size, chunk_bytes)))
+
+
+def read_chunk(store, oid: ObjectID, offset: int, length: int) -> bytes:
+    """Serve one chunk out of a node's plasma store (source side)."""
+    store.ensure_local(oid)
+    buf = store.get(oid)
+    if buf is None:
+        raise KeyError(f"object {oid.hex()} not in store")
+    try:
+        return bytes(buf.view()[offset : offset + length])
+    finally:
+        buf.close()
+
+
+async def pull_into_store(store, oid: ObjectID, size: int, src_peer,
+                          chunk_bytes: int) -> bool:
+    """Pull a remote object into ``store`` (destination side). Partial
+    pulls are deleted on failure so the store never holds torn objects."""
+    if store.contains(oid) and store.ensure_local(oid):
+        return True
+    try:
+        buf = store.create(oid, size)
+    except FileExistsError:
+        return True  # concurrent pull won
+    try:
+        await fetch_into(src_peer, oid, size, buf.view(), chunk_bytes)
+    except BaseException:
+        buf.close()
+        store.delete(oid)
+        raise
+    buf.close()
+    store.seal(oid)
+    return True

@@ -25,10 +25,28 @@ logger = logging.getLogger(__name__)
 
 _REQ, _RESP, _ERR, _NOTIFY = 0, 1, 2, 3
 _HDR = struct.Struct("<Q")
+# Out-of-band frame marker: frames normally start with pickle's 0x80
+# protocol opcode; a 0x01 first byte instead means
+# [0x01][u32 head_len][head pickle (kind, msg_id)][raw payload bytes] —
+# the payload crosses WITHOUT being pickled (no serialize copy on the
+# sender, zero-copy memoryview on the receiver). Used for bulk data
+# (object-transfer chunks; reference analogue: gRPC byte-buffer frames).
+_OOB_MARK = 0x01
+_OOB_HEAD = struct.Struct("<I")
 
 
 class ConnectionLost(ConnectionError):
     pass
+
+
+class Raw:
+    """Wrap a handler's return value to send it as an out-of-band raw
+    frame; the caller receives a zero-copy memoryview."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data):
+        self.data = data
 
 
 class Peer:
@@ -49,7 +67,13 @@ class Peer:
         self._closed = False
         self._recv_task: asyncio.Task | None = None
         self._outbuf: list[bytes] = []
+        self._outbuf_bytes = 0
         self._flushing = False
+        self._drained = asyncio.Event()
+        self._drained.set()
+        # notify() applies backpressure above this backlog (call() gets
+        # natural flow control from awaiting replies).
+        self.backlog_limit = 8 * 1024 * 1024
         # Arbitrary metadata the handler may attach (worker id, node id, ...).
         self.meta: dict[str, Any] = {}
 
@@ -62,6 +86,23 @@ class Peer:
         data = pickle.dumps(frame, protocol=5)
         self._outbuf.append(_HDR.pack(len(data)))
         self._outbuf.append(data)
+        self._outbuf_bytes += _HDR.size + len(data)
+        if self._outbuf_bytes > self.backlog_limit:
+            self._drained.clear()
+        if not self._flushing:
+            self._flushing = True
+            asyncio.get_running_loop().create_task(self._flush())
+
+    def _enqueue_raw_response(self, msg_id: int, payload):
+        head = pickle.dumps((_RESP, msg_id), protocol=5)
+        payload = memoryview(payload)
+        total = 1 + _OOB_HEAD.size + len(head) + payload.nbytes
+        self._outbuf.append(_HDR.pack(total))
+        self._outbuf.append(bytes([_OOB_MARK]) + _OOB_HEAD.pack(len(head)) + head)
+        self._outbuf.append(payload)  # written without a join copy
+        self._outbuf_bytes += _HDR.size + total
+        if self._outbuf_bytes > self.backlog_limit:
+            self._drained.clear()
         if not self._flushing:
             self._flushing = True
             asyncio.get_running_loop().create_task(self._flush())
@@ -70,13 +111,29 @@ class Peer:
         try:
             while self._outbuf:
                 chunk, self._outbuf = self._outbuf, []
-                self.writer.write(b"".join(chunk))
+                self._outbuf_bytes = 0
+                # Large items (raw payloads) are written individually so
+                # the b"".join never copies bulk data.
+                small: list[bytes] = []
+                for item in chunk:
+                    if len(item) > 256 * 1024:
+                        if small:
+                            self.writer.write(b"".join(small))
+                            small = []
+                        self.writer.write(item)
+                    else:
+                        small.append(bytes(item))
+                if small:
+                    self.writer.write(b"".join(small))
                 await self.writer.drain()
+                if self._outbuf_bytes <= self.backlog_limit:
+                    self._drained.set()
         except (ConnectionError, OSError):
             if not self._closed:
                 await self._on_disconnect()
         finally:
             self._flushing = False
+            self._drained.set()  # never leave a notifier waiting forever
 
     def call_nowait(self, method: str, *args, **kwargs) -> asyncio.Future:
         """Issue a request and return its reply future without awaiting
@@ -98,6 +155,11 @@ class Peer:
         if self._closed:
             return
         self._enqueue_frame((_NOTIFY, 0, method, (args, kwargs)))
+        if not self._drained.is_set():
+            # Backpressure: a fast notifier must not grow the buffer
+            # unboundedly against a slow receiver (the pre-batching path
+            # awaited writer.drain on every send).
+            await self._drained.wait()
 
     async def _recv_loop(self):
         try:
@@ -105,7 +167,14 @@ class Peer:
                 hdr = await self.reader.readexactly(_HDR.size)
                 (length,) = _HDR.unpack(hdr)
                 data = await self.reader.readexactly(length)
-                kind, msg_id, a, b = pickle.loads(data)
+                if data[0] == _OOB_MARK:
+                    (head_len,) = _OOB_HEAD.unpack(data[1 : 1 + _OOB_HEAD.size])
+                    off = 1 + _OOB_HEAD.size
+                    kind, msg_id = pickle.loads(data[off : off + head_len])
+                    a = memoryview(data)[off + head_len :]  # zero-copy payload
+                    b = None
+                else:
+                    kind, msg_id, a, b = pickle.loads(data)
                 if kind == _RESP:
                     fut = self._pending.pop(msg_id, None)
                     if fut is not None and not fut.done():
@@ -153,7 +222,10 @@ class Peer:
         if self._closed:
             return
         try:
-            self._enqueue_frame((_RESP, msg_id, res, None))
+            if isinstance(res, Raw):
+                self._enqueue_raw_response(msg_id, res.data)
+            else:
+                self._enqueue_frame((_RESP, msg_id, res, None))
         except Exception as e:  # noqa: BLE001 — unpicklable result
             self._respond_err(msg_id, method, e)
 

@@ -1,0 +1,92 @@
+"""Network object data plane: node↔node chunked transfer.
+
+Reference test model: python/ray/tests/test_object_manager.py (push/pull
+across nodes). Cross-node shm mapping is DISABLED by default
+(``cross_node_shm=False``), so these tests prove the network path moves
+the bytes — the topology a real multi-host pod has.
+"""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster_utils import Cluster
+
+
+@pytest.fixture
+def two_node_cluster():
+    cluster = Cluster({"CPU": 2})
+    cluster.add_node(num_cpus=2, resources={"remote_node": 10})
+    cluster.connect()
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+MB = 1024 * 1024
+
+
+def test_cross_node_get_over_network(two_node_cluster):
+    """An object produced on node B is get-able from the driver (head)
+    via chunked network pull — no cross-node shm open."""
+
+    @ray_tpu.remote(resources={"remote_node": 1})
+    def produce():
+        return np.arange(4 * MB, dtype=np.uint8).reshape(4, MB)
+
+    arr = ray_tpu.get(produce.remote(), timeout=120)
+    assert arr.shape == (4, MB)
+    assert arr[2, 5] == np.uint8(5)
+
+
+def test_driver_object_read_on_remote_node(two_node_cluster):
+    data = np.full(3 * MB, 7, dtype=np.uint8)
+    ref = ray_tpu.put(data)
+
+    @ray_tpu.remote(resources={"remote_node": 1})
+    def consume(x):
+        return int(x.sum())
+
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == 7 * 3 * MB
+
+
+def test_concurrent_pulls_coalesce(two_node_cluster):
+    """Two readers on the remote node pulling the same object at once."""
+    data = np.ones(4 * MB, dtype=np.uint8)
+    ref = ray_tpu.put(data)
+
+    @ray_tpu.remote(resources={"remote_node": 0.5})
+    def consume(x):
+        return int(x[0]) + int(x[-1])
+
+    out = ray_tpu.get([consume.remote(ref), consume.remote(ref)], timeout=120)
+    assert out == [2, 2]
+
+
+def test_round_trip_both_directions(two_node_cluster):
+    """head→node and node→head transfers of the same bytes agree."""
+
+    @ray_tpu.remote(resources={"remote_node": 1})
+    def bounce(x):
+        return x * 2
+
+    data = np.arange(2 * MB, dtype=np.int32)
+    out = ray_tpu.get(bounce.remote(ray_tpu.put(data)), timeout=120)
+    np.testing.assert_array_equal(out, data * 2)
+
+
+def test_cross_node_shm_legacy_mode():
+    """cross_node_shm=True keeps the single-host mmap shortcut working."""
+    cluster = Cluster({"CPU": 2}, system_config={"cross_node_shm": True})
+    cluster.add_node(num_cpus=2, resources={"remote_node": 10})
+    cluster.connect()
+    try:
+
+        @ray_tpu.remote(resources={"remote_node": 1})
+        def produce():
+            return np.zeros(2 * MB, dtype=np.uint8)
+
+        arr = ray_tpu.get(produce.remote(), timeout=120)
+        assert arr.nbytes == 2 * MB
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
