@@ -327,7 +327,13 @@ class CoreWorker:
                     value = deserialize(data)
                 else:
                     _, size, node_hex, shm_dir, is_error = meta
-                    value = deserialize(self._read_object(oid, size, node_hex, shm_dir))
+                    remain = (
+                        None if deadline is None
+                        else max(0.1, deadline - _time.monotonic())
+                    )
+                    value = deserialize(
+                        self._read_object(oid, size, node_hex, shm_dir, timeout=remain)
+                    )
             if is_error:
                 raise value
             out.append(value)
@@ -342,7 +348,8 @@ class CoreWorker:
                 client = self._plasma_clients[shm_dir] = PlasmaClient(shm_dir)
             return client
 
-    def _read_object(self, oid: ObjectID, size: int, node_hex: str, shm_dir: str) -> memoryview:
+    def _read_object(self, oid: ObjectID, size: int, node_hex: str, shm_dir: str,
+                     timeout: Optional[float] = None) -> memoryview:
         local = self.node_id is not None and node_hex == self.node_id.hex()
         if not local and not self.config.get("cross_node_shm", False):
             # Network data plane (reference: object_manager.cc Push/Pull):
@@ -353,7 +360,13 @@ class CoreWorker:
             view = self.plasma.try_view(oid, size)
             if view is not None:
                 return view
-            if not self._call("object_pull", oid, self.node_id):
+            try:
+                ok = self._call("object_pull", oid, self.node_id, timeout=timeout)
+            except (TimeoutError, _CfTimeout):
+                raise GetTimeoutError(
+                    f"get() timed out pulling {oid.hex()[:8]} cross-node"
+                )
+            if not ok:
                 raise ObjectLostError(oid.hex(), "cross-node object pull failed")
             view = self.plasma.try_view(oid, size)
             if view is None:

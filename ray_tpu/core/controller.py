@@ -166,11 +166,6 @@ class ActorRecord:
     ready_waiters: List[asyncio.Future] = field(default_factory=list)
 
 
-class _NullFetchHandler:
-    def on_disconnect(self, peer):
-        pass
-
-
 class Controller:
     def __init__(self, session_dir: str, head_resources: Dict[str, float], config: Config, owned: bool):
         self.session_dir = session_dir
@@ -221,8 +216,10 @@ class Controller:
         )
         self._holder_index: Dict[str, Set[ObjectID]] = {}
         # In-flight cross-node object pulls, deduped per (oid, dest node).
+        from ray_tpu.core.object_transfer import FetchPeerCache
+
         self._pulls: Dict[Tuple[ObjectID, NodeID], asyncio.Future] = {}
-        self._fetch_peers: Dict[str, rpc.Peer] = {}
+        self._fetch_peers = FetchPeerCache()
         self.events: List[dict] = []  # task event ring buffer
         self.finished_specs: Dict[TaskID, TaskSpec] = {}  # lineage for reconstruction
         self.metrics: Dict[str, dict] = {}  # aggregated app metrics
@@ -232,6 +229,9 @@ class Controller:
         self.head_node_id = NodeID.from_random()
         cap = config.object_store_memory or _default_store_bytes()
         self.head_store = PlasmaStore(session_dir, cap)
+        from ray_tpu.core.object_transfer import ChunkReader
+
+        self._chunk_reader = ChunkReader(self.head_store)
         head_total = ResourceSet.from_dict(head_resources)
         self.cluster.add_node(self.head_node_id, NodeResources(head_total, labels={"node_type": "head"}))
         import socket
@@ -1020,9 +1020,7 @@ class Controller:
         """Serve a chunk of a head-node object to a pulling agent
         (reference: ObjectManagerService on every node — the head's
         'agent' is the controller itself)."""
-        from ray_tpu.core.object_transfer import read_chunk
-
-        return rpc.Raw(read_chunk(self.head_store, oid, offset, length))
+        return rpc.Raw(self._chunk_reader.read(oid, offset, length))
 
     async def rpc_object_pull(self, peer: rpc.Peer, oid: ObjectID, dest_node_id: NodeID) -> bool:
         """Ensure ``oid`` is readable on ``dest_node_id``, transferring it
@@ -1090,15 +1088,7 @@ class Controller:
     async def _fetch_peer_for(self, addr: str) -> Optional[rpc.Peer]:
         if addr == "controller":
             return None  # head pulling from itself makes no sense
-        p = self._fetch_peers.get(addr)
-        if p is None or p.closed:
-            host, port = addr.rsplit(":", 1)
-            try:
-                p = await rpc.connect(host, int(port), _NullFetchHandler(), retries=3, delay=0.05)
-            except rpc.ConnectionLost:
-                return None
-            self._fetch_peers[addr] = p
-        return p
+        return await self._fetch_peers.get(addr)
 
     async def rpc_object_get(self, peer: rpc.Peer, oids: List[ObjectID], timeout: Optional[float]):
         """Long-poll get: resolves when ALL are ready (or raises on timeout)."""

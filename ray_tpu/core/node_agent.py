@@ -98,7 +98,10 @@ class NodeAgent:
         self.store = PlasmaStore(session_dir, capacity, name=self.node_id.hex()[:8])
         self._exit = asyncio.Event()
         self._controller_peer = None
-        self._fetch_peers: Dict[str, rpc.Peer] = {}
+        from ray_tpu.core.object_transfer import ChunkReader, FetchPeerCache
+
+        self._fetch_peers = FetchPeerCache()
+        self._chunk_reader = ChunkReader(self.store)
         self._chunk_bytes = 8 * 1024 * 1024
 
     # -- notifications from the controller ------------------------------
@@ -117,10 +120,8 @@ class NodeAgent:
 
     # -- object data plane (reference: object_manager.cc Push/Pull) -----
     def rpc_fetch_chunk(self, peer, oid: ObjectID, offset: int, length: int):
-        from ray_tpu.core.object_transfer import read_chunk
-
         # Raw: the chunk crosses as an out-of-band frame (no pickle copy)
-        return rpc.Raw(read_chunk(self.store, oid, offset, length))
+        return rpc.Raw(self._chunk_reader.read(oid, offset, length))
 
     async def rpc_pull_object(self, peer, oid: ObjectID, size: int, src_addr: str) -> bool:
         """Pull a remote object into this node's store, chunked over the
@@ -136,11 +137,9 @@ class NodeAgent:
     async def _peer_for(self, addr: str) -> rpc.Peer:
         if addr == "controller":
             return self._controller_peer
-        p = self._fetch_peers.get(addr)
-        if p is None or p.closed:
-            host, port = addr.rsplit(":", 1)
-            p = await rpc.connect(host, int(port), _FetchHandler(), retries=5, delay=0.05)
-            self._fetch_peers[addr] = p
+        p = await self._fetch_peers.get(addr)
+        if p is None:
+            raise ConnectionError(f"cannot reach source agent at {addr}")
         return p
 
     def rpc_exit(self, peer):
@@ -156,10 +155,13 @@ class NodeAgent:
             self._exit.set()
 
     async def run(self):
+        from ray_tpu.utils.net import host_ip
+
         host, port = self.controller_addr.rsplit(":", 1)
         # Listener for sibling agents pulling object chunks (reference:
-        # the ObjectManagerService gRPC server every node runs).
-        _server, fetch_port = await rpc.serve(self, "127.0.0.1", 0)
+        # the ObjectManagerService gRPC server every node runs). Binds
+        # all interfaces; advertises a cross-host-routable address.
+        _server, fetch_port = await rpc.serve(self, "0.0.0.0", 0)
         peer = await rpc.connect(host, int(port), self)
         self._controller_peer = peer
         config = self._chunk_bytes
@@ -168,7 +170,7 @@ class NodeAgent:
         info = await peer.call(
             "register_node", self.node_id, self.resources, self.store.shm_dir,
             hostname=socket.gethostname(), pid=os.getpid(),
-            fetch_addr=f"127.0.0.1:{fetch_port}",
+            fetch_addr=f"{host_ip()}:{fetch_port}",
         )
         cfg = (info or {}).get("config") or {}
         self._chunk_bytes = int(cfg.get("object_transfer_chunk_bytes", config))
@@ -181,12 +183,8 @@ class NodeAgent:
                     pass
         finally:
             kill_children()
+            self._chunk_reader.close()
             self.store.destroy()
-
-
-class _FetchHandler:
-    def on_disconnect(self, peer):
-        pass
 
 
 def main():

@@ -120,6 +120,14 @@ class PlasmaStore:
     def _shm_path(self, oid: ObjectID) -> str:
         return os.path.join(self.shm_dir, oid.hex())
 
+    def _part_path(self, oid: ObjectID) -> str:
+        # File-tier objects are written under a .part name and renamed on
+        # seal, so readers (PlasmaClient.try_view has no entry table) can
+        # NEVER map an in-progress object — torn reads during network
+        # pulls were possible otherwise (the arena tier's lookup already
+        # refuses unsealed slots).
+        return os.path.join(self.shm_dir, oid.hex() + ".part")
+
     def _spill_path(self, oid: ObjectID) -> str:
         return os.path.join(self.spill_dir, oid.hex())
 
@@ -136,7 +144,7 @@ class PlasmaStore:
             self._maybe_evict(size)
             self._entries[oid] = PlasmaEntry(size=size)
             self.used += size
-        return PlasmaBuffer(self._shm_path(oid), size, writable=True)
+        return PlasmaBuffer(self._part_path(oid), size, writable=True)
 
     def _arena_alloc_evicting(self, oid_bytes: bytes, size: int):
         """Arena alloc, spilling LRU victims to disk until it fits (the
@@ -168,6 +176,8 @@ class PlasmaStore:
                 e.sealed = True
                 if e.in_arena and self._arena is not None:
                     self._arena.seal(oid.binary())
+                elif os.path.exists(self._part_path(oid)):
+                    os.rename(self._part_path(oid), self._shm_path(oid))
 
     def put_bytes(self, oid: ObjectID, data: bytes | memoryview) -> int:
         buf = self.create(oid, len(data))
@@ -252,7 +262,7 @@ class PlasmaStore:
                 self._arena.delete(oid.binary())
             elif not e.spilled:
                 self.used -= e.size
-            for p in (self._shm_path(oid), self._spill_path(oid)):
+            for p in (self._shm_path(oid), self._part_path(oid), self._spill_path(oid)):
                 try:
                     os.unlink(p)
                 except FileNotFoundError:
@@ -358,13 +368,17 @@ class PlasmaClient:
                 arena.seal(oid.binary())
                 return total
         path = self._path(oid)
-        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+        # write under .part, rename on completion: readers never see a
+        # torn object (see PlasmaStore._part_path)
+        part = path + ".part"
+        fd = os.open(part, os.O_RDWR | os.O_CREAT, 0o600)
         try:
             os.ftruncate(fd, total)
             with mmap.mmap(fd, total, access=mmap.ACCESS_WRITE) as mm:
                 write_parts(memoryview(mm), meta, raws)
         finally:
             os.close(fd)
+        os.rename(part, path)
         return total
 
     def put_bytes(self, oid: ObjectID, data: bytes | memoryview) -> int:
@@ -382,13 +396,15 @@ class PlasmaClient:
                 arena.seal(oid.binary())
                 return len(data)
         path = self._path(oid)
-        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+        part = path + ".part"
+        fd = os.open(part, os.O_RDWR | os.O_CREAT, 0o600)
         try:
             os.ftruncate(fd, len(data))
             with mmap.mmap(fd, len(data), access=mmap.ACCESS_WRITE) as mm:
                 mm[: len(data)] = data
         finally:
             os.close(fd)
+        os.rename(part, path)
         return len(data)
 
     def get_buffer(self, oid: ObjectID, size: int):

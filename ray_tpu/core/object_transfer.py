@@ -15,8 +15,11 @@ records the new location when the pull completes.
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
+from typing import Optional
 
+from ray_tpu.utils import rpc
 from ray_tpu.utils.ids import ObjectID
 
 logger = logging.getLogger("ray_tpu.object_transfer")
@@ -25,11 +28,16 @@ DEFAULT_WINDOW = 4
 
 
 async def fetch_into(src_peer, oid: ObjectID, size: int, view, chunk_bytes: int,
-                     window: int = DEFAULT_WINDOW) -> None:
+                     window: int = DEFAULT_WINDOW) -> Optional[BaseException]:
     """Fill ``view`` (a writable memoryview of ``size`` bytes) with the
-    object's content fetched from ``src_peer`` in pipelined chunks."""
+    object's content fetched from ``src_peer`` in pipelined chunks.
+
+    Returns the first error (traceback stripped) instead of raising: by
+    return time every chunk task has finished, and no frame anywhere
+    still exports ``view`` — so the caller can close its buffer without
+    BufferError and clean up a torn object."""
     if size <= 0:
-        return
+        return None
     sem = asyncio.Semaphore(max(1, window))
 
     async def one(off: int):
@@ -42,37 +50,119 @@ async def fetch_into(src_peer, oid: ObjectID, size: int, view, chunk_bytes: int,
             )
         view[off : off + n] = data
 
-    await asyncio.gather(*(one(off) for off in range(0, size, chunk_bytes)))
+    results = await asyncio.gather(
+        *(one(off) for off in range(0, size, chunk_bytes)),
+        return_exceptions=True,
+    )
+    for r in results:
+        if isinstance(r, BaseException):
+            # the traceback chain would pin frames that captured `view`
+            return r.with_traceback(None)
+    return None
+
+
+class ChunkReader:
+    """Source-side chunk server with a small cache of open buffers — a
+    1 GiB transfer is 128 chunk RPCs, and re-mmapping the whole object
+    per chunk costs more than the copy (reference: ObjectBufferPool
+    holds the object's chunks open for the transfer's duration)."""
+
+    def __init__(self, store, capacity: int = 4):
+        self.store = store
+        self.capacity = capacity
+        self._bufs: "collections.OrderedDict[ObjectID, object]" = collections.OrderedDict()
+
+    def read(self, oid: ObjectID, offset: int, length: int) -> bytes:
+        buf = self._bufs.pop(oid, None)
+        if buf is None:
+            self.store.ensure_local(oid)
+            buf = self.store.get(oid)
+            if buf is None:
+                raise KeyError(f"object {oid.hex()} not in store")
+        view = buf.view()
+        try:
+            data = bytes(view[offset : offset + length])
+            last = offset + length >= view.nbytes
+        finally:
+            del view
+        if last:
+            buf.close()  # final chunk — transfer complete
+        else:
+            self._bufs[oid] = buf
+            while len(self._bufs) > self.capacity:
+                _, old = self._bufs.popitem(last=False)
+                old.close()
+        return data
+
+    def close(self):
+        while self._bufs:
+            _, buf = self._bufs.popitem()
+            buf.close()
 
 
 def read_chunk(store, oid: ObjectID, offset: int, length: int) -> bytes:
-    """Serve one chunk out of a node's plasma store (source side)."""
+    """One-shot chunk read (no caching) — kept for small transfers."""
     store.ensure_local(oid)
     buf = store.get(oid)
     if buf is None:
         raise KeyError(f"object {oid.hex()} not in store")
     try:
-        return bytes(buf.view()[offset : offset + length])
+        view = buf.view()
+        try:
+            return bytes(view[offset : offset + length])
+        finally:
+            del view
     finally:
         buf.close()
+
+
+class FetchPeerCache:
+    """Cached connections to other nodes' transfer listeners (used by
+    both the node agent and the controller's head-pull path)."""
+
+    class _Handler:
+        def on_disconnect(self, peer):
+            pass
+
+    def __init__(self):
+        self._peers: dict = {}
+
+    async def get(self, addr: str) -> Optional[rpc.Peer]:
+        p = self._peers.get(addr)
+        if p is None or p.closed:
+            host, port = addr.rsplit(":", 1)
+            try:
+                p = await rpc.connect(
+                    host, int(port), FetchPeerCache._Handler(), retries=5, delay=0.05
+                )
+            except rpc.ConnectionLost:
+                return None
+            self._peers[addr] = p
+        return p
+
+    def drop(self, addr: str):
+        self._peers.pop(addr, None)
 
 
 async def pull_into_store(store, oid: ObjectID, size: int, src_peer,
                           chunk_bytes: int) -> bool:
     """Pull a remote object into ``store`` (destination side). Partial
-    pulls are deleted on failure so the store never holds torn objects."""
+    pulls are deleted on failure so the store never holds torn objects
+    (unsealed objects are additionally invisible to readers — arena
+    lookups require the sealed state; file-tier objects live under a
+    .part name until sealed)."""
     if store.contains(oid) and store.ensure_local(oid):
         return True
     try:
         buf = store.create(oid, size)
     except FileExistsError:
         return True  # concurrent pull won
-    try:
-        await fetch_into(src_peer, oid, size, buf.view(), chunk_bytes)
-    except BaseException:
-        buf.close()
-        store.delete(oid)
-        raise
+    view = buf.view()
+    err = await fetch_into(src_peer, oid, size, view, chunk_bytes)
+    del view
     buf.close()
+    if err is not None:
+        store.delete(oid)
+        raise err
     store.seal(oid)
     return True

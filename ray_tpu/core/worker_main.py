@@ -432,11 +432,14 @@ def main():
     worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
     shm_dir = os.environ["RAY_TPU_SHM_DIR"]
 
+    from ray_tpu.utils.net import host_ip
+
     handler = WorkerHandler()
     loop_runner = rpc.EventLoopThread("worker-io")
     # Direct-transport listener: callers push actor tasks straight here
     # (reference: each worker hosts a CoreWorkerService gRPC server).
-    _server, listen_port = loop_runner.run(rpc.serve(handler, "127.0.0.1", 0))
+    # Binds all interfaces; advertises a cross-host-routable address.
+    _server, listen_port = loop_runner.run(rpc.serve(handler, "0.0.0.0", 0))
     core = CoreWorker(
         addr,
         mode="worker",
@@ -445,7 +448,7 @@ def main():
         worker_id=worker_id,
         node_id=node_id,
         local_shm_dir=shm_dir,
-        listen_addr=f"127.0.0.1:{listen_port}",
+        listen_addr=f"{host_ip()}:{listen_port}",
     )
     handler._controller_peer = core.peer
     # Make the full public API usable from inside tasks (nested tasks,

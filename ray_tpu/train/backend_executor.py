@@ -74,9 +74,13 @@ class BackendExecutor:
                 experiment_name=self.experiment_name,
                 storage_path=self.storage_path,
             )
-            env = self._visibility_env(w, tpu_per_worker)
+            env = dict(self.scaling.worker_env or {})
+            env.update(self._visibility_env(w, tpu_per_worker))
             refs.append(
-                w.actor.setup_session.remote(ctx, group_name, latest_checkpoint, env)
+                w.actor.setup_session.remote(
+                    ctx, group_name, latest_checkpoint, env,
+                    jax_distributed=self.scaling.use_jax_distributed,
+                )
             )
         ray_tpu.get(refs)
 
@@ -101,12 +105,38 @@ class BackendExecutor:
             for w in self.worker_group.workers
         ]
 
-    def next_results(self) -> Optional[List[dict]]:
-        """One result per rank, or None when all loops finished."""
+    def next_results(self, run_refs: Optional[List] = None) -> Optional[List[dict]]:
+        """One result per rank, or None when all loops finished.
+
+        ``run_refs`` (the run_train_fn return refs) are watched while
+        waiting: a training loop that dies before its first report —
+        including failing to even deserialize the train fn — must surface
+        as an error, not leave next_result() blocked forever."""
         assert self.worker_group is not None
-        results = ray_tpu.get(
-            [w.actor.next_result.remote() for w in self.worker_group.workers]
-        )
+        result_refs = [
+            w.actor.next_result.remote() for w in self.worker_group.workers
+        ]
+        if run_refs:
+            result_set = set(result_refs)
+            pending_run = list(run_refs)
+            while True:
+                ready, _ = ray_tpu.wait(
+                    result_refs + pending_run,
+                    num_returns=len(result_refs),
+                    timeout=5.0,
+                )
+                if sum(1 for r in ready if r in result_set) == len(result_refs):
+                    break
+                for r in ready:
+                    if r not in result_set:
+                        # raises the loop's error if it failed; a clean
+                        # finish resolves next_result() to None shortly.
+                        # Seen run refs leave the wait set — otherwise a
+                        # finished loop would satisfy the quota instantly
+                        # and turn this into a zero-delay spin.
+                        ray_tpu.get(r)
+                        pending_run.remove(r)
+        results = ray_tpu.get(result_refs)
         done = [r is None for r in results]
         if all(done):
             return None

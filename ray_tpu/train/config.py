@@ -24,6 +24,15 @@ class ScalingConfig:
     placement_strategy: str = "PACK"
     # e.g. "v5p-16": informs slice-aware placement; None = any chips.
     topology: Optional[str] = None
+    # Multi-host SPMD: every worker is one host process of a single JAX
+    # runtime — ranks rendezvous through the controller KV and call
+    # jax.distributed.initialize before the training loop, so
+    # jax.devices() spans the gang (reference precedent:
+    # train/torch/xla/config.py env-var rendezvous + init_process_group).
+    use_jax_distributed: bool = False
+    # Extra env vars applied in each worker BEFORE jax initializes
+    # (platform pinning, XLA flags).
+    worker_env: Optional[Dict[str, str]] = None
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker is not None:

@@ -98,7 +98,7 @@ class DataParallelTrainer:
                 run_refs = executor.start_training(self._train_fn, self._config)
                 try:
                     while True:
-                        results = executor.next_results()
+                        results = executor.next_results(run_refs)
                         if results is None:
                             break
                         rank0 = results[0]

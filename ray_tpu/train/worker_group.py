@@ -42,6 +42,7 @@ class TrainWorker:
         group_name: str,
         latest_checkpoint: Optional[str],
         env_vars: Optional[Dict[str, str]] = None,
+        jax_distributed: bool = False,
     ):
         from ray_tpu import collective
 
@@ -49,6 +50,13 @@ class TrainWorker:
             os.environ[k] = v
         self._session = _TrainSession(ctx, group_name, latest_checkpoint)
         _set_session(self._session)
+        if jax_distributed:
+            # One JAX runtime across the gang: rendezvous via controller
+            # KV, then jax.distributed.initialize (multi-host SPMD).
+            from ray_tpu.train.jax_rendezvous import setup_jax_distributed
+
+            setup_jax_distributed(ctx.world_rank, ctx.world_size, group_name)
+            self._jax_distributed = True
         # Join the rank-sync collective group for report() barriers.
         collective.init_collective_group(
             ctx.world_size, ctx.world_rank, "host", group_name
@@ -77,6 +85,10 @@ class TrainWorker:
     def teardown(self):
         from ray_tpu import collective
 
+        if getattr(self, "_jax_distributed", False):
+            from ray_tpu.train.jax_rendezvous import shutdown_jax_distributed
+
+            shutdown_jax_distributed()
         if self._session is not None:
             try:
                 collective.destroy_collective_group(self._session.group_name)

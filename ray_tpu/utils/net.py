@@ -1,0 +1,21 @@
+"""Host address resolution for cross-host listeners.
+
+Listeners that other HOSTS must reach (worker direct-transport
+listeners, agent object-transfer listeners) bind all interfaces and
+advertise a routable address: RAY_TPU_NODE_IP when the operator set
+one, else the hostname's resolved address, else loopback (single-host
+simulations)."""
+from __future__ import annotations
+
+import os
+import socket
+
+
+def host_ip() -> str:
+    ip = os.environ.get("RAY_TPU_NODE_IP")
+    if ip:
+        return ip
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
