@@ -1,0 +1,127 @@
+"""7B north-star config: sharded AOT compile proof.
+
+The single-chip bench (bench.py) runs the largest config one v5e holds;
+the BASELINE.json north star is tokens/s/chip AT 7B — which only exists
+sharded. This script AOT-compiles the FULL train step (loss + grads +
+adamw update, remat, flash attention) for a Llama-2-7B-shaped config
+with MeshPlan(fsdp=8) on an 8-device mesh, entirely from abstract
+arrays (no 28 GB of host RAM needed), and records XLA's memory analysis
+— proving the sharded program compiles and that per-device state fits a
+v5e/v5p chip's HBM.
+
+Usage:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+            python benchmarks/compile_7b.py [--out benchmarks/COMPILE_7B.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="")
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from ray_tpu.models import transformer as tf
+    from ray_tpu.parallel import MeshPlan, build_mesh
+    from ray_tpu.parallel import mesh as mesh_lib
+    from ray_tpu.parallel.train_step import make_optimizer, make_train_step
+
+    assert jax.device_count() >= 8, (
+        "need 8 devices: run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    )
+    cfg = tf.TransformerConfig(
+        vocab_size=32000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=32,
+        d_ff=11008,
+        max_seq_len=4096,
+        dtype=jnp.bfloat16,
+        remat=True,
+    )
+    plan = MeshPlan(fsdp=8)
+    mesh = build_mesh(plan)
+    opt = make_optimizer(lr=3e-4, warmup=100)
+
+    # Abstract sharded state: eval_shape gives shapes/dtypes; the plan's
+    # param/optimizer shardings attach without materializing 28 GB.
+    p_shard = mesh_lib.param_shardings(mesh, cfg, plan)
+    params_abs = jax.eval_shape(lambda k: tf.init_params(k, cfg), jax.random.PRNGKey(0))
+    params_abs = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        params_abs, p_shard,
+    )
+    n_params = sum(
+        int(jnp.prod(jnp.array(a.shape))) for a in jax.tree.leaves(params_abs)
+    )
+    from ray_tpu.parallel.train_step import _opt_state_shardings
+
+    opt_abs = jax.eval_shape(opt.init, params_abs)
+    opt_shard = _opt_state_shardings(opt, params_abs, p_shard, mesh)
+    opt_abs = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        opt_abs, opt_shard,
+    )
+    batch_size, seq = 8, 2048
+    batch_abs = {
+        "tokens": jax.ShapeDtypeStruct(
+            (batch_size, seq + 1), jnp.int32,
+            sharding=mesh_lib.batch_sharding(mesh, plan),
+        )
+    }
+
+    step = make_train_step(cfg, plan, mesh, opt)
+    t0 = time.perf_counter()
+    lowered = step.lower(params_abs, opt_abs, batch_abs)
+    t_lower = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    t_compile = time.perf_counter() - t0
+    ma = compiled.memory_analysis()
+    gib = 1 << 30
+    out = {
+        "artifact": "compile_7b_fsdp8",
+        "model_params": n_params,
+        "config": {
+            "d_model": cfg.d_model, "n_layers": cfg.n_layers,
+            "n_heads": cfg.n_heads, "d_ff": cfg.d_ff,
+            "seq": seq, "batch": batch_size, "remat": True,
+        },
+        "plan": plan.sizes(),
+        "lower_s": round(t_lower, 1),
+        "compile_s": round(t_compile, 1),
+        # per-device bytes from XLA's own analysis of the sharded program
+        "per_device_argument_gib": round(ma.argument_size_in_bytes / gib, 2),
+        "per_device_temp_gib": round(ma.temp_size_in_bytes / gib, 2),
+        "per_device_output_gib": round(ma.output_size_in_bytes / gib, 2),
+        "per_device_aliased_gib": round(ma.alias_size_in_bytes / gib, 2),
+        "per_device_peak_gib": round(
+            (ma.argument_size_in_bytes + ma.temp_size_in_bytes
+             + ma.output_size_in_bytes - ma.alias_size_in_bytes) / gib, 2
+        ),
+        "note": (
+            "memory analysis is from the CPU backend, whose attention is "
+            "the O(S^2) reference path — the TPU build lowers the Pallas "
+            "flash kernel (O(S) activation memory), so temp_gib on real "
+            "chips is far lower; argument_gib (sharded fsdp=8 state) "
+            "transfers directly"
+        ),
+    }
+    line = json.dumps(out)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -327,10 +327,12 @@ class CoreWorker:
                     value = deserialize(data)
                 else:
                     _, size, node_hex, shm_dir, is_error = meta
-                    remain = (
-                        None if deadline is None
-                        else max(0.1, deadline - _time.monotonic())
-                    )
+                    if deadline is None:
+                        remain = None
+                    else:
+                        remain = deadline - _time.monotonic()
+                        if remain <= 0:
+                            raise GetTimeoutError(f"get() timed out after {timeout}s")
                     value = deserialize(
                         self._read_object(oid, size, node_hex, shm_dir, timeout=remain)
                     )

@@ -1187,6 +1187,7 @@ class Controller:
             if node is None:
                 continue
             if node.peer is None:
+                self._chunk_reader.invalidate(oid)
                 self.head_store.delete(oid)
             else:
                 await node.peer.notify("delete_object", oid)
@@ -1836,7 +1837,11 @@ class Controller:
         asyncio.run_coroutine_threadsafe(send(), self._loop)
 
     async def run(self, port: int = 0):
-        server, self.port = await rpc.serve(self, port=port)
+        from ray_tpu.utils.net import bind_host
+
+        # Loopback unless RAY_TPU_NODE_IP opts into multi-host (agents on
+        # other hosts must reach the control plane).
+        server, self.port = await rpc.serve(self, host=bind_host(), port=port)
         self._loop = asyncio.get_running_loop()
         self._log_tailer = None
         if self.config.log_to_driver:

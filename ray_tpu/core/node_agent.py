@@ -110,6 +110,7 @@ class NodeAgent:
             spawn_worker(self.session_dir, self.controller_addr, self.node_id, self.store.shm_dir)
 
     def rpc_delete_object(self, peer, oid: ObjectID):
+        self._chunk_reader.invalidate(oid)
         self.store.delete(oid)
 
     def rpc_adopt_object(self, peer, oid: ObjectID, size: int):
@@ -155,13 +156,13 @@ class NodeAgent:
             self._exit.set()
 
     async def run(self):
-        from ray_tpu.utils.net import host_ip
+        from ray_tpu.utils.net import bind_host, host_ip
 
         host, port = self.controller_addr.rsplit(":", 1)
         # Listener for sibling agents pulling object chunks (reference:
-        # the ObjectManagerService gRPC server every node runs). Binds
-        # all interfaces; advertises a cross-host-routable address.
-        _server, fetch_port = await rpc.serve(self, "0.0.0.0", 0)
+        # the ObjectManagerService gRPC server every node runs).
+        # Loopback unless RAY_TPU_NODE_IP opts this host into multi-host.
+        _server, fetch_port = await rpc.serve(self, bind_host(), 0)
         peer = await rpc.connect(host, int(port), self)
         self._controller_peer = peer
         config = self._chunk_bytes

@@ -94,26 +94,19 @@ class ChunkReader:
                 old.close()
         return data
 
+    def invalidate(self, oid: ObjectID):
+        """Drop a cached buffer when the store deletes the object — a
+        same-id recreation (lineage reconstruction) must never be served
+        stale bytes from the old mapping, and aborted transfers must not
+        pin unlinked tmpfs files."""
+        buf = self._bufs.pop(oid, None)
+        if buf is not None:
+            buf.close()
+
     def close(self):
         while self._bufs:
             _, buf = self._bufs.popitem()
             buf.close()
-
-
-def read_chunk(store, oid: ObjectID, offset: int, length: int) -> bytes:
-    """One-shot chunk read (no caching) — kept for small transfers."""
-    store.ensure_local(oid)
-    buf = store.get(oid)
-    if buf is None:
-        raise KeyError(f"object {oid.hex()} not in store")
-    try:
-        view = buf.view()
-        try:
-            return bytes(view[offset : offset + length])
-        finally:
-            del view
-    finally:
-        buf.close()
 
 
 class FetchPeerCache:
@@ -140,8 +133,6 @@ class FetchPeerCache:
             self._peers[addr] = p
         return p
 
-    def drop(self, addr: str):
-        self._peers.pop(addr, None)
 
 
 async def pull_into_store(store, oid: ObjectID, size: int, src_peer,

@@ -432,14 +432,14 @@ def main():
     worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
     shm_dir = os.environ["RAY_TPU_SHM_DIR"]
 
-    from ray_tpu.utils.net import host_ip
+    from ray_tpu.utils.net import bind_host, host_ip
 
     handler = WorkerHandler()
     loop_runner = rpc.EventLoopThread("worker-io")
     # Direct-transport listener: callers push actor tasks straight here
     # (reference: each worker hosts a CoreWorkerService gRPC server).
-    # Binds all interfaces; advertises a cross-host-routable address.
-    _server, listen_port = loop_runner.run(rpc.serve(handler, "0.0.0.0", 0))
+    # Loopback unless RAY_TPU_NODE_IP opts this host into multi-host.
+    _server, listen_port = loop_runner.run(rpc.serve(handler, bind_host(), 0))
     core = CoreWorker(
         addr,
         mode="worker",

@@ -1,21 +1,26 @@
 """Host address resolution for cross-host listeners.
 
-Listeners that other HOSTS must reach (worker direct-transport
-listeners, agent object-transfer listeners) bind all interfaces and
-advertise a routable address: RAY_TPU_NODE_IP when the operator set
-one, else the hostname's resolved address, else loopback (single-host
-simulations)."""
+Multi-host mode is an EXPLICIT opt-in via RAY_TPU_NODE_IP (set per host
+on real pods): listeners that other hosts must reach (worker
+direct-transport listeners, agent object-transfer listeners, the
+controller) then bind all interfaces and advertise that address.
+Without it, everything binds loopback — single-host runs never expose
+unauthenticated task-execution or object endpoints on the network, and
+no unroutable guessed address (the Debian 127.0.1.1 hostname wart) is
+ever advertised to a remote host.
+"""
 from __future__ import annotations
 
 import os
-import socket
+
+
+def multihost_enabled() -> bool:
+    return bool(os.environ.get("RAY_TPU_NODE_IP"))
+
+
+def bind_host() -> str:
+    return "0.0.0.0" if multihost_enabled() else "127.0.0.1"
 
 
 def host_ip() -> str:
-    ip = os.environ.get("RAY_TPU_NODE_IP")
-    if ip:
-        return ip
-    try:
-        return socket.gethostbyname(socket.gethostname())
-    except OSError:
-        return "127.0.0.1"
+    return os.environ.get("RAY_TPU_NODE_IP") or "127.0.0.1"
