@@ -55,6 +55,11 @@ class FakeMultiNodeProvider(NodeProvider):
         log_path = os.path.join(self._session_dir, "logs", f"autoscaled-{provider_id}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
         log = open(log_path, "ab")
+        env = child_env(needs_tpu=False)
+        # The agent reports this back at register_node, giving the
+        # autoscaler the provider↔node identity it needs for per-node
+        # idle scale-down (reference: v2 instance_manager cloud ids).
+        env["RAY_TPU_PROVIDER_INSTANCE_ID"] = provider_id
         proc = subprocess.Popen(
             [
                 sys.executable,
@@ -67,7 +72,7 @@ class FakeMultiNodeProvider(NodeProvider):
                 "--resources",
                 json.dumps(dict(resources)),
             ],
-            env=child_env(needs_tpu=False),
+            env=env,
             stdout=log,
             stderr=subprocess.STDOUT,
         )

@@ -236,26 +236,41 @@ class AutoscalerV2(StandardAutoscaler):
         im.reconcile(alive)
 
     def _terminate_idle_v2(self, counts: Dict[str, int]):
+        """Per-NODE idle scale-down: agents report their provider
+        instance id at registration, so each ledger instance maps to its
+        cluster node and reaps individually when that node has been idle
+        past the timeout (reference: v2 instance_manager's cloud-id ↔ ray
+        node mapping). Instances whose node lacks identity (external
+        agents) fall back to the conservative all-idle rule."""
+        if self._unmet_demand():
+            self._idle_since.clear()
+            return
         nodes = self._call("list_nodes")
         alive_workers = [
             n for n in nodes if n["state"] == "ALIVE" and not n["is_head"]
         ]
-        idle_cluster = [
-            n for n in alive_workers
-            if n["resources"].get("available") == n["resources"].get("total")
-        ]
-        # The ledger has no provider↔cluster node identity, so reaping is
-        # only safe when EVERY worker node is idle — otherwise the timer
-        # could pick an instance whose node is mid-task (same conservative
-        # rule v1 uses, routed through the ledger).
-        all_idle = bool(alive_workers) and len(idle_cluster) == len(alive_workers)
-        if not all_idle or self._unmet_demand():
-            self._idle_since.clear()
-            return
+
+        def _node_idle(n) -> bool:
+            return n["resources"].get("available") == n["resources"].get("total")
+
+        by_provider = {
+            n["provider_instance_id"]: n
+            for n in alive_workers
+            if n.get("provider_instance_id")
+        }
+        all_idle = bool(alive_workers) and all(_node_idle(n) for n in alive_workers)
         now = time.monotonic()
         im = self.instance_manager
         for inst in im.instances({InstanceStatus.RAY_RUNNING, InstanceStatus.ALLOCATED}):
             if counts.get(inst.node_type, 0) <= self.node_types[inst.node_type].get("min_workers", 0):
+                self._idle_since.pop(inst.instance_id, None)
+                continue
+            node = by_provider.get(inst.provider_id)
+            if node is not None:
+                idle = _node_idle(node)
+            else:
+                idle = all_idle  # no identity → conservative whole-cluster rule
+            if not idle:
                 self._idle_since.pop(inst.instance_id, None)
                 continue
             since = self._idle_since.setdefault(inst.instance_id, now)

@@ -117,6 +117,10 @@ class NodeRecord:
     # Agent's object-transfer listener ("host:port"; "" for the head —
     # head objects are fetched over the controller connection).
     fetch_addr: str = ""
+    # Provider instance identity (reference: autoscaler v2
+    # instance_manager's cloud_instance_id ↔ ray node mapping) — lets the
+    # autoscaler reap ONE idle node instead of waiting for full idleness.
+    provider_instance_id: str = ""
     workers: Set[WorkerID] = field(default_factory=set)
     num_starting: int = 0
     max_workers: int = 32
@@ -300,14 +304,14 @@ class Controller:
         self._schedule_pump()
         return {"session_dir": self.session_dir, "config": self.config.to_dict()}
 
-    async def rpc_register_node(self, peer: rpc.Peer, node_id: NodeID, resources: Dict[str, float], shm_dir: str, hostname: str = "localhost", pid: int = 0, fetch_addr: str = ""):
+    async def rpc_register_node(self, peer: rpc.Peer, node_id: NodeID, resources: Dict[str, float], shm_dir: str, hostname: str = "localhost", pid: int = 0, fetch_addr: str = "", provider_instance_id: str = ""):
         peer.meta.update(kind="agent", node_id=node_id)
         total = ResourceSet.from_dict(resources)
         self.cluster.add_node(node_id, NodeResources(total))
         ncpu = int(resources.get("CPU", 1))
         rec = NodeRecord(
             node_id=node_id, shm_dir=shm_dir, peer=peer, hostname=hostname,
-            fetch_addr=fetch_addr,
+            fetch_addr=fetch_addr, provider_instance_id=provider_instance_id,
         )
         rec.agent_pid = pid
         rec.max_workers = max(4 * max(ncpu, 1), 16)
@@ -1503,6 +1507,7 @@ class Controller:
                     "num_workers": len(node.workers),
                     "agent_pid": node.agent_pid,
                     "hostname": node.hostname,
+                    "provider_instance_id": node.provider_instance_id,
                     "resources": res.to_dict() if res else {},
                 }
             )

@@ -172,6 +172,7 @@ class NodeAgent:
             "register_node", self.node_id, self.resources, self.store.shm_dir,
             hostname=socket.gethostname(), pid=os.getpid(),
             fetch_addr=f"{host_ip()}:{fetch_port}",
+            provider_instance_id=os.environ.get("RAY_TPU_PROVIDER_INSTANCE_ID", ""),
         )
         cfg = (info or {}).get("config") or {}
         self._chunk_bytes = int(cfg.get("object_transfer_chunk_bytes", config))

@@ -1,0 +1,28 @@
+"""Execution context / tuning knobs for the data layer.
+
+Reference: python/ray/data/context.py (DataContext) — a process-wide
+singleton the executor consults, overridable per test/workload.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class DataContext:
+    # Back-pressure between operators is BYTE-budgeted (block sizes come
+    # from RefBundle metadata), with a block-count cap for tiny blocks
+    # (reference: backpressure_policy/ streaming output backpressure).
+    max_buffered_blocks: int = 16
+    max_buffered_bytes: int = 128 * 1024 * 1024
+    # Autoscaling actor pools: kill an idle actor above min_size after
+    # this long (reference: execution/autoscaler actor-pool scaling).
+    actor_idle_timeout_s: float = 2.0
+
+    _current = None
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        if cls._current is None:
+            cls._current = cls()
+        return cls._current

@@ -58,9 +58,20 @@ class Dataset:
         if isinstance(fn, type) and not concurrency:
             raise ValueError(
                 "class-based map_batches UDFs are stateful and run in an actor "
-                "pool; pass concurrency=N (reference: Dataset.map_batches "
-                "compute semantics)"
+                "pool; pass concurrency=N or concurrency=(min, max) for an "
+                "autoscaling pool (reference: Dataset.map_batches compute "
+                "semantics / ActorPoolStrategy)"
             )
+        if isinstance(concurrency, (tuple, list)):
+            if not isinstance(fn, type):
+                raise ValueError(
+                    "concurrency=(min, max) requires a class-based UDF "
+                    "(autoscaling actor pool)"
+                )
+            lo, hi = concurrency
+            if not (0 < lo <= hi):
+                raise ValueError(f"invalid concurrency range {concurrency}")
+            concurrency = (int(lo), int(hi))
         op = MapLike(
             name=f"MapBatches({getattr(fn, '__name__', type(fn).__name__)})",
             kind="map_batches",

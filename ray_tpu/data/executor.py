@@ -37,7 +37,28 @@ from ray_tpu.data.operators import (
 
 # Max bundles buffered between two operators before upstream is paused
 # (reference: backpressure_policy/streaming_output_backpressure_policy.py).
+# The byte budget (DataContext.max_buffered_bytes) is the primary limit —
+# block sizes come from bundle metadata — with this count cap for tiny
+# blocks. MAX_BUFFERED remains the count default.
 MAX_BUFFERED = 16
+
+
+def _input_saturated(op) -> bool:
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    q = op._in_queue
+    return len(q) >= ctx.max_buffered_blocks or op.input_bytes() >= ctx.max_buffered_bytes
+
+
+def _output_saturated(op) -> bool:
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    return (
+        op.outputs_buffered() >= ctx.max_buffered_blocks
+        or op.output_bytes() >= ctx.max_buffered_bytes
+    )
 
 
 def plan_to_operators(plan: LogicalPlan, concurrency: int = 8) -> List[PhysicalOperator]:
@@ -99,15 +120,23 @@ class StreamingExecutor:
         self._stopped = False
 
     def stats(self) -> List[dict]:
-        return [
-            dict(
+        out = []
+        for o in self._ops:
+            row = dict(
                 op=o.name,
                 rows_out=o.rows_out,
                 blocks_out=o.blocks_out,
                 tasks=o.tasks_submitted,
+                queued_blocks=len(o._in_queue),
+                queued_bytes=o.input_bytes(),
+                peak_in_bytes=o.peak_in_bytes,
+                active_tasks=o.num_active_tasks(),
             )
-            for o in self._ops
-        ]
+            if hasattr(o, "pool_size"):
+                row["actors"] = o.pool_size
+                row["actors_peak"] = o.actors_peak
+            out.append(row)
+        return out
 
     def _step(self) -> bool:
         """One scheduling tick; returns True if the pipeline is finished."""
@@ -133,15 +162,31 @@ class StreamingExecutor:
         if self._stopped:
             return
         self._stopped = True
+        record_last_stats(self.stats())
         for op in self._ops:
             op.shutdown()
+
+
+# Last execution's per-op stats, surfaced by the state API
+# (ray_tpu.util.state.summarize_data — reference: the dashboard's data
+# module exposing per-operator metrics from _internal/stats.py).
+_last_stats: List[dict] = []
+
+
+def record_last_stats(stats: List[dict]):
+    global _last_stats
+    _last_stats = stats
+
+
+def last_execution_stats() -> List[dict]:
+    return list(_last_stats)
 
 
 def _step_chain(ops: List[PhysicalOperator]) -> bool:
     # Move bundles downstream (last op's outputs are consumed by caller).
     for i, op in enumerate(ops[:-1]):
         nxt = ops[i + 1]
-        while op.has_next() and len(nxt._in_queue) < MAX_BUFFERED:
+        while op.has_next() and not _input_saturated(nxt):
             nxt.add_input(op.get_next())
         if op.completed() and not nxt._inputs_done:
             nxt.all_inputs_done()
@@ -157,13 +202,12 @@ def _step_chain(ops: List[PhysicalOperator]) -> bool:
                     up._pending = []
             if not op._inputs_done:
                 op.all_inputs_done()
-    # Poll operators unless their downstream buffer is saturated.
+    # Poll operators unless their downstream buffer is saturated (by
+    # block count OR byte budget — a fat producer stalls instead of
+    # OOMing the store; reference: resource-aware backpressure).
     for i, op in enumerate(ops):
-        downstream_full = (
-            i + 1 < len(ops) and len(ops[i + 1]._in_queue) >= MAX_BUFFERED
-        )
-        out_full = op.outputs_buffered() >= MAX_BUFFERED
-        if not (downstream_full or out_full):
+        downstream_full = i + 1 < len(ops) and _input_saturated(ops[i + 1])
+        if not (downstream_full or _output_saturated(op)):
             op.poll()
     return all(o.completed() for o in ops)
 

@@ -176,10 +176,20 @@ class PhysicalOperator:
         self.rows_out = 0
         self.blocks_out = 0
         self.tasks_submitted = 0
+        self.peak_in_bytes = 0
 
     # -- executor-facing ---------------------------------------------------
     def add_input(self, bundle: RefBundle):
         self._in_queue.append(bundle)
+        self.peak_in_bytes = max(self.peak_in_bytes, self.input_bytes())
+
+    def input_bytes(self) -> int:
+        """Bytes buffered at this operator's input (block sizes from the
+        bundles' metadata) — drives byte-budgeted backpressure."""
+        return sum(b.meta.size_bytes for b in self._in_queue)
+
+    def output_bytes(self) -> int:
+        return sum(b.meta.size_bytes for b in self._out_queue)
 
     def all_inputs_done(self):
         self._inputs_done = True
@@ -311,33 +321,86 @@ class _UDFActor:
 
 
 class ActorPoolMapOperator(PhysicalOperator):
-    def __init__(self, op: MapLike, tasks_per_actor: int = 2):
-        super().__init__(f"{op.name}(actors={op.compute_actors})")
-        self._op = op
-        self._pool_size = op.compute_actors
-        self._tasks_per_actor = tasks_per_actor
-        self._actors: List[Any] = []
-        self._load: Dict[int, int] = {}
-        self._live: List[Tuple[int, Any, Any]] = []
+    """Stateful-UDF map over an actor pool that AUTOSCALES between a min
+    and max size on queue depth (reference:
+    execution/autoscaler/default_autoscaler.py + actor_pool_map_operator's
+    scale_up/scale_down): ``concurrency=N`` pins the pool at N;
+    ``concurrency=(lo, hi)`` starts at ``lo``, grows while queued input
+    exceeds in-flight capacity, and reaps actors idle past the context's
+    idle timeout back down to ``lo``."""
 
-    def _ensure_pool(self):
-        if self._actors:
-            return
+    def __init__(self, op: MapLike, tasks_per_actor: int = 2):
+        ca = op.compute_actors
+        self._min, self._max = (ca, ca) if isinstance(ca, int) else (ca[0], ca[1])
+        super().__init__(f"{op.name}(actors={self._min}..{self._max})")
+        self._op = op
+        self._tasks_per_actor = tasks_per_actor
+        self._actors: Dict[int, Any] = {}
+        self._load: Dict[int, int] = {}
+        self._idle_since: Dict[int, float] = {}
+        self._next_idx = 0
+        self._live: List[Tuple[int, Any, Any]] = []
+        self.actors_peak = 0
+
+    @property
+    def pool_size(self) -> int:
+        return len(self._actors)
+
+    def _add_actor(self):
         cls = ray_tpu.remote(num_cpus=self._op.num_cpus, num_tpus=self._op.num_tpus)(
             _UDFActor
         )
-        for i in range(self._pool_size):
-            self._actors.append(
-                cls.remote(self._op.fn, self._op.fn_constructor_args, [self._op])
-            )
-            self._load[i] = 0
+        i = self._next_idx
+        self._next_idx += 1
+        self._actors[i] = cls.remote(
+            self._op.fn, self._op.fn_constructor_args, [self._op]
+        )
+        self._load[i] = 0
+        self.actors_peak = max(self.actors_peak, len(self._actors))
+
+    def _scale(self):
+        import time as _time
+
+        from ray_tpu.data.context import DataContext
+
+        while len(self._actors) < self._min:
+            self._add_actor()
+        free_slots = sum(
+            max(0, self._tasks_per_actor - n) for n in self._load.values()
+        )
+        # scale UP: queued work beyond what the pool can take in flight
+        while (
+            len(self._actors) < self._max
+            and len(self._in_queue) > free_slots
+        ):
+            self._add_actor()
+            free_slots += self._tasks_per_actor
+        # scale DOWN: reap actors idle past the timeout, min floor holds
+        if len(self._actors) > self._min:
+            now = _time.monotonic()
+            timeout = DataContext.get_current().actor_idle_timeout_s
+            for i in list(self._actors):
+                if len(self._actors) <= self._min:
+                    break
+                if self._load[i] > 0:
+                    self._idle_since.pop(i, None)
+                    continue
+                since = self._idle_since.setdefault(i, now)
+                if now - since >= timeout:
+                    try:
+                        ray_tpu.kill(self._actors[i])
+                    except Exception:  # noqa: BLE001
+                        pass
+                    del self._actors[i]
+                    del self._load[i]
+                    self._idle_since.pop(i, None)
 
     def num_active_tasks(self) -> int:
         return len(self._live)
 
     def poll(self):
-        self._ensure_pool()
-        cap = self._pool_size * self._tasks_per_actor
+        self._scale()
+        cap = len(self._actors) * self._tasks_per_actor
         while self._in_queue and len(self._live) < cap:
             bundle = self._in_queue.popleft()
             i = min(self._load, key=self._load.get)
@@ -353,19 +416,21 @@ class ActorPoolMapOperator(PhysicalOperator):
             if not ready:
                 break
             self._live.pop(0)
-            self._load[i] -= 1
+            if i in self._load:
+                self._load[i] -= 1
             self._out_queue.append(RefBundle(block_ref, ray_tpu.get(meta_ref)))
 
     def _finished_extra(self) -> bool:
         return not self._live
 
     def shutdown(self):
-        for a in self._actors:
+        for a in self._actors.values():
             try:
                 ray_tpu.kill(a)
             except Exception:
                 pass
-        self._actors = []
+        self._actors = {}
+        self._load = {}
 
 
 class AllToAllOperator(PhysicalOperator):

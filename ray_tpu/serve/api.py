@@ -18,6 +18,7 @@ from ray_tpu.utils.serialization import serialize_function
 _lock = threading.Lock()
 _controller = None
 _proxy = None
+_node_proxies: dict = {}
 
 _DEPLOYMENT_DEFAULTS = dict(
     num_replicas=None,  # None + min/max set → autoscaling
@@ -82,13 +83,20 @@ def _get_controller():
         return _controller
 
 
-def start(http_port: Optional[int] = None):
+def start(http_port: Optional[int] = None, proxy_location: str = "HeadOnly"):
     """Start serve system actors (controller + optional HTTP proxy).
 
-    Reference: serve.start (api.py). Called implicitly by serve.run.
+    Reference: serve.start (api.py) + proxy_location (HeadOnly |
+    EveryNode — the reference runs a ProxyActor per node; replicas are
+    reached local-first through the handle's locality-aware router).
     """
-    global _proxy
+    global _proxy, _node_proxies
     ctrl = _get_controller()
+    if proxy_location == "EveryNode" and http_port is None:
+        raise ValueError(
+            "proxy_location='EveryNode' requires http_port (proxies are "
+            "HTTP ingress actors)"
+        )
     if http_port is not None:
         with _lock:
             if _proxy is None:
@@ -96,12 +104,40 @@ def start(http_port: Optional[int] = None):
 
                 _proxy = ProxyActor.options(name="__serve_proxy__").remote(http_port)
                 ray_tpu.wait_actor_ready(_proxy)
+            if proxy_location == "EveryNode":
+                # Re-scanned on every start()/run() call: nodes that
+                # joined since the last call get their proxy then.
+                from ray_tpu.serve.proxy import ProxyActor
+                from ray_tpu.util.scheduling_strategies import (
+                    NodeAffinitySchedulingStrategy,
+                )
+
+                for n in ray_tpu.nodes():
+                    if (
+                        n["state"] != "ALIVE"
+                        or n["is_head"]  # the head proxy above covers it
+                        or n["node_id"] in _node_proxies
+                    ):
+                        continue
+                    p = ProxyActor.options(
+                        name=f"__serve_proxy_{n['node_id'][:8]}__",
+                        scheduling_strategy=NodeAffinitySchedulingStrategy(
+                            node_id=n["node_id"], soft=False
+                        ),
+                    ).remote(0)
+                    ray_tpu.wait_actor_ready(p)
+                    _node_proxies[n["node_id"]] = p
     return ctrl
 
 
-def run(app: Application, name: Optional[str] = None, http_port: Optional[int] = None) -> DeploymentHandle:
+def run(
+    app: Application,
+    name: Optional[str] = None,
+    http_port: Optional[int] = None,
+    proxy_location: str = "HeadOnly",
+) -> DeploymentHandle:
     """Deploy an application graph; returns the ingress handle."""
-    ctrl = start(http_port)
+    ctrl = start(http_port, proxy_location=proxy_location)
     ingress = _deploy_app(ctrl, app)
     return get_deployment_handle(ingress)
 
@@ -145,11 +181,31 @@ def get_proxy_port() -> Optional[int]:
     return ray_tpu.get(proxy.port.remote())
 
 
+def get_proxy_ports() -> dict:
+    """node_id → HTTP port for every running proxy (head + per-node)."""
+    with _lock:
+        proxy = _proxy
+        node_proxies = dict(_node_proxies)
+    out = {}
+    if proxy is not None:
+        out["head"] = ray_tpu.get(proxy.port.remote())
+    for node_id, p in node_proxies.items():
+        out[node_id] = ray_tpu.get(p.port.remote())
+    return out
+
+
 def shutdown():
     global _controller, _proxy
     with _lock:
         ctrl, _controller = _controller, None
         proxy, _proxy = _proxy, None
+        node_proxies = dict(_node_proxies)
+        _node_proxies.clear()
+    for p in node_proxies.values():
+        try:
+            ray_tpu.kill(p)
+        except Exception:  # noqa: BLE001
+            pass
     if proxy is not None:
         try:
             ray_tpu.kill(proxy)

@@ -117,6 +117,7 @@ class _Router:
         self._controller = controller
         self._lock = threading.Lock()
         self._replicas: list = []
+        self._local: list = []
         self._version = -1
         self._inflight: Dict[Any, int] = {}
         self._last_report = 0.0
@@ -134,10 +135,33 @@ class _Router:
             v, replicas = ray_tpu.get(self._controller.get_replicas.remote(self._name))
             if replicas is None:
                 raise RuntimeError(f"deployment {self._name} does not exist")
+            local = self._local_subset(replicas)
             with self._lock:
                 self._version = v
                 self._replicas = replicas
+                self._local = local
                 self._inflight = {r: self._inflight.get(r, 0) for r in replicas}
+
+    @staticmethod
+    def _local_subset(replicas) -> list:
+        """Replicas co-located on this node — routed to preferentially
+        (reference: pow_2_scheduler's prefer_local_node routing; the
+        basis of the per-node proxy pattern)."""
+        try:
+            from ray_tpu.runtime_context import get_runtime_context
+            from ray_tpu.util.state import list_actors
+
+            my_node = get_runtime_context().get_node_id()
+            if my_node is None:
+                return []  # driver process — no node identity, no locality
+            nodes = {a["actor_id"]: a["node_id"] for a in list_actors()}
+            return [
+                r for r in replicas
+                if nodes.get(r._actor_id.hex()) is not None
+                and nodes[r._actor_id.hex()] == my_node
+            ]
+        except Exception:  # noqa: BLE001 — locality is best-effort
+            return []
 
     def pick(self):
         """p2c: sample two, take the one with fewer in-flight requests."""
@@ -147,11 +171,25 @@ class _Router:
             self._refresh(force)
             force = True  # empty replica list → poll the controller directly
             with self._lock:
-                if self._replicas:
-                    if len(self._replicas) == 1:
-                        chosen = self._replicas[0]
+                # Local-PREFERRED: co-located replicas win while they have
+                # headroom comparable to the global pool; a saturated
+                # local replica falls back to remote ones (reference:
+                # prefer-local routing only when the local replica has
+                # capacity).
+                pool = self._replicas
+                if self._local:
+                    local_min = min(self._inflight.get(r, 0) for r in self._local)
+                    global_min = min(
+                        (self._inflight.get(r, 0) for r in self._replicas),
+                        default=0,
+                    )
+                    if local_min <= global_min + 2:
+                        pool = self._local
+                if pool:
+                    if len(pool) == 1:
+                        chosen = pool[0]
                     else:
-                        a, b = random.sample(self._replicas, 2)
+                        a, b = random.sample(pool, 2)
                         chosen = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
                     self._inflight[chosen] = self._inflight.get(chosen, 0) + 1
                     return chosen

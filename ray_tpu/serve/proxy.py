@@ -56,21 +56,33 @@ class ProxyActor:
                 n = int(self.headers.get("Content-Length", 0))
                 self._handle(self.rfile.read(n))
 
-            def _wants_stream(self) -> bool:
-                # NDJSON only — no text/event-stream trigger: SSE clients
-                # expect "data:" framing, which this proxy does not emit.
+            def _stream_mode(self):
+                """"sse" | "ndjson" | None (reference: proxy.py streaming —
+                SSE for EventSource/LLM clients, NDJSON otherwise)."""
                 accept = self.headers.get("Accept", "")
-                return (
+                if "text/event-stream" in accept:
+                    return "sse"
+                if (
                     "application/x-ndjson" in accept
                     or self.headers.get("X-Stream") == "1"
-                )
+                ):
+                    return "ndjson"
+                return None
 
-            def _send_stream(self, items):
-                """Chunked NDJSON: one JSON line per yielded item, flushed
-                as produced (reference: proxy streaming responses — the
-                LLM token-streaming path)."""
+            def _send_stream(self, items, mode: str):
+                """Chunked streaming: one frame per yielded item, flushed
+                as produced (the LLM token-streaming path). NDJSON frames
+                are JSON lines; SSE frames are ``data: <json>\\n\\n`` with
+                errors as ``event: error`` (reference: serve's SSE
+                responses consumed by EventSource clients)."""
+                sse = mode == "sse"
                 self.send_response(200)
-                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header(
+                    "Content-Type",
+                    "text/event-stream" if sse else "application/x-ndjson",
+                )
+                if sse:
+                    self.send_header("Cache-Control", "no-cache")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
 
@@ -82,16 +94,27 @@ class ProxyActor:
                     except OSError:
                         return False  # client went away — just stop
 
+                def frame(item=None, error=None) -> bytes:
+                    if sse:
+                        if error is not None:
+                            return (
+                                b"event: error\ndata: "
+                                + json.dumps({"error": error}).encode()
+                                + b"\n\n"
+                            )
+                        return b"data: " + json.dumps(item, default=str).encode() + b"\n\n"
+                    if error is not None:
+                        return json.dumps({"error": error}).encode() + b"\n"
+                    return json.dumps(item, default=str).encode() + b"\n"
+
                 alive = True
                 try:
                     for item in items:
-                        alive = chunk(json.dumps(item, default=str).encode() + b"\n")
+                        alive = chunk(frame(item=item))
                         if not alive:
                             break
-                except Exception as e:  # noqa: BLE001 — replica error → error line
-                    alive = alive and chunk(
-                        json.dumps({"error": str(e)}).encode() + b"\n"
-                    )
+                except Exception as e:  # noqa: BLE001 — replica error → error frame
+                    alive = alive and chunk(frame(error=str(e)))
                 finally:
                     close = getattr(items, "close", None)
                     if close:
@@ -106,8 +129,9 @@ class ProxyActor:
 
             def _handle(self, body: bytes):
                 try:
-                    if self._wants_stream():
-                        self._send_stream(proxy._dispatch_stream(self.path, body))
+                    mode = self._stream_mode()
+                    if mode:
+                        self._send_stream(proxy._dispatch_stream(self.path, body), mode)
                         return
                     result = proxy._dispatch(self.path, body)
                     self._send(200, json.dumps(result, default=str).encode())

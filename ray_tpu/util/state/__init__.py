@@ -111,6 +111,14 @@ def summarize_objects() -> dict:
     }
 
 
+def summarize_data() -> list:
+    """Per-operator stats of this process's most recent Dataset execution
+    (reference: the dashboard data module's per-op metrics)."""
+    from ray_tpu.data.executor import last_execution_stats
+
+    return last_execution_stats()
+
+
 # ---------------------------------------------------------------------------
 # Logs (reference: api.py get_log :1262 / list_logs)
 # ---------------------------------------------------------------------------

@@ -163,3 +163,54 @@ def test_autoscaler_v2_scales_up_and_down():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_autoscaler_v2_partial_idle_scale_down():
+    """Per-node identity: ONE idle node is reaped while another node of
+    the same type stays busy (pre-identity, scale-down required FULL
+    cluster idleness)."""
+    import ray_tpu
+    from ray_tpu.autoscaler.v2 import AutoscalerV2
+
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1},
+        worker_node_types={
+            "cpu2": {"resources": {"CPU": 2, "slot": 1}, "min_workers": 0, "max_workers": 2},
+        },
+        autoscaler_cls=AutoscalerV2,
+        interval_s=0.5,
+        idle_timeout_s=3.0,
+    )
+    try:
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(num_cpus=2, resources={"slot": 1})
+        def burst(x):
+            time.sleep(1.0)
+            return x
+
+        # force two nodes up (each fits one 'burst' at a time)
+        assert sorted(ray_tpu.get([burst.remote(i) for i in range(2)], timeout=90)) == [0, 1]
+        assert len(cluster.provider.non_terminated_nodes()) == 2
+
+        @ray_tpu.remote(num_cpus=2, resources={"slot": 1})
+        class Holder:
+            def ping(self):
+                return "pong"
+
+        # pin ONE node busy; the other goes idle
+        h = Holder.remote()
+        assert ray_tpu.get(h.ping.remote(), timeout=60) == "pong"
+        deadline = time.monotonic() + 40
+        while time.monotonic() < deadline:
+            if len(cluster.provider.non_terminated_nodes()) == 1:
+                break
+            time.sleep(0.5)
+        assert len(cluster.provider.non_terminated_nodes()) == 1, (
+            "idle node not individually reaped while sibling busy"
+        )
+        # the busy node survives the whole window
+        assert ray_tpu.get(h.ping.remote(), timeout=60) == "pong"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()

@@ -1,0 +1,113 @@
+"""Resource-aware backpressure + autoscaling actor pools (reference:
+data/_internal/execution/backpressure_policy/ + execution/autoscaler/).
+"""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.data.context import DataContext
+
+
+@pytest.fixture
+def small_byte_budget():
+    ctx = DataContext.get_current()
+    old_bytes, old_blocks = ctx.max_buffered_bytes, ctx.max_buffered_blocks
+    ctx.max_buffered_bytes = 2 * 1024 * 1024
+    ctx.max_buffered_blocks = 1000  # byte budget is the binding limit
+    yield ctx
+    ctx.max_buffered_bytes, ctx.max_buffered_blocks = old_bytes, old_blocks
+
+
+def _slow_consumer_cls():
+    # defined via closure-factory: pytest test modules are not importable
+    # from workers, so classes must pickle by value
+    class SlowConsumer:
+        def __call__(self, batch):
+            time.sleep(0.05)
+            return {"s": np.asarray([float(sum(v.sum() for v in batch.values()))])}
+
+    return SlowConsumer
+
+
+def test_fat_producer_byte_budget(ray_start_regular, small_byte_budget):
+    """A producer emitting ~1MB blocks through a slow consumer never
+    buffers more than the byte budget (plus one in-flight block) at the
+    consumer's input — previously the only bound was 16 BLOCKS of any
+    size."""
+    from ray_tpu.data.executor import StreamingExecutor, plan_to_operators
+
+    ds = (
+        ray_tpu.data.range(16, parallelism=16)
+        .map_batches(lambda b: {"x": np.zeros((1024, 128), dtype=np.float64)})  # ~1MB
+        .map_batches(_slow_consumer_cls(), concurrency=1)
+    )
+    ops = plan_to_operators(ds._plan())
+    ex = StreamingExecutor(ops)
+    n = sum(1 for _ in ex.iter_bundles())
+    assert n == 16
+    consumer = next(o for o in ops if "SlowConsumer" in o.name)
+    budget = small_byte_budget.max_buffered_bytes
+    one_block = 1024 * 128 * 8
+    assert 0 < consumer.peak_in_bytes <= budget + one_block, consumer.peak_in_bytes
+
+
+def test_actor_pool_autoscales_up(ray_start_regular):
+    """concurrency=(1, 4): the pool grows under queue pressure."""
+    from ray_tpu.data.executor import StreamingExecutor, plan_to_operators
+
+    class Slow:
+        def __call__(self, batch):
+            time.sleep(0.3)
+            return batch
+
+    ds = ray_tpu.data.range(12, parallelism=12).map_batches(Slow, concurrency=(1, 4))
+    ops = plan_to_operators(ds._plan())
+    ex = StreamingExecutor(ops)
+    n = sum(1 for _ in ex.iter_bundles())
+    assert n == 12
+    pool = next(o for o in ops if "actors=1..4" in o.name)
+    assert 2 <= pool.actors_peak <= 4, pool.actors_peak
+
+
+def test_actor_pool_scales_down_to_min(ray_start_regular):
+    """Idle actors above min are reaped after the idle timeout."""
+    from ray_tpu.data.logical import MapLike
+    from ray_tpu.data.operators import ActorPoolMapOperator
+
+    ctx = DataContext.get_current()
+    old = ctx.actor_idle_timeout_s
+    ctx.actor_idle_timeout_s = 0.0
+    try:
+        op = ActorPoolMapOperator(
+            MapLike(
+                name="noop", kind="map_batches", fn=_slow_consumer_cls(),
+                compute_actors=(1, 3),
+            )
+        )
+        for _ in range(3):
+            op._add_actor()
+        assert op.pool_size == 3
+        op._scale()  # queue empty, all idle, timeout 0 → reap to min
+        assert op.pool_size == 1
+        op.shutdown()
+    finally:
+        ctx.actor_idle_timeout_s = old
+
+
+def test_summarize_data_surfaces_per_op_stats(ray_start_regular):
+    from ray_tpu.util.state import summarize_data
+
+    ds = ray_tpu.data.range(8, parallelism=4).map_batches(lambda b: b)
+    assert ds.count() == 8
+    rows = summarize_data()
+    assert rows, "no per-op stats recorded"
+    assert any(r["rows_out"] == 8 for r in rows)
+    assert all("queued_bytes" in r and "active_tasks" in r for r in rows)
+
+
+def test_fixed_pool_unchanged(ray_start_regular):
+    """concurrency=N keeps the fixed-size pool semantics."""
+    ds = ray_tpu.data.range(8, parallelism=8).map_batches(_slow_consumer_cls(), concurrency=2)
+    assert ds.count() == 8

@@ -299,3 +299,83 @@ def test_streaming_http_ndjson(serve_cluster):
             urllib.request.urlopen(req2, timeout=30)
     finally:
         serve.delete("gen")
+
+
+def test_streaming_http_sse(serve_cluster):
+    """Accept: text/event-stream gets SSE framing (data: <json>\\n\\n) —
+    the EventSource/LLM-client contract (reference: serve SSE responses)."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu import serve
+
+    @serve.deployment(name="ssegen")
+    class Gen:
+        def __call__(self, prompt):
+            for tok in ("a", "b"):
+                yield {"tok": tok}
+
+    serve.run(Gen.bind(), http_port=0)
+    try:
+        port = serve.api.get_proxy_port()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/ssegen",
+            data=_json.dumps("p").encode(),
+            headers={"Accept": "text/event-stream", "Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert "text/event-stream" in resp.headers.get("Content-Type", "")
+            body = resp.read().decode()
+        events = [e for e in body.split("\n\n") if e.strip()]
+        toks = []
+        for e in events:
+            for line in e.splitlines():
+                if line.startswith("data: "):
+                    toks.append(_json.loads(line[len("data: "):])["tok"])
+        assert toks == ["a", "b"], body
+    finally:
+        serve.delete("ssegen")
+
+
+def test_per_node_proxies_and_local_routing():
+    """proxy_location=EveryNode: a proxy runs on each node; the handle
+    router prefers co-located replicas (reference: per-node ProxyActor +
+    prefer-local replica scheduling)."""
+    import json as _json
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.core.cluster_utils import Cluster
+
+    cluster = Cluster({"CPU": 2})
+    cluster.add_node(num_cpus=2, resources={"n2": 10})
+    cluster.connect()
+    try:
+
+        @serve.deployment(name="where", num_replicas=2)
+        class Where:
+            def __call__(self, _=None):
+                from ray_tpu.runtime_context import get_runtime_context
+
+                return get_runtime_context().get_node_id()
+
+        serve.run(Where.bind(), http_port=0, proxy_location="EveryNode")
+        ports = serve.api.get_proxy_ports()
+        assert "head" in ports and len(ports) == 2, ports
+        # every proxy serves the route
+        for port in ports.values():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/where",
+                data=_json.dumps(None).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                node = _json.loads(r.read())
+            assert isinstance(node, str) and len(node) == 32
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
