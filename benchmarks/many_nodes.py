@@ -1,0 +1,118 @@
+"""Control-plane scale: many nodes, deep task queue, many actors.
+
+Reference: release/benchmarks/ many_nodes / many_tasks / many_actors
+(README.md:1-16; 250-node task rate 351.4/s in release_logs). Here: N
+real node-agent PROCESSES register with one controller; a deep queue of
+tiny tasks and a burst of actors measure scheduler throughput, while a
+side channel samples controller-loop latency (KV round-trips) under
+load — the single-asyncio-loop design's health metric.
+
+Usage: python benchmarks/many_nodes.py [--nodes 100] [--tasks 10000] [--actors 1000]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import threading
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=100)
+    p.add_argument("--tasks", type=int, default=10000)
+    p.add_argument("--actors", type=int, default=1000)
+    args = p.parse_args()
+
+    import ray_tpu
+    from ray_tpu.core.cluster_utils import Cluster
+
+    cluster = Cluster({"CPU": 2})
+    t0 = time.perf_counter()
+    for i in range(args.nodes):
+        cluster.add_node(num_cpus=1, resources={"filler": 4}, wait=False)
+    # wait for all registrations
+    deadline = time.monotonic() + 300
+    cluster.connect()
+    while time.monotonic() < deadline:
+        alive = sum(1 for n in ray_tpu.nodes() if n["state"] == "ALIVE")
+        if alive >= args.nodes + 1:
+            break
+        time.sleep(0.5)
+    reg_time = time.perf_counter() - t0
+    alive = sum(1 for n in ray_tpu.nodes() if n["state"] == "ALIVE")
+    print(json.dumps({
+        "benchmark": "many_nodes_register",
+        "nodes": alive - 1,
+        "seconds": round(reg_time, 1),
+        "nodes_per_s": round((alive - 1) / reg_time, 1),
+    }), flush=True)
+
+    # controller-loop latency sampler (KV round-trips) during the storms
+    lat: list = []
+    stop = threading.Event()
+
+    def sampler():
+        core = ray_tpu.core.api._require_worker()
+        while not stop.is_set():
+            t = time.perf_counter()
+            core.kv_get("bench", b"probe")
+            lat.append(time.perf_counter() - t)
+            time.sleep(0.05)
+
+    sampler_thread = threading.Thread(target=sampler, daemon=True)
+    sampler_thread.start()
+
+    @ray_tpu.remote(num_cpus=1)
+    def noop():
+        return 0
+
+    # warm a few workers
+    ray_tpu.get([noop.remote() for _ in range(20)], timeout=300)
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(args.tasks)]
+    ray_tpu.get(refs, timeout=1800)
+    task_dt = time.perf_counter() - t0
+    print(json.dumps({
+        "benchmark": "many_nodes_tasks",
+        "nodes": alive - 1,
+        "tasks": args.tasks,
+        "tasks_per_s": round(args.tasks / task_dt, 1),
+    }), flush=True)
+
+    @ray_tpu.remote(num_cpus=0.01)
+    class A:
+        def ping(self):
+            return 0
+
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(args.actors)]
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=1800)
+    actor_dt = time.perf_counter() - t0
+    print(json.dumps({
+        "benchmark": "many_nodes_actors",
+        "actors": args.actors,
+        "actors_per_s": round(args.actors / actor_dt, 1),
+    }), flush=True)
+
+    stop.set()
+    sampler_thread.join(timeout=2)
+    if lat:
+        lat_ms = sorted(x * 1e3 for x in lat)
+        print(json.dumps({
+            "benchmark": "controller_loop_latency_under_load",
+            "samples": len(lat_ms),
+            "p50_ms": round(statistics.median(lat_ms), 1),
+            "p99_ms": round(lat_ms[int(0.99 * (len(lat_ms) - 1))], 1),
+            "max_ms": round(lat_ms[-1], 1),
+        }), flush=True)
+
+    for a in actors:
+        ray_tpu.kill(a)
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

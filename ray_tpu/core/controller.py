@@ -340,9 +340,10 @@ class Controller:
         else:
             await node.peer.notify("start_workers", n)
 
-    async def _recycle_idle_worker(self, node: NodeRecord, wanted_hash: str):
+    async def _recycle_idle_worker(self, node: NodeRecord, wanted_hash: str) -> bool:
         """Retire one idle worker whose env differs from ``wanted_hash`` so
-        a replacement (pristine) worker can be spawned."""
+        a replacement (pristine) worker can be spawned. True if a slot is
+        being freed."""
         for wid in list(node.workers):
             w = self.workers.get(wid)
             if w is not None and w.state == "IDLE" and w.env_hash != wanted_hash:
@@ -351,7 +352,8 @@ class Controller:
                     await w.peer.notify("exit")
                 except Exception:  # noqa: BLE001
                     pass
-                return
+                return True
+        return False
 
     def _idle_worker_on(self, node_id: NodeID, env_hash: str = "") -> Optional[WorkerRecord]:
         node = self.nodes.get(node_id)
@@ -501,6 +503,12 @@ class Controller:
         # tasks costs O(n) per pump, not O(n × schedule).
         blocked_classes: Set[Tuple] = set()
         class_spawn_node: Dict[Tuple, NodeID] = {}
+        # Worker ramp-up is capped by the node's SCHEDULABLE concurrency
+        # for the blocked class — a deep queue of 1-CPU tasks on a 1-CPU
+        # node must not spawn max_workers processes that can never run
+        # concurrently (reference: worker_pool soft limit ≈ CPU slots).
+        class_spawn_cap: Dict[Tuple, int] = {}
+        class_spawned: Dict[Tuple, int] = {}
         for tid in queue:
             rec = self.tasks.get(tid)
             if rec is None or rec.state != "PENDING":
@@ -514,10 +522,12 @@ class Controller:
             sclass = (spec.scheduling_class(), ehash)
             if sclass in blocked_classes:
                 still_pending.append(tid)
-                # queued depth still drives worker ramp-up for the class
+                # queued depth still drives worker ramp-up for the class,
+                # bounded by the node's concurrency for its demand
                 nid = class_spawn_node.get(sclass)
-                if nid is not None:
+                if nid is not None and class_spawned.get(sclass, 0) < class_spawn_cap.get(sclass, 1):
                     spawn_requests[nid] = spawn_requests.get(nid, 0) + 1
+                    class_spawned[sclass] = class_spawned.get(sclass, 0) + 1
                 continue
             # 1. dependencies local?
             deps_ready = True
@@ -552,17 +562,46 @@ class Controller:
             # 3. idle worker (env-affine)? (ehash computed at the top)
             worker = self._idle_worker_on(result.node_id, ehash)
             if worker is None:
-                node = self.nodes[result.node_id]
-                if len(node.workers) + node.num_starting >= node.max_workers:
-                    # Pool full of env-mismatched idle workers: recycle one
-                    # so this env can get a worker (reference: idle worker
-                    # killing frees pool slots for other runtime envs).
-                    await self._recycle_idle_worker(node, ehash)
-                spawn_requests[result.node_id] = spawn_requests.get(result.node_id, 0) + 1
-                still_pending.append(tid)
-                blocked_classes.add(sclass)
-                class_spawn_node[sclass] = result.node_id
-                continue
+                # A node whose worker pool is EXHAUSTED (full, nothing
+                # recyclable) cannot take the task even though resources
+                # are free — spill to other feasible nodes instead of
+                # wedging on it (reference: lease spillback re-requests
+                # with the rejecting raylet excluded).
+                excluded: Set[NodeID] = set()
+                while worker is None and result.node_id is not None:
+                    node = self.nodes[result.node_id]
+                    if len(node.workers) + node.num_starting < node.max_workers:
+                        break  # room to spawn here
+                    if await self._recycle_idle_worker(node, ehash):
+                        break  # a slot is freeing up here
+                    excluded.add(result.node_id)
+                    result = self.scheduler.schedule(
+                        spec.resources, spec.scheduling_strategy, exclude=excluded
+                    )
+                    if result.node_id is None:
+                        break
+                    demand = self.scheduler.translated_pg_demand(
+                        spec.resources, spec.scheduling_strategy
+                    )
+                    worker = self._idle_worker_on(result.node_id, ehash)
+                if worker is None:
+                    still_pending.append(tid)
+                    blocked_classes.add(sclass)
+                    if result.node_id is None:
+                        # every feasible node's pool is exhausted — wait
+                        # for a worker to free (completion re-pumps)
+                        class_spawn_cap[sclass] = 0
+                        class_spawned[sclass] = 0
+                        continue
+                    class_spawn_node[sclass] = result.node_id
+                    cap = self._class_slots(result.node_id, demand)
+                    class_spawn_cap[sclass] = cap
+                    if cap > 0:
+                        spawn_requests[result.node_id] = spawn_requests.get(result.node_id, 0) + 1
+                        class_spawned[sclass] = 1
+                    else:
+                        class_spawned[sclass] = 0
+                    continue
             # 4. acquire resources + dispatch
             node_res = self.cluster.nodes[result.node_id]
             if not node_res.acquire(demand):
@@ -595,6 +634,28 @@ class Controller:
             node = self.nodes.get(nid)
             if node is not None:
                 await self._request_workers(node, n)
+
+    def _class_slots(self, node_id: NodeID, demand) -> int:
+        """How many MORE tasks of ``demand`` the node could start right
+        now (available resources, minus workers already spawning) — the
+        worker ramp-up cap for one scheduling class. Prevents a deep
+        queue of 1-CPU tasks on a 1-CPU node from spawning max_workers
+        processes that can never run concurrently (reference:
+        worker_pool.h prestart/soft-limit semantics)."""
+        node = self.cluster.nodes.get(node_id)
+        if node is None:
+            return 1
+        starting = self.nodes[node_id].num_starting if node_id in self.nodes else 0
+        slots = None
+        for name, fp in demand.items_fp():
+            if fp <= 0:
+                continue
+            avail = node.available.get(name)
+            s = avail // fp
+            slots = s if slots is None else min(slots, s)
+        if slots is None:
+            slots = 4  # zero-resource tasks: modest default ramp
+        return max(0, int(slots) - starting)
 
     def _wait_dep(self, dep: ObjectID):
         orec = self._object(dep)

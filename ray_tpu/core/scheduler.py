@@ -102,35 +102,40 @@ class ClusterResourceScheduler:
         self._spread_idx = 0
 
     # ------------------------------------------------------------------
-    def schedule(self, demand: ResourceSet, strategy: SchedulingStrategy) -> ScheduleResult:
+    def schedule(self, demand: ResourceSet, strategy: SchedulingStrategy,
+                 exclude: "Optional[set]" = None) -> ScheduleResult:
+        """``exclude``: nodes the caller cannot use right now (worker pool
+        exhausted) — the spillback filter (reference: raylet lease
+        spillback re-requests with the rejecting node excluded)."""
         if strategy.kind == "NODE_AFFINITY":
-            return self._node_affinity(demand, strategy)
+            return self._node_affinity(demand, strategy, exclude)
         if strategy.kind == "SPREAD":
-            return self._spread(demand)
+            return self._spread(demand, exclude)
         if strategy.kind == "PLACEMENT_GROUP":
-            return self._placement_group(demand, strategy)
-        return self._hybrid(demand)
+            return self._placement_group(demand, strategy, exclude)
+        return self._hybrid(demand, exclude)
 
     # ------------------------------------------------------------------
-    def _feasible_nodes(self, demand: ResourceSet) -> List[NodeID]:
+    def _feasible_nodes(self, demand: ResourceSet, exclude=None) -> List[NodeID]:
         return [
             nid
             for nid in self.state.ordered_nodes()
             if self.state.nodes[nid].is_feasible(demand)
+            and not (exclude and nid in exclude)
         ]
 
-    def _hybrid(self, demand: ResourceSet) -> ScheduleResult:
+    def _hybrid(self, demand: ResourceSet, exclude=None) -> ScheduleResult:
         """Pack onto the first nodes (stable order) while their utilization is
         below ``scheduler_spread_threshold``; otherwise pick the
         least-utilized available node (reference:
         hybrid_scheduling_policy.cc HybridPolicyWithFilter)."""
         threshold = get_config().scheduler_spread_threshold
-        if self.state.native is not None:
+        if self.state.native is not None and not exclude:
             node_id, infeasible = self.state.native.schedule_hybrid(
                 demand.items_fp(), threshold
             )
             return ScheduleResult(node_id, infeasible=infeasible)
-        feasible = self._feasible_nodes(demand)
+        feasible = self._feasible_nodes(demand, exclude)
         if not feasible:
             return ScheduleResult(None, infeasible=True)
         available = [n for n in feasible if self.state.nodes[n].fits(demand)]
@@ -142,11 +147,11 @@ class ClusterResourceScheduler:
         best = min(available, key=lambda n: self.state.nodes[n].utilization())
         return ScheduleResult(best)
 
-    def _spread(self, demand: ResourceSet) -> ScheduleResult:
-        if self.state.native is not None:
+    def _spread(self, demand: ResourceSet, exclude=None) -> ScheduleResult:
+        if self.state.native is not None and not exclude:
             node_id, infeasible = self.state.native.schedule_spread(demand.items_fp())
             return ScheduleResult(node_id, infeasible=infeasible)
-        feasible = self._feasible_nodes(demand)
+        feasible = self._feasible_nodes(demand, exclude)
         if not feasible:
             return ScheduleResult(None, infeasible=True)
         available = [n for n in feasible if self.state.nodes[n].fits(demand)]
@@ -156,18 +161,24 @@ class ClusterResourceScheduler:
         self._spread_idx += 1
         return ScheduleResult(pick)
 
-    def _node_affinity(self, demand: ResourceSet, strategy: SchedulingStrategy) -> ScheduleResult:
+    def _node_affinity(self, demand: ResourceSet, strategy: SchedulingStrategy, exclude=None) -> ScheduleResult:
         nid = NodeID.from_hex(strategy.node_id) if isinstance(strategy.node_id, str) else strategy.node_id
+        if exclude and nid in exclude:
+            if strategy.soft:
+                # soft affinity is a preference — spill elsewhere
+                return self._hybrid(demand, exclude)
+            # hard pin: the node cannot take the task right now — wait
+            return ScheduleResult(None, infeasible=False)
         node = self.state.nodes.get(nid)
         if node is not None and not node.draining and node.fits(demand):
             return ScheduleResult(nid)
         if strategy.soft:
-            return self._hybrid(demand)
+            return self._hybrid(demand, exclude)
         if node is None:
             return ScheduleResult(None, infeasible=True)
         return ScheduleResult(None)
 
-    def _placement_group(self, demand: ResourceSet, strategy: SchedulingStrategy) -> ScheduleResult:
+    def _placement_group(self, demand: ResourceSet, strategy: SchedulingStrategy, exclude=None) -> ScheduleResult:
         """Translate demand into the PG's renamed group resources
         (reference: placement_group_resource_manager.h — ``CPU`` →
         ``CPU_group_<pgid>`` / ``CPU_group_<i>_<pgid>``)."""
@@ -184,6 +195,8 @@ class ClusterResourceScheduler:
             wildcard = ResourceSet({f"{k}_group_{pgid.hex()}": v for k, v in demand.items_fp()})
             translated = translated + wildcard
         for nid in self.state.ordered_nodes():
+            if exclude and nid in exclude:
+                continue
             if self.state.nodes[nid].fits(translated):
                 return ScheduleResult(nid)
         return ScheduleResult(None)

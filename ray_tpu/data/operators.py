@@ -375,8 +375,10 @@ class ActorPoolMapOperator(PhysicalOperator):
         ):
             self._add_actor()
             free_slots += self._tasks_per_actor
-        # scale DOWN: reap actors idle past the timeout, min floor holds
-        if len(self._actors) > self._min:
+        # scale DOWN: reap actors idle past the timeout, min floor holds.
+        # Never while input is queued — poll() is about to dispatch it and
+        # a kill-then-respawn would re-pay UDF constructor cost per burst.
+        if len(self._actors) > self._min and not self._in_queue:
             now = _time.monotonic()
             timeout = DataContext.get_current().actor_idle_timeout_s
             for i in list(self._actors):

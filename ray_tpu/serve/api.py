@@ -104,29 +104,51 @@ def start(http_port: Optional[int] = None, proxy_location: str = "HeadOnly"):
 
                 _proxy = ProxyActor.options(name="__serve_proxy__").remote(http_port)
                 ray_tpu.wait_actor_ready(_proxy)
-            if proxy_location == "EveryNode":
-                # Re-scanned on every start()/run() call: nodes that
-                # joined since the last call get their proxy then.
-                from ray_tpu.serve.proxy import ProxyActor
-                from ray_tpu.util.scheduling_strategies import (
-                    NodeAffinitySchedulingStrategy,
-                )
+    if http_port is not None and proxy_location == "EveryNode":
+        # Re-scanned on every start()/run() call: nodes that joined since
+        # the last call get their proxy then. Proxies request zero CPU (a
+        # fully occupied node must still get its ingress) and readiness
+        # is awaited OUTSIDE the module lock with a bound, so a slow node
+        # can neither hang serve.run forever nor deadlock other serve
+        # calls on _lock.
+        from ray_tpu.serve.proxy import ProxyActor
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
 
-                for n in ray_tpu.nodes():
-                    if (
-                        n["state"] != "ALIVE"
-                        or n["is_head"]  # the head proxy above covers it
-                        or n["node_id"] in _node_proxies
-                    ):
-                        continue
-                    p = ProxyActor.options(
-                        name=f"__serve_proxy_{n['node_id'][:8]}__",
-                        scheduling_strategy=NodeAffinitySchedulingStrategy(
-                            node_id=n["node_id"], soft=False
-                        ),
-                    ).remote(0)
-                    ray_tpu.wait_actor_ready(p)
-                    _node_proxies[n["node_id"]] = p
+        pending = []
+        with _lock:
+            for n in ray_tpu.nodes():
+                if (
+                    n["state"] != "ALIVE"
+                    or n["is_head"]  # the head proxy above covers it
+                    or n["node_id"] in _node_proxies
+                ):
+                    continue
+                p = ProxyActor.options(
+                    name=f"__serve_proxy_{n['node_id'][:8]}__",
+                    num_cpus=0,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=n["node_id"], soft=False
+                    ),
+                ).remote(0)
+                pending.append((n["node_id"], p))
+        for node_id, p in pending:
+            try:
+                ray_tpu.wait_actor_ready(p, timeout=30)
+            except Exception:  # noqa: BLE001 — node slow/unreachable
+                import logging
+
+                logging.getLogger("ray_tpu.serve").warning(
+                    "per-node proxy on %s not ready in 30s; skipping", node_id[:8]
+                )
+                try:
+                    ray_tpu.kill(p)
+                except Exception:  # noqa: BLE001
+                    pass
+                continue
+            with _lock:
+                _node_proxies[node_id] = p
     return ctrl
 
 

@@ -77,12 +77,37 @@ class ServeController:
         return True
 
     def get_replicas(self, name: str):
-        """(version, [ActorHandle]) — handles cache this by version."""
+        """(version, [(ActorHandle, node_id_hex|None)]) — handles cache
+        this by version; node ids feed locality-preferred routing without
+        every router scanning the cluster actor table."""
         with self._lock:
             d = self._deployments.get(name)
             if d is None:
                 return self._version, None
-            return self._version, list(d["replicas"])
+            replicas = list(d["replicas"])
+        nodes = self._replica_nodes(replicas)
+        return self._version, [(r, nodes.get(r._actor_id.hex())) for r in replicas]
+
+    def _replica_nodes(self, replicas) -> dict:
+        """actor_id hex → node hex for this controller's replicas, cached
+        once placement is known (one state query here instead of one per
+        router per refresh)."""
+        cache = getattr(self, "_node_cache", None)
+        if cache is None:
+            cache = self._node_cache = {}
+        missing = [r for r in replicas if r._actor_id.hex() not in cache]
+        if missing:
+            try:
+                from ray_tpu.util.state import list_actors
+
+                table = {a["actor_id"]: a["node_id"] for a in list_actors()}
+                for r in missing:
+                    node = table.get(r._actor_id.hex())
+                    if node:  # only cache once actually placed
+                        cache[r._actor_id.hex()] = node
+            except Exception:  # noqa: BLE001 — locality is best-effort
+                pass
+        return cache
 
     def get_version(self) -> int:
         return self._version

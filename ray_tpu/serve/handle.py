@@ -132,10 +132,11 @@ class _Router:
         self._last_refresh = now
         version = ray_tpu.get(self._controller.get_version.remote())
         if version != self._version:
-            v, replicas = ray_tpu.get(self._controller.get_replicas.remote(self._name))
-            if replicas is None:
+            v, pairs = ray_tpu.get(self._controller.get_replicas.remote(self._name))
+            if pairs is None:
                 raise RuntimeError(f"deployment {self._name} does not exist")
-            local = self._local_subset(replicas)
+            replicas = [r for r, _node in pairs]
+            local = self._local_subset(pairs)
             with self._lock:
                 self._version = v
                 self._replicas = replicas
@@ -143,23 +144,18 @@ class _Router:
                 self._inflight = {r: self._inflight.get(r, 0) for r in replicas}
 
     @staticmethod
-    def _local_subset(replicas) -> list:
+    def _local_subset(pairs) -> list:
         """Replicas co-located on this node — routed to preferentially
         (reference: pow_2_scheduler's prefer_local_node routing; the
-        basis of the per-node proxy pattern)."""
+        basis of the per-node proxy pattern). Node ids come from the
+        serve controller with the replica list."""
         try:
             from ray_tpu.runtime_context import get_runtime_context
-            from ray_tpu.util.state import list_actors
 
             my_node = get_runtime_context().get_node_id()
             if my_node is None:
                 return []  # driver process — no node identity, no locality
-            nodes = {a["actor_id"]: a["node_id"] for a in list_actors()}
-            return [
-                r for r in replicas
-                if nodes.get(r._actor_id.hex()) is not None
-                and nodes[r._actor_id.hex()] == my_node
-            ]
+            return [r for r, node in pairs if node is not None and node == my_node]
         except Exception:  # noqa: BLE001 — locality is best-effort
             return []
 
