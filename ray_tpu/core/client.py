@@ -694,3 +694,8 @@ class DriverHandler(_NullHandler):
         from ray_tpu.core.log_monitor import print_to_driver
 
         print_to_driver(batch)
+
+    def rpc_pubsub_msg(self, peer, channel: str, message):
+        from ray_tpu.experimental.pubsub import _deliver
+
+        _deliver(channel, message)

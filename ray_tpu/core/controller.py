@@ -224,6 +224,7 @@ class Controller:
 
         self._pulls: Dict[Tuple[ObjectID, NodeID], asyncio.Future] = {}
         self._fetch_peers = FetchPeerCache()
+        self._pubsub_subs: Dict[str, Set[rpc.Peer]] = {}
         self.events: List[dict] = []  # task event ring buffer
         self.finished_specs: Dict[TaskID, TaskSpec] = {}  # lineage for reconstruction
         self.metrics: Dict[str, dict] = {}  # aggregated app metrics
@@ -264,6 +265,7 @@ class Controller:
         holder = peer.meta.get("holder_id")
         if holder:
             self._drop_holder(holder)
+        self._drop_subscriber(peer)
         if kind == "worker":
             await self._on_worker_death(peer.meta["worker_id"], "connection lost")
         elif kind == "agent":
@@ -1435,6 +1437,77 @@ class Controller:
             "addr": worker.listen_addr,
             "instance": actor.num_restarts,
         }
+
+    # -- general pub/sub (reference: src/ray/pubsub/ — long-poll batched
+    # publisher/subscriber; here subscribers ride their existing control
+    # connection, so publish is a push notify per subscriber) -----------
+    async def rpc_subscribe(self, peer: rpc.Peer, channel: str):
+        self._pubsub_subs.setdefault(channel, set()).add(peer)
+        peer.meta.setdefault("subscriptions", set()).add(channel)
+        return True
+
+    async def rpc_unsubscribe(self, peer: rpc.Peer, channel: str):
+        subs = self._pubsub_subs.get(channel)
+        if subs is not None:
+            subs.discard(peer)
+            if not subs:
+                del self._pubsub_subs[channel]
+        peer.meta.get("subscriptions", set()).discard(channel)
+        return True
+
+    async def rpc_publish(self, peer: rpc.Peer, channel: str, msg) -> int:
+        """Fan a message out to the channel's subscribers CONCURRENTLY
+        (one wedged subscriber's backpressure must not stall the rest or
+        the publisher); returns the number of live subscribers."""
+        subs = self._pubsub_subs.get(channel)
+        if not subs:
+            return 0
+        live = []
+        for p in list(subs):
+            if p.closed:
+                subs.discard(p)
+            else:
+                live.append(p)
+        if not subs:
+            self._pubsub_subs.pop(channel, None)
+        if live:
+            await asyncio.gather(
+                *(p.notify("pubsub_msg", channel, msg) for p in live),
+                return_exceptions=True,
+            )
+        return len(live)
+
+    def _drop_subscriber(self, peer: rpc.Peer):
+        for channel in list(peer.meta.get("subscriptions", ())):
+            subs = self._pubsub_subs.get(channel)
+            if subs is not None:
+                subs.discard(peer)
+                if not subs:
+                    del self._pubsub_subs[channel]
+
+    async def rpc_stack_dump_all(self, peer: rpc.Peer, timeout_s: float = 10.0):
+        """Live stacks of every cluster process (reference: `ray stack` +
+        the dashboard reporter's py-spy dumps). Controller itself,
+        agents, and workers dump over their existing channels."""
+        from ray_tpu.utils.stack_dump import dump_all_threads
+
+        out: Dict[str, str] = {"controller": dump_all_threads()}
+
+        async def ask(name: str, p: rpc.Peer):
+            try:
+                out[name] = await asyncio.wait_for(p.call("stack_dump"), timeout_s)
+            except Exception as e:  # noqa: BLE001 — wedged/gone process
+                out[name] = f"<unavailable: {e}>"
+
+        calls = []
+        for w in self.workers.values():
+            if w.state != "DEAD" and not w.peer.closed:
+                calls.append(ask(f"worker:{w.worker_id.hex()[:8]}:pid{w.pid}", w.peer))
+        for n in self.nodes.values():
+            if n.peer is not None and not n.peer.closed:
+                calls.append(ask(f"agent:{n.node_id.hex()[:8]}", n.peer))
+        await asyncio.gather(*calls)
+        return out
 
     async def rpc_task_events(self, peer: rpc.Peer, batch: List[dict]):
         """Batched task events from workers executing direct-push tasks

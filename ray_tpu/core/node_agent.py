@@ -149,6 +149,11 @@ class NodeAgent:
     def rpc_ping(self, peer):
         return "pong"
 
+    def rpc_stack_dump(self, peer):
+        from ray_tpu.utils.stack_dump import dump_all_threads
+
+        return dump_all_threads()
+
     def on_disconnect(self, peer):
         # Only the controller connection is load-bearing; fetch peers
         # (other agents pulling from us) come and go.

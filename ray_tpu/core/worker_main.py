@@ -107,6 +107,18 @@ class WorkerHandler:
     def rpc_ping(self, peer):
         return "pong"
 
+    def rpc_stack_dump(self, peer):
+        """Live stacks of every thread (reference: py-spy dump via the
+        dashboard reporter / `ray stack`)."""
+        from ray_tpu.utils.stack_dump import dump_all_threads
+
+        return dump_all_threads()
+
+    def rpc_pubsub_msg(self, peer, channel: str, message):
+        from ray_tpu.experimental.pubsub import _deliver
+
+        _deliver(channel, message)
+
     def on_disconnect(self, peer):
         # Direct-caller connections come and go; only the controller
         # connection is load-bearing.

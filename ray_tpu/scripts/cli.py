@@ -174,6 +174,18 @@ def cmd_timeline(args):
     return 0
 
 
+def cmd_stack(args):
+    """Live stacks of every cluster process (reference: `ray stack`)."""
+    from ray_tpu.util import state
+
+    _connect()
+    dumps = state.get_stack_traces(timeout_s=args.timeout)
+    for name in sorted(dumps):
+        print(f"===== {name} =====")
+        print(dumps[name])
+    return 0
+
+
 def cmd_memory(args):
     from ray_tpu.util import state
 
@@ -290,6 +302,10 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_timeline)
 
     sub.add_parser("memory", help="object store summary").set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("stack", help="live thread stacks of all cluster processes")
+    sp.add_argument("--timeout", type=float, default=10.0)
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("drain-node", help="gracefully drain a node")
     sp.add_argument("node_id", help="node id (hex, from `ray-tpu status`)")

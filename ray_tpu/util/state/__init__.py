@@ -126,6 +126,14 @@ def _logs_dir() -> str:
     return os.path.join(_require_worker().session_dir, "logs")
 
 
+def get_stack_traces(timeout_s: float = 10.0) -> dict:
+    """Live thread stacks of every cluster process (reference: `ray
+    stack` / the dashboard reporter's py-spy dumps): {process: text}."""
+    from ray_tpu.core.api import _require_worker
+
+    return _require_worker()._call("stack_dump_all", timeout_s)
+
+
 def list_logs() -> List[str]:
     d = _logs_dir()
     return sorted(os.listdir(d)) if os.path.isdir(d) else []

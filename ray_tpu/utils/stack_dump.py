@@ -1,0 +1,24 @@
+"""Live thread stack dumps for cluster processes.
+
+Reference: the dashboard reporter's py-spy integration + the ``ray
+stack`` CLI (python/ray/dashboard/modules/reporter/) — on-demand stack
+traces of every worker for debugging hangs. py-spy attaches externally;
+here every process can dump itself over its existing RPC channel
+(sys._current_frames covers all threads, including executors stuck in
+user code).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+
+
+def dump_all_threads() -> str:
+    """Formatted stacks of every thread in THIS process."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sorted(sys._current_frames().items()):
+        out.append(f"--- Thread {names.get(ident, '?')} (id {ident}) ---")
+        out.append("".join(traceback.format_stack(frame)))
+    return "\n".join(out)

@@ -13,6 +13,8 @@ re-executes the frontier. Storage is a shared filesystem directory (on TPU
 pods: NFS/GCS-fuse), set via :func:`init` or ``RAY_TPU_WORKFLOW_STORAGE``.
 """
 from ray_tpu.workflow.execution import (
+    Continuation,
+    continuation,
     delete,
     get_output,
     get_status,
@@ -21,6 +23,8 @@ from ray_tpu.workflow.execution import (
     resume,
     run,
     run_async,
+    trigger_event,
+    wait_for_event,
 )
 
 __all__ = [
@@ -32,4 +36,8 @@ __all__ = [
     "get_output",
     "list_all",
     "delete",
+    "continuation",
+    "Continuation",
+    "wait_for_event",
+    "trigger_event",
 ]

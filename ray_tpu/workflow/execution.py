@@ -87,6 +87,13 @@ def _run_step_with_checkpoint(fn, wf_dir: str, key: str, *args, **kwargs):
     return result
 
 
+def _run_step_no_checkpoint(fn, wf_dir: str, key: str, *args, **kwargs):
+    """checkpoint=False steps: cheap/non-deterministic steps the user
+    prefers to re-run on resume (reference: workflow.options(checkpoint=
+    False))."""
+    return fn(*args, **kwargs)
+
+
 # ---------------------------------------------------------------------------
 # DAG walk
 # ---------------------------------------------------------------------------
@@ -122,17 +129,41 @@ def _execute_workflow(dag: DAGNode, workflow_id: str, args: tuple, kwargs: dict)
         elif isinstance(node, FunctionNode):
             key = _step_key(idx, node)
             ckpt = _ckpt_path(wf_dir, key)
+            rf = node._remote_fn
+            # Per-step workflow options (reference:
+            # python/ray/workflow/api.py ``workflow.options`` splatted
+            # into .options()): max_retries / retry_exceptions /
+            # checkpoint=False.
+            wopts = dict(rf._options.get("workflow_options") or {})
             if os.path.exists(ckpt):
                 with open(ckpt, "rb") as f:
                     results[id(node)] = deserialize(f.read())
                 continue
             rargs = tuple(resolve(a) for a in node._bound_args)
             rkwargs = {k: resolve(v) for k, v in node._bound_kwargs.items()}
-            rf = node._remote_fn
             rf._ensure_exported()
-            shim = ray_tpu.remote(_run_step_with_checkpoint).options(
+            if getattr(rf, "_fn", None) is _wait_for_event_step:
+                shim_fn = _run_event_step  # needs wf_dir for claiming
+            elif wopts.get("checkpoint") is False:
+                shim_fn = _run_step_no_checkpoint
+            else:
+                shim_fn = _run_step_with_checkpoint
+            # workflow max_retries covers APPLICATION failures (reference:
+            # workflow step max_retries retries user exceptions) — so an
+            # explicit workflow max_retries implies retry_exceptions
+            # unless the user said otherwise.
+            w_retries = wopts.get("max_retries")
+            retry_exc = wopts.get(
+                "retry_exceptions",
+                True if w_retries else rf._options.get("retry_exceptions", False),
+            )
+            shim = ray_tpu.remote(shim_fn).options(
                 num_cpus=rf._options.get("num_cpus", 1),
-                max_retries=rf._options.get("max_retries", 3),
+                max_retries=(
+                    w_retries if w_retries is not None
+                    else rf._options.get("max_retries", 3)
+                ),
+                retry_exceptions=retry_exc,
             )
             results[id(node)] = shim.remote(rf._fn, wf_dir, key, *rargs, **rkwargs)
         else:
@@ -170,8 +201,37 @@ def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs):
     return workflow_id, out
 
 
-def run(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs):
-    """Run to completion; returns the final value(s)."""
+class Continuation:
+    """A step's return value saying "the workflow continues with THIS
+    sub-DAG" (reference: workflow.continuation — dynamic workflows whose
+    shape depends on runtime values)."""
+
+    def __init__(self, dag: DAGNode, *args, **kwargs):
+        self.dag = dag
+        self.args = args
+        self.kwargs = kwargs
+
+
+def continuation(dag: DAGNode, *args, **kwargs) -> Continuation:
+    return Continuation(dag, *args, **kwargs)
+
+
+def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+        catch_exceptions: bool = False, **kwargs):
+    """Run to completion; returns the final value(s). With
+    ``catch_exceptions`` the result is ``(value, None)`` on success or
+    ``(None, exception)`` on failure (reference:
+    workflow.options(catch_exceptions=True) surfaced at run)."""
+    try:
+        value = _run_inner(dag, *args, workflow_id=workflow_id, **kwargs)
+    except Exception as e:  # noqa: BLE001 — surfaced per catch_exceptions
+        if catch_exceptions:
+            return None, e
+        raise
+    return (value, None) if catch_exceptions else value
+
+
+def _run_inner(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs):
     import ray_tpu
 
     workflow_id, out = run_async(dag, *args, workflow_id=workflow_id, **kwargs)
@@ -187,11 +247,91 @@ def run(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs):
     except Exception:
         _write_meta(workflow_id, status="RESUMABLE", end_time=time.time())
         raise
+    # Dynamic workflows: a Continuation return chains another DAG under a
+    # derived id — resume replays the outer (checkpoint-skipped) and
+    # re-enters the same continuation ids (deterministic keys). A failure
+    # inside a continuation marks the OUTER workflow RESUMABLE too, so
+    # status tooling sees one resumable unit, not a phantom RUNNING.
+    depth = 0
+    try:
+        while isinstance(value, Continuation):
+            depth += 1
+            value = _run_inner(
+                value.dag, *value.args,
+                workflow_id=f"{workflow_id}.c{depth}", **value.kwargs,
+            )
+    except Exception:
+        _write_meta(workflow_id, status="RESUMABLE", end_time=time.time())
+        raise
     _write_meta(workflow_id, status="SUCCEEDED", end_time=time.time())
     # The final value doubles as the workflow output checkpoint.
     with open(os.path.join(_wf_dir(workflow_id), "output.pkl"), "wb") as f:
         f.write(serialize(value))
     return value
+
+
+# ---------------------------------------------------------------------------
+# Durable external events (reference: python/ray/workflow/event_listener.py
+# + workflow.wait_for_event) — an event is a named payload persisted in the
+# workflow storage; a wait step polls for it and checkpoints like any step,
+# so resumes do not re-wait for already-delivered events.
+# ---------------------------------------------------------------------------
+def _event_path(name: str) -> str:
+    return os.path.join(_storage(), "events", name + ".pkl")
+
+
+def trigger_event(name: str, payload: Any = None):
+    path = _event_path(name)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{uuid.uuid4().hex[:6]}"
+    with open(tmp, "wb") as f:
+        f.write(serialize(payload))
+    os.replace(tmp, path)
+
+
+def _wait_for_event_step(name: str, storage_root: str, timeout_s, poll_s: float):
+    """Marker fn: the executor swaps in _run_event_step (which needs the
+    workflow dir for crash-safe claiming)."""
+    raise RuntimeError("event steps must run through workflow.run")
+
+
+def _run_event_step(_fn, wf_dir: str, key: str, name: str, storage_root: str,
+                    timeout_s, poll_s: float):
+    """Wait for + CONSUME an event, crash-safe: the trigger file is
+    atomically renamed into the workflow's own dir ("claimed"), so a
+    later workflow (or a second wait step) never sees a stale payload,
+    and a crash after the claim but before the checkpoint still resumes
+    with the payload (the claim persists). Then checkpoints like any
+    step."""
+    claimed = os.path.join(wf_dir, "claimed_events", f"{key}.pkl")
+    if not os.path.exists(claimed):
+        os.makedirs(os.path.dirname(claimed), exist_ok=True)
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        path = os.path.join(storage_root, "events", name + ".pkl")
+        while True:
+            try:
+                os.replace(path, claimed)  # atomic claim-and-consume
+                break
+            except FileNotFoundError:
+                pass
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"workflow event {name!r} not delivered in {timeout_s}s"
+                )
+            time.sleep(poll_s)
+    with open(claimed, "rb") as f:
+        payload = deserialize(f.read())
+    return _run_step_with_checkpoint(lambda: payload, wf_dir, key)
+
+
+def wait_for_event(name: str, timeout_s: Optional[float] = None,
+                   poll_s: float = 0.2) -> DAGNode:
+    """A bindable step that blocks until ``trigger_event(name, ...)``
+    delivers, returning the payload."""
+    import ray_tpu
+
+    step = ray_tpu.remote(_wait_for_event_step)
+    return step.bind(name, _storage(), timeout_s, poll_s)
 
 
 def resume(workflow_id: str):

@@ -64,6 +64,7 @@ def test_moe_forward():
     assert bool(jnp.isfinite(logits).all())
 
 
+@pytest.mark.slow
 def test_flash_attention_matches_reference_interpret():
     """Pallas kernel (interpret mode on CPU) vs jnp reference.
 
@@ -88,6 +89,7 @@ def test_flash_attention_matches_reference_interpret():
     np.testing.assert_array_equal(np.asarray(out[:, :, :100]), np.asarray(out2[:, :, :100]))
 
 
+@pytest.mark.slow
 def test_flash_attention_noncausal_interpret():
     from ray_tpu.ops import attention as att
 
@@ -101,6 +103,7 @@ def test_flash_attention_noncausal_interpret():
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-2, atol=2e-2)
 
 
+@pytest.mark.slow
 def test_flash_attention_grad_matches():
     key = jax.random.PRNGKey(5)
     q, k, v = (
@@ -122,6 +125,7 @@ def test_flash_attention_grad_matches():
 
 @pytest.mark.parametrize("causal,q_len,k_len", [(True, 128, 128), (False, 96, 160)],
                          ids=["causal", "noncausal_ragged"])
+@pytest.mark.slow
 def test_flash_backward_kernels_match_reference(causal, q_len, k_len):
     """Pallas dQ/dKV kernels (interpret mode) vs the reference VJP,
     including ragged lengths that exercise both pad paths."""
@@ -244,6 +248,7 @@ def test_generate_gqa_and_moe():
     assert out2.shape == (1, 4)
 
 
+@pytest.mark.slow
 def test_flash_block_q_gt_block_k_ragged():
     """Causal with block_q > block_k and a partial final q-block: the
     k-block loop must clamp instead of issuing a clamped (row-shifting)
@@ -272,6 +277,7 @@ def test_flash_block_q_gt_block_k_ragged():
 
 
 @pytest.mark.parametrize("k_len", [128, 96], ids=["q_gt_k", "q_gt_k_padded"])
+@pytest.mark.slow
 def test_flash_causal_cross_length(k_len):
     """Causal with q_len > k_len (top-left convention): the unmasked
     phase must stay off K padding and in bounds."""
@@ -344,6 +350,7 @@ def test_vit_patchify_roundtrip():
     np.testing.assert_array_equal(patches[0, 1], expected)
 
 
+@pytest.mark.slow
 def test_vit_learns_tiny_classification():
     import jax
     import jax.numpy as jnp

@@ -120,6 +120,7 @@ def test_actor_manager_restarts(ray_start_regular):
     assert sorted(r for _, r in results) == [0, 1, 2]
 
 
+@pytest.mark.slow
 def test_ppo_learns_cartpole_local():
     """Learning-threshold test (reference: tuned_examples cartpole-ppo:
     reward >=150 — scaled down for CI wall-clock)."""
@@ -142,6 +143,7 @@ def test_ppo_learns_cartpole_local():
     algo.stop()
 
 
+@pytest.mark.slow
 def test_ppo_distributed_runners(ray_start_regular):
     config = (
         PPOConfig()
@@ -196,6 +198,7 @@ def test_impala_local_smoke():
     algo.stop()
 
 
+@pytest.mark.slow
 def test_impala_async_distributed(ray_start_regular):
     config = (
         IMPALAConfig()
@@ -293,6 +296,7 @@ def test_prioritized_buffer_priorities_shift_sampling():
     assert mb["weights"].max() <= 1.0 + 1e-6
 
 
+@pytest.mark.slow
 def test_dqn_learns_cartpole_local():
     from ray_tpu.rllib import DQNConfig
 
@@ -319,6 +323,7 @@ def test_dqn_learns_cartpole_local():
     algo.stop()
 
 
+@pytest.mark.slow
 def test_sac_discrete_smoke():
     from ray_tpu.rllib import SACConfig
 

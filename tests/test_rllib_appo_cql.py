@@ -23,6 +23,7 @@ def test_appo_local_smoke():
     algo.stop()
 
 
+@pytest.mark.slow
 def test_appo_async_distributed(ray_start_regular):
     config = (
         APPOConfig()
@@ -95,6 +96,7 @@ def _scripted_episodes(n=20):
     return episodes
 
 
+@pytest.mark.slow
 def test_cql_offline_training():
     episodes = _scripted_episodes(20)
     config = (
@@ -116,6 +118,7 @@ def test_cql_offline_training():
     algo.stop()
 
 
+@pytest.mark.slow
 def test_cql_penalty_pushes_down_ood():
     """CQL loss > SAC loss by exactly the penalty, and the penalty is the
     logsumexp gap."""

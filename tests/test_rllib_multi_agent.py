@@ -153,6 +153,7 @@ def test_ma_turn_based_all_done_finalization():
     assert runner.pop_metrics() == [5.0, 5.0]
 
 
+@pytest.mark.slow
 def test_ma_ppo_learns_separate_policies():
     specs, mapping = _specs(shared=False)
     config = (
@@ -176,6 +177,7 @@ def test_ma_ppo_learns_separate_policies():
     algo.stop()
 
 
+@pytest.mark.slow
 def test_ma_ppo_shared_policy():
     specs, mapping = _specs(shared=True)
     config = (
@@ -196,6 +198,7 @@ def test_ma_ppo_shared_policy():
     algo.stop()
 
 
+@pytest.mark.slow
 def test_ma_ppo_distributed_runners(ray_start_regular):
     specs, mapping = _specs(shared=True)
     config = (
