@@ -18,6 +18,7 @@ from ray_tpu.utils.serialization import serialize_function
 _lock = threading.Lock()
 _controller = None
 _proxy = None
+_grpc_proxy = None
 _node_proxies: dict = {}
 
 _DEPLOYMENT_DEFAULTS = dict(
@@ -83,15 +84,29 @@ def _get_controller():
         return _controller
 
 
-def start(http_port: Optional[int] = None, proxy_location: str = "HeadOnly"):
-    """Start serve system actors (controller + optional HTTP proxy).
+def start(
+    http_port: Optional[int] = None,
+    proxy_location: str = "HeadOnly",
+    grpc_port: Optional[int] = None,
+):
+    """Start serve system actors (controller + optional HTTP/gRPC proxies).
 
     Reference: serve.start (api.py) + proxy_location (HeadOnly |
     EveryNode — the reference runs a ProxyActor per node; replicas are
-    reached local-first through the handle's locality-aware router).
+    reached local-first through the handle's locality-aware router) +
+    the gRPC proxy (proxy.py:545; generic bytes service here).
     """
-    global _proxy, _node_proxies
+    global _proxy, _grpc_proxy, _node_proxies
     ctrl = _get_controller()
+    if grpc_port is not None:
+        with _lock:
+            if _grpc_proxy is None:
+                from ray_tpu.serve.grpc_proxy import GrpcProxyActor
+
+                _grpc_proxy = GrpcProxyActor.options(
+                    name="__serve_grpc_proxy__"
+                ).remote(grpc_port)
+                ray_tpu.wait_actor_ready(_grpc_proxy)
     if proxy_location == "EveryNode" and http_port is None:
         raise ValueError(
             "proxy_location='EveryNode' requires http_port (proxies are "
@@ -157,9 +172,10 @@ def run(
     name: Optional[str] = None,
     http_port: Optional[int] = None,
     proxy_location: str = "HeadOnly",
+    grpc_port: Optional[int] = None,
 ) -> DeploymentHandle:
     """Deploy an application graph; returns the ingress handle."""
-    ctrl = start(http_port, proxy_location=proxy_location)
+    ctrl = start(http_port, proxy_location=proxy_location, grpc_port=grpc_port)
     ingress = _deploy_app(ctrl, app)
     return get_deployment_handle(ingress)
 
@@ -203,6 +219,14 @@ def get_proxy_port() -> Optional[int]:
     return ray_tpu.get(proxy.port.remote())
 
 
+def get_grpc_port() -> Optional[int]:
+    with _lock:
+        proxy = _grpc_proxy
+    if proxy is None:
+        return None
+    return ray_tpu.get(proxy.port.remote())
+
+
 def get_proxy_ports() -> dict:
     """node_id → HTTP port for every running proxy (head + per-node)."""
     with _lock:
@@ -217,12 +241,18 @@ def get_proxy_ports() -> dict:
 
 
 def shutdown():
-    global _controller, _proxy
+    global _controller, _proxy, _grpc_proxy
     with _lock:
         ctrl, _controller = _controller, None
         proxy, _proxy = _proxy, None
+        gproxy, _grpc_proxy = _grpc_proxy, None
         node_proxies = dict(_node_proxies)
         _node_proxies.clear()
+    if gproxy is not None:
+        try:
+            ray_tpu.kill(gproxy)
+        except Exception:  # noqa: BLE001
+            pass
     for p in node_proxies.values():
         try:
             ray_tpu.kill(p)

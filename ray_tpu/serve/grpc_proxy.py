@@ -1,0 +1,135 @@
+"""gRPC ingress proxy actor.
+
+Reference: python/ray/serve/_private/proxy.py:545 (gRPC proxy) — the
+reference serves user-defined proto services; here the ingress is a
+GENERIC gRPC service (no codegen, works with any grpc client using
+bytes serializers):
+
+  unary  /ray_tpu.serve.Ingress/Call    request = JSON {"route", "payload"}
+                                        response = JSON result
+  stream /ray_tpu.serve.Ingress/Stream  same request; one JSON frame per
+                                        yielded item (the LLM path)
+
+Errors surface as gRPC status NOT_FOUND (unknown route) / INTERNAL
+(application error). See ``grpc_call``/``grpc_stream`` for the matching
+client helpers.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterator
+
+import ray_tpu
+
+CALL_METHOD = "/ray_tpu.serve.Ingress/Call"
+STREAM_METHOD = "/ray_tpu.serve.Ingress/Stream"
+
+
+@ray_tpu.remote
+class GrpcProxyActor:
+    def __init__(self, grpc_port: int = 0):
+        from concurrent import futures
+
+        import grpc
+
+        from ray_tpu.serve.api import _get_controller, get_deployment_handle
+
+        self._controller = _get_controller()
+        self._handles: Dict[str, object] = {}
+        self._get_handle = get_deployment_handle
+        proxy = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, call_details):
+                if call_details.method == CALL_METHOD:
+                    return grpc.unary_unary_rpc_method_handler(
+                        proxy._call,
+                        request_deserializer=bytes,
+                        response_serializer=bytes,
+                    )
+                if call_details.method == STREAM_METHOD:
+                    return grpc.unary_stream_rpc_method_handler(
+                        proxy._stream,
+                        request_deserializer=bytes,
+                        response_serializer=bytes,
+                    )
+                return None
+
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self._port = self._server.add_insecure_port(f"127.0.0.1:{grpc_port}")
+        self._server.start()
+
+    def port(self) -> int:
+        return self._port
+
+    # -- request handling ----------------------------------------------
+    def _resolve(self, request: bytes, context):
+        import grpc
+
+        try:
+            envelope = json.loads(request or b"{}")
+            route = envelope.get("route", "/")
+            payload = envelope.get("payload")
+        except json.JSONDecodeError:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "request must be JSON")
+        routes = ray_tpu.get(self._controller.routes.remote())
+        name = routes.get(route.rstrip("/") or "/")
+        if name is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"no such route {route!r}")
+        handle = self._handles.get(name)
+        if handle is None:
+            handle = self._handles[name] = self._get_handle(name)
+        return handle, payload
+
+    def _call(self, request: bytes, context) -> bytes:
+        import grpc
+
+        handle, payload = self._resolve(request, context)
+        try:
+            resp = handle.remote(payload) if payload is not None else handle.remote()
+            return json.dumps(resp.result(timeout=60), default=str).encode()
+        except Exception as e:  # noqa: BLE001 — user errors → INTERNAL
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    def _stream(self, request: bytes, context) -> Iterator[bytes]:
+        import grpc
+
+        handle, payload = self._resolve(request, context)
+        items = handle.stream(payload) if payload is not None else handle.stream()
+        try:
+            for item in items:
+                yield json.dumps(item, default=str).encode()
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        finally:
+            close = getattr(items, "close", None)
+            if close:
+                close()
+
+
+# -- client helpers ------------------------------------------------------
+def grpc_call(target: str, route: str, payload=None, timeout: float = 60.0):
+    """Unary call against the gRPC ingress: returns the JSON-decoded
+    result."""
+    import grpc
+
+    with grpc.insecure_channel(target) as channel:
+        fn = channel.unary_unary(
+            CALL_METHOD, request_serializer=bytes, response_deserializer=bytes
+        )
+        req = json.dumps({"route": route, "payload": payload}).encode()
+        return json.loads(fn(req, timeout=timeout))
+
+
+def grpc_stream(target: str, route: str, payload=None, timeout: float = 60.0):
+    """Streaming call: yields JSON-decoded items as the replica yields."""
+    import grpc
+
+    with grpc.insecure_channel(target) as channel:
+        fn = channel.unary_stream(
+            STREAM_METHOD, request_serializer=bytes, response_deserializer=bytes
+        )
+        req = json.dumps({"route": route, "payload": payload}).encode()
+        for frame in fn(req, timeout=timeout):
+            yield json.loads(frame)

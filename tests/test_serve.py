@@ -379,3 +379,53 @@ def test_per_node_proxies_and_local_routing():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_grpc_ingress(serve_cluster):
+    """Generic gRPC ingress: unary Call + server-streaming Stream
+    (reference: serve's gRPC proxy, proxy.py:545)."""
+    from ray_tpu import serve
+    from ray_tpu.serve.grpc_proxy import grpc_call, grpc_stream
+
+    @serve.deployment(name="gsum")
+    class Summer:
+        def __call__(self, xs):
+            return {"sum": sum(xs)}
+
+        def toks(self, n):
+            for i in range(n):
+                yield {"tok": i}
+
+    serve.run(Summer.bind(), grpc_port=0)
+    try:
+        port = serve.api.get_grpc_port()
+        assert port
+        target = f"127.0.0.1:{port}"
+        assert grpc_call(target, "/gsum", [1, 2, 3]) == {"sum": 6}
+        # unknown route → NOT_FOUND
+        import grpc as _grpc
+
+        with pytest.raises(_grpc.RpcError) as ei:
+            grpc_call(target, "/nope", 1)
+        assert ei.value.code() == _grpc.StatusCode.NOT_FOUND
+    finally:
+        serve.delete("gsum")
+
+
+def test_grpc_ingress_streaming(serve_cluster):
+    from ray_tpu import serve
+    from ray_tpu.serve.grpc_proxy import grpc_stream
+
+    @serve.deployment(name="gstream")
+    class Gen:
+        def __call__(self, n):
+            for i in range(n):
+                yield {"tok": i}
+
+    serve.run(Gen.bind(), grpc_port=0)
+    try:
+        port = serve.api.get_grpc_port()
+        items = list(grpc_stream(f"127.0.0.1:{port}", "/gstream", 3))
+        assert items == [{"tok": 0}, {"tok": 1}, {"tok": 2}]
+    finally:
+        serve.delete("gstream")
