@@ -98,6 +98,13 @@ def start(
     """
     global _proxy, _grpc_proxy, _node_proxies
     ctrl = _get_controller()
+    if proxy_location == "EveryNode" and http_port is None:
+        # validate BEFORE creating any proxy actor — a failed start()
+        # must not leave live system actors behind
+        raise ValueError(
+            "proxy_location='EveryNode' requires http_port (proxies are "
+            "HTTP ingress actors)"
+        )
     if grpc_port is not None:
         with _lock:
             if _grpc_proxy is None:
@@ -107,11 +114,6 @@ def start(
                     name="__serve_grpc_proxy__"
                 ).remote(grpc_port)
                 ray_tpu.wait_actor_ready(_grpc_proxy)
-    if proxy_location == "EveryNode" and http_port is None:
-        raise ValueError(
-            "proxy_location='EveryNode' requires http_port (proxies are "
-            "HTTP ingress actors)"
-        )
     if http_port is not None:
         with _lock:
             if _proxy is None:

@@ -27,16 +27,16 @@ STREAM_METHOD = "/ray_tpu.serve.Ingress/Stream"
 
 @ray_tpu.remote
 class GrpcProxyActor:
-    def __init__(self, grpc_port: int = 0):
+    def __init__(self, grpc_port: int = 0, max_workers: int = 64):
         from concurrent import futures
 
         import grpc
 
         from ray_tpu.serve.api import _get_controller, get_deployment_handle
+        from ray_tpu.serve.proxy import RouteResolver
 
         self._controller = _get_controller()
-        self._handles: Dict[str, object] = {}
-        self._get_handle = get_deployment_handle
+        self._resolver = RouteResolver(self._controller, get_deployment_handle)
         proxy = self
 
         class Handler(grpc.GenericRpcHandler):
@@ -55,7 +55,10 @@ class GrpcProxyActor:
                     )
                 return None
 
-        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        # Streams hold their worker for the FULL response (LLM token
+        # streams run minutes) — size the pool for that, like the HTTP
+        # proxy's thread-per-connection server.
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
         self._server.add_generic_rpc_handlers((Handler(),))
         self._port = self._server.add_insecure_port(f"127.0.0.1:{grpc_port}")
         self._server.start()
@@ -69,34 +72,44 @@ class GrpcProxyActor:
 
         try:
             envelope = json.loads(request or b"{}")
-            route = envelope.get("route", "/")
-            payload = envelope.get("payload")
         except json.JSONDecodeError:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "request must be JSON")
-        routes = ray_tpu.get(self._controller.routes.remote())
-        name = routes.get(route.rstrip("/") or "/")
-        if name is None:
+            envelope = None
+        # Valid-but-wrong-shape JSON (a list, a bare string, route=null)
+        # must ALSO be INVALID_ARGUMENT, not an AttributeError → UNKNOWN.
+        if not isinstance(envelope, dict) or not isinstance(
+            envelope.get("route", "/"), str
+        ):
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                'request must be a JSON object {"route": str, "payload": ...}',
+            )
+        route = envelope.get("route", "/")
+        try:
+            handle = self._resolver.handle_for(route)
+        except KeyError:
             context.abort(grpc.StatusCode.NOT_FOUND, f"no such route {route!r}")
-        handle = self._handles.get(name)
-        if handle is None:
-            handle = self._handles[name] = self._get_handle(name)
-        return handle, payload
+        return handle, envelope.get("payload")
 
     def _call(self, request: bytes, context) -> bytes:
         import grpc
 
+        from ray_tpu.serve.proxy import RouteResolver
+
         handle, payload = self._resolve(request, context)
         try:
-            resp = handle.remote(payload) if payload is not None else handle.remote()
-            return json.dumps(resp.result(timeout=60), default=str).encode()
+            return json.dumps(
+                RouteResolver.call(handle, payload), default=str
+            ).encode()
         except Exception as e:  # noqa: BLE001 — user errors → INTERNAL
             context.abort(grpc.StatusCode.INTERNAL, str(e))
 
     def _stream(self, request: bytes, context) -> Iterator[bytes]:
         import grpc
 
+        from ray_tpu.serve.proxy import RouteResolver
+
         handle, payload = self._resolve(request, context)
-        items = handle.stream(payload) if payload is not None else handle.stream()
+        items = RouteResolver.stream(handle, payload)
         try:
             for item in items:
                 yield json.dumps(item, default=str).encode()

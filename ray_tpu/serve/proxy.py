@@ -18,14 +18,46 @@ from typing import Dict
 import ray_tpu
 
 
+class RouteResolver:
+    """Route-table → DeploymentHandle resolution + dispatch, shared by
+    the HTTP and gRPC ingress actors (one pipeline to keep in sync)."""
+
+    def __init__(self, controller, get_handle):
+        self._controller = controller
+        self._get_handle = get_handle
+        self._handles: Dict[str, object] = {}
+
+    def routes(self) -> Dict[str, str]:
+        return ray_tpu.get(self._controller.routes.remote())
+
+    def handle_for(self, route: str):
+        """Raises KeyError for unknown routes."""
+        route = route.split("?")[0].rstrip("/") or "/"
+        name = self.routes().get(route)
+        if name is None:
+            raise KeyError(route)
+        handle = self._handles.get(name)
+        if handle is None:
+            handle = self._handles[name] = self._get_handle(name)
+        return handle
+
+    @staticmethod
+    def call(handle, payload, timeout: float = 60.0):
+        resp = handle.remote(payload) if payload is not None else handle.remote()
+        return resp.result(timeout=timeout)
+
+    @staticmethod
+    def stream(handle, payload):
+        return handle.stream(payload) if payload is not None else handle.stream()
+
+
 @ray_tpu.remote
 class ProxyActor:
     def __init__(self, http_port: int = 0):
         from ray_tpu.serve.api import _get_controller, get_deployment_handle
 
         self._controller = _get_controller()
-        self._handles: Dict[str, object] = {}
-        self._get_handle = get_deployment_handle
+        self._resolver = RouteResolver(self._controller, get_deployment_handle)
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -145,17 +177,10 @@ class ProxyActor:
         threading.Thread(target=self._server.serve_forever, daemon=True).start()
 
     def _routes(self) -> Dict[str, str]:
-        return ray_tpu.get(self._controller.routes.remote())
+        return self._resolver.routes()
 
     def _resolve(self, path: str, body: bytes):
-        routes = self._routes()
-        route = path.split("?")[0].rstrip("/") or "/"
-        name = routes.get(route)
-        if name is None:
-            raise KeyError(route)
-        handle = self._handles.get(name)
-        if handle is None:
-            handle = self._handles[name] = self._get_handle(name)
+        handle = self._resolver.handle_for(path)
         try:
             payload = json.loads(body) if body else None
         except json.JSONDecodeError:
@@ -164,12 +189,11 @@ class ProxyActor:
 
     def _dispatch(self, path: str, body: bytes):
         handle, payload = self._resolve(path, body)
-        resp = handle.remote(payload) if payload is not None else handle.remote()
-        return resp.result(timeout=60)
+        return RouteResolver.call(handle, payload)
 
     def _dispatch_stream(self, path: str, body: bytes):
         handle, payload = self._resolve(path, body)
-        return handle.stream(payload) if payload is not None else handle.stream()
+        return RouteResolver.stream(handle, payload)
 
     def port(self) -> int:
         return self._port
