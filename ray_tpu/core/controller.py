@@ -1861,14 +1861,55 @@ class Controller:
             del self.events[: len(self.events) // 2]
 
     # =================================================================
-    async def _memory_monitor_loop(self):
-        """Kill workers when host memory crosses the threshold (reference:
-        memory_monitor.h polling + worker_killing_policy victim choice).
-        All simulated nodes share this host, so one monitor here covers the
-        cluster; real multi-host deployments run this in each node agent."""
-        from ray_tpu.core.memory_monitor import POLICIES, KillCandidate, MemoryMonitor
+    def _oom_candidates(self, head_only: bool, node_id: Optional[NodeID] = None):
+        """KillCandidates among a node's workers (reference:
+        worker_killing_policy candidate assembly)."""
+        from ray_tpu.core.memory_monitor import KillCandidate
 
-        monitor = MemoryMonitor(threshold=self.config.memory_usage_threshold)
+        candidates = []
+        for w in self.workers.values():
+            node = self.nodes.get(w.node_id)
+            if node is None:
+                continue
+            if head_only and node.peer is not None:
+                continue
+            if node_id is not None and w.node_id != node_id:
+                continue
+            if w.state == "LEASED" and w.running:
+                tid = next(iter(w.running))
+                rec = self.tasks.get(tid)
+                if rec is None:
+                    continue
+                candidates.append(
+                    KillCandidate(
+                        worker_id=w.worker_id.hex(),
+                        pid=w.pid,
+                        is_retriable=rec.retries_left > 0,
+                        start_time=rec.submitted_at,
+                        owner_id=rec.spec.owner_id.hex() if rec.spec.owner_id else "",
+                    )
+                )
+            elif w.state == "ACTOR" and w.actor_id is not None:
+                actor = self.actors.get(w.actor_id)
+                if actor is None:
+                    continue
+                candidates.append(
+                    KillCandidate(
+                        worker_id=w.worker_id.hex(),
+                        pid=w.pid,
+                        is_retriable=actor.restarts_left > 0,
+                        # Actors rank as oldest: tasks die before actors.
+                        start_time=0.0,
+                        owner_id=actor.creation_spec.owner_id.hex()
+                        if actor.creation_spec.owner_id
+                        else "",
+                    )
+                )
+        return candidates
+
+    def _oom_policy(self):
+        from ray_tpu.core.memory_monitor import POLICIES
+
         policy = POLICIES.get(self.config.worker_killing_policy)
         if policy is None:
             logger.error(
@@ -1876,50 +1917,52 @@ class Controller:
                 self.config.worker_killing_policy,
             )
             policy = POLICIES["retriable_fifo"]
+        return policy
+
+    async def rpc_node_over_memory(self, peer: rpc.Peer, node_id: NodeID):
+        """A node agent's memory monitor crossed the threshold: pick a
+        victim among THAT node's workers (the policies need task/actor
+        context only the controller has) and return its pid for the
+        agent to SIGKILL locally (reference: each raylet runs its own
+        MemoryMonitor; victim choice is worker_killing_policy)."""
+        victim = self._oom_policy()(self._oom_candidates(False, node_id))
+        if victim is None:
+            return None
+        w = self.workers.get(WorkerID.from_hex(victim.worker_id))
+        if w is None:
+            return None
+        logger.warning(
+            "node %s over memory: killing worker %s (pid %s, policy %s)",
+            node_id.hex()[:8], victim.worker_id[:8], victim.pid,
+            self.config.worker_killing_policy,
+        )
+        w.oom_marked = True
+        # Belt-and-braces: also ask the worker to exit — if the agent's
+        # SIGKILL fails (permission, races), the worker still dies and
+        # the oom_marked flag stays truthful about the death cause.
+        try:
+            await w.peer.notify("exit")
+        except Exception:  # noqa: BLE001
+            pass
+        return victim.pid
+
+    async def _memory_monitor_loop(self):
+        """Kill workers when the HEAD host's memory crosses the threshold
+        (reference: memory_monitor.h polling + worker_killing_policy
+        victim choice). Non-head nodes run the same monitor in their
+        agent, reporting through rpc_node_over_memory — on single-host
+        simulations the agents' monitors see the same memory, so the
+        head-only filter here avoids double-killing."""
+        from ray_tpu.core.memory_monitor import MemoryMonitor
+
+        monitor = MemoryMonitor(threshold=self.config.memory_usage_threshold)
+        policy = self._oom_policy()
         interval = self.config.memory_monitor_refresh_ms / 1000.0
         while not self._shutdown.is_set():
             await asyncio.sleep(interval)
             if not monitor.should_kill():
                 continue
-            candidates = []
-            for w in self.workers.values():
-                # This monitor measures THIS host's memory: only head-node
-                # workers (whose pids are local) are valid victims. Remote
-                # hosts run their own monitor in the node agent.
-                node = self.nodes.get(w.node_id)
-                if node is None or node.peer is not None:
-                    continue
-                if w.state == "LEASED" and w.running:
-                    tid = next(iter(w.running))
-                    rec = self.tasks.get(tid)
-                    if rec is None:
-                        continue
-                    candidates.append(
-                        KillCandidate(
-                            worker_id=w.worker_id.hex(),
-                            pid=w.pid,
-                            is_retriable=rec.retries_left > 0,
-                            start_time=rec.submitted_at,
-                            owner_id=rec.spec.owner_id.hex() if rec.spec.owner_id else "",
-                        )
-                    )
-                elif w.state == "ACTOR" and w.actor_id is not None:
-                    actor = self.actors.get(w.actor_id)
-                    if actor is None:
-                        continue
-                    candidates.append(
-                        KillCandidate(
-                            worker_id=w.worker_id.hex(),
-                            pid=w.pid,
-                            is_retriable=actor.restarts_left > 0,
-                            # Actors rank as oldest: tasks die before actors.
-                            start_time=0.0,
-                            owner_id=actor.creation_spec.owner_id.hex()
-                            if actor.creation_spec.owner_id
-                            else "",
-                        )
-                    )
-            victim = policy(candidates)
+            victim = policy(self._oom_candidates(head_only=True))
             if victim is None:
                 continue
             wid = WorkerID.from_hex(victim.worker_id)

@@ -181,6 +181,10 @@ class NodeAgent:
         )
         cfg = (info or {}).get("config") or {}
         self._chunk_bytes = int(cfg.get("object_transfer_chunk_bytes", config))
+        self._config = cfg
+        monitor_task = asyncio.get_running_loop().create_task(
+            self._memory_monitor_loop()
+        )
         try:
             while not self._exit.is_set():
                 reap_children()
@@ -189,9 +193,48 @@ class NodeAgent:
                 except asyncio.TimeoutError:
                     pass
         finally:
+            monitor_task.cancel()
             kill_children()
             self._chunk_reader.close()
             self.store.destroy()
+
+    async def _memory_monitor_loop(self):
+        """Per-node OOM monitoring (reference: every raylet runs its own
+        MemoryMonitor). Multi-host only — on single-host simulations all
+        'nodes' see the same host memory and the head's monitor covers
+        it; per-agent monitors there would mass-fire on one host spike."""
+        from ray_tpu.utils.net import multihost_enabled
+
+        if not multihost_enabled():
+            return
+        refresh_ms = int(self._config.get("memory_monitor_refresh_ms", 250))
+        if refresh_ms <= 0:
+            return
+        from ray_tpu.core.memory_monitor import MemoryMonitor
+
+        monitor = MemoryMonitor(
+            threshold=float(self._config.get("memory_usage_threshold", 0.95))
+        )
+        while not self._exit.is_set():
+            await asyncio.sleep(refresh_ms / 1000.0)
+            if not monitor.should_kill():
+                continue
+            try:
+                # victim choice needs task/actor context → the controller
+                pid = await self._controller_peer.call(
+                    "node_over_memory", self.node_id
+                )
+            except Exception as e:  # noqa: BLE001
+                if self._controller_peer.closed or self._exit.is_set():
+                    return  # controller gone; agent is exiting anyway
+                # transient/remote error: OOM protection must SURVIVE it
+                logger.warning("node_over_memory report failed: %s", e)
+                continue
+            if pid:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
 
 
 def main():

@@ -140,3 +140,47 @@ def test_oom_kill_end_to_end():
             ray_tpu.get(hog.remote(), timeout=60)
     finally:
         ray_tpu.shutdown()
+
+
+def test_node_over_memory_rpc_picks_node_local_victim():
+    """Per-node OOM path (reference: every raylet runs its own memory
+    monitor): an agent reporting memory pressure gets back the pid of a
+    victim among ITS OWN node's workers; killing it drives the normal
+    OOM retry/error flow."""
+    import os
+    import signal
+    import time
+
+    import ray_tpu
+    from ray_tpu.core.cluster_utils import Cluster
+    from ray_tpu.utils.ids import NodeID
+
+    cluster = Cluster({"CPU": 1})
+    cluster.add_node(num_cpus=2, resources={"mem_node": 2})
+    cluster.connect()
+    try:
+
+        @ray_tpu.remote(resources={"mem_node": 1}, max_retries=0)
+        def hog():
+            time.sleep(30)
+            return "survived"
+
+        ref = hog.remote()
+        core = ray_tpu.core.api._require_worker()
+        node_id = next(
+            NodeID.from_hex(n["node_id"]) for n in ray_tpu.nodes() if not n["is_head"]
+        )
+        deadline = time.time() + 30
+        pid = None
+        while time.time() < deadline and pid is None:
+            pid = core._call("node_over_memory", node_id)
+            if pid is None:
+                time.sleep(0.3)  # task not yet running on that node
+        assert pid, "no victim chosen on the pressured node"
+        os.kill(pid, signal.SIGKILL)  # what the agent does with the reply
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(ref, timeout=60)
+        assert "memory" in str(ei.value).lower() or "OutOfMemory" in type(ei.value).__name__
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
