@@ -16,6 +16,8 @@ from ray_tpu.rllib.multi_agent import (
     MultiAgentEnvRunner,
     MultiAgentEpisode,
     MultiAgentPPO,
+    MultiAgentDQN,
+    MultiAgentDQNConfig,
     MultiAgentPPOConfig,
 )
 from ray_tpu.rllib.offline import BC, BCConfig, MARWIL, MARWILConfig
@@ -57,6 +59,8 @@ __all__ = [
     "MultiAgentEnvRunner",
     "MultiAgentEpisode",
     "MultiAgentPPO",
+    "MultiAgentDQN",
+    "MultiAgentDQNConfig",
     "MultiAgentPPOConfig",
     "CQL",
     "CQLConfig",

@@ -18,6 +18,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.dqn import DQNConfig
 from ray_tpu.rllib.episodes import SingleAgentEpisode, episodes_to_batch
 from ray_tpu.rllib.learner import LearnerGroup
 from ray_tpu.rllib.ppo import PPOConfig, ppo_loss
@@ -142,7 +143,11 @@ class MultiAgentEnvRunner:
 
         module = self.modules[mid]
         out = module.forward_train(self.params[mid], jnp.asarray(np.asarray(obs, dtype=np.float32))[None])
-        return float(np.asarray(out["vf"])[0])
+        if "vf" in out:
+            return float(np.asarray(out["vf"])[0])
+        if "q" in out:  # value-based modules: V(s) ≈ max_a Q(s, a)
+            return float(np.asarray(out["q"]).max())
+        return 0.0
 
     def sample(self, num_env_steps: int) -> List[tuple]:
         """Returns [(module_id, SingleAgentEpisode), ...] fragments — the
@@ -280,12 +285,11 @@ class MultiAgentEnvRunner:
         return float(np.mean(totals))
 
 
-class MultiAgentPPOConfig(PPOConfig):
-    """PPO over a MultiRLModule (reference: PPO + MultiRLModule new-stack
-    path; ``multi_agent()`` mirrors AlgorithmConfig.multi_agent)."""
+class _MultiAgentConfigMixin:
+    """``multi_agent()`` fluent surface shared by MA algorithms
+    (reference: AlgorithmConfig.multi_agent)."""
 
-    def __init__(self):
-        super().__init__()
+    def _init_multi_agent(self):
         self._module_specs: Dict[str, RLModuleSpec] = {}
         self._policy_mapping_fn: Callable[[str], str] = lambda aid: "default"
         self._policies_to_train: Optional[List[str]] = None
@@ -295,28 +299,27 @@ class MultiAgentPPOConfig(PPOConfig):
         module_specs: Dict[str, RLModuleSpec],
         policy_mapping_fn: Callable[[str], str],
         policies_to_train: Optional[List[str]] = None,
-    ) -> "MultiAgentPPOConfig":
+    ):
         self._module_specs = module_specs
         self._policy_mapping_fn = policy_mapping_fn
         self._policies_to_train = policies_to_train
         return self
 
-    def build(self) -> "MultiAgentPPO":
-        return MultiAgentPPO(self)
 
+class _MultiAgentAlgorithmBase:
+    """Runner/manager plumbing shared by the MA algorithms: per-policy
+    learner groups over one joint rollout, weight fan-out, fault-tolerant
+    remote runners (reference: the Algorithm + EnvRunnerGroup split)."""
 
-class MultiAgentPPO:
-    """One LearnerGroup per trainable policy; agents sharing a policy are
-    batched together (reference: MultiRLModule learner update where each
-    module's loss runs over its own agents' sub-batch)."""
-
-    def __init__(self, config: MultiAgentPPOConfig):
-        if not config._module_specs:
+    def __init__(self, config, module_specs: Dict[str, RLModuleSpec]):
+        if not module_specs:
             raise ValueError("use .multi_agent(module_specs=..., policy_mapping_fn=...)")
         self.config = config
+        self._specs = module_specs
+        self._trainable = config._policies_to_train or list(module_specs)
         self.local_runner = MultiAgentEnvRunner(
             config.env_spec,
-            config._module_specs,
+            module_specs,
             config._policy_mapping_fn,
             seed=config.seed,
         )
@@ -326,7 +329,7 @@ class MultiAgentPPO:
             def make(i: int):
                 return runner_cls.remote(
                     config.env_spec,
-                    config._module_specs,
+                    module_specs,
                     config._policy_mapping_fn,
                     seed=config.seed,
                     worker_index=i + 1,
@@ -335,29 +338,10 @@ class MultiAgentPPO:
             self._manager = FaultTolerantActorManager(make, config.num_env_runners)
         else:
             self._manager = None
-        trainable = config._policies_to_train or list(config._module_specs)
-        self.learner_groups: Dict[str, LearnerGroup] = {
-            mid: LearnerGroup(
-                spec,
-                ppo_loss,
-                loss_cfg=dict(
-                    clip_param=config.clip_param,
-                    vf_clip_param=config.vf_clip_param,
-                    vf_loss_coeff=config.vf_loss_coeff,
-                    entropy_coeff=config.entropy_coeff,
-                ),
-                num_learners=0,
-                lr=config.lr,
-                grad_clip=config.grad_clip,
-                seed=config.seed,
-            )
-            for mid, spec in config._module_specs.items()
-            if mid in trainable
-        }
+        self.learner_groups: Dict[str, LearnerGroup] = {}
         self.iteration = 0
         self._total_env_steps = 0
         self._recent_returns: List[float] = []
-        self._sync_weights()
 
     def _weights(self) -> Dict[str, Any]:
         w = dict(self.local_runner.params)
@@ -372,23 +356,84 @@ class MultiAgentPPO:
             ref = ray_tpu.put(params)
             self._manager.foreach_actor("set_state", ref, timeout=60)
 
-    def _sample(self) -> List[tuple]:
-        cfg = self.config
+    def _sample(self, want: int) -> List[tuple]:
         if not self._manager:
-            return self.local_runner.sample(cfg.train_batch_size)
+            return self.local_runner.sample(want)
         n = max(1, self._manager.num_healthy())
-        per = max(1, cfg.train_batch_size // n)
+        per = max(1, want // n)
         out: List[tuple] = []
         for _, frags in self._manager.foreach_actor("sample", per, timeout=300):
             out.extend(frags)
-        return out or self.local_runner.sample(cfg.train_batch_size)
+        return out or self.local_runner.sample(want)
+
+    def _collect_returns(self) -> List[float]:
+        returns = self.local_runner.pop_metrics()
+        if self._manager:
+            for _, r in self._manager.foreach_actor("pop_metrics", timeout=60):
+                returns.extend(r)
+        if returns:
+            self._recent_returns = (self._recent_returns + returns)[-100:]
+        return returns
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        return self.local_runner.evaluate(num_episodes)
+
+    def stop(self):
+        for lg in self.learner_groups.values():
+            lg.shutdown()
+        if self._manager:
+            for actor in self._manager.actors.values():
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:  # noqa: BLE001 — already dead
+                    pass
+
+
+class MultiAgentPPOConfig(PPOConfig, _MultiAgentConfigMixin):
+    """PPO over a MultiRLModule (reference: PPO + MultiRLModule new-stack
+    path; ``multi_agent()`` mirrors AlgorithmConfig.multi_agent)."""
+
+    def __init__(self):
+        super().__init__()
+        self._init_multi_agent()
+
+    def build(self) -> "MultiAgentPPO":
+        return MultiAgentPPO(self)
+
+
+class MultiAgentPPO(_MultiAgentAlgorithmBase):
+    """One LearnerGroup per trainable policy; agents sharing a policy are
+    batched together (reference: MultiRLModule learner update where each
+    module's loss runs over its own agents' sub-batch)."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        _MultiAgentAlgorithmBase.__init__(self, config, config._module_specs)
+        self.learner_groups = {
+            mid: LearnerGroup(
+                spec,
+                ppo_loss,
+                loss_cfg=dict(
+                    clip_param=config.clip_param,
+                    vf_clip_param=config.vf_clip_param,
+                    vf_loss_coeff=config.vf_loss_coeff,
+                    entropy_coeff=config.entropy_coeff,
+                ),
+                num_learners=0,
+                lr=config.lr,
+                grad_clip=config.grad_clip,
+                seed=config.seed,
+            )
+            for mid, spec in self._specs.items()
+            if mid in self._trainable
+        }
+        self._sync_weights()
 
     def train(self) -> Dict[str, Any]:
         import time
 
         t0 = time.time()
         cfg = self.config
-        frags = self._sample()
+        frags = self._sample(cfg.train_batch_size)
         env_steps = sum(len(ep) for _, ep in frags)
         self._total_env_steps += env_steps
         by_module: Dict[str, List[SingleAgentEpisode]] = {}
@@ -411,12 +456,7 @@ class MultiAgentPPO:
                     m = lg.update_from_batch(mb)
                 metrics.update({f"learner/{mid}/{k}": v for k, v in m.items()})
         self._sync_weights()
-        returns = self.local_runner.pop_metrics()
-        if self._manager:
-            for _, r in self._manager.foreach_actor("pop_metrics", timeout=60):
-                returns.extend(r)
-        if returns:
-            self._recent_returns = (self._recent_returns + returns)[-100:]
+        self._collect_returns()
         self.iteration += 1
         return {
             "training_iteration": self.iteration,
@@ -429,9 +469,142 @@ class MultiAgentPPO:
             **metrics,
         }
 
-    def evaluate(self, num_episodes: int = 5) -> float:
-        return self.local_runner.evaluate(num_episodes)
 
-    def stop(self):
-        for lg in self.learner_groups.values():
-            lg.shutdown()
+class MultiAgentDQNConfig(DQNConfig, _MultiAgentConfigMixin):
+    """DQN over a MultiRLModule (reference: the multi-agent variants of
+    the off-policy algorithms on the new API stack: per-policy Q modules,
+    replay buffers, and target networks; agents sharing a policy share
+    all three)."""
+
+    def __init__(self):
+        super().__init__()
+        self._init_multi_agent()
+
+    def build(self) -> "MultiAgentDQN":
+        return MultiAgentDQN(self)
+
+
+class MultiAgentDQN(_MultiAgentAlgorithmBase):
+    """One Q-learner + replay buffer + target net per trainable policy;
+    the joint env rollout feeds each policy's buffer with its agents'
+    transitions. Exploration is a shared ε-greedy schedule injected into
+    every module's shipped weights."""
+
+    def __init__(self, config: MultiAgentDQNConfig):
+        import dataclasses
+
+        from ray_tpu.rllib.dqn import dqn_loss
+        from ray_tpu.rllib.replay_buffer import (
+            PrioritizedReplayBuffer,
+            ReplayBuffer,
+        )
+
+        # COPY specs to q-kind — the caller's spec objects must not be
+        # mutated (reusing them for an MA-PPO would silently swap modules)
+        specs = {
+            mid: dataclasses.replace(spec, kind="q")
+            for mid, spec in config._module_specs.items()
+        }
+        _MultiAgentAlgorithmBase.__init__(self, config, specs)
+        self.learner_groups = {
+            mid: LearnerGroup(
+                spec,
+                dqn_loss,
+                loss_cfg=dict(gamma=config.gamma, use_huber=config.use_huber),
+                num_learners=0,
+                lr=config.lr,
+                grad_clip=config.grad_clip,
+                seed=config.seed,
+            )
+            for mid, spec in self._specs.items()
+            if mid in self._trainable
+        }
+        self.buffers = {
+            mid: (
+                PrioritizedReplayBuffer(
+                    config.buffer_size, config.per_alpha, config.per_beta,
+                    seed=config.seed,
+                )
+                if config.prioritized_replay
+                else ReplayBuffer(config.buffer_size, seed=config.seed)
+            )
+            for mid in self.learner_groups
+        }
+        self._num_updates: Dict[str, int] = {mid: 0 for mid in self.learner_groups}
+        self._sync_weights()
+
+    # -- ε schedule (shared across policies; reference: DQN epsilon) -----
+    def current_epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self._total_env_steps / max(1, c.epsilon_decay_steps))
+        return float(c.epsilon_initial + frac * (c.epsilon_final - c.epsilon_initial))
+
+    def _weights(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        eps = jnp.asarray(self.current_epsilon(), jnp.float32)
+        w = {}
+        for mid, params in self.local_runner.params.items():
+            lg = self.learner_groups.get(mid)
+            p = dict(lg.get_weights()) if lg is not None else dict(params)
+            p["epsilon"] = eps
+            w[mid] = p
+        return w
+
+    def _sync_target(self, mid: str):
+        import jax
+
+        lg = self.learner_groups[mid]
+        state = lg.get_state()
+        params = state["params"]
+        params["target"] = jax.tree.map(lambda x: x, params["q"])
+        lg.set_state(state)
+
+    def train(self) -> Dict[str, Any]:
+        import time
+
+        t0 = time.time()
+        cfg = self.config
+        frags = self._sample(
+            cfg.rollout_fragment_length * max(1, cfg.num_env_runners or 1)
+        )
+        env_steps = sum(len(ep) for _, ep in frags)
+        self._total_env_steps += env_steps
+        for mid, ep in frags:
+            buf = self.buffers.get(mid)
+            if buf is not None and len(ep) > 0:
+                buf.add_episodes([ep])
+
+        metrics: Dict[str, Any] = {}
+        for mid, lg in self.learner_groups.items():
+            buf = self.buffers[mid]
+            if len(buf) < cfg.learning_starts:
+                continue
+            m: Dict[str, Any] = {}
+            for _ in range(cfg.num_updates_per_iter):
+                mb = buf.sample(cfg.train_batch_size)
+                idx = mb.pop("idx")
+                m = lg.update_from_batch(mb)
+                td = m.pop("td_errors", None)
+                if td is not None:
+                    buf.update_priorities(idx, np.asarray(td)[: len(idx)])
+                self._num_updates[mid] += 1
+                if self._num_updates[mid] % cfg.target_update_freq == 0:
+                    self._sync_target(mid)
+            metrics.update(
+                {f"learner/{mid}/{k}": v for k, v in m.items() if np.ndim(v) == 0}
+            )
+        self._sync_weights()
+        self._collect_returns()
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "env_steps_this_iter": env_steps,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            "episode_return_mean": float(np.mean(self._recent_returns))
+            if self._recent_returns
+            else 0.0,
+            "epsilon": self.current_epsilon(),
+            "time_this_iter_s": time.time() - t0,
+            **metrics,
+        }
