@@ -119,6 +119,7 @@ class ActorSubmitter:
                         (c for c in self.inflight.values() if c.sent_peer is None),
                         key=lambda c: c.seq,
                     )
+                    lost_peer = False
                     for call in resend:
                         try:
                             deps = await self._inline_deps(call)
@@ -126,7 +127,22 @@ class ActorSubmitter:
                             self.inflight.pop(call.seq, None)
                             self._fail_call(call, None, serialized=e.payload)
                             continue
+                        # _inline_deps awaited — a reply callback processing
+                        # a connection loss may have cleared self.peer. Loop
+                        # back to reconnect rather than send into the void.
+                        if self.peer is None or self.peer.closed:
+                            lost_peer = True
+                            break
                         self._send(call, deps)
+                    if lost_peer:
+                        self._need_resend = True
+                        continue
+                if self._need_resend:
+                    # a loss callback for a call bound to a STALE peer
+                    # fired during an _inline_deps await (self.peer still
+                    # healthy, so no reconnect happened) — loop back and
+                    # resend rather than exiting with work pending
+                    continue
                 if not self.queue:
                     return  # connected; replies drive the rest
                 call = self.queue.popleft()
@@ -135,12 +151,21 @@ class ActorSubmitter:
                 except _DepFailed as e:
                     self._fail_call(call, None, serialized=e.payload)
                     continue
+                if self.peer is None or self.peer.closed:
+                    # connection dropped while awaiting local deps —
+                    # requeue at the front and reconnect first
+                    self.queue.appendleft(call)
+                    continue
                 self.inflight[call.seq] = call
                 self._send(call, inline_deps)
         finally:
             self._draining = False
             # work may have raced in while we were exiting
-            if (self.queue or (self.dead_error and self.inflight)) and not self._draining:
+            if (
+                self.queue
+                or self._need_resend
+                or (self.dead_error and self.inflight)
+            ) and not self._draining:
                 self._ensure_drain()
 
     async def _inline_deps(self, call: _Call):

@@ -144,10 +144,42 @@ async def pull_into_store(store, oid: ObjectID, size: int, src_peer,
     .part name until sealed)."""
     if store.contains(oid) and store.ensure_local(oid):
         return True
-    try:
-        buf = store.create(oid, size)
-    except FileExistsError:
-        return True  # concurrent pull won
+    loop = asyncio.get_running_loop()
+    # Seal-wait bound scales with object size: a healthy concurrent
+    # writer of a multi-GiB object on a slow link must not trip a fixed
+    # 30s timeout (floor assumes >= 32 MiB/s effective transfer rate).
+    seal_wait = 30.0 + size / (32 * 1024 * 1024)
+    deadline = loop.time() + seal_wait
+    while True:
+        try:
+            buf = store.create(oid, size)
+            break
+        except FileExistsError:
+            pass
+        # A concurrent pull (or a local task recreating the same object
+        # id) holds the unsealed slot. Returning success immediately
+        # would let the caller's try_view race the seal — wait until the
+        # winner seals, or until its partial entry is deleted (writer
+        # crashed), in which case we retry the create ourselves.
+        while loop.time() < deadline:
+            # ensure_local is sealed-gated (unsealed arena entries don't
+            # resolve; file-tier objects live under .part until sealed)
+            # and also sees cross-process arena writers.
+            if store.ensure_local(oid):
+                return True
+            if not store.contains(oid):
+                # Writer vanished from this process's table — take over
+                # the pull. (A cross-process arena writer is invisible to
+                # contains(); the sleep keeps the create-retry from
+                # busy-spinning against its still-unsealed arena slot.)
+                await asyncio.sleep(0.01)
+                break
+            await asyncio.sleep(0.01)
+        else:
+            raise TimeoutError(
+                f"object {oid.hex()}: concurrent writer never sealed "
+                f"within {seal_wait:.0f}s"
+            )
     view = buf.view()
     err = await fetch_into(src_peer, oid, size, view, chunk_bytes)
     del view

@@ -19,7 +19,8 @@ _lock = threading.Lock()
 _controller = None
 _proxy = None
 _grpc_proxy = None
-_node_proxies: dict = {}
+_node_proxies: dict = {}  # node_id → READY proxy handle only
+_node_proxies_pending: set = set()  # node_ids with an in-flight proxy spawn
 
 _DEPLOYMENT_DEFAULTS = dict(
     num_replicas=None,  # None + min/max set → autoscaling
@@ -128,45 +129,68 @@ def start(
         # is awaited OUTSIDE the module lock with a bound, so a slow node
         # can neither hang serve.run forever nor deadlock other serve
         # calls on _lock.
-        from ray_tpu.serve.proxy import ProxyActor
-        from ray_tpu.util.scheduling_strategies import (
-            NodeAffinitySchedulingStrategy,
-        )
-
         pending = []
-        with _lock:
-            for n in ray_tpu.nodes():
-                if (
-                    n["state"] != "ALIVE"
-                    or n["is_head"]  # the head proxy above covers it
-                    or n["node_id"] in _node_proxies
-                ):
-                    continue
-                p = ProxyActor.options(
-                    name=f"__serve_proxy_{n['node_id'][:8]}__",
-                    num_cpus=0,
-                    scheduling_strategy=NodeAffinitySchedulingStrategy(
-                        node_id=n["node_id"], soft=False
-                    ),
-                ).remote(0)
-                pending.append((n["node_id"], p))
-        for node_id, p in pending:
-            try:
-                ray_tpu.wait_actor_ready(p, timeout=30)
-            except Exception:  # noqa: BLE001 — node slow/unreachable
-                import logging
-
-                logging.getLogger("ray_tpu.serve").warning(
-                    "per-node proxy on %s not ready in 30s; skipping", node_id[:8]
-                )
-                try:
-                    ray_tpu.kill(p)
-                except Exception:  # noqa: BLE001
-                    pass
-                continue
+        try:
+            _spawn_node_proxies(pending)
+        finally:
+            # Exception mid-scan/mid-wait must not leak reservations: any
+            # node_id still pending here was neither promoted to
+            # _node_proxies nor cleaned up by the failure path.
             with _lock:
-                _node_proxies[node_id] = p
+                for node_id, _ in pending:
+                    _node_proxies_pending.discard(node_id)
     return ctrl
+
+
+def _spawn_node_proxies(pending):
+    """Spawn a zero-CPU ingress proxy on every ALIVE non-head node that
+    lacks one, recording (node_id, handle) in ``pending`` as spawns are
+    issued so the caller can clean up reservations on any exit path."""
+    from ray_tpu.serve.proxy import ProxyActor
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    with _lock:
+        for n in ray_tpu.nodes():
+            if (
+                n["state"] != "ALIVE"
+                or n["is_head"]  # the head proxy above covers it
+                or n["node_id"] in _node_proxies
+                or n["node_id"] in _node_proxies_pending
+            ):
+                continue
+            p = ProxyActor.options(
+                name=f"__serve_proxy_{n['node_id'][:8]}__",
+                num_cpus=0,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=n["node_id"], soft=False
+                ),
+            ).remote(0)
+            # Reserve the node slot NOW, under the lock: a concurrent
+            # start()/run() scanning nodes must not spawn a second
+            # proxy for it (the named-actor create would collide).
+            # The pending set keeps not-yet-ready handles out of
+            # _node_proxies so readers (get_proxy_ports) never block
+            # on an unready proxy.
+            _node_proxies_pending.add(n["node_id"])
+            pending.append((n["node_id"], p))
+    for node_id, p in pending:
+        try:
+            ray_tpu.wait_actor_ready(p, timeout=30)
+        except Exception:  # noqa: BLE001 — node slow/unreachable
+            import logging
+
+            logging.getLogger("ray_tpu.serve").warning(
+                "per-node proxy on %s not ready in 30s; skipping", node_id[:8]
+            )
+            try:
+                ray_tpu.kill(p)
+            except Exception:  # noqa: BLE001
+                pass
+            continue
+        with _lock:
+            _node_proxies[node_id] = p
 
 
 def run(
@@ -250,6 +274,7 @@ def shutdown():
         gproxy, _grpc_proxy = _grpc_proxy, None
         node_proxies = dict(_node_proxies)
         _node_proxies.clear()
+        _node_proxies_pending.clear()
     if gproxy is not None:
         try:
             ray_tpu.kill(gproxy)
