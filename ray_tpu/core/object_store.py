@@ -96,8 +96,14 @@ class PlasmaStore:
             "/dev/shm", "ray_tpu", f"{os.path.basename(session_dir)}_{name}"
         )
         os.makedirs(self.shm_dir, exist_ok=True)
+        from ray_tpu.utils import cloudfs
+
         self.spill_dir = spill_dir or os.path.join(session_dir, f"spilled_objects_{name}")
-        os.makedirs(self.spill_dir, exist_ok=True)
+        # Cloud spill targets (reference: external_storage.py:452 spills
+        # to S3 via smart_open) — `gs://bucket/spill` just works; local
+        # paths stay on the plain-os fast path.
+        self._spill_uri = cloudfs.is_uri(self.spill_dir)
+        cloudfs.makedirs(self.spill_dir)
         self.capacity = capacity
         self.used = 0  # file-tier bytes only; the arena self-accounts
         self._entries: Dict[ObjectID, PlasmaEntry] = {}
@@ -129,6 +135,10 @@ class PlasmaStore:
         return os.path.join(self.shm_dir, oid.hex() + ".part")
 
     def _spill_path(self, oid: ObjectID) -> str:
+        if self._spill_uri:
+            from ray_tpu.utils import cloudfs
+
+            return cloudfs.join(self.spill_dir, oid.hex())
         return os.path.join(self.spill_dir, oid.hex())
 
     # -- write path --------------------------------------------------------
@@ -161,8 +171,13 @@ class PlasmaStore:
             ve = self._entries.get(vid)
             vbuf = self._arena.get(vid_bytes)
             if vbuf is not None:
-                with open(self._spill_path(vid), "wb") as f:
-                    f.write(vbuf.view())
+                if self._spill_uri:
+                    from ray_tpu.utils import cloudfs
+
+                    cloudfs.write_bytes(self._spill_path(vid), bytes(vbuf.view()))
+                else:
+                    with open(self._spill_path(vid), "wb") as f:
+                        f.write(vbuf.view())
                 vbuf.close()
             self._arena.delete(vid_bytes)
             if ve is not None:
@@ -262,9 +277,19 @@ class PlasmaStore:
                 self._arena.delete(oid.binary())
             elif not e.spilled:
                 self.used -= e.size
-            for p in (self._shm_path(oid), self._part_path(oid), self._spill_path(oid)):
+            for p in (self._shm_path(oid), self._part_path(oid)):
                 try:
                     os.unlink(p)
+                except FileNotFoundError:
+                    pass
+            if self._spill_uri:
+                if e.spilled:
+                    from ray_tpu.utils import cloudfs
+
+                    cloudfs.delete(self._spill_path(oid), recursive=False)
+            else:
+                try:
+                    os.unlink(self._spill_path(oid))
                 except FileNotFoundError:
                     pass
 
@@ -284,7 +309,14 @@ class PlasmaStore:
         for _, oid, e in victims:
             if self.used + incoming <= self.capacity:
                 break
-            shutil.move(self._shm_path(oid), self._spill_path(oid))
+            if self._spill_uri:
+                from ray_tpu.utils import cloudfs
+
+                with open(self._shm_path(oid), "rb") as f:
+                    cloudfs.write_bytes(self._spill_path(oid), f.read())
+                os.unlink(self._shm_path(oid))
+            else:
+                shutil.move(self._shm_path(oid), self._spill_path(oid))
             e.spilled = True
             self.used -= e.size
 
@@ -292,18 +324,41 @@ class PlasmaStore:
         if self._arena is not None:
             buf = self._arena_alloc_evicting(oid.binary(), e.size)
             if buf is not None:
-                with open(self._spill_path(oid), "rb") as f:
-                    buf.view()[:] = f.read()
+                buf.view()[:] = self._read_spilled(oid)
                 buf.close()
                 self._arena.seal(oid.binary())
-                os.unlink(self._spill_path(oid))
+                self._delete_spilled(oid)
                 e.spilled = False
                 e.in_arena = True
                 return
         self._maybe_evict(e.size)
-        shutil.move(self._spill_path(oid), self._shm_path(oid))
+        if self._spill_uri:
+            with open(self._shm_path(oid), "wb") as f:
+                f.write(self._read_spilled(oid))
+            self._delete_spilled(oid)
+        else:
+            shutil.move(self._spill_path(oid), self._shm_path(oid))
         e.spilled = False
         self.used += e.size
+
+    def _read_spilled(self, oid: ObjectID) -> bytes:
+        if self._spill_uri:
+            from ray_tpu.utils import cloudfs
+
+            return cloudfs.read_bytes(self._spill_path(oid))
+        with open(self._spill_path(oid), "rb") as f:
+            return f.read()
+
+    def _delete_spilled(self, oid: ObjectID):
+        if self._spill_uri:
+            from ray_tpu.utils import cloudfs
+
+            cloudfs.delete(self._spill_path(oid), recursive=False)
+        else:
+            try:
+                os.unlink(self._spill_path(oid))
+            except FileNotFoundError:
+                pass
 
     def stats(self) -> dict:
         with self._lock:
@@ -325,7 +380,12 @@ class PlasmaStore:
             self._arena.close()
             self._arena = None
         shutil.rmtree(self.shm_dir, ignore_errors=True)
-        shutil.rmtree(self.spill_dir, ignore_errors=True)
+        if self._spill_uri:
+            from ray_tpu.utils import cloudfs
+
+            cloudfs.delete(self.spill_dir)
+        else:
+            shutil.rmtree(self.spill_dir, ignore_errors=True)
 
 
 class PlasmaClient:

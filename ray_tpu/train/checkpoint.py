@@ -2,9 +2,9 @@
 
 Reference: python/ray/train/_checkpoint.py (Checkpoint = directory handle)
 and train/_internal/checkpoint_manager.py:43,80 (_CheckpointManager).
-Storage is a filesystem path (local or mounted GCS/NFS — the reference uses
-pyarrow.fs; local-path semantics are the common denominator here, and orbax
-handles cloud URIs natively on the TPU path).
+Storage paths resolve through ray_tpu.utils.cloudfs (reference:
+train/_internal/storage.py:352 uses pyarrow.fs the same way), so
+``storage_path="gs://bucket/run"`` works wherever a local path does.
 """
 from __future__ import annotations
 
@@ -15,26 +15,34 @@ import tempfile
 from contextlib import contextmanager
 from typing import List, Optional
 
+from ray_tpu.utils import cloudfs
+
 
 class Checkpoint:
-    """A handle to a directory of checkpoint data."""
+    """A handle to a directory of checkpoint data (local or cloud URI)."""
 
     def __init__(self, path: str):
         self.path = path
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
-        return cls(os.path.abspath(path))
+        return cls(cloudfs.normalize(path))
 
     def to_directory(self, path: Optional[str] = None) -> str:
         dest = path or tempfile.mkdtemp(prefix="rt_ckpt_")
-        if os.path.abspath(dest) != os.path.abspath(self.path):
-            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        if cloudfs.normalize(dest) != cloudfs.normalize(self.path):
+            cloudfs.copy_dir(self.path, dest)
         return dest
 
     @contextmanager
     def as_directory(self):
-        yield self.path
+        """A LOCAL directory view (downloads cloud checkpoints)."""
+        local, is_tmp = cloudfs.as_local_dir(self.path)
+        try:
+            yield local
+        finally:
+            if is_tmp:
+                shutil.rmtree(local, ignore_errors=True)
 
     def __repr__(self):
         return f"Checkpoint(path={self.path!r})"
@@ -58,7 +66,7 @@ class CheckpointManager:
         self.score_attr = score_attr
         self.score_order = score_order
         self._kept: List[ReportedCheckpoint] = []
-        os.makedirs(root, exist_ok=True)
+        cloudfs.makedirs(root)
 
     @property
     def latest(self) -> Optional[ReportedCheckpoint]:
@@ -81,12 +89,13 @@ class CheckpointManager:
     def register(self, checkpoint: Checkpoint, metrics: dict, index: int) -> ReportedCheckpoint:
         rc = ReportedCheckpoint(checkpoint, metrics, index)
         self._kept.append(rc)
-        with open(os.path.join(self.root, "checkpoints.json"), "w") as f:
-            json.dump(
+        cloudfs.write_text(
+            cloudfs.join(self.root, "checkpoints.json"),
+            json.dumps(
                 [{"path": c.checkpoint.path, "metrics": c.metrics, "index": c.index}
-                 for c in self._kept],
-                f,
-            )
+                 for c in self._kept]
+            ),
+        )
         self._evict()
         return rc
 
@@ -106,7 +115,7 @@ class CheckpointManager:
         while len(self._kept) > self.num_to_keep and candidates:
             victim = candidates.pop(0)
             self._kept.remove(victim)
-            shutil.rmtree(victim.checkpoint.path, ignore_errors=True)
+            cloudfs.delete(victim.checkpoint.path)
 
     def sync_from_storage(self):
         """Register checkpoints that were fully persisted (``.complete``
@@ -114,12 +123,12 @@ class CheckpointManager:
         driver never consumed because the gang died first."""
         known = {c.checkpoint.path for c in self._kept}
         found = []
-        for entry in sorted(os.listdir(self.root)):
-            path = os.path.join(self.root, entry)
+        for entry in sorted(cloudfs.listdir(self.root)):
+            path = cloudfs.join(self.root, entry)
             if (
                 entry.startswith("checkpoint_")
-                and os.path.isdir(path)
-                and os.path.exists(os.path.join(path, ".complete"))
+                and cloudfs.isdir(path)
+                and cloudfs.exists(cloudfs.join(path, ".complete"))
                 and path not in known
             ):
                 try:
@@ -133,14 +142,13 @@ class CheckpointManager:
     @classmethod
     def restore_state(cls, root: str, **kwargs) -> "CheckpointManager":
         mgr = cls(root, **kwargs)
-        state_file = os.path.join(root, "checkpoints.json")
-        if os.path.exists(state_file):
-            with open(state_file) as f:
-                for entry in json.load(f):
-                    if os.path.exists(entry["path"]):
-                        mgr._kept.append(
-                            ReportedCheckpoint(
-                                Checkpoint(entry["path"]), entry["metrics"], entry["index"]
-                            )
+        state_file = cloudfs.join(root, "checkpoints.json")
+        if cloudfs.exists(state_file):
+            for entry in json.loads(cloudfs.read_text(state_file)):
+                if cloudfs.exists(entry["path"]):
+                    mgr._kept.append(
+                        ReportedCheckpoint(
+                            Checkpoint(entry["path"]), entry["metrics"], entry["index"]
                         )
+                    )
         return mgr

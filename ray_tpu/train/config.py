@@ -78,6 +78,10 @@ class RunConfig:
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
 
     def resolve_storage(self) -> str:
+        from ray_tpu.utils import cloudfs
+
         base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
         name = self.name or "train_run"
-        return os.path.join(base, name)
+        # storage_path may be a cloud URI (gs://bucket/runs) — cloudfs.join
+        # keeps the scheme intact (reference: storage.py:352 pyarrow.fs).
+        return cloudfs.join(base, name)

@@ -11,20 +11,26 @@ checkpoint shapes.
 """
 from __future__ import annotations
 
-import os
 from typing import Any, Optional
 
 import jax
+
+from ray_tpu.utils import cloudfs
 
 
 def save_sharded(path: str, state: Any, *, force: bool = True) -> str:
     """Write a (possibly sharded) pytree of jax.Arrays to ``path``.
 
+    ``path`` may be a cloud URI (`gs://bucket/ckpt`) — orbax/tensorstore
+    handle those natively, and on a real TPU pod a bucket is the only
+    durable target (reference: storage.py:352 pyarrow.fs resolution).
+    cloudfs.normalize abspaths ONLY local paths; URIs pass through.
+
     Every process in a multi-host mesh must call this with the same
     ``path``; each writes only the shards it owns."""
     import orbax.checkpoint as ocp
 
-    path = os.path.abspath(path)
+    path = cloudfs.normalize(path)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, state, force=force)
     ckptr.wait_until_finished()
@@ -44,7 +50,7 @@ def restore_sharded(path: str, template: Any) -> Any:
         template,
     )
     ckptr = ocp.StandardCheckpointer()
-    return ckptr.restore(os.path.abspath(path), abstract)
+    return ckptr.restore(cloudfs.normalize(path), abstract)
 
 
 def _replicated_scalar(value: int, like_tree: Any):

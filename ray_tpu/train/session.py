@@ -65,15 +65,18 @@ class _TrainSession:
 
         persisted = None
         if checkpoint is not None:
-            dest = os.path.join(
+            from ray_tpu.utils import cloudfs
+
+            dest = cloudfs.join(
                 self.ctx.storage_path, f"checkpoint_{self.ckpt_seq:06d}"
             )
-            os.makedirs(dest, exist_ok=True)
+            cloudfs.makedirs(dest)
             # Every rank copies its files into the shared checkpoint dir
             # (sharded checkpoints: orbax writes disjoint per-host files;
-            # reference: storage.py:508 persist_current_checkpoint).
-            if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
-                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            # reference: storage.py:508 persist_current_checkpoint —
+            # cloudfs uploads when storage_path is a gs://-style URI).
+            if cloudfs.normalize(checkpoint.path) != cloudfs.normalize(dest):
+                cloudfs.copy_dir(checkpoint.path, dest)
             persisted = dest
         self.ckpt_seq += 1
         # Rank synchronization barrier (reference session.py:403 semantics).
@@ -83,7 +86,9 @@ class _TrainSession:
             # makes the checkpoint discoverable on restart even if the driver
             # never consumes this report (rank death races the queue).
             if self.ctx.world_rank == 0:
-                open(os.path.join(persisted, ".complete"), "w").close()
+                from ray_tpu.utils import cloudfs
+
+                cloudfs.touch(cloudfs.join(persisted, ".complete"))
             self.latest_checkpoint = persisted
         # Block until the driver consumed the previous result — keeps
         # training paced with the driver loop.

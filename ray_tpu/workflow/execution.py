@@ -13,6 +13,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.utils import cloudfs
 from ray_tpu.utils.serialization import deserialize, serialize
 from ray_tpu.dag.node import (
     DAGNode,
@@ -26,12 +27,14 @@ _storage_dir: Optional[str] = None
 
 
 def init(storage: Optional[str] = None):
-    """Set the workflow storage root (shared filesystem path)."""
+    """Set the workflow storage root — a local path or a cloud URI
+    (``gs://bucket/workflows``); all step/meta/event I/O goes through
+    cloudfs (reference: workflow storage is pluggable the same way)."""
     global _storage_dir
     _storage_dir = storage or os.environ.get(
         "RAY_TPU_WORKFLOW_STORAGE", "/tmp/ray_tpu/workflows"
     )
-    os.makedirs(_storage_dir, exist_ok=True)
+    cloudfs.makedirs(_storage_dir)
     return _storage_dir
 
 
@@ -42,43 +45,48 @@ def _storage() -> str:
 
 
 def _wf_dir(workflow_id: str) -> str:
-    return os.path.join(_storage(), workflow_id)
+    return cloudfs.join(_storage(), workflow_id)
 
 
 def _meta_path(workflow_id: str) -> str:
-    return os.path.join(_wf_dir(workflow_id), "meta.json")
+    return cloudfs.join(_wf_dir(workflow_id), "meta.json")
 
 
 def _write_meta(wf_id: str, /, **updates):
     path = _meta_path(wf_id)
     meta = {}
-    if os.path.exists(path):
-        with open(path) as f:
-            meta = json.load(f)
+    if cloudfs.exists(path):
+        meta = json.loads(cloudfs.read_text(path))
     meta.update(updates)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(meta, f)
-    os.replace(tmp, path)
+    if cloudfs.is_uri(path):
+        # object-store PUT is atomic per object — no tmp+rename needed
+        cloudfs.write_text(path, json.dumps(meta))
+    else:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, path)
     return meta
 
 
 def _read_meta(workflow_id: str) -> dict:
-    with open(_meta_path(workflow_id)) as f:
-        return json.load(f)
+    return json.loads(cloudfs.read_text(_meta_path(workflow_id)))
 
 
 # ---------------------------------------------------------------------------
 # Step checkpointing shim (runs on workers)
 # ---------------------------------------------------------------------------
 def _ckpt_path(wf_dir: str, key: str) -> str:
-    return os.path.join(wf_dir, "steps", key)
+    return cloudfs.join(wf_dir, "steps", key)
 
 
 def _run_step_with_checkpoint(fn, wf_dir: str, key: str, *args, **kwargs):
     """Wrapper executed as the task body: compute, checkpoint, return."""
     result = fn(*args, **kwargs)
     path = _ckpt_path(wf_dir, key)
+    if cloudfs.is_uri(path):
+        cloudfs.write_bytes(path, serialize(result))  # atomic PUT
+        return result
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = f"{path}.tmp.{uuid.uuid4().hex[:6]}"
     with open(tmp, "wb") as f:
@@ -135,9 +143,8 @@ def _execute_workflow(dag: DAGNode, workflow_id: str, args: tuple, kwargs: dict)
             # into .options()): max_retries / retry_exceptions /
             # checkpoint=False.
             wopts = dict(rf._options.get("workflow_options") or {})
-            if os.path.exists(ckpt):
-                with open(ckpt, "rb") as f:
-                    results[id(node)] = deserialize(f.read())
+            if cloudfs.exists(ckpt):
+                results[id(node)] = deserialize(cloudfs.read_bytes(ckpt))
                 continue
             rargs = tuple(resolve(a) for a in node._bound_args)
             rkwargs = {k: resolve(v) for k, v in node._bound_kwargs.items()}
@@ -185,14 +192,14 @@ def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs):
     import ray_tpu
 
     workflow_id = workflow_id or f"wf_{uuid.uuid4().hex[:12]}"
-    os.makedirs(os.path.join(_wf_dir(workflow_id), "steps"), exist_ok=True)
+    cloudfs.makedirs(cloudfs.join(_wf_dir(workflow_id), "steps"))
     _write_meta(
         workflow_id,
         **{"workflow_id": workflow_id, "status": "RUNNING", "start_time": time.time()},
     )
-    blob = serialize((dag, args, kwargs))
-    with open(os.path.join(_wf_dir(workflow_id), "dag.pkl"), "wb") as f:
-        f.write(blob)
+    cloudfs.write_bytes(
+        cloudfs.join(_wf_dir(workflow_id), "dag.pkl"), serialize((dag, args, kwargs))
+    )
     try:
         out = _execute_workflow(dag, workflow_id, args, kwargs)
     except Exception:
@@ -265,8 +272,9 @@ def _run_inner(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs)
         raise
     _write_meta(workflow_id, status="SUCCEEDED", end_time=time.time())
     # The final value doubles as the workflow output checkpoint.
-    with open(os.path.join(_wf_dir(workflow_id), "output.pkl"), "wb") as f:
-        f.write(serialize(value))
+    cloudfs.write_bytes(
+        cloudfs.join(_wf_dir(workflow_id), "output.pkl"), serialize(value)
+    )
     return value
 
 
@@ -277,11 +285,14 @@ def _run_inner(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs)
 # so resumes do not re-wait for already-delivered events.
 # ---------------------------------------------------------------------------
 def _event_path(name: str) -> str:
-    return os.path.join(_storage(), "events", name + ".pkl")
+    return cloudfs.join(_storage(), "events", name + ".pkl")
 
 
 def trigger_event(name: str, payload: Any = None):
     path = _event_path(name)
+    if cloudfs.is_uri(path):
+        cloudfs.write_bytes(path, serialize(payload))  # atomic PUT
+        return
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = f"{path}.tmp.{uuid.uuid4().hex[:6]}"
     with open(tmp, "wb") as f:
@@ -303,24 +314,47 @@ def _run_event_step(_fn, wf_dir: str, key: str, name: str, storage_root: str,
     and a crash after the claim but before the checkpoint still resumes
     with the payload (the claim persists). Then checkpoints like any
     step."""
-    claimed = os.path.join(wf_dir, "claimed_events", f"{key}.pkl")
-    if not os.path.exists(claimed):
-        os.makedirs(os.path.dirname(claimed), exist_ok=True)
+    claimed = cloudfs.join(wf_dir, "claimed_events", f"{key}.pkl")
+    if not cloudfs.exists(claimed):
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
-        path = os.path.join(storage_root, "events", name + ".pkl")
-        while True:
-            try:
-                os.replace(path, claimed)  # atomic claim-and-consume
-                break
-            except FileNotFoundError:
-                pass
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"workflow event {name!r} not delivered in {timeout_s}s"
-                )
-            time.sleep(poll_s)
-    with open(claimed, "rb") as f:
-        payload = deserialize(f.read())
+        path = cloudfs.join(storage_root, "events", name + ".pkl")
+        if cloudfs.is_uri(path):
+            # No atomic rename on object stores: copy-then-delete. Within
+            # one workflow, waiters share a deterministic key, so the
+            # claim is idempotent; across DIFFERENT workflows racing for
+            # one event, delivery is at-least-once (a loser that read
+            # before the winner's delete also claims) — object stores
+            # lack the rename primitive that makes the local path
+            # exactly-once. A loser that observes the file vanish
+            # mid-claim keeps waiting for the next trigger.
+            while True:
+                try:
+                    data = cloudfs.read_bytes(path)
+                except FileNotFoundError:
+                    data = None
+                if data is not None:
+                    cloudfs.write_bytes(claimed, data)
+                    cloudfs.delete(path)
+                    break
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"workflow event {name!r} not delivered in {timeout_s}s"
+                    )
+                time.sleep(poll_s)
+        else:
+            os.makedirs(os.path.dirname(claimed), exist_ok=True)
+            while True:
+                try:
+                    os.replace(path, claimed)  # atomic claim-and-consume
+                    break
+                except FileNotFoundError:
+                    pass
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"workflow event {name!r} not delivered in {timeout_s}s"
+                    )
+                time.sleep(poll_s)
+    payload = deserialize(cloudfs.read_bytes(claimed))
     return _run_step_with_checkpoint(lambda: payload, wf_dir, key)
 
 
@@ -337,11 +371,10 @@ def wait_for_event(name: str, timeout_s: Optional[float] = None,
 def resume(workflow_id: str):
     """Re-run a failed/interrupted workflow; completed steps are skipped
     via their checkpoints."""
-    dag_path = os.path.join(_wf_dir(workflow_id), "dag.pkl")
-    if not os.path.exists(dag_path):
+    dag_path = cloudfs.join(_wf_dir(workflow_id), "dag.pkl")
+    if not cloudfs.exists(dag_path):
         raise ValueError(f"no stored workflow {workflow_id!r}")
-    with open(dag_path, "rb") as f:
-        dag, args, kwargs = deserialize(f.read())
+    dag, args, kwargs = deserialize(cloudfs.read_bytes(dag_path))
     return run(dag, *args, workflow_id=workflow_id, **kwargs)
 
 
@@ -350,24 +383,22 @@ def get_status(workflow_id: str) -> str:
 
 
 def get_output(workflow_id: str):
-    path = os.path.join(_wf_dir(workflow_id), "output.pkl")
-    if not os.path.exists(path):
+    path = cloudfs.join(_wf_dir(workflow_id), "output.pkl")
+    if not cloudfs.exists(path):
         raise ValueError(f"workflow {workflow_id!r} has no output (status: "
                          f"{get_status(workflow_id)})")
-    with open(path, "rb") as f:
-        return deserialize(f.read())
+    return deserialize(cloudfs.read_bytes(path))
 
 
 def list_all() -> List[dict]:
     root = _storage()
     out = []
-    for wid in sorted(os.listdir(root)):
+    for wid in sorted(cloudfs.listdir(root)):
         meta = _meta_path(wid)
-        if os.path.exists(meta):
-            with open(meta) as f:
-                out.append(json.load(f))
+        if cloudfs.exists(meta):
+            out.append(json.loads(cloudfs.read_text(meta)))
     return out
 
 
 def delete(workflow_id: str):
-    shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)
+    cloudfs.delete(_wf_dir(workflow_id))

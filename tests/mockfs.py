@@ -1,0 +1,30 @@
+"""A cross-process mock cloud filesystem for tests: ``mock://bucket/key``
+resolves to /tmp/rt_mockfs/<bucket>/<key> through fsspec — the same code
+path as gs:// (URI detection, fsspec open/ls/rm), but backed by local
+disk so driver, controller, and worker processes all see one namespace
+(fsspec's memory:// is per-process and can't test cross-process flows).
+"""
+import fsspec
+from fsspec.implementations.dirfs import DirFileSystem
+from fsspec.implementations.local import LocalFileSystem
+
+MOCK_ROOT = "/tmp/rt_mockfs"
+
+
+class MockFS(DirFileSystem):
+    protocol = "mock"
+
+    def __init__(self, *args, **kwargs):
+        import os
+
+        os.makedirs(MOCK_ROOT, exist_ok=True)
+        kwargs.pop("path", None)
+        kwargs.pop("fs", None)
+        super().__init__(path=MOCK_ROOT, fs=LocalFileSystem(), **kwargs)
+
+
+def ensure_registered():
+    fsspec.register_implementation("mock", MockFS, clobber=True)
+
+
+ensure_registered()

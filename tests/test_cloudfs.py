@@ -1,0 +1,183 @@
+"""Cloud filesystem layer (reference: train/_internal/storage.py:352
+pyarrow.fs storage_path resolution; _private/external_storage.py:452
+spill-to-cloud). `mock://` is a registered fsspec filesystem backed by
+local disk (tests/mockfs.py) — same code path as `gs://`, cross-process.
+"""
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import tests.mockfs  # registers mock:// in this process
+from ray_tpu.utils import cloudfs
+from ray_tpu.train import (
+    CheckpointConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_mockfs():
+    shutil.rmtree(tests.mockfs.MOCK_ROOT, ignore_errors=True)
+    yield
+    shutil.rmtree(tests.mockfs.MOCK_ROOT, ignore_errors=True)
+
+
+def test_normalize_never_mangles_uris():
+    # The round-2 bug: os.path.abspath("gs://b/ckpt") -> "/.../gs:/b/ckpt"
+    assert cloudfs.normalize("gs://bucket/ckpt") == "gs://bucket/ckpt"
+    assert cloudfs.normalize("s3://bucket/x/y") == "s3://bucket/x/y"
+    assert cloudfs.normalize("mock://a/b") == "mock://a/b"
+    assert os.path.isabs(cloudfs.normalize("rel/path"))
+    assert cloudfs.normalize("file:///tmp/x") == "/tmp/x"
+    assert cloudfs.join("gs://b/x", "y") == "gs://b/x/y"
+
+
+def test_orbax_paths_accept_uris():
+    """save_sharded must pass URIs through to orbax untouched (orbax/
+    tensorstore natively write gs:// buckets on real pods)."""
+    from ray_tpu.train import orbax_checkpoint as oc
+
+    assert cloudfs.normalize("gs://bucket/state") == "gs://bucket/state"
+    # local round-trip still works through the same normalize
+    import jax.numpy as jnp
+
+    state = {"w": jnp.arange(8, dtype=jnp.float32)}
+    path = oc.save_sharded("/tmp/rt_orbax_uri_test/ckpt", state)
+    restored = oc.restore_sharded(path, state)
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]), np.arange(8, dtype=np.float32)
+    )
+    shutil.rmtree("/tmp/rt_orbax_uri_test", ignore_errors=True)
+
+
+def test_roundtrip_write_read_copy():
+    cloudfs.write_bytes("mock://bkt/a/b.bin", b"payload")
+    assert cloudfs.read_bytes("mock://bkt/a/b.bin") == b"payload"
+    src = "/tmp/rt_cloudfs_src"
+    shutil.rmtree(src, ignore_errors=True)
+    os.makedirs(os.path.join(src, "sub"))
+    with open(os.path.join(src, "sub", "f"), "w") as f:
+        f.write("x")
+    cloudfs.copy_dir(src, "mock://bkt/up")
+    assert cloudfs.read_text("mock://bkt/up/sub/f") == "x"
+    local, is_tmp = cloudfs.as_local_dir("mock://bkt/up")
+    assert is_tmp
+    assert open(os.path.join(local, "sub", "f")).read() == "x"
+    shutil.rmtree(local)
+    shutil.rmtree(src)
+
+
+def test_trainer_checkpoints_to_uri(ray_start_regular):
+    """JaxTrainer round-trips checkpoints through a non-local filesystem
+    (the VERDICT 'done when': storage_path on a bucket works end-to-end)."""
+
+    def loop(config):
+        import tempfile
+
+        import numpy as _np
+
+        import tests.mockfs  # noqa: F401 — register mock:// in the worker
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        for step in range(3):
+            with tempfile.TemporaryDirectory() as d:
+                if ctx.get_world_rank() == 0:
+                    with open(os.path.join(d, "model.npy"), "wb") as f:
+                        _np.save(f, _np.full((3,), step, _np.float32))
+                train.report(
+                    {"score": float(step)},
+                    checkpoint=train.Checkpoint.from_directory(d),
+                )
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="uri_run",
+            storage_path="mock://train_bucket",
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=2, checkpoint_score_attribute="score"
+            ),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.checkpoint.path.startswith("mock://")
+    with result.checkpoint.as_directory() as local:
+        arr = np.load(os.path.join(local, "model.npy"))
+    np.testing.assert_array_equal(arr, np.full((3,), 2, np.float32))
+    # top-k eviction happened on the bucket
+    ckpts = [
+        d for d in cloudfs.listdir("mock://train_bucket/uri_run")
+        if d.startswith("checkpoint_")
+    ]
+    assert len(ckpts) == 2, ckpts
+
+
+def test_object_spill_to_uri():
+    """Objects spill to (and restore from) a cloud URI target (reference:
+    external_storage.py:452 S3 spilling)."""
+    from ray_tpu.core.object_store import PlasmaStore
+    from ray_tpu.utils.ids import ObjectID
+
+    store = PlasmaStore(
+        "/tmp/rt_spill_uri_session", capacity=2 * 1024 * 1024,
+        spill_dir="mock://spill_bucket/node1", name="spilltest",
+    )
+    try:
+        oids = []
+        blobs = []
+        for i in range(6):
+            oid = ObjectID.from_random()
+            # 4 MiB each, 6 total = 24 MiB > the arena's 16 MiB floor —
+            # forces LRU victims onto the spill target
+            data = bytes([i]) * (4 * 1024 * 1024)
+            store.put_bytes(oid, data)
+            oids.append(oid)
+            blobs.append(data)
+        stats = store.stats()
+        assert stats["num_spilled"] > 0, stats  # something went to the bucket
+        assert cloudfs.listdir("mock://spill_bucket/node1")
+        for oid, data in zip(oids, blobs):
+            assert store.ensure_local(oid)
+            buf = store.get(oid)
+            assert bytes(buf.view()[:16]) == data[:16]
+            buf.close()
+    finally:
+        store.destroy()
+    # destroy cleaned the bucket prefix
+    assert not cloudfs.exists("mock://spill_bucket/node1")
+
+
+def test_workflow_storage_on_uri(ray_start_regular):
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def double(x):
+        import tests.mockfs  # noqa: F401 — steps checkpoint to mock://
+
+        return x * 2
+
+    @ray_tpu.remote
+    def add(a, b):
+        import tests.mockfs  # noqa: F401
+
+        return a + b
+
+    workflow.init("mock://wf_bucket/flows")
+    dag = add.bind(double.bind(3), double.bind(4))
+    wf_id, value = "wf_uri_test", None
+    value = workflow.run(dag, workflow_id=wf_id)
+    assert value == 14
+    assert workflow.get_status(wf_id) == "SUCCEEDED"
+    assert workflow.get_output(wf_id) == 14
+    # step checkpoints landed on the bucket
+    steps = cloudfs.listdir(f"mock://wf_bucket/flows/{wf_id}/steps")
+    assert steps
+    workflow.init(None)  # reset storage for other tests
