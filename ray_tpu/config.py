@@ -90,12 +90,29 @@ class Config:
     # in the caller's owner-local memory store. Off → every call routes
     # through the controller (the pre-round-2 path).
     direct_actor_calls: bool = True
+    # Lease-based direct submission for NORMAL tasks (reference:
+    # normal_task_submitter.cc worker leasing + PushNormalTask): the
+    # caller leases a worker (controller does placement only; the node
+    # agent owns the local free-worker view) and pushes tasks straight to
+    # it, reusing the lease across a scheduling key's queue. Off → tasks
+    # dispatch through the controller loop (the round-2 path).
+    direct_normal_tasks: bool = True
+    # Pushes in flight per leased worker (reference:
+    # max_tasks_in_flight_per_worker pipelining) — 2 keeps the worker's
+    # execution thread fed while the previous reply is on the wire.
+    max_tasks_in_flight_per_lease: int = 2
+    # Outstanding lease requests + held leases per scheduling key
+    # (reference: max_pending_lease_requests_per_scheduling_category).
+    max_leases_per_scheduling_key: int = 10
 
     # --- control plane ---
     raylet_heartbeat_period_s: float = 0.5
     pubsub_batch_size: int = 1000
     task_event_buffer_size: int = 100000
-    event_flush_period_s: float = 1.0
+    # Worker-side task-event flush cadence. The state API is eventually
+    # consistent for direct-push tasks (reference: GCS task events are
+    # buffered the same way); short period = snappy `list_tasks`.
+    event_flush_period_s: float = 0.25
 
     # --- distributed ref counting / object GC ---
     # Free objects no process references (reference: reference_count.cc

@@ -143,6 +143,7 @@ class CoreWorker:
             info = self._call(
                 "register_worker", self.worker_id, node_id, os.getpid(),
                 listen_addr=listen_addr,
+                pool=os.environ.get("RAY_TPU_WORKER_POOL", ""),
             )
             self.local_shm_dir = local_shm_dir
         self.session_dir = info["session_dir"]
@@ -154,9 +155,11 @@ class CoreWorker:
         # memory_store.cc; actor_task_submitter.h caller→actor push).
         self.memory_store = LocalMemoryStore()
         self.direct_enabled = bool(self.config.get("direct_actor_calls", True))
+        self.direct_normal_enabled = bool(self.config.get("direct_normal_tasks", True))
         self._submitters: dict = {}  # ActorID -> ActorSubmitter
         self._direct_tasks: dict = {}  # TaskID -> ActorSubmitter (cancel routing)
         self._direct_returns: dict = {}  # return ObjectID -> TaskID
+        self._normal_sub = None  # lazily-created NormalSubmitter
         # Batched caller-thread → loop handoff for direct submissions.
         self._direct_handoff = rpc.BatchedHandoff(
             self.loop_runner.loop, lambda item: item[0]._enqueue(item[1])
@@ -518,8 +521,47 @@ class CoreWorker:
         return [ObjectRef(oid) for oid in spec.return_ids()]
 
     def submit_task(self, spec: TaskSpec, captures: Optional[list] = None) -> List[ObjectRef]:
+        if (
+            self.direct_normal_enabled
+            and spec.task_type == TaskType.NORMAL_TASK
+            and not spec.is_streaming
+        ):
+            return self._submit_normal_direct(spec, captures)
         self.promote_refs(list(spec.dependencies) + list(captures or []))
         return self._submit_pipelined(spec, captures)
+
+    def _submit_normal_direct(self, spec: TaskSpec, captures: Optional[list]) -> List[ObjectRef]:
+        """Lease-based direct submission (reference:
+        normal_task_submitter.cc). Top-level owner-local deps travel
+        inline with the push — no promotion; captured (nested) refs must
+        be globally resolvable by the executing worker → promote."""
+        self._check_async_errors()
+        if captures:
+            self.promote_refs(captures)
+        rids = spec.return_ids()
+        self.memory_store.register_pending([oid.binary() for oid in rids])
+        refs = [ObjectRef(oid) for oid in rids]
+        if spec.dependencies or captures:
+            pins = [ObjectRef(d) for d in spec.dependencies]
+            pins += [
+                ObjectRef(c if isinstance(c, ObjectID) else ObjectID(c))
+                for c in (captures or [])
+            ]
+        else:
+            pins = None
+        self._normal_submitter().submit(spec, pins)
+        return refs
+
+    def _normal_submitter(self):
+        sub = self._normal_sub
+        if sub is None:
+            with self._lock:
+                if self._normal_sub is None:
+                    from ray_tpu.core.normal_direct import NormalSubmitter
+
+                    self._normal_sub = NormalSubmitter(self)
+                sub = self._normal_sub
+        return sub
 
     def create_actor(self, spec: TaskSpec, captures: Optional[list] = None):
         self.promote_refs(list(spec.dependencies) + list(captures or []))
@@ -620,10 +662,15 @@ class CoreWorker:
         if sub is not None:
             sub.cancel_threadsafe(task_id)
             return
+        if self._normal_sub is not None and self._normal_sub.owns_task(task_id):
+            self._normal_sub.cancel_threadsafe(task_id)
+            return
         self._call("cancel_task", task_id, force)
 
     def cancel_by_object(self, oid: ObjectID, force: bool):
         tid = self._direct_returns.get(oid)
+        if tid is None and self._normal_sub is not None:
+            tid = self._normal_sub.task_for_return(oid)
         if tid is not None:
             self.cancel_task(tid, force)
             return

@@ -131,6 +131,35 @@ class NodeRecord:
 
 
 @dataclass
+class LeaseRecord:
+    """A granted worker lease for direct normal-task submission
+    (reference: the raylet's granted leases in local_task_manager.h). The
+    controller's part is placement + resource reservation; the worker
+    itself is handed out by the node agent (or by the controller for
+    head-node leases, where it doubles as the agent)."""
+
+    lease_id: bytes
+    demand: ResourceSet  # translated (PG-renamed) resources, reserved
+    node_id: NodeID
+    owner: rpc.Peer  # caller connection; lease dies with it
+    ehash: str = ""
+    worker_id: Optional[WorkerID] = None  # head-node leases only
+
+
+class _LeaseReq:
+    __slots__ = ("demand", "translated", "strategy", "ehash", "dep_keys", "peer", "fut")
+
+    def __init__(self, demand, translated, strategy, ehash, dep_keys, peer, fut):
+        self.demand = demand
+        self.translated = translated
+        self.strategy = strategy
+        self.ehash = ehash
+        self.dep_keys = dep_keys
+        self.peer = peer
+        self.fut = fut
+
+
+@dataclass
 class TaskRecord:
     spec: TaskSpec
     state: str = "PENDING"  # PENDING | DISPATCHED | RUNNING | FINISHED | FAILED
@@ -202,6 +231,23 @@ class Controller:
                 len(self._restored.kv), len(self._restored.actors), len(self._restored.pgs),
             )
         self.pending_tasks: List[TaskID] = []
+        # Worker leases for direct normal-task submission (reference:
+        # normal_task_submitter.cc leasing; controller = placement only).
+        import collections as _c
+        import itertools as _it
+
+        self.leases: Dict[bytes, LeaseRecord] = {}
+        self._lease_reqs: "_c.deque[_LeaseReq]" = _c.deque()
+        self._lease_seq = _it.count(1)
+        self._head_direct_free: List[WorkerID] = []
+        self._head_direct_waiters: "_c.deque[Tuple[str, asyncio.Future]]" = _c.deque()
+        # Synthesized task rows for direct-push tasks (reference: the GCS
+        # task manager's event-derived view) — bounded LRU.
+        self._direct_task_rows: "_c.OrderedDict[str, dict]" = _c.OrderedDict()
+        # Death reasons for recently-dead workers ("oom" | free-text) —
+        # direct-push callers query this to turn a connection loss into
+        # the right error (reference: NodeDeathInfo / worker exit detail).
+        self._dead_worker_info: "_c.OrderedDict[str, str]" = _c.OrderedDict()
         self.drivers: Set[rpc.Peer] = set()
         self._drain_tasks: Set[asyncio.Task] = set()
         self._pump_scheduled = False
@@ -266,6 +312,11 @@ class Controller:
         if holder:
             self._drop_holder(holder)
         self._drop_subscriber(peer)
+        # Leases die with their owner's connection (reference: leased
+        # workers are returned when the lease-holder worker dies).
+        owned = [lid for lid, r in self.leases.items() if r.owner is peer]
+        for lid in owned:
+            await self.rpc_lease_release(peer, lid)
         if kind == "worker":
             await self._on_worker_death(peer.meta["worker_id"], "connection lost")
         elif kind == "agent":
@@ -291,7 +342,7 @@ class Controller:
 
     async def rpc_register_worker(
         self, peer: rpc.Peer, worker_id: WorkerID, node_id: NodeID, pid: int,
-        listen_addr: str = "",
+        listen_addr: str = "", pool: str = "",
     ):
         peer.meta.update(kind="worker", worker_id=worker_id)
         rec = WorkerRecord(
@@ -303,6 +354,14 @@ class Controller:
         if node is not None:
             node.workers.add(worker_id)
             node.num_starting = max(0, node.num_starting - 1)
+        if pool == "direct":
+            # Direct-lease pool: never controller-dispatched. Head-node
+            # direct workers feed the controller's own free list (it is
+            # the head's agent); agent-node ones are tracked by their
+            # agent and merely recorded here (death handling, state API).
+            rec.state = "DIRECT"
+            if node_id == self.head_node_id:
+                self._head_direct_put(rec)
         self._schedule_pump()
         return {"session_dir": self.session_dir, "config": self.config.to_dict()}
 
@@ -371,6 +430,207 @@ class Controller:
             if env_hash and w.env_hash == "" and fallback is None:
                 fallback = w  # pristine worker can adopt the env
         return fallback
+
+    # =================================================================
+    # Worker leasing (direct normal-task submission)
+    # =================================================================
+    async def rpc_lease_request(
+        self, peer: rpc.Peer, demand_items: list, strategy: SchedulingStrategy,
+        ehash: str, dep_keys: list, queued: int = 0,
+    ):
+        """Grant a worker lease: pick a node (locality-aware for DEFAULT
+        strategy), reserve the lease's resources, and tell the caller
+        which agent hands out the worker (reference: RequestWorkerLease,
+        raylet/node_manager.cc:1795 — here split controller/agent).
+        Parks until grantable; the pump re-tries parked requests whenever
+        resources or nodes free up."""
+        demand = ResourceSet(dict(demand_items))
+        translated = self.scheduler.translated_pg_demand(demand, strategy)
+        req = _LeaseReq(
+            demand, translated, strategy, ehash, dep_keys, peer,
+            asyncio.get_running_loop().create_future(),
+        )
+        grant = self._try_grant_lease(req)
+        if grant is not None:
+            return grant
+        self._lease_reqs.append(req)
+        return await req.fut
+
+    def _try_grant_lease(self, req: _LeaseReq) -> Optional[dict]:
+        nid = self._locality_choice(req)
+        if nid is None:
+            nid = self.scheduler.schedule(req.demand, req.strategy).node_id
+        if nid is None:
+            return None
+        node_res = self.cluster.nodes.get(nid)
+        if node_res is None or not node_res.acquire(req.translated):
+            return None
+        lease_id = b"L%d" % next(self._lease_seq)
+        self.leases[lease_id] = LeaseRecord(
+            lease_id=lease_id, demand=req.translated, node_id=nid,
+            owner=req.peer, ehash=req.ehash,
+        )
+        node = self.nodes[nid]
+        agent_addr = "controller" if node.peer is None else node.fetch_addr
+        return {"lease_id": lease_id, "node_id": nid.hex(), "agent_addr": agent_addr}
+
+    def _locality_choice(self, req: _LeaseReq) -> Optional[NodeID]:
+        """Prefer the feasible node holding the most dependency bytes
+        (reference: lease_policy.cc picks the raylet with the task's
+        args). DEFAULT strategy only — explicit placement wins."""
+        if req.strategy.kind != "DEFAULT" or not req.dep_keys:
+            return None
+        per_node: Dict[NodeID, int] = {}
+        for k in req.dep_keys:
+            orec = self.objects.get(ObjectID(k))
+            if orec is None or orec.inline is not None or orec.state != "READY":
+                continue
+            for nid in orec.locations:
+                per_node[nid] = per_node.get(nid, 0) + orec.size
+        for nid in sorted(per_node, key=per_node.get, reverse=True):  # type: ignore[arg-type]
+            node_res = self.cluster.nodes.get(nid)
+            if (
+                node_res is not None
+                and not getattr(node_res, "draining", False)
+                and node_res.fits(req.translated)
+            ):
+                return nid
+        return None
+
+    def _pump_leases(self):
+        """Re-try parked lease requests (FIFO) — called from the pump."""
+        if not self._lease_reqs:
+            return
+        still = []
+        while self._lease_reqs:
+            req = self._lease_reqs.popleft()
+            if req.fut.done() or req.peer.closed:
+                continue  # caller gave up / died
+            grant = self._try_grant_lease(req)
+            if grant is None:
+                still.append(req)
+            else:
+                req.fut.set_result(grant)
+        self._lease_reqs.extend(still)
+
+    async def rpc_lease_worker(self, peer: rpc.Peer, lease_id: bytes, ehash: str):
+        """Hand out a head-node worker for a granted lease — the
+        controller doubles as the head's node agent (reference: the
+        raylet's WorkerPool PopWorker, worker_pool.h:363). Agent nodes
+        serve this same RPC themselves (node_agent.rpc_lease_worker)."""
+        rec = self.leases.get(lease_id)
+        if rec is None:
+            raise ValueError(f"unknown lease {lease_id!r}")
+        node = self.nodes[rec.node_id]
+        w = self._head_direct_pop(ehash)
+        while w is None:
+            if len(node.workers) + node.num_starting < node.max_workers:
+                from ray_tpu.core.node_agent import spawn_worker
+
+                node.num_starting += 1
+                spawn_worker(
+                    self.session_dir, f"127.0.0.1:{self.port}", node.node_id,
+                    node.shm_dir, extra_env={"RAY_TPU_WORKER_POOL": "direct"},
+                )
+            else:
+                # pool at cap: retire one mismatched free direct worker so
+                # a pristine replacement can spawn (reference:
+                # _recycle_idle_worker / worker_pool idle eviction)
+                await self._retire_mismatched_direct(ehash)
+            fut = asyncio.get_running_loop().create_future()
+            self._head_direct_waiters.append((ehash, fut))
+            w = await fut
+            if w.state == "DEAD":
+                w = self._head_direct_pop(ehash)
+        # The awaits above race lease_release: the caller may have timed
+        # out and released this lease while we waited — the worker must
+        # go back to the pool, not leak as LEASED on a dead lease.
+        rec = self.leases.get(lease_id)
+        if rec is None:
+            self._head_direct_put(w)
+            raise ValueError(f"lease {lease_id!r} released while waiting for a worker")
+        rec.worker_id = w.worker_id
+        w.state = "LEASED"
+        w.env_hash = ehash or w.env_hash
+        return {"worker_addr": w.listen_addr, "worker_id": w.worker_id.hex()}
+
+    async def _retire_mismatched_direct(self, ehash: str):
+        for wid in list(self._head_direct_free):
+            w = self.workers.get(wid)
+            if w is None or w.state == "DEAD":
+                self._head_direct_free.remove(wid)
+                continue
+            if w.env_hash and w.env_hash != ehash:
+                self._head_direct_free.remove(wid)
+                w.state = "DEAD"
+                try:
+                    await w.peer.notify("exit")
+                except Exception:  # noqa: BLE001
+                    pass
+                return
+
+    def _head_direct_pop(self, ehash: str) -> Optional[WorkerRecord]:
+        fallback = None
+        for wid in list(self._head_direct_free):
+            w = self.workers.get(wid)
+            if w is None or w.state != "DIRECT":
+                self._head_direct_free.remove(wid)
+                continue
+            if w.env_hash == ehash:
+                self._head_direct_free.remove(wid)
+                return w
+            if w.env_hash == "" and fallback is None:
+                fallback = wid
+        if fallback is not None:
+            self._head_direct_free.remove(fallback)
+            return self.workers[fallback]
+        return None
+
+    def _head_direct_put(self, w: WorkerRecord):
+        w.state = "DIRECT"
+        for i, (ehash, fut) in enumerate(self._head_direct_waiters):
+            if not fut.done() and (w.env_hash in ("", ehash)):
+                del self._head_direct_waiters[i]
+                fut.set_result(w)
+                return
+        self._head_direct_free.append(w.worker_id)
+
+    async def rpc_lease_release(self, peer: rpc.Peer, lease_id: bytes):
+        rec = self.leases.pop(lease_id, None)
+        if rec is None:
+            return False
+        node_res = self.cluster.nodes.get(rec.node_id)
+        if node_res is not None:
+            node_res.release(rec.demand)
+        if rec.worker_id is not None:
+            w = self.workers.get(rec.worker_id)
+            if w is not None and w.state != "DEAD":
+                self._head_direct_put(w)
+        else:
+            # agent lease: the agent bound a worker we never saw — relay
+            # the release so a dead lease-holder can't strand it busy
+            node = self.nodes.get(rec.node_id)
+            if node is not None and node.peer is not None and not node.peer.closed:
+                try:
+                    await node.peer.notify("lease_release", lease_id)
+                except Exception:  # noqa: BLE001 — agent dying too
+                    pass
+        self._schedule_pump()
+        return True
+
+    async def rpc_worker_death_info(self, peer: rpc.Peer, worker_id_hex: str):
+        return self._dead_worker_info.get(worker_id_hex)
+
+    async def rpc_task_lineage(self, peer: rpc.Peer, spec: TaskSpec):
+        """Lineage for a direct-push task whose result went to shm: lets
+        the existing reconstruction path (_try_reconstruct) resubmit it if
+        the storing node dies (reference: owner-side TaskManager lineage;
+        inline results never need reconstruction — they live in the
+        owner's memory store)."""
+        self.finished_specs[spec.task_id] = spec
+        for oid in spec.return_ids():
+            self._object(oid).creating_task = spec.task_id
+        return True
 
     # =================================================================
     # Task submission / scheduling pump
@@ -495,6 +755,7 @@ class Controller:
             self._pump_running = False
 
     async def _pump_once(self):
+        self._pump_leases()
         queue, self.pending_tasks = self.pending_tasks, []
         still_pending: List[TaskID] = []
         spawn_requests: Dict[NodeID, int] = {}
@@ -824,6 +1085,13 @@ class Controller:
         node = self.nodes.get(worker.node_id)
         if node is not None:
             node.workers.discard(worker_id)
+        if worker_id in self._head_direct_free:
+            self._head_direct_free.remove(worker_id)
+        self._dead_worker_info[worker_id.hex()] = (
+            "oom" if worker.oom_marked else reason
+        )
+        while len(self._dead_worker_info) > 1000:
+            self._dead_worker_info.popitem(last=False)
         # Fail or retry running tasks FIRST: _on_actor_death below requeues
         # the creation task under the same deterministic task id, and must
         # not have its fresh record clobbered by this loop.
@@ -1383,7 +1651,64 @@ class Controller:
 
     async def rpc_object_sealed(self, peer: rpc.Peer, oid: ObjectID, size: int, node_id: NodeID):
         await self._account_object(node_id, oid, size)
+        # a sealed copy IS a replica — record it in the directory (chain
+        # broadcast hops report through here)
+        orec = self.objects.get(oid)
+        if orec is not None and orec.state == "READY" and orec.inline is None:
+            orec.locations.add(node_id)
         return True
+
+    async def rpc_object_broadcast(self, peer: rpc.Peer, oid: ObjectID,
+                                   dest_node_ids: Optional[list] = None):
+        """1→N object distribution over a pipelined agent chain
+        (reference: push_manager.h broadcast; release/benchmarks
+        README.md:18-21 '1 GiB to 50 nodes'). Every link runs at full
+        bandwidth concurrently, so N deliveries cost ~1 transfer time
+        instead of N sequential (or N bandwidth-sharing) pulls from one
+        source. Returns True when EVERY destination holds a replica."""
+        orec = self.objects.get(oid)
+        if orec is None or orec.state != "READY" or orec.inline is not None:
+            return False
+        if dest_node_ids is None:
+            dests = [
+                nid for nid, n in self.nodes.items()
+                if n.state == "ALIVE" and n.peer is not None
+                and nid not in orec.locations
+            ]
+        else:
+            dests = [
+                NodeID.from_hex(d) if isinstance(d, str) else d
+                for d in dest_node_ids
+            ]
+            dests = [
+                d for d in dests
+                if d in self.nodes and self.nodes[d].state == "ALIVE"
+                and self.nodes[d].peer is not None and d not in orec.locations
+            ]
+        if not dests:
+            return True
+        # source: any live replica; the head serves over the controller
+        # connection ("controller" pseudo-address)
+        src_addr = None
+        for nid in orec.locations:
+            node = self.nodes.get(nid)
+            if node is None or node.state != "ALIVE":
+                continue
+            src_addr = "controller" if node.peer is None else node.fetch_addr
+            if src_addr:
+                break
+        if src_addr is None:
+            return False
+        first = self.nodes[dests[0]]
+        next_addrs = [self.nodes[d].fetch_addr for d in dests[1:]]
+        try:
+            ok = await first.peer.call(
+                "pull_chain", oid, orec.size, src_addr, next_addrs
+            )
+        except Exception:  # noqa: BLE001 — a hop died mid-chain
+            logger.exception("broadcast chain failed for %s", oid.hex()[:8])
+            return False
+        return bool(ok)
 
     # =================================================================
     # Actors: kill / get-by-name / wait-ready
@@ -1515,6 +1840,25 @@ class Controller:
         self.events.extend(batch)
         if len(self.events) > self.config.task_event_buffer_size:
             del self.events[: len(self.events) // 2]
+        # Keep the state API's task view covering direct-push tasks the
+        # controller never dispatched (reference: GcsTaskManager's
+        # event-derived task table).
+        wid = peer.meta.get("worker_id")
+        w = self.workers.get(wid) if wid else None
+        node_hex = w.node_id.hex() if w is not None else None
+        for ev in batch:
+            if ev.get("kind") != "task" or "task_id" not in ev:
+                continue
+            self._direct_task_rows[ev["task_id"]] = {
+                "task_id": ev["task_id"],
+                "name": ev.get("name", ""),
+                "state": ev.get("state", ""),
+                "type": ev.get("type", "NORMAL_TASK"),
+                "node_id": node_hex,
+            }
+            self._direct_task_rows.move_to_end(ev["task_id"])
+        while len(self._direct_task_rows) > 10000:
+            self._direct_task_rows.popitem(last=False)
         return True
 
     async def rpc_get_actor_by_name(self, peer: rpc.Peer, name: str):
@@ -1662,7 +2006,9 @@ class Controller:
 
     async def rpc_list_tasks(self, peer, limit: int = 1000):
         out = []
+        seen = set()
         for tid, rec in list(self.tasks.items())[-limit:]:
+            seen.add(tid.hex())
             out.append(
                 {
                     "task_id": tid.hex(),
@@ -1672,7 +2018,11 @@ class Controller:
                     "node_id": rec.node_id.hex() if rec.node_id else None,
                 }
             )
-        return out
+        # direct-push tasks (event-derived rows; no TaskRecord exists)
+        for tid_hex, row in list(self._direct_task_rows.items())[-limit:]:
+            if tid_hex not in seen:
+                out.append(row)
+        return out[-limit:]
 
     async def rpc_list_actors(self, peer):
         return [
@@ -1745,6 +2095,9 @@ class Controller:
             rec = self.tasks.get(tid)
             if rec is not None and rec.state == "PENDING":
                 demand.append(rec.spec.resources.to_dict())
+        for req in self._lease_reqs:
+            # parked worker-lease requests are unmet task demand too
+            demand.append(req.demand.to_dict())
         pg_demand = []
         for pg in self.pg_manager.pending_records():
             pg_demand.append(
@@ -1907,6 +2260,45 @@ class Controller:
                 )
         return candidates
 
+    async def _direct_oom_candidates(self, head_only: bool, node_id: Optional[NodeID] = None):
+        """Candidates among DIRECT-pool workers, whose running tasks the
+        controller never sees — ask each worker what it's executing
+        (rpc_current_task). OOM is rare; a per-incident fan-out beats
+        per-task tracking traffic."""
+        from ray_tpu.core.memory_monitor import KillCandidate
+
+        targets = []
+        for w in self.workers.values():
+            node = self.nodes.get(w.node_id)
+            if node is None:
+                continue
+            if head_only and node.peer is not None:
+                continue
+            if node_id is not None and w.node_id != node_id:
+                continue
+            if w.state == "DIRECT" or (
+                w.state == "LEASED" and not w.running and w.actor_id is None
+            ):
+                targets.append(w)
+
+        async def ask(w):
+            try:
+                info = await asyncio.wait_for(w.peer.call("current_task"), 0.5)
+            except Exception:  # noqa: BLE001 — dying worker
+                return None
+            if not info:
+                return None
+            return KillCandidate(
+                worker_id=w.worker_id.hex(),
+                pid=w.pid,
+                is_retriable=bool(info.get("retriable")),
+                start_time=float(info.get("start", time.time())),
+                owner_id=info.get("owner", ""),
+            )
+
+        results = await asyncio.gather(*(ask(w) for w in targets))
+        return [c for c in results if c is not None]
+
     def _oom_policy(self):
         from ray_tpu.core.memory_monitor import POLICIES
 
@@ -1925,7 +2317,9 @@ class Controller:
         context only the controller has) and return its pid for the
         agent to SIGKILL locally (reference: each raylet runs its own
         MemoryMonitor; victim choice is worker_killing_policy)."""
-        victim = self._oom_policy()(self._oom_candidates(False, node_id))
+        candidates = self._oom_candidates(False, node_id)
+        candidates += await self._direct_oom_candidates(False, node_id)
+        victim = self._oom_policy()(candidates)
         if victim is None:
             return None
         w = self.workers.get(WorkerID.from_hex(victim.worker_id))
@@ -1962,7 +2356,9 @@ class Controller:
             await asyncio.sleep(interval)
             if not monitor.should_kill():
                 continue
-            victim = policy(self._oom_candidates(head_only=True))
+            candidates = self._oom_candidates(head_only=True)
+            candidates += await self._direct_oom_candidates(head_only=True)
+            victim = policy(candidates)
             if victim is None:
                 continue
             wid = WorkerID.from_hex(victim.worker_id)

@@ -288,60 +288,15 @@ class ActorSubmitter:
 
     # -- completion -----------------------------------------------------
     def _store_result(self, oid, payload, is_err: bool, kind: str, registered: bool) -> None:
-        """Resolve a return entry, honoring escapes and drops that raced
-        the in-flight call: a deferred promotion publishes now; a doomed
-        entry whose object became GLOBAL (shm, or registered by the
-        worker) reports the drop so the controller can GC it."""
-        ms = self.core.memory_store
-        key = oid.binary()
-        doomed, want_promote = ms.put(key, payload, is_err, kind=kind)
-        promoted = registered
-        if registered:
-            ms.mark_promoted(key)
-        if want_promote and kind == "inline" and not registered:
-            data, err = payload, is_err
-            if isinstance(data, Exception):
-                from ray_tpu.utils.serialization import serialize
-
-                data, err = serialize(data), True
-            asyncio.ensure_future(
-                self.core.peer.notify("object_put_inline", oid, bytes(data), err, [])
-            )
-            ms.mark_promoted(key)
-            promoted = True
-        if doomed and (kind == "shm" or promoted):
-            # global object whose local refs all dropped mid-flight — the
-            # flush loop skipped the drop (entry was pending local-only)
-            asyncio.ensure_future(
-                self.core.peer.notify(
-                    "ref_update", self.core.worker_id.hex(), [], [key]
-                )
-            )
+        store_result(self.core, oid, payload, is_err, kind, registered)
 
     def _complete(self, call: _Call, results: List[tuple], error) -> None:
         self.inflight.pop(call.seq, None)
-        if error is not None:
-            from ray_tpu.utils.serialization import serialize
-
-            blob = serialize(error)
-            for oid in call.spec.return_ids():
-                self._store_result(oid, blob, True, "inline", False)
-        else:
-            for item in results:
-                oid, kind = item[0], item[1]
-                if kind == "inline":
-                    registered = bool(len(item) > 4 and item[4])
-                    self._store_result(oid, item[2], bool(item[3]), "inline", registered)
-                else:
-                    self._store_result(oid, None, False, "shm", True)
+        complete_results(self.core, call.spec, results, error)
         self._done(call)
 
     def _fail_call(self, call: _Call, exc: Optional[Exception], serialized: Optional[bytes] = None) -> None:
-        from ray_tpu.utils.serialization import serialize
-
-        blob = serialized if serialized is not None else serialize(exc)
-        for oid in call.spec.return_ids():
-            self._store_result(oid, blob, True, "inline", False)
+        fail_returns(self.core, call.spec, exc, serialized)
         self._done(call)
 
     def _fail_all(self, exc: Exception) -> None:
@@ -379,6 +334,65 @@ class ActorSubmitter:
 class _DepFailed(Exception):
     def __init__(self, payload: bytes):
         self.payload = payload
+
+
+# -- shared direct-transport completion helpers (used by the actor path
+#    above and the normal-task lease path, normal_direct.py) ------------
+def store_result(core, oid, payload, is_err: bool, kind: str, registered: bool) -> None:
+    """Resolve a return entry in the owner-local memory store, honoring
+    escapes and drops that raced the in-flight call: a deferred promotion
+    publishes now; a doomed entry whose object became GLOBAL (shm, or
+    registered by the worker) reports the drop so the controller can GC
+    it. Loop-thread only."""
+    ms = core.memory_store
+    key = oid.binary()
+    doomed, want_promote = ms.put(key, payload, is_err, kind=kind)
+    promoted = registered
+    if registered:
+        ms.mark_promoted(key)
+    if want_promote and kind == "inline" and not registered:
+        data, err = payload, is_err
+        if isinstance(data, Exception):
+            from ray_tpu.utils.serialization import serialize
+
+            data, err = serialize(data), True
+        asyncio.ensure_future(
+            core.peer.notify("object_put_inline", oid, bytes(data), err, [])
+        )
+        ms.mark_promoted(key)
+        promoted = True
+    if doomed and (kind == "shm" or promoted):
+        # global object whose local refs all dropped mid-flight — the
+        # flush loop skipped the drop (entry was pending local-only)
+        asyncio.ensure_future(
+            core.peer.notify("ref_update", core.worker_id.hex(), [], [key])
+        )
+
+
+def complete_results(core, spec: TaskSpec, results: List[tuple], error) -> None:
+    """Store a push reply's results (same wire shape as _report_direct)."""
+    if error is not None:
+        from ray_tpu.utils.serialization import serialize
+
+        blob = serialize(error)
+        for oid in spec.return_ids():
+            store_result(core, oid, blob, True, "inline", False)
+        return
+    for item in results:
+        oid, kind = item[0], item[1]
+        if kind == "inline":
+            registered = bool(len(item) > 4 and item[4])
+            store_result(core, oid, item[2], bool(item[3]), "inline", registered)
+        else:
+            store_result(core, oid, None, False, "shm", True)
+
+
+def fail_returns(core, spec: TaskSpec, exc: Optional[Exception], serialized: Optional[bytes] = None) -> None:
+    from ray_tpu.utils.serialization import serialize
+
+    blob = serialized if serialized is not None else serialize(exc)
+    for oid in spec.return_ids():
+        store_result(core, oid, blob, True, "inline", False)
 
 
 def _copy_future(src):

@@ -44,7 +44,8 @@ def child_env(needs_tpu: bool) -> dict:
     return env
 
 
-def spawn_worker(session_dir: str, controller_addr: str, node_id: NodeID, shm_dir: str) -> subprocess.Popen:
+def spawn_worker(session_dir: str, controller_addr: str, node_id: NodeID, shm_dir: str,
+                 extra_env: Dict[str, str] = None) -> subprocess.Popen:
     """Start a worker process (reference: python/ray/_private/workers/
     default_worker.py is the reference's equivalent entrypoint)."""
     worker_id = WorkerID.from_random()
@@ -61,6 +62,8 @@ def spawn_worker(session_dir: str, controller_addr: str, node_id: NodeID, shm_di
         # buffering would hold prints back until process exit.
         PYTHONUNBUFFERED="1",
     )
+    if extra_env:
+        env.update(extra_env)
     log_dir = os.path.join(session_dir, "logs")
     os.makedirs(log_dir, exist_ok=True)
     out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:8]}.log"), "ab")
@@ -89,6 +92,19 @@ def kill_children():
             pass
 
 
+class _DirectWorker:
+    """One spawned direct-pool worker in the agent's free-worker view."""
+
+    __slots__ = ("wid", "addr", "env_hash", "busy", "peer")
+
+    def __init__(self, wid: str, addr: str, peer=None):
+        self.wid = wid
+        self.addr = addr
+        self.env_hash = ""
+        self.busy = False
+        self.peer = peer  # the worker's attach connection (exit channel)
+
+
 class NodeAgent:
     def __init__(self, controller_addr: str, session_dir: str, resources: Dict[str, float], capacity: int):
         self.controller_addr = controller_addr
@@ -103,6 +119,20 @@ class NodeAgent:
         self._fetch_peers = FetchPeerCache()
         self._chunk_reader = ChunkReader(self.store)
         self._chunk_bytes = 8 * 1024 * 1024
+        self._inflight_pulls: Dict = {}  # oid -> InflightPull (broadcast hops)
+        # Direct-lease worker pool: THE AGENT owns this node's free-worker
+        # view (reference: the raylet's WorkerPool, worker_pool.h:174); the
+        # controller only places leases onto the node.
+        import collections
+
+        self._direct: Dict[str, _DirectWorker] = {}
+        self._direct_waiters: "collections.deque" = collections.deque()
+        self._direct_starting = 0
+        self._direct_spawns: list = []  # Popen handles not yet attached
+        self._lease_workers: Dict[bytes, str] = {}  # lease_id -> worker id
+        ncpu = int(resources.get("CPU", 1))
+        self._max_direct = max(4 * max(ncpu, 1), 16)
+        self._listen_addr = ""  # set in run()
 
     # -- notifications from the controller ------------------------------
     def rpc_start_workers(self, peer, n: int):
@@ -120,8 +150,16 @@ class NodeAgent:
         return self.store.ensure_local(oid)
 
     # -- object data plane (reference: object_manager.cc Push/Pull) -----
-    def rpc_fetch_chunk(self, peer, oid: ObjectID, offset: int, length: int):
+    async def rpc_fetch_chunk(self, peer, oid: ObjectID, offset: int, length: int):
         # Raw: the chunk crosses as an out-of-band frame (no pickle copy)
+        ip = self._inflight_pulls.get(oid)
+        if ip is not None:
+            # mid-broadcast hop: serve from the in-progress buffer once
+            # the contiguous watermark covers the range
+            await ip.wait_for(offset + length)
+            ip = self._inflight_pulls.get(oid)
+            if ip is not None and ip.view is not None:
+                return rpc.Raw(ip.read(offset, length))
         return rpc.Raw(self._chunk_reader.read(oid, offset, length))
 
     async def rpc_pull_object(self, peer, oid: ObjectID, size: int, src_addr: str) -> bool:
@@ -143,6 +181,188 @@ class NodeAgent:
             raise ConnectionError(f"cannot reach source agent at {addr}")
         return p
 
+    async def rpc_pull_chain(self, peer, oid: ObjectID, size: int, src_addr: str,
+                             next_addrs: list) -> bool:
+        """One hop of a 1→N broadcast chain (reference: push_manager.h —
+        the reference rate-limits a fan-out push; a pipelined CHAIN moves
+        1 GiB to N nodes in ~1 transfer time because every link runs at
+        full bandwidth concurrently, each hop forwarding chunks as its
+        contiguous watermark grows). Kicks the downstream hop FIRST so it
+        pulls from this node's in-progress buffer, then pulls from
+        upstream; resolves when this hop AND everything downstream hold
+        the object."""
+        from ray_tpu.core.object_transfer import InflightPull, fetch_into, pull_into_store
+
+        down_fut = None
+        if next_addrs:
+            nxt = await self._fetch_peers.get(next_addrs[0])
+            if nxt is None:
+                raise ConnectionError(f"cannot reach next hop {next_addrs[0]}")
+            down_fut = asyncio.ensure_future(
+                nxt.call("pull_chain", oid, size, self._listen_addr, next_addrs[1:])
+            )
+        ok = True
+        try:
+            if self.store.contains(oid) and self.store.ensure_local(oid):
+                pass  # already local: just relay
+            else:
+                src_peer = await self._peer_for(src_addr)
+                try:
+                    buf = self.store.create(oid, size)
+                except FileExistsError:
+                    # concurrent regular pull owns the slot — wait for it
+                    ok = await pull_into_store(
+                        self.store, oid, size, src_peer, self._chunk_bytes
+                    )
+                    buf = None
+                if buf is not None:
+                    view = buf.view()
+                    entry = InflightPull(view, size)
+                    self._inflight_pulls[oid] = entry
+                    err = await fetch_into(
+                        src_peer, oid, size, view, self._chunk_bytes,
+                        progress=entry.advance,
+                    )
+                    # No awaits between here and seal/cleanup: readers on
+                    # this loop can't observe the intermediate states.
+                    entry.view = None
+                    del view
+                    buf.close()
+                    self._inflight_pulls.pop(oid, None)
+                    if err is not None:
+                        entry.fail()
+                        self.store.delete(oid)
+                        raise err
+                    self.store.seal(oid)
+                    entry.advance(size)
+                if ok:
+                    # register the new replica so the controller's object
+                    # directory (and broadcast completion) sees it
+                    await self._controller_peer.notify(
+                        "object_sealed", oid, size, self.node_id
+                    )
+        except Exception:
+            if down_fut is not None:
+                down_fut.cancel()
+            raise
+        if down_fut is not None:
+            ok_down = await down_fut
+            return bool(ok) and bool(ok_down)
+        return bool(ok)
+
+    # -- direct-lease worker pool (reference: WorkerPool::PopWorker) ----
+    def rpc_worker_attach(self, peer, worker_id_hex: str, listen_addr: str):
+        """A direct-pool worker this agent spawned announces itself."""
+        self._direct_starting = max(0, self._direct_starting - 1)
+        if self._direct_spawns:
+            self._direct_spawns.pop(0)  # count-based pairing with spawns
+        w = _DirectWorker(worker_id_hex, listen_addr, peer)
+        self._direct[worker_id_hex] = w
+        peer.meta["direct_wid"] = worker_id_hex
+        self._hand_to_waiter(w)
+
+    def _hand_to_waiter(self, w: _DirectWorker) -> bool:
+        for i, (ehash, fut) in enumerate(self._direct_waiters):
+            if not fut.done() and w.env_hash in ("", ehash):
+                del self._direct_waiters[i]
+                w.busy = True
+                w.env_hash = ehash or w.env_hash
+                fut.set_result(w)
+                return True
+        return False
+
+    def _pop_free(self, ehash: str):
+        fallback = None
+        for w in self._direct.values():
+            if w.busy:
+                continue
+            if w.env_hash == ehash:
+                return w
+            if w.env_hash == "" and fallback is None:
+                fallback = w
+        return fallback
+
+    async def rpc_lease_worker(self, peer, lease_id: bytes, ehash: str):
+        """Hand out (or spawn) a worker for a controller-granted lease.
+        The controller reserved the lease's resources; this side only
+        manages processes (reference: LocalTaskManager dispatch popping
+        from the WorkerPool, local_task_manager.cc:122)."""
+        w = self._pop_free(ehash)
+        if w is None:
+            if len(self._direct) + self._direct_starting < self._max_direct:
+                self._spawn_direct()
+            else:
+                self._retire_mismatched(ehash)
+            fut = asyncio.get_running_loop().create_future()
+            self._direct_waiters.append((ehash, fut))
+            w = await fut
+        else:
+            w.busy = True
+            w.env_hash = ehash or w.env_hash
+        # lease→worker binding lets the CONTROLLER free this worker when
+        # the lease-holder dies without ever sending lease_return (its
+        # disconnect cleanup relays rpc_lease_release here)
+        self._lease_workers[bytes(lease_id)] = w.wid
+        return {"worker_addr": w.addr, "worker_id": w.wid}
+
+    def _spawn_direct(self):
+        self._direct_starting += 1
+        proc = spawn_worker(
+            self.session_dir, self.controller_addr, self.node_id,
+            self.store.shm_dir,
+            extra_env={
+                "RAY_TPU_WORKER_POOL": "direct",
+                "RAY_TPU_AGENT_ADDR": self._listen_addr,
+            },
+        )
+        self._direct_spawns.append(proc)
+
+    def _reap_direct_spawns(self):
+        """A direct worker that died BEFORE attaching (import error, OOM)
+        must not inflate _direct_starting forever — that would wedge the
+        pool at a phantom cap with every waiter parked. Count-based: the
+        spawn list length mirrors _direct_starting; attach pops one."""
+        dead = [p for p in self._direct_spawns if p.poll() is not None]
+        for p in dead:
+            self._direct_spawns.remove(p)
+            self._direct_starting = max(0, self._direct_starting - 1)
+        if dead and self._direct_waiters:
+            # retry the spawn the dead process was supposed to satisfy
+            if len(self._direct) + self._direct_starting < self._max_direct:
+                self._spawn_direct()
+
+    def _retire_mismatched(self, ehash: str):
+        """Pool at cap with no usable free worker: retire one free worker
+        locked to a different env so a pristine replacement can spawn."""
+        for wid, w in list(self._direct.items()):
+            if not w.busy and w.env_hash and w.env_hash != ehash:
+                self._direct.pop(wid, None)
+                if w.peer is not None and not w.peer.closed:
+                    asyncio.ensure_future(w.peer.notify("exit"))
+                self._spawn_direct()
+                return
+
+    def rpc_lease_return(self, peer, worker_id_hex: str, lease_id: bytes = None):
+        if lease_id is not None:
+            self._lease_workers.pop(bytes(lease_id), None)
+        w = self._direct.get(worker_id_hex)
+        if w is None:
+            return
+        w.busy = False
+        self._hand_to_waiter(w)
+
+    def rpc_lease_release(self, peer, lease_id: bytes):
+        """Controller relay on lease-holder death: free the bound worker
+        (idempotent vs. a caller's own lease_return, which pops the
+        binding first)."""
+        wid = self._lease_workers.pop(bytes(lease_id), None)
+        if wid is None:
+            return
+        w = self._direct.get(wid)
+        if w is not None:
+            w.busy = False
+            self._hand_to_waiter(w)
+
     def rpc_exit(self, peer):
         self._exit.set()
 
@@ -155,6 +375,10 @@ class NodeAgent:
         return dump_all_threads()
 
     def on_disconnect(self, peer):
+        wid = peer.meta.get("direct_wid")
+        if wid is not None:
+            self._direct.pop(wid, None)  # direct-pool worker died
+            return
         # Only the controller connection is load-bearing; fetch peers
         # (other agents pulling from us) come and go.
         if peer is self._controller_peer or self._controller_peer is None:
@@ -168,6 +392,7 @@ class NodeAgent:
         # the ObjectManagerService gRPC server every node runs).
         # Loopback unless RAY_TPU_NODE_IP opts this host into multi-host.
         _server, fetch_port = await rpc.serve(self, bind_host(), 0)
+        self._listen_addr = f"{host_ip()}:{fetch_port}"
         peer = await rpc.connect(host, int(port), self)
         self._controller_peer = peer
         config = self._chunk_bytes
@@ -188,6 +413,7 @@ class NodeAgent:
         try:
             while not self._exit.is_set():
                 reap_children()
+                self._reap_direct_spawns()
                 try:
                     await asyncio.wait_for(self._exit.wait(), timeout=1.0)
                 except asyncio.TimeoutError:

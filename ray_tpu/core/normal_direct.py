@@ -1,0 +1,407 @@
+"""Lease-based direct submission for NORMAL tasks.
+
+Reference: src/ray/core_worker/transport/normal_task_submitter.cc:24
+(SubmitTask queues by SchedulingKey, RequestNewWorkerIfNeeded :299 leases
+workers, PushNormalTask pushes to the leased worker) +
+src/ray/core_worker/lease_policy.cc (locality-aware raylet choice) +
+src/ray/raylet/local_task_manager.cc:122 (the node-local dispatch half).
+
+Shape here, mapped onto the controller/agent split:
+
+  caller ──lease_request──▶ controller   (PLACEMENT ONLY: picks the node —
+                                          locality-aware — and reserves the
+                                          lease's resources)
+  caller ──lease_worker───▶ node agent   (the agent owns the node's
+                                          free-worker view and hands out /
+                                          spawns a worker; the controller
+                                          plays this role for head-node
+                                          leases)
+  caller ──push_task──────▶ worker       (direct, pipelined, lease reused
+                                          across the scheduling key's
+                                          queue; results land in the
+                                          caller's owner-local memory
+                                          store)
+
+The controller is consulted once per LEASE, not once per task — a queue of
+10k same-shaped tasks costs a handful of lease round-trips, and every push
+and reply travels caller↔worker. Dependencies are resolved caller-side
+before a task becomes leaseable (reference: LocalDependencyResolver), so a
+leased worker never blocks on a dep fetch while holding its slot.
+
+All submitter state is mutated ONLY on the CoreWorker's asyncio loop
+thread (same single-writer discipline as direct.py).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+from ray_tpu.core.direct import _copy_future, complete_results, fail_returns
+from ray_tpu.core.task_spec import TaskSpec, pack_normal_task
+from ray_tpu.exceptions import TaskCancelledError, WorkerCrashedError
+from ray_tpu.utils import rpc
+
+logger = logging.getLogger("ray_tpu.normal_direct")
+
+
+class _NCall:
+    __slots__ = ("spec", "pins", "attempts_left", "cancelled", "global_deps")
+
+    def __init__(self, spec: TaskSpec, pins, attempts_left: int):
+        self.spec = spec
+        self.pins = pins
+        self.attempts_left = attempts_left
+        self.cancelled = False
+        self.global_deps = None  # filled during resolve (locality hint)
+
+
+class _Lease:
+    __slots__ = ("lease_id", "worker_peer", "worker_id_hex", "agent_addr", "inflight")
+
+    def __init__(self, lease_id: bytes, worker_peer: rpc.Peer, worker_id_hex: str, agent_addr: str):
+        self.lease_id = lease_id
+        self.worker_peer = worker_peer
+        self.worker_id_hex = worker_id_hex
+        self.agent_addr = agent_addr  # "controller" for head-node leases
+        self.inflight: set = set()
+
+
+class _KeyState:
+    """Per-SchedulingKey queue + leases (reference: SchedulingKey entries
+    in normal_task_submitter.h:40-54)."""
+
+    __slots__ = ("key", "demand_items", "strategy", "ehash", "queue", "leases",
+                 "pending_requests", "resolving")
+
+    def __init__(self, key, spec: TaskSpec, ehash: str):
+        self.key = key
+        self.demand_items = tuple(spec.resources.items_fp())
+        self.strategy = spec.scheduling_strategy
+        self.ehash = ehash
+        self.queue: deque = deque()
+        self.leases: list = []
+        self.pending_requests = 0
+        self.resolving = 0  # calls still waiting on dependencies
+
+
+class _PeerHandler:
+    def on_disconnect(self, peer):
+        pass
+
+
+class NormalSubmitter:
+    """One per CoreWorker process; owns every scheduling key's state."""
+
+    def __init__(self, core):
+        self.core = core
+        cfg = core.config
+        self.pipeline = int(cfg.get("max_tasks_in_flight_per_lease", 2))
+        self.max_leases = int(cfg.get("max_leases_per_scheduling_key", 10))
+        self.lease_timeout = float(cfg.get("worker_lease_timeout_s", 30.0))
+        self.keys: Dict[Tuple, _KeyState] = {}
+        self.tasks: Dict = {}  # TaskID -> (_KeyState, _NCall) for cancel
+        self.returns: Dict = {}  # return ObjectID -> TaskID
+        self._worker_peers: Dict[str, rpc.Peer] = {}
+        self._agent_peers: Dict[str, rpc.Peer] = {}
+        self._handoff = rpc.BatchedHandoff(
+            core.loop_runner.loop, lambda item: self._enqueue(*item)
+        )
+
+    # -- caller thread ---------------------------------------------------
+    def submit(self, spec: TaskSpec, pins) -> None:
+        call = _NCall(spec, pins, spec.max_retries)
+        self._handoff.push((spec, call))
+
+    def cancel_threadsafe(self, task_id) -> None:
+        self.core.loop_runner.loop.call_soon_threadsafe(self._cancel, task_id)
+
+    def owns_task(self, task_id) -> bool:
+        return task_id in self.tasks
+
+    def task_for_return(self, oid):
+        return self.returns.get(oid)
+
+    # -- loop thread -----------------------------------------------------
+    def _key_state(self, spec: TaskSpec) -> _KeyState:
+        from ray_tpu.runtime_env import env_hash
+
+        ehash = env_hash(spec.runtime_env)
+        key = (spec.scheduling_class(), ehash)
+        ks = self.keys.get(key)
+        if ks is None:
+            ks = self.keys[key] = _KeyState(key, spec, ehash)
+        return ks
+
+    def _enqueue(self, spec: TaskSpec, call: _NCall) -> None:
+        ks = self._key_state(spec)
+        self.tasks[spec.task_id] = (ks, call)
+        for oid in spec.return_ids():
+            self.returns[oid] = spec.task_id
+        ks.resolving += 1
+        asyncio.get_running_loop().create_task(self._resolve_then_queue(ks, call))
+
+    async def _resolve_then_queue(self, ks: _KeyState, call: _NCall) -> None:
+        """Wait until every dependency is READY — owner-local entries via
+        their local futures, global objects via one controller wait
+        (reference: LocalDependencyResolver resolves deps BEFORE the lease
+        request; pushing earlier could deadlock a full cluster on a task
+        blocked fetching a dep that needs the held slot to be produced)."""
+        try:
+            ms = self.core.memory_store
+            global_deps = []
+            for dep in call.spec.dependencies:
+                key = dep.binary()
+                e = ms.lookup(key)
+                if e is None:
+                    global_deps.append(dep)
+                    continue
+                if not e.ready:
+                    await asyncio.wrap_future(_copy_future(e.ensure_future()))
+                if e.kind != "inline":
+                    global_deps.append(dep)
+            if global_deps:
+                await self.core.peer.call(
+                    "object_wait", global_deps, len(global_deps), None
+                )
+            call.global_deps = global_deps
+        except Exception as e:  # noqa: BLE001 — controller gone / dep wait failed
+            ks.resolving -= 1
+            self._fail(call, e)
+            self._pump(ks)  # may be the last pending work → release leases
+            return
+        ks.resolving -= 1
+        if call.cancelled:
+            self._pump(ks)
+            return
+        ks.queue.append(call)
+        self._pump(ks)
+
+    # -- lease + dispatch pump -------------------------------------------
+    def _pump(self, ks: _KeyState) -> None:
+        for lease in list(ks.leases):
+            while ks.queue and len(lease.inflight) < self.pipeline:
+                self._send(ks, lease, ks.queue.popleft())
+        if ks.queue:
+            # Rate-limit lease REQUESTS in flight (reference:
+            # max_pending_lease_requests per scheduling category); held
+            # leases are unbounded — they scale with queue depth so a
+            # storm can fan out across the whole cluster.
+            want = min(len(ks.queue), self.max_leases) - ks.pending_requests
+            for _ in range(max(0, want)):
+                ks.pending_requests += 1
+                asyncio.get_running_loop().create_task(self._lease_task(ks))
+            return
+        if ks.resolving:
+            return  # tasks still resolving deps will want these leases
+        # Queue drained: release leases with nothing in flight (reference:
+        # the submitter returns the leased worker when its scheduling
+        # key's queue empties).
+        for lease in [l for l in ks.leases if not l.inflight]:
+            self._release_lease(ks, lease)
+
+    async def _lease_task(self, ks: _KeyState) -> None:
+        lease = None
+        lease_id = None
+        try:
+            # Locality hint: global deps of the head-of-queue task — the
+            # controller weighs their stored bytes per node (reference:
+            # lease_policy.cc best_node_by_arg_bytes).
+            dep_hint = []
+            if ks.queue:
+                head = ks.queue[0]
+                if head.global_deps:
+                    dep_hint = [d.binary() for d in head.global_deps]
+            resp = await self.core.peer.call(
+                "lease_request", list(ks.demand_items), ks.strategy, ks.ehash,
+                dep_hint, len(ks.queue),
+            )
+            if resp is None:
+                return  # shutting down
+            lease_id = resp["lease_id"]
+            agent_addr = resp["agent_addr"]
+            if agent_addr == "controller":
+                grant = await asyncio.wait_for(
+                    self.core.peer.call("lease_worker", lease_id, ks.ehash),
+                    self.lease_timeout,
+                )
+            else:
+                agent = await self._agent_peer(agent_addr)
+                grant = await asyncio.wait_for(
+                    agent.call("lease_worker", lease_id, ks.ehash),
+                    self.lease_timeout,
+                )
+            peer = await self._worker_peer(grant["worker_addr"])
+            lease = _Lease(lease_id, peer, grant["worker_id"], agent_addr)
+        except Exception as e:  # noqa: BLE001 — agent/worker unreachable, timeout
+            if lease_id is not None:
+                self._notify_release(lease_id, None, None)
+            if ks.queue:
+                logger.warning("lease acquisition failed (%s); retrying", e)
+                await asyncio.sleep(0.05)
+            return
+        finally:
+            ks.pending_requests -= 1
+            if lease is not None:
+                if ks.queue:
+                    ks.leases.append(lease)
+                else:
+                    # burst already drained by other leases
+                    self._notify_release(lease.lease_id, lease.agent_addr, lease.worker_id_hex)
+            self._pump(ks)
+
+    async def _agent_peer(self, addr: str) -> rpc.Peer:
+        p = self._agent_peers.get(addr)
+        if p is None or p.closed:
+            host, port = addr.rsplit(":", 1)
+            p = await rpc.connect(host, int(port), _PeerHandler(), retries=3, delay=0.05)
+            self._agent_peers[addr] = p
+        return p
+
+    async def _worker_peer(self, addr: str) -> rpc.Peer:
+        p = self._worker_peers.get(addr)
+        if p is None or p.closed:
+            host, port = addr.rsplit(":", 1)
+            p = await rpc.connect(host, int(port), _PeerHandler(), retries=3, delay=0.05)
+            self._worker_peers[addr] = p
+        return p
+
+    # -- push / reply -----------------------------------------------------
+    def _send(self, ks: _KeyState, lease: _Lease, call: _NCall) -> None:
+        if call.cancelled:
+            # e.g. cancelled while in flight, then requeued by a worker
+            # connection loss — must resolve the returns, not vanish
+            self._fail(call, TaskCancelledError(call.spec.task_id.hex()))
+            return
+        inline = None
+        ms = self.core.memory_store
+        for dep in call.spec.dependencies:
+            key = dep.binary()
+            e = ms.lookup(key)
+            if e is None or e.kind != "inline" or not e.ready:
+                continue
+            payload, is_err = e.value()
+            if isinstance(payload, Exception) or is_err:
+                # dep resolved to an error — fail without occupying the lease
+                from ray_tpu.utils.serialization import serialize
+
+                blob = bytes(payload) if not isinstance(payload, Exception) else serialize(payload)
+                self._fail(call, None, serialized=blob)
+                return
+            if inline is None:
+                inline = {}
+            inline[key] = bytes(payload)
+        lease.inflight.add(call)
+        fut = lease.worker_peer.call_nowait(
+            "push_task", pack_normal_task(call.spec), inline
+        )
+        fut.add_done_callback(lambda f: self._on_reply(ks, lease, call, f))
+
+    def _on_reply(self, ks: _KeyState, lease: _Lease, call: _NCall, fut: asyncio.Future) -> None:
+        lease.inflight.discard(call)
+        if fut.cancelled() or fut.exception() is not None:
+            self._lease_lost(ks, lease)
+            if call.attempts_left > 0:
+                call.attempts_left -= 1
+                ks.queue.appendleft(call)
+            else:
+                asyncio.get_running_loop().create_task(
+                    self._fail_worker_death(call, lease.worker_id_hex)
+                )
+            self._pump(ks)
+            return
+        results, error = fut.result()
+        if error is not None and call.spec.retry_exceptions and call.attempts_left > 0:
+            call.attempts_left -= 1
+            ks.queue.appendleft(call)
+            self._pump(ks)
+            return
+        complete_results(self.core, call.spec, results, error)
+        self._done(call)
+        self._pump(ks)
+
+    # -- lease lifecycle ---------------------------------------------------
+    def _lease_lost(self, ks: _KeyState, lease: _Lease) -> None:
+        if lease in ks.leases:
+            ks.leases.remove(lease)
+            # resources must be freed even though the worker is gone; the
+            # agent's pool entry cleans itself up on the worker's death
+            self._notify_release(lease.lease_id, None, None)
+        addr_peer = self._worker_peers
+        for addr, p in list(addr_peer.items()):
+            if p is lease.worker_peer:
+                addr_peer.pop(addr, None)
+
+    def _release_lease(self, ks: _KeyState, lease: _Lease) -> None:
+        ks.leases.remove(lease)
+        self._notify_release(lease.lease_id, lease.agent_addr, lease.worker_id_hex)
+
+    def _notify_release(self, lease_id: bytes, agent_addr: Optional[str], worker_id_hex: Optional[str]) -> None:
+        asyncio.ensure_future(self.core.peer.notify("lease_release", lease_id))
+        if agent_addr and agent_addr != "controller" and worker_id_hex:
+            async def _ret():
+                try:
+                    agent = await self._agent_peer(agent_addr)
+                    await agent.notify("lease_return", worker_id_hex, lease_id)
+                except Exception:  # noqa: BLE001 — agent gone with its node
+                    pass
+
+            asyncio.ensure_future(_ret())
+
+    async def _fail_worker_death(self, call: _NCall, worker_id_hex: str) -> None:
+        """Terminal worker death: ask the controller WHY the worker died
+        so an OOM kill surfaces as OutOfMemoryError, matching the legacy
+        path's taxonomy (reference: worker exit detail in GCS)."""
+        from ray_tpu.exceptions import OutOfMemoryError
+
+        reason = None
+        for _ in range(5):  # death processing may lag the conn loss
+            try:
+                reason = await self.core.peer.call("worker_death_info", worker_id_hex)
+            except Exception:  # noqa: BLE001 — controller gone too
+                break
+            if reason is not None:
+                break
+            await asyncio.sleep(0.1)
+        if reason == "oom":
+            exc: Exception = OutOfMemoryError(
+                f"task {call.spec.name} killed by the memory monitor"
+            )
+        else:
+            exc = WorkerCrashedError(
+                f"worker executing {call.spec.name} died (connection lost)"
+            )
+        self._fail(call, exc)
+
+    # -- completion --------------------------------------------------------
+    def _fail(self, call: _NCall, exc: Optional[Exception], serialized: Optional[bytes] = None) -> None:
+        fail_returns(self.core, call.spec, exc, serialized)
+        self._done(call)
+
+    def _done(self, call: _NCall) -> None:
+        call.pins = None
+        self.tasks.pop(call.spec.task_id, None)
+        for oid in call.spec.return_ids():
+            self.returns.pop(oid, None)
+
+    def _cancel(self, task_id) -> None:
+        entry = self.tasks.get(task_id)
+        if entry is None:
+            return
+        ks, call = entry
+        call.cancelled = True
+        try:
+            ks.queue.remove(call)
+        except ValueError:
+            pass
+        else:
+            self._fail(call, TaskCancelledError(task_id.hex()))
+            return
+        for lease in ks.leases:
+            if call in lease.inflight:
+                asyncio.ensure_future(lease.worker_peer.notify("cancel", task_id))
+                return
+        # still resolving deps — _resolve_then_queue observes the flag
+        self._fail(call, TaskCancelledError(task_id.hex()))

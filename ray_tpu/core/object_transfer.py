@@ -28,9 +28,16 @@ DEFAULT_WINDOW = 4
 
 
 async def fetch_into(src_peer, oid: ObjectID, size: int, view, chunk_bytes: int,
-                     window: int = DEFAULT_WINDOW) -> Optional[BaseException]:
+                     window: int = DEFAULT_WINDOW,
+                     progress=None) -> Optional[BaseException]:
     """Fill ``view`` (a writable memoryview of ``size`` bytes) with the
     object's content fetched from ``src_peer`` in pipelined chunks.
+
+    ``progress(watermark_bytes)``, if given, is called as the CONTIGUOUS
+    prefix of the object grows — the hook that lets a broadcast chain
+    forward bytes downstream while this node is still receiving
+    (reference: push_manager.h streams chunks through intermediate
+    nodes).
 
     Returns the first error (traceback stripped) instead of raising: by
     return time every chunk task has finished, and no frame anywhere
@@ -39,6 +46,17 @@ async def fetch_into(src_peer, oid: ObjectID, size: int, view, chunk_bytes: int,
     if size <= 0:
         return None
     sem = asyncio.Semaphore(max(1, window))
+    done_offsets: set = set()
+    watermark = 0
+
+    def _advance(off: int):
+        nonlocal watermark
+        done_offsets.add(off)
+        while watermark in done_offsets:
+            done_offsets.discard(watermark)
+            watermark += min(chunk_bytes, size - watermark)
+        if progress is not None:
+            progress(watermark)
 
     async def one(off: int):
         n = min(chunk_bytes, size - off)
@@ -49,6 +67,7 @@ async def fetch_into(src_peer, oid: ObjectID, size: int, view, chunk_bytes: int,
                 f"short chunk for {oid.hex()} at {off}: got {len(data)}, want {n}"
             )
         view[off : off + n] = data
+        _advance(off)
 
     results = await asyncio.gather(
         *(one(off) for off in range(0, size, chunk_bytes)),
@@ -59,6 +78,45 @@ async def fetch_into(src_peer, oid: ObjectID, size: int, view, chunk_bytes: int,
             # the traceback chain would pin frames that captured `view`
             return r.with_traceback(None)
     return None
+
+
+class InflightPull:
+    """An object mid-pull whose contiguous prefix is readable — lets a
+    broadcast chain hop serve chunks downstream while still receiving
+    from upstream (reference: push_manager.h chunk streaming through
+    intermediate nodes). Loop-thread only."""
+
+    __slots__ = ("view", "size", "watermark", "failed", "_waiters")
+
+    def __init__(self, view, size: int):
+        self.view = view
+        self.size = size
+        self.watermark = 0
+        self.failed = False
+        self._waiters: list = []
+
+    def advance(self, watermark: int):
+        self.watermark = watermark
+        if self._waiters:
+            for fut in self._waiters:
+                if not fut.done():
+                    fut.set_result(None)
+            self._waiters.clear()
+
+    def fail(self):
+        self.failed = True
+        self.advance(self.watermark)
+
+    async def wait_for(self, end: int):
+        while self.watermark < end and not self.failed:
+            fut = asyncio.get_running_loop().create_future()
+            self._waiters.append(fut)
+            await fut
+        if self.failed:
+            raise IOError("upstream pull failed mid-chain")
+
+    def read(self, offset: int, length: int) -> bytes:
+        return bytes(self.view[offset : offset + length])
 
 
 class ChunkReader:

@@ -127,12 +127,16 @@ class TaskSpec:
 
     def scheduling_class(self) -> Tuple:
         """Tasks with equal scheduling class share lease requests (reference:
-        normal_task_submitter.h:40 SchedulingKey)."""
+        normal_task_submitter.h:40 SchedulingKey). bundle_index matters:
+        PG tasks pinned to different bundles translate to different group
+        resources, so they must not share leases."""
         return (
             tuple(sorted(self.resources.items_fp())),
             self.scheduling_strategy.kind,
             self.scheduling_strategy.node_id,
+            self.scheduling_strategy.soft,
             str(self.scheduling_strategy.placement_group_id),
+            self.scheduling_strategy.bundle_index,
             self.func_digest,
         )
 
@@ -176,4 +180,42 @@ def unpack_actor_task(t: tuple) -> TaskSpec:
         actor_method_name=t[3],
         actor_seq_no=t[9],
         runtime_env=t[8],
+    )
+
+
+def pack_normal_task(spec: TaskSpec) -> tuple:
+    """Trimmed wire form for the direct normal-task push (reference:
+    PushTask carries a trimmed TaskSpec). Scheduling fields stay behind —
+    placement already happened at lease time; the executing worker only
+    needs identity + code + args. Resources travel so lineage
+    reconstruction (controller resubmit of shm results) can reschedule."""
+    return (
+        spec.task_id.binary(),
+        spec.name,
+        spec.func_digest,
+        spec.func_blob,
+        spec.args_blob,
+        spec.num_returns,
+        spec.runtime_env,
+        spec.owner_id.binary() if spec.owner_id else None,
+        [d.binary() for d in spec.dependencies],
+        tuple(spec.resources.items_fp()),
+        spec.max_retries,
+    )
+
+
+def unpack_normal_task(t: tuple) -> TaskSpec:
+    return TaskSpec(
+        task_id=TaskID(t[0]),
+        task_type=TaskType.NORMAL_TASK,
+        name=t[1],
+        func_digest=t[2],
+        func_blob=t[3],
+        args_blob=t[4],
+        dependencies=[ObjectID(d) for d in t[8]],
+        num_returns=t[5],
+        resources=ResourceSet(dict(t[9])) if t[9] else _EMPTY_RESOURCES,
+        owner_id=WorkerID(t[7]) if t[7] else None,
+        runtime_env=t[6],
+        max_retries=t[10],
     )

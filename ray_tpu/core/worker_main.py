@@ -26,7 +26,7 @@ from typing import Any, Dict, Optional
 
 from ray_tpu.core.client import CoreWorker
 from ray_tpu.core.object_ref import _RefMarker
-from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.core.task_spec import TaskSpec, TaskType
 from ray_tpu.exceptions import TaskError
 from ray_tpu.utils import rpc
 from ray_tpu.utils.ids import NodeID, TaskID, WorkerID
@@ -83,23 +83,46 @@ class WorkerHandler:
 
         spec = unpack_actor_task(packed)
         if self.executor is None:
-            return self._push_when_ready(spec, inline_deps)
+            return self._push_when_ready(spec, "actor_task", inline_deps)
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         self.executor.submit(spec, "actor_task", reply=(loop, fut), inline_deps=inline_deps)
         return fut
 
-    async def _push_when_ready(self, spec: TaskSpec, inline_deps):
+    def rpc_push_task(self, peer, packed: tuple, inline_deps=None):
+        """Direct lease-holder→worker push of a NORMAL task (reference:
+        NormalTaskSubmitter PushNormalTask → CoreWorkerService::PushTask);
+        results travel back in the reply to the caller's memory store."""
+        from ray_tpu.core.task_spec import unpack_normal_task
+
+        spec = unpack_normal_task(packed)
+        if self.executor is None:
+            return self._push_when_ready(spec, "task", inline_deps)
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self.executor.submit(spec, "task", reply=(loop, fut), inline_deps=inline_deps)
+        return fut
+
+    async def _push_when_ready(self, spec: TaskSpec, kind: str, inline_deps):
         while self.executor is None:  # registration race (first push only)
             await asyncio.sleep(0.002)
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        self.executor.submit(spec, "actor_task", reply=(loop, fut), inline_deps=inline_deps)
+        self.executor.submit(spec, kind, reply=(loop, fut), inline_deps=inline_deps)
         return fut
 
     def rpc_cancel(self, peer, task_id: TaskID):
         if self.executor is not None:
             self.executor.cancelled.add(task_id)
+
+    def rpc_current_task(self, peer):
+        """What this worker is executing right now — queried by the
+        controller's OOM victim policies for direct-push tasks it never
+        dispatched (reference: the raylet knows its leased workers'
+        tasks; here the worker itself is the source of truth)."""
+        if self.executor is None:
+            return None
+        return self.executor.current_task_info
 
     def rpc_exit(self, peer):
         os._exit(0)
@@ -135,6 +158,7 @@ class TaskExecutor:
         self.actor_pool: Optional[ThreadPoolExecutor] = None
         self.actor_instance: Any = None
         self.cancelled: set = set()
+        self.current_task_info: Optional[dict] = None  # read by rpc_current_task
         self._func_cache: Dict[bytes, Any] = {}
         self._reply_handoff = None  # created lazily (needs the loop)
         # Direct-push tasks bypass the controller, so their observability
@@ -204,7 +228,11 @@ class TaskExecutor:
                         return deserialize(data)
                 value, is_error = self.core.get_raw(v.oid)
                 if is_error:
-                    raise value
+                    # dependency failures propagate AS the original error
+                    # (ObjectLostError, the producer's exception, …) — not
+                    # wrapped in this task's TaskError (reference: dep
+                    # errors pass through ray.get unchanged)
+                    raise _DepError(value)
                 return value
             return v
 
@@ -225,6 +253,30 @@ class TaskExecutor:
         runtime_context._set_task(
             spec.task_id.hex(), spec.actor_id.hex() if spec.actor_id else None
         )
+        if reply is not None:
+            # Direct pushes bypass the controller, so the worker emits the
+            # RUNNING half of the task's timeline span itself (FINISHED
+            # comes from _report_direct); the event flush batches both.
+            self._events.append(
+                {
+                    "ts": time.time(),
+                    "kind": "task",
+                    "type": spec.task_type.name,
+                    "task_id": spec.task_id.hex(),
+                    "name": spec.name,
+                    "state": "RUNNING",
+                }
+            )
+        if kind == "task" and reply is not None:
+            # direct-push normal task: controller doesn't track it, so the
+            # worker itself answers OOM-victim queries (rpc_current_task)
+            self.current_task_info = {
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "owner": spec.owner_id.hex() if spec.owner_id else "",
+                "retriable": spec.max_retries > 0,
+                "start": time.time(),
+            }
         trace_span_cm = None
         try:
             if spec.runtime_env:
@@ -272,6 +324,11 @@ class TaskExecutor:
                 self._report_direct(spec, result, None, reply)
             else:
                 self._report(spec, result, None)
+        except _DepError as e:
+            if reply is not None:
+                self._report_direct(spec, None, e.inner, reply)
+            else:
+                self._report(spec, None, e.inner)
         except Exception as e:  # noqa: BLE001 — user errors cross the wire
             tb = traceback.format_exc()
             err = e if isinstance(e, TaskError) else TaskError(spec.name, tb, None)
@@ -280,6 +337,7 @@ class TaskExecutor:
             else:
                 self._report(spec, None, err)
         finally:
+            self.current_task_info = None
             if trace_span_cm is not None:
                 from ray_tpu.util import tracing as _tracing
 
@@ -338,11 +396,20 @@ class TaskExecutor:
             {
                 "ts": time.time(),
                 "kind": "task",
+                "type": spec.task_type.name,
                 "task_id": spec.task_id.hex(),
                 "name": spec.name,
                 "state": "FINISHED" if error is None else "FAILED",
             }
         )
+        if (
+            spec.task_type == TaskType.NORMAL_TASK
+            and any(r[1] == "shm" for r in results)
+        ):
+            # shm results are reconstructible — give the controller the
+            # lineage the legacy path would have recorded (reference:
+            # owner-side TaskManager lineage feeding ObjectRecoveryManager)
+            self.core._submit("task_lineage", spec)
         self._reply(reply, (results, error))
 
     def _report(self, spec: TaskSpec, result, error):
@@ -425,6 +492,13 @@ class TaskExecutor:
             os._exit(1)
 
 
+class _DepError(Exception):
+    """Carrier for a failed dependency's ORIGINAL error."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+
 def _resolve_reply(item):
     fut, payload = item
     if not fut.done():
@@ -471,6 +545,21 @@ def main():
     runtime_context._set_process(node_id.hex(), worker_id.hex())
     api._attach_worker(core)
     handler.attach_executor(TaskExecutor(core))
+    agent_addr = os.environ.get("RAY_TPU_AGENT_ADDR", "")
+    if agent_addr:
+        # Direct-pool worker spawned by a node agent: announce to the
+        # agent's free-worker view (reference: worker registration with
+        # its raylet). The connection stays open; the agent uses it to
+        # retire the worker and to observe its death.
+        async def _attach():
+            host, port = agent_addr.rsplit(":", 1)
+            peer = await rpc.connect(host, int(port), handler)
+            await peer.notify(
+                "worker_attach", worker_id.hex(), f"{host_ip()}:{listen_port}"
+            )
+            handler._agent_peer = peer  # keep alive
+
+        loop_runner.run(_attach())
 
     threading.Event().wait()  # serve forever; exit via rpc_exit / disconnect
 

@@ -1,0 +1,73 @@
+"""1→N broadcast over the pipelined agent chain (reference:
+src/ray/object_manager/push_manager.h; release/benchmarks README
+'1 GiB object broadcast to 50 nodes').
+"""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster_utils import Cluster
+
+
+def test_broadcast_chain_delivers_to_all_nodes():
+    cluster = Cluster(head_resources={"CPU": 1})
+    for _ in range(3):
+        cluster.add_node(num_cpus=1)
+    cluster.connect()
+    try:
+        data = np.arange(8 * 1024 * 1024, dtype=np.uint8)  # 8 MiB
+        ref = ray_tpu.put(data)
+        core = ray_tpu.core.api._require_worker()
+        ok = core._call("object_broadcast", ref.id, None, timeout=120)
+        assert ok is True
+        # every ALIVE node (head put + 3 agents) now holds a replica
+        rows = {o["object_id"]: o for o in core.list_state("objects")}
+        locs = rows[ref.id.hex()]["locations"]
+        assert len(locs) == 4, locs
+
+        # consumers on any node read locally (no cross-node pull needed)
+        @ray_tpu.remote(num_cpus=1)
+        def head_tail(x):
+            return int(x[0]), int(x[-1])
+
+        outs = ray_tpu.get([head_tail.remote(ref) for _ in range(6)], timeout=120)
+        assert all(o == (0, 255) for o in outs)
+    finally:
+        cluster.shutdown()
+
+
+def test_broadcast_subset_and_idempotent():
+    cluster = Cluster(head_resources={"CPU": 1})
+    cluster.add_node(num_cpus=1)
+    cluster.add_node(num_cpus=1)
+    cluster.connect()
+    try:
+        ref = ray_tpu.put(np.ones(2 * 1024 * 1024, dtype=np.uint8))
+        core = ray_tpu.core.api._require_worker()
+        nodes = [
+            n["node_id"] for n in ray_tpu.nodes()
+            if n["state"] == "ALIVE" and not n["is_head"]
+        ]
+        assert core._call("object_broadcast", ref.id, [nodes[0]], timeout=60)
+        rows = {o["object_id"]: o for o in core.list_state("objects")}
+        assert len(rows[ref.id.hex()]["locations"]) == 2
+        # idempotent: already-holding nodes are skipped
+        assert core._call("object_broadcast", ref.id, [nodes[0]], timeout=60)
+        # full fan-out picks up the remaining node
+        assert core._call("object_broadcast", ref.id, None, timeout=60)
+        rows = {o["object_id"]: o for o in core.list_state("objects")}
+        assert len(rows[ref.id.hex()]["locations"]) == 3
+    finally:
+        cluster.shutdown()
+
+
+def test_broadcast_inline_object_rejected():
+    ray_tpu.init(num_cpus=1)
+    try:
+        ref = ray_tpu.put(b"small")  # inline — nothing to broadcast
+        core = ray_tpu.core.api._require_worker()
+        assert core._call("object_broadcast", ref.id, None) is False
+    finally:
+        ray_tpu.shutdown()

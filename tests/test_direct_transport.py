@@ -60,7 +60,11 @@ def test_chained_local_dep_inlined(ray_start_regular):
     assert core.memory_store.is_local_only(r1.id.binary())
 
 
-def test_promotion_on_escape_to_normal_task(ray_start_regular):
+def test_inline_dep_to_normal_task_stays_local(ray_start_regular):
+    """A direct actor result consumed by a direct NORMAL task travels
+    inline with the push (reference: LocalDependencyResolver) — it never
+    needs the controller directory, so it stays owner-local (the whole
+    point of the lease path: zero controller traffic per task)."""
     c = Counter.remote()
     r1 = c.inc.remote(7)
 
@@ -70,7 +74,24 @@ def test_promotion_on_escape_to_normal_task(ray_start_regular):
 
     assert ray_tpu.get(plus_one.remote(r1)) == 8
     core = ray_tpu.core.api._require_worker()
-    # escaped → promoted to the controller directory
+    assert core.memory_store.lookup(r1.id.binary()) is not None
+
+
+def test_promotion_on_escape_to_streaming_task(ray_start_regular):
+    """Controller-routed submissions (streaming generators) still force
+    promotion of owner-local deps — the worker resolves them through the
+    controller directory."""
+    c = Counter.remote()
+    r1 = c.inc.remote(7)
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(x):
+        yield x + 1
+
+    (item,) = list(gen.remote(r1))
+    assert ray_tpu.get(item) == 8
+    core = ray_tpu.core.api._require_worker()
+    # escaped through the controller path → promoted
     assert not core.memory_store.is_local_only(r1.id.binary())
 
 

@@ -19,6 +19,18 @@ def _http_get(url: str) -> bytes:
         return r.read()
 
 
+def _wait_until(cond, timeout=5.0, interval=0.1):
+    """The task-state view is EVENTUALLY consistent for direct-push tasks
+    (worker event batches flush on a short period — reference: GCS task
+    events are buffered the same way)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
 def test_list_state(ray_start_regular):
     @ray_tpu.remote
     def f(x):
@@ -36,14 +48,16 @@ def test_list_state(ray_start_regular):
     assert len(nodes) == 1 and nodes[0]["is_head"]
     workers = state_api.list_workers()
     assert len(workers) >= 1
-    tasks = state_api.list_tasks()
-    assert sum(1 for t in tasks if t["name"] == "f") == 3
+    assert _wait_until(
+        lambda: sum(1 for t in state_api.list_tasks() if t["name"] == "f") == 3
+    )
     actors = state_api.list_actors()
     assert len(actors) == 1 and actors[0]["state"] == "ALIVE"
     assert state_api.get_actor(actors[0]["actor_id"])["actor_id"] == actors[0]["actor_id"]
 
-    summary = state_api.summarize_tasks()
-    assert summary["f"]["FINISHED"] == 3
+    assert _wait_until(
+        lambda: state_api.summarize_tasks().get("f", {}).get("FINISHED") == 3
+    )
     assert state_api.summarize_actors()["ALIVE"] == 1
     objs = state_api.summarize_objects()
     assert objs["total"] >= 1
@@ -107,8 +121,12 @@ def test_http_gateway(ray_start_regular):
     ray_tpu.get(f.remote())
     nodes = json.loads(_http_get(url + "/api/v0/nodes"))
     assert nodes[0]["is_head"]
-    tasks = json.loads(_http_get(url + "/api/v0/tasks"))
-    assert any(t["name"] == "f" for t in tasks)
+    assert _wait_until(
+        lambda: any(
+            t["name"] == "f"
+            for t in json.loads(_http_get(url + "/api/v0/tasks"))
+        )
+    )
 
     Counter("gw_metric_total").inc(2)
     flush()
@@ -143,6 +161,11 @@ def test_timeline_chrome(ray_start_regular, tmp_path):
 
     ray_tpu.get([slow.remote() for _ in range(3)])
     out = tmp_path / "trace.json"
+    assert _wait_until(
+        lambda: len(
+            [t for t in state_api.timeline_chrome() if t["name"] == "slow"]
+        ) == 3
+    )
     trace = state_api.timeline_chrome(str(out))
     spans = [t for t in trace if t["name"] == "slow"]
     assert len(spans) == 3
