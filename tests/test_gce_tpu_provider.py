@@ -1,0 +1,112 @@
+"""GCE/GKE TPU pod-slice provider (reference:
+python/ray/autoscaler/_private/gcp/node_provider.py TPU path).
+
+The fake GCE API boots one REAL node agent per slice host, so these
+tests drive the v2 InstanceManager FSM against genuinely-joining nodes:
+QUEUED → REQUESTED → ALLOCATED → RAY_RUNNING for a 2-host v5e-16 slice,
+gang semantics (all hosts appear/die together), and drain termination.
+"""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler.gce_tpu_provider import (
+    FakeGceTpuApi,
+    GceTpuNodeProvider,
+    _slice_shape,
+)
+from ray_tpu.autoscaler.v2 import InstanceManager, InstanceStatus
+from ray_tpu.core.cluster_utils import Cluster
+
+NODE_TYPES = {
+    "tpu_v5e_16": {
+        "resources": {"CPU": 2},
+        "accelerator_type": "v5e-16",
+        "min_workers": 0,
+        "max_workers": 2,
+    }
+}
+
+
+def test_slice_shape():
+    assert _slice_shape("v5e-16") == (2, 8)  # 2 hosts x 8 chips
+    assert _slice_shape("v5e-8") == (1, 8)
+    assert _slice_shape("v4-16") == (4, 4)  # 16 chips = 4 hosts x 4
+
+
+def _alive_workers():
+    return [n for n in ray_tpu.nodes() if n["state"] == "ALIVE" and not n["is_head"]]
+
+
+def test_slice_fsm_to_running_and_drain():
+    cluster = Cluster(head_resources={"CPU": 1})
+    try:
+        cluster.connect()
+        api = FakeGceTpuApi(cluster.address, cluster._session_dir)
+        provider = GceTpuNodeProvider(api, node_types=NODE_TYPES)
+        im = InstanceManager(provider, NODE_TYPES)
+
+        (iid,) = im.queue_instances("tpu_v5e_16", 1)
+        im.reconcile(cluster_alive_count=1)
+        assert im.instances()[0].status == InstanceStatus.REQUESTED
+
+        # both hosts of the slice must register (gang create)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and len(_alive_workers()) < 2:
+            time.sleep(0.5)
+        workers = _alive_workers()
+        assert len(workers) == 2, workers
+        totals = [w["resources"]["total"] for w in workers]
+        assert all(t.get("TPU") == 8 for t in totals)
+        assert all(t.get("TPU-v5e-16") == 1 for t in totals)
+        heads = [t for t in totals if t.get("TPU-v5e-16-head")]
+        assert len(heads) == 1  # exactly one gang-scheduling head resource
+
+        im.reconcile(cluster_alive_count=3)
+        assert im.instances()[0].status == InstanceStatus.ALLOCATED
+        im.reconcile(cluster_alive_count=3)
+        assert im.instances()[0].status == InstanceStatus.RAY_RUNNING
+
+        # drain: terminate takes the WHOLE slice down
+        im.request_terminate(iid)
+        im.reconcile(cluster_alive_count=3)
+        assert im.instances(None)[0].status == InstanceStatus.TERMINATED
+        assert provider.non_terminated_nodes() == []
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and _alive_workers():
+            time.sleep(0.5)
+        assert not _alive_workers(), "slice hosts survived termination"
+    finally:
+        cluster.shutdown()
+
+
+def test_slice_gang_preemption():
+    """One host dying marks the SLICE preempted; the ledger observes the
+    provider-side disappearance and terminates the instance."""
+    cluster = Cluster(head_resources={"CPU": 1})
+    try:
+        cluster.connect()
+        api = FakeGceTpuApi(cluster.address, cluster._session_dir)
+        provider = GceTpuNodeProvider(api, node_types=NODE_TYPES)
+        im = InstanceManager(provider, NODE_TYPES)
+
+        im.queue_instances("tpu_v5e_16", 1)
+        im.reconcile(1)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and len(_alive_workers()) < 2:
+            time.sleep(0.5)
+        im.reconcile(3)
+        im.reconcile(3)
+        assert im.instances()[0].status == InstanceStatus.RAY_RUNNING
+
+        slice_name = provider.non_terminated_nodes()[0]
+        api.preempt(slice_name)
+        time.sleep(1.0)
+        # gang failure: any host down → slice no longer non-terminated
+        assert provider.non_terminated_nodes() == []
+        im.reconcile(1)
+        assert im.instances(None)[0].status == InstanceStatus.TERMINATED
+        api.delete_node(slice_name)  # reap the dead procs
+    finally:
+        cluster.shutdown()
