@@ -68,12 +68,20 @@ def pipeline_apply(
     num_stages: int,
     num_microbatches: int,
     axis_name: str = "pp",
+    seq_axis: str = None,
 ):
     """Run h [b, s, d] through the pipelined decoder stack.
 
     stage_fn(params_one_stage, x, positions) -> x, where params_one_stage
     has leading dim layers_per_stage. ``stacked_stage_params`` has leading
     dims [num_stages, layers_per_stage] with the stage axis sharded over pp.
+
+    ``seq_axis``: when sequence parallelism composes with pp, the pipeline
+    shard_map goes manual over BOTH axes (nested partial-manual shard_maps
+    don't lower) — the sequence dim arrives pre-sharded and the stage_fn's
+    attention must be the RAW per-shard collective body
+    (ring_attention_local / ulysses_attention_local), whose ppermute/
+    all_to_all run directly in this manual context.
     """
     b = h.shape[0]
     assert b % num_microbatches == 0, (b, num_microbatches)
@@ -81,8 +89,12 @@ def pipeline_apply(
     h_mb = h.reshape(num_microbatches, mb, *h.shape[1:])
     pos_mb = positions[:mb]
 
-    # Manual only over pp; all other axes stay automatic so GSPMD keeps
-    # inserting fsdp/tp/sp collectives inside the stage body.
+    # Manual only over pp (+ seq_axis when composing with sp); remaining
+    # axes stay automatic so GSPMD keeps inserting fsdp/tp collectives
+    # inside the stage body.
+    h_spec = P(None, None, seq_axis, None) if seq_axis else P()
+    pos_spec = P(None, seq_axis) if seq_axis else P()
+    manual = {axis_name} | ({seq_axis} if seq_axis else set())
     body = jax.shard_map(
         functools.partial(
             _pipeline_body,
@@ -94,11 +106,11 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=(
             jax.tree.map(lambda _: P(axis_name), stacked_stage_params),
-            P(),
-            P(),
+            h_spec,
+            pos_spec,
         ),
-        out_specs=P(),
-        axis_names={axis_name},
+        out_specs=h_spec,
+        axis_names=manual,
         check_vma=False,
     )
     out = body(stacked_stage_params, h_mb, pos_mb)

@@ -95,16 +95,26 @@ def ring_attention_local(q, k, v, axis_name: str, scale: Optional[float] = None)
 def make_ring_attn_fn(mesh: Mesh, axis_name: str = "sp"):
     """An attn_fn for models.transformer: [b,h,s,d] global → ring attention
     over the ``axis_name`` shards. Must run inside a jit whose inputs are
-    sharded over this mesh."""
-    fn = jax.shard_map(
-        functools.partial(ring_attention_local, axis_name=axis_name),
-        mesh=mesh,
-        in_specs=(
-            P(("dp", "fsdp"), "tp", axis_name, None),
-            P(("dp", "fsdp"), "tp", axis_name, None),
-            P(("dp", "fsdp"), "tp", axis_name, None),
-        ),
-        out_specs=P(("dp", "fsdp"), "tp", axis_name, None),
-        check_vma=False,
-    )
-    return fn
+    sharded over this mesh.
+
+    Nestable under another shard_map (the pp pipeline body): at trace
+    time the AMBIENT abstract mesh — whose already-manual axes (pp) are
+    marked as such — is used instead of the concrete construction-time
+    mesh, and only the axes this collective touches are manualized."""
+    spec = P(("dp", "fsdp"), "tp", axis_name, None)
+    body = functools.partial(ring_attention_local, axis_name=axis_name)
+
+    def attn(q, k, v):
+        cur = jax.sharding.get_abstract_mesh()
+        use = cur if (cur is not None and cur.shape) else mesh
+        fn = jax.shard_map(
+            body,
+            mesh=use,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            axis_names={"dp", "fsdp", "tp", axis_name},
+            check_vma=False,
+        )
+        return fn(q, k, v)
+
+    return attn

@@ -35,10 +35,18 @@ def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1, warmup: int = 10
 def build_loss_fn(cfg: tf.TransformerConfig, plan: MeshPlan, mesh: Mesh, num_microbatches: int = 4):
     """Loss with the plan's parallelism baked in (ring or Ulysses
     attention for sp>1 per ``plan.sp_mode``, GPipe for pp>1)."""
+    # sp dispatch: (shard_map wrapper for GSPMD-auto contexts, raw local
+    # collective body for manual contexts like the pp pipeline)
+    from ray_tpu.parallel.ring import ring_attention_local
+    from ray_tpu.parallel.ulysses import ulysses_attention_local
+
+    SP_MODES = {
+        "ring": (make_ring_attn_fn, ring_attention_local),
+        "ulysses": (make_ulysses_attn_fn, ulysses_attention_local),
+    }
     attn_fn = None
     if plan.sp > 1:
-        make = {"ring": make_ring_attn_fn, "ulysses": make_ulysses_attn_fn}[plan.sp_mode]
-        attn_fn = make(mesh)
+        attn_fn = SP_MODES[plan.sp_mode][0](mesh)
 
     if plan.pp == 1:
         def loss(params, batch):
@@ -49,9 +57,18 @@ def build_loss_fn(cfg: tf.TransformerConfig, plan: MeshPlan, mesh: Mesh, num_mic
     S = plan.pp
     assert cfg.n_layers % S == 0, (cfg.n_layers, S)
 
+    # pp × sp composition: the pipeline shard_map is manual over BOTH
+    # axes, so the attention must be the raw per-shard collective body
+    # (nested partial-manual shard_maps don't lower — see pipeline_apply).
+    seq_axis = None
+    stage_attn_fn = attn_fn
+    if plan.sp > 1:
+        stage_attn_fn = functools.partial(SP_MODES[plan.sp_mode][1], axis_name="sp")
+        seq_axis = "sp"
+
     def stage_fn(stage_params, x, positions):
         def layer_fn(carry, lp):
-            out = tf.decoder_layer(carry, lp, cfg, positions, attn_fn)
+            out = tf.decoder_layer(carry, lp, cfg, positions, stage_attn_fn)
             return out, None
 
         if cfg.remat:
@@ -66,7 +83,10 @@ def build_loss_fn(cfg: tf.TransformerConfig, plan: MeshPlan, mesh: Mesh, num_mic
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
         h = tf.embed(params, inputs, cfg)
         staged = split_stages(params["layers"], S)
-        h = pipeline_apply(stage_fn, staged, h, positions, mesh, S, num_microbatches)
+        h = pipeline_apply(
+            stage_fn, staged, h, positions, mesh, S, num_microbatches,
+            seq_axis=seq_axis,
+        )
         logits = tf.unembed(params, h, cfg)
         mask = batch.get("mask")
         return tf.token_nll(logits, targets, mask[:, 1:] if mask is not None else None)

@@ -59,15 +59,21 @@ def make_ulysses_attn_fn(mesh: Mesh, axis_name: str = "sp"):
     attention over the ``axis_name`` shards. Must run inside a jit whose
     inputs are sharded over this mesh. Same signature/specs as
     ring.make_ring_attn_fn so the two are drop-in alternatives."""
-    fn = jax.shard_map(
-        functools.partial(ulysses_attention_local, axis_name=axis_name),
-        mesh=mesh,
-        in_specs=(
-            P(("dp", "fsdp"), "tp", axis_name, None),
-            P(("dp", "fsdp"), "tp", axis_name, None),
-            P(("dp", "fsdp"), "tp", axis_name, None),
-        ),
-        out_specs=P(("dp", "fsdp"), "tp", axis_name, None),
-        check_vma=False,
-    )
-    return fn
+    spec = P(("dp", "fsdp"), "tp", axis_name, None)
+    body = functools.partial(ulysses_attention_local, axis_name=axis_name)
+
+    def attn(q, k, v):
+        # nestable under a pp shard_map — see ring.make_ring_attn_fn
+        cur = jax.sharding.get_abstract_mesh()
+        use = cur if (cur is not None and cur.shape) else mesh
+        fn = jax.shard_map(
+            body,
+            mesh=use,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            axis_names={"dp", "fsdp", "tp", axis_name},
+            check_vma=False,
+        )
+        return fn(q, k, v)
+
+    return attn

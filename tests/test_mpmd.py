@@ -1,0 +1,106 @@
+"""MPMD pipeline: per-stage jit programs over disjoint device sets
+(reference: dag/dag_node_operation.py op-graph scheduling +
+torch_tensor_nccl_channel.py device channels; SURVEY §7 'PP/MPMD on
+TPU'). The VERDICT 'done when': 2 stages × 2 microbatches matching the
+in-graph GPipe loss bit-for-bit on the CPU dryrun.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import transformer as tf
+from ray_tpu.parallel import MeshPlan, build_mesh
+from ray_tpu.parallel.mpmd import MpmdPipeline, mpmd_train_step_fns
+from ray_tpu.parallel.train_step import build_loss_fn
+
+CFG = tf.TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    n_layers=4,
+    n_heads=4,
+    n_kv_heads=4,
+    d_ff=64,
+    max_seq_len=32,
+    dtype=jnp.float32,
+    remat=False,
+)
+
+
+def _params_and_batch(batch=4, seq=16):
+    params = tf.init_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0, CFG.vocab_size)
+    return params, {"tokens": tokens}
+
+
+def test_mpmd_loss_matches_ingraph_gpipe_bitwise():
+    params, batch = _params_and_batch()
+
+    # in-graph GPipe: pp=2 over 2 devices, 2 microbatches
+    plan = MeshPlan(pp=2)
+    mesh = build_mesh(plan, devices=jax.devices()[:2])
+    ingraph_loss = jax.jit(build_loss_fn(CFG, plan, mesh, num_microbatches=2))
+    expected = ingraph_loss(params, batch)
+
+    # MPMD: 2 stages × 2 devices each, same microbatching
+    pipe = MpmdPipeline(CFG, num_stages=2, devices=jax.devices()[:4])
+    split = pipe.split_params(params)
+    loss, _grads = pipe.loss_and_grads(split, batch, num_microbatches=2)
+
+    assert float(loss) == float(expected), (
+        f"MPMD loss {float(loss)!r} != in-graph GPipe loss {float(expected)!r}"
+    )
+
+
+def test_mpmd_grads_match_single_program():
+    """Gradient check: MPMD grads equal the single-program autodiff
+    grads (allclose — accumulation order differs across microbatches)."""
+    params, batch = _params_and_batch()
+
+    def ref_loss(p):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = tf.forward(p, inputs, CFG)
+        return tf.token_nll(logits, targets)
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(params)
+
+    pipe = MpmdPipeline(CFG, num_stages=2, devices=jax.devices()[:2])
+    split = pipe.split_params(params)
+    loss, (g_embed, g_stage, g_head) = pipe.loss_and_grads(split, batch, num_microbatches=2)
+
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(g_embed["embed"]), np.asarray(ref_g["embed"]), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(g_head["lm_head"]), np.asarray(ref_g["lm_head"]), rtol=1e-5, atol=1e-6
+    )
+    # layer grads: reassemble stage slices and compare one leaf
+    wq = np.concatenate([np.asarray(g["wq"]) for g in g_stage], axis=0)
+    np.testing.assert_allclose(wq, np.asarray(ref_g["layers"]["wq"]), rtol=1e-5, atol=1e-6)
+
+
+def test_mpmd_full_train_step_loss_decreases():
+    params, batch = _params_and_batch()
+    pipe, init_fn, step_fn = mpmd_train_step_fns(
+        CFG, num_stages=2, devices=jax.devices()[:4], num_microbatches=2
+    )
+    split, opt_states = init_fn(params)
+    losses = []
+    for _ in range(5):
+        split, opt_states, loss = step_fn(split, opt_states, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_mpmd_per_microbatch_mode_close():
+    """True-1F1B per-microbatch head: same math, different FP order."""
+    params, batch = _params_and_batch()
+    pipe = MpmdPipeline(CFG, num_stages=2, devices=jax.devices()[:2])
+    split = pipe.split_params(params)
+    l_full, _ = pipe.loss_and_grads(split, batch, num_microbatches=2)
+    l_mb, _ = pipe.loss_and_grads(
+        split, batch, num_microbatches=2, loss_mode="per_microbatch"
+    )
+    np.testing.assert_allclose(float(l_mb), float(l_full), rtol=1e-6)
