@@ -162,14 +162,21 @@ def attention_block(
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = rms_norm(x, lp["attn_norm"])
     q, k, v = project_qkv(h, lp, cfg, positions)
-    kr, vr = k, v
-    if KV != H:
-        rep = H // KV
-        kr = jnp.repeat(k, rep, axis=2)
-        vr = jnp.repeat(v, rep, axis=2)
-    qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, kr, vr))  # [b,h,s,hd]
-    fn = attn_fn or (lambda q, k, v: flash_attention(q, k, v, True, None))
-    o = fn(qt, kt, vt)
+    if attn_fn is None:
+        # flash_attention is GQA-NATIVE: the kernel indexes the shared kv
+        # head per q-head group — no repeated K/V in HBM (ops/attention.py)
+        qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        o = flash_attention(qt, kt, vt, True, None)
+    else:
+        # custom attention (ring/Ulysses SP) still takes equal head
+        # counts — repeat kv heads for those paths
+        kr, vr = k, v
+        if KV != H:
+            rep = H // KV
+            kr = jnp.repeat(k, rep, axis=2)
+            vr = jnp.repeat(v, rep, axis=2)
+        qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, kr, vr))
+        o = attn_fn(qt, kt, vt)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, H * HD)
     out = x + o @ lp["wo"].astype(o.dtype)
     if return_kv:

@@ -11,8 +11,12 @@ libraries); on TPU the kernel must be native (SURVEY.md §2.9). Design:
 - ``reference_attention``: straight jnp implementation used for CPU tests,
   as the non-TPU VJP path, and as the numerical oracle.
 
-Layouts: q, k, v are [batch, heads, seq, head_dim]; GQA is handled by the
-caller (kv heads repeated before the call or via q head grouping).
+Layouts: q is [batch, q_heads, seq, head_dim]; k/v are
+[batch, kv_heads, seq, head_dim] with q_heads % kv_heads == 0 — GQA is
+NATIVE: the kernels index the shared kv head per q-head group instead of
+the caller repeating K/V, so a Mistral-style 8-kv-head config reads each
+K/V head once from HBM (and never materializes the repeated tensors the
+old caller-side repeat cost both HBM and VJP traffic for).
 """
 from __future__ import annotations
 
@@ -29,6 +33,10 @@ DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 def reference_attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
     *_, q_len, head_dim = q.shape
+    if k.shape[1] != q.shape[1]:  # GQA: expand kv heads for the oracle
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     k_len = k.shape[-2]
     scale = scale if scale is not None else head_dim**-0.5
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
@@ -128,20 +136,34 @@ def _flash_fwd_kernel(
     lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
+def _kv_index_map(q_heads: int, kv_heads: int):
+    """Program id over batch·q_heads → the [batch·kv_heads] row holding
+    that q head's shared K/V (the GQA mapping; identity when MHA)."""
+    group = q_heads // kv_heads
+
+    def imap(b, i):
+        return ((b // q_heads) * kv_heads + (b % q_heads) // group, 0, 0)
+
+    return imap
+
+
 def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int, block_k: int, interpret: bool):
     batch, heads, q_len, head_dim = q.shape
+    kv_heads = k.shape[1]
+    assert heads % kv_heads == 0, (heads, kv_heads)
     k_len = k.shape[2]
     bq = min(block_q, q_len)
     bk = min(block_k, k_len)
     qr = q.reshape(batch * heads, q_len, head_dim)
-    kr = k.reshape(batch * heads, k_len, head_dim)
-    vr = v.reshape(batch * heads, k_len, head_dim)
+    kr = k.reshape(batch * kv_heads, k_len, head_dim)
+    vr = v.reshape(batch * kv_heads, k_len, head_dim)
     # Pad K/V so every k-block slice is in bounds (see kernel docstring).
     k_pad = (-k_len) % bk
     if k_pad:
         kr = jnp.pad(kr, ((0, 0), (0, k_pad), (0, 0)))
         vr = jnp.pad(vr, ((0, 0), (0, k_pad), (0, 0)))
     k_len_padded = k_len + k_pad
+    kv_map = _kv_index_map(heads, kv_heads)
     grid = (batch * heads, pl.cdiv(q_len, bq))
     out, lse = pl.pallas_call(
         functools.partial(
@@ -150,8 +172,8 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int, block_k: i
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, k_len_padded, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, k_len_padded, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, k_len_padded, head_dim), kv_map),
+            pl.BlockSpec((1, k_len_padded, head_dim), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0)),
@@ -239,9 +261,16 @@ def _flash_bwd_dq_kernel(
 
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
-    block_q: int, causal: bool, scale: float
+    block_q: int, causal: bool, scale: float, grouped: bool = False
 ):
-    """One (batch·head, k-block) program: dK/dV accumulated over q blocks.
+    """One (batch·kv_head, k-block[, group]) program: dK/dV accumulated
+    over q blocks.
+
+    GQA (``grouped``): a third, innermost grid dim walks the kv head's
+    group of q heads; each program sees ONE q head's (padded) rows — the
+    same VMEM footprint as MHA — and accumulates into the shared
+    (batch·kv_head, k-block) output block, which stays resident across
+    the group steps (output index map constant along the group dim).
 
     Padded q rows (q/do/delta zero-padded, lse zero) contribute nothing:
     dO = 0 kills the dV term and dP − Δ = 0 kills the dK term.
@@ -249,7 +278,7 @@ def _flash_bwd_dkv_kernel(
     k = k_ref[0].astype(jnp.float32)  # [block_k, d]
     v = v_ref[0].astype(jnp.float32)
     block_k, head_dim = k.shape
-    q_len = q_ref.shape[1]  # padded, multiple of block_q
+    q_len = q_ref.shape[1]  # one q head's rows, padded to block_q multiple
     k_start = pl.program_id(1) * block_k
     num_q_blocks = q_len // block_q
     # Causal: q blocks strictly before this k block see none of it.
@@ -300,21 +329,36 @@ def _flash_bwd_dkv_kernel(
         dk, dv = jax.lax.fori_loop(first_full, num_q_blocks, make_body(False), carry)
     else:
         dk, dv = jax.lax.fori_loop(start_qb, num_q_blocks, make_body(True), init)
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk = dk * scale
+    if grouped:
+        # fp32 outputs accumulate across the group grid dim
+        @pl.when(pl.program_id(2) == 0)
+        def _init():
+            dk_ref[0] = dk.astype(dk_ref.dtype)
+            dv_ref[0] = dv.astype(dv_ref.dtype)
+
+        @pl.when(pl.program_id(2) != 0)
+        def _acc():
+            dk_ref[0] += dk.astype(dk_ref.dtype)
+            dv_ref[0] += dv.astype(dv_ref.dtype)
+    else:
+        dk_ref[0] = dk.astype(dk_ref.dtype)
+        dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
                     block_q: int, block_k: int, interpret: bool):
     batch, heads, q_len, head_dim = q.shape
+    kv_heads = k.shape[1]
+    group = heads // kv_heads
     k_len = k.shape[2]
     bq = min(block_q, q_len)
     bk = min(block_k, k_len)
     bh = batch * heads
 
     qr = q.reshape(bh, q_len, head_dim)
-    kr = k.reshape(bh, k_len, head_dim)
-    vr = v.reshape(bh, k_len, head_dim)
+    kr = k.reshape(batch * kv_heads, k_len, head_dim)
+    vr = v.reshape(batch * kv_heads, k_len, head_dim)
     dor = do.reshape(bh, q_len, head_dim)
     lser = lse.reshape(bh, 1, q_len)
     # Δ = rowsum(dO ∘ O): one fused elementwise+reduce, cheap in XLA.
@@ -328,8 +372,9 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
         kr = jnp.pad(kr, ((0, 0), (0, k_pad), (0, 0)))
         vr = jnp.pad(vr, ((0, 0), (0, k_pad), (0, 0)))
     k_len_p = k_len + k_pad
+    kv_map = _kv_index_map(heads, kv_heads)
 
-    # dQ: grid over q blocks, K/V resident.
+    # dQ: grid over q blocks, K/V resident (GQA: shared kv head indexed).
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, block_k=bk, causal=causal, scale=scale,
@@ -338,8 +383,8 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
         grid=(bh, pl.cdiv(q_len, bq)),
         in_specs=[
             pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, k_len_p, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, k_len_p, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, k_len_p, head_dim), kv_map),
+            pl.BlockSpec((1, k_len_p, head_dim), kv_map),
             pl.BlockSpec((1, bq, head_dim), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
             pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
@@ -349,9 +394,12 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
         interpret=interpret,
     )(qr, kr, vr, dor, lser, delta)
 
-    # dK/dV: grid over k blocks, Q-side streamed in the kernel loop —
-    # q-side arrays must be padded to a block_q multiple for the dynamic
-    # slices (padded rows are harmless per the kernel docstring).
+    # dK/dV: grid over (batch·kv_heads, k blocks[, q-head group]); each
+    # program streams ONE q head's blocks (same VMEM footprint as MHA);
+    # for GQA the group is the innermost grid dim and dK/dV accumulate in
+    # the resident fp32 output block. Q-side arrays must be padded to a
+    # block_q multiple for the dynamic slices (padded rows are harmless
+    # per the kernel docstring).
     q_pad = (-q_len) % bq
     if q_pad:
         qr = jnp.pad(qr, ((0, 0), (0, q_pad), (0, 0)))
@@ -360,36 +408,53 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
         delta = jnp.pad(delta, ((0, 0), (0, 0), (0, q_pad)))
     q_len_p = q_len + q_pad
 
+    if group > 1:
+        bkv = batch * kv_heads
+        # [b·H, q_len_p, d] -> [b·KV, group·q_len_p, d]; block index g on
+        # the row axis selects one q head's segment
+        qr = qr.reshape(bkv, group * q_len_p, head_dim)
+        dor = dor.reshape(bkv, group * q_len_p, head_dim)
+        lser = lser.reshape(bkv, 1, group * q_len_p)
+        delta = delta.reshape(bkv, 1, group * q_len_p)
+        grid = (bkv, k_len_p // bk, group)
+        q_spec = pl.BlockSpec((1, q_len_p, head_dim), lambda b, j, g: (b, g, 0))
+        r_spec = pl.BlockSpec((1, 1, q_len_p), lambda b, j, g: (b, 0, g))
+        kv_in = pl.BlockSpec((1, bk, head_dim), lambda b, j, g: (b, j, 0))
+        kv_out = pl.BlockSpec((1, bk, head_dim), lambda b, j, g: (b, j, 0))
+        out_dtype = jnp.float32  # group accumulation stays full precision
+    else:
+        bkv = bh
+        grid = (bkv, k_len_p // bk)
+        q_spec = pl.BlockSpec((1, q_len_p, head_dim), lambda b, j: (b, 0, 0))
+        r_spec = pl.BlockSpec((1, 1, q_len_p), lambda b, j: (b, 0, 0))
+        kv_in = pl.BlockSpec((1, bk, head_dim), lambda b, j: (b, j, 0))
+        kv_out = pl.BlockSpec((1, bk, head_dim), lambda b, j: (b, j, 0))
+        out_dtype = None
+
     dk, dv = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dkv_kernel, block_q=bq, causal=causal, scale=scale
+            _flash_bwd_dkv_kernel, block_q=bq, causal=causal, scale=scale,
+            grouped=group > 1,
         ),
-        grid=(bh, k_len_p // bk),
-        in_specs=[
-            pl.BlockSpec((1, q_len_p, head_dim), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, bk, head_dim), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, head_dim), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, q_len_p, head_dim), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, 1, q_len_p), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, 1, q_len_p), lambda b, j: (b, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bk, head_dim), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, head_dim), lambda b, j: (b, j, 0)),
-        ],
+        grid=grid,
+        in_specs=[q_spec, kv_in, kv_in, q_spec, r_spec, r_spec],
+        out_specs=[kv_out, kv_out],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, k_len_p, head_dim), k.dtype),
-            jax.ShapeDtypeStruct((bh, k_len_p, head_dim), v.dtype),
+            jax.ShapeDtypeStruct((bkv, k_len_p, head_dim), out_dtype or k.dtype),
+            jax.ShapeDtypeStruct((bkv, k_len_p, head_dim), out_dtype or v.dtype),
         ],
         interpret=interpret,
     )(qr, kr, vr, dor, lser, delta)
     if k_pad:
         dk = dk[:, :k_len]
         dv = dv[:, :k_len]
+    if group > 1:
+        dk = dk.astype(k.dtype)
+        dv = dv.astype(v.dtype)
     return (
         dq.reshape(batch, heads, q_len, head_dim),
-        dk.reshape(batch, heads, k_len, head_dim),
-        dv.reshape(batch, heads, k_len, head_dim),
+        dk.reshape(batch, kv_heads, k_len, head_dim),
+        dv.reshape(batch, kv_heads, k_len, head_dim),
     )
 
 
