@@ -57,7 +57,7 @@ class GceTpuApi:
     """The slice of tpu.googleapis.com v2 the provider needs."""
 
     def create_node(self, name: str, accelerator_type: str, runtime_version: str,
-                    labels: Dict[str, str]) -> None:
+                    labels: Dict[str, str], startup_script: str = "") -> None:
         raise NotImplementedError
 
     def delete_node(self, name: str) -> None:
@@ -111,7 +111,7 @@ class RestGceTpuApi(GceTpuApi):
             return json.loads(resp.read() or b"{}")
 
     def create_node(self, name: str, accelerator_type: str, runtime_version: str,
-                    labels: Dict[str, str]) -> None:
+                    labels: Dict[str, str], startup_script: str = "") -> None:
         self._call(
             "POST", f"{self.base}?nodeId={name}",
             {
@@ -120,7 +120,7 @@ class RestGceTpuApi(GceTpuApi):
                 "labels": labels,
                 # the boot script starts a node agent per host pointed at
                 # the controller; shipped via metadata like the reference
-                "metadata": {"startup-script": labels.get("rt-startup", "")},
+                "metadata": {"startup-script": startup_script},
             },
         )
 
@@ -128,16 +128,23 @@ class RestGceTpuApi(GceTpuApi):
         self._call("DELETE", f"{self.base}/{name}")
 
     def list_nodes(self) -> List[dict]:
-        out = self._call("GET", self.base)
-        return [
-            {
-                "name": n["name"].rsplit("/", 1)[-1],
-                "state": n.get("state", "READY"),
-                "accelerator_type": n.get("acceleratorType", ""),
-                "labels": n.get("labels", {}),
-            }
-            for n in out.get("nodes", [])
-        ]
+        out: List[dict] = []
+        page_token = ""
+        while True:  # nodes.list paginates; dropping pages orphans slices
+            url = self.base + (f"?pageToken={page_token}" if page_token else "")
+            resp = self._call("GET", url)
+            out.extend(
+                {
+                    "name": n["name"].rsplit("/", 1)[-1],
+                    "state": n.get("state", "READY"),
+                    "accelerator_type": n.get("acceleratorType", ""),
+                    "labels": n.get("labels", {}),
+                }
+                for n in resp.get("nodes", [])
+            )
+            page_token = resp.get("nextPageToken", "")
+            if not page_token:
+                return out
 
 
 class FakeGceTpuApi(GceTpuApi):
@@ -155,11 +162,12 @@ class FakeGceTpuApi(GceTpuApi):
         self._slices: Dict[str, dict] = {}
 
     def create_node(self, name: str, accelerator_type: str, runtime_version: str,
-                    labels: Dict[str, str]) -> None:
+                    labels: Dict[str, str], startup_script: str = "") -> None:
         from ray_tpu.core.node_agent import child_env
 
         hosts, chips = _slice_shape(accelerator_type)
         procs = []
+        logs = []
         for host_idx in range(hosts):
             resources = dict(self.host_resources)
             resources["TPU"] = chips
@@ -172,6 +180,8 @@ class FakeGceTpuApi(GceTpuApi):
                 self.session_dir, "logs", f"gce-{name}-h{host_idx}.log"
             )
             os.makedirs(os.path.dirname(log_path), exist_ok=True)
+            log = open(log_path, "ab")
+            logs.append(log)
             procs.append(
                 subprocess.Popen(
                     [
@@ -180,13 +190,14 @@ class FakeGceTpuApi(GceTpuApi):
                         "--session-dir", self.session_dir,
                         "--resources", json.dumps(resources),
                     ],
-                    env=env, stdout=open(log_path, "ab"),
+                    env=env, stdout=log,
                     stderr=subprocess.STDOUT,
                 )
             )
         with self._lock:
             self._slices[name] = {
                 "procs": procs,
+                "logs": logs,
                 "accelerator_type": accelerator_type,
                 "labels": labels,
                 "created_at": time.time(),
@@ -204,6 +215,8 @@ class FakeGceTpuApi(GceTpuApi):
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+        for log in info.get("logs", []):
+            log.close()
 
     def preempt(self, name: str) -> None:
         """Test hook: a maintenance event takes the WHOLE slice."""
@@ -242,13 +255,27 @@ class GceTpuNodeProvider(NodeProvider):
     are whole-slice (gang) operations (reference: the GCP provider's TPU
     path, where one tpu.googleapis.com node spans all slice hosts)."""
 
+    #: Per-host boot script for REAL slices (GCE runs it on every host of
+    #: the pod): starts a node agent pointed at the cluster controller
+    #: (reference: the GCP provider's setup/startup commands). Formatted
+    #: with {controller}; TPU resources are auto-detected on-host via the
+    #: accelerator manager.
+    STARTUP_TEMPLATE = (
+        "#!/bin/bash\n"
+        "python3 -m ray_tpu.core.node_agent --controller {controller} "
+        "--session-dir /tmp/ray_tpu/session_gce "
+        ">> /var/log/ray_tpu_agent.log 2>&1 &\n"
+    )
+
     def __init__(self, api: GceTpuApi, cluster_name: str = "rt",
                  runtime_version: str = "tpu-ubuntu2204-base",
-                 node_types: Optional[Dict[str, dict]] = None):
+                 node_types: Optional[Dict[str, dict]] = None,
+                 controller_address: str = ""):
         self.api = api
         self.cluster_name = cluster_name
         self.runtime_version = runtime_version
         self.node_types = node_types or {}
+        self.controller_address = controller_address
         self._types: Dict[str, str] = {}  # slice name -> node_type
 
     def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
@@ -257,9 +284,15 @@ class GceTpuNodeProvider(NodeProvider):
             or node_type.replace("tpu_", "").replace("_", "-")
         )
         name = f"{self.cluster_name}-{node_type}-{uuid.uuid4().hex[:8]}"
+        startup = (
+            self.STARTUP_TEMPLATE.format(controller=self.controller_address)
+            if self.controller_address
+            else ""
+        )
         self.api.create_node(
             name, accelerator_type, self.runtime_version,
             labels={"rt-cluster": self.cluster_name, "rt-node-type": node_type},
+            startup_script=startup,
         )
         self._types[name] = node_type
         return name
