@@ -128,10 +128,14 @@ class RestGceTpuApi(GceTpuApi):
         self._call("DELETE", f"{self.base}/{name}")
 
     def list_nodes(self) -> List[dict]:
+        from urllib.parse import quote
+
         out: List[dict] = []
         page_token = ""
         while True:  # nodes.list paginates; dropping pages orphans slices
-            url = self.base + (f"?pageToken={page_token}" if page_token else "")
+            url = self.base + (
+                f"?pageToken={quote(page_token, safe='')}" if page_token else ""
+            )
             resp = self._call("GET", url)
             out.extend(
                 {
@@ -256,26 +260,42 @@ class GceTpuNodeProvider(NodeProvider):
     path, where one tpu.googleapis.com node spans all slice hosts)."""
 
     #: Per-host boot script for REAL slices (GCE runs it on every host of
-    #: the pod): starts a node agent pointed at the cluster controller
-    #: (reference: the GCP provider's setup/startup commands). Formatted
-    #: with {controller}; TPU resources are auto-detected on-host via the
-    #: accelerator manager.
+    #: the pod): installs the framework, then starts a node agent pointed
+    #: at the cluster controller (reference: the GCP provider's
+    #: setup_commands + startup script in the cluster yaml). Formatted
+    #: with {package_spec} (pip spec or a gs:// wheel the operator
+    #: staged) and {controller}; TPU resources are auto-detected on-host
+    #: via the accelerator manager.
     STARTUP_TEMPLATE = (
         "#!/bin/bash\n"
+        "set -e\n"  # a failed install must not launch a doomed agent
+        "{install}\n"
         "python3 -m ray_tpu.core.node_agent --controller {controller} "
         "--session-dir /tmp/ray_tpu/session_gce "
         ">> /var/log/ray_tpu_agent.log 2>&1 &\n"
     )
 
+    @staticmethod
+    def _install_cmd(package_spec: str) -> str:
+        if package_spec.startswith("gs://"):
+            # pip can't fetch gs:// — stage the wheel with gsutil first
+            return (
+                f"gsutil cp {package_spec} /tmp/ray_tpu_pkg.whl\n"
+                "python3 -m pip install --quiet /tmp/ray_tpu_pkg.whl"
+            )
+        return f"python3 -m pip install --quiet {package_spec}"
+
     def __init__(self, api: GceTpuApi, cluster_name: str = "rt",
                  runtime_version: str = "tpu-ubuntu2204-base",
                  node_types: Optional[Dict[str, dict]] = None,
-                 controller_address: str = ""):
+                 controller_address: str = "",
+                 package_spec: str = "ray-tpu"):
         self.api = api
         self.cluster_name = cluster_name
         self.runtime_version = runtime_version
         self.node_types = node_types or {}
         self.controller_address = controller_address
+        self.package_spec = package_spec
         self._types: Dict[str, str] = {}  # slice name -> node_type
 
     def create_node(self, node_type: str, resources: Dict[str, float]) -> str:
@@ -285,7 +305,10 @@ class GceTpuNodeProvider(NodeProvider):
         )
         name = f"{self.cluster_name}-{node_type}-{uuid.uuid4().hex[:8]}"
         startup = (
-            self.STARTUP_TEMPLATE.format(controller=self.controller_address)
+            self.STARTUP_TEMPLATE.format(
+                controller=self.controller_address,
+                install=self._install_cmd(self.package_spec),
+            )
             if self.controller_address
             else ""
         )

@@ -162,11 +162,16 @@ def attention_block(
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = rms_norm(x, lp["attn_norm"])
     q, k, v = project_qkv(h, lp, cfg, positions)
-    if attn_fn is None:
-        # flash_attention is GQA-NATIVE: the kernel indexes the shared kv
-        # head per q-head group — no repeated K/V in HBM (ops/attention.py)
+    if attn_fn is None or getattr(attn_fn, "supports_gqa", False):
+        # flash_attention (and its shard_map wrapper) is GQA-NATIVE: the
+        # kernel indexes the shared kv head per q-head group — no
+        # repeated K/V in HBM (ops/attention.py)
         qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-        o = flash_attention(qt, kt, vt, True, None)
+        o = (
+            flash_attention(qt, kt, vt, True, None)
+            if attn_fn is None
+            else attn_fn(qt, kt, vt)
+        )
     else:
         # custom attention (ring/Ulysses SP) still takes equal head
         # counts — repeat kv heads for those paths

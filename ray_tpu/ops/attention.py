@@ -473,6 +473,14 @@ def _default_blocks(q_len: int, k_len: int, head_dim: int):
 
 
 def _use_pallas() -> bool:
+    import os
+
+    # AOT compiles against a TPU *topology* run with a CPU default
+    # backend — the env override lets them force the TPU lowering
+    # (benchmarks/compile_7b.py --backend tpu).
+    force = os.environ.get("RAY_TPU_FORCE_PALLAS")
+    if force is not None:
+        return force == "1"
     try:
         return jax.default_backend() == "tpu"
     except Exception:  # pragma: no cover
@@ -512,3 +520,69 @@ def _bwd(causal, scale, res, g):
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+def make_flash_attn_fn(mesh, causal: bool = True):
+    """Flash attention for MULTI-DEVICE meshes: Mosaic (Pallas) kernels
+    cannot be auto-partitioned by GSPMD, so the kernel must run inside a
+    shard_map that makes the batch/head axes manual — each device runs
+    the kernel on its local [b/(dp·fsdp), h/tp, s, d] shard (sequence
+    stays whole; sp>1 uses ring/Ulysses instead). Falls back to a direct
+    call on single-device meshes and when no known axes are present.
+
+    Same construction-time-mesh/ambient-mesh convention as
+    ring.make_ring_attn_fn so it nests under the pp pipeline shard_map.
+    """
+
+    def attn(q, k, v):
+        cur = jax.sharding.get_abstract_mesh()
+        use = cur if (cur is not None and cur.shape) else mesh
+        if getattr(use, "size", 1) <= 1:
+            return flash_attention(q, k, v, causal, None)
+        # Mosaic's lowering requires the union of manual axes to cover
+        # EVERY mesh axis (tpu_custom_call.py) — manualize all axes not
+        # already manual in the ambient context (e.g. pp inside the
+        # pipeline body); size-1 axes cost nothing.
+        types = getattr(use, "axis_types", None)
+        if types is None:
+            manual = set(use.axis_names)
+        else:
+            from jax.sharding import AxisType
+
+            manual = {
+                n for n, t in zip(use.axis_names, types) if t != AxisType.Manual
+            }
+        if not manual:
+            # fully-manual context already: data is per-device local
+            return flash_attention(q, k, v, causal, None)
+        batch_axes = tuple(a for a in ("dp", "fsdp") if a in manual)
+        head_axis = None
+        if "tp" in manual:
+            tp_size = dict(use.shape)["tp"]
+            if q.shape[1] % tp_size == 0:
+                head_axis = "tp"
+                if k.shape[1] != q.shape[1] and k.shape[1] % tp_size:
+                    # kv heads don't shard over tp: expand to MHA so each
+                    # tp shard's local q↔kv mapping stays contiguous
+                    # (native GQA under tp requires tp | kv_heads)
+                    rep = q.shape[1] // k.shape[1]
+                    k = jnp.repeat(k, rep, axis=1)
+                    v = jnp.repeat(v, rep, axis=1)
+            # else: heads don't divide tp — leave them unsharded; each tp
+            # shard computes all heads (redundant but correct, like the
+            # GSPMD partial-replication this replaces)
+        from jax.sharding import PartitionSpec as P
+
+        qspec = P(batch_axes or None, head_axis, None, None)
+        fn = jax.shard_map(
+            lambda q, k, v: flash_attention(q, k, v, causal, None),
+            mesh=use,
+            in_specs=(qspec, qspec, qspec),
+            out_specs=qspec,
+            axis_names=manual,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+
+    attn.supports_gqa = True  # kernel handles kv_heads != q_heads natively
+    return attn

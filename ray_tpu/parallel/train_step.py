@@ -47,6 +47,13 @@ def build_loss_fn(cfg: tf.TransformerConfig, plan: MeshPlan, mesh: Mesh, num_mic
     attn_fn = None
     if plan.sp > 1:
         attn_fn = SP_MODES[plan.sp_mode][0](mesh)
+    elif mesh.size > 1:
+        # Pallas kernels can't be auto-partitioned by GSPMD — on any
+        # multi-device mesh the flash attention must run inside its own
+        # shard_map over the batch/head axes (ops/attention.py).
+        from ray_tpu.ops.attention import make_flash_attn_fn
+
+        attn_fn = make_flash_attn_fn(mesh)
 
     if plan.pp == 1:
         def loss(params, batch):
