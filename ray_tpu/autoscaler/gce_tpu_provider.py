@@ -263,9 +263,10 @@ class GceTpuNodeProvider(NodeProvider):
     #: the pod): installs the framework, then starts a node agent pointed
     #: at the cluster controller (reference: the GCP provider's
     #: setup_commands + startup script in the cluster yaml). Formatted
-    #: with {package_spec} (pip spec or a gs:// wheel the operator
-    #: staged) and {controller}; TPU resources are auto-detected on-host
-    #: via the accelerator manager.
+    #: with {install} (built from ``package_spec`` — a pip spec or a
+    #: gs:// wheel the operator staged — by _install_cmd) and
+    #: {controller}; TPU resources are auto-detected on-host via the
+    #: accelerator manager.
     STARTUP_TEMPLATE = (
         "#!/bin/bash\n"
         "set -e\n"  # a failed install must not launch a doomed agent

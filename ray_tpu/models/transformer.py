@@ -47,8 +47,14 @@ class TransformerConfig:
     # Remat policy: "full" recomputes the whole layer on backward;
     # "dots" saves matmul outputs and recomputes only cheap elementwise
     # ops (jax.checkpoint_policies.dots_with_no_batch_dims_saveable) —
-    # far less recompute FLOPs for modestly more HBM.
+    # far less recompute FLOPs for modestly more HBM; "attn" saves only
+    # the flash-attention outputs.
     remat_policy: str = "full"
+    # lax.scan unroll over the layer stack: >1 inlines several layer
+    # bodies per scan step, widening XLA's fusion/scheduling scope
+    # (each layer stays its own remat block; measured neutral-to-slower
+    # on the flagship bench — kept as a tuning knob).
+    scan_unroll: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -172,6 +178,9 @@ def attention_block(
             if attn_fn is None
             else attn_fn(qt, kt, vt)
         )
+        from jax.ad_checkpoint import checkpoint_name
+
+        o = checkpoint_name(o, "attn_out")  # remat_policy="attn" saves these
     else:
         # custom attention (ring/Ulysses SP) still takes equal head
         # counts — repeat kv heads for those paths
@@ -182,6 +191,9 @@ def attention_block(
             vr = jnp.repeat(v, rep, axis=2)
         qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, kr, vr))
         o = attn_fn(qt, kt, vt)
+        from jax.ad_checkpoint import checkpoint_name
+
+        o = checkpoint_name(o, "attn_out")  # remat_policy="attn" saves these
     o = o.transpose(0, 2, 1, 3).reshape(b, s, H * HD)
     out = x + o @ lp["wo"].astype(o.dtype)
     if return_kv:
@@ -244,17 +256,22 @@ def decoder_stack(params: Params, h, cfg: TransformerConfig, positions, attn_fn=
         return out, None
 
     if cfg.remat:
-        if cfg.remat_policy not in ("full", "dots"):
+        if cfg.remat_policy not in ("full", "dots", "attn"):
             raise ValueError(
-                f"remat_policy must be 'full' or 'dots', got {cfg.remat_policy!r}"
+                f"remat_policy must be 'full', 'dots' or 'attn', got {cfg.remat_policy!r}"
             )
-        policy = (
-            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            if cfg.remat_policy == "dots"
-            else None
-        )
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif cfg.remat_policy == "attn":
+            # Save ONLY the flash-attention outputs ([b,s,d] per layer —
+            # ~50 MB/layer at the flagship config): the backward pass then
+            # skips recomputing the most expensive fwd op while activation
+            # memory stays near full-remat levels.
+            policy = jax.checkpoint_policies.save_only_these_names("attn_out")
+        else:
+            policy = None
         layer_fn = jax.checkpoint(layer_fn, prevent_cse=False, policy=policy)
-    h, _ = jax.lax.scan(layer_fn, h, params["layers"])
+    h, _ = jax.lax.scan(layer_fn, h, params["layers"], unroll=cfg.scan_unroll)
     return h
 
 

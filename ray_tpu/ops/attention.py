@@ -458,13 +458,33 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, scale: float,
     )
 
 
-def _default_blocks(q_len: int, k_len: int, head_dim: int):
+def _env_blocks(var: str):
+    import os
+
+    raw = os.environ.get(var)
+    if not raw:
+        return None
+    bq, bk = raw.split(",")
+    return int(bq), int(bk)
+
+
+def _default_blocks(q_len: int, k_len: int, head_dim: int, bwd: bool = False):
     """Shape-adaptive Pallas block sizes, measured on v5e (bf16):
     (1024, 512) beats (256, 256) by ~35-40%% at head_dim 64 across
-    2k-8k sequence; at head_dim 128 (512, 512) beats (512, 256) by ~4%
-    of end-to-end train MFU (53.4%->57.5% on the 750M flagship bench).
+    2k-8k sequence; at head_dim 128 (512, 512) beats (512, 256) by ~4
+    points of end-to-end train MFU on the 750M flagship bench, and the
+    round-3 sweep (benchmarks/tune_flash.py) confirmed it still wins
+    against (1024,512)/(512,1024)/(256,512) variants there.
     Larger head dims multiply per-program VMEM (blocks plus the resident
-    K/V), so they step down conservatively."""
+    K/V), so they step down conservatively.
+
+    Env overrides for tuning sweeps: RAY_TPU_FLASH_BLOCKS="bq,bk" and
+    RAY_TPU_FLASH_BWD_BLOCKS="bq,bk" (backward kernels only)."""
+    override = _env_blocks("RAY_TPU_FLASH_BWD_BLOCKS" if bwd else "RAY_TPU_FLASH_BLOCKS")
+    if override is None and bwd:
+        override = _env_blocks("RAY_TPU_FLASH_BLOCKS")
+    if override is not None:
+        return override
     if head_dim <= 64:
         return 1024, 512
     if head_dim <= 128:
@@ -506,7 +526,7 @@ def _bwd(causal, scale, res, g):
     q, k, v, o, lse = res
     s = scale if scale is not None else q.shape[-1] ** -0.5
     if o is not None:
-        bq, bk = _default_blocks(q.shape[-2], k.shape[-2], q.shape[-1])
+        bq, bk = _default_blocks(q.shape[-2], k.shape[-2], q.shape[-1], bwd=True)
         return _flash_backward(
             q, k, v, o, lse, g, causal, s, block_q=bq, block_k=bk, interpret=False
         )
