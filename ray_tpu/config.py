@@ -136,6 +136,12 @@ class Config:
     # (/metrics Prometheus text + /api/v0/* state JSON); -1 disables it.
     dashboard_port: int = 0
 
+    # --- fault injection (tests only; reference:
+    # python/ray/tests/chaos/chaos_network_delay.yaml injects network
+    # latency with k8s traffic shaping — here the agents' chunk server
+    # sleeps per chunk, stretching transfers so chaos can land mid-pull) ---
+    chaos_fetch_delay_ms: int = 0
+
     # --- misc ---
     temp_dir: str = field(default_factory=lambda: os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu"))
     log_to_driver: bool = True

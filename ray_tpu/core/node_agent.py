@@ -151,6 +151,9 @@ class NodeAgent:
 
     # -- object data plane (reference: object_manager.cc Push/Pull) -----
     async def rpc_fetch_chunk(self, peer, oid: ObjectID, offset: int, length: int):
+        delay = getattr(self, "_config", {}).get("chaos_fetch_delay_ms", 0)
+        if delay:
+            await asyncio.sleep(delay / 1000.0)  # fault injection (tests)
         # Raw: the chunk crosses as an out-of-band frame (no pickle copy)
         ip = self._inflight_pulls.get(oid)
         if ip is not None:
