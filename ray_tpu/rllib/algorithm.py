@@ -130,11 +130,26 @@ class EnvRunnerGroup:
 
     def sync_weights(self, params):
         """Ship learner weights to every runner via one object-store put
-        (reference: sync_weights' broadcast-by-ref)."""
+        (reference: sync_weights' broadcast-by-ref). When runners span
+        multiple nodes, large weights are pre-staged onto every node over
+        the pipelined broadcast chain (controller object_broadcast,
+        reference: push_manager.h) so N runners don't issue N competing
+        pulls from the one source node."""
         self._weights_version += 1
         self.local_runner.set_state(params, self._weights_version)
         if self._manager:
             ref = ray_tpu.put(params)
+            try:
+                core = ray_tpu.core.api._require_worker()
+                nodes = {
+                    n["node_id"] for n in ray_tpu.nodes()
+                    if n["state"] == "ALIVE" and not n["is_head"]
+                }
+                if nodes:
+                    # False for inline-small weights (nothing to stage)
+                    core._call("object_broadcast", ref.id, None, timeout=300)
+            except Exception:  # noqa: BLE001 — staging is best-effort
+                pass
             self._manager.foreach_actor(
                 "set_state", ref, self._weights_version, timeout=60
             )
