@@ -21,6 +21,10 @@ from ray_tpu.serve.api import (
     start,
     status,
 )
+from ray_tpu.serve.grpc_proxy import (
+    register_grpc_service,
+    unregister_grpc_service,
+)
 from ray_tpu.serve.handle import (
     DeploymentHandle,
     DeploymentResponse,
@@ -35,6 +39,8 @@ __all__ = [
     "delete",
     "status",
     "get_deployment_handle",
+    "register_grpc_service",
+    "unregister_grpc_service",
     "DeploymentHandle",
     "DeploymentResponse",
     "DeploymentStreamingResponse",

@@ -1,18 +1,26 @@
 """gRPC ingress proxy actor.
 
-Reference: python/ray/serve/_private/proxy.py:545 (gRPC proxy) — the
-reference serves user-defined proto services; here the ingress is a
-GENERIC gRPC service (no codegen, works with any grpc client using
-bytes serializers):
+Reference: python/ray/serve/_private/proxy.py:545 (gRPC proxy). Two
+ingress modes:
 
-  unary  /ray_tpu.serve.Ingress/Call    request = JSON {"route", "payload"}
-                                        response = JSON result
-  stream /ray_tpu.serve.Ingress/Stream  same request; one JSON frame per
-                                        yielded item (the LLM path)
+1. GENERIC service (no codegen, any grpc client with bytes serializers):
+     unary  /ray_tpu.serve.Ingress/Call    request = JSON {"route", "payload"}
+     stream /ray_tpu.serve.Ingress/Stream  one JSON frame per yielded item
 
-Errors surface as gRPC status NOT_FOUND (unknown route) / INTERNAL
-(application error). See ``grpc_call``/``grpc_stream`` for the matching
-client helpers.
+2. USER-DEFINED services with METHOD DISPATCH (the reference's
+   grpc_servicer_functions model): ``register_grpc_service`` maps a
+   fully-qualified service name to a deployment; the proxy then serves
+   ``/pkg.Service/Method`` by invoking the deployment's ``Method`` with
+   the RAW request bytes and returning the raw bytes it produces — the
+   replica does the proto decode/encode (generated classes optional),
+   so the ingress needs no codegen. Streaming methods (server-side) are
+   declared at registration, and ONLY registered methods dispatch (the
+   method list is an allowlist — unlisted replica methods stay
+   unreachable from the ingress). The registry lives in the controller
+   KV; proxies observe changes within a 2 s cache TTL.
+
+Errors surface as gRPC status NOT_FOUND / UNIMPLEMENTED / INTERNAL. See
+``grpc_call``/``grpc_stream`` for generic-mode client helpers.
 """
 from __future__ import annotations
 
@@ -23,6 +31,39 @@ import ray_tpu
 
 CALL_METHOD = "/ray_tpu.serve.Ingress/Call"
 STREAM_METHOD = "/ray_tpu.serve.Ingress/Stream"
+_GRPC_KV_NS = "serve_grpc_services"
+
+
+def register_grpc_service(service: str, deployment_name: str,
+                          methods=(), stream_methods=()):
+    """Route a fully-qualified gRPC service (``"pkg.Service"``) to a
+    deployment: ``/pkg.Service/Method`` dispatches to the deployment's
+    ``Method(request_bytes) -> response_bytes`` for Method in
+    ``methods``, or a generator of bytes for Method in
+    ``stream_methods``. The two lists are an ALLOWLIST — other replica
+    methods are not reachable from the ingress. Reference:
+    serve/_private/proxy.py gRPC method routing over user servicers."""
+    from ray_tpu.core.api import _require_worker
+
+    if not methods and not stream_methods:
+        raise ValueError("register_grpc_service needs methods and/or stream_methods")
+    _require_worker().kv_put(
+        _GRPC_KV_NS,
+        service.encode(),
+        json.dumps(
+            {
+                "deployment": deployment_name,
+                "methods": sorted(methods),
+                "stream": sorted(stream_methods),
+            }
+        ).encode(),
+    )
+
+
+def unregister_grpc_service(service: str):
+    from ray_tpu.core.api import _require_worker
+
+    _require_worker().kv_del(_GRPC_KV_NS, service.encode())
 
 
 @ray_tpu.remote
@@ -37,6 +78,7 @@ class GrpcProxyActor:
 
         self._controller = _get_controller()
         self._resolver = RouteResolver(self._controller, get_deployment_handle)
+        self._svc_cache: Dict[str, tuple] = {}
         proxy = self
 
         class Handler(grpc.GenericRpcHandler):
@@ -53,6 +95,28 @@ class GrpcProxyActor:
                         request_deserializer=bytes,
                         response_serializer=bytes,
                     )
+                # user-defined service dispatch: /pkg.Service/Method
+                method = call_details.method
+                if isinstance(method, bytes):
+                    method = method.decode()
+                parts = method.strip("/").split("/")
+                if len(parts) == 2:
+                    reg = proxy._service_registration(parts[0])
+                    if reg is not None:
+                        # the registration's method lists are an
+                        # allowlist; anything else → UNIMPLEMENTED
+                        if parts[1] in reg.get("stream", []):
+                            return grpc.unary_stream_rpc_method_handler(
+                                proxy._make_user_stream(reg["deployment"], parts[1]),
+                                request_deserializer=bytes,
+                                response_serializer=bytes,
+                            )
+                        if parts[1] in reg.get("methods", []):
+                            return grpc.unary_unary_rpc_method_handler(
+                                proxy._make_user_call(reg["deployment"], parts[1]),
+                                request_deserializer=bytes,
+                                response_serializer=bytes,
+                            )
                 return None
 
         # Streams hold their worker for the FULL response (LLM token
@@ -65,6 +129,54 @@ class GrpcProxyActor:
 
     def port(self) -> int:
         return self._port
+
+    # -- user-defined service dispatch ---------------------------------
+    def _service_registration(self, service: str):
+        """KV-backed registry with a short cache (registrations are rare;
+        lookups are per-RPC)."""
+        import time
+
+        cached = self._svc_cache.get(service)
+        if cached is not None and time.monotonic() - cached[1] < 2.0:
+            return cached[0]
+        from ray_tpu.core.api import _require_worker
+
+        raw = _require_worker().kv_get(_GRPC_KV_NS, service.encode())
+        reg = json.loads(raw) if raw else None
+        if len(self._svc_cache) >= 256:
+            # bound the cache: unknown-service probes (scanners, typos)
+            # must not grow proxy memory forever
+            self._svc_cache.pop(next(iter(self._svc_cache)))
+        self._svc_cache[service] = (reg, time.monotonic())
+        return reg
+
+    def _user_handle(self, deployment: str):
+        from ray_tpu.serve.api import get_deployment_handle
+
+        return get_deployment_handle(deployment)
+
+    def _make_user_call(self, deployment: str, method: str):
+        import grpc
+
+        def call(request: bytes, context) -> bytes:
+            try:
+                handle = getattr(self._user_handle(deployment), method)
+                out = handle.remote(bytes(request)).result(timeout=300)
+                return bytes(out)
+            except Exception as e:  # noqa: BLE001 — user errors → INTERNAL
+                context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+        return call
+
+    def _make_user_stream(self, deployment: str, method: str):
+        def stream(request: bytes, context) -> Iterator[bytes]:
+            def start():
+                handle = getattr(self._user_handle(deployment), method)
+                return handle.stream(bytes(request))
+
+            yield from _pump_stream(start, context, bytes)
+
+        return stream
 
     # -- request handling ----------------------------------------------
     def _resolve(self, request: bytes, context):
@@ -104,21 +216,34 @@ class GrpcProxyActor:
             context.abort(grpc.StatusCode.INTERNAL, str(e))
 
     def _stream(self, request: bytes, context) -> Iterator[bytes]:
-        import grpc
-
         from ray_tpu.serve.proxy import RouteResolver
 
         handle, payload = self._resolve(request, context)
-        items = RouteResolver.stream(handle, payload)
-        try:
-            for item in items:
-                yield json.dumps(item, default=str).encode()
-        except Exception as e:  # noqa: BLE001
-            context.abort(grpc.StatusCode.INTERNAL, str(e))
-        finally:
-            close = getattr(items, "close", None)
-            if close:
-                close()
+        yield from _pump_stream(
+            lambda: RouteResolver.stream(handle, payload),
+            context,
+            lambda item: json.dumps(item, default=str).encode(),
+        )
+
+
+def _pump_stream(start, context, encode) -> Iterator[bytes]:
+    """Shared server-streaming scaffolding: setup AND iteration errors
+    map to INTERNAL (a no-replica routing timeout must not surface as
+    UNKNOWN), the source generator is closed on any exit (client
+    disconnects run replica-side finally blocks)."""
+    import grpc
+
+    items = None
+    try:
+        items = start()
+        for item in items:
+            yield encode(item)
+    except Exception as e:  # noqa: BLE001 — user/routing errors → INTERNAL
+        context.abort(grpc.StatusCode.INTERNAL, str(e))
+    finally:
+        close = getattr(items, "close", None)
+        if close:
+            close()
 
 
 # -- client helpers ------------------------------------------------------
