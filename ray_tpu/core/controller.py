@@ -313,10 +313,11 @@ class Controller:
             self._drop_holder(holder)
         self._drop_subscriber(peer)
         # Leases die with their owner's connection (reference: leased
-        # workers are returned when the lease-holder worker dies).
+        # workers are returned when the lease-holder worker dies). The
+        # workers may be mid-task on orphaned pushes → kill, don't pool.
         owned = [lid for lid, r in self.leases.items() if r.owner is peer]
         for lid in owned:
-            await self.rpc_lease_release(peer, lid)
+            await self.rpc_lease_release(peer, lid, kill_worker=True)
         if kind == "worker":
             await self._on_worker_death(peer.meta["worker_id"], "connection lost")
         elif kind == "agent":
@@ -595,7 +596,12 @@ class Controller:
                 return
         self._head_direct_free.append(w.worker_id)
 
-    async def rpc_lease_release(self, peer: rpc.Peer, lease_id: bytes):
+    async def rpc_lease_release(self, peer: rpc.Peer, lease_id: bytes,
+                                kill_worker: bool = False):
+        """``kill_worker``: the release came from the lease-holder DYING,
+        not from a drained queue — the worker may be mid-task on an
+        orphaned push, so it must be exited, never pooled (a pooled
+        busy worker would queue the next caller's task behind it)."""
         rec = self.leases.pop(lease_id, None)
         if rec is None:
             return False
@@ -605,14 +611,34 @@ class Controller:
         if rec.worker_id is not None:
             w = self.workers.get(rec.worker_id)
             if w is not None and w.state != "DEAD":
-                self._head_direct_put(w)
+                if kill_worker:
+                    w.state = "DEAD"
+                    try:
+                        await w.peer.notify("exit")
+                    except Exception:  # noqa: BLE001
+                        pass
+                    # keep parked head lease_worker callers from hanging
+                    node = self.nodes[rec.node_id]
+                    if self._head_direct_waiters and (
+                        len(node.workers) + node.num_starting < node.max_workers
+                    ):
+                        from ray_tpu.core.node_agent import spawn_worker
+
+                        node.num_starting += 1
+                        spawn_worker(
+                            self.session_dir, f"127.0.0.1:{self.port}",
+                            node.node_id, node.shm_dir,
+                            extra_env={"RAY_TPU_WORKER_POOL": "direct"},
+                        )
+                else:
+                    self._head_direct_put(w)
         else:
             # agent lease: the agent bound a worker we never saw — relay
             # the release so a dead lease-holder can't strand it busy
             node = self.nodes.get(rec.node_id)
             if node is not None and node.peer is not None and not node.peer.closed:
                 try:
-                    await node.peer.notify("lease_release", lease_id)
+                    await node.peer.notify("lease_release", lease_id, kill_worker)
                 except Exception:  # noqa: BLE001 — agent dying too
                     pass
         self._schedule_pump()

@@ -354,17 +354,32 @@ class NodeAgent:
         w.busy = False
         self._hand_to_waiter(w)
 
-    def rpc_lease_release(self, peer, lease_id: bytes):
-        """Controller relay on lease-holder death: free the bound worker
-        (idempotent vs. a caller's own lease_return, which pops the
-        binding first)."""
+    def rpc_lease_release(self, peer, lease_id: bytes, kill_worker: bool = False):
+        """Controller relay on lease-holder death: reclaim the bound
+        worker (idempotent vs. a caller's own lease_return, which pops
+        the binding first). With ``kill_worker`` the worker may be
+        mid-task on an orphaned push — exit it rather than pooling a
+        busy worker."""
         wid = self._lease_workers.pop(bytes(lease_id), None)
         if wid is None:
             return
         w = self._direct.get(wid)
-        if w is not None:
-            w.busy = False
-            self._hand_to_waiter(w)
+        if w is None:
+            return
+        if kill_worker:
+            self._direct.pop(wid, None)
+            if w.peer is not None and not w.peer.closed:
+                asyncio.ensure_future(w.peer.notify("exit"))
+            # parked lease_worker callers must not hang on the shrunken
+            # pool — pair the pop with a replacement spawn (same contract
+            # as _retire_mismatched)
+            if self._direct_waiters and (
+                len(self._direct) + self._direct_starting < self._max_direct
+            ):
+                self._spawn_direct()
+            return
+        w.busy = False
+        self._hand_to_waiter(w)
 
     def rpc_exit(self, peer):
         self._exit.set()

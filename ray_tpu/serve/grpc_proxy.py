@@ -79,6 +79,7 @@ class GrpcProxyActor:
         self._controller = _get_controller()
         self._resolver = RouteResolver(self._controller, get_deployment_handle)
         self._svc_cache: Dict[str, tuple] = {}
+        self._user_handles: Dict[str, object] = {}
         proxy = self
 
         class Handler(grpc.GenericRpcHandler):
@@ -145,15 +146,24 @@ class GrpcProxyActor:
         reg = json.loads(raw) if raw else None
         if len(self._svc_cache) >= 256:
             # bound the cache: unknown-service probes (scanners, typos)
-            # must not grow proxy memory forever
-            self._svc_cache.pop(next(iter(self._svc_cache)))
+            # must not grow proxy memory forever (pop defensively —
+            # concurrent gRPC threads may race the eviction)
+            try:
+                self._svc_cache.pop(next(iter(self._svc_cache)), None)
+            except (StopIteration, RuntimeError):
+                pass
         self._svc_cache[service] = (reg, time.monotonic())
         return reg
 
     def _user_handle(self, deployment: str):
-        from ray_tpu.serve.api import get_deployment_handle
+        # cached: a fresh handle per RPC would rebuild router state (and
+        # its controller round-trips) on every request
+        h = self._user_handles.get(deployment)
+        if h is None:
+            from ray_tpu.serve.api import get_deployment_handle
 
-        return get_deployment_handle(deployment)
+            h = self._user_handles[deployment] = get_deployment_handle(deployment)
+        return h
 
     def _make_user_call(self, deployment: str, method: str):
         import grpc

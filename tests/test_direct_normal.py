@@ -138,6 +138,74 @@ def test_pg_tasks_through_lease_path(rt):
     remove_placement_group(pg)
 
 
+def test_caller_death_releases_leases_and_workers(rt):
+    """A driver that dies holding worker leases must not strand resources
+    or pool workers: the controller's disconnect cleanup releases the
+    lease resources and relays the release to the agents' pools."""
+    import subprocess
+    import sys
+    import textwrap
+
+    core = ray_tpu.core.api._require_worker()
+    addr = core.address
+    before = ray_tpu.available_resources()["CPU"]
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {repo_root!r})
+        import os, time
+        import ray_tpu
+        ray_tpu.init(address={addr!r})
+
+        @ray_tpu.remote(num_cpus=1)
+        def hold(tag):
+            import time
+            while True:  # heartbeat until killed
+                open(f"/tmp/rt_orphan_{{tag}}", "w").write(str(time.time()))
+                time.sleep(0.2)
+
+        refs = [hold.remote(i) for i in range(4)]  # leases all 4 CPUs
+        time.sleep(2.5)  # leases granted, tasks running
+        os._exit(1)  # die WITHOUT releasing anything
+    """)
+    env = dict(__import__("os").environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", child], env=env, timeout=120,
+        capture_output=True,
+    )
+    assert proc.returncode == 1
+    # resources come back once the controller processes the disconnect
+    # (and kills/reclaims the orphaned task workers)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0) == before:
+            break
+        time.sleep(0.25)
+    assert ray_tpu.available_resources()["CPU"] == before
+    # the pool still serves new work promptly
+    @ray_tpu.remote(num_cpus=1)
+    def ping():
+        return "ok"
+
+    assert ray_tpu.get([ping.remote() for _ in range(4)], timeout=60) == ["ok"] * 4
+    # the orphaned tasks' workers were KILLED, not pooled busy: their
+    # heartbeats stop (a pooled busy worker would strand the next push)
+    import glob
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        time.sleep(1.0)
+        now = time.time()
+        beats = [float(open(p).read()) for p in glob.glob("/tmp/rt_orphan_*")]
+        if beats and all(b < now - 0.8 for b in beats):
+            break
+    else:
+        pytest.fail(f"orphaned workers still heartbeating: {beats}")
+    for path in glob.glob("/tmp/rt_orphan_*"):
+        os.unlink(path)
+
+
 class TestMultiNode:
     def test_locality_aware_placement(self):
         """A task whose only big arg lives on node B must schedule onto
