@@ -1,0 +1,135 @@
+"""Self-contained dashboard page (reference: python/ray/dashboard/ —
+the reference ships a 29 kLoC React client; this rebuild serves ONE
+dependency-free HTML page from the controller's HTTP gateway that polls
+the same state API the React app would (/api/v0/*, /api/jobs) and
+renders cluster resources, nodes, actors, tasks, placement groups, jobs
+and the event tail with a 2 s refresh)."""
+
+DASHBOARD_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  :root { color-scheme: light dark; }
+  body { font: 13px/1.45 system-ui, sans-serif; margin: 0; padding: 1rem 1.4rem;
+         max-width: 1200px; }
+  h1 { font-size: 1.15rem; margin: 0 0 .2rem; }
+  h2 { font-size: .95rem; margin: 1.2rem 0 .4rem; border-bottom: 1px solid
+       color-mix(in srgb, currentColor 25%, transparent); padding-bottom: .2rem; }
+  small { opacity: .65 }
+  table { border-collapse: collapse; width: 100%; }
+  th, td { text-align: left; padding: .18rem .6rem .18rem 0; vertical-align: top;
+           border-bottom: 1px solid color-mix(in srgb, currentColor 12%, transparent); }
+  th { font-weight: 600; opacity: .75 }
+  .num { text-align: right; font-variant-numeric: tabular-nums; }
+  .ok { color: #188038 } .bad { color: #c5221f } .warn { color: #b06000 }
+  .bar { display: inline-block; height: .6rem; background: #1a73e8;
+         border-radius: 2px; vertical-align: middle; }
+  .pill { display: inline-block; padding: 0 .45rem; border-radius: 999px;
+          background: color-mix(in srgb, currentColor 12%, transparent);
+          font-size: .78rem; }
+  #err { color: #c5221f; min-height: 1em; }
+  code { font-size: .85em }
+</style>
+</head>
+<body>
+<h1>ray_tpu <small id="ts"></small></h1>
+<div id="err"></div>
+<h2>Resources</h2><div id="resources"></div>
+<h2>Nodes</h2><div id="nodes"></div>
+<h2>Actors</h2><div id="actors"></div>
+<h2>Tasks <small>(most recent)</small></h2><div id="tasks"></div>
+<h2>Placement groups</h2><div id="pgs"></div>
+<h2>Jobs</h2><div id="jobs"></div>
+<h2>Events <small>(tail)</small></h2><div id="events"></div>
+<script>
+const get = (p) => fetch(p).then(r => r.json());
+const esc = (s) => String(s ?? "").replace(/[&<>]/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
+function table(rows, cols) {
+  if (!rows || !rows.length) return "<small>none</small>";
+  let h = "<table><tr>" + cols.map(c => `<th>${c[0]}</th>`).join("") + "</tr>";
+  for (const r of rows)
+    h += "<tr>" + cols.map(c => `<td class="${c[2]||""}">${c[1](r)}</td>`).join("") + "</tr>";
+  return h + "</table>";
+}
+function stateCls(s) {
+  if (["ALIVE","FINISHED","RUNNING","SUCCEEDED","CREATED"].includes(s)) return "ok";
+  if (["DEAD","FAILED","CREATION_FAILED","STOPPED"].includes(s)) return "bad";
+  return "warn";
+}
+const pill = (s) => `<span class="pill ${stateCls(s)}">${esc(s)}</span>`;
+async function refresh() {
+  try {
+    const [total, avail, nodes, actors, tasks, pgs, events] = await Promise.all([
+      get("/api/v0/cluster_resources"), get("/api/v0/available_resources"),
+      get("/api/v0/nodes"), get("/api/v0/actors"), get("/api/v0/tasks"),
+      get("/api/v0/placement_groups"), get("/api/v0/events"),
+    ]);
+    let jobs = [];
+    try { jobs = await get("/api/jobs"); } catch (e) {}
+    document.getElementById("ts").textContent = new Date().toLocaleTimeString();
+    document.getElementById("err").textContent = "";
+    let res = "<table>";
+    for (const k of Object.keys(total).sort()) {
+      const t = total[k], a = avail[k] ?? 0, used = t - a;
+      const pct = t > 0 ? Math.round(100 * used / t) : 0;
+      res += `<tr><td>${esc(k)}</td><td class="num">${used.toFixed(2)} / ${t}</td>
+        <td style="width:40%"><span class="bar" style="width:${pct}%"></span>
+        <small> ${pct}%</small></td></tr>`;
+    }
+    document.getElementById("resources").innerHTML = res + "</table>";
+    document.getElementById("nodes").innerHTML = table(nodes, [
+      ["node", r => `<code>${esc(r.node_id.slice(0,10))}</code>` +
+                    (r.is_head ? ' <span class="pill">head</span>' : "")],
+      ["state", r => pill(r.state)],
+      ["host", r => esc(r.hostname)],
+      ["workers", r => r.num_workers, "num"],
+      ["cpu avail/total", r => {
+        const res2 = r.resources || {};
+        const t = (res2.total||{}).CPU ?? "-", a = (res2.available||{}).CPU ?? "-";
+        return `${a} / ${t}`; }, "num"],
+    ]);
+    document.getElementById("actors").innerHTML = table(actors, [
+      ["actor", r => `<code>${esc(r.actor_id.slice(0,10))}</code>`],
+      ["name", r => esc(r.name || "")],
+      ["state", r => pill(r.state)],
+      ["restarts", r => r.num_restarts, "num"],
+      ["node", r => r.node_id ? `<code>${esc(r.node_id.slice(0,10))}</code>` : ""],
+    ]);
+    document.getElementById("tasks").innerHTML = table(tasks.slice(-40).reverse(), [
+      ["task", r => `<code>${esc(r.task_id.slice(0,10))}</code>`],
+      ["name", r => esc(r.name)],
+      ["type", r => esc(r.type)],
+      ["state", r => pill(r.state)],
+    ]);
+    const pgRows = Array.isArray(pgs) ? pgs : Object.values(pgs || {});
+    document.getElementById("pgs").innerHTML = table(pgRows, [
+      ["pg", r => `<code>${esc((r.placement_group_id || r.id || "").slice(0,10))}</code>`],
+      ["name", r => esc(r.name || "")],
+      ["state", r => pill(r.state || "")],
+      ["bundles", r => esc(JSON.stringify(r.bundles || []))],
+    ]);
+    document.getElementById("jobs").innerHTML = table(
+      Array.isArray(jobs) ? jobs : Object.values(jobs || {}), [
+      ["job", r => `<code>${esc(r.submission_id || r.job_id || "")}</code>`],
+      ["status", r => pill(r.status || "")],
+      ["entrypoint", r => `<code>${esc((r.entrypoint || "").slice(0, 80))}</code>`],
+    ]);
+    document.getElementById("events").innerHTML = table(events.slice(-15).reverse(), [
+      ["time", r => new Date(r.ts * 1000).toLocaleTimeString()],
+      ["kind", r => esc(r.kind)],
+      ["name", r => esc(r.name)],
+      ["state", r => pill(r.state)],
+    ]);
+  } catch (e) {
+    document.getElementById("err").textContent = "refresh failed: " + e;
+  }
+}
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

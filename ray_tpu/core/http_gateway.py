@@ -109,6 +109,10 @@ def start_http_gateway(controller, loop: asyncio.AbstractEventLoop, port: int) -
                 path = self.path.split("?")[0].rstrip("/")
                 if path == "/healthz":
                     self._send(200, b"ok", "text/plain")
+                elif path in ("", "/", "/dashboard"):
+                    from ray_tpu.core.dashboard_ui import DASHBOARD_HTML
+
+                    self._send(200, DASHBOARD_HTML.encode(), "text/html; charset=utf-8")
                 elif path == "/api/jobs":
                     self._json(job_manager().list_jobs())
                 elif path.startswith("/api/jobs/") and path.endswith("/logs"):

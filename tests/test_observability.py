@@ -113,6 +113,10 @@ def test_http_gateway(ray_start_regular):
     url = state_api.dashboard_url()
     assert url is not None
     assert _http_get(url + "/healthz") == b"ok"
+    # dashboard UI page (reference: the dashboard head's web client)
+    page = _http_get(url + "/").decode()
+    assert "<title>ray_tpu dashboard</title>" in page
+    assert "/api/v0/nodes" in page  # polls the state API
 
     @ray_tpu.remote
     def f():
