@@ -44,7 +44,10 @@ DASHBOARD_HTML = """<!doctype html>
 <h2>Jobs</h2><div id="jobs"></div>
 <h2>Events <small>(tail)</small></h2><div id="events"></div>
 <script>
-const get = (p) => fetch(p).then(r => r.json());
+const get = (p) => fetch(p).then(r => {
+  if (!r.ok) throw new Error(p + " -> " + r.status);
+  return r.json();
+});
 const esc = (s) => String(s ?? "").replace(/[&<>]/g,
   c => ({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
 function table(rows, cols) {
@@ -64,8 +67,8 @@ async function refresh() {
   try {
     const [total, avail, nodes, actors, tasks, pgs, events] = await Promise.all([
       get("/api/v0/cluster_resources"), get("/api/v0/available_resources"),
-      get("/api/v0/nodes"), get("/api/v0/actors"), get("/api/v0/tasks"),
-      get("/api/v0/placement_groups"), get("/api/v0/events"),
+      get("/api/v0/nodes"), get("/api/v0/actors"), get("/api/v0/tasks?limit=60"),
+      get("/api/v0/placement_groups"), get("/api/v0/events?limit=50"),
     ]);
     let jobs = [];
     try { jobs = await get("/api/jobs"); } catch (e) {}
@@ -127,8 +130,12 @@ async function refresh() {
     document.getElementById("err").textContent = "refresh failed: " + e;
   }
 }
-refresh();
-setInterval(refresh, 2000);
+// re-arm only after each refresh completes: overlapping polls on a
+// slow backend would interleave stale DOM writes
+(async function loop() {
+  await refresh();
+  setTimeout(loop, 2000);
+})();
 </script>
 </body>
 </html>

@@ -40,8 +40,8 @@ _STATE_ROUTES = {
 
 
 def start_http_gateway(controller, loop: asyncio.AbstractEventLoop, port: int) -> int:
-    def call(method_name):
-        coro = getattr(controller, method_name)(None)
+    def call(method_name, **kwargs):
+        coro = getattr(controller, method_name)(None, **kwargs)
         return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=10)
 
     job_lock = threading.Lock()
@@ -109,7 +109,7 @@ def start_http_gateway(controller, loop: asyncio.AbstractEventLoop, port: int) -
                 path = self.path.split("?")[0].rstrip("/")
                 if path == "/healthz":
                     self._send(200, b"ok", "text/plain")
-                elif path in ("", "/", "/dashboard"):
+                elif path in ("", "/dashboard"):  # rstrip already folded "/"
                     from ray_tpu.core.dashboard_ui import DASHBOARD_HTML
 
                     self._send(200, DASHBOARD_HTML.encode(), "text/html; charset=utf-8")
@@ -142,7 +142,14 @@ def start_http_gateway(controller, loop: asyncio.AbstractEventLoop, port: int) -
                     if method is None:
                         self._send(404, b'{"error": "unknown resource"}', "application/json")
                         return
-                    data = call(method)
+                    kwargs = {}
+                    if "?" in self.path and what in ("tasks", "objects", "events"):
+                        from urllib.parse import parse_qs, urlsplit
+
+                        q = parse_qs(urlsplit(self.path).query)
+                        if q.get("limit"):
+                            kwargs["limit"] = int(q["limit"][0])
+                    data = call(method, **kwargs)
                     self._send(200, json.dumps(data, default=str).encode(), "application/json")
                 else:
                     self._send(404, b"not found", "text/plain")
