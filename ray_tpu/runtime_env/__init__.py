@@ -10,10 +10,11 @@ Rebuild: the same two pieces, trimmed to what a TPU pod needs —
 - a **plugin registry** (:func:`register_plugin`): each key in the env dict
   maps to a setup function applied inside the worker process before the
   first task of that env runs. Built-ins: ``env_vars``, ``working_dir``,
-  ``py_modules``, ``config``. ``pip``/``conda`` raise
-  :class:`RuntimeEnvSetupError` — workers share the host interpreter and
-  the fleet has no package egress; bake deps into the image (the TPU-pod
-  deployment model) or use ``py_modules`` with local paths.
+  ``py_modules``, ``config``, and ``pip`` (per-hash ``pip install
+  --target`` — offline-capable with local wheels/dirs or gs:// wheels;
+  see :func:`_setup_pip`). ``conda``/``container`` raise
+  :class:`RuntimeEnvSetupError` — workers share the host interpreter;
+  bake system deps into the image (the TPU-pod deployment model).
 - **worker affinity by env hash**: the controller only dispatches an
   env-tagged task to a worker already in that env or to a pristine worker
   (which then becomes env-tagged) — reference behavior, collapsed into the
@@ -126,13 +127,118 @@ def _setup_config(value: dict):
     pass  # setup-timeout etc.; carried for API parity
 
 
+def _setup_pip(value):
+    """Per-env-hash pip install into a --target directory prepended to
+    sys.path (reference: _private/runtime_env/pip.py builds a venv per
+    env; workers here share the interpreter, so a target dir gives the
+    same isolation-by-precedence at a fraction of the cost).
+
+    Specs may be package names (needs an index — TPU fleets usually run
+    hermetic, so expect local use), LOCAL paths (wheels or source dirs;
+    built with --no-build-isolation against the image's setuptools —
+    fully offline), or gs://-style URIs staged through cloudfs. The
+    install runs once per unique spec list; concurrent workers wait on
+    the winner (reference: the runtime-env agent's per-URI refcounts)."""
+    import hashlib
+    import json as _json
+    import subprocess
+    import tempfile
+    import time
+
+    if isinstance(value, dict):
+        packages = list(value.get("packages", []))
+        extra_args = list(value.get("pip_install_options", []))
+    else:
+        packages = list(value)
+        extra_args = []
+    if not packages:
+        return
+    digest = hashlib.blake2s(
+        _json.dumps([sorted(packages), sorted(extra_args)]).encode()
+    ).hexdigest()[:16]
+    base = os.path.join(tempfile.gettempdir(), "ray_tpu", "pip_envs")
+    root = os.path.join(base, digest)
+    done = os.path.join(root, ".done")
+    lock = root + ".lock"
+    while not os.path.exists(done):
+        os.makedirs(base, exist_ok=True)
+        try:
+            os.close(os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+            owner = True
+        except FileExistsError:
+            # stale lock from a crashed owner (OOM-killed mid-install)
+            # must not wedge the env forever — take it over past the
+            # staleness horizon
+            try:
+                if time.time() - os.path.getmtime(lock) > 900:
+                    os.unlink(lock)
+                    continue
+            except FileNotFoundError:
+                continue  # owner just finished/failed — re-evaluate
+            owner = False
+        if owner:
+            try:
+                os.makedirs(root, exist_ok=True)
+                staged = []
+                for i, spec in enumerate(packages):
+                    from ray_tpu.utils import cloudfs
+
+                    if cloudfs.is_uri(spec):
+                        # index prefix: same-basename URIs must not collide
+                        local = os.path.join(
+                            root, f"{i}-{os.path.basename(spec)}"
+                        )
+                        cloudfs.write_bytes(local, cloudfs.read_bytes(spec))
+                        staged.append(local)
+                    else:
+                        staged.append(spec)
+                cmd = [
+                    sys.executable, "-m", "pip", "install", "--quiet",
+                    "--no-build-isolation",  # offline: ambient setuptools
+                    "--target", root, *extra_args, *staged,
+                ]
+                r = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+                if r.returncode != 0:
+                    raise RuntimeEnvSetupError(
+                        f"pip install failed: {r.stderr[-800:] or r.stdout[-800:]}"
+                    )
+                open(done, "w").close()
+            finally:
+                try:
+                    os.unlink(lock)
+                except FileNotFoundError:
+                    pass
+            break
+        else:
+            # waiter outlives the owner's worst case (staging + the 600s
+            # pip timeout) so a slow-but-successful install isn't failed
+            deadline = time.time() + 900
+            while not os.path.exists(done):
+                if time.time() > deadline:
+                    raise RuntimeEnvSetupError(
+                        "timed out waiting for a concurrent pip env install"
+                    )
+                if not os.path.exists(lock):
+                    # owner exited: success wrote .done FIRST, so re-check
+                    # it before declaring failure (TOCTOU)
+                    if os.path.exists(done):
+                        break
+                    raise RuntimeEnvSetupError(
+                        "concurrent pip env install failed (no .done marker)"
+                    )
+                time.sleep(0.25)
+            break
+    if root not in sys.path:
+        sys.path.insert(0, root)
+
+
 def _setup_unsupported(kind: str):
     def fail(value):
         raise RuntimeEnvSetupError(
             f"runtime_env[{kind!r}] is not supported: workers share the host "
             "interpreter and TPU fleets run hermetic images with no package "
             "egress. Bake dependencies into the image, or ship local code "
-            "with py_modules/working_dir."
+            "with py_modules/working_dir/pip (local wheels)."
         )
 
     return fail
@@ -142,7 +248,7 @@ register_plugin("env_vars", _setup_env_vars)
 register_plugin("working_dir", _setup_working_dir)
 register_plugin("py_modules", _setup_py_modules)
 register_plugin("config", _setup_config)
-register_plugin("pip", _setup_unsupported("pip"))
+register_plugin("pip", _setup_pip)
 register_plugin("conda", _setup_unsupported("conda"))
 
 # ---------------------------------------------------------------------------

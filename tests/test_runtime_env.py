@@ -88,10 +88,34 @@ def test_actor_runtime_env(ray_start_regular):
     assert ray_tpu.get(a.read.remote()) == "yes"
 
 
-def test_pip_rejected(ray_start_regular):
-    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+def test_pip_local_package_env(ray_start_regular, tmp_path):
+    """runtime_env={"pip": [...]} installs LOCAL packages into a cached
+    per-hash --target dir on the worker (reference:
+    _private/runtime_env/pip.py; offline-capable — hermetic TPU images
+    have no package index)."""
+    pkg = tmp_path / "minipkg"
+    (pkg / "minipkg_rt").mkdir(parents=True)
+    (pkg / "minipkg_rt" / "__init__.py").write_text("MAGIC = 'rt-pip-41'\n")
+    (pkg / "setup.py").write_text(
+        "from setuptools import setup, find_packages\n"
+        "setup(name='minipkg-rt', version='0.1', packages=find_packages())\n"
+    )
+
+    @ray_tpu.remote(runtime_env={"pip": [str(pkg)]})
+    def use_it():
+        import minipkg_rt
+
+        return minipkg_rt.MAGIC
+
+    assert ray_tpu.get(use_it.remote(), timeout=120) == "rt-pip-41"
+    # second task reuses the cached env (same hash, no reinstall)
+    assert ray_tpu.get(use_it.remote(), timeout=60) == "rt-pip-41"
+
+
+def test_pip_missing_package_fails(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"pip": ["/definitely/not/a/package"]})
     def f():
         return 1
 
     with pytest.raises((RuntimeEnvSetupError, TaskError)):
-        ray_tpu.get(f.remote())
+        ray_tpu.get(f.remote(), timeout=120)
