@@ -153,8 +153,20 @@ def _setup_pip(value):
         extra_args = []
     if not packages:
         return
+    def _spec_key(spec: str):
+        # local specs key on (path, mtime, size) so a rebuilt wheel or
+        # edited source dir gets a fresh env instead of the stale cache
+        # (per-file content hashing is the reference's heavier answer);
+        # dir mtime only tracks top-level changes — `touch` the dir after
+        # deep edits, or bump the package version.
+        try:
+            st = os.stat(spec)
+            return [spec, int(st.st_mtime_ns), st.st_size]
+        except OSError:
+            return [spec]
+
     digest = hashlib.blake2s(
-        _json.dumps([sorted(packages), sorted(extra_args)]).encode()
+        _json.dumps([sorted(map(_spec_key, packages)), sorted(extra_args)]).encode()
     ).hexdigest()[:16]
     base = os.path.join(tempfile.gettempdir(), "ray_tpu", "pip_envs")
     root = os.path.join(base, digest)
@@ -170,13 +182,31 @@ def _setup_pip(value):
             # must not wedge the env forever — take it over past the
             # staleness horizon
             try:
-                if time.time() - os.path.getmtime(lock) > 900:
+                # live owners heartbeat the lock mtime every 5s, so 120s
+                # of silence really means a dead owner
+                if time.time() - os.path.getmtime(lock) > 120:
                     os.unlink(lock)
                     continue
             except FileNotFoundError:
                 continue  # owner just finished/failed — re-evaluate
             owner = False
         if owner:
+            import threading
+
+            # heartbeat thread keeps the lock mtime fresh through BOTH
+            # staging and the pip run — the 120s takeover check must only
+            # ever fire on a genuinely dead owner
+            stop_hb = threading.Event()
+
+            def _hb():
+                while not stop_hb.is_set():
+                    try:
+                        os.utime(lock)
+                    except OSError:
+                        return
+                    stop_hb.wait(5)
+
+            threading.Thread(target=_hb, daemon=True).start()
             try:
                 os.makedirs(root, exist_ok=True)
                 staged = []
@@ -188,7 +218,7 @@ def _setup_pip(value):
                         local = os.path.join(
                             root, f"{i}-{os.path.basename(spec)}"
                         )
-                        cloudfs.write_bytes(local, cloudfs.read_bytes(spec))
+                        cloudfs.download_file(spec, local)  # streamed
                         staged.append(local)
                     else:
                         staged.append(spec)
@@ -204,6 +234,7 @@ def _setup_pip(value):
                     )
                 open(done, "w").close()
             finally:
+                stop_hb.set()
                 try:
                     os.unlink(lock)
                 except FileNotFoundError:

@@ -48,14 +48,30 @@ class Trial:
 class _TuneSession:
     """Per-trial worker-side session: report()/get_checkpoint() plumbing."""
 
-    def __init__(self, config, local_dir, restored_checkpoint):
+    def __init__(self, config, local_dir, restored_checkpoint, remote_dir=None):
         self.config = config
         self.local_dir = local_dir
+        # cloud experiment dir (reference: storage_path URIs): reported
+        # checkpoints upload here and the REMOTE path is what the
+        # controller records/restores from
+        self.remote_dir = remote_dir
         self.result_queue: "queue.Queue" = queue.Queue(maxsize=4)
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
         self.restored_checkpoint = restored_checkpoint
+        # continue numbering past any pre-restore checkpoints in the
+        # local dir — a reset would re-upload to colliding remote names
         self.ckpt_seq = 0
+        try:
+            existing = [
+                int(d.split("_")[-1])
+                for d in os.listdir(local_dir)
+                if d.startswith("checkpoint_")
+            ]
+            if existing:
+                self.ckpt_seq = max(existing) + 1
+        except (OSError, ValueError):
+            pass
 
 
 _session: Optional[_TuneSession] = None
@@ -65,6 +81,12 @@ def report(metrics: dict, checkpoint_dir: Optional[str] = None):
     """tune.report inside a trainable (reference: ray.tune.report)."""
     if _session is None:
         raise RuntimeError("tune.report() called outside a Tune trial")
+    if checkpoint_dir and _session.remote_dir:
+        from ray_tpu.utils import cloudfs
+
+        dest = cloudfs.join(_session.remote_dir, os.path.basename(checkpoint_dir))
+        cloudfs.copy_dir(checkpoint_dir, dest)
+        checkpoint_dir = dest  # the durable path is what gets recorded
     _session.result_queue.put({"metrics": dict(metrics), "checkpoint": checkpoint_dir})
 
 
@@ -89,13 +111,28 @@ class TrialRunner:
     results to the controller (reference: FunctionTrainable + the
     ray.air.execution actor manager's train-result polling)."""
 
-    def __init__(self, fn_blob: bytes, config: dict, local_dir: str, restored_checkpoint):
+    def __init__(self, fn_blob: bytes, config: dict, local_dir: str, restored_checkpoint,
+                 remote_dir=None):
         from ray_tpu.utils.serialization import deserialize_function
 
         global _session
         os.makedirs(local_dir, exist_ok=True)
+        if restored_checkpoint:
+            from ray_tpu.utils import cloudfs
+
+            if cloudfs.is_uri(restored_checkpoint):
+                # download the durable checkpoint into a FIXED slot in the
+                # trial's local dir — restarts overwrite it instead of
+                # leaking one mkdtemp download per attempt
+                local = os.path.join(local_dir, "_restored")
+                import shutil as _sh
+
+                _sh.rmtree(local, ignore_errors=True)
+                cloudfs.copy_dir(restored_checkpoint, local)
+                restored_checkpoint = local
         self._fn = deserialize_function(fn_blob)
-        self._session = _TuneSession(config, local_dir, restored_checkpoint)
+        self._session = _TuneSession(config, local_dir, restored_checkpoint,
+                                     remote_dir=remote_dir)
         _session = self._session
         self._thread = threading.Thread(target=self._run, daemon=True, name="trial-fn")
         self._thread.start()

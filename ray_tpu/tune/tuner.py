@@ -112,10 +112,27 @@ class TuneController:
         experiment_dir: str,
         restore_state: Optional[dict] = None,
     ):
+        from ray_tpu.utils import cloudfs
+
         self._fn_blob = serialize_function(trainable)
         self._cfg = tune_config
         self._dir = experiment_dir
-        os.makedirs(experiment_dir, exist_ok=True)
+        # Cloud experiment dirs (reference: storage_path via pyarrow.fs):
+        # tuner state + reported checkpoints persist to the URI; trials
+        # get a LOCAL scratch working dir.
+        self._dir_is_uri = cloudfs.is_uri(experiment_dir)
+        cloudfs.makedirs(experiment_dir)
+        if self._dir_is_uri:
+            import hashlib
+            import tempfile
+
+            tag = hashlib.blake2s(experiment_dir.encode()).hexdigest()[:12]
+            self._scratch = os.path.join(
+                tempfile.gettempdir(), "ray_tpu", "tune_scratch", tag
+            )
+            os.makedirs(self._scratch, exist_ok=True)
+        else:
+            self._scratch = experiment_dir
         self._searcher = tune_config.search_alg or BasicVariantGenerator(
             param_space, tune_config.num_samples, seed=tune_config.seed
         )
@@ -171,6 +188,14 @@ class TuneController:
             "exhausted": self._exhausted,
             "next_id": self._next_id,
         }
+        from ray_tpu.utils import cloudfs
+
+        if self._dir_is_uri:
+            cloudfs.write_text(
+                cloudfs.join(self._dir, "tuner_state.json"),
+                json.dumps(state, default=_json_np),
+            )  # object PUT is atomic
+            return
         tmp = os.path.join(self._dir, ".tuner_state.json.tmp")
         with open(tmp, "w") as f:
             json.dump(state, f, default=_json_np)
@@ -208,11 +233,16 @@ class TuneController:
         new_cfg = self._scheduler.choose_config(t)
         if new_cfg is not None:
             t.config = new_cfg
+        from ray_tpu.utils import cloudfs
+
         t.actor = runner_cls.remote(
             self._fn_blob,
             t.config,
-            os.path.join(self._dir, t.trial_id),
+            os.path.join(self._scratch, t.trial_id),
             t.checkpoint_dir if restore else None,
+            remote_dir=(
+                cloudfs.join(self._dir, t.trial_id) if self._dir_is_uri else None
+            ),
         )
         t.status = RUNNING
         self._state_dirty = True
@@ -392,8 +422,11 @@ class Tuner:
         checkpoint when one was reported). ``param_space`` must be re-passed
         when the search was not yet exhausted, so remaining variants can
         still be generated."""
-        with open(os.path.join(path, "tuner_state.json")) as f:
-            state = json.load(f)
+        from ray_tpu.utils import cloudfs
+
+        state = json.loads(
+            cloudfs.read_text(cloudfs.join(path, "tuner_state.json"))
+        )
         return cls(
             trainable,
             param_space=param_space,

@@ -195,6 +195,19 @@ def copy_dir(src: str, dest: str) -> None:
             f.write(data)
 
 
+def download_file(src: str, dest: str, chunk: int = 8 * 1024 * 1024) -> None:
+    """Stream a (possibly multi-GB) URI file to a local path in chunks —
+    O(chunk) memory, unlike read_bytes/write_bytes."""
+    fs, p = _fs(src)
+    os.makedirs(os.path.dirname(normalize(dest)) or ".", exist_ok=True)
+    with fs.open(p, "rb") as fin, open(normalize(dest), "wb") as fout:
+        while True:
+            buf = fin.read(chunk)
+            if not buf:
+                return
+            fout.write(buf)
+
+
 def as_local_dir(path: str) -> Tuple[str, bool]:
     """(local_dir, is_temp): a local view of ``path`` — downloads URI
     contents to a temp dir (caller cleans up when is_temp)."""

@@ -181,3 +181,49 @@ def test_workflow_storage_on_uri(ray_start_regular):
     steps = cloudfs.listdir(f"mock://wf_bucket/flows/{wf_id}/steps")
     assert steps
     workflow.init(None)  # reset storage for other tests
+
+
+def test_tune_experiment_on_uri(ray_start_regular):
+    """Tune with a cloud storage_path: tuner state and reported trial
+    checkpoints persist to the bucket (trials work in local scratch);
+    Tuner.restore resumes from the URI (reference: Tune storage_path
+    through pyarrow.fs)."""
+    from ray_tpu import tune
+    from ray_tpu.tune import TuneConfig, Tuner
+
+    def trainable(config):
+        import os as _os
+
+        import tests.mockfs  # noqa: F401 — register mock:// in the trial actor
+        from ray_tpu import tune as _tune
+
+        for i in range(2):
+            d = _tune.make_checkpoint_dir()
+            with open(_os.path.join(d, "w.txt"), "w") as f:
+                f.write(str(config["x"] * (i + 1)))
+            _tune.report({"score": config["x"] * (i + 1)}, checkpoint_dir=d)
+
+    class RC:
+        name = "uri_exp"
+        storage_path = "mock://tune_bucket"
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=1),
+        run_config=RC(),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 4
+    # durable state + checkpoints live on the bucket
+    assert cloudfs.exists("mock://tune_bucket/uri_exp/tuner_state.json")
+    assert best.checkpoint and best.checkpoint.path.startswith("mock://")
+    # restore from the URI sees the finished experiment
+    tuner2 = Tuner.restore(
+        "mock://tune_bucket/uri_exp", trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=1),
+    )
+    grid2 = tuner2.fit()
+    assert grid2.get_best_result().metrics["score"] == 4
