@@ -30,6 +30,29 @@ def bench_many_tasks(n: int) -> dict:
     return {"benchmark": "many_tasks", "n": n, "tasks_per_s": round(n / dt, 1)}
 
 
+def bench_sequential_task_latency(n: int = 1000) -> dict:
+    """1:1 sequential task round-trips — the per-task latency floor of
+    the LEASE path (submit → push to the held lease → reply → get),
+    reference microbenchmark: 'single client tasks sync'."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def noop():
+        return 0
+
+    ray_tpu.get(noop.remote())  # lease + worker warm
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(noop.remote())
+    dt = time.perf_counter() - t0
+    return {
+        "benchmark": "sequential_task_roundtrips",
+        "n": n,
+        "tasks_per_s": round(n / dt, 1),
+        "p_latency_ms": round(dt / n * 1e3, 2),
+    }
+
+
 def bench_many_actors(n: int) -> dict:
     import ray_tpu
 
@@ -231,6 +254,7 @@ def main():
         # discard the lines already earned.
         for fn, fnargs in (
             (bench_many_tasks, (args.tasks,)),
+            (bench_sequential_task_latency, (1000,)),
             (bench_many_actors, (args.actors,)),
             (bench_actor_call_throughput, (args.calls,)),
             (bench_1to1_async_calls, (args.direct_calls,)),
