@@ -111,10 +111,23 @@ def _step_key(idx: int, node: DAGNode) -> str:
     return f"{idx:04d}_{name}"
 
 
-def _execute_workflow(dag: DAGNode, workflow_id: str, args: tuple, kwargs: dict):
+def _execute_workflow(dag: DAGNode, workflow_id: str, args: tuple, kwargs: dict,
+                      max_concurrent_steps=None):
     import ray_tpu
 
     wf_dir = _wf_dir(workflow_id)
+    pending_refs: list = []
+
+    def _throttle():
+        # workflow-level step-concurrency cap (reference: workflow's
+        # max_running_workflows/queueing knobs): hold submission until a
+        # slot frees — topo order is preserved
+        if not max_concurrent_steps:
+            return
+        while len(pending_refs) >= max_concurrent_steps:
+            ready, _ = ray_tpu.wait(pending_refs, num_returns=1, timeout=None)
+            for r in ready:
+                pending_refs.remove(r)
     order = dag.topo_sort()
     results: Dict[int, Any] = {}
 
@@ -172,7 +185,16 @@ def _execute_workflow(dag: DAGNode, workflow_id: str, args: tuple, kwargs: dict)
                 ),
                 retry_exceptions=retry_exc,
             )
-            results[id(node)] = shim.remote(rf._fn, wf_dir, key, *rargs, **rkwargs)
+            if shim_fn is _run_event_step:
+                # event WAITERS don't occupy compute slots — counting
+                # them could deadlock a capped DAG whose trigger step
+                # hasn't been submitted yet
+                ref = shim.remote(rf._fn, wf_dir, key, *rargs, **rkwargs)
+            else:
+                _throttle()
+                ref = shim.remote(rf._fn, wf_dir, key, *rargs, **rkwargs)
+                pending_refs.append(ref)
+            results[id(node)] = ref
         else:
             raise ValueError(
                 f"workflows support function DAGs; got {type(node).__name__} "
@@ -187,21 +209,33 @@ def _execute_workflow(dag: DAGNode, workflow_id: str, args: tuple, kwargs: dict)
     return out
 
 
-def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs):
-    """Start (or restart) a workflow; returns the output ObjectRef(s)."""
+def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+              max_concurrent_steps: Optional[int] = None, **kwargs):
+    """Start (or restart) a workflow; returns the output ObjectRef(s).
+    ``max_concurrent_steps`` caps how many of this workflow's steps run
+    at once (submission throttles; topo order preserved)."""
     import ray_tpu
 
     workflow_id = workflow_id or f"wf_{uuid.uuid4().hex[:12]}"
     cloudfs.makedirs(cloudfs.join(_wf_dir(workflow_id), "steps"))
     _write_meta(
         workflow_id,
-        **{"workflow_id": workflow_id, "status": "RUNNING", "start_time": time.time()},
+        **{
+            "workflow_id": workflow_id,
+            "status": "RUNNING",
+            "start_time": time.time(),
+            # persisted so resume() re-applies the same cap
+            "max_concurrent_steps": max_concurrent_steps,
+        },
     )
     cloudfs.write_bytes(
         cloudfs.join(_wf_dir(workflow_id), "dag.pkl"), serialize((dag, args, kwargs))
     )
     try:
-        out = _execute_workflow(dag, workflow_id, args, kwargs)
+        out = _execute_workflow(
+            dag, workflow_id, args, kwargs,
+            max_concurrent_steps=max_concurrent_steps,
+        )
     except Exception:
         _write_meta(workflow_id, status="FAILED", end_time=time.time())
         raise
@@ -224,13 +258,17 @@ def continuation(dag: DAGNode, *args, **kwargs) -> Continuation:
 
 
 def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
-        catch_exceptions: bool = False, **kwargs):
+        catch_exceptions: bool = False,
+        max_concurrent_steps: Optional[int] = None, **kwargs):
     """Run to completion; returns the final value(s). With
     ``catch_exceptions`` the result is ``(value, None)`` on success or
     ``(None, exception)`` on failure (reference:
     workflow.options(catch_exceptions=True) surfaced at run)."""
     try:
-        value = _run_inner(dag, *args, workflow_id=workflow_id, **kwargs)
+        value = _run_inner(
+            dag, *args, workflow_id=workflow_id,
+            max_concurrent_steps=max_concurrent_steps, **kwargs,
+        )
     except Exception as e:  # noqa: BLE001 — surfaced per catch_exceptions
         if catch_exceptions:
             return None, e
@@ -238,10 +276,14 @@ def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
     return (value, None) if catch_exceptions else value
 
 
-def _run_inner(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs):
+def _run_inner(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+               max_concurrent_steps: Optional[int] = None, **kwargs):
     import ray_tpu
 
-    workflow_id, out = run_async(dag, *args, workflow_id=workflow_id, **kwargs)
+    workflow_id, out = run_async(
+        dag, *args, workflow_id=workflow_id,
+        max_concurrent_steps=max_concurrent_steps, **kwargs,
+    )
     try:
         from ray_tpu.core.object_ref import ObjectRef
 
@@ -265,7 +307,9 @@ def _run_inner(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs)
             depth += 1
             value = _run_inner(
                 value.dag, *value.args,
-                workflow_id=f"{workflow_id}.c{depth}", **value.kwargs,
+                workflow_id=f"{workflow_id}.c{depth}",
+                max_concurrent_steps=max_concurrent_steps,  # cap carries
+                **value.kwargs,
             )
     except Exception:
         _write_meta(workflow_id, status="RESUMABLE", end_time=time.time())
@@ -375,7 +419,10 @@ def resume(workflow_id: str):
     if not cloudfs.exists(dag_path):
         raise ValueError(f"no stored workflow {workflow_id!r}")
     dag, args, kwargs = deserialize(cloudfs.read_bytes(dag_path))
-    return run(dag, *args, workflow_id=workflow_id, **kwargs)
+    cap = _read_meta(workflow_id).get("max_concurrent_steps")
+    return run(
+        dag, *args, workflow_id=workflow_id, max_concurrent_steps=cap, **kwargs
+    )
 
 
 def get_status(workflow_id: str) -> str:

@@ -98,3 +98,39 @@ def test_workflow_multi_output_and_delete(ray_start_regular, wf_storage):
     assert workflow.run(dag, 7, workflow_id="wf4") == [8, 6]
     workflow.delete("wf4")
     assert all(w["workflow_id"] != "wf4" for w in workflow.list_all())
+
+
+def test_max_concurrent_steps_caps_parallelism(ray_start_regular, wf_storage, tmp_path):
+    """workflow.run(max_concurrent_steps=N) throttles step submission
+    (reference: workflow queueing/concurrency knobs)."""
+    import json as _json
+
+    from ray_tpu import workflow
+
+    log = str(tmp_path / "spans")
+    os.makedirs(log, exist_ok=True)
+
+    @ray_tpu.remote
+    def step(i, logdir):
+        import json as _j
+        import time as _t
+
+        t0 = _t.time()
+        _t.sleep(0.4)
+        with open(f"{logdir}/{i}.json", "w") as f:
+            _j.dump([t0, _t.time()], f)
+        return i
+
+    from ray_tpu.dag.node import MultiOutputNode
+
+    dag = MultiOutputNode([step.bind(i, log) for i in range(6)])
+    out = workflow.run(dag, workflow_id="capped", max_concurrent_steps=2)
+    assert sorted(out) == list(range(6))  # run() materializes list outputs
+    spans = []
+    for f in os.listdir(log):
+        spans.append(_json.load(open(f"{log}/{f}")))
+    # max overlap <= 2 at any step start
+    overlap = max(
+        sum(1 for (s2, e2) in spans if s2 <= s < e2) for (s, _e) in spans
+    )
+    assert overlap <= 2, f"overlap {overlap}, spans {spans}"
