@@ -213,9 +213,14 @@ def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None,
               max_concurrent_steps: Optional[int] = None, **kwargs):
     """Start (or restart) a workflow; returns the output ObjectRef(s).
     ``max_concurrent_steps`` caps how many of this workflow's steps run
-    at once (submission throttles; topo order preserved)."""
+    at once (submission throttles; topo order preserved); None/omitted =
+    uncapped."""
     import ray_tpu
 
+    if max_concurrent_steps is not None and max_concurrent_steps < 1:
+        raise ValueError(
+            f"max_concurrent_steps must be >= 1 or None, got {max_concurrent_steps}"
+        )
     workflow_id = workflow_id or f"wf_{uuid.uuid4().hex[:12]}"
     cloudfs.makedirs(cloudfs.join(_wf_dir(workflow_id), "steps"))
     _write_meta(
