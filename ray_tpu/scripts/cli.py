@@ -124,6 +124,19 @@ def cmd_status(args):
     return 0
 
 
+def cmd_dashboard(args):
+    """Print the dashboard URL (reference: `ray dashboard`)."""
+    _connect()
+    from ray_tpu.util import state as state_api
+
+    url = state_api.dashboard_url()
+    if url is None:
+        print("dashboard disabled (dashboard_port=-1)")
+        return 1
+    print(url)
+    return 0
+
+
 def cmd_submit(args):
     from ray_tpu.job import JobSubmissionClient
 
@@ -302,6 +315,9 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_timeline)
 
     sub.add_parser("memory", help="object store summary").set_defaults(fn=cmd_memory)
+    sub.add_parser("dashboard", help="print the dashboard URL").set_defaults(
+        fn=cmd_dashboard
+    )
 
     sp = sub.add_parser("stack", help="live thread stacks of all cluster processes")
     sp.add_argument("--timeout", type=float, default=10.0)

@@ -95,6 +95,10 @@ def test_cli_start_status_submit_stop(tmp_path):
         r = _cli("summary", "tasks", env=env)
         assert r.returncode == 0
         json.loads(r.stdout)
+
+        r = _cli("dashboard", env=env)
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert r.stdout.strip().startswith("http://")
     finally:
         r = _cli("stop", env=env)
     assert r.returncode == 0
