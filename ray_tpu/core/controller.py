@@ -514,6 +514,17 @@ class Controller:
                 req.fut.set_result(grant)
         self._lease_reqs.extend(still)
 
+    def _spawn_head_direct(self, node):
+        """Spawn one direct-pool worker on the head node (the controller
+        doubles as the head's node agent)."""
+        from ray_tpu.core.node_agent import spawn_worker
+
+        node.num_starting += 1
+        spawn_worker(
+            self.session_dir, f"127.0.0.1:{self.port}", node.node_id,
+            node.shm_dir, extra_env={"RAY_TPU_WORKER_POOL": "direct"},
+        )
+
     async def rpc_lease_worker(self, peer: rpc.Peer, lease_id: bytes, ehash: str):
         """Hand out a head-node worker for a granted lease — the
         controller doubles as the head's node agent (reference: the
@@ -526,18 +537,12 @@ class Controller:
         w = self._head_direct_pop(ehash)
         while w is None:
             if len(node.workers) + node.num_starting < node.max_workers:
-                from ray_tpu.core.node_agent import spawn_worker
-
-                node.num_starting += 1
-                spawn_worker(
-                    self.session_dir, f"127.0.0.1:{self.port}", node.node_id,
-                    node.shm_dir, extra_env={"RAY_TPU_WORKER_POOL": "direct"},
-                )
+                self._spawn_head_direct(node)
             else:
                 # pool at cap: retire one mismatched free direct worker so
                 # a pristine replacement can spawn (reference:
                 # _recycle_idle_worker / worker_pool idle eviction)
-                await self._retire_mismatched_direct(ehash)
+                await self._retire_mismatched_direct(ehash, node)
             fut = asyncio.get_running_loop().create_future()
             self._head_direct_waiters.append((ehash, fut))
             w = await fut
@@ -555,7 +560,7 @@ class Controller:
         w.env_hash = ehash or w.env_hash
         return {"worker_addr": w.listen_addr, "worker_id": w.worker_id.hex()}
 
-    async def _retire_mismatched_direct(self, ehash: str):
+    async def _retire_mismatched_direct(self, ehash: str, node=None):
         for wid in list(self._head_direct_free):
             w = self.workers.get(wid)
             if w is None or w.state == "DEAD":
@@ -568,6 +573,12 @@ class Controller:
                     await w.peer.notify("exit")
                 except Exception:  # noqa: BLE001
                     pass
+                # Pair the kill with a replacement spawn (mirrors
+                # NodeAgent._retire_mismatched) so the parked caller isn't
+                # left waiting on its own 30s lease timeout for capacity
+                # that only frees when the retired worker's death is seen.
+                if node is not None:
+                    self._spawn_head_direct(node)
                 return
 
     def _head_direct_pop(self, ehash: str) -> Optional[WorkerRecord]:
@@ -622,14 +633,7 @@ class Controller:
                     if self._head_direct_waiters and (
                         len(node.workers) + node.num_starting < node.max_workers
                     ):
-                        from ray_tpu.core.node_agent import spawn_worker
-
-                        node.num_starting += 1
-                        spawn_worker(
-                            self.session_dir, f"127.0.0.1:{self.port}",
-                            node.node_id, node.shm_dir,
-                            extra_env={"RAY_TPU_WORKER_POOL": "direct"},
-                        )
+                        self._spawn_head_direct(node)
                 else:
                     self._head_direct_put(w)
         else:

@@ -130,6 +130,12 @@ class NodeAgent:
         self._direct_starting = 0
         self._direct_spawns: list = []  # Popen handles not yet attached
         self._lease_workers: Dict[bytes, str] = {}  # lease_id -> worker id
+        # rpc_lease_worker grants in flight, and leases released while
+        # their grant was still in flight (bounded: only grants currently
+        # executing can enter _released_leases; the grant's finally
+        # clears both).
+        self._granting: set = set()
+        self._released_leases: set = set()
         ncpu = int(resources.get("CPU", 1))
         self._max_direct = max(4 * max(ncpu, 1), 16)
         self._listen_addr = ""  # set in run()
@@ -196,17 +202,26 @@ class NodeAgent:
         the object."""
         from ray_tpu.core.object_transfer import InflightPull, fetch_into, pull_into_store
 
+        already = self.store.contains(oid) and self.store.ensure_local(oid)
+        # Register the inflight entry BEFORE the downstream hop is kicked:
+        # the downstream's first fetch_chunk can arrive before our own
+        # upstream pull has created the buffer, and must park on the
+        # watermark instead of hitting a store miss in ChunkReader.
+        entry = None
+        if next_addrs and not already:
+            entry = InflightPull(None, size)
+            self._inflight_pulls[oid] = entry
         down_fut = None
-        if next_addrs:
-            nxt = await self._fetch_peers.get(next_addrs[0])
-            if nxt is None:
-                raise ConnectionError(f"cannot reach next hop {next_addrs[0]}")
-            down_fut = asyncio.ensure_future(
-                nxt.call("pull_chain", oid, size, self._listen_addr, next_addrs[1:])
-            )
         ok = True
         try:
-            if self.store.contains(oid) and self.store.ensure_local(oid):
+            if next_addrs:
+                nxt = await self._fetch_peers.get(next_addrs[0])
+                if nxt is None:
+                    raise ConnectionError(f"cannot reach next hop {next_addrs[0]}")
+                down_fut = asyncio.ensure_future(
+                    nxt.call("pull_chain", oid, size, self._listen_addr, next_addrs[1:])
+                )
+            if already:
                 pass  # already local: just relay
             else:
                 src_peer = await self._peer_for(src_addr)
@@ -218,10 +233,28 @@ class NodeAgent:
                         self.store, oid, size, src_peer, self._chunk_bytes
                     )
                     buf = None
+                    # unpark downstream readers: the object is now stored
+                    # (or the pull failed) — they re-check the store.
+                    # Always settle OUR entry (a concurrent chain for the
+                    # same oid may have overwritten the dict slot; its
+                    # readers are parked on a different entry), and pop
+                    # the slot only if it is still ours.
+                    if entry is not None:
+                        if self._inflight_pulls.get(oid) is entry:
+                            self._inflight_pulls.pop(oid, None)
+                        if ok:
+                            entry.advance(size)
+                        else:
+                            entry.fail()
+                        entry = None
                 if buf is not None:
                     view = buf.view()
-                    entry = InflightPull(view, size)
-                    self._inflight_pulls[oid] = entry
+                    if entry is None:
+                        entry = InflightPull(view, size)
+                        if oid not in self._inflight_pulls:
+                            self._inflight_pulls[oid] = entry
+                    else:
+                        entry.view = view
                     err = await fetch_into(
                         src_peer, oid, size, view, self._chunk_bytes,
                         progress=entry.advance,
@@ -231,7 +264,8 @@ class NodeAgent:
                     entry.view = None
                     del view
                     buf.close()
-                    self._inflight_pulls.pop(oid, None)
+                    if self._inflight_pulls.get(oid) is entry:
+                        self._inflight_pulls.pop(oid, None)
                     if err is not None:
                         entry.fail()
                         self.store.delete(oid)
@@ -245,6 +279,10 @@ class NodeAgent:
                         "object_sealed", oid, size, self.node_id
                     )
         except Exception:
+            if entry is not None:
+                if self._inflight_pulls.get(oid) is entry:
+                    self._inflight_pulls.pop(oid, None)
+                entry.fail()
             if down_fut is not None:
                 down_fut.cancel()
             raise
@@ -265,7 +303,7 @@ class NodeAgent:
         self._hand_to_waiter(w)
 
     def _hand_to_waiter(self, w: _DirectWorker) -> bool:
-        for i, (ehash, fut) in enumerate(self._direct_waiters):
+        for i, (ehash, _lid, fut) in enumerate(self._direct_waiters):
             if not fut.done() and w.env_hash in ("", ehash):
                 del self._direct_waiters[i]
                 w.busy = True
@@ -290,23 +328,39 @@ class NodeAgent:
         The controller reserved the lease's resources; this side only
         manages processes (reference: LocalTaskManager dispatch popping
         from the WorkerPool, local_task_manager.cc:122)."""
-        w = self._pop_free(ehash)
-        if w is None:
-            if len(self._direct) + self._direct_starting < self._max_direct:
-                self._spawn_direct()
+        lid = bytes(lease_id)
+        self._granting.add(lid)
+        try:
+            w = self._pop_free(ehash)
+            if w is None:
+                if len(self._direct) + self._direct_starting < self._max_direct:
+                    self._spawn_direct()
+                else:
+                    self._retire_mismatched(ehash)
+                fut = asyncio.get_running_loop().create_future()
+                self._direct_waiters.append((ehash, lid, fut))
+                w = await fut
             else:
-                self._retire_mismatched(ehash)
-            fut = asyncio.get_running_loop().create_future()
-            self._direct_waiters.append((ehash, fut))
-            w = await fut
-        else:
-            w.busy = True
-            w.env_hash = ehash or w.env_hash
-        # lease→worker binding lets the CONTROLLER free this worker when
-        # the lease-holder dies without ever sending lease_return (its
-        # disconnect cleanup relays rpc_lease_release here)
-        self._lease_workers[bytes(lease_id)] = w.wid
-        return {"worker_addr": w.addr, "worker_id": w.wid}
+                w.busy = True
+                w.env_hash = ehash or w.env_hash
+            # The await races lease_release: the caller's 30s lease RPC may
+            # have timed out (controller relayed the release before any
+            # binding existed). Binding the worker to the dead lease would
+            # strand it busy forever — pool it instead.
+            if lid in self._released_leases:
+                w.busy = False
+                self._hand_to_waiter(w)
+                raise ConnectionError(
+                    f"lease {lid!r} released while waiting for a worker"
+                )
+            # lease→worker binding lets the CONTROLLER free this worker when
+            # the lease-holder dies without ever sending lease_return (its
+            # disconnect cleanup relays rpc_lease_release here)
+            self._lease_workers[lid] = w.wid
+            return {"worker_addr": w.addr, "worker_id": w.wid}
+        finally:
+            self._granting.discard(lid)
+            self._released_leases.discard(lid)
 
     def _spawn_direct(self):
         self._direct_starting += 1
@@ -360,8 +414,24 @@ class NodeAgent:
         the binding first). With ``kill_worker`` the worker may be
         mid-task on an orphaned push — exit it rather than pooling a
         busy worker."""
-        wid = self._lease_workers.pop(bytes(lease_id), None)
+        lid = bytes(lease_id)
+        wid = self._lease_workers.pop(lid, None)
         if wid is None:
+            # The caller may still be parked in rpc_lease_worker (its
+            # lease RPC timed out): fail the waiter so a later worker
+            # never binds to the dead lease, or — if the hand-off already
+            # happened but the binding hasn't been written — flag the
+            # lease so the grant path pools the worker instead.
+            for i, (_ehash, wlid, fut) in enumerate(self._direct_waiters):
+                if wlid == lid:
+                    del self._direct_waiters[i]
+                    if not fut.done():
+                        fut.set_exception(
+                            ConnectionError("lease released while parked")
+                        )
+                    return
+            if lid in self._granting:
+                self._released_leases.add(lid)
             return
         w = self._direct.get(wid)
         if w is None:

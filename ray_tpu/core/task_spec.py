@@ -185,10 +185,19 @@ def unpack_actor_task(t: tuple) -> TaskSpec:
 
 def pack_normal_task(spec: TaskSpec) -> tuple:
     """Trimmed wire form for the direct normal-task push (reference:
-    PushTask carries a trimmed TaskSpec). Scheduling fields stay behind —
-    placement already happened at lease time; the executing worker only
-    needs identity + code + args. Resources travel so lineage
-    reconstruction (controller resubmit of shm results) can reschedule."""
+    PushTask carries a trimmed TaskSpec). Resources AND the scheduling
+    strategy travel so lineage reconstruction (controller resubmit of
+    shm results, rpc_task_lineage) can reschedule a PG-pinned or
+    node-affinity task with its original placement; DEFAULT strategies
+    (the common case) encode as None to keep the tuple cheap."""
+    st = spec.scheduling_strategy
+    packed_st = None
+    if st.kind != "DEFAULT" or st.node_labels:
+        packed_st = (
+            st.kind, st.node_id, st.soft,
+            st.placement_group_id.binary() if st.placement_group_id else None,
+            st.bundle_index, st.node_labels,
+        )
     return (
         spec.task_id.binary(),
         spec.name,
@@ -201,10 +210,23 @@ def pack_normal_task(spec: TaskSpec) -> tuple:
         [d.binary() for d in spec.dependencies],
         tuple(spec.resources.items_fp()),
         spec.max_retries,
+        packed_st,
+        spec.retry_exceptions,
     )
 
 
 def unpack_normal_task(t: tuple) -> TaskSpec:
+    packed_st = t[11] if len(t) > 11 else None
+    if packed_st is not None:
+        strategy = SchedulingStrategy(
+            kind=packed_st[0], node_id=packed_st[1], soft=packed_st[2],
+            placement_group_id=(
+                PlacementGroupID(packed_st[3]) if packed_st[3] else None
+            ),
+            bundle_index=packed_st[4], node_labels=packed_st[5],
+        )
+    else:
+        strategy = SchedulingStrategy()
     return TaskSpec(
         task_id=TaskID(t[0]),
         task_type=TaskType.NORMAL_TASK,
@@ -218,4 +240,6 @@ def unpack_normal_task(t: tuple) -> TaskSpec:
         owner_id=WorkerID(t[7]) if t[7] else None,
         runtime_env=t[6],
         max_retries=t[10],
+        scheduling_strategy=strategy,
+        retry_exceptions=t[12] if len(t) > 12 else False,
     )

@@ -241,14 +241,21 @@ def _setup_pip(value):
                     pass
             break
         else:
-            # waiter outlives the owner's worst case (staging + the 600s
-            # pip timeout) so a slow-but-successful install isn't failed
-            deadline = time.time() + 900
+            # waiter deadline keys off the owner's lock heartbeat (the
+            # owner utimes the lock every 5s through BOTH gs:// staging —
+            # which has no timeout of its own — and the pip run): a
+            # slow-but-alive install is never failed. A stale heartbeat
+            # means a dead owner: loop back to the acquisition path, whose
+            # 120s takeover check unlinks the stale lock so THIS worker
+            # finishes the install itself instead of failing its task.
+            stale = False
             while not os.path.exists(done):
-                if time.time() > deadline:
-                    raise RuntimeEnvSetupError(
-                        "timed out waiting for a concurrent pip env install"
-                    )
+                try:
+                    if time.time() - os.path.getmtime(lock) > 120:
+                        stale = True
+                        break
+                except OSError:
+                    pass  # lock vanished — the exists() checks decide
                 if not os.path.exists(lock):
                     # owner exited: success wrote .done FIRST, so re-check
                     # it before declaring failure (TOCTOU)
@@ -258,6 +265,8 @@ def _setup_pip(value):
                         "concurrent pip env install failed (no .done marker)"
                     )
                 time.sleep(0.25)
+            if stale:
+                continue
             break
     if root not in sys.path:
         sys.path.insert(0, root)

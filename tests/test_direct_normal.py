@@ -269,3 +269,48 @@ class TestMultiNode:
             assert all(o == target for o in outs)
         finally:
             cluster.shutdown()
+
+
+def test_pack_normal_task_preserves_strategy_for_lineage():
+    """The lineage record on the worker side must carry the original
+    scheduling strategy: a PG-pinned task whose shm result is lost would
+    otherwise be reconstructed with DEFAULT placement (advisor r3)."""
+    from ray_tpu.core.task_spec import (
+        SchedulingStrategy, TaskSpec, TaskType, pack_normal_task,
+        unpack_normal_task,
+    )
+    from ray_tpu.core.resources import ResourceSet
+    from ray_tpu.utils.ids import PlacementGroupID, TaskID
+
+    pgid = PlacementGroupID.from_random()
+    spec = TaskSpec(
+        task_id=TaskID.from_random(),
+        task_type=TaskType.NORMAL_TASK,
+        name="t",
+        func_digest=b"d",
+        func_blob=b"f",
+        args_blob=b"a",
+        dependencies=[],
+        num_returns=1,
+        resources=ResourceSet({"CPU": 1}),
+        owner_id=None,
+        scheduling_strategy=SchedulingStrategy(
+            kind="PLACEMENT_GROUP", placement_group_id=pgid, bundle_index=2
+        ),
+        retry_exceptions=True,
+    )
+    out = unpack_normal_task(pack_normal_task(spec))
+    assert out.scheduling_strategy.kind == "PLACEMENT_GROUP"
+    assert out.scheduling_strategy.placement_group_id == pgid
+    assert out.scheduling_strategy.bundle_index == 2
+    assert out.retry_exceptions is True
+    # DEFAULT stays cheap on the wire (None slot)
+    spec2 = TaskSpec(
+        task_id=TaskID.from_random(), task_type=TaskType.NORMAL_TASK,
+        name="t", func_digest=b"d", func_blob=b"f", args_blob=b"a",
+        dependencies=[], num_returns=1, resources=ResourceSet(),
+        owner_id=None,
+    )
+    packed = pack_normal_task(spec2)
+    assert packed[11] is None
+    assert unpack_normal_task(packed).scheduling_strategy.kind == "DEFAULT"
