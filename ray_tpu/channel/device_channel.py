@@ -12,9 +12,12 @@ aDAG actors over NCCL) and gpu_communicator.py. TPU-first shape:
 - CROSS PROCESS (single host): arrays stage through the shm ring
   (zero-copy numpy view on read) and re-materialize on the reader's
   devices with ``jax.device_put`` — host-RAM staging is the TPU
-  equivalent of the reference's CPU-fallback channel; true cross-host
-  device transport needs a multi-controller jax runtime (same stub
-  boundary as parallel/mpmd.CrossHostHandoff).
+  equivalent of the reference's CPU-fallback channel.
+- CROSS PROCESS / CROSS HOST, device-to-device: when both endpoints
+  live in one ``jax.distributed`` runtime (a gang), ``HopDeviceChannel``
+  moves the value over the collective fabric (ICI/DCN; the hop-bridge
+  program of parallel/hop_bridge) without ever touching host RAM — the
+  direct analog of the reference's cross-node NCCL channel.
 
 ``DeviceChannel`` auto-selects per (writer, reader) locality the way the
 reference picks NCCL vs shm per actor pair.
@@ -118,3 +121,86 @@ class _DeviceReader:
 
     def close(self):
         self._reader.close()
+
+
+class HopDeviceChannel:
+    """Cross-process device-to-device channel over the hop-bridge
+    collective (reference: torch_tensor_nccl_channel.py:190 — NCCL p2p
+    between aDAG actors on different nodes).
+
+    Contract (mirrors the reference's declared ``TorchTensorType``):
+    shape and dtype are static, declared at construction. Both endpoints
+    must live in ONE jax.distributed runtime, and ``write()`` /
+    ``read()`` are the two halves of a single jointly-dispatched
+    collective — the writer's n-th write pairs with the reader's n-th
+    read (SPSC ordering, exactly the compiled-DAG schedule contract).
+    XLA's async dispatch keeps writes non-blocking up to the fabric's
+    buffering; there is no host-side queue.
+    """
+
+    def __init__(self, src_devices, dst_devices, shape, dtype):
+        import collections
+
+        from ray_tpu.parallel.hop_bridge import HopBridge
+
+        self._bridge = HopBridge(src_devices, dst_devices)
+        self._shape = tuple(shape)
+        self._dtype = dtype
+        import jax
+
+        pid = jax.process_index()
+        self._is_writer = any(d.process_index == pid for d in self._bridge.src_devices)
+        self._is_reader = any(d.process_index == pid for d in self._bridge.dst_devices)
+        # writer-AND-reader process (single-process degenerate gang):
+        # write()'s own transfer already delivers the dst-row value to
+        # this process — queue it for read() instead of dispatching a
+        # second collective that would move the zeros row.
+        self._pending = collections.deque()
+
+    @classmethod
+    def for_processes(cls, src_process: int, dst_process: int, shape, dtype):
+        """Build from gang process indices: each side contributes all of
+        its local devices (equal device counts per process)."""
+        import jax
+
+        devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+        src = [d for d in devs if d.process_index == src_process]
+        dst = [d for d in devs if d.process_index == dst_process]
+        return cls(src, dst, shape, dtype)
+
+    def write(self, value, timeout=None):
+        """Writer half of the collective. ``value``: array data on the
+        writer side (host or local device array; committed replicated
+        onto the src row)."""
+        import jax
+
+        from ray_tpu.parallel.hop_bridge import commit_replicated
+
+        if not self._is_writer:
+            raise RuntimeError("write() called on a non-writer process")
+        if not (isinstance(value, jax.Array)
+                and getattr(value.sharding, "mesh", None) is not None
+                and set(value.sharding.device_set) == set(self._bridge.src_devices)):
+            value = commit_replicated(value, self._bridge.src_devices)
+        out = self._bridge.transfer(value, self._shape, self._dtype)
+        if self._is_reader:
+            self._pending.append(out)
+
+    def read(self, timeout=None):
+        """Reader half: dispatches the same collective and returns the
+        value replicated over the reader row's devices. On a process
+        that is also the writer, returns the value its own write()
+        already received (no second collective)."""
+        if not self._is_reader:
+            raise RuntimeError("read() called on a non-reader process")
+        if self._is_writer:
+            if not self._pending:
+                raise RuntimeError(
+                    "read() before the matching write() on a same-process "
+                    "writer+reader channel"
+                )
+            return self._pending.popleft()
+        return self._bridge.transfer(None, self._shape, self._dtype)
+
+    def close(self):
+        pass

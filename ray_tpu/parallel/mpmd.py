@@ -13,9 +13,9 @@ Shape here, TPU-first:
   own jit-compiled forward/backward programs (separate XLA programs — the
   "MPMD" in the name);
 - activations hand off between stage meshes with ``jax.device_put`` —
-  HBM→HBM over ICI when the meshes sit in one slice. Cross-HOST handoff
-  (DCN) requires a multi-controller runtime and is stubbed
-  (:class:`CrossHostHandoff`);
+  HBM→HBM over ICI when the meshes sit in one slice. Cross-PROCESS /
+  cross-host handoff (DCN) is the collective-bridge program in
+  hop_bridge.HopBridge, driven by the gang pipeline in mpmd_gang;
 - the host issues the microbatch schedule; XLA's async dispatch runs
   stage programs concurrently, so issue order ≈ the reference's op-graph
   schedule. Backward for microbatch m is issued 1F1B-style (oldest
@@ -45,19 +45,59 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ray_tpu.models import transformer as tf
 
 
-class CrossHostHandoff:
-    """Placeholder for the DCN leg of a cross-host MPMD pipeline: on a
-    multi-host deployment each stage is a gang of processes and the
-    activation handoff rides jax.distributed device-to-device transfer
-    (or a collective bridge program). Single-host pipelines never hit
-    this."""
+# The cross-process/cross-host leg of the handoff lives in
+# hop_bridge.HopBridge (a collective-bridge program per hop, jointly
+# dispatched by both stage gangs); the gang-driven pipeline that uses it
+# is parallel/mpmd_gang.MpmdGangPipeline. This module keeps the
+# single-process form, whose handoffs are plain jax.device_put.
 
-    def __call__(self, value, target_sharding):
-        raise NotImplementedError(
-            "cross-host MPMD handoff needs a jax.distributed runtime "
-            "spanning both stage gangs; single-host stage meshes hand "
-            "off via jax.device_put"
-        )
+
+def make_stage_fn(cfg: "tf.TransformerConfig", attn_fn=None) -> Callable:
+    """The per-stage layer-stack program. IDENTICAL structure to
+    train_step.build_loss_fn's stage_fn — the bit-for-bit loss equality
+    between MPMD (single- AND multi-process) and in-graph GPipe depends
+    on every pipeline flavor using this one definition."""
+
+    def stage_fn(stage_params, x, positions):
+        def layer_fn(carry, lp):
+            return tf.decoder_layer(carry, lp, cfg, positions, attn_fn), None
+
+        if cfg.remat:
+            layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+        x, _ = jax.lax.scan(layer_fn, x, stage_params)
+        return x
+
+    return stage_fn
+
+
+def make_stage_bwd(stage_fn: Callable) -> Callable:
+    """Recompute-in-backward VJP of a stage: only stage INPUTS are saved
+    across the schedule, not intermediate activations."""
+
+    def bwd(stage_params, x, positions, gy):
+        y, vjp = jax.vjp(lambda p, xx: stage_fn(p, xx, positions), stage_params, x)
+        gparams, gx = vjp(gy)
+        del y
+        return gx, gparams
+
+    return bwd
+
+
+def make_head_loss(cfg: "tf.TransformerConfig") -> Callable:
+    def head_loss(head_params, h, targets, mask):
+        logits = tf.unembed(head_params, h, cfg)
+        return tf.token_nll(logits, targets, mask)
+
+    return head_loss
+
+
+def make_embed_bwd(cfg: "tf.TransformerConfig") -> Callable:
+    def embed_bwd(emb_params, tokens, gh):
+        _, vjp = jax.vjp(lambda p: tf.embed(p, tokens, cfg), emb_params)
+        (gp,) = vjp(gh)
+        return gp
+
+    return embed_bwd
 
 
 @dataclass
@@ -90,30 +130,12 @@ class MpmdPipeline:
         per = len(devices) // num_stages
         self.stages: List[_Stage] = []
 
-        def stage_fn(stage_params, x, positions):
-            # IDENTICAL structure to train_step.build_loss_fn's stage_fn —
-            # the bit-for-bit loss equality depends on it
-            def layer_fn(carry, lp):
-                return tf.decoder_layer(carry, lp, cfg, positions, attn_fn), None
-
-            if cfg.remat:
-                layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
-            x, _ = jax.lax.scan(layer_fn, x, stage_params)
-            return x
-
+        stage_fn = make_stage_fn(cfg, attn_fn)
         self._stage_fn = stage_fn
+        bwd = make_stage_bwd(stage_fn)
         for s in range(num_stages):
             mesh = Mesh(np.array(devices[s * per : (s + 1) * per]), ("stage",))
             shard = NamedSharding(mesh, P())
-
-            def bwd(stage_params, x, positions, gy, *, _fn=stage_fn):
-                # recompute-in-backward: only stage INPUTS are saved
-                # across the schedule, not intermediate activations
-                y, vjp = jax.vjp(lambda p, xx: _fn(p, xx, positions), stage_params, x)
-                gparams, gx = vjp(gy)
-                del y
-                return gx, gparams
-
             self.stages.append(
                 _Stage(
                     index=s,
@@ -130,20 +152,12 @@ class MpmdPipeline:
             out_shardings=first.sharding,
         )
 
-        def head_loss(head_params, h, targets, mask):
-            logits = tf.unembed(head_params, h, cfg)
-            return tf.token_nll(logits, targets, mask)
-
         self._head_grad = jax.jit(
-            jax.value_and_grad(head_loss, argnums=(0, 1)),
+            jax.value_and_grad(make_head_loss(cfg), argnums=(0, 1)),
         )
-
-        def embed_bwd(emb_params, tokens, gh):
-            _, vjp = jax.vjp(lambda p: tf.embed(p, tokens, cfg), emb_params)
-            (gp,) = vjp(gh)
-            return gp
-
-        self._embed_bwd = jax.jit(embed_bwd, out_shardings=first.sharding)
+        self._embed_bwd = jax.jit(
+            make_embed_bwd(cfg), out_shardings=first.sharding
+        )
 
     # ------------------------------------------------------------------
     def split_params(self, params: Dict[str, Any]):
@@ -168,8 +182,8 @@ class MpmdPipeline:
 
     def _handoff(self, value, stage: _Stage):
         """Activation transfer onto ``stage``'s devices (ICI/HBM path).
-        Raises through CrossHostHandoff when the meshes live in different
-        processes."""
+        All stage meshes here are single-process; the cross-process form
+        rides hop_bridge.HopBridge (see mpmd_gang)."""
         return jax.device_put(value, stage.sharding)
 
     # ------------------------------------------------------------------

@@ -68,3 +68,21 @@ def test_cross_process_reader_device_put(ray_start_regular):
     ch.write(jnp.full((16, 16), 2.0), timeout=10)
     assert ray_tpu.get(fut, timeout=60) == float(16 * 16 * 2.0)
     ch.close()
+
+
+def test_hop_device_channel_same_process():
+    """Single-process writer+reader pairing must hand over the written
+    value (regression: a second collective returned the zeros row)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.channel.device_channel import HopDeviceChannel
+
+    devs = jax.devices()
+    chan = HopDeviceChannel(devs[:4], devs[4:8], (2, 3), jnp.float32)
+    for i in range(3):
+        chan.write(np.full((2, 3), float(i + 7), dtype=np.float32))
+        got = chan.read()
+        arr = np.asarray(got.addressable_shards[0].data)
+        assert np.all(arr == float(i + 7)), arr

@@ -158,3 +158,122 @@ def test_failed_train_fn_surfaces_not_hangs(ray_start_regular):
     result = trainer.fit()
     assert result.error is not None
     assert "boom" in str(result.error.__cause__ or result.error)
+
+
+@pytest.mark.slow
+def test_mpmd_cross_process_stage_boundary(ray_start_regular):
+    """MPMD pipeline whose stage boundary IS the process boundary
+    (VERDICT r3 #1): stage 0 = process 0's 4 devices, stage 1 =
+    process 1's 4 devices, activations crossing on the hop-bridge
+    collective (gloo here; ICI/DCN on real pods). Loss must match the
+    in-graph GPipe loss computed over the same global runtime
+    bit-for-bit, and a training step must run end-to-end."""
+    def train_fn(config):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu import train
+        from ray_tpu.models import transformer as tf
+        from ray_tpu.parallel import MeshPlan, build_mesh
+        from ray_tpu.parallel.mpmd_gang import (
+            MpmdGangPipeline,
+            mpmd_gang_train_step_fns,
+        )
+        from ray_tpu.parallel.train_step import build_loss_fn
+
+        assert len(jax.devices()) == 8, "gang is not one global JAX runtime"
+        cfg = tf.TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=4, n_heads=4, n_kv_heads=4,
+            d_ff=64, max_seq_len=32, dtype=jnp.float32, remat=False,
+        )
+        params = tf.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = np.asarray(
+            jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, cfg.vocab_size)
+        )
+        batch = {"tokens": tokens}
+
+        pipe = MpmdGangPipeline(cfg, num_stages=2)
+        # the stage boundary must sit between the two processes
+        procs0 = {d.process_index for d in pipe.stages[0].devices}
+        procs1 = {d.process_index for d in pipe.stages[1].devices}
+        assert procs0 == {0} and procs1 == {1}, (procs0, procs1)
+        split = pipe.split_params(params)
+        loss, grads = pipe.loss_and_grads(split, batch, num_microbatches=2)
+
+        # in-graph GPipe on the SAME global runtime (pp axis across the
+        # two processes) — the bit-parity reference
+        plan = MeshPlan(pp=2)
+        devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+        # one device per process: the in-graph pp axis also crosses the
+        # process boundary; host-numpy inputs auto-replicate
+        mesh = build_mesh(plan, devices=[devs[0], devs[4]])
+        host_params = jax.tree.map(np.asarray, params)
+        ingraph = float(
+            jax.jit(build_loss_fn(cfg, plan, mesh, num_microbatches=2))(
+                host_params, {"tokens": tokens}
+            )
+        )
+        # full train step end-to-end (optimizer updates per stage gang)
+        pipe2, init_fn, step_fn = mpmd_gang_train_step_fns(
+            cfg, num_stages=2, num_microbatches=2
+        )
+        split2, opt_states = init_fn(params)
+        losses = []
+        for _ in range(3):
+            split2, opt_states, l2 = step_fn(split2, opt_states, batch)
+            losses.append(l2)
+        train.report({
+            "mpmd_loss": loss,
+            "ingraph_loss": ingraph,
+            "first_step": losses[0],
+            "last_step": losses[-1],
+        })
+
+    trainer = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(**MULTIHOST_SCALING),
+        run_config=RunConfig(name="multihost_mpmd"),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    m = result.metrics
+    assert m["mpmd_loss"] == m["ingraph_loss"], m
+    assert m["last_step"] < m["first_step"], m
+
+
+@pytest.mark.slow
+def test_hop_device_channel_cross_process(ray_start_regular):
+    """HopDeviceChannel: device-to-device values crossing the process
+    boundary on the collective fabric (the reference's cross-node NCCL
+    channel, torch_tensor_nccl_channel.py:190) — no host staging."""
+    def train_fn(config):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu import train
+        from ray_tpu.channel.device_channel import HopDeviceChannel
+
+        ctx = train.get_context()
+        rank = ctx.get_world_rank()
+        chan = HopDeviceChannel.for_processes(0, 1, (4, 8), jnp.float32)
+        total = 0.0
+        for i in range(3):
+            if rank == 0:
+                chan.write(np.full((4, 8), float(i + 1), dtype=np.float32))
+            else:
+                got = chan.read()
+                arr = np.asarray(got.addressable_shards[0].data)
+                assert arr.shape == (4, 8)
+                assert np.all(arr == float(i + 1)), arr
+                total += float(arr.sum())
+        train.report({"total": total})
+
+    trainer = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(**MULTIHOST_SCALING),
+        run_config=RunConfig(name="multihost_hopchan"),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
