@@ -19,8 +19,11 @@ aDAG actors over NCCL) and gpu_communicator.py. TPU-first shape:
   program of parallel/hop_bridge) without ever touching host RAM — the
   direct analog of the reference's cross-node NCCL channel.
 
-``DeviceChannel`` auto-selects per (writer, reader) locality the way the
-reference picks NCCL vs shm per actor pair.
+``DeviceChannel`` auto-selects between its in-process and shm modes per
+(writer, reader) locality the way the reference picks NCCL vs shm per
+actor pair; ``HopDeviceChannel`` is constructed explicitly by gang-aware
+code (it needs the declared shape/dtype and a shared jax runtime — the
+same opt-in the reference requires via TorchTensorType annotations).
 """
 from __future__ import annotations
 
@@ -179,7 +182,7 @@ class HopDeviceChannel:
         if not self._is_writer:
             raise RuntimeError("write() called on a non-writer process")
         if not (isinstance(value, jax.Array)
-                and getattr(value.sharding, "mesh", None) is not None
+                and value.sharding.is_fully_replicated
                 and set(value.sharding.device_set) == set(self._bridge.src_devices)):
             value = commit_replicated(value, self._bridge.src_devices)
         out = self._bridge.transfer(value, self._shape, self._dtype)

@@ -104,16 +104,24 @@ def make_embed_bwd(cfg: "tf.TransformerConfig") -> Callable:
 class _Stage:
     index: int
     mesh: Mesh
-    sharding: NamedSharding  # replicated-within-stage placement
+    sharding: NamedSharding  # activation placement within the stage
     fwd: Callable  # (stage_params, x, positions) -> y
     bwd: Callable  # (stage_params, x, positions, gy) -> (gx, gparams)
+    layer_shardings: Any = None  # per-leaf shardings of the stage's layers
 
 
 class MpmdPipeline:
     """A transformer layer-stack pipeline where stage ``s`` is its own
-    XLA program on its own devices. Parameters within a stage are
-    replicated in this first cut (compose tp/fsdp inside a stage by
-    widening the stage mesh — future work)."""
+    XLA program on its own devices.
+
+    Stage interiors compose with tensor/FSDP parallelism: with
+    ``stage_tp``/``stage_fsdp`` > 1 each stage's devices form a
+    ``(fsdp, tp)`` mesh and the stage program is GSPMD-partitioned with
+    the same Megatron/ZeRO specs the in-graph path uses
+    (mesh.param_specs) — XLA inserts the per-block tp psums inside the
+    stage while the pipeline schedule stays host-driven. Activations at
+    stage boundaries are batch-sharded over fsdp and replicated over tp
+    (the Megatron contract), so handoffs remain a single device_put."""
 
     def __init__(
         self,
@@ -121,31 +129,60 @@ class MpmdPipeline:
         num_stages: int,
         devices: Optional[List[Any]] = None,
         attn_fn=None,
+        stage_tp: int = 1,
+        stage_fsdp: int = 1,
     ):
+        from ray_tpu.parallel import mesh as mesh_lib
+
         self.cfg = cfg
         self.num_stages = num_stages
+        self.stage_tp = stage_tp
+        self.stage_fsdp = stage_fsdp
         devices = list(devices if devices is not None else jax.devices())
         assert len(devices) % num_stages == 0, (len(devices), num_stages)
         assert cfg.n_layers % num_stages == 0, (cfg.n_layers, num_stages)
         per = len(devices) // num_stages
+        inner = stage_tp * stage_fsdp
+        assert per % inner == 0, (per, inner)
+        # extra stage devices replicate over a leading "rep" axis
+        rep = per // inner
+        self._stage_plan = mesh_lib.MeshPlan(fsdp=stage_fsdp, tp=stage_tp)
+        self._act_spec = P(("fsdp",) if stage_fsdp > 1 else None)
         self.stages: List[_Stage] = []
 
         stage_fn = make_stage_fn(cfg, attn_fn)
         self._stage_fn = stage_fn
         bwd = make_stage_bwd(stage_fn)
+        all_specs = mesh_lib.param_specs(cfg, self._stage_plan)
+        self._layer_specs = all_specs["layers"]
         for s in range(num_stages):
-            mesh = Mesh(np.array(devices[s * per : (s + 1) * per]), ("stage",))
-            shard = NamedSharding(mesh, P())
+            devs = np.array(devices[s * per : (s + 1) * per]).reshape(
+                rep, stage_fsdp, stage_tp
+            )
+            mesh = Mesh(devs, ("rep", "fsdp", "tp"))
+            shard = NamedSharding(mesh, self._act_spec)
+            lshard = jax.tree.map(
+                lambda sp: NamedSharding(mesh, sp), self._layer_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
             self.stages.append(
                 _Stage(
                     index=s,
                     mesh=mesh,
                     sharding=shard,
                     fwd=jax.jit(stage_fn, out_shardings=shard),
-                    bwd=jax.jit(bwd, out_shardings=(shard, shard)),
+                    bwd=jax.jit(bwd, out_shardings=(shard, lshard)),
+                    layer_shardings=lshard,
                 )
             )
         first, last = self.stages[0], self.stages[-1]
+        self._embed_shardings = {
+            "embed": NamedSharding(first.mesh, all_specs["embed"])
+        }
+        self._head_shardings = {
+            "final_norm": NamedSharding(last.mesh, all_specs["final_norm"]),
+            "lm_head": NamedSharding(last.mesh, all_specs["lm_head"]),
+        }
         # stage-resident programs for the model's ends
         self._embed = jax.jit(
             lambda emb_params, tokens: tf.embed(emb_params, tokens, cfg),
@@ -156,7 +193,7 @@ class MpmdPipeline:
             jax.value_and_grad(make_head_loss(cfg), argnums=(0, 1)),
         )
         self._embed_bwd = jax.jit(
-            make_embed_bwd(cfg), out_shardings=first.sharding
+            make_embed_bwd(cfg), out_shardings=self._embed_shardings
         )
 
     # ------------------------------------------------------------------
@@ -169,14 +206,14 @@ class MpmdPipeline:
         stage_layers = []
         for s in range(S):
             sl = jax.tree.map(lambda x: x[s * per : (s + 1) * per], params["layers"])
-            stage_layers.append(jax.device_put(sl, self.stages[s].sharding))
+            stage_layers.append(jax.device_put(sl, self.stages[s].layer_shardings))
         embed_params = jax.device_put(
             {k: v for k, v in params.items() if k == "embed"},
-            self.stages[0].sharding,
+            self._embed_shardings,
         )
         head_params = jax.device_put(
             {k: params[k] for k in ("final_norm", "lm_head")},
-            self.stages[-1].sharding,
+            self._head_shardings,
         )
         return embed_params, stage_layers, head_params
 
@@ -297,7 +334,8 @@ class MpmdPipeline:
 
 
 def mpmd_train_step_fns(cfg: tf.TransformerConfig, num_stages: int,
-                        devices=None, optimizer=None, num_microbatches: int = 2):
+                        devices=None, optimizer=None, num_microbatches: int = 2,
+                        stage_tp: int = 1, stage_fsdp: int = 1):
     """A full MPMD training step (loss + grads + per-partition optimizer
     update) as host-driven per-stage programs. Returns
     (pipeline, init_fn, step_fn):
@@ -307,7 +345,9 @@ def mpmd_train_step_fns(cfg: tf.TransformerConfig, num_stages: int,
     import optax
 
     optimizer = optimizer or optax.adamw(1e-3)
-    pipe = MpmdPipeline(cfg, num_stages, devices)
+    pipe = MpmdPipeline(
+        cfg, num_stages, devices, stage_tp=stage_tp, stage_fsdp=stage_fsdp
+    )
 
     # One jitted apply serves every partition: output placement follows
     # the donated inputs, and the jit cache keys on shapes/shardings.

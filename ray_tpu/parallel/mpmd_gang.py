@@ -54,6 +54,7 @@ class _GangStage:
     local: bool  # this process owns devices in the stage
     fwd: Optional[Callable]
     bwd: Optional[Callable]
+    layer_shardings: Any = None  # per-leaf shardings of the stage's layers
 
 
 def _local_copy(value) -> np.ndarray:
@@ -65,22 +66,49 @@ def _local_copy(value) -> np.ndarray:
 class MpmdGangPipeline:
     """MPMD transformer pipeline across a jax.distributed gang."""
 
-    def __init__(self, cfg: tf.TransformerConfig, num_stages: int, attn_fn=None):
+    def __init__(self, cfg: tf.TransformerConfig, num_stages: int, attn_fn=None,
+                 stage_tp: int = 1):
+        from ray_tpu.parallel import mesh as mesh_lib
+
         self.cfg = cfg
         self.num_stages = num_stages
+        self.stage_tp = stage_tp
         devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
         assert len(devices) % num_stages == 0, (len(devices), num_stages)
         assert cfg.n_layers % num_stages == 0, (cfg.n_layers, num_stages)
         per = len(devices) // num_stages
+        assert per % stage_tp == 0, (per, stage_tp)
+        rep = per // stage_tp
         my_pid = jax.process_index()
+
+        # tp inside a stage keeps activations replicated at the stage
+        # boundary (Megatron contract), so the hop bridge is unchanged;
+        # params are tp-sharded which needs single-owner commits.
+        self._stage_plan = mesh_lib.MeshPlan(tp=stage_tp)
+        all_specs = mesh_lib.param_specs(cfg, self._stage_plan)
+        layer_specs = all_specs["layers"]
 
         stage_fn = make_stage_fn(cfg, attn_fn)
         bwd_fn = make_stage_bwd(stage_fn)
         self.stages: List[_GangStage] = []
         for s in range(num_stages):
             devs = devices[s * per : (s + 1) * per]
-            mesh = Mesh(np.array(devs), ("stage",))
+            owners = {d.process_index for d in devs}
+            if stage_tp > 1 and len(owners) > 1:
+                raise NotImplementedError(
+                    "stage_tp > 1 needs each stage owned by one process "
+                    "(stage-per-host MPMD); multi-process tp stages would "
+                    f"need sharded cross-process commits (stage {s} spans "
+                    f"processes {sorted(owners)})"
+                )
+            mesh = Mesh(
+                np.array(devs).reshape(rep, 1, stage_tp), ("rep", "fsdp", "tp")
+            )
             shard = NamedSharding(mesh, P())
+            lshard = jax.tree.map(
+                lambda sp: NamedSharding(mesh, sp), layer_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
             local = any(d.process_index == my_pid for d in devs)
             self.stages.append(
                 _GangStage(
@@ -90,7 +118,8 @@ class MpmdGangPipeline:
                     sharding=shard,
                     local=local,
                     fwd=jax.jit(stage_fn, out_shardings=shard) if local else None,
-                    bwd=jax.jit(bwd_fn, out_shardings=(shard, shard)) if local else None,
+                    bwd=jax.jit(bwd_fn, out_shardings=(shard, lshard)) if local else None,
+                    layer_shardings=lshard,
                 )
             )
         # hop bridges between consecutive stages (collective programs;
@@ -100,6 +129,13 @@ class MpmdGangPipeline:
             for s in range(num_stages - 1)
         ]
         first, last = self.stages[0], self.stages[-1]
+        self._embed_shardings = {
+            "embed": NamedSharding(first.mesh, all_specs["embed"])
+        }
+        self._head_shardings = {
+            "final_norm": NamedSharding(last.mesh, all_specs["final_norm"]),
+            "lm_head": NamedSharding(last.mesh, all_specs["lm_head"]),
+        }
         self._embed = (
             jax.jit(
                 lambda emb_params, tokens: tf.embed(emb_params, tokens, cfg),
@@ -112,19 +148,20 @@ class MpmdGangPipeline:
             if last.local else None
         )
         self._embed_bwd = (
-            jax.jit(make_embed_bwd(cfg), out_shardings=first.sharding)
+            jax.jit(make_embed_bwd(cfg), out_shardings=self._embed_shardings)
             if first.local else None
         )
 
     # ------------------------------------------------------------------
-    def _commit(self, arr, stage: _GangStage):
-        """Place host data replicated onto a stage's (possibly
-        multi-process) mesh. Participating processes only."""
+    def _commit(self, arr, stage: _GangStage, sharding=None):
+        """Place host data onto a stage's (possibly multi-process) mesh —
+        replicated by default, or per ``sharding`` (tp-sharded params).
+        Participating processes only."""
         if not stage.local:
             return None
         from ray_tpu.parallel.hop_bridge import commit_replicated
 
-        return commit_replicated(arr, stage.devices, stage.sharding)
+        return commit_replicated(arr, stage.devices, sharding or stage.sharding)
 
     def split_params(self, params: Dict[str, Any]):
         """Full host param tree (identical on every process) → this
@@ -141,17 +178,22 @@ class MpmdGangPipeline:
                     lambda x: np.asarray(x)[s * per : (s + 1) * per],
                     params["layers"],
                 )
-                stage_layers.append(jax.tree.map(lambda a: self._commit(a, st), sl))
+                stage_layers.append(
+                    jax.tree.map(
+                        lambda a, sh: self._commit(a, st, sh),
+                        sl, st.layer_shardings,
+                    )
+                )
             else:
                 stage_layers.append(None)
         embed_params = (
-            jax.tree.map(lambda a: self._commit(a, self.stages[0]),
-                         {k: v for k, v in params.items() if k == "embed"})
+            {"embed": self._commit(params["embed"], self.stages[0],
+                                   self._embed_shardings["embed"])}
             if self.stages[0].local else None
         )
         head_params = (
-            jax.tree.map(lambda a: self._commit(a, self.stages[-1]),
-                         {k: params[k] for k in ("final_norm", "lm_head")})
+            {k: self._commit(params[k], self.stages[-1], self._head_shardings[k])
+             for k in ("final_norm", "lm_head")}
             if self.stages[-1].local else None
         )
         return embed_params, stage_layers, head_params
@@ -266,14 +308,14 @@ class MpmdGangPipeline:
 
 def mpmd_gang_train_step_fns(cfg: tf.TransformerConfig, num_stages: int,
                              optimizer=None, num_microbatches: int = 2,
-                             attn_fn=None):
+                             attn_fn=None, stage_tp: int = 1):
     """Training-step closure over MpmdGangPipeline, mirroring
     mpmd.mpmd_train_step_fns: init_fn(params) -> (split, opt_states);
     step_fn(split, opt_states, batch) -> (split', opt_states', loss)."""
     import optax
 
     optimizer = optimizer or optax.adamw(1e-3)
-    pipe = MpmdGangPipeline(cfg, num_stages, attn_fn=attn_fn)
+    pipe = MpmdGangPipeline(cfg, num_stages, attn_fn=attn_fn, stage_tp=stage_tp)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def _apply_update(p, st, g):

@@ -178,3 +178,56 @@ def test_mpmd_gang_four_stages_single_process():
     split2 = pipe2.split_params(params)
     loss2, _ = pipe2.loss_and_grads(split2, batch, num_microbatches=2)
     assert loss == loss2, (loss, loss2)
+
+
+def test_mpmd_stage_internal_tp_matches_ingraph():
+    """pp=2 x tp=2 MPMD (VERDICT r3 #10): stage interiors GSPMD-
+    partitioned with the Megatron tp specs; loss must match the in-graph
+    pp=2 x tp=2 plan."""
+    params, batch = _params_and_batch()
+
+    plan = MeshPlan(pp=2, tp=2)
+    mesh = build_mesh(plan, devices=jax.devices()[:4])
+    expected = float(
+        jax.jit(build_loss_fn(CFG, plan, mesh, num_microbatches=2))(params, batch)
+    )
+
+    pipe = MpmdPipeline(CFG, num_stages=2, devices=jax.devices()[:4], stage_tp=2)
+    # stage params must actually be tp-sharded (not replicated)
+    split = pipe.split_params(params)
+    wq_sharding = split[1][0]["wq"].sharding
+    assert "tp" in str(wq_sharding.spec), wq_sharding.spec
+    loss, grads = pipe.loss_and_grads(split, batch, num_microbatches=2)
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-6)
+
+
+def test_mpmd_stage_internal_fsdp_trains():
+    """pp=2 x fsdp=2: batch-sharded stage interiors; full train step."""
+    params, batch = _params_and_batch()
+    pipe, init_fn, step_fn = mpmd_train_step_fns(
+        CFG, num_stages=2, devices=jax.devices()[:4], num_microbatches=2,
+        stage_fsdp=2,
+    )
+    split, opt_states = init_fn(params)
+    losses = []
+    for _ in range(3):
+        split, opt_states, loss = step_fn(split, opt_states, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_mpmd_gang_stage_tp_single_process():
+    """Gang pipeline with tp inside each stage (stage-per-host shape,
+    degenerate single process): loss matches the replicated gang."""
+    from ray_tpu.parallel.mpmd_gang import MpmdGangPipeline
+
+    params, batch = _params_and_batch()
+    pipe = MpmdGangPipeline(CFG, num_stages=2, stage_tp=2)
+    split = pipe.split_params(params)
+    assert "tp" in str(split[1][0]["wq"].sharding.spec)
+    loss, _ = pipe.loss_and_grads(split, batch, num_microbatches=2)
+
+    pipe_rep = MpmdGangPipeline(CFG, num_stages=2)
+    split_rep = pipe_rep.split_params(params)
+    loss_rep, _ = pipe_rep.loss_and_grads(split_rep, batch, num_microbatches=2)
+    np.testing.assert_allclose(loss, loss_rep, rtol=1e-6)

@@ -277,3 +277,48 @@ def test_hop_device_channel_cross_process(ray_start_regular):
     )
     result = trainer.fit()
     assert result.error is None, result.error
+
+
+@pytest.mark.slow
+def test_mpmd_gang_cross_process_stage_tp(ray_start_regular):
+    """pp x tp ACROSS the process boundary: stage-per-process MPMD with
+    Megatron tp partitioning inside each stage's 4 devices (VERDICT r3
+    #10 done-when, in its cross-process form)."""
+    def train_fn(config):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu import train
+        from ray_tpu.models import transformer as tf
+        from ray_tpu.parallel.mpmd_gang import mpmd_gang_train_step_fns
+
+        assert len(jax.devices()) == 8
+        cfg = tf.TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=4, n_heads=4, n_kv_heads=4,
+            d_ff=64, max_seq_len=32, dtype=jnp.float32, remat=False,
+        )
+        pipe, init_fn, step_fn = mpmd_gang_train_step_fns(
+            cfg, num_stages=2, num_microbatches=2, stage_tp=2
+        )
+        assert {d.process_index for d in pipe.stages[0].devices} == {0}
+        assert {d.process_index for d in pipe.stages[1].devices} == {1}
+        params = tf.init_params(jax.random.PRNGKey(0), cfg)
+        split, opt = init_fn(params)
+        if pipe.stages[0].local:
+            assert "tp" in str(split[1][0]["wq"].sharding.spec)
+        toks = np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 17), dtype=np.int32)
+        losses = []
+        for _ in range(3):
+            split, opt, loss = step_fn(split, opt, {"tokens": toks})
+            losses.append(loss)
+        train.report({"first": losses[0], "last": losses[-1]})
+
+    trainer = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(**MULTIHOST_SCALING),
+        run_config=RunConfig(name="multihost_mpmd_tp"),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["last"] < result.metrics["first"], result.metrics
