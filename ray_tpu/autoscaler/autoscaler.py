@@ -24,19 +24,42 @@ def _subtract(avail: Dict[str, float], demand: Dict[str, float]):
         avail[k] = avail.get(k, 0.0) - v
 
 
+def _split_labels(item: Dict) -> tuple:
+    """Demand items may carry hard label expressions under ``_labels``
+    (controller rpc_resource_demand); split them from the resource part."""
+    if "_labels" in item:
+        item = dict(item)
+        labels = item.pop("_labels")
+        return item, labels
+    return item, None
+
+
+def _labels_ok(exprs, node_labels: Dict[str, str]) -> bool:
+    if not exprs:
+        return True
+    from ray_tpu.core.scheduler import match_label_expressions
+
+    return match_label_expressions(exprs, node_labels or {})
+
+
 def bin_pack_new_nodes(
     unmet: List[Dict[str, float]],
     node_types: Dict[str, dict],
     launchable: Dict[str, int],
 ) -> Dict[str, int]:
     """First-fit-decreasing of unmet demand onto hypothetical new nodes
-    (reference: resource_demand_scheduler.get_nodes_for :~380)."""
+    (reference: resource_demand_scheduler.get_nodes_for :~380).
+    Label-constrained demand only opens node types whose configured
+    ``labels`` satisfy the hard expressions."""
     to_launch: Dict[str, int] = {}
     open_nodes: List[tuple] = []  # (type, remaining resources)
-    for item in sorted(unmet, key=lambda d: -sum(d.values())):
+    split = [_split_labels(i) for i in unmet]
+    for item, labels in sorted(split, key=lambda p: -sum(p[0].values())):
         placed = False
         for _t, rem in open_nodes:
-            if _fits(rem, item):
+            if _fits(rem, item) and _labels_ok(
+                labels, node_types.get(_t, {}).get("labels", {})
+            ):
                 _subtract(rem, item)
                 placed = True
                 break
@@ -44,6 +67,8 @@ def bin_pack_new_nodes(
             continue
         for tname, tcfg in node_types.items():
             if launchable.get(tname, 0) <= to_launch.get(tname, 0):
+                continue
+            if not _labels_ok(labels, tcfg.get("labels", {})):
                 continue
             res = dict(tcfg["resources"])
             if _fits(res, item):
@@ -150,19 +175,22 @@ class StandardAutoscaler:
             return []
         # Subtract what still fits on live nodes' availability — pending
         # tasks merely waiting on worker spawn must not trigger scale-up.
+        # Label-constrained items only fit nodes whose labels match.
         avail = [
-            dict(n["resources"].get("available", {}))
+            (dict(n["resources"].get("available", {})),
+             n["resources"].get("labels", {}))
             for n in self._call("list_nodes")
             if n["state"] == "ALIVE"
         ]
         unmet = []
         for item in items:
-            for a in avail:
-                if _fits(a, item):
-                    _subtract(a, item)
+            res, labels = _split_labels(item)
+            for a, node_labels in avail:
+                if _fits(a, res) and _labels_ok(labels, node_labels):
+                    _subtract(a, res)
                     break
             else:
-                unmet.append(item)
+                unmet.append(item)  # keeps _labels for bin_pack
         return unmet
 
     def _terminate_idle(self, counts: Dict[str, int]):

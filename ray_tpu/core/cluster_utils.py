@@ -51,6 +51,7 @@ class Cluster:
         num_cpus: int = 1,
         resources: Optional[Dict[str, float]] = None,
         wait: bool = True,
+        labels: Optional[Dict[str, str]] = None,
     ) -> NodeHandle:
         res = dict(resources or {})
         res.setdefault("CPU", num_cpus)
@@ -58,6 +59,8 @@ class Cluster:
 
         before = self._list_node_ids()
         env = child_env(needs_tpu=False)
+        if labels:
+            env["RAY_TPU_NODE_LABELS"] = json.dumps(labels)
         log = open(os.path.join(self._session_dir, "logs", f"agent-{len(self._nodes)}.log"), "ab")
         proc = subprocess.Popen(
             [

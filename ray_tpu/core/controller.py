@@ -236,6 +236,19 @@ class Controller:
         import collections as _c
         import itertools as _it
 
+        # Pending work indexed by (scheduling class, env hash): the pump
+        # visits CLASSES and skips a blocked one in O(1), so a deep queue
+        # of homogeneous tasks costs O(#classes) per pump instead of
+        # O(#tasks) (reference: SchedulingClass queues in
+        # cluster_task_manager.cc; fixes the measured O(n²) registration
+        # collapse at 10k pending actor records).
+        self._class_queues: Dict[Tuple, "_c.deque"] = {}
+        self._dep_parked: Set[TaskID] = set()
+        # dep object → pending tasks that consume it: lets an object free
+        # fail its dependents in O(dependents) instead of scanning every
+        # pending task (objects free routinely via GC sweeps).
+        self._dep_index: Dict[ObjectID, Set[TaskID]] = {}
+
         self.leases: Dict[bytes, LeaseRecord] = {}
         self._lease_reqs: "_c.deque[_LeaseReq]" = _c.deque()
         self._lease_seq = _it.count(1)
@@ -366,10 +379,10 @@ class Controller:
         self._schedule_pump()
         return {"session_dir": self.session_dir, "config": self.config.to_dict()}
 
-    async def rpc_register_node(self, peer: rpc.Peer, node_id: NodeID, resources: Dict[str, float], shm_dir: str, hostname: str = "localhost", pid: int = 0, fetch_addr: str = "", provider_instance_id: str = ""):
+    async def rpc_register_node(self, peer: rpc.Peer, node_id: NodeID, resources: Dict[str, float], shm_dir: str, hostname: str = "localhost", pid: int = 0, fetch_addr: str = "", provider_instance_id: str = "", labels: Optional[Dict[str, str]] = None):
         peer.meta.update(kind="agent", node_id=node_id)
         total = ResourceSet.from_dict(resources)
-        self.cluster.add_node(node_id, NodeResources(total))
+        self.cluster.add_node(node_id, NodeResources(total, labels=labels))
         ncpu = int(resources.get("CPU", 1))
         rec = NodeRecord(
             node_id=node_id, shm_dir=shm_dir, peer=peer, hostname=hostname,
@@ -786,73 +799,87 @@ class Controller:
 
     async def _pump_once(self):
         self._pump_leases()
-        queue, self.pending_tasks = self.pending_tasks, []
-        still_pending: List[TaskID] = []
-        spawn_requests: Dict[NodeID, int] = {}
-        # Head-of-line blocking per scheduling class (reference:
-        # SchedulingClass queues in cluster_task_manager.cc): once a task
-        # of a class fails to place, identical later tasks are skipped
-        # without re-running the scheduler — a deep queue of homogeneous
-        # tasks costs O(n) per pump, not O(n × schedule).
-        blocked_classes: Set[Tuple] = set()
-        class_spawn_node: Dict[Tuple, NodeID] = {}
-        # Worker ramp-up is capped by the node's SCHEDULABLE concurrency
-        # for the blocked class — a deep queue of 1-CPU tasks on a 1-CPU
-        # node must not spawn max_workers processes that can never run
-        # concurrently (reference: worker_pool soft limit ≈ CPU slots).
-        class_spawn_cap: Dict[Tuple, int] = {}
-        class_spawned: Dict[Tuple, int] = {}
-        for tid in queue:
+        import collections
+
+        # Drain the intake list into per-class FIFOs. The pump then visits
+        # CLASSES: a blocked class (infeasible / no worker / no resources)
+        # is skipped in O(1) with its whole queue intact, so registration
+        # of the n-th pending record costs O(#classes), not O(n).
+        # Dispatch eligibility is env-affine (idle-worker match keys on
+        # the runtime-env hash), so the class key must include it —
+        # otherwise an env-B task with an idle env-B worker is starved
+        # because an env-A task of the same class blocks first.
+        intake, self.pending_tasks = self.pending_tasks, []
+        for tid in intake:
             rec = self.tasks.get(tid)
             if rec is None or rec.state != "PENDING":
                 continue
             spec = rec.spec
-            # Dispatch eligibility is env-affine (idle-worker match keys on
-            # the runtime-env hash), so the block key must include it —
-            # otherwise an env-B task with an idle env-B worker is skipped
-            # because an env-A task of the same class blocked first.
-            ehash = _env_hash(spec.runtime_env)
-            sclass = (spec.scheduling_class(), ehash)
-            if sclass in blocked_classes:
-                still_pending.append(tid)
-                # queued depth still drives worker ramp-up for the class,
-                # bounded by the node's concurrency for its demand
-                nid = class_spawn_node.get(sclass)
-                if nid is not None and class_spawned.get(sclass, 0) < class_spawn_cap.get(sclass, 1):
-                    spawn_requests[nid] = spawn_requests.get(nid, 0) + 1
-                    class_spawned[sclass] = class_spawned.get(sclass, 0) + 1
+            key = (spec.scheduling_class(), _env_hash(spec.runtime_env))
+            q = self._class_queues.get(key)
+            if q is None:
+                q = self._class_queues[key] = collections.deque()
+            q.append(tid)
+            for dep in spec.dependencies:
+                self._dep_index.setdefault(dep, set()).add(tid)
+        spawn_requests: Dict[NodeID, int] = {}
+        for key in list(self._class_queues.keys()):
+            q = self._class_queues.get(key)
+            if q:
+                await self._pump_class(key, q, spawn_requests)
+            if not q:
+                self._class_queues.pop(key, None)
+        for nid, n in spawn_requests.items():
+            node = self.nodes.get(nid)
+            if node is not None:
+                await self._request_workers(node, n)
+
+    async def _pump_class(self, key: Tuple, q, spawn_requests: Dict[NodeID, int]):
+        """Dispatch from one scheduling-class FIFO until the class blocks
+        (head-of-line blocking per class, reference: SchedulingClass
+        queues in cluster_task_manager.cc). Returning with the queue
+        non-empty means blocked; a completion/attach/registration re-pump
+        retries the head."""
+        _sclass, ehash = key
+        while q:
+            tid = q[0]
+            rec = self.tasks.get(tid)
+            if rec is None or rec.state != "PENDING":
+                q.popleft()  # cancelled/failed/dispatched elsewhere
                 continue
+            spec = rec.spec
             # 1. dependencies local?
-            deps_ready = True
+            advance = True
             for dep in spec.dependencies:
                 if dep not in self.objects and dep in self._freed_lru:
                     self._fail_task_objects(
                         spec, ObjectLostError(dep.hex(), "dependency was freed")
                     )
                     rec.state = "FAILED"
-                    deps_ready = False
+                    self._unindex_deps(spec)
                     break
                 orec = self._object(dep)
                 if orec.state == "FAILED":
                     self._fail_task_objects(spec, ObjectLostError(dep.hex(), "dependency failed"))
                     rec.state = "FAILED"
-                    deps_ready = False
+                    self._unindex_deps(spec)
                     break
                 if orec.state != "READY":
-                    deps_ready = False
-                    self._wait_dep(dep)
-                    still_pending.append(tid)
+                    # park OUT of the class queue (a dep-waiting head must
+                    # not block class-mates whose deps are ready); any dep
+                    # state change re-enqueues through the intake list
+                    self._park_on_dep(dep, tid)
+                    advance = False
                     break
-            if not deps_ready:
+            if not advance or rec.state != "PENDING":
+                q.popleft()
                 continue
             # 2. pick node
             demand = self.scheduler.translated_pg_demand(spec.resources, spec.scheduling_strategy)
             result = self.scheduler.schedule(spec.resources, spec.scheduling_strategy)
             if result.node_id is None:
-                still_pending.append(tid)
-                blocked_classes.add(sclass)
-                continue
-            # 3. idle worker (env-affine)? (ehash computed at the top)
+                return  # class blocked: infeasible for now
+            # 3. idle worker (env-affine)?
             worker = self._idle_worker_on(result.node_id, ehash)
             if worker is None:
                 # A node whose worker pool is EXHAUSTED (full, nothing
@@ -878,35 +905,38 @@ class Controller:
                     )
                     worker = self._idle_worker_on(result.node_id, ehash)
                 if worker is None:
-                    still_pending.append(tid)
-                    blocked_classes.add(sclass)
-                    if result.node_id is None:
-                        # every feasible node's pool is exhausted — wait
-                        # for a worker to free (completion re-pumps)
-                        class_spawn_cap[sclass] = 0
-                        class_spawned[sclass] = 0
-                        continue
-                    class_spawn_node[sclass] = result.node_id
-                    cap = self._class_slots(result.node_id, demand)
-                    class_spawn_cap[sclass] = cap
-                    if cap > 0:
-                        spawn_requests[result.node_id] = spawn_requests.get(result.node_id, 0) + 1
-                        class_spawned[sclass] = 1
-                    else:
-                        class_spawned[sclass] = 0
-                    continue
-            # 4. acquire resources + dispatch
+                    if result.node_id is not None:
+                        # Worker ramp-up for the queued depth, capped by
+                        # the node's SCHEDULABLE concurrency for this
+                        # demand — a deep queue of 1-CPU tasks on a 1-CPU
+                        # node must not spawn max_workers processes that
+                        # can never run concurrently (reference:
+                        # worker_pool soft limit ≈ CPU slots).
+                        cap = self._class_slots(result.node_id, demand)
+                        n = min(len(q), max(cap, 0))
+                        if n > 0:
+                            spawn_requests[result.node_id] = (
+                                spawn_requests.get(result.node_id, 0) + n
+                            )
+                    return  # class blocked until a worker attaches/frees
+            # 4. acquire resources + dispatch. The recycle loop above
+            # awaited: the task may have been cancelled/failed meanwhile —
+            # dispatching it would resurrect a FAILED record whose result
+            # objects were already failed.
+            if rec.state != "PENDING":
+                q.popleft()
+                continue
             node_res = self.cluster.nodes[result.node_id]
             if not node_res.acquire(demand):
-                still_pending.append(tid)
-                blocked_classes.add(sclass)
-                continue
+                return  # class blocked on resources
             rec.acquired = demand
             rec.node_id = result.node_id
             rec.worker_id = worker.worker_id
             rec.state = "DISPATCHED"
             worker.running.add(tid)
             worker.env_hash = ehash or worker.env_hash
+            q.popleft()
+            self._unindex_deps(spec)
             if spec.task_type == TaskType.ACTOR_CREATION_TASK:
                 worker.state = "ACTOR"
                 worker.actor_id = spec.actor_id
@@ -920,13 +950,41 @@ class Controller:
                 worker.state = "LEASED"
                 self._event("task", spec, "RUNNING")
                 await worker.peer.notify("execute_task", spec)
-        # New submissions may have arrived into self.pending_tasks while this
-        # loop awaited — keep both.
-        self.pending_tasks = still_pending + self.pending_tasks
-        for nid, n in spawn_requests.items():
-            node = self.nodes.get(nid)
-            if node is not None:
-                await self._request_workers(node, n)
+
+    def _unindex_deps(self, spec: TaskSpec):
+        for dep in spec.dependencies:
+            s = self._dep_index.get(dep)
+            if s is not None:
+                s.discard(spec.task_id)
+                if not s:
+                    del self._dep_index[dep]
+
+    def _fail_freed_dependents(self, oid: ObjectID):
+        for tid in list(self._dep_index.pop(oid, ())):
+            rec = self.tasks.get(tid)
+            if rec is None or rec.state != "PENDING":
+                continue
+            rec.state = "FAILED"
+            self._fail_task_objects(
+                rec.spec, ObjectLostError(oid.hex(), "dependency was freed")
+            )
+            self._unindex_deps(rec.spec)
+
+    def _park_on_dep(self, dep: ObjectID, tid: TaskID):
+        """Hold a dep-waiting task outside the class FIFOs until the dep
+        resolves; any state change (_wake on READY or FAILED) re-enqueues
+        it through the intake list for a fresh eligibility pass."""
+        self._dep_parked.add(tid)
+        orec = self._object(dep)
+        fut = asyncio.get_running_loop().create_future()
+
+        def _requeue(_):
+            self._dep_parked.discard(tid)
+            self.pending_tasks.append(tid)
+            self._schedule_pump()
+
+        fut.add_done_callback(_requeue)
+        orec.waiters.append(fut)
 
     def _class_slots(self, node_id: NodeID, demand) -> int:
         """How many MORE tasks of ``demand`` the node could start right
@@ -949,12 +1007,6 @@ class Controller:
         if slots is None:
             slots = 4  # zero-resource tasks: modest default ramp
         return max(0, int(slots) - starting)
-
-    def _wait_dep(self, dep: ObjectID):
-        orec = self._object(dep)
-        fut = asyncio.get_running_loop().create_future()
-        fut.add_done_callback(lambda _: self._schedule_pump())
-        orec.waiters.append(fut)
 
     # =================================================================
     # Task completion
@@ -1547,6 +1599,11 @@ class Controller:
         if orec.waiters:
             orec.state = "FAILED"
             self._wake(orec)
+        # Tasks queued behind a blocked class head may depend on the freed
+        # object; the per-class pump no longer re-scans every pending task
+        # each cycle, so fail them here (frees are rare, pending can be
+        # huge — this is the right side of that trade).
+        self._fail_freed_dependents(oid)
         for nid in orec.locations:
             node = self.nodes.get(nid)
             if node is None:
@@ -1916,6 +1973,7 @@ class Controller:
             rec.retries_left = 0
             self.pending_tasks = [t for t in self.pending_tasks if t != task_id]
             self._fail_task_objects(rec.spec, TaskCancelledError(task_id.hex()))
+            self._unindex_deps(rec.spec)
             return True
         if rec.state in ("DISPATCHED", "RUNNING") and rec.worker_id:
             worker = self.workers.get(rec.worker_id)
@@ -2120,14 +2178,33 @@ class Controller:
         waiting for placement plus bundles of pending placement groups
         (reference: SchedulerResourceReporter feeding the autoscaler via
         GcsAutoscalerStateManager)."""
+        import itertools
+
         demand = []
-        for tid in self.pending_tasks:
+        # pending work lives in the intake list, the per-class FIFOs, and
+        # the dep-parked set — all of it is unmet demand
+        pending_views = itertools.chain(
+            self.pending_tasks,
+            *self._class_queues.values(),
+            self._dep_parked,
+        )
+        def _with_labels(item: dict, strategy) -> dict:
+            # label-constrained demand carries its hard expressions so the
+            # autoscaler can pick a node TYPE whose labels satisfy them
+            hard = (strategy.node_labels or {}).get("hard") if strategy else None
+            if hard:
+                item["_labels"] = hard
+            return item
+
+        for tid in pending_views:
             rec = self.tasks.get(tid)
             if rec is not None and rec.state == "PENDING":
-                demand.append(rec.spec.resources.to_dict())
+                demand.append(_with_labels(
+                    rec.spec.resources.to_dict(), rec.spec.scheduling_strategy
+                ))
         for req in self._lease_reqs:
             # parked worker-lease requests are unmet task demand too
-            demand.append(req.demand.to_dict())
+            demand.append(_with_labels(req.demand.to_dict(), req.strategy))
         pg_demand = []
         for pg in self.pg_manager.pending_records():
             pg_demand.append(

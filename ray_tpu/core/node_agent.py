@@ -39,8 +39,16 @@ def child_env(needs_tpu: bool) -> dict:
     env = dict(os.environ)
     pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    # The trigger var must survive a CPU-mode hop in the spawn chain
+    # (driver → controller [no TPU] → worker [TPU]): stash it instead of
+    # dropping it, and restore for TPU-mode children.
+    saved = env.pop("RAY_TPU_SAVED_AXON_POOL_IPS", None)
     if not needs_tpu:
-        env.pop("PALLAS_AXON_POOL_IPS", None)
+        cur = env.pop("PALLAS_AXON_POOL_IPS", None) or saved
+        if cur:
+            env["RAY_TPU_SAVED_AXON_POOL_IPS"] = cur
+    elif "PALLAS_AXON_POOL_IPS" not in env and saved:
+        env["PALLAS_AXON_POOL_IPS"] = saved
     return env
 
 
@@ -486,11 +494,31 @@ class NodeAgent:
         config = self._chunk_bytes
         import socket
 
+        labels = {}
+        raw_labels = os.environ.get("RAY_TPU_NODE_LABELS", "")
+        if raw_labels:
+            try:
+                parsed = json.loads(raw_labels)
+            except ValueError:
+                parsed = None
+            # must be a str→str dict: dict(['ab']) would silently fabricate
+            # phantom labels and non-dict JSON would fail registration
+            if isinstance(parsed, dict) and all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in parsed.items()
+            ):
+                labels = parsed
+            else:
+                logger.warning(
+                    "RAY_TPU_NODE_LABELS must be a JSON object of string "
+                    "values, got %r — ignoring", raw_labels,
+                )
         info = await peer.call(
             "register_node", self.node_id, self.resources, self.store.shm_dir,
             hostname=socket.gethostname(), pid=os.getpid(),
             fetch_addr=f"{host_ip()}:{fetch_port}",
             provider_instance_id=os.environ.get("RAY_TPU_PROVIDER_INSTANCE_ID", ""),
+            labels=labels,
         )
         cfg = (info or {}).get("config") or {}
         self._chunk_bytes = int(cfg.get("object_transfer_chunk_bytes", config))

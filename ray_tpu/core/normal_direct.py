@@ -181,6 +181,8 @@ class NormalSubmitter:
 
     # -- lease + dispatch pump -------------------------------------------
     def _pump(self, ks: _KeyState) -> None:
+        if self.core.peer.closed:
+            return  # shutting down: no new lease requests, no retries
         for lease in list(ks.leases):
             while ks.queue and len(lease.inflight) < self.pipeline:
                 self._send(ks, lease, ks.queue.popleft())
@@ -238,7 +240,7 @@ class NormalSubmitter:
         except Exception as e:  # noqa: BLE001 — agent/worker unreachable, timeout
             if lease_id is not None:
                 self._notify_release(lease_id, None, None)
-            if ks.queue:
+            if ks.queue and not self.core.peer.closed:
                 logger.warning("lease acquisition failed (%s); retrying", e)
                 await asyncio.sleep(0.05)
             return

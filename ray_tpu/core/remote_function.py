@@ -65,6 +65,10 @@ def normalize_strategy(raw) -> SchedulingStrategy:
         return SchedulingStrategy(
             kind="NODE_AFFINITY", node_id=raw.node_id, soft=getattr(raw, "soft", False)
         )
+    if hasattr(raw, "to_wire") and (hasattr(raw, "hard") or hasattr(raw, "soft")):
+        # NodeLabelSchedulingStrategy (reference:
+        # util/scheduling_strategies.py:94-115 In/NotIn/Exists/DoesNotExist)
+        return SchedulingStrategy(kind="NODE_LABEL", node_labels=raw.to_wire())
     raise ValueError(f"unsupported scheduling strategy: {raw!r}")
 
 

@@ -33,6 +33,30 @@ class ScheduleResult:
     infeasible: bool = False  # no node could EVER run this → autoscaler hint
 
 
+def match_label_expressions(exprs: Optional[Dict], labels: Dict[str, str]) -> bool:
+    """Evaluate wire-form label expressions ({key: (op, values)}) against
+    a node's labels (reference: util/scheduling_strategies.py:94-115
+    In/NotIn/Exists/DoesNotExist)."""
+    for key, (op, values) in (exprs or {}).items():
+        present = key in labels
+        val = labels.get(key)
+        if op == "in":
+            if not present or val not in values:
+                return False
+        elif op == "not_in":
+            if present and val in values:
+                return False
+        elif op == "exists":
+            if not present:
+                return False
+        elif op == "does_not_exist":
+            if present:
+                return False
+        else:
+            raise ValueError(f"unknown label operator {op!r}")
+    return True
+
+
 class ClusterState:
     """Authoritative view of node resources (reference:
     ClusterResourceManager, cluster_resource_data.h).
@@ -113,6 +137,8 @@ class ClusterResourceScheduler:
             return self._spread(demand, exclude)
         if strategy.kind == "PLACEMENT_GROUP":
             return self._placement_group(demand, strategy, exclude)
+        if strategy.kind == "NODE_LABEL":
+            return self._node_label(demand, strategy, exclude)
         return self._hybrid(demand, exclude)
 
     # ------------------------------------------------------------------
@@ -177,6 +203,36 @@ class ClusterResourceScheduler:
         if node is None:
             return ScheduleResult(None, infeasible=True)
         return ScheduleResult(None)
+
+    def _node_label(self, demand: ResourceSet, strategy: SchedulingStrategy,
+                    exclude=None) -> ScheduleResult:
+        """Hard label expressions filter candidates (no match anywhere →
+        infeasible, surfaced to the autoscaler with the label demand);
+        soft expressions rank the survivors."""
+        labels = strategy.node_labels or {}
+        hard, soft = labels.get("hard"), labels.get("soft")
+        candidates = [
+            nid for nid in self.state.ordered_nodes()
+            if match_label_expressions(hard, self.state.nodes[nid].labels)
+            and not (exclude and nid in exclude)
+        ]
+        if not candidates:
+            return ScheduleResult(None, infeasible=True)
+        feasible = [n for n in candidates if self.state.nodes[n].is_feasible(demand)]
+        if not feasible:
+            return ScheduleResult(None, infeasible=True)
+        available = [n for n in feasible if self.state.nodes[n].fits(demand)]
+        if not available:
+            return ScheduleResult(None)
+        if soft:
+            preferred = [
+                n for n in available
+                if match_label_expressions(soft, self.state.nodes[n].labels)
+            ]
+            if preferred:
+                available = preferred
+        best = min(available, key=lambda n: self.state.nodes[n].utilization())
+        return ScheduleResult(best)
 
     def _placement_group(self, demand: ResourceSet, strategy: SchedulingStrategy, exclude=None) -> ScheduleResult:
         """Translate demand into the PG's renamed group resources

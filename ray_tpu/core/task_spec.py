@@ -130,13 +130,27 @@ class TaskSpec:
         normal_task_submitter.h:40 SchedulingKey). bundle_index matters:
         PG tasks pinned to different bundles translate to different group
         resources, so they must not share leases."""
+        st = self.scheduling_strategy
+        labels_key = None
+        if st.node_labels:
+            # canonical tuple form: label-different tasks must not share
+            # leases (placement differs even when resources match)
+            labels_key = tuple(
+                (kind, tuple(sorted(
+                    (k, op, tuple(sorted(vals)))
+                    for k, (op, vals) in exprs.items()
+                )))
+                for kind, exprs in sorted(st.node_labels.items())
+                if exprs
+            )
         return (
             tuple(sorted(self.resources.items_fp())),
-            self.scheduling_strategy.kind,
-            self.scheduling_strategy.node_id,
-            self.scheduling_strategy.soft,
-            str(self.scheduling_strategy.placement_group_id),
-            self.scheduling_strategy.bundle_index,
+            st.kind,
+            st.node_id,
+            st.soft,
+            str(st.placement_group_id),
+            st.bundle_index,
+            labels_key,
             self.func_digest,
         )
 

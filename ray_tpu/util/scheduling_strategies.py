@@ -20,3 +20,56 @@ class PlacementGroupSchedulingStrategy:
 class NodeAffinitySchedulingStrategy:
     node_id: str
     soft: bool = False
+
+
+# Node-label operators (reference: python/ray/util/scheduling_strategies
+# .py:94-115 — In/NotIn/Exists/DoesNotExist label matching).
+@dataclass
+class In:
+    values: list
+
+    def __init__(self, *values):
+        self.values = list(values)
+
+    def to_wire(self):
+        return ("in", self.values)
+
+
+@dataclass
+class NotIn:
+    values: list
+
+    def __init__(self, *values):
+        self.values = list(values)
+
+    def to_wire(self):
+        return ("not_in", self.values)
+
+
+class Exists:
+    def to_wire(self):
+        return ("exists", [])
+
+
+class DoesNotExist:
+    def to_wire(self):
+        return ("does_not_exist", [])
+
+
+@dataclass
+class NodeLabelSchedulingStrategy:
+    """Schedule onto nodes whose labels satisfy the expressions.
+
+    ``hard``: must match or the task stays pending (and its demand is
+    surfaced to the autoscaler as label-constrained). ``soft``: prefer
+    matching nodes, fall back to any hard-feasible node.
+    """
+
+    hard: Optional[dict] = None
+    soft: Optional[dict] = None
+
+    def to_wire(self) -> dict:
+        def conv(exprs):
+            return {k: op.to_wire() for k, op in (exprs or {}).items()}
+
+        return {"hard": conv(self.hard), "soft": conv(self.soft)}

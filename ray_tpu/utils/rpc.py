@@ -272,7 +272,12 @@ class Peer:
         self._closed = True
         for fut in self._pending.values():
             if not fut.done():
-                fut.set_exception(ConnectionLost("peer disconnected"))
+                try:
+                    fut.set_exception(ConnectionLost("peer disconnected"))
+                except RuntimeError:
+                    # teardown race: the loop closed under us — nobody is
+                    # left to read the future either
+                    pass
         self._pending.clear()
         cb = getattr(self.handler, "on_disconnect", None)
         if cb is not None:
@@ -404,12 +409,25 @@ class EventLoopThread:
         return asyncio.run_coroutine_threadsafe(coro, self.loop)
 
     def stop(self):
-        def _cancel_all():
-            for task in asyncio.all_tasks(self.loop):
-                task.cancel()
+        async def _drain_cancel():
+            tasks = [
+                t for t in asyncio.all_tasks(self.loop)
+                if t is not asyncio.current_task()
+            ]
+            for t in tasks:
+                t.cancel()
+            # Give cancelled tasks a cycle to unwind WHILE the loop is
+            # still alive: recv loops run their disconnect cleanup here,
+            # so no "Task was destroyed but it is pending" at GC and no
+            # set_exception against a closed loop.
+            await asyncio.gather(*tasks, return_exceptions=True)
 
         try:
-            self.loop.call_soon_threadsafe(_cancel_all)
+            fut = asyncio.run_coroutine_threadsafe(_drain_cancel(), self.loop)
+            try:
+                fut.result(timeout=3)
+            except Exception:  # noqa: BLE001 — wedged task; stop anyway
+                pass
             self.loop.call_soon_threadsafe(self.loop.stop)
             self.thread.join(timeout=5)
             if not self.loop.is_running():

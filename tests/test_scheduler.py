@@ -141,3 +141,124 @@ def test_spread_tasks_across_nodes(ray_start_cluster):
 
     nodes = set(ray_tpu.get([where.remote() for _ in range(6)], timeout=90))
     assert len(nodes) >= 2
+
+
+def _mk_labeled_state(nodes):
+    """nodes: list of (resources_dict, labels_dict)."""
+    state = ClusterState()
+    ids = []
+    for res, labels in nodes:
+        nid = NodeID.from_random()
+        state.add_node(nid, NodeResources(ResourceSet.from_dict(res), labels=labels))
+        ids.append(nid)
+    return state, ids
+
+
+class TestNodeLabelScheduling:
+    """Reference: python/ray/util/scheduling_strategies.py:94-115
+    (In/NotIn/Exists/DoesNotExist node-label strategies)."""
+
+    def _strategy(self, hard=None, soft=None):
+        return SchedulingStrategy(
+            kind="NODE_LABEL", node_labels={"hard": hard or {}, "soft": soft or {}}
+        )
+
+    def test_hard_in_places_on_matching_node(self):
+        state, ids = _mk_labeled_state([
+            ({"CPU": 4}, {"region": "us-east1"}),
+            ({"CPU": 4}, {"region": "us-west1"}),
+        ])
+        sched = ClusterResourceScheduler(state)
+        demand = ResourceSet.from_dict({"CPU": 1})
+        r = sched.schedule(demand, self._strategy(hard={"region": ("in", ["us-west1"])}))
+        assert r.node_id == ids[1]
+
+    def test_hard_not_in_excludes(self):
+        state, ids = _mk_labeled_state([
+            ({"CPU": 4}, {"region": "us-east1"}),
+            ({"CPU": 4}, {"region": "us-west1"}),
+        ])
+        sched = ClusterResourceScheduler(state)
+        demand = ResourceSet.from_dict({"CPU": 1})
+        r = sched.schedule(demand, self._strategy(hard={"region": ("not_in", ["us-east1"])}))
+        assert r.node_id == ids[1]
+
+    def test_exists_and_does_not_exist(self):
+        state, ids = _mk_labeled_state([
+            ({"CPU": 4}, {"spot": "true"}),
+            ({"CPU": 4}, {}),
+        ])
+        sched = ClusterResourceScheduler(state)
+        demand = ResourceSet.from_dict({"CPU": 1})
+        r = sched.schedule(demand, self._strategy(hard={"spot": ("exists", [])}))
+        assert r.node_id == ids[0]
+        r = sched.schedule(demand, self._strategy(hard={"spot": ("does_not_exist", [])}))
+        assert r.node_id == ids[1]
+
+    def test_no_label_match_is_infeasible(self):
+        state, _ = _mk_labeled_state([({"CPU": 4}, {"region": "us-east1"})])
+        sched = ClusterResourceScheduler(state)
+        demand = ResourceSet.from_dict({"CPU": 1})
+        r = sched.schedule(demand, self._strategy(hard={"region": ("in", ["eu-west4"])}))
+        assert r.node_id is None and r.infeasible
+
+    def test_soft_prefers_but_falls_back(self):
+        state, ids = _mk_labeled_state([
+            ({"CPU": 4}, {"region": "us-east1", "fast": "yes"}),
+            ({"CPU": 4}, {"region": "us-east1"}),
+        ])
+        sched = ClusterResourceScheduler(state)
+        demand = ResourceSet.from_dict({"CPU": 1})
+        st = self._strategy(
+            hard={"region": ("in", ["us-east1"])}, soft={"fast": ("exists", [])}
+        )
+        r = sched.schedule(demand, st)
+        assert r.node_id == ids[0]  # soft-preferred
+        # saturate the preferred node: falls back to the other hard match
+        state.nodes[ids[0]].acquire(ResourceSet.from_dict({"CPU": 4}))
+        r = sched.schedule(demand, st)
+        assert r.node_id == ids[1]
+
+    def test_label_demand_feeds_autoscaler_bin_pack(self):
+        from ray_tpu.autoscaler.autoscaler import bin_pack_new_nodes
+
+        node_types = {
+            "cpu": {"resources": {"CPU": 8}},
+            "tpu_east": {"resources": {"CPU": 8, "TPU": 4},
+                         "labels": {"region": "us-east1"}},
+        }
+        unmet = [{"CPU": 2, "_labels": {"region": ("in", ["us-east1"])}}]
+        launch = bin_pack_new_nodes(unmet, node_types, {"cpu": 5, "tpu_east": 5})
+        assert launch == {"tpu_east": 1}, launch
+
+
+@pytest.mark.slow
+def test_node_label_strategy_end_to_end(ray_start_cluster):
+    """Labels flow node_agent registration → scheduler → lease path."""
+    from ray_tpu.core.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import In, NodeLabelSchedulingStrategy
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2, labels={"tier": "gold"})
+    cluster.add_node(num_cpus=2, labels={"tier": "bronze"})
+    cluster.connect()
+    try:
+        @ray_tpu.remote(
+            num_cpus=1,
+            scheduling_strategy=NodeLabelSchedulingStrategy(hard={"tier": In("gold")}),
+        )
+        def where():
+            from ray_tpu import runtime_context
+
+            return runtime_context.get_runtime_context().get_node_id()
+
+        nodes = {n["node_id"]: n for n in ray_tpu.nodes()}
+        gold = [
+            nid for nid, n in nodes.items()
+            if n["resources"].get("labels", {}).get("tier") == "gold"
+        ]
+        assert len(gold) == 1, nodes
+        outs = ray_tpu.get([where.remote() for _ in range(4)], timeout=120)
+        assert all(o == gold[0] for o in outs), (outs, gold)
+    finally:
+        cluster.shutdown()
