@@ -25,9 +25,19 @@ def log(msg):
 
 
 def main():
+    cpu_mode = "--cpu" in sys.argv
+    # The end-to-end trainer bench must run FIRST: its worker process owns
+    # the chip, so this process must not have initialized the TPU backend
+    # yet (import jax alone is safe; device_count() is not).
+    e2e_step_time = None
+    if not cpu_mode and "--no-e2e" not in sys.argv:
+        try:
+            e2e_step_time = _bench_trainer_e2e(log)
+        except Exception as e:  # noqa: BLE001 — e2e must not kill the bare metric
+            log(f"trainer e2e bench failed: {e!r}")
+
     import jax
 
-    cpu_mode = "--cpu" in sys.argv
     if cpu_mode:
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
@@ -132,6 +142,17 @@ def main():
     log(f"tokens/s/chip {value:.0f}  MFU~{mfu:.2%} (peak {peak/1e12:.0f}TF)")
 
     extra = {}
+    if e2e_step_time is not None:
+        e2e_value = tokens_per_step / e2e_step_time / n_dev
+        extra["e2e_tokens_per_sec_per_chip"] = round(e2e_value, 1)
+        # ≥0.97 target: the framework loop (init→PG→WorkerGroup→session)
+        # must not tax the compiled step (reference e2e parity claim:
+        # doc/source/train/benchmarks.rst:49-83)
+        extra["e2e_vs_bare_step"] = round(fw_time / e2e_step_time, 4)
+        log(
+            f"e2e (JaxTrainer loop): {e2e_value:.0f} tokens/s/chip "
+            f"({extra['e2e_vs_bare_step']:.4f}x bare step)"
+        )
     if not cpu_mode:
         try:
             extra["decode_7b_bf16_tok_s"] = _bench_decode_7b(log)
@@ -146,6 +167,79 @@ def main():
     }
     record.update(extra)
     print(json.dumps(record))
+
+
+def _bench_trainer_e2e(log):
+    """The flagship config driven through the WHOLE framework on the real
+    chip: ray_tpu.init → placement group → WorkerGroup → _TrainSession
+    report (VERDICT r3 #4 — the reference's Train parity claim is
+    end-to-end, doc/source/train/benchmarks.rst:49-83). Returns the
+    measured per-step time from inside the training loop; the driver
+    process never touches the chip (the train WORKER owns it)."""
+    import ray_tpu
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def train_fn(config):
+        import time as _t
+
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu import train
+        from ray_tpu.models import transformer as tf
+        from ray_tpu.parallel import (
+            MeshPlan,
+            build_mesh,
+            make_train_state,
+            make_train_step,
+        )
+        from ray_tpu.parallel import mesh as mesh_lib
+        from ray_tpu.parallel.train_step import make_optimizer
+
+        cfg = tf.TransformerConfig(
+            vocab_size=32000, d_model=2304, n_layers=10, n_heads=18,
+            n_kv_heads=18, d_ff=5760, max_seq_len=2048,
+            dtype=jnp.bfloat16, remat=True,
+        )
+        batch_size, seq, steps, warmup = 12, 2048, 8, 3
+        plan = MeshPlan(dp=jax.device_count())
+        mesh = build_mesh(plan)
+        opt = make_optimizer(lr=3e-4, warmup=10)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (batch_size, seq + 1), 0, cfg.vocab_size
+        )
+        batch = {"tokens": jax.device_put(tokens, mesh_lib.batch_sharding(mesh, plan))}
+        params, opt_state, _ = make_train_state(cfg, plan, mesh, opt)
+        step = make_train_step(cfg, plan, mesh, opt)
+        # float() forces completion; block_until_ready is NOT a sync
+        # point for the tunneled-TPU backend inside a worker thread
+        # (measured: it returns in µs while float() waits the full step).
+        # 3 warmups: the 3rd step still re-autotunes on this backend.
+        for _ in range(warmup):
+            params, opt_state, m = step(params, opt_state, batch)
+            float(m["loss"])
+        t0 = _t.perf_counter()
+        for _ in range(steps):
+            params, opt_state, m = step(params, opt_state, batch)
+        float(m["loss"])
+        dt = (_t.perf_counter() - t0) / steps
+        train.report({"step_time_s": dt, "devices": jax.device_count()})
+
+    ray_tpu.init(num_cpus=4, num_tpus=1)
+    try:
+        trainer = JaxTrainer(
+            train_fn,
+            scaling_config=ScalingConfig(num_workers=1, use_tpu=True),
+            run_config=RunConfig(name="bench_e2e"),
+        )
+        result = trainer.fit()
+        if result.error is not None:
+            raise result.error
+        dt = result.metrics["step_time_s"]
+        log(f"e2e trainer step {dt*1e3:.1f}ms on {result.metrics['devices']} device(s)")
+        return dt
+    finally:
+        ray_tpu.shutdown()
 
 
 def _bench_decode_7b(log):

@@ -17,9 +17,14 @@ from ray_tpu.serve.api import (
     deployment,
     get_deployment_handle,
     run,
+    run_config,
     shutdown,
     start,
     status,
+)
+from ray_tpu.serve.multiplex import (
+    get_multiplexed_model_id,
+    multiplexed,
 )
 from ray_tpu.serve.grpc_proxy import (
     register_grpc_service,
@@ -34,6 +39,9 @@ from ray_tpu.serve.handle import (
 __all__ = [
     "deployment",
     "run",
+    "run_config",
+    "multiplexed",
+    "get_multiplexed_model_id",
     "start",
     "shutdown",
     "delete",

@@ -296,3 +296,110 @@ def shutdown():
             ray_tpu.kill(ctrl)
         except Exception:  # noqa: BLE001
             pass
+
+
+def run_config(config, name: Optional[str] = None) -> Dict[str, "DeploymentHandle"]:
+    """Declarative application deploy (reference: the serve config-file
+    deploy path — ``serve deploy config.yaml`` / ``serve.run`` with a
+    built config). ``config`` is a dict, a YAML/JSON file path, or a YAML
+    string with the reference's schema shape::
+
+        applications:
+          - name: app1                  # optional
+            import_path: mymodule:app   # module attr holding an Application
+            route_prefix: /app1         # optional
+            deployments:                # optional per-deployment overrides
+              - name: Model
+                num_replicas: 3
+                max_ongoing_requests: 16
+
+    Returns {application name: ingress handle}.
+    """
+    import importlib
+    import os as _os
+
+    if isinstance(config, str):
+        import yaml
+
+        if _os.path.exists(config):
+            with open(config) as f:
+                config = yaml.safe_load(f)
+        else:
+            config = yaml.safe_load(config)
+    if not isinstance(config, dict):
+        raise TypeError(f"config must be a dict/path/YAML string, got {type(config)}")
+    apps = config.get("applications")
+    if apps is None:
+        raise ValueError("config needs an 'applications' list")
+    handles: Dict[str, DeploymentHandle] = {}
+    http_cfg = config.get("http_options", {}) or {}
+    for app_cfg in apps:
+        import_path = app_cfg["import_path"]
+        mod_name, _, attr = import_path.replace("/", ".").partition(":")
+        if not attr:
+            raise ValueError(
+                f"import_path {import_path!r} must be 'module:attribute'"
+            )
+        app = getattr(importlib.import_module(mod_name), attr)
+        if not isinstance(app, Application):
+            raise TypeError(f"{import_path} is not a serve Application")
+        # copy the graph: sys.modules caches the imported Application, so
+        # in-place overrides would leak into later deploys of the same
+        # import_path
+        app = _copy_app(app)
+        overrides = {
+            d["name"]: {k: v for k, v in d.items() if k != "name"}
+            for d in app_cfg.get("deployments", []) or []
+        }
+        _apply_overrides(app, overrides)
+        if app_cfg.get("route_prefix"):
+            app.deployment = app.deployment.options(
+                route_prefix=app_cfg["route_prefix"]
+            )
+        handle = run(
+            app,
+            name=app_cfg.get("name"),
+            http_port=http_cfg.get("port"),
+            proxy_location=http_cfg.get("proxy_location", "HeadOnly"),
+        )
+        handles[app_cfg.get("name") or app.deployment.name] = handle
+    return handles
+
+
+def _apply_overrides(app: Application, overrides: Dict[str, dict], seen=None):
+    """Walk the application graph applying per-deployment config
+    overrides by deployment name (reference: config deploy merges the
+    file's deployment options over the decorated defaults)."""
+    seen = seen if seen is not None else set()
+    if id(app) in seen:
+        return
+    seen.add(id(app))
+    o = overrides.get(app.deployment.name)
+    if o:
+        # Deployment.config is a copy — rebuild the deployment with the
+        # merged options instead of mutating
+        app.deployment = app.deployment.options(**o)
+    for v in list(app.args) + list(app.kwargs.values()):
+        if isinstance(v, Application):
+            _apply_overrides(v, overrides, seen)
+
+
+def _copy_app(app: Application, memo: Optional[dict] = None) -> Application:
+    """Copy an Application graph (Deployment configs included) so config
+    overrides never mutate the imported module's shared objects. Diamond
+    sharing is preserved via ``memo``; bind graphs are acyclic."""
+    memo = memo if memo is not None else {}
+    hit = memo.get(id(app))
+    if hit is not None:
+        return hit
+
+    def conv(v):
+        return _copy_app(v, memo) if isinstance(v, Application) else v
+
+    new = Application(
+        Deployment(app.deployment._target, dict(app.deployment._config)),
+        tuple(conv(a) for a in app.args),
+        {k: conv(v) for k, v in app.kwargs.items()},
+    )
+    memo[id(app)] = new
+    return new

@@ -77,16 +77,30 @@ class ServeController:
         return True
 
     def get_replicas(self, name: str):
-        """(version, [(ActorHandle, node_id_hex|None)]) — handles cache
-        this by version; node ids feed locality-preferred routing without
-        every router scanning the cluster actor table."""
+        """(version, [(ActorHandle, node_id_hex|None, model_ids)]) —
+        handles cache this by version; node ids feed locality-preferred
+        routing and model ids feed multiplexed (model-affine) routing
+        without every router scanning the cluster."""
         with self._lock:
             d = self._deployments.get(name)
             if d is None:
                 return self._version, None
             replicas = list(d["replicas"])
+            models = dict(d.get("models", {}))
         nodes = self._replica_nodes(replicas)
-        return self._version, [(r, nodes.get(r._actor_id.hex())) for r in replicas]
+        return self._version, [
+            (r, nodes.get(r._actor_id.hex()), models.get(r._actor_id.hex(), []))
+            for r in replicas
+        ]
+
+    def report_models(self, name: str, replica_id_hex: str, model_ids: list):
+        """A multiplexed replica's resident-model set changed (reference:
+        the model-id push that backs model-affine routing)."""
+        with self._lock:
+            d = self._deployments.get(name)
+            if d is not None and replica_id_hex:
+                d.setdefault("models", {})[replica_id_hex] = list(model_ids)
+                self._version += 1
 
     def _replica_nodes(self, replicas) -> dict:
         """actor_id hex → node hex for this controller's replicas, cached

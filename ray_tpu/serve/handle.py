@@ -118,6 +118,7 @@ class _Router:
         self._lock = threading.Lock()
         self._replicas: list = []
         self._local: list = []
+        self._by_model: Dict[str, list] = {}
         self._version = -1
         self._inflight: Dict[Any, int] = {}
         self._last_report = 0.0
@@ -132,15 +133,20 @@ class _Router:
         self._last_refresh = now
         version = ray_tpu.get(self._controller.get_version.remote())
         if version != self._version:
-            v, pairs = ray_tpu.get(self._controller.get_replicas.remote(self._name))
-            if pairs is None:
+            v, rows = ray_tpu.get(self._controller.get_replicas.remote(self._name))
+            if rows is None:
                 raise RuntimeError(f"deployment {self._name} does not exist")
-            replicas = [r for r, _node in pairs]
-            local = self._local_subset(pairs)
+            replicas = [r for r, _node, _models in rows]
+            local = self._local_subset([(r, node) for r, node, _m in rows])
+            by_model: Dict[str, list] = {}
+            for r, _node, models in rows:
+                for mid in models or ():
+                    by_model.setdefault(mid, []).append(r)
             with self._lock:
                 self._version = v
                 self._replicas = replicas
                 self._local = local
+                self._by_model = by_model
                 self._inflight = {r: self._inflight.get(r, 0) for r in replicas}
 
     @staticmethod
@@ -159,8 +165,12 @@ class _Router:
         except Exception:  # noqa: BLE001 — locality is best-effort
             return []
 
-    def pick(self):
-        """p2c: sample two, take the one with fewer in-flight requests."""
+    def pick(self, multiplexed_model_id: str = ""):
+        """p2c: sample two, take the one with fewer in-flight requests.
+        With a model id, replicas that already hold the model win (the
+        reference's model-affine pow-2 routing); if none holds it yet,
+        fall back to the general pool — the chosen replica loads it and
+        the next refresh makes the route sticky."""
         deadline = time.monotonic() + 30
         force = False
         while True:
@@ -173,7 +183,12 @@ class _Router:
                 # prefer-local routing only when the local replica has
                 # capacity).
                 pool = self._replicas
-                if self._local:
+                holders = self._by_model.get(multiplexed_model_id) if multiplexed_model_id else None
+                if holders:
+                    live = [r for r in holders if r in self._inflight]
+                    if live:
+                        pool = live
+                elif self._local:
                     local_min = min(self._inflight.get(r, 0) for r in self._local)
                     global_min = min(
                         (self._inflight.get(r, 0) for r in self._replicas),
@@ -218,26 +233,36 @@ class DeploymentHandle:
         self.deployment_name = deployment_name
         self._controller = controller
         self._method = method_name
+        self._mux_id = ""
         self._router = _Router(deployment_name, controller)
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
+        return self._clone(method=name)
+
+    def _clone(self, method=None, mux_id=None) -> "DeploymentHandle":
         h = DeploymentHandle.__new__(DeploymentHandle)
         h.deployment_name = self.deployment_name
         h._controller = self._controller
-        h._method = name
+        h._method = method if method is not None else self._method
+        h._mux_id = mux_id if mux_id is not None else self._mux_id
         h._router = self._router  # share routing state across method handles
         return h
 
-    def options(self, method_name: str = "__call__") -> "DeploymentHandle":
-        return getattr(self, method_name) if method_name != "__call__" else self
+    def options(self, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+        """``multiplexed_model_id``: route to a replica already holding
+        the model (reference: handle.options(multiplexed_model_id=...))."""
+        return self._clone(method=method_name, mux_id=multiplexed_model_id)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         args = tuple(_unwrap(a) for a in args)
         kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
-        replica = self._router.pick()
-        ref = replica.handle_request.remote(self._method, args, kwargs)
+        replica = self._router.pick(self._mux_id)
+        ref = replica.handle_request.remote(
+            self._method, args, kwargs, self._mux_id
+        )
         return DeploymentResponse(ref, on_done=lambda r=replica: self._router.done(r))
 
     def stream(self, *args, **kwargs) -> DeploymentStreamingResponse:
@@ -246,23 +271,23 @@ class DeploymentHandle:
         → DeploymentResponseGenerator; the LLM token-streaming path)."""
         args = tuple(_unwrap(a) for a in args)
         kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
-        replica = self._router.pick()
+        replica = self._router.pick(self._mux_id)
         gen = replica.handle_request_stream.options(num_returns="streaming").remote(
-            self._method, args, kwargs
+            self._method, args, kwargs, self._mux_id
         )
         return DeploymentStreamingResponse(
             gen, on_done=lambda r=replica: self._router.done(r)
         )
 
     def __reduce__(self):
-        return (_rebuild_handle, (self.deployment_name, self._method))
+        return (_rebuild_handle, (self.deployment_name, self._method, self._mux_id))
 
 
-def _rebuild_handle(name: str, method: str):
+def _rebuild_handle(name: str, method: str, mux_id: str = ""):
     from ray_tpu.serve.api import get_deployment_handle
 
     h = get_deployment_handle(name)
-    return getattr(h, method) if method != "__call__" else h
+    return h._clone(method=method, mux_id=mux_id)
 
 
 def _unwrap(v):

@@ -1,0 +1,130 @@
+"""Model multiplexing: many models per deployment, few per replica.
+
+Reference: python/ray/serve/api.py ``serve.multiplexed`` +
+``serve.get_multiplexed_model_id`` and _private/multiplex.py
+(_ModelMultiplexWrapper) — a replica holds up to
+``max_num_models_per_replica`` models in an LRU cache, requests carry a
+model id (handle option or HTTP header), and the router sends a request
+to a replica that already has its model resident.
+
+TPU shape: a "model" is typically a param tree in HBM. Eviction drops
+the reference (freeing device memory); an optional ``__serve_unload__``
+hook on the model runs first (e.g. to persist KV state). The
+max-models cap is the HBM budget knob: models_per_replica ×
+model_bytes must fit the chip.
+"""
+from __future__ import annotations
+
+import collections
+import contextvars
+import functools
+import threading
+from typing import Any, Callable, List, Optional
+
+_current_model_id: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default=""
+)
+# HTTP header carrying the model id (reference: the serve_multiplexed_model_id
+# request header).
+MODEL_ID_HEADER = "serve_multiplexed_model_id"
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica handler: the model id of the current request
+    (reference: serve.get_multiplexed_model_id)."""
+    return _current_model_id.get()
+
+
+def _set_current_model_id(model_id: str):
+    return _current_model_id.set(model_id or "")
+
+
+class _MuxCache:
+    """Per-replica-instance LRU of loaded models."""
+
+    def __init__(self, loader: Callable, owner: Any, max_models: int,
+                 on_change: Optional[Callable[[List[str]], None]] = None):
+        self._loader = loader
+        self._owner = owner
+        self._max = max(1, int(max_models))
+        self._models: "collections.OrderedDict[str, Any]" = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self._on_change = on_change
+
+    def get(self, model_id: str):
+        with self._lock:
+            if model_id in self._models:
+                self._models.move_to_end(model_id)
+                return self._models[model_id]
+        # load OUTSIDE the lock (model loads are slow; concurrent requests
+        # for already-resident models must not queue behind them)
+        model = self._loader(self._owner, model_id)
+        changed = False
+        with self._lock:
+            if model_id not in self._models:
+                self._models[model_id] = model
+                changed = True
+            evicted = []
+            while len(self._models) > self._max:
+                _mid, old = self._models.popitem(last=False)
+                evicted.append(old)
+                changed = True
+        for old in evicted:
+            unload = getattr(old, "__serve_unload__", None)
+            if callable(unload):
+                try:
+                    unload()
+                except Exception:  # noqa: BLE001 — eviction must proceed
+                    pass
+            del old  # last reference → HBM freed
+        if changed and self._on_change is not None:
+            try:
+                self._on_change(self.loaded_ids())
+            except Exception:  # noqa: BLE001 — reporting is best-effort
+                pass
+        return model
+
+    def loaded_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._models)
+
+
+def multiplexed(func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorator for the replica method that loads a model by id
+    (reference: serve.multiplexed). The wrapped method becomes an
+    LRU-cached loader; calling it with a model id returns the resident
+    model, loading/evicting as needed."""
+
+    def deco(fn):
+        cache_attr = "_serve_mux_" + fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(self, model_id: str):
+            mux = getattr(self, cache_attr, None)
+            if mux is None:
+                on_change = getattr(self, "_serve_report_models", None)
+                mux = _MuxCache(fn, self, max_num_models_per_replica, on_change)
+                setattr(self, cache_attr, mux)
+            return mux.get(model_id)
+
+        wrapper.__serve_multiplexed__ = True
+        wrapper._serve_mux_cache_attr = cache_attr
+        return wrapper
+
+    if func is not None:
+        return deco(func)
+    return deco
+
+
+def loaded_model_ids(instance: Any) -> List[str]:
+    """Union of model ids resident in any mux cache on the instance."""
+    ids: List[str] = []
+    for name in dir(type(instance)):
+        fn = getattr(type(instance), name, None)
+        attr = getattr(fn, "_serve_mux_cache_attr", None)
+        if attr:
+            mux = getattr(instance, attr, None)
+            if mux is not None:
+                ids.extend(mux.loaded_ids())
+    return sorted(set(ids))

@@ -161,11 +161,16 @@ class ProxyActor:
 
             def _handle(self, body: bytes):
                 try:
+                    # model-multiplexed routing (reference: the
+                    # serve_multiplexed_model_id request header)
+                    mux_id = self.headers.get("serve_multiplexed_model_id", "")
                     mode = self._stream_mode()
                     if mode:
-                        self._send_stream(proxy._dispatch_stream(self.path, body), mode)
+                        self._send_stream(
+                            proxy._dispatch_stream(self.path, body, mux_id), mode
+                        )
                         return
-                    result = proxy._dispatch(self.path, body)
+                    result = proxy._dispatch(self.path, body, mux_id)
                     self._send(200, json.dumps(result, default=str).encode())
                 except KeyError:
                     self._send(404, b'{"error": "no such route"}')
@@ -187,12 +192,16 @@ class ProxyActor:
             payload = body.decode(errors="replace")
         return handle, payload
 
-    def _dispatch(self, path: str, body: bytes):
+    def _dispatch(self, path: str, body: bytes, mux_id: str = ""):
         handle, payload = self._resolve(path, body)
+        if mux_id:
+            handle = handle.options(multiplexed_model_id=mux_id)
         return RouteResolver.call(handle, payload)
 
-    def _dispatch_stream(self, path: str, body: bytes):
+    def _dispatch_stream(self, path: str, body: bytes, mux_id: str = ""):
         handle, payload = self._resolve(path, body)
+        if mux_id:
+            handle = handle.options(multiplexed_model_id=mux_id)
         return RouteResolver.stream(handle, payload)
 
     def port(self) -> int:

@@ -19,19 +19,46 @@ class Replica:
         else:
             # Function deployment: the "instance" is the function itself.
             self.instance = target
+        # Multiplexed deployments report their resident model ids to the
+        # controller so routers can prefer model-holding replicas
+        # (reference: multiplexed model id push in replica.py).
+        try:
+            self.instance._serve_report_models = self._report_models
+        except Exception:  # noqa: BLE001 — e.g. function deployments
+            pass
 
-    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+    def _report_models(self, model_ids):
+        try:
+            from ray_tpu.runtime_context import get_runtime_context
+            from ray_tpu.serve.controller import CONTROLLER_NAME
+            import ray_tpu as _ray
+
+            ctrl = _ray.get_actor(CONTROLLER_NAME)
+            aid = get_runtime_context().get_actor_id()
+            ctrl.report_models.remote(self.deployment_name, aid, list(model_ids))
+        except Exception:  # noqa: BLE001 — routing hint only
+            pass
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict,
+                       multiplexed_model_id: str = ""):
+        from ray_tpu.serve.multiplex import _set_current_model_id
+
+        _set_current_model_id(multiplexed_model_id)
         if method_name == "__call__":
             return self.instance(*args, **kwargs)
         return getattr(self.instance, method_name)(*args, **kwargs)
 
-    def handle_request_stream(self, method_name: str, args: tuple, kwargs: dict):
+    def handle_request_stream(self, method_name: str, args: tuple, kwargs: dict,
+                              multiplexed_model_id: str = ""):
         """Generator deployments: each yielded item becomes its own
         streamed object (reference: replica.py streaming request path —
         token streaming for LLM serving). Invoke with
         ``num_returns="streaming"``."""
         import inspect
 
+        from ray_tpu.serve.multiplex import _set_current_model_id
+
+        _set_current_model_id(multiplexed_model_id)
         target = (
             self.instance if method_name == "__call__" else getattr(self.instance, method_name)
         )
@@ -45,6 +72,11 @@ class Replica:
             yield from result
             return
         yield result
+
+    def get_loaded_model_ids(self):
+        from ray_tpu.serve.multiplex import loaded_model_ids
+
+        return loaded_model_ids(self.instance)
 
     def check_health(self) -> str:
         # User classes may define their own probe (reference:

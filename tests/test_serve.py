@@ -490,3 +490,110 @@ def test_grpc_user_service_method_dispatch(serve_cluster):
     finally:
         serve.unregister_grpc_service("test.Echo")
         serve.delete("echo_svc")
+
+
+def test_multiplexed_models_lru_and_sticky_routing(serve_cluster):
+    """3 model ids through 2 replicas: each replica holds <= 2 resident
+    models (LRU eviction at max_num_models_per_replica), and repeat
+    requests for a model route sticky to a replica that has it loaded
+    (reference: serve.multiplexed + model-affine routing)."""
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=4)
+    class MuxModel:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            # a "model" is a callable tagging outputs with its id
+            return lambda x, _mid=model_id: f"{_mid}:{x}"
+
+        def __call__(self, x):
+            import os
+
+            mid = serve.get_multiplexed_model_id()
+            model = self.get_model(mid)
+            return {"out": model(x), "pid": os.getpid(), "resident": len(self._serve_mux_get_model.loaded_ids())}
+
+    handle = serve.run(MuxModel.bind())
+    # drive 3 model ids; each must produce its own model's output
+    for mid in ("m1", "m2", "m3"):
+        r = handle.options(multiplexed_model_id=mid).remote(7).result(timeout=60)
+        assert r["out"] == f"{mid}:7", r
+    # LRU cap: no replica ever holds more than 2
+    for mid in ("m1", "m2", "m3", "m1", "m2", "m3"):
+        r = handle.options(multiplexed_model_id=mid).remote(1).result(timeout=60)
+        assert r["resident"] <= 2, r
+    # sticky: a FRESH model id loads on exactly one replica; once the
+    # routing table refreshes, every later request lands on that replica
+    # (model-affine routing — never a second copy on the other replica)
+    r0 = handle.options(multiplexed_model_id="m-sticky").remote(0).result(timeout=60)
+    time.sleep(1.5)  # let report_models + router refresh settle
+    pids = set()
+    for _ in range(5):
+        r = handle.options(multiplexed_model_id="m-sticky").remote(0).result(timeout=60)
+        pids.add(r["pid"])
+    assert pids == {r0["pid"]}, f"m-sticky bounced: {pids} vs loader {r0['pid']}"
+    serve.delete("MuxModel")
+
+
+def test_multiplexed_http_header_routing(serve_cluster):
+    """The serve_multiplexed_model_id HTTP header reaches the replica."""
+
+    @serve.deployment(num_replicas=1)
+    class H:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            return model_id.upper()
+
+        def __call__(self, payload):
+            return {"model": self.get_model(serve.get_multiplexed_model_id())}
+
+    serve.run(H.bind(), http_port=0)
+    port = serve.api.get_proxy_port()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/H",
+        data=json.dumps({"x": 1}).encode(),
+        headers={"serve_multiplexed_model_id": "fancy"},
+    )
+    body = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    assert body == {"model": "FANCY"}, body
+    serve.delete("H")
+
+
+def test_run_config_declarative_deploy(serve_cluster, tmp_path):
+    """YAML config deploy: import_path + per-deployment overrides
+    (reference: the serve config-file deploy path)."""
+    import textwrap
+
+    mod = tmp_path / "serve_cfg_app.py"
+    mod.write_text(textwrap.dedent("""
+        from ray_tpu import serve
+
+        @serve.deployment(num_replicas=1)
+        class CfgModel:
+            def __call__(self, x):
+                return {"doubled": x * 2}
+
+        app = CfgModel.bind()
+    """))
+    import sys
+
+    sys.path.insert(0, str(tmp_path))
+    try:
+        cfg = f"""
+applications:
+  - name: cfgapp
+    import_path: serve_cfg_app:app
+    route_prefix: /cfg
+    deployments:
+      - name: CfgModel
+        num_replicas: 2
+"""
+        handles = serve.run_config(cfg)
+        assert "cfgapp" in handles
+        out = handles["cfgapp"].remote(21).result(timeout=60)
+        assert out == {"doubled": 42}, out
+        st = serve.status()
+        assert st["CfgModel"]["target_replicas"] == 2, st
+        assert st["CfgModel"]["config"]["route_prefix"] == "/cfg", st
+        serve.delete("CfgModel")
+    finally:
+        sys.path.remove(str(tmp_path))
