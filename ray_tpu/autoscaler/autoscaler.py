@@ -93,6 +93,7 @@ class StandardAutoscaler:
         interval_s: float = 1.0,
         idle_timeout_s: float = 30.0,
         upscale_ticks: int = 2,
+        max_total_workers: Optional[int] = None,
     ):
         self.provider = provider
         self.node_types = node_types
@@ -100,6 +101,9 @@ class StandardAutoscaler:
         self.interval_s = interval_s
         self.idle_timeout_s = idle_timeout_s
         self.upscale_ticks = upscale_ticks
+        # global fleet cap across ALL node types (reference: the cluster
+        # YAML's top-level max_workers); per-type caps still apply.
+        self.max_total_workers = max_total_workers
         self._demand_age = 0
         self._idle_since: Dict[str, float] = {}
         self._provider_node_count: Dict[str, int] = {}
@@ -126,9 +130,17 @@ class StandardAutoscaler:
     # -- one reconciliation tick -------------------------------------------
     def update(self):
         counts = self._counts()
+
+        def _headroom() -> int:
+            if self.max_total_workers is None:
+                return 1 << 30
+            return max(0, self.max_total_workers - sum(counts.values()))
+
         # 1. min_workers floor.
         for tname, tcfg in self.node_types.items():
             for _ in range(tcfg.get("min_workers", 0) - counts.get(tname, 0)):
+                if _headroom() <= 0:
+                    break
                 self.provider.create_node(tname, tcfg["resources"])
                 counts[tname] = counts.get(tname, 0) + 1
 
@@ -145,7 +157,10 @@ class StandardAutoscaler:
             }
             for tname, n in bin_pack_new_nodes(unmet, self.node_types, launchable).items():
                 for _ in range(n):
+                    if _headroom() <= 0:
+                        break
                     self.provider.create_node(tname, self.node_types[tname]["resources"])
+                    counts[tname] = counts.get(tname, 0) + 1
             self._demand_age = 0
 
         # 3. idle nodes above min_workers → scale down.

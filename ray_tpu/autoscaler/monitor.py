@@ -1,0 +1,94 @@
+"""Cluster monitor process: the autoscaler the launcher runs next to the
+head (reference: python/ray/autoscaler/_private/monitor.py:126 — the
+Monitor process on the head node driving StandardAutoscaler).
+
+Spawned by ``ray-tpu up``; owns the provider (its provisioned node
+processes/instances) and gang-terminates them on SIGTERM — that is how
+``ray-tpu down`` tears the cluster down.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import threading
+
+logger = logging.getLogger("ray_tpu.monitor")
+
+
+def build_provider(provider_cfg: dict, address: str, session_dir: str):
+    ptype = provider_cfg["type"]
+    if ptype == "fake":
+        from ray_tpu.autoscaler.node_provider import FakeMultiNodeProvider
+
+        return FakeMultiNodeProvider(address, session_dir)
+    if ptype == "gce_tpu":
+        from ray_tpu.autoscaler.gce_tpu_provider import (
+            GceTpuNodeProvider,
+            RestGceTpuApi,
+        )
+
+        api = RestGceTpuApi(provider_cfg["project"], provider_cfg["zone"])
+        return GceTpuNodeProvider(
+            api,
+            cluster_name=provider_cfg.get("cluster_name", "rt"),
+            controller_address=address,
+            node_types=provider_cfg.get("node_types"),
+            **{k: v for k, v in provider_cfg.items()
+               if k in ("runtime_version", "package_spec")},
+        )
+    raise ValueError(f"unknown provider type {ptype!r}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--address", required=True)
+    p.add_argument("--session-dir", required=True)
+    p.add_argument("--config-json", required=True)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="[monitor] %(levelname)s %(message)s")
+    cfg = json.loads(args.config_json)
+
+    from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+    from ray_tpu.core.client import CoreWorker
+    from ray_tpu.utils import rpc
+
+    runner = rpc.EventLoopThread("monitor-admin")
+    admin = CoreWorker(args.address, mode="driver", loop_runner=runner)
+    provider = build_provider(cfg["provider"], args.address, args.session_dir)
+    autoscaler = StandardAutoscaler(
+        provider,
+        cfg["available_node_types"],
+        admin_call=lambda m, *a: admin._call(m, *a),
+        idle_timeout_s=cfg.get("idle_timeout_s", 60),
+        max_total_workers=cfg.get("max_workers"),
+    )
+    stop = threading.Event()
+
+    def on_term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    autoscaler.start()
+    logger.info("monitor up for %s (%d node types)",
+                args.address, len(cfg["available_node_types"]))
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        autoscaler.stop()
+        # gang-terminate everything this monitor provisioned — the
+        # launcher's `down` contract
+        provider.shutdown()
+        try:
+            admin.disconnect()
+            runner.stop()
+        except Exception:  # noqa: BLE001
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -278,6 +278,46 @@ def cmd_microbenchmark(args):
 
 
 # ---------------------------------------------------------------------------
+
+
+def cmd_up(args):
+    from ray_tpu.autoscaler.commands import create_or_update_cluster
+
+    state = create_or_update_cluster(args.cluster_config)
+    print(f"cluster {state['cluster_name']} up at {state['address']}")
+    print(f"session: {state['session_dir']}")
+    print(f"attach:  ray-tpu attach {state['cluster_name']}")
+    print(f"exec:    ray-tpu exec {state['cluster_name']} -- <cmd...>")
+    print(f"down:    ray-tpu down {state['cluster_name']}")
+    return 0
+
+
+def cmd_down(args):
+    from ray_tpu.autoscaler.commands import teardown_cluster
+
+    state = teardown_cluster(args.cluster)
+    print(f"cluster {state['cluster_name']} torn down")
+    return 0
+
+
+def cmd_exec(args):
+    from ray_tpu.autoscaler.commands import exec_on_cluster
+
+    cmd = list(args.command)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        print("usage: ray-tpu exec <cluster> -- <cmd...>", file=sys.stderr)
+        return 1
+    return exec_on_cluster(args.cluster, cmd).returncode
+
+
+def cmd_attach(args):
+    from ray_tpu.autoscaler.commands import attach_cluster
+
+    return attach_cluster(args.cluster)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="ray-tpu", description=__doc__.split("\n")[0])
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -293,6 +333,23 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_start)
 
     sub.add_parser("stop", help="stop the running cluster").set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("up", help="launch a cluster from a cluster YAML")
+    sp.add_argument("cluster_config")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a launched cluster (name or YAML)")
+    sp.add_argument("cluster")
+    sp.set_defaults(fn=cmd_down)
+
+    sp = sub.add_parser("exec", help="run a command against a launched cluster")
+    sp.add_argument("cluster")
+    sp.add_argument("command", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_exec)
+
+    sp = sub.add_parser("attach", help="interactive shell wired to a launched cluster")
+    sp.add_argument("cluster")
+    sp.set_defaults(fn=cmd_attach)
     sub.add_parser("status", help="cluster resource status").set_defaults(fn=cmd_status)
 
     sp = sub.add_parser("submit", help="submit a job: ray-tpu submit -- python x.py")

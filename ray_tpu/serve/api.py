@@ -331,7 +331,12 @@ def run_config(config, name: Optional[str] = None) -> Dict[str, "DeploymentHandl
     apps = config.get("applications")
     if apps is None:
         raise ValueError("config needs an 'applications' list")
+    if name is not None:
+        apps = [a for a in apps if a.get("name") == name]
+        if not apps:
+            raise ValueError(f"no application named {name!r} in config")
     handles: Dict[str, DeploymentHandle] = {}
+    deployed_names: Dict[str, str] = {}  # deployment -> application
     http_cfg = config.get("http_options", {}) or {}
     for app_cfg in apps:
         import_path = app_cfg["import_path"]
@@ -352,6 +357,18 @@ def run_config(config, name: Optional[str] = None) -> Dict[str, "DeploymentHandl
             for d in app_cfg.get("deployments", []) or []
         }
         _apply_overrides(app, overrides)
+        # deployments share ONE flat controller namespace: a cross-app
+        # name collision would silently clobber the earlier app's
+        # replicas via the redeploy path — refuse instead
+        app_name = app_cfg.get("name") or app.deployment.name
+        for dname in _graph_names(app):
+            owner = deployed_names.setdefault(dname, app_name)
+            if owner != app_name:
+                raise ValueError(
+                    f"deployment name {dname!r} appears in both "
+                    f"applications {owner!r} and {app_name!r}; deployment "
+                    "names are cluster-wide — rename one"
+                )
         if app_cfg.get("route_prefix"):
             app.deployment = app.deployment.options(
                 route_prefix=app_cfg["route_prefix"]
@@ -362,8 +379,17 @@ def run_config(config, name: Optional[str] = None) -> Dict[str, "DeploymentHandl
             http_port=http_cfg.get("port"),
             proxy_location=http_cfg.get("proxy_location", "HeadOnly"),
         )
-        handles[app_cfg.get("name") or app.deployment.name] = handle
+        handles[app_name] = handle
     return handles
+
+
+def _graph_names(app: Application, out=None) -> set:
+    out = out if out is not None else set()
+    out.add(app.deployment.name)
+    for v in list(app.args) + list(app.kwargs.values()):
+        if isinstance(v, Application):
+            _graph_names(v, out)
+    return out
 
 
 def _apply_overrides(app: Application, overrides: Dict[str, dict], seen=None):

@@ -24,6 +24,7 @@ from typing import Any, Callable, List, Optional
 _current_model_id: contextvars.ContextVar = contextvars.ContextVar(
     "serve_multiplexed_model_id", default=""
 )
+_mux_init_lock = threading.Lock()
 # HTTP header carrying the model id (reference: the serve_multiplexed_model_id
 # request header).
 MODEL_ID_HEADER = "serve_multiplexed_model_id"
@@ -49,26 +50,42 @@ class _MuxCache:
         self._max = max(1, int(max_models))
         self._models: "collections.OrderedDict[str, Any]" = collections.OrderedDict()
         self._lock = threading.Lock()
+        self._loading: dict = {}  # model_id -> Event (single-flight)
         self._on_change = on_change
 
     def get(self, model_id: str):
-        with self._lock:
-            if model_id in self._models:
-                self._models.move_to_end(model_id)
-                return self._models[model_id]
-        # load OUTSIDE the lock (model loads are slow; concurrent requests
-        # for already-resident models must not queue behind them)
-        model = self._loader(self._owner, model_id)
+        # Single-flight loading: concurrent first requests for one model
+        # must not each run the loader — a second param tree in HBM can
+        # OOM a chip sized for max_models exactly. Loads still run
+        # OUTSIDE the lock so resident-model requests never queue behind
+        # a slow load.
+        while True:
+            with self._lock:
+                if model_id in self._models:
+                    self._models.move_to_end(model_id)
+                    return self._models[model_id]
+                ev = self._loading.get(model_id)
+                if ev is None:
+                    ev = self._loading[model_id] = threading.Event()
+                    break  # this thread is the loader
+            ev.wait()  # another thread is loading — wait, then re-check
+        try:
+            model = self._loader(self._owner, model_id)
+        except BaseException:
+            with self._lock:
+                self._loading.pop(model_id, None)
+            ev.set()
+            raise
         changed = False
         with self._lock:
-            if model_id not in self._models:
-                self._models[model_id] = model
-                changed = True
+            self._models[model_id] = model
+            changed = True
             evicted = []
             while len(self._models) > self._max:
                 _mid, old = self._models.popitem(last=False)
                 evicted.append(old)
-                changed = True
+            self._loading.pop(model_id, None)
+        ev.set()
         for old in evicted:
             unload = getattr(old, "__serve_unload__", None)
             if callable(unload):
@@ -101,11 +118,21 @@ def multiplexed(func: Optional[Callable] = None, *,
 
         @functools.wraps(fn)
         def wrapper(self, model_id: str):
+            # call-time import: the wrapper ships by value inside the
+            # deployment's cls_blob (cloudpickle) and a captured module
+            # lock would be unpicklable
+            from ray_tpu.serve import multiplex as _mod
+
             mux = getattr(self, cache_attr, None)
             if mux is None:
-                on_change = getattr(self, "_serve_report_models", None)
-                mux = _MuxCache(fn, self, max_num_models_per_replica, on_change)
-                setattr(self, cache_attr, mux)
+                with _mod._mux_init_lock:  # one cache per instance+method
+                    mux = getattr(self, cache_attr, None)
+                    if mux is None:
+                        on_change = getattr(self, "_serve_report_models", None)
+                        mux = _mod._MuxCache(
+                            fn, self, max_num_models_per_replica, on_change
+                        )
+                        setattr(self, cache_attr, mux)
             return mux.get(model_id)
 
         wrapper.__serve_multiplexed__ = True

@@ -163,7 +163,9 @@ class ProxyActor:
                 try:
                     # model-multiplexed routing (reference: the
                     # serve_multiplexed_model_id request header)
-                    mux_id = self.headers.get("serve_multiplexed_model_id", "")
+                    from ray_tpu.serve.multiplex import MODEL_ID_HEADER
+
+                    mux_id = self.headers.get(MODEL_ID_HEADER, "")
                     mode = self._stream_mode()
                     if mode:
                         self._send_stream(

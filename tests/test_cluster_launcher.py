@@ -1,0 +1,95 @@
+"""Cluster launcher: up / exec / down over the NodeProvider layer.
+
+Reference: python/ray/scripts/scripts.py:2548-2579 (ray up/down/attach/
+exec) + autoscaler/_private/commands.py, exercised on the fake provider
+the way the reference tests the launcher on FakeMultiNodeProvider.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+@pytest.mark.slow
+def test_up_exec_down_fake_provider(tmp_path, monkeypatch):
+    # isolate cluster-state files from the user's home
+    monkeypatch.setenv("HOME", str(tmp_path))
+    cfg = tmp_path / "cluster.yaml"
+    cfg.write_text(
+        """
+cluster_name: launcher_test
+provider:
+  type: fake
+head_resources: {CPU: 2}
+idle_timeout_s: 300
+available_node_types:
+  worker:
+    resources: {CPU: 2, marker: 1}
+    min_workers: 2
+    max_workers: 4
+"""
+    )
+    from ray_tpu.autoscaler.commands import (
+        create_or_update_cluster,
+        exec_on_cluster,
+        read_cluster_state,
+        teardown_cluster,
+    )
+
+    state = create_or_update_cluster(str(cfg))
+    try:
+        assert state["cluster_name"] == "launcher_test"
+        assert read_cluster_state("launcher_test")["address"] == state["address"]
+        # idempotent re-up returns the live cluster
+        assert create_or_update_cluster(str(cfg))["address"] == state["address"]
+
+        # the monitor must bring up min_workers=2 agents: head + 2 ALIVE
+        check = (
+            "import ray_tpu, json, time\n"
+            "ray_tpu.init(address='auto')\n"
+            "deadline = time.time() + 90\n"
+            "while time.time() < deadline:\n"
+            "    alive = [n for n in ray_tpu.nodes() if n['state'] == 'ALIVE']\n"
+            "    if len(alive) >= 3: break\n"
+            "    time.sleep(0.5)\n"
+            "print(json.dumps({'alive': len(alive)}))\n"
+            "assert len(alive) >= 3, alive\n"
+            # run a task on a provisioned worker (its marker resource)
+            "@ray_tpu.remote(resources={'marker': 0.1})\n"
+            "def where():\n"
+            "    from ray_tpu import runtime_context\n"
+            "    return runtime_context.get_runtime_context().get_node_id()\n"
+            "print(json.dumps({'ran_on': ray_tpu.get(where.remote(), timeout=60)}))\n"
+            "ray_tpu.shutdown()\n"
+        )
+        # exec: the command runs against the launched head via
+        # RAY_TPU_ADDRESS (ray_tpu.init(address='auto'))
+        r = exec_on_cluster(
+            "launcher_test", [sys.executable, "-c", check], capture=True
+        )
+        assert r.returncode == 0, r.stderr
+        lines = [json.loads(l) for l in r.stdout.strip().splitlines() if l.startswith("{")]
+        assert lines[0]["alive"] >= 3, r.stdout
+        assert lines[1]["ran_on"], r.stdout
+    finally:
+        state = teardown_cluster("launcher_test")
+    # everything must be gone: head, monitor, provisioned agents
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        out = subprocess.run(
+            ["ps", "-eo", "pid,cmd"], capture_output=True, text=True
+        ).stdout
+        leftovers = [
+            l for l in out.splitlines()
+            if state["session_dir"] in l and "grep" not in l
+        ]
+        if not leftovers:
+            break
+        time.sleep(0.5)
+    assert not leftovers, leftovers
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), ".ray_tpu", "clusters", "launcher_test.json")
+    )
