@@ -104,16 +104,16 @@ def _spawn_monitor(cfg: dict, address: str, session_dir: str) -> int:
         "idle_timeout_s": cfg.get("idle_timeout_s", 60),
         "max_workers": cfg.get("max_workers"),
     }
-    log = open(os.path.join(session_dir, "logs", "monitor.log"), "ab")
-    mon = subprocess.Popen(
-        [
-            sys.executable, "-m", "ray_tpu.autoscaler.monitor",
-            "--address", address, "--session-dir", session_dir,
-            "--config-json", json.dumps(mon_cfg),
-        ],
-        env=child_env(needs_tpu=False),
-        stdout=log, stderr=subprocess.STDOUT,
-    )
+    with open(os.path.join(session_dir, "logs", "monitor.log"), "ab") as log:
+        mon = subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu.autoscaler.monitor",
+                "--address", address, "--session-dir", session_dir,
+                "--config-json", json.dumps(mon_cfg),
+            ],
+            env=child_env(needs_tpu=False),
+            stdout=log, stderr=subprocess.STDOUT,
+        )
     return mon.pid
 
 
@@ -183,18 +183,30 @@ def teardown_cluster(name_or_path) -> dict:
     them and cleans up on SIGTERM), then stop the head."""
     state = read_cluster_state(name_or_path)
     # 1. monitor: SIGTERM → provider.shutdown() terminates every
-    #    provisioned node, then the monitor exits.
+    #    provisioned node, then the monitor exits. Node termination can
+    #    take minutes (cloud TPU slice deletes), so wait generously —
+    #    SIGKILLing mid-shutdown leaks running (billing!) nodes.
     pid = state.get("monitor_pid")
+    unclean = False
     if pid:
         try:
             os.kill(pid, signal.SIGTERM)
-            for _ in range(100):
+            deadline = time.time() + 300
+            while time.time() < deadline:
                 try:
-                    os.kill(pid, 0)
-                except ProcessLookupError:
-                    break
+                    # reap if the monitor is OUR child — a zombie would
+                    # answer kill(pid, 0) forever
+                    done, _ = os.waitpid(pid, os.WNOHANG)
+                    if done == pid:
+                        break
+                except ChildProcessError:
+                    try:
+                        os.kill(pid, 0)
+                    except ProcessLookupError:
+                        break
                 time.sleep(0.1)
             else:
+                unclean = True
                 os.kill(pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
@@ -217,6 +229,19 @@ def teardown_cluster(name_or_path) -> dict:
             os.kill(state["head_pid"], signal.SIGTERM)
         except (ProcessLookupError, PermissionError):
             pass
+    if unclean:
+        # the monitor may not have finished terminating provider nodes —
+        # KEEP the state record so the operator can investigate/re-run
+        state["teardown_incomplete"] = True
+        with open(cluster_state_path(state["cluster_name"]), "w") as f:
+            json.dump(state, f, indent=1)
+        print(
+            f"WARNING: monitor for {state['cluster_name']} did not exit "
+            "cleanly; provider nodes may still be running — state kept at "
+            + cluster_state_path(state["cluster_name"]),
+            file=sys.stderr,
+        )
+        return state
     try:
         os.unlink(cluster_state_path(state["cluster_name"]))
     except FileNotFoundError:

@@ -278,6 +278,7 @@ class TaskExecutor:
                 "start": time.time(),
             }
         trace_span_cm = None
+        profiler_cm = None
         try:
             if spec.runtime_env:
                 from ray_tpu import runtime_env as _renv
@@ -299,6 +300,13 @@ class TaskExecutor:
                         f"execute:{spec.name}", {"task_id": spec.task_id.hex()}
                     )
                     trace_span_cm.__enter__()
+            if spec.runtime_env and spec.runtime_env.get("jax_profiler"):
+                # per-task jax.profiler capture (reference: the nsight
+                # runtime-env plugin wraps the worker with the profiler)
+                from ray_tpu.runtime_env.jax_profiler import task_trace
+
+                profiler_cm = task_trace(spec, spec.runtime_env["jax_profiler"])
+                profiler_cm.__enter__()
             args, kwargs = self._resolve_args(spec, inline_deps)
             if kind == "task":
                 fn = self._load_func(spec)
@@ -318,6 +326,16 @@ class TaskExecutor:
             else:  # actor_task
                 method = getattr(self.actor_instance, spec.actor_method_name)
                 result = _maybe_async(method(*args, **kwargs))
+            # Close the profiler capture BEFORE reporting: the caller's
+            # ray.get returns at report time and must be able to list
+            # the finished capture (streaming generator bodies run during
+            # _report and are not captured — a documented edge).
+            if profiler_cm is not None:
+                cmx, profiler_cm = profiler_cm, None
+                try:
+                    cmx.__exit__(None, None, None)
+                except Exception:  # noqa: BLE001 — capture teardown only
+                    pass
             # Report inside the span: for streaming tasks the generator
             # body runs during _report, which must be attributed.
             if reply is not None:
@@ -338,6 +356,11 @@ class TaskExecutor:
                 self._report(spec, None, err)
         finally:
             self.current_task_info = None
+            if profiler_cm is not None:
+                try:
+                    profiler_cm.__exit__(None, None, None)
+                except Exception:  # noqa: BLE001 — capture teardown only
+                    pass
             if trace_span_cm is not None:
                 from ray_tpu.util import tracing as _tracing
 

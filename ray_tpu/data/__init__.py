@@ -86,28 +86,43 @@ def read_parquet(paths, *, parallelism: int = -1) -> Dataset:
     return _read(ParquetDatasource(paths), parallelism)
 
 
-def read_bigquery(project_id: str, query: str, *, parallelism: int = -1) -> Dataset:
+def read_bigquery(project_id: str, query: str, *, parallelism: int = -1,
+                  _client_factory=None) -> Dataset:
     from ray_tpu.data.extra_datasources import BigQueryDatasource
 
-    return _read(BigQueryDatasource(project_id, query), parallelism)
+    # sharding only on an EXPLICIT parallelism (each shard re-runs the
+    # query; the -1 default must stay one query execution)
+    return _read(
+        BigQueryDatasource(project_id, query, _client_factory, shard=parallelism > 1),
+        parallelism,
+    )
 
 
-def read_mongo(uri: str, database: str, collection: str, *, pipeline=None, parallelism: int = -1) -> Dataset:
+def read_mongo(uri: str, database: str, collection: str, *, pipeline=None,
+               parallelism: int = -1, _client_factory=None) -> Dataset:
     from ray_tpu.data.extra_datasources import MongoDatasource
 
-    return _read(MongoDatasource(uri, database, collection, pipeline), parallelism)
+    return _read(
+        MongoDatasource(uri, database, collection, pipeline, _client_factory,
+                        shard=parallelism > 1),
+        parallelism,
+    )
 
 
-def read_lance(uri: str, *, parallelism: int = -1) -> Dataset:
+def read_lance(uri: str, *, parallelism: int = -1, _dataset_factory=None) -> Dataset:
     from ray_tpu.data.extra_datasources import LanceDatasource
 
-    return _read(LanceDatasource(uri), parallelism)
+    return _read(LanceDatasource(uri, _dataset_factory), parallelism)
 
 
-def read_iceberg(table_identifier: str, *, catalog_kwargs=None, row_filter=None, parallelism: int = -1) -> Dataset:
+def read_iceberg(table_identifier: str, *, catalog_kwargs=None, row_filter=None,
+                 parallelism: int = -1, _scan_factory=None) -> Dataset:
     from ray_tpu.data.extra_datasources import IcebergDatasource
 
-    return _read(IcebergDatasource(table_identifier, catalog_kwargs, row_filter), parallelism)
+    return _read(
+        IcebergDatasource(table_identifier, catalog_kwargs, row_filter, _scan_factory),
+        parallelism,
+    )
 
 
 def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:

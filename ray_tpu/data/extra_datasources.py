@@ -156,117 +156,240 @@ class SQLDatasource(Datasource):
 
 
 class BigQueryDatasource(Datasource):
-    """Reference: python/ray/data/_internal/datasource/bigquery_datasource.py.
+    """Reference: python/ray/data/_internal/datasource/bigquery_datasource.py
+    (the reference shards a BigQuery read across Storage-API streams).
     Requires ``google-cloud-bigquery`` (gated import — read tasks fail
-    with a clear error if it is absent). Single-task read: the query
-    result lands in one block (``parallelism`` is ignored); shard large
-    tables by issuing range-partitioned queries via ``read_sql``-style
-    WHERE clauses."""
+    with a clear error if it is absent). With ``parallelism > 1`` the
+    read fans out into N tasks, each running a deterministic hash-shard
+    of the query (``FARM_FINGERPRINT(TO_JSON_STRING(row)) MOD N``) so
+    shards are disjoint and exhaustive server-side.
 
-    def __init__(self, project_id: str, query: str):
+    Sharding is OPT-IN (``read_bigquery(..., parallelism=N)`` with an
+    explicit N>1): each shard re-executes the query with an output
+    filter, so an N-way read costs N query scans and requires a
+    deterministic query (no RAND()/unordered LIMIT). The default read
+    stays a single query execution.
+
+    ``client_factory`` (serialized into the read tasks, runs on workers)
+    exists for dependency injection in tests and for custom auth."""
+
+    def __init__(self, project_id: str, query: str,
+                 client_factory: Optional[Callable[[], Any]] = None,
+                 shard: bool = False):
         self._project = project_id
         self._query = query
+        self._factory = client_factory
+        self._shard = shard
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
-        project, query = self._project, self._query
+        project, query, factory = self._project, self._query, self._factory
 
-        def read() -> Iterable[Block]:
+        def make_client():
+            if factory is not None:
+                return factory()
             try:
                 from google.cloud import bigquery  # type: ignore
             except ImportError as e:
                 raise ImportError(
                     "read_bigquery requires google-cloud-bigquery"
                 ) from e
-            client = bigquery.Client(project=project)
-            rows = client.query(query).result()
+            return bigquery.Client(project=project)
+
+        p = max(1, parallelism) if self._shard else 1
+
+        def read(i: int = 0, p: int = p) -> Iterable[Block]:
+            client = make_client()
+            q = query if p == 1 else (
+                f"SELECT * FROM ({query}) AS _rt WHERE "
+                f"MOD(ABS(FARM_FINGERPRINT(TO_JSON_STRING(_rt))), {p}) = {i}"
+            )
+            rows = client.query(q).result()
             yield [dict(r) for r in rows]
 
-        return [ReadTask(read, BlockMetadata(0, 0))]
+        return [
+            ReadTask((lambda i=i: read(i)), BlockMetadata(0, 0))
+            for i in range(p)
+        ]
 
 
 class MongoDatasource(Datasource):
-    """Reference: mongo_datasource.py. Requires ``pymongo`` (gated).
-    Single-task read (``parallelism`` ignored); shard by passing a
-    ``pipeline`` with ``$match`` partitions per call."""
+    """Reference: mongo_datasource.py (the reference partitions the
+    collection across read tasks). Requires ``pymongo`` (gated). With
+    ``parallelism > 1`` each task reads the documents whose hashed
+    ``_id`` falls in its shard (``$toHashedIndexKey`` — disjoint and
+    exhaustive), composing with any user ``pipeline``."""
 
-    def __init__(self, uri: str, database: str, collection: str, pipeline: Optional[list] = None):
+    def __init__(self, uri: str, database: str, collection: str,
+                 pipeline: Optional[list] = None,
+                 client_factory: Optional[Callable[[], Any]] = None,
+                 shard: bool = False):
         self._uri = uri
         self._db = database
         self._coll = collection
         self._pipeline = pipeline or []
+        self._factory = client_factory
+        self._shard = shard
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
         uri, db, coll, pipeline = self._uri, self._db, self._coll, self._pipeline
+        factory = self._factory
 
-        def read() -> Iterable[Block]:
+        def make_client():
+            if factory is not None:
+                return factory()
             try:
                 import pymongo  # type: ignore
             except ImportError as e:
                 raise ImportError("read_mongo requires pymongo") from e
-            client = pymongo.MongoClient(uri)
+            return pymongo.MongoClient(uri)
+
+        # A user pipeline's $group/$sort/$limit stages are GLOBAL
+        # aggregations; running them per-shard would concatenate partial
+        # results — never shard around a pipeline.
+        p = max(1, parallelism) if (self._shard and not pipeline) else 1
+
+        def read(i: int = 0, p: int = p) -> Iterable[Block]:
+            client = make_client()
             try:
-                cursor = client[db][coll].aggregate(pipeline) if pipeline else client[db][coll].find()
-                yield [{k: v for k, v in doc.items() if k != "_id"} for doc in cursor]
+                c = client[db][coll]
+                if p == 1:
+                    cursor = c.aggregate(pipeline) if pipeline else c.find()
+                else:
+                    shard = {
+                        "$match": {
+                            "$expr": {
+                                "$eq": [
+                                    {"$mod": [
+                                        {"$abs": {"$toHashedIndexKey": "$_id"}},
+                                        p,
+                                    ]},
+                                    i,
+                                ]
+                            }
+                        }
+                    }
+                    cursor = c.aggregate([shard])
+                yield [
+                    {k: v for k, v in doc.items() if k != "_id"}
+                    for doc in cursor
+                ]
             finally:
                 client.close()
 
-        return [ReadTask(read, BlockMetadata(0, 0))]
+        return [
+            ReadTask((lambda i=i: read(i)), BlockMetadata(0, 0))
+            for i in range(p)
+        ]
 
 
 class LanceDatasource(Datasource):
-    """Reference: lance_datasource.py. Requires ``lance`` (gated). Lance
-    datasets are directories, not file globs, so this is a plain
-    single-task Datasource like IcebergDatasource."""
+    """Reference: lance_datasource.py (the reference fans out over Lance
+    FRAGMENTS). Requires ``lance`` (gated). Each read task opens the
+    dataset and reads the fragment stripe ``fragments[i::N]`` — no
+    plan-time metadata call, so the driver does not need the client."""
 
-    def __init__(self, uri: str):
+    def __init__(self, uri: str,
+                 dataset_factory: Optional[Callable[[], Any]] = None):
         self._uri = uri
+        self._factory = dataset_factory
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
-        uri = self._uri
+        uri, factory = self._uri, self._factory
 
-        def read() -> Iterable[Block]:
+        def open_dataset():
+            if factory is not None:
+                return factory()
             try:
                 import lance  # type: ignore
             except ImportError as e:
                 raise ImportError("read_lance requires pylance") from e
-            ds = lance.dataset(uri)
-            for batch in ds.to_batches():
-                yield {
-                    c: batch.column(c).to_numpy(zero_copy_only=False)
-                    for c in batch.schema.names
-                }
+            return lance.dataset(uri)
 
-        return [ReadTask(read, BlockMetadata(0, 0))]
+        p = max(1, parallelism)
+
+        def read(i: int = 0, p: int = p) -> Iterable[Block]:
+            ds = open_dataset()
+            frags = list(ds.get_fragments())[i::p] if p > 1 else [None]
+            for frag in frags:
+                source = frag if frag is not None else ds
+                for batch in source.to_batches():
+                    yield {
+                        c: batch.column(c).to_numpy(zero_copy_only=False)
+                        for c in batch.schema.names
+                    }
+
+        return [
+            ReadTask((lambda i=i: read(i)), BlockMetadata(0, 0))
+            for i in range(p)
+        ]
 
 
 class IcebergDatasource(Datasource):
-    """Reference: iceberg_datasource.py. Requires ``pyiceberg`` (gated).
-    Single-task read (``parallelism`` ignored); use ``row_filter`` to
-    shard by partition predicates."""
+    """Reference: iceberg_datasource.py (the reference fans out over the
+    scan's ``plan_files``). Requires ``pyiceberg`` (gated). Each read
+    task loads the table, plans the scan, and reads the file stripe
+    ``plan_files()[i::N]`` through pyiceberg's arrow projection (falling
+    back to a raw parquet read of ``task.file.file_path``; tasks with
+    delete files reject the raw path rather than return wrong rows)."""
 
-    def __init__(self, table_identifier: str, catalog_kwargs: Optional[dict] = None, row_filter: Optional[str] = None):
+    def __init__(self, table_identifier: str, catalog_kwargs: Optional[dict] = None,
+                 row_filter: Optional[str] = None,
+                 scan_factory: Optional[Callable[[], Any]] = None):
         self._table = table_identifier
         self._catalog_kwargs = catalog_kwargs or {}
         self._filter = row_filter
+        self._factory = scan_factory
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
         table_id, ckw, flt = self._table, self._catalog_kwargs, self._filter
+        factory = self._factory
 
-        def read() -> Iterable[Block]:
+        def make_scan():
+            if factory is not None:
+                return factory()
             try:
                 from pyiceberg.catalog import load_catalog  # type: ignore
             except ImportError as e:
                 raise ImportError("read_iceberg requires pyiceberg") from e
             catalog = load_catalog(**ckw)
             table = catalog.load_table(table_id)
-            scan = table.scan(row_filter=flt) if flt else table.scan()
-            arrow = scan.to_arrow()
-            yield {
+            return table.scan(row_filter=flt) if flt else table.scan()
+
+        # plan_files stripes cannot re-apply a row_filter (file stats only
+        # prune whole files); a filtered scan stays single-task so results
+        # never depend on parallelism.
+        p = max(1, parallelism) if flt is None else 1
+
+        def _arrow_to_block(arrow) -> Block:
+            return {
                 c: arrow.column(c).to_numpy(zero_copy_only=False)
                 for c in arrow.column_names
             }
 
-        return [ReadTask(read, BlockMetadata(0, 0))]
+        def read(i: int = 0, p: int = p) -> Iterable[Block]:
+            scan = make_scan()
+            if p == 1:
+                yield _arrow_to_block(scan.to_arrow())
+                return
+            tasks = list(scan.plan_files())[i::p]
+            for t in tasks:
+                reader = getattr(t, "to_arrow", None)
+                if callable(reader):  # test/mock or future pyiceberg API
+                    yield _arrow_to_block(reader())
+                    continue
+                if getattr(t, "delete_files", None):
+                    raise NotImplementedError(
+                        "sharded iceberg read cannot apply merge-on-read "
+                        "delete files; use parallelism=1 or compact the table"
+                    )
+                import pyarrow.parquet as pq
+
+                yield _arrow_to_block(pq.read_table(t.file.file_path))
+
+        return [
+            ReadTask((lambda i=i: read(i)), BlockMetadata(0, 0))
+            for i in range(p)
+        ]
 
 
 class ImageDatasource(FileBasedDatasource):

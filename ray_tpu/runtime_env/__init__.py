@@ -284,7 +284,14 @@ def _setup_unsupported(kind: str):
     return fail
 
 
+def _setup_jax_profiler_hook(value):
+    from ray_tpu.runtime_env.jax_profiler import _setup_jax_profiler
+
+    _setup_jax_profiler(value)
+
+
 register_plugin("env_vars", _setup_env_vars)
+register_plugin("jax_profiler", _setup_jax_profiler_hook)
 register_plugin("working_dir", _setup_working_dir)
 register_plugin("py_modules", _setup_py_modules)
 register_plugin("config", _setup_config)

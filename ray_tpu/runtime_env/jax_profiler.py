@@ -1,0 +1,93 @@
+"""Per-task JAX profiler capture via runtime_env.
+
+Reference: python/ray/_private/runtime_env/nsight.py — the reference
+wraps a worker with the nsight CUDA profiler when
+``runtime_env={"nsight": ...}``. The TPU-native analogue is
+``runtime_env={"jax_profiler": True}`` (or ``{"jax_profiler": {"dir":
+...}}``): the worker captures a ``jax.profiler`` trace around each task
+of that env, written under ``<session>/profiles/<task>-<id>/`` in the
+TensorBoard trace format (xplane; open with TensorBoard's profile
+plugin or xprof). Captures are listed by ``ray_tpu.util.state
+.list_profiles`` and the ``ray-tpu profile`` CLI.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Any
+
+from ray_tpu.exceptions import RuntimeEnvSetupError
+
+
+def _setup_jax_profiler(value: Any):
+    """Plugin hook: validates the env value (the capture itself wraps
+    task execution in worker_main — a per-task concern, not a one-time
+    env application)."""
+    if value in (True, False) or value is None:
+        return
+    if isinstance(value, dict):
+        unknown = set(value) - {"dir"}
+        if unknown:
+            raise RuntimeEnvSetupError(
+                f"jax_profiler options not understood: {sorted(unknown)}"
+            )
+        return
+    raise RuntimeEnvSetupError(
+        "runtime_env['jax_profiler'] must be True or {'dir': path}"
+    )
+
+
+def profiles_root(session_dir: str | None = None) -> str:
+    session_dir = session_dir or os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
+    return os.path.join(session_dir, "profiles")
+
+
+@contextlib.contextmanager
+def task_trace(spec, value: Any):
+    """Capture a jax.profiler trace around one task execution."""
+    if not value:
+        yield None
+        return
+    base = None
+    if isinstance(value, dict):
+        base = value.get("dir")
+    safe_name = "".join(c if c.isalnum() or c in "._-" else "_" for c in spec.name)[:48]
+    out_dir = os.path.join(
+        base or profiles_root(), f"{safe_name}-{spec.task_id.hex()[:8]}"
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    import jax
+
+    t0 = time.time()
+    jax.profiler.start_trace(out_dir)
+    try:
+        yield out_dir
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 — a failed stop must not mask the task error
+            pass
+        meta = {
+            "task_id": spec.task_id.hex(),
+            "name": spec.name,
+            "captured_at": t0,
+            "duration_s": round(time.time() - t0, 4),
+            "pid": os.getpid(),
+        }
+        try:
+            with open(os.path.join(out_dir, "profile.json"), "w") as f:
+                json.dump(meta, f)
+            if base:
+                # custom dir: leave a pointer in the session profiles
+                # root so list_profiles / the CLI still discover it
+                root = profiles_root()
+                os.makedirs(root, exist_ok=True)
+                marker = os.path.join(
+                    root, os.path.basename(out_dir) + ".external.json"
+                )
+                with open(marker, "w") as f:
+                    json.dump({**meta, "path": out_dir}, f)
+        except OSError:
+            pass

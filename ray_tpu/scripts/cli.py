@@ -227,6 +227,27 @@ def cmd_logs(args):
     return 0
 
 
+def cmd_profile(args):
+    """List/fetch jax.profiler captures (reference: nsight runtime-env
+    plugin reports; capture with runtime_env={"jax_profiler": True})."""
+    from ray_tpu.util import state
+
+    _connect()
+    if args.profile_id:
+        info = state.get_profile(args.profile_id)
+        print(json.dumps({k: v for k, v in info.items() if k != "files"}, indent=1))
+        for f in info["files"]:
+            print(f)
+    else:
+        rows = state.list_profiles()
+        if not rows:
+            print("no profiles captured (use runtime_env={'jax_profiler': True})")
+        for r in rows:
+            print(f"{r['id']}  task={r.get('task_id', '?')[:12]}  "
+                  f"dur={r.get('duration_s', '?')}s  {r['path']}")
+    return 0
+
+
 def cmd_microbenchmark(args):
     """Core perf smoke (reference: `ray microbenchmark`,
     python/ray/_private/ray_perf.py:93)."""
@@ -372,6 +393,10 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_timeline)
 
     sub.add_parser("memory", help="object store summary").set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("profile", help="list/fetch jax.profiler task captures")
+    sp.add_argument("profile_id", nargs="?")
+    sp.set_defaults(fn=cmd_profile)
     sub.add_parser("dashboard", help="print the dashboard URL").set_defaults(
         fn=cmd_dashboard
     )

@@ -197,3 +197,64 @@ def timeline_chrome(filename: Optional[str] = None) -> list:
         with open(filename, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+def list_profiles() -> List[dict]:
+    """Captured jax.profiler traces in this session (reference: the
+    nsight runtime-env plugin's reports, surfaced like `ray logs`).
+    Rows: {id, name, task_id, captured_at, duration_s, path}."""
+    import json as _json
+
+    from ray_tpu.core.api import _require_worker
+    from ray_tpu.runtime_env.jax_profiler import profiles_root
+
+    root = profiles_root(_require_worker().session_dir)
+    rows = []
+    if not os.path.isdir(root):
+        return rows
+    for entry in sorted(os.listdir(root)):
+        d = os.path.join(root, entry)
+        if entry.endswith(".external.json"):
+            # pointer to a capture written to a custom dir
+            row = {"id": entry[: -len(".external.json")]}
+            try:
+                with open(d) as f:
+                    row.update(_json.load(f))
+            except (OSError, ValueError):
+                row["path"] = d
+            rows.append(row)
+            continue
+        meta_path = os.path.join(d, "profile.json")
+        row = {"id": entry, "path": d}
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path) as f:
+                    row.update(_json.load(f))
+            except (OSError, ValueError):
+                pass
+        rows.append(row)
+    return rows
+
+
+def get_profile(profile_id: str) -> dict:
+    """One capture's metadata + its trace files (absolute paths)."""
+    from ray_tpu.core.api import _require_worker
+    from ray_tpu.runtime_env.jax_profiler import profiles_root
+
+    root = os.path.realpath(profiles_root(_require_worker().session_dir))
+    rows = list_profiles()
+    row = next((r for r in rows if r["id"] == profile_id), None)
+    if row is not None and row.get("path") and os.path.isdir(row["path"]):
+        d = row["path"]  # may be a custom capture dir outside the root
+    else:
+        d = os.path.realpath(os.path.join(root, profile_id))
+        if os.path.commonpath([d, root]) != root:
+            raise ValueError("profile path escapes the session profiles dir")
+        if not os.path.isdir(d):
+            raise FileNotFoundError(f"no profile {profile_id!r}")
+        row = row or {"id": profile_id, "path": d}
+    files = []
+    for base, _dirs, names in os.walk(d):
+        files.extend(os.path.join(base, n) for n in names)
+    row["files"] = sorted(files)
+    return row

@@ -154,3 +154,153 @@ def test_zip_uneven_block_boundaries(ray_start_regular):
     rows = a.zip(b).take_all()
     assert len(rows) == 24
     assert sorted(r["id"] for r in rows) == list(range(24))
+
+
+def test_read_bigquery_sharded_fan_out(ray_start_regular):
+    """VERDICT r3 #9: exotic reads shard into N read tasks (reference:
+    bigquery_datasource.py fans out over Storage-API streams). Mock
+    clients are defined INSIDE the test so cloudpickle ships them by
+    value into the worker processes."""
+    from ray_tpu import data
+    from ray_tpu.data.extra_datasources import BigQueryDatasource
+
+    TABLE = [{"id": i, "v": i * 10} for i in range(20)]
+
+    class FakeBQClient:
+        def query(self, q):
+            import re
+
+            class Rows:
+                def __init__(r, rows):
+                    r._rows = rows
+
+                def result(r):
+                    return r._rows
+
+            m = re.search(
+                r"MOD\(ABS\(FARM_FINGERPRINT\(TO_JSON_STRING\(_rt\)\)\), (\d+)\) = (\d+)", q
+            )
+            if not m:
+                return Rows(list(TABLE))
+            p, i = int(m.group(1)), int(m.group(2))
+            return Rows([r for r in TABLE if r["id"] % p == i])
+
+    # the plan must hold >1 read task
+    tasks = BigQueryDatasource("p", "SELECT * FROM t", FakeBQClient, shard=True).get_read_tasks(4)
+    assert len(tasks) == 4
+
+    ds = data.read_bigquery(
+        "p", "SELECT * FROM t", parallelism=4, _client_factory=FakeBQClient
+    )
+    rows = sorted(ds.take_all(), key=lambda r: r["id"])
+    assert rows == TABLE, rows[:3]
+
+
+def test_read_mongo_sharded_fan_out(ray_start_regular):
+    from ray_tpu import data
+
+    DOCS = [{"_id": i, "v": i} for i in range(18)]
+
+    class FakeMongoClient:
+        def __init__(self):
+            class Coll:
+                def aggregate(_self, pipeline):
+                    # evaluate the $toHashedIndexKey shard stage: mock hash = _id
+                    m = pipeline[0]["$match"]["$expr"]["$eq"]
+                    p = m[0]["$mod"][1]
+                    i = m[1]
+                    return [d for d in DOCS if abs(d["_id"]) % p == i]
+
+                def find(_self):
+                    return list(DOCS)
+
+            class DB:
+                def __getitem__(_self, k):
+                    return Coll()
+
+            self._db = DB()
+
+        def __getitem__(self, k):
+            return self._db
+
+        def close(self):
+            pass
+
+    ds = data.read_mongo(
+        "mongodb://x", "db", "c", parallelism=3, _client_factory=FakeMongoClient
+    )
+    rows = sorted(ds.take_all(), key=lambda r: r["v"])
+    assert [r["v"] for r in rows] == list(range(18))
+
+
+def test_read_lance_sharded_fan_out(ray_start_regular):
+    from ray_tpu import data
+
+    class FakeLanceDataset:
+        def get_fragments(self):
+            import numpy as np
+
+            class Fragment:
+                def __init__(f, lo, hi):
+                    f.lo, f.hi = lo, hi
+
+                def to_batches(f):
+                    class B:
+                        def __init__(b, vals):
+                            b._vals = vals
+
+                        @property
+                        def schema(b):
+                            class S:
+                                names = ["x"]
+
+                            return S()
+
+                        def column(b, c):
+                            class C:
+                                def __init__(c_, v):
+                                    c_.v = v
+
+                                def to_numpy(c_, zero_copy_only=False):
+                                    return c_.v
+
+                            return C(b._vals)
+
+                    yield B(np.arange(f.lo, f.hi))
+
+            return [Fragment(i * 5, (i + 1) * 5) for i in range(6)]
+
+    ds = data.read_lance("x", parallelism=3, _dataset_factory=FakeLanceDataset)
+    vals = sorted(v for row in ds.take_all() for v in [row["x"]])
+    assert vals == list(range(30))
+
+
+def test_read_iceberg_sharded_fan_out(ray_start_regular):
+    from ray_tpu import data
+
+    class FakeIcebergScan:
+        def plan_files(self):
+            import numpy as np
+
+            class T:
+                def __init__(t, lo, hi):
+                    t.lo, t.hi = lo, hi
+
+                def to_arrow(t):
+                    class A:
+                        column_names = ["y"]
+
+                        def column(a, c):
+                            class C:
+                                def to_numpy(c_, zero_copy_only=False):
+                                    return np.arange(t.lo, t.hi)
+
+                            return C()
+
+                    return A()
+
+            return [T(i * 4, (i + 1) * 4) for i in range(5)]
+
+    ds = data.read_iceberg("db.tbl", parallelism=2, _scan_factory=FakeIcebergScan)
+    vals = sorted(v for row in ds.take_all() for v in [row["y"]])
+    assert vals == list(range(20))

@@ -119,3 +119,55 @@ def test_pip_missing_package_fails(ray_start_regular):
 
     with pytest.raises((RuntimeEnvSetupError, TaskError)):
         ray_tpu.get(f.remote(), timeout=120)
+
+
+def test_jax_profiler_captures_trace(ray_start_regular):
+    """runtime_env={"jax_profiler": True} captures a jax.profiler trace
+    around a jitted task, stored in the session dir and listed via the
+    state API + fetched by the CLI (reference: the nsight runtime-env
+    plugin, _private/runtime_env/nsight.py)."""
+
+    @ray_tpu.remote(num_cpus=1, runtime_env={"jax_profiler": True})
+    def jitted(n):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: (x @ x.T).sum())
+        return float(f(jnp.ones((n, n))))
+
+    assert ray_tpu.get(jitted.remote(32), timeout=120) == 32.0 * 32 * 32
+
+    from ray_tpu.util import state
+
+    rows = state.list_profiles()
+    mine = [r for r in rows if r.get("name", "").startswith("jitted")]
+    assert mine, rows
+    row = mine[-1]
+    assert row.get("task_id") and row.get("duration_s") is not None
+    info = state.get_profile(row["id"])
+    # a real capture has xplane/trace payload files beside the metadata
+    payload = [f for f in info["files"] if not f.endswith("profile.json")]
+    assert payload, info["files"]
+
+    # CLI fetch
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    from ray_tpu.core.api import _require_worker
+    env["RAY_TPU_ADDRESS"] = _require_worker().address
+    r = subprocess.run(
+        [_sys.executable, "-m", "ray_tpu.scripts.cli", "profile", row["id"]],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert row["id"] in r.stdout and "profile.json" in r.stdout
+
+
+def test_jax_profiler_rejects_bad_options(ray_start_regular):
+    @ray_tpu.remote(num_cpus=1, runtime_env={"jax_profiler": {"bogus": 1}})
+    def f():
+        return 1
+
+    with pytest.raises((RuntimeEnvSetupError, TaskError)):
+        ray_tpu.get(f.remote(), timeout=60)
