@@ -29,7 +29,7 @@ from ray_tpu.core.api import (
     wait_actor_ready,
 )
 from ray_tpu.core.object_ref import ObjectRef
-from ray_tpu.core.actor import ActorClass, ActorHandle
+from ray_tpu.core.actor import ActorClass, ActorHandle, method
 from ray_tpu import exceptions
 
 __version__ = "0.1.0"
@@ -55,6 +55,7 @@ __all__ = [
     "ObjectRef",
     "ActorClass",
     "ActorHandle",
+    "method",
     "exceptions",
     "__version__",
 ]

@@ -125,6 +125,38 @@ def _pid_alive(pid) -> bool:
     return True
 
 
+def _terminate_monitor(pid, timeout: float = 300.0) -> bool:
+    """SIGTERM the monitor and wait for it to gang-terminate its provider
+    nodes and exit (cloud TPU slice deletes can take minutes). Returns
+    True on clean exit; False if it had to be SIGKILLed (provider nodes
+    may still be running)."""
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except ProcessLookupError:
+        return True  # already gone
+    except PermissionError:
+        return False  # alive but not ours — we cannot manage it
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            # reap if the monitor is OUR child — a zombie would answer
+            # kill(pid, 0) forever
+            done, _ = os.waitpid(pid, os.WNOHANG)
+            if done == pid:
+                return True
+        except ChildProcessError:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                return True
+        time.sleep(0.1)
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    return False
+
+
 def create_or_update_cluster(config_path, *, no_monitor: bool = False) -> dict:
     """``ray-tpu up``: start the head controller + the monitor process
     (autoscaler over the YAML's provider). With a live head, re-running
@@ -145,6 +177,20 @@ def create_or_update_cluster(config_path, *, no_monitor: bool = False) -> dict:
                 with open(state_path, "w") as f:
                     json.dump(state, f, indent=1)
             return state  # already up
+        # Head died but the monitor may have survived, still owning
+        # provisioned provider nodes. Terminate it (SIGTERM →
+        # provider.shutdown() gang-terminates its nodes) BEFORE discarding
+        # the state record — unlinking first would orphan a node-owning
+        # monitor with no recorded pid (a billing leak).
+        mon_pid = state.get("monitor_pid")
+        if mon_pid and _pid_alive(mon_pid):
+            if not _terminate_monitor(mon_pid):
+                raise RuntimeError(
+                    f"stale monitor (pid {mon_pid}) for cluster {name!r} did "
+                    "not exit within the teardown window; its provider nodes "
+                    "may still be running. Refusing to re-up — investigate "
+                    f"and tear down manually (state kept at {state_path})"
+                )
         os.unlink(state_path)
 
     from ray_tpu.core import api

@@ -174,7 +174,13 @@ class HopDeviceChannel:
     def write(self, value, timeout=None):
         """Writer half of the collective. ``value``: array data on the
         writer side (host or local device array; committed replicated
-        onto the src row)."""
+        onto the src row).
+
+        ``timeout`` is accepted for DeviceChannel interface parity but
+        IGNORED: hop transfers are untimed collectives — if the peer
+        process dies or never dispatches its half, this call blocks
+        indefinitely. Peer-failure detection belongs to gang supervision
+        (mpmd_gang restarts the gang on member death), not the channel."""
         import jax
 
         from ray_tpu.parallel.hop_bridge import commit_replicated
@@ -193,7 +199,11 @@ class HopDeviceChannel:
         """Reader half: dispatches the same collective and returns the
         value replicated over the reader row's devices. On a process
         that is also the writer, returns the value its own write()
-        already received (no second collective)."""
+        already received (no second collective).
+
+        ``timeout`` is accepted for DeviceChannel interface parity but
+        IGNORED — see write(): hop transfers are untimed collectives;
+        rely on gang supervision for peer-failure detection."""
         if not self._is_reader:
             raise RuntimeError("read() called on a non-reader process")
         if self._is_writer:

@@ -28,11 +28,33 @@ _DEFAULT_ACTOR_OPTIONS = dict(
     max_restarts=0,
     max_task_retries=0,
     max_concurrency=1,
+    concurrency_groups=None,  # {name: max_concurrency} per-group executors
     name=None,
     lifetime=None,
     scheduling_strategy=None,
     runtime_env=None,
 )
+
+
+def method(concurrency_group: Optional[str] = None, **unsupported):
+    """Method-level actor options (reference: python/ray/actor.py
+    ``@ray.method``): declare the concurrency group a method routes to.
+    Per-call ``.options(concurrency_group=...)`` overrides this.
+
+    ``num_returns`` is per-CALL here (handles don't carry class metadata
+    across pickling) — use ``.options(num_returns=...)``; passing it at
+    declaration raises rather than being silently ignored."""
+    if unsupported:
+        raise ValueError(
+            f"unsupported @ray_tpu.method option(s) {sorted(unsupported)}; "
+            "declare num_returns per call via .options(num_returns=...)"
+        )
+
+    def deco(f):
+        f.__ray_tpu_method_options__ = {"concurrency_group": concurrency_group}
+        return f
+
+    return deco
 
 
 class ActorClass:
@@ -96,6 +118,9 @@ class ActorClass:
             max_restarts=opts["max_restarts"],
             max_task_retries=opts["max_task_retries"],
             max_concurrency=opts["max_concurrency"],
+            concurrency_groups=dict(opts["concurrency_groups"])
+            if opts.get("concurrency_groups")
+            else None,
             runtime_env=runtime_env,
             hold_resources_while_alive=hold,
             lifetime=opts.get("lifetime"),
@@ -167,13 +192,16 @@ class ActorHandle:
 
 
 class ActorMethod:
-    def __init__(self, handle: ActorHandle, name: str, num_returns: int = 1):
+    def __init__(self, handle: ActorHandle, name: str, num_returns: int = 1,
+                 concurrency_group: Optional[str] = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
-    def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns: int = 1, concurrency_group: Optional[str] = None,
+                **_ignored) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns, concurrency_group)
 
     def bind(self, *args, **kwargs):
         """Lazy DAG node on a live actor (reference: actor method bind —
@@ -204,6 +232,7 @@ class ActorMethod:
             max_retries=self._handle._max_task_retries,
             actor_id=self._handle._actor_id,
             actor_method_name=self._name,
+            concurrency_group=self._concurrency_group,
             runtime_env=_tracing.inject_runtime_env(None),
         )
         refs = core.submit_actor_task(spec, captures)

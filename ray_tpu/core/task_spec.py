@@ -69,6 +69,13 @@ class TaskSpec:
     max_restarts: int = 0
     max_task_retries: int = 0
     max_concurrency: int = 1
+    # Named concurrency groups (reference:
+    # core_worker/transport/concurrency_group_manager.h:34): on actor
+    # creation, {group_name: max_concurrency}; on actor tasks, the group
+    # this call routes to (None → the method's declared group, else the
+    # default pool).
+    concurrency_groups: Optional[dict] = None
+    concurrency_group: Optional[str] = None
     # Runtime env (env vars only in v0; reference has full plugin system).
     runtime_env: Optional[dict] = None
     # Actor lifetime: None (owner-scoped) or "detached" — detached actors
@@ -111,8 +118,12 @@ class TaskSpec:
         "scheduling_strategy", "max_retries", "retry_exceptions", "actor_id",
         "actor_method_name", "actor_seq_no", "max_restarts",
         "max_task_retries", "max_concurrency", "runtime_env", "lifetime",
-        "hold_resources_while_alive",
+        "hold_resources_while_alive", "concurrency_groups",
+        "concurrency_group",
     )
+    # Defaults for trailing fields absent from tuples written by older
+    # builds (journal replay across upgrades).
+    _TAIL_DEFAULTS = {"concurrency_groups": None, "concurrency_group": None}
 
     def __getstate__(self):
         return tuple(getattr(self, f) for f in TaskSpec._FIELDS)
@@ -120,9 +131,12 @@ class TaskSpec:
     def __setstate__(self, state):
         if isinstance(state, dict):  # journals written pre-tuple-state
             self.__dict__.update(state)
+            for f, v in TaskSpec._TAIL_DEFAULTS.items():
+                self.__dict__.setdefault(f, v)
             self.__dict__.pop("_return_ids", None)
             return
-        for f, v in zip(TaskSpec._FIELDS, state):
+        for i, f in enumerate(TaskSpec._FIELDS):
+            v = state[i] if i < len(state) else TaskSpec._TAIL_DEFAULTS[f]
             object.__setattr__(self, f, v)
 
     def scheduling_class(self) -> Tuple:
@@ -175,6 +189,7 @@ def pack_actor_task(spec: TaskSpec) -> tuple:
         spec.runtime_env,
         spec.actor_seq_no,
         spec.owner_id.binary() if spec.owner_id else None,
+        spec.concurrency_group,
     )
 
 
@@ -194,6 +209,7 @@ def unpack_actor_task(t: tuple) -> TaskSpec:
         actor_method_name=t[3],
         actor_seq_no=t[9],
         runtime_env=t[8],
+        concurrency_group=t[11] if len(t) > 11 else None,
     )
 
 

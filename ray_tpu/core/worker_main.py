@@ -156,6 +156,16 @@ class TaskExecutor:
         self.core = core
         self.pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="task-exec")
         self.actor_pool: Optional[ThreadPoolExecutor] = None
+        # Named concurrency groups (reference: concurrency_group_manager.h
+        # :34 — per-group executors so a slow group can't starve another;
+        # ordering is preserved within each group's queue). Actor tasks
+        # that arrive before __init__ completes park in _pending_actor so
+        # group routing (which needs the constructed class) happens after
+        # creation, in submission order.
+        self.actor_groups: Dict[str, ThreadPoolExecutor] = {}
+        self._actor_ready = False
+        self._pending_actor: list = []
+        self._actor_gate = threading.Lock()
         self.actor_instance: Any = None
         self.cancelled: set = set()
         self.current_task_info: Optional[dict] = None  # read by rpc_current_task
@@ -181,12 +191,52 @@ class TaskExecutor:
             except Exception:  # noqa: BLE001 — controller gone
                 return
 
+    def _group_for(self, spec: TaskSpec) -> Optional[str]:
+        """Resolve an actor task's concurrency group: per-call override
+        (.options(concurrency_group=...)) wins over the method's declared
+        group (@ray_tpu.method(concurrency_group=...))."""
+        if spec.concurrency_group:
+            return spec.concurrency_group
+        if self.actor_instance is not None and spec.actor_method_name:
+            m = getattr(type(self.actor_instance), spec.actor_method_name, None)
+            if m is not None:
+                return getattr(m, "__ray_tpu_method_options__", {}).get(
+                    "concurrency_group"
+                )
+        return None
+
     def submit(self, spec: TaskSpec, kind: str, reply=None, inline_deps=None):
         if kind == "actor_task":
-            pool = self.actor_pool or self.pool
+            with self._actor_gate:
+                if not self._actor_ready:
+                    # __init__ still running (or queued): park; flushed in
+                    # order by _flush_pending_actor_tasks after creation.
+                    self._pending_actor.append((spec, reply, inline_deps))
+                    return
+            # Unknown group names fall through to the default pool; _run
+            # rejects them with a clean TaskError before executing.
+            pool = (
+                self.actor_groups.get(self._group_for(spec))
+                or self.actor_pool
+                or self.pool
+            )
         else:
             pool = self.pool
         pool.submit(self._guarded_run, spec, kind, reply, inline_deps)
+
+    def _flush_pending_actor_tasks(self):
+        """Called once creation finished (or failed): open the gate and
+        route everything parked behind it, preserving submission order."""
+        with self._actor_gate:
+            self._actor_ready = True
+            pending, self._pending_actor = self._pending_actor, []
+            for spec, reply, inline_deps in pending:
+                pool = (
+                    self.actor_groups.get(self._group_for(spec))
+                    or self.actor_pool
+                    or self.pool
+                )
+                pool.submit(self._guarded_run, spec, "actor_task", reply, inline_deps)
 
     def _guarded_run(self, spec: TaskSpec, kind: str, reply=None, inline_deps=None):
         try:
@@ -196,6 +246,11 @@ class TaskExecutor:
             if reply is not None:
                 self._reply(reply, ([], TaskError(spec.name, traceback.format_exc(), None)))
         finally:
+            # Creation done (success OR failure): release parked actor
+            # tasks — on failure they run against actor_instance=None and
+            # report clean TaskErrors, same as before the gate existed.
+            if kind == "actor_create" and not self._actor_ready:
+                self._flush_pending_actor_tasks()
             from ray_tpu import runtime_context
 
             runtime_context._set_task(None, None)
@@ -316,6 +371,10 @@ class TaskExecutor:
                 self.actor_instance = cls(*args, **kwargs)
                 n = max(1, spec.max_concurrency)
                 self.actor_pool = ThreadPoolExecutor(n, thread_name_prefix="actor-exec")
+                for gname, gsize in (spec.concurrency_groups or {}).items():
+                    self.actor_groups[gname] = ThreadPoolExecutor(
+                        max(1, int(gsize)), thread_name_prefix=f"actor-cg-{gname}"
+                    )
                 result = None
             elif spec.func_blob is not None:
                 # Function-on-actor (reference: __ray_call__): compiled-DAG
@@ -324,6 +383,12 @@ class TaskExecutor:
                 fn = self._load_func(spec)
                 result = _maybe_async(fn(self.actor_instance, *args, **kwargs))
             else:  # actor_task
+                group = self._group_for(spec)  # per-call override OR declared
+                if group and group not in self.actor_groups:
+                    raise ValueError(
+                        f"unknown concurrency group {group!r}; "
+                        f"declared groups: {sorted(self.actor_groups)}"
+                    )
                 method = getattr(self.actor_instance, spec.actor_method_name)
                 result = _maybe_async(method(*args, **kwargs))
             # Close the profiler capture BEFORE reporting: the caller's

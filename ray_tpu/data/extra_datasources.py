@@ -217,7 +217,9 @@ class MongoDatasource(Datasource):
     collection across read tasks). Requires ``pymongo`` (gated). With
     ``parallelism > 1`` each task reads the documents whose hashed
     ``_id`` falls in its shard (``$toHashedIndexKey`` — disjoint and
-    exhaustive), composing with any user ``pipeline``."""
+    exhaustive; requires MongoDB server >= 7.0). Against older servers
+    the sharded read degrades to a single full read on task 0 (with a
+    warning) rather than failing every task at runtime."""
 
     def __init__(self, uri: str, database: str, collection: str,
                  pipeline: Optional[list] = None,
@@ -268,7 +270,29 @@ class MongoDatasource(Datasource):
                             }
                         }
                     }
-                    cursor = c.aggregate([shard])
+                    try:
+                        cursor = c.aggregate([shard])
+                    except Exception as e:  # noqa: BLE001 — server capability probe
+                        # Degrade ONLY for the missing-operator error; any
+                        # other OperationFailure (stepdown, killed cursor,
+                        # auth) must propagate — swallowing it would return
+                        # an empty shard and silently drop 1/p of the rows.
+                        if (
+                            type(e).__name__ != "OperationFailure"
+                            or "toHashedIndexKey" not in str(e)
+                        ):
+                            raise
+                        # Pre-7.0 server: no $toHashedIndexKey. Degrade to
+                        # one full read (task 0) so results stay correct.
+                        import warnings
+
+                        warnings.warn(
+                            "MongoDB server lacks $toHashedIndexKey (needs "
+                            ">= 7.0); sharded read degrades to a single "
+                            "task reading the full collection",
+                            stacklevel=2,
+                        )
+                        cursor = c.find() if i == 0 else iter(())
                 yield [
                     {k: v for k, v in doc.items() if k != "_id"}
                     for doc in cursor
@@ -372,7 +396,26 @@ class IcebergDatasource(Datasource):
                 yield _arrow_to_block(scan.to_arrow())
                 return
             tasks = list(scan.plan_files())[i::p]
+            # Preferred stripe reader: pyiceberg's own arrow projection
+            # (field-id-based) — identical schema semantics to to_arrow()
+            # on schema-evolved tables (renamed/dropped/added columns) and
+            # correct merge-on-read delete handling. The raw parquet read
+            # below is only for mocks/missing-API fallback (reference:
+            # _internal/datasource/iceberg_datasource.py:160 uses
+            # project_table per FileScanTask for exactly this reason).
+            try:
+                from pyiceberg.io.pyarrow import project_table  # type: ignore
+
+                meta = scan.table_metadata
+                io = scan.io
+                proj = scan.projection()
+                rf = scan.row_filter
+            except (ImportError, AttributeError):
+                project_table = None
             for t in tasks:
+                if project_table is not None:
+                    yield _arrow_to_block(project_table([t], meta, io, rf, proj))
+                    continue
                 reader = getattr(t, "to_arrow", None)
                 if callable(reader):  # test/mock or future pyiceberg API
                     yield _arrow_to_block(reader())

@@ -1,0 +1,239 @@
+"""Paged KV cache + batched decode for continuous-batching LLM serving.
+
+The reference's LLM-serving story is vLLM running as Ray actors (SURVEY
+§2.9); this framework serves natively on TPU, so the vLLM ideas —
+block-paged KV memory and iteration-level (continuous) batching — are
+re-designed for XLA's static-shape world:
+
+- **Physical cache**: one pool of fixed-size blocks per layer,
+  ``[L, num_blocks, block_size, kv_heads, head_dim]``. Block 0 is a
+  reserved trash block that idle decode slots harmlessly write to, so
+  the decode step never branches on slot liveness.
+- **Block tables**: each decode slot owns a row ``[max_blocks_per_seq]``
+  of physical block ids. Tables/lengths are tiny int32 arrays passed
+  into the jitted step each iteration — the host allocator (see
+  ``ray_tpu/serve/llm_engine.py``) mutates them between steps, the
+  device program never sees allocation logic.
+- **Decode step** (``paged_decode_step``): fixed ``[max_batch]`` token
+  vector in, next tokens out. Per layer inside one ``lax.scan``:
+  scatter the new K/V into (block, offset) slots via batched
+  ``.at[].set``, gather the slot's blocks back as a contiguous
+  ``[b, W*bs, KV, HD]`` view, and run grouped-GQA einsum attention
+  under a per-slot length mask. Everything is static-shape; XLA sees
+  one compiled program regardless of which slots are live.
+- **Prefill** (``paged_prefill``): full-attention forward over a padded
+  prompt bucket, scattering each layer's roped K/V into the slot's
+  blocks. Buckets (powers of two) bound the number of compilations.
+
+Sampling is on-device and per-slot (greedy where ``temps == 0``, else
+temperature-scaled categorical), so one step moves only ``[b]`` int32s
+host↔device.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import (
+    Params,
+    TransformerConfig,
+    attention_block,
+    embed,
+    mlp_block,
+    project_qkv,
+    rms_norm,
+    unembed,
+)
+
+PagedCache = Dict[str, jax.Array]
+
+TRASH_BLOCK = 0  # physical block 0 is the write target for idle slots
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedConfig:
+    """Shape of the paged cache; all fields are compile-time constants."""
+
+    block_size: int = 16
+    num_blocks: int = 64  # physical pool size, incl. the trash block
+    max_batch: int = 8  # decode slots
+    max_blocks_per_seq: int = 8  # block-table width W
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.block_size * self.max_blocks_per_seq
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1  # minus trash
+
+
+def init_paged_cache(cfg: TransformerConfig, pcfg: PagedConfig) -> PagedCache:
+    shape = (
+        cfg.n_layers,
+        pcfg.num_blocks,
+        pcfg.block_size,
+        cfg.n_kv_heads,
+        cfg.head_dim,
+    )
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _attend_paged(q, ck, cv, lens, cfg: TransformerConfig):
+    """q: [b, H, HD] one token per slot; ck/cv: [b, m, KV, HD] gathered
+    contiguous views; lens: [b] — position of the token just written
+    (attend over positions <= lens, i.e. the prefix INCLUDING itself)."""
+    b, H, HD = q.shape
+    KV = cfg.n_kv_heads
+    G = H // KV
+    qg = q.reshape(b, KV, G, HD)
+    scores = jnp.einsum(
+        "bkgd,bmkd->bkgm", qg.astype(jnp.float32), ck.astype(jnp.float32)
+    ) * (HD**-0.5)
+    m = ck.shape[1]
+    valid = jnp.arange(m)[None, :] <= lens[:, None]  # [b, m]
+    scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    og = jnp.einsum("bkgm,bmkd->bkgd", probs, cv.astype(jnp.float32))
+    return og.reshape(b, H * HD).astype(q.dtype)
+
+
+def _paged_layer_step(x, lp: Params, cfg: TransformerConfig, ck, cv, tables, lens):
+    """One layer, one token per slot.
+
+    x: [b, 1, d]; ck/cv: [num_blocks, bs, KV, HD] (this layer's pool);
+    tables: [b, W] physical block ids; lens: [b] write positions.
+    """
+    b = x.shape[0]
+    bs = ck.shape[1]
+    h = rms_norm(x, lp["attn_norm"])
+    q, k, v = project_qkv(h, lp, cfg, lens[:, None])
+    # Scatter the new K/V at (block, offset) per slot. Idle slots are
+    # pointed at the trash block by the host allocator.
+    phys = jnp.take_along_axis(tables, (lens // bs)[:, None], axis=1)[:, 0]  # [b]
+    off = lens % bs
+    ck = ck.at[phys, off].set(k[:, 0])
+    cv = cv.at[phys, off].set(v[:, 0])
+    # Gather each slot's blocks into a contiguous [b, W*bs, KV, HD] view
+    # (post-scatter, so the just-written token attends to itself).
+    KV, HD = cfg.n_kv_heads, cfg.head_dim
+    W = tables.shape[1]
+    ck_g = ck[tables].reshape(b, W * bs, KV, HD)
+    cv_g = cv[tables].reshape(b, W * bs, KV, HD)
+    o = _attend_paged(q[:, 0], ck_g, cv_g, lens, cfg)
+    x = x + (o @ lp["wo"].astype(o.dtype))[:, None, :]
+    x = mlp_block(x, lp, cfg)
+    return x, ck, cv
+
+
+def paged_decode_step(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jax.Array,  # [b] int32 — the tokens AT positions ``lens``
+    cache: PagedCache,
+    tables: jax.Array,  # [b, W] int32
+    lens: jax.Array,  # [b] int32
+) -> Tuple[jax.Array, PagedCache]:
+    """One decode iteration over all slots → (logits [b, V] fp32, cache')."""
+    x = embed(params, tokens[:, None], cfg)
+
+    def body(carry, xs):
+        lp, ck, cv = xs
+        x, ck, cv = _paged_layer_step(carry, lp, cfg, ck, cv, tables, lens)
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    logits = unembed(params, x, cfg)[:, 0]
+    return logits, {"k": ks, "v": vs}
+
+
+def sample_tokens(logits: jax.Array, temps: jax.Array, key: jax.Array) -> jax.Array:
+    """Per-slot sampling: greedy where temps == 0, else categorical at
+    that slot's temperature. logits: [b, V] fp32; temps: [b] fp32."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+    sampled = jax.random.categorical(key, logits / safe_t).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
+def paged_decode_sample_step(
+    params, cfg: TransformerConfig, tokens, cache, tables, lens, temps, key
+):
+    """decode + on-device sampling → (next_tokens [b], cache')."""
+    logits, cache = paged_decode_step(params, cfg, tokens, cache, tables, lens)
+    return sample_tokens(logits, temps, key), cache
+
+
+def paged_prefill(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jax.Array,  # [1, S] int32, S a multiple of block_size (padded)
+    cache: PagedCache,
+    block_row: jax.Array,  # [S // block_size] int32 physical block ids
+    block_size: int,
+) -> Tuple[jax.Array, PagedCache]:
+    """Full-attention prefill of ONE slot, scattering K/V into its blocks.
+
+    Returns (logits [S, V] fp32, cache'). Padded tail positions hold
+    garbage K/V inside the last real block; they are masked by the
+    length mask during decode and overwritten as the sequence grows.
+    """
+    b, S = tokens.shape
+    assert b == 1 and S % block_size == 0
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :]
+    h = embed(params, tokens, cfg)
+
+    def body(carry, lp):
+        x, k, v = attention_block(carry, lp, cfg, positions, return_kv=True)
+        x = mlp_block(x, lp, cfg)
+        return x, (k, v)
+
+    h, (ks, vs) = jax.lax.scan(body, h, params["layers"])
+    logits = unembed(params, h, cfg)[0]
+    # ks: [L, 1, S, KV, HD] → [L, S//bs, bs, KV, HD], scatter rows into
+    # the pool at the slot's block ids (batched index scatter on axis 1).
+    L = cfg.n_layers
+    KV, HD = cfg.n_kv_heads, cfg.head_dim
+    nb = S // block_size
+    ks = ks.reshape(L, nb, block_size, KV, HD)
+    vs = vs.reshape(L, nb, block_size, KV, HD)
+    cache = {
+        "k": cache["k"].at[:, block_row].set(ks),
+        "v": cache["v"].at[:, block_row].set(vs),
+    }
+    return logits, cache
+
+
+def prefill_and_sample(
+    params, cfg: TransformerConfig, tokens, cache, block_row, block_size: int,
+    real_len, temp, key,
+):
+    """Prefill one slot and sample its first generated token on-device.
+
+    real_len: scalar int32 — the unpadded prompt length; the sampled
+    token continues from position real_len - 1.
+    """
+    logits, cache = paged_prefill(params, cfg, tokens, cache, block_row, block_size)
+    last = jax.lax.dynamic_index_in_dim(logits, real_len - 1, axis=0, keepdims=False)
+    tok = sample_tokens(last[None, :], temp[None], key)[0]
+    return tok, cache
+
+
+def make_jitted(params, cfg: TransformerConfig):
+    """Compile the decode step and prefill (cache donated in both — the
+    pool is updated in place, never double-buffered). jit re-specializes
+    prefill per prompt bucket automatically (one compile per bucket)."""
+    decode = jax.jit(
+        functools.partial(paged_decode_sample_step, params, cfg),
+        donate_argnums=(1,),  # cache
+    )
+    prefill = jax.jit(
+        functools.partial(prefill_and_sample, params, cfg),
+        static_argnums=(3,),  # block_size
+        donate_argnums=(1,),  # cache
+    )
+    return decode, prefill

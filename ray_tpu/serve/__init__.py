@@ -22,6 +22,7 @@ from ray_tpu.serve.api import (
     start,
     status,
 )
+from ray_tpu.serve.batching import batch
 from ray_tpu.serve.multiplex import (
     get_multiplexed_model_id,
     multiplexed,
@@ -37,6 +38,7 @@ from ray_tpu.serve.handle import (
 )
 
 __all__ = [
+    "batch",
     "deployment",
     "run",
     "run_config",

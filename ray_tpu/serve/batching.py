@@ -1,0 +1,136 @@
+"""``@serve.batch`` — transparent request batching for deployments.
+
+Reference: python/ray/serve/batching.py:468 (``@serve.batch``) and its
+``_BatchQueue`` (:80): callers invoke the wrapped method with a single
+item; calls accumulate in a queue and one flusher invokes the
+underlying function with the batched list, then scatters results back
+to the per-call futures.
+
+The reference's implementation is asyncio-native; replicas here run
+handlers on an actor thread pool (``max_concurrency`` /
+concurrency groups), so this queue is thread-based: any handler thread
+may trigger a flush, a ``threading.Condition`` coordinates, and each
+caller blocks on its own ``Future``. Flush fires when ``max_batch_size``
+items are waiting or the oldest has waited ``batch_wait_timeout_s``.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int, timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = timeout_s
+        self.items: List = []
+        self.futures: List[Future] = []
+        self.cond = threading.Condition()
+        self.flushing = False
+
+    def submit(self, item) -> Future:
+        fut: Future = Future()
+        with self.cond:
+            self.items.append(item)
+            self.futures.append(fut)
+            self.cond.notify_all()
+            if len(self.items) >= self.max_batch_size:
+                self._flush_locked()
+                return fut
+            if not self.flushing:
+                # This caller becomes the flusher: wait out the batching
+                # window (or until someone else fills/flushes the batch).
+                self.flushing = True
+                deadline = time.monotonic() + self.timeout_s
+                while self.items and len(self.items) < self.max_batch_size:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self.cond.wait(timeout=remaining)
+                self.flushing = False
+                if self.items:
+                    self._flush_locked()
+        return fut
+
+    def _flush_locked(self):
+        items, futs = self.items, self.futures
+        self.items, self.futures = [], []
+        # Run the batch OUTSIDE the lock so new arrivals queue up for the
+        # next batch while this one computes.
+        self.cond.release()
+        try:
+            try:
+                results = self.fn(items)
+                if results is None or len(results) != len(items):
+                    raise ValueError(
+                        f"@serve.batch function must return one result per "
+                        f"input ({len(items)} in, "
+                        f"{len(results) if results is not None else 0} out)"
+                    )
+                for f, r in zip(futs, results):
+                    f.set_result(r)
+            except Exception as e:  # noqa: BLE001 — propagate to every caller
+                for f in futs:
+                    if not f.done():
+                        f.set_exception(e)
+        finally:
+            self.cond.acquire()
+
+
+def batch(
+    _fn: Optional[Callable] = None,
+    *,
+    max_batch_size: int = 10,
+    batch_wait_timeout_s: float = 0.01,
+):
+    """Decorate a (self, items: List[X]) -> List[Y] method (or a plain
+    items->results function); callers invoke it with ONE item and get
+    that item's result. Usable bare (``@serve.batch``) or configured
+    (``@serve.batch(max_batch_size=32, batch_wait_timeout_s=0.05)``).
+    """
+
+    def deco(fn: Callable):
+        lock = threading.Lock()
+        # Plain-function queue lives with the decorated function; bound-
+        # method queues live ON the instance (dies with the replica — a
+        # module-level id(inst) map would pin every instance forever).
+        attr = f"__serve_batch_queue_{fn.__name__}__"
+        fn_queue: List[Optional[_BatchQueue]] = [None]
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            if len(args) == 2:  # bound method: (self, item)
+                inst, item = args
+                q = inst.__dict__.get(attr)
+                if q is None:
+                    with lock:
+                        q = inst.__dict__.get(attr)
+                        if q is None:
+                            q = _BatchQueue(
+                                lambda items, inst=inst: fn(inst, items),
+                                max_batch_size, batch_wait_timeout_s,
+                            )
+                            setattr(inst, attr, q)
+            elif len(args) == 1:  # plain function: (item,)
+                (item,) = args
+                if fn_queue[0] is None:
+                    with lock:
+                        if fn_queue[0] is None:
+                            fn_queue[0] = _BatchQueue(
+                                fn, max_batch_size, batch_wait_timeout_s
+                            )
+                q = fn_queue[0]
+            else:
+                raise TypeError("@serve.batch handlers take exactly one request arg")
+            return q.submit(item).result()
+
+        wrapper._is_serve_batch = True  # noqa: SLF001 — introspection marker
+        return wrapper
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco

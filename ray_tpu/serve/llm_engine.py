@@ -1,0 +1,359 @@
+"""Continuous-batching LLM engine over the paged KV cache.
+
+The reference serves LLMs by running vLLM engines as Ray actors
+(SURVEY §2.9 "delegated"); here the engine is native. It implements
+iteration-level scheduling (Orca/vLLM): between every decode iteration
+the host admits waiting requests into free slots, allocates KV blocks
+on demand, and retires finished sequences — so one compiled decode
+program continuously serves an evolving request mix.
+
+Host/device split:
+- Device (``ray_tpu/models/paged.py``): one jitted decode step over all
+  ``max_batch`` slots; one jitted prefill per prompt bucket. Sampling is
+  on-device; a step moves only ``[b]`` int32 tokens back.
+- Host (this module): block free-list, slot assignment, preemption
+  (victim's blocks are freed and the request re-queued with its
+  generated prefix folded into the prompt — recompute-on-resume, the
+  vLLM default), per-request streaming queues.
+
+Threading: ``step()`` is single-threaded; ``start()`` runs it in a pump
+thread so serve replicas can stream from concurrent handler threads
+while one engine drives the chip.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import queue
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ray_tpu.models.paged import (
+    TRASH_BLOCK,
+    PagedConfig,
+    init_paged_cache,
+    make_jitted,
+)
+from ray_tpu.models.transformer import TransformerConfig
+
+_req_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request; ``out`` streams generated token ids and a
+    final ``None`` sentinel."""
+
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    eos_id: Optional[int] = None
+    rid: int = dataclasses.field(default_factory=lambda: next(_req_ids))
+    out: "queue.Queue" = dataclasses.field(default_factory=queue.Queue)
+    generated: List[int] = dataclasses.field(default_factory=list)
+    # Set on rejection (prompt too long etc.); the sentinel is still sent.
+    error: Optional[str] = None
+
+    @property
+    def remaining(self) -> int:
+        return self.max_new_tokens - len(self.generated)
+
+    @property
+    def full_prompt(self) -> List[int]:
+        """Prompt + everything generated so far — what a preempted
+        request must re-prefill on resume (recompute policy)."""
+        return self.prompt + self.generated
+
+    def tokens(self, timeout: Optional[float] = None):
+        """Iterate generated tokens until the sentinel (blocking)."""
+        while True:
+            tok = self.out.get(timeout=timeout)
+            if tok is None:
+                if self.error:
+                    raise RuntimeError(self.error)
+                return
+            yield tok
+
+
+class _BlockAllocator:
+    def __init__(self, pcfg: PagedConfig):
+        # Block 0 is the trash block — never handed out.
+        self.free = list(range(pcfg.num_blocks - 1, TRASH_BLOCK, -1))
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        if n <= 0:
+            return []  # NOT free[-0:] — that slice is the whole list
+        if len(self.free) < n:
+            return None
+        got, self.free = self.free[-n:], self.free[:-n]
+        return got
+
+    def release(self, blocks: Sequence[int]):
+        self.free.extend(b for b in blocks if b != TRASH_BLOCK)
+
+    @property
+    def available(self) -> int:
+        return len(self.free)
+
+
+class LLMEngine:
+    """Continuous-batching engine for one model on one chip/mesh."""
+
+    def __init__(
+        self,
+        params,
+        cfg: TransformerConfig,
+        pcfg: Optional[PagedConfig] = None,
+        *,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.pcfg = pcfg or PagedConfig()
+        p = self.pcfg
+        self._decode, self._prefill = make_jitted(params, cfg)
+        self.cache = init_paged_cache(cfg, p)
+        self.alloc = _BlockAllocator(p)
+        self.key = jax.random.PRNGKey(seed)
+        # Slot state (host-side numpy; shipped to device each step).
+        self.slots: List[Optional[Request]] = [None] * p.max_batch
+        self.slot_blocks: List[List[int]] = [[] for _ in range(p.max_batch)]
+        self.tables = np.full((p.max_batch, p.max_blocks_per_seq), TRASH_BLOCK, np.int32)
+        self.lens = np.zeros(p.max_batch, np.int32)
+        self.temps = np.zeros(p.max_batch, np.float32)
+        self.cur = np.zeros(p.max_batch, np.int32)
+        self.waiting: "collections.deque[Request]" = collections.deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Stats for tests/bench.
+        self.stats = {"steps": 0, "tokens": 0, "max_active": 0, "preemptions": 0,
+                      "prefills": 0}
+
+    # ------------------------------------------------------------------
+    # Public API
+    # ------------------------------------------------------------------
+
+    def add_request(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+    ) -> Request:
+        req = Request(list(prompt), max_new_tokens, temperature, eos_id)
+        if not req.prompt:
+            req.error = "prompt must be non-empty"
+            req.out.put(None)
+            return req
+        total = len(req.prompt) + max_new_tokens
+        worst_blocks = -(-total // self.pcfg.block_size)
+        if total > self.pcfg.max_seq_len or worst_blocks > self.pcfg.usable_blocks:
+            req.error = (
+                f"prompt({len(req.prompt)}) + max_new_tokens({max_new_tokens}) "
+                f"exceeds capacity (max_seq_len={self.pcfg.max_seq_len}, "
+                f"usable_blocks={self.pcfg.usable_blocks})"
+            )
+            req.out.put(None)
+            return req
+        with self._lock:
+            self.waiting.append(req)
+        self._wake.set()
+        return req
+
+    def start(self):
+        """Run the pump loop in a daemon thread."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                if not self.step():
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="llm-engine")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def generate_batch(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+    ) -> List[List[int]]:
+        """Synchronous convenience: submit all, pump until done."""
+        reqs = [
+            self.add_request(p, max_new_tokens, temperature=temperature, eos_id=eos_id)
+            for p in prompts
+        ]
+        if self._thread is None:
+            while self.active_count() or self.waiting:
+                self.step()
+        return [list(r.tokens(timeout=120.0)) for r in reqs]
+
+    def active_count(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    # ------------------------------------------------------------------
+    # Scheduler internals
+    # ------------------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        """Smallest block-multiple power-of-two-ish bucket >= n, bounding
+        prefill compilations to O(log max_seq_len)."""
+        b = self.pcfg.block_size
+        while b < n:
+            b *= 2
+        return min(b, self.pcfg.max_seq_len)
+
+    def _free_slot(self, i: int):
+        self.alloc.release(self.slot_blocks[i])
+        self.slot_blocks[i] = []
+        self.slots[i] = None
+        self.tables[i] = TRASH_BLOCK
+        self.lens[i] = 0
+        self.temps[i] = 0.0
+        self.cur[i] = 0
+
+    def _finish(self, i: int):
+        req = self.slots[i]
+        self._free_slot(i)
+        req.out.put(None)
+
+    def _preempt_one(self) -> bool:
+        """Evict the most-recently admitted slot (its prefix is shortest
+        to recompute) and requeue it at the front; on resume its whole
+        ``full_prompt`` (prompt + generated) is re-prefilled and
+        generation continues — already-streamed tokens are not replayed.
+        Reference policy: vLLM recompute-preemption."""
+        victims = [i for i, s in enumerate(self.slots) if s is not None]
+        if not victims:
+            return False
+        i = max(victims, key=lambda j: self.slots[j].rid)
+        req = self.slots[i]
+        self._free_slot(i)
+        with self._lock:
+            self.waiting.appendleft(req)
+        self.stats["preemptions"] += 1
+        return True
+
+    def _ensure_decode_blocks(self) -> None:
+        """Every active slot must own the block its next write lands in;
+        allocate on demand, preempting if the pool is exhausted."""
+        bs = self.pcfg.block_size
+        for i in range(len(self.slots)):
+            while self.slots[i] is not None:
+                need_idx = int(self.lens[i]) // bs
+                if need_idx < len(self.slot_blocks[i]):
+                    break  # this slot's next write is covered
+                got = self.alloc.alloc(1)
+                if got is not None:
+                    self.slot_blocks[i].append(got[0])
+                    self.tables[i, len(self.slot_blocks[i]) - 1] = got[0]
+                    continue
+                # Pool exhausted: evict the youngest slot (possibly i
+                # itself, in which case the outer while sees it freed).
+                if not self._preempt_one():
+                    return  # nothing evictable; retry next step
+
+    def _admit(self):
+        """Move waiting requests into free slots while blocks allow."""
+        p = self.pcfg
+        bs = p.block_size
+        while True:
+            free_slots = [i for i, s in enumerate(self.slots) if s is None]
+            if not free_slots:
+                return
+            with self._lock:
+                if not self.waiting:
+                    return
+                req = self.waiting.popleft()
+            plen = len(req.full_prompt)
+            real_blocks = -(-plen // bs)  # ceil
+            got = self.alloc.alloc(real_blocks)
+            if got is None:
+                with self._lock:
+                    self.waiting.appendleft(req)
+                return
+            i = free_slots[0]
+            self.slots[i] = req
+            self.slot_blocks[i] = got
+            self.tables[i] = TRASH_BLOCK
+            self.tables[i, :real_blocks] = got
+            self.temps[i] = req.temperature
+            self._run_prefill(i, req)
+
+    def _run_prefill(self, i: int, req: Request):
+        """Prefill slot ``i``'s prompt and emit the first sampled token."""
+        p = self.pcfg
+        bs = p.block_size
+        full = req.full_prompt
+        plen = len(full)
+        S = self._bucket(plen)
+        toks = np.zeros((1, S), np.int32)
+        toks[0, :plen] = full
+        # Block row covers the padded bucket; entries past the real
+        # prompt scatter into the trash block.
+        row = np.full(S // bs, TRASH_BLOCK, np.int32)
+        nreal = -(-plen // bs)
+        row[:nreal] = self.slot_blocks[i]
+        self.key, sub = jax.random.split(self.key)
+        tok, self.cache = self._prefill(
+            jax.numpy.asarray(toks), self.cache, jax.numpy.asarray(row), bs,
+            np.int32(plen), np.float32(req.temperature), sub,
+        )
+        self.stats["prefills"] += 1
+        self.lens[i] = plen
+        self.cur[i] = int(tok)
+        self._emit(i, int(tok))
+
+    def _emit(self, i: int, tok: int):
+        """Record + stream one generated token; retire the slot when done."""
+        req = self.slots[i]
+        req.generated.append(tok)
+        req.out.put(tok)
+        self.stats["tokens"] += 1
+        if (req.eos_id is not None and tok == req.eos_id) or req.remaining <= 0:
+            self._finish(i)
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit → page → decode. Returns True
+        if any device work ran (False = idle)."""
+        self._admit()
+        if self.active_count() == 0:
+            return False
+        self._ensure_decode_blocks()
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            return False
+        self.stats["max_active"] = max(self.stats["max_active"], len(active))
+        self.key, sub = jax.random.split(self.key)
+        nxt, self.cache = self._decode(
+            jax.numpy.asarray(self.cur), self.cache,
+            jax.numpy.asarray(self.tables), jax.numpy.asarray(self.lens),
+            jax.numpy.asarray(self.temps), sub,
+        )
+        nxt = np.asarray(nxt)
+        self.stats["steps"] += 1
+        for i in active:
+            if self.slots[i] is None:
+                continue
+            self.lens[i] += 1  # the fed token's KV is now in the cache
+            self.cur[i] = nxt[i]
+            self._emit(i, int(nxt[i]))
+        return True

@@ -54,12 +54,12 @@ def test_autoscaling_cluster_scales_up_and_down():
 
         # Head has 1 CPU; each task needs 2 → must autoscale.
         refs = [heavy.remote(i) for i in range(4)]
-        assert sorted(ray_tpu.get(refs, timeout=90)) == [0, 1, 2, 3]
+        assert sorted(ray_tpu.get(refs, timeout=180)) == [0, 1, 2, 3]
         n_nodes = len([n for n in ray_tpu.nodes() if n["state"] == "ALIVE"])
         assert n_nodes >= 2  # head + at least one autoscaled node
 
         # Idle long enough → scale back down.
-        deadline = time.monotonic() + 40
+        deadline = time.monotonic() + 120  # generous: shared box under load
         while time.monotonic() < deadline:
             if not cluster.provider.non_terminated_nodes():
                 break
@@ -143,14 +143,14 @@ def test_autoscaler_v2_scales_up_and_down():
             return x
 
         refs = [heavy.remote(i) for i in range(4)]
-        assert sorted(ray_tpu.get(refs, timeout=90)) == [0, 1, 2, 3]
+        assert sorted(ray_tpu.get(refs, timeout=180)) == [0, 1, 2, 3]
         im = cluster.autoscaler.instance_manager
         assert im.instances()  # ledger populated
         assert any(
             i.status == InstanceStatus.RAY_RUNNING for i in im.instances()
         ) or any(i.status == InstanceStatus.TERMINATED for i in im.instances(None))
 
-        deadline = time.monotonic() + 40
+        deadline = time.monotonic() + 120  # generous: shared box under load
         while time.monotonic() < deadline:
             if not cluster.provider.non_terminated_nodes():
                 break

@@ -270,7 +270,7 @@ def test_compiled_teardown_with_unread_results(ray_start_regular):
     compiled.execute(2)
     t0 = time.monotonic()
     compiled.teardown()
-    assert time.monotonic() - t0 < 8
+    assert time.monotonic() - t0 < 20  # returns promptly, not hung
     assert ray_tpu.get(a.echo.remote("alive")) == "alive"
 
 

@@ -597,3 +597,71 @@ applications:
         serve.delete("CfgModel")
     finally:
         sys.path.remove(str(tmp_path))
+
+
+def test_llm_deployment_two_clients_share_one_decode_batch(serve_cluster):
+    """Native LLM serving (the reference delegates this to vLLM-on-Ray,
+    SURVEY §2.9): two concurrent HTTP clients stream tokens from ONE
+    continuously-batched engine — both requests occupy decode slots of
+    the same jitted step (engine max_active >= 2)."""
+    import threading
+
+    @serve.deployment(name="llm", max_ongoing_requests=8)
+    class LLM:
+        def __init__(self):
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu.models.paged import PagedConfig
+            from ray_tpu.models.transformer import TransformerConfig, init_params
+            from ray_tpu.serve.llm_engine import LLMEngine
+
+            cfg = TransformerConfig.tiny(dtype=jnp.float32, remat=False)
+            params = init_params(jax.random.PRNGKey(0), cfg)
+            self.engine = LLMEngine(
+                params, cfg,
+                PagedConfig(block_size=8, num_blocks=17, max_batch=4,
+                            max_blocks_per_seq=4),
+            )
+            self.engine.start()
+
+        def __call__(self, prompt_ids):
+            req = self.engine.add_request(
+                [int(t) for t in prompt_ids], max_new_tokens=24
+            )
+            for tok in req.tokens(timeout=180):
+                yield {"tok": int(tok)}
+
+        def stats(self):
+            return dict(self.engine.stats)
+
+    serve.run(LLM.bind(), http_port=0)
+    try:
+        port = serve.api.get_proxy_port()
+        results = {}
+
+        def client(name, prompt):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/llm",
+                data=json.dumps(prompt).encode(),
+                headers={"Accept": "application/x-ndjson",
+                         "Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                results[name] = [
+                    json.loads(l)["tok"]
+                    for l in resp.read().decode().splitlines() if l
+                ]
+
+        t1 = threading.Thread(target=client, args=("a", [2, 4, 6]))
+        t2 = threading.Thread(target=client, args=("b", [1, 3, 5, 7]))
+        t1.start(); t2.start()
+        t1.join(300); t2.join(300)
+        assert len(results["a"]) == 24, results
+        assert len(results["b"]) == 24, results
+        h = serve.get_deployment_handle("llm")
+        stats = h.stats.remote().result(timeout=30)
+        assert stats["max_active"] >= 2, stats  # shared one decode batch
+    finally:
+        serve.delete("llm")

@@ -32,7 +32,7 @@ def test_streaming_produces_incrementally(ray_start_regular):
     @ray_tpu.remote(num_returns="streaming")
     def slow_gen():
         for i in range(3):
-            time.sleep(0.3)
+            time.sleep(1.0)
             yield i
 
     g = slow_gen.remote()
@@ -40,7 +40,9 @@ def test_streaming_produces_incrementally(ray_start_regular):
     first = ray_tpu.get(next(g))
     first_latency = time.monotonic() - t0
     assert first == 0
-    assert first_latency < 0.8, "first item should arrive before the stream ends"
+    # Stream takes 3s to finish; the first item must arrive well before
+    # that (margin sized for a loaded shared box).
+    assert first_latency < 2.5, "first item should arrive before the stream ends"
     assert [ray_tpu.get(r) for r in g] == [1, 2]
 
 
