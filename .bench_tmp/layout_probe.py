@@ -1,0 +1,28 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+def log(m):
+    with open("/root/repo/.bench_tmp/layout.log", "a") as f: f.write(m + "\n")
+import jax, jax.numpy as jnp
+from jax.experimental.layout import Format, Layout
+from ray_tpu.models import transformer as tf
+from ray_tpu.models.paged import PagedConfig, init_paged_cache, paged_decode_loop
+cfg = tf.TransformerConfig.llama7b(max_seq_len=2048, dtype=jnp.bfloat16, remat=False)
+pcfg = PagedConfig(block_size=16, num_blocks=73, max_batch=16, max_blocks_per_seq=8)
+def _decode(params, tokens, cache, tables, lens, temps, key):
+    return paged_decode_loop(params, cfg, tokens, cache, tables, lens, temps, key, 8)
+shapes = jax.eval_shape(lambda k: tf.init_params(k, cfg), jax.random.PRNGKey(0))
+params_s = jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape, jnp.bfloat16), shapes)
+cache_s = jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), jax.eval_shape(lambda: init_paged_cache(cfg, pcfg)))
+toks = jax.ShapeDtypeStruct((16,), jnp.int32); tables = jax.ShapeDtypeStruct((16,8), jnp.int32)
+lens = jax.ShapeDtypeStruct((16,), jnp.int32); temps = jax.ShapeDtypeStruct((16,), jnp.float32)
+key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+auto = Format(Layout.AUTO)
+params_auto = jax.tree.map(lambda _: auto, params_s)
+dec = jax.jit(_decode, donate_argnums=(2,), in_shardings=(params_auto, None, None, None, None, None, None))
+t0=time.perf_counter()
+compiled = dec.lower(params_s, toks, cache_s, tables, lens, temps, key).compile()
+log(f"compiled {time.perf_counter()-t0:.1f}s")
+ma = compiled.memory_analysis()
+log(f"temp={ma.temp_size_in_bytes/1e9:.2f}GB args={ma.argument_size_in_bytes/1e9:.2f}GB out={ma.output_size_in_bytes/1e9:.2f}GB alias={ma.alias_size_in_bytes/1e9:.2f}GB")
+fmts = compiled.input_formats
+log(f"wq format: {jax.tree.leaves(fmts)[:1]}")

@@ -1,0 +1,26 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+def log(m):
+    with open("/root/repo/.bench_tmp/mem.log", "a") as f: f.write(m + "\n")
+import jax, jax.numpy as jnp
+from ray_tpu.models import transformer as tf
+from ray_tpu.models.paged import PagedConfig, init_paged_cache, make_jitted
+cfg = tf.TransformerConfig.llama7b(max_seq_len=2048, dtype=jnp.bfloat16, remat=False)
+pcfg = PagedConfig(block_size=16, num_blocks=129, max_batch=16, max_blocks_per_seq=8)
+dec, pf = make_jitted(cfg, 8)
+# memory analysis WITHOUT allocating the real params: AOT lower+compile on shapes
+import numpy as np
+shapes = jax.eval_shape(lambda k: tf.init_params(k, cfg), jax.random.PRNGKey(0))
+params_s = jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape, jnp.bfloat16), shapes)
+cache_s = jax.tree.map(lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), jax.eval_shape(lambda: init_paged_cache(cfg, pcfg)))
+toks = jax.ShapeDtypeStruct((16,), jnp.int32); tables = jax.ShapeDtypeStruct((16,8), jnp.int32)
+lens = jax.ShapeDtypeStruct((16,), jnp.int32); temps = jax.ShapeDtypeStruct((16,), jnp.float32)
+key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+t0=time.perf_counter()
+lowered = dec.lower(params_s, toks, cache_s, tables, lens, temps, key)
+log(f"lowered {time.perf_counter()-t0:.1f}s")
+t0=time.perf_counter()
+compiled = lowered.compile()
+log(f"compiled {time.perf_counter()-t0:.1f}s")
+ma = compiled.memory_analysis()
+log(f"memory: {ma}")

@@ -1,0 +1,39 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+import numpy as np
+def log(m):
+    with open("/root/repo/.bench_tmp/rtt.log", "a") as f: f.write(m + "\n")
+import jax, jax.numpy as jnp
+from ray_tpu.models import transformer as tf
+from ray_tpu.models.paged import PagedConfig, init_paged_cache, make_jitted
+cfg = tf.TransformerConfig.llama7b(max_seq_len=2048, dtype=jnp.bfloat16, remat=False)
+@jax.jit
+def init_bf16(key):
+    return jax.tree.map(lambda x: x.astype(jnp.bfloat16), tf.init_params(key, cfg))
+params = init_bf16(jax.random.PRNGKey(0))
+np.asarray(jax.tree.leaves(params)[0][0,0])
+pcfg = PagedConfig(block_size=16, num_blocks=129, max_batch=16, max_blocks_per_seq=8)
+cache = init_paged_cache(cfg, pcfg)
+toks = jnp.zeros(16, jnp.int32); tables = jnp.asarray(np.arange(1,129).reshape(16,8).astype(np.int32))
+lens = jnp.zeros(16, jnp.int32); temps = jnp.zeros(16, jnp.float32); key = jax.random.PRNGKey(0)
+dec, pf = make_jitted(cfg)
+out, cache = dec(params, toks, cache, tables, lens, temps, key)
+np.asarray(out)
+# synced per step
+t0 = time.perf_counter()
+for _ in range(16):
+    out, cache = dec(params, out, cache, tables, lens, temps, key)
+    np.asarray(out)
+log(f"synced per step: {(time.perf_counter()-t0)/16*1000:.1f} ms/step")
+# chained, one sync
+t0 = time.perf_counter()
+for _ in range(16):
+    out, cache = dec(params, out, cache, tables, lens, temps, key)
+np.asarray(out)
+log(f"chained 16 + 1 sync: {(time.perf_counter()-t0)/16*1000:.1f} ms/step")
+# pure RTT: tiny transfer
+x = jnp.zeros(4, jnp.int32)
+t0 = time.perf_counter()
+for _ in range(10):
+    np.asarray(x + 1)
+log(f"tiny roundtrip: {(time.perf_counter()-t0)/10*1000:.1f} ms")

@@ -1,0 +1,8 @@
+import sys
+sys.path.insert(0, "/root/repo")
+import bench
+def log(m):
+    with open("/root/repo/.bench_tmp/serve_bench.log", "a") as f:
+        f.write(m + "\n")
+r = bench._bench_serving_7b(log)
+log(f"RESULT {r}")

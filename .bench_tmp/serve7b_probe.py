@@ -1,0 +1,69 @@
+import sys
+sys.path.insert(0, "/root/repo")
+import time
+
+LOG = "/root/repo/.bench_tmp/serve7b.log"
+
+
+def log(m):
+    with open(LOG, "a") as f:
+        f.write(f"[{time.strftime('%H:%M:%S')}] {m}\n")
+
+
+log("start")
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import transformer as tf
+from ray_tpu.models.paged import PagedConfig, init_paged_cache, make_jitted
+
+cfg = tf.TransformerConfig.llama7b(max_seq_len=2048, dtype=jnp.bfloat16, remat=False)
+
+
+@jax.jit
+def init_bf16(key):
+    return jax.tree.map(lambda x: x.astype(jnp.bfloat16), tf.init_params(key, cfg))
+
+
+t0 = time.perf_counter()
+params = init_bf16(jax.random.PRNGKey(0))
+jax.block_until_ready(jax.tree.leaves(params)[0])
+log(f"params ready {time.perf_counter()-t0:.0f}s")
+pcfg = PagedConfig(block_size=16, num_blocks=129, max_batch=16, max_blocks_per_seq=8)
+cache = init_paged_cache(cfg, pcfg)
+jax.block_until_ready(cache["k"])
+log("cache ready")
+toks = jnp.zeros(16, jnp.int32)
+tables = jnp.zeros((16, 8), jnp.int32)
+lens = jnp.zeros(16, jnp.int32)
+temps = jnp.zeros(16, jnp.float32)
+key = jax.random.PRNGKey(0)
+dec, pf = make_jitted(cfg)
+t0 = time.perf_counter()
+lowered = dec.lower(params, toks, cache, tables, lens, temps, key)
+log(f"decode lowered {time.perf_counter()-t0:.1f}s")
+t0 = time.perf_counter()
+compiled = lowered.compile()
+log(f"decode compiled {time.perf_counter()-t0:.1f}s")
+t0 = time.perf_counter()
+out, cache = compiled(params, toks, cache, tables, lens, temps, key)
+jax.block_until_ready(out)
+log(f"decode step1 {time.perf_counter()-t0:.2f}s")
+t0 = time.perf_counter()
+for _ in range(16):
+    out, cache = compiled(params, out, cache, tables, lens, temps, key)
+jax.block_until_ready(out)
+log(f"decode steady {(time.perf_counter()-t0)/16*1000:.1f}ms/step")
+ptoks = jnp.zeros((1, 32), jnp.int32)
+row = jnp.zeros(2, jnp.int32)
+t0 = time.perf_counter()
+pl = pf.lower(params, ptoks, cache, row, 16, jnp.int32(32), jnp.float32(0.0), key)
+log(f"prefill lowered {time.perf_counter()-t0:.1f}s")
+t0 = time.perf_counter()
+pc = pl.compile()
+log(f"prefill compiled {time.perf_counter()-t0:.1f}s")
+t0 = time.perf_counter()
+tok, cache = pc(params, ptoks, cache, row, jnp.int32(32), jnp.float32(0.0), key)
+jax.block_until_ready(tok)
+log(f"prefill step {time.perf_counter()-t0:.2f}s")
+log("DONE")

@@ -304,6 +304,13 @@ def _bench_decode_7b(log):
     return round(tok_s, 1)
 
 
+def rng_prompt(cfg, n, _state=[0]):
+    import numpy as np
+
+    _state[0] += 1
+    return np.random.default_rng(_state[0]).integers(0, cfg.vocab_size, n).tolist()
+
+
 def _bench_serving_7b(log):
     """Continuous-batching 7B serving: aggregate tok/s at concurrency
     1/4/8/16 through the paged-KV engine (VERDICT r4 #1 — the reference
@@ -321,22 +328,35 @@ def _bench_serving_7b(log):
 
     cfg = tf.TransformerConfig.llama7b(max_seq_len=2048, dtype=jnp.bfloat16, remat=False)
 
-    @jax.jit
-    def init_bf16(key):
-        return jax.tree.map(lambda x: x.astype(jnp.bfloat16), tf.init_params(key, cfg))
+    def init_bf16():
+        return jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16),
+            tf.init_params(jax.random.PRNGKey(0), cfg),
+        )
 
-    params = init_bf16(jax.random.PRNGKey(0))
-    jax.block_until_ready(jax.tree.leaves(params)[0])
-    # KV pool: 128 usable blocks x 16 tokens x 512 KB/token = ~1.07 GB
-    # alongside the 13.5 GB weights on one 16 GB chip.
-    pcfg = PagedConfig(block_size=16, num_blocks=129, max_batch=16, max_blocks_per_seq=8)
-    eng = LLMEngine(params, cfg, pcfg)
-    rng = np.random.default_rng(0)
-    eng.generate_batch([rng.integers(0, cfg.vocab_size, 32).tolist()], 3)  # compile
+    t0 = time.perf_counter()
+    # KV pool sized to HBM: the decode program's working set is ~2x the
+    # pool (in-place scan carry + one live intermediate at window seams)
+    # on top of the 13.5 GB weights; 144 usable 8-token blocks (1152
+    # cache tokens, ~0.6 GB) keeps the compiled program inside the 16 GB
+    # chip, and the small block size keeps the per-step gather narrow
+    # (W*bs = 72 positions/slot).
+    pcfg = PagedConfig(block_size=8, num_blocks=145, max_batch=16, max_blocks_per_seq=9)
+    # decode_window=10: one host sync per 10 tokens — the tunneled
+    # chip's ~170 ms dispatch RTT would otherwise dominate (measured:
+    # synced steps 136 ms vs 38 ms chained at batch 16). Params passed
+    # as an INIT CALLABLE: the engine materializes the 13.5 GB weights
+    # directly in its decode program's preferred layout (no relayout
+    # copy — see LLMEngine docstring).
+    eng = LLMEngine(init_bf16, cfg, pcfg, decode_window=10)
+    log(f"7B serve: engine built, params in layout ({time.perf_counter()-t0:.0f}s)")
+    t0 = time.perf_counter()
+    eng.generate_batch([rng_prompt(cfg, 16)], 3)  # compile prefill+decode
+    log(f"7B serve: warmup/compile done ({time.perf_counter()-t0:.0f}s)")
     results = {}
-    gen_tokens = 64
+    gen_tokens = 40  # 16+40+9 overshoot = 9 blocks/slot; 16 slots = 144 blocks
     for c in (1, 4, 8, 16):
-        prompts = [rng.integers(0, cfg.vocab_size, 32).tolist() for _ in range(c)]
+        prompts = [rng_prompt(cfg, 16) for _ in range(c)]
         t0 = time.perf_counter()
         outs = eng.generate_batch(prompts, gen_tokens)
         dt = time.perf_counter() - t0

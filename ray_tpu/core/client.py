@@ -144,6 +144,9 @@ class CoreWorker:
                 "register_worker", self.worker_id, node_id, os.getpid(),
                 listen_addr=listen_addr,
                 pool=os.environ.get("RAY_TPU_WORKER_POOL", ""),
+                # Spawn-time env identity (container images): the worker
+                # was born into this env hash (runtime_env/container.py).
+                env_hash=os.environ.get("RAY_TPU_PRESET_ENV_HASH", ""),
             )
             self.local_shm_dir = local_shm_dir
         self.session_dir = info["session_dir"]
@@ -525,6 +528,10 @@ class CoreWorker:
             self.direct_normal_enabled
             and spec.task_type == TaskType.NORMAL_TASK
             and not spec.is_streaming
+            # Container envs need spawn-time (image-wrapped) workers,
+            # which only the controller's dispatch path provisions; the
+            # direct-lease pool hands out host workers.
+            and not (spec.runtime_env or {}).get("image_uri")
         ):
             return self._submit_normal_direct(spec, captures)
         self.promote_refs(list(spec.dependencies) + list(captures or []))

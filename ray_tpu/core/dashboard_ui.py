@@ -140,3 +140,43 @@ async function refresh() {
 </body>
 </html>
 """
+
+
+def render_profiles_page(rows) -> str:
+    """The /profiles page: captured jax.profiler traces (reference: the
+    dashboard's profiling surface — py-spy flamegraphs in the reporter
+    module; here the TPU-native equivalent lists jax.profiler captures,
+    openable with TensorBoard/XProf or `ray-tpu profile <id>`)."""
+    import html as _html
+
+    def td(v):
+        return f"<td>{_html.escape(str(v))}</td>"
+
+    body = "".join(
+        "<tr>"
+        + td(r.get("id", ""))
+        + td(r.get("name", ""))
+        + td(r.get("task_id", ""))
+        + td(r.get("captured_at", ""))
+        + td(r.get("duration_s", ""))
+        + td(r.get("path", ""))
+        + "</tr>"
+        for r in rows
+    )
+    return f"""<!doctype html>
+<html><head><title>ray_tpu profiles</title>
+<style>
+body {{ font-family: system-ui, sans-serif; margin: 2rem; }}
+table {{ border-collapse: collapse; width: 100%; }}
+td, th {{ border: 1px solid #ddd; padding: 6px 10px; font-size: 13px; }}
+th {{ background: #f5f5f5; text-align: left; }}
+</style></head>
+<body>
+<h2>jax.profiler captures ({len(rows)})</h2>
+<p>Fetch with <code>ray-tpu profile &lt;id&gt;</code>; open trace dirs with
+TensorBoard / XProf. JSON at <a href="/api/profiles">/api/profiles</a>;
+Grafana dashboard JSON at
+<a href="/api/grafana/dashboard">/api/grafana/dashboard</a>.</p>
+<table><tr><th>id</th><th>name</th><th>task</th><th>captured</th>
+<th>duration (s)</th><th>path</th></tr>{body}</table>
+</body></html>"""

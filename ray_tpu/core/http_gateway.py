@@ -127,6 +127,23 @@ def start_http_gateway(controller, loop: asyncio.AbstractEventLoop, port: int) -
                         self._json(job_manager().get_info(job_id))
                     except (KeyError, ValueError):
                         self._json({"error": f"no job {job_id}"}, 404)
+                elif path == "/api/profiles":
+                    from ray_tpu.util.state import list_profiles
+
+                    self._json(list_profiles(controller.session_dir))
+                elif path == "/api/grafana/dashboard":
+                    # Importable Grafana JSON generated from the live
+                    # metric registry (reference: dashboard/modules/
+                    # metrics/grafana_dashboard_factory.py).
+                    from ray_tpu.util.grafana import generate_dashboard
+
+                    self._json(generate_dashboard(call("rpc_metrics_snapshot")))
+                elif path == "/profiles":
+                    from ray_tpu.core.dashboard_ui import render_profiles_page
+                    from ray_tpu.util.state import list_profiles
+
+                    page = render_profiles_page(list_profiles(controller.session_dir))
+                    self._send(200, page.encode(), "text/html; charset=utf-8")
                 elif path == "/metrics":
                     from ray_tpu.util.metrics import prometheus_text
 

@@ -53,9 +53,14 @@ def child_env(needs_tpu: bool) -> dict:
 
 
 def spawn_worker(session_dir: str, controller_addr: str, node_id: NodeID, shm_dir: str,
-                 extra_env: Dict[str, str] = None) -> subprocess.Popen:
+                 extra_env: Dict[str, str] = None,
+                 container_image: str = None) -> subprocess.Popen:
     """Start a worker process (reference: python/ray/_private/workers/
-    default_worker.py is the reference's equivalent entrypoint)."""
+    default_worker.py is the reference's equivalent entrypoint).
+
+    ``container_image``: launch the worker INSIDE this OCI image via the
+    node's container runtime (reference: runtime_env/image_uri.py; here
+    ray_tpu/runtime_env/container.py builds the podman/docker argv)."""
     worker_id = WorkerID.from_random()
     # Workers may run TPU compute tasks — keep the TPU hook unless the
     # session is pinned to CPU (tests).
@@ -72,11 +77,19 @@ def spawn_worker(session_dir: str, controller_addr: str, node_id: NodeID, shm_di
     )
     if extra_env:
         env.update(extra_env)
+    cmd = [sys.executable, "-m", "ray_tpu.core.worker_main"]
+    if container_image:
+        # wrap_command embeds the (cached) image pull in the spawned
+        # shell — spawn_worker itself never blocks on a registry (it is
+        # called from the controller/agent event loop).
+        from ray_tpu.runtime_env import container as _container
+
+        cmd = _container.wrap_command(container_image, cmd, env, session_dir, shm_dir)
     log_dir = os.path.join(session_dir, "logs")
     os.makedirs(log_dir, exist_ok=True)
     out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:8]}.log"), "ab")
     proc = subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu.core.worker_main"],
+        cmd,
         env=env,
         stdout=out,
         stderr=subprocess.STDOUT,
@@ -149,9 +162,13 @@ class NodeAgent:
         self._listen_addr = ""  # set in run()
 
     # -- notifications from the controller ------------------------------
-    def rpc_start_workers(self, peer, n: int):
+    def rpc_start_workers(self, peer, n: int, container_image: str = None,
+                          preset_env_hash: str = ""):
+        extra = {"RAY_TPU_PRESET_ENV_HASH": preset_env_hash} if preset_env_hash else None
         for _ in range(n):
-            spawn_worker(self.session_dir, self.controller_addr, self.node_id, self.store.shm_dir)
+            spawn_worker(self.session_dir, self.controller_addr, self.node_id,
+                         self.store.shm_dir, extra_env=extra,
+                         container_image=container_image)
 
     def rpc_delete_object(self, peer, oid: ObjectID):
         self._chunk_reader.invalidate(oid)
@@ -330,6 +347,25 @@ class NodeAgent:
             if w.env_hash == "" and fallback is None:
                 fallback = w
         return fallback
+
+    def rpc_claim_direct_worker(self, peer, ehash: str):
+        """Controller claims a free pooled worker for ACTOR CREATION
+        (reference: PopWorker serves actors too, worker_pool.h:363-374).
+        Non-blocking: None when the pool has nothing compatible — the
+        controller falls back to its spawn path."""
+        w = self._pop_free(ehash)
+        if w is None:
+            return None
+        w.busy = True
+        w.env_hash = ehash or w.env_hash
+        return w.wid
+
+    def rpc_release_direct_worker(self, peer, wid: str):
+        """Undo an actor claim that never dispatched (scheduling race)."""
+        w = self._direct.get(wid)
+        if w is not None:
+            w.busy = False
+            self._hand_to_waiter(w)
 
     async def rpc_lease_worker(self, peer, lease_id: bytes, ehash: str):
         """Hand out (or spawn) a worker for a controller-granted lease.

@@ -32,7 +32,6 @@ host↔device.
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Dict, Tuple
 
 import jax
@@ -138,15 +137,31 @@ def paged_decode_step(
     tables: jax.Array,  # [b, W] int32
     lens: jax.Array,  # [b] int32
 ) -> Tuple[jax.Array, PagedCache]:
-    """One decode iteration over all slots → (logits [b, V] fp32, cache')."""
+    """One decode iteration over all slots → (logits [b, V] fp32, cache').
+
+    The FULL pool rides the layer scan as a carry, updated per layer via
+    dynamic_update_index_in_dim — the standard in-place KV-cache shape.
+    Passing per-layer slices as scan xs/ys instead would stack a fresh
+    pool copy as the scan output (and chained windows would hold several
+    such copies): at 7B that is multiple GB of pure waste and an OOM on
+    a 16 GB chip."""
     x = embed(params, tokens[:, None], cfg)
+    L = cfg.n_layers
 
     def body(carry, xs):
-        lp, ck, cv = xs
-        x, ck, cv = _paged_layer_step(carry, lp, cfg, ck, cv, tables, lens)
-        return x, (ck, cv)
+        x, ck_all, cv_all = carry
+        lp, i = xs
+        ck = jax.lax.dynamic_index_in_dim(ck_all, i, 0, keepdims=False)
+        cv = jax.lax.dynamic_index_in_dim(cv_all, i, 0, keepdims=False)
+        x, ck, cv = _paged_layer_step(x, lp, cfg, ck, cv, tables, lens)
+        ck_all = jax.lax.dynamic_update_index_in_dim(ck_all, ck, i, 0)
+        cv_all = jax.lax.dynamic_update_index_in_dim(cv_all, cv, i, 0)
+        return (x, ck_all, cv_all), None
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    (x, ks, vs), _ = jax.lax.scan(
+        body, (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(L, dtype=jnp.int32)),
+    )
     logits = unembed(params, x, cfg)[:, 0]
     return logits, {"k": ks, "v": vs}
 
@@ -166,6 +181,41 @@ def paged_decode_sample_step(
     """decode + on-device sampling → (next_tokens [b], cache')."""
     logits, cache = paged_decode_step(params, cfg, tokens, cache, tables, lens)
     return sample_tokens(logits, temps, key), cache
+
+
+def paged_decode_loop(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jax.Array,  # [b] int32 — tokens AT positions ``lens``
+    cache: PagedCache,
+    tables: jax.Array,  # [b, W] — FIXED across the window
+    lens: jax.Array,  # [b]
+    temps: jax.Array,  # [b]
+    key: jax.Array,
+    n_steps: int,
+) -> Tuple[jax.Array, PagedCache]:
+    """``n_steps`` decode iterations in ONE device program (lax.scan),
+    feeding each step's sampled tokens to the next — the host syncs once
+    per window instead of per token, amortizing dispatch/transfer
+    latency (decisive when the host↔device link is slow; still a win on
+    local PCIe). Requires every slot's block table to cover positions
+    ``lens .. lens+n_steps-1`` (the engine allocates the window horizon
+    up front). Returns ([n_steps, b] sampled tokens, cache').
+
+    The window is UNROLLED (Python loop, n_steps is static), not a
+    lax.scan: a scan carry holding the KV pool double-buffers it on top
+    of the layer-scan's own double buffer (~4x pool HBM — an OOM at 7B
+    on one chip), while the unrolled chain is straight-line dataflow
+    whose intermediate caches XLA reuses in place. Compile time grows
+    linearly in n_steps (~seconds for window 8)."""
+    seq = []
+    for _ in range(n_steps):
+        key, sub = jax.random.split(key)
+        logits, cache = paged_decode_step(params, cfg, tokens, cache, tables, lens)
+        tokens = sample_tokens(logits, temps, sub)
+        lens = lens + 1
+        seq.append(tokens)
+    return jnp.stack(seq), cache
 
 
 def paged_prefill(
@@ -223,17 +273,27 @@ def prefill_and_sample(
     return tok, cache
 
 
-def make_jitted(params, cfg: TransformerConfig):
-    """Compile the decode step and prefill (cache donated in both — the
-    pool is updated in place, never double-buffered). jit re-specializes
-    prefill per prompt bucket automatically (one compile per bucket)."""
-    decode = jax.jit(
-        functools.partial(paged_decode_sample_step, params, cfg),
-        donate_argnums=(1,),  # cache
-    )
-    prefill = jax.jit(
-        functools.partial(prefill_and_sample, params, cfg),
-        static_argnums=(3,),  # block_size
-        donate_argnums=(1,),  # cache
-    )
+def make_jitted(cfg: TransformerConfig, decode_window: int = 1):
+    """Compile the decode window and prefill. ``params`` is a RUNTIME
+    argument, never closed over — closing over it would capture the
+    whole model (13.5 GB at 7B) as compile-time constants baked into the
+    HLO, which takes tens of minutes to lower. The cache is donated in
+    both programs (the pool updates in place, never double-buffered);
+    jit re-specializes prefill per prompt bucket automatically (one
+    compile per bucket).
+
+    ``decode_window``: steps per device call (see paged_decode_loop).
+    The returned decode fn always yields [window, b] tokens (window=1
+    included), so the engine has one shape contract."""
+
+    def _decode(params, tokens, cache, tables, lens, temps, key):
+        return paged_decode_loop(
+            params, cfg, tokens, cache, tables, lens, temps, key, decode_window
+        )
+
+    def _prefill(params, tokens, cache, block_row, block_size, real_len, temp, key):
+        return prefill_and_sample(params, cfg, tokens, cache, block_row, block_size, real_len, temp, key)
+
+    decode = jax.jit(_decode, donate_argnums=(2,))  # cache
+    prefill = jax.jit(_prefill, static_argnums=(4,), donate_argnums=(2,))  # cache
     return decode, prefill

@@ -12,9 +12,12 @@ Rebuild: the same two pieces, trimmed to what a TPU pod needs —
   first task of that env runs. Built-ins: ``env_vars``, ``working_dir``,
   ``py_modules``, ``config``, and ``pip`` (per-hash ``pip install
   --target`` — offline-capable with local wheels/dirs or gs:// wheels;
-  see :func:`_setup_pip`). ``conda``/``container`` raise
-  :class:`RuntimeEnvSetupError` — workers share the host interpreter;
-  bake system deps into the image (the TPU-pod deployment model).
+  see :func:`_setup_pip`). ``image_uri`` launches the WORKER ITSELF
+  inside a container image (spawn-time, not in-process — see
+  ray_tpu/runtime_env/container.py; env hashes prefix ``img:`` so the
+  scheduler never lets a pristine host worker adopt one). ``conda``
+  raises :class:`RuntimeEnvSetupError` — workers share the host
+  interpreter; use ``image_uri`` (or bake deps into the pod image).
 - **worker affinity by env hash**: the controller only dispatches an
   env-tagged task to a worker already in that env or to a pristine worker
   (which then becomes env-tagged) — reference behavior, collapsed into the
@@ -53,9 +56,14 @@ class RuntimeEnv(dict):
         working_dir: Optional[str] = None,
         py_modules: Optional[list] = None,
         config: Optional[dict] = None,
+        image_uri: Optional[str] = None,
         **extra,
     ):
         super().__init__()
+        if image_uri is not None:
+            if not isinstance(image_uri, str) or not image_uri:
+                raise ValueError("image_uri must be a non-empty string")
+            self["image_uri"] = image_uri
         if env_vars is not None:
             if not all(isinstance(k, str) and isinstance(v, str) for k, v in env_vars.items()):
                 raise ValueError("env_vars must be a str→str mapping")
@@ -78,12 +86,15 @@ def strip_internal(env: Optional[dict]) -> dict:
 
 def env_hash(env: Optional[dict]) -> str:
     """Stable hash keying worker reuse (reference: worker_pool runtime-env
-    hash in the lease request)."""
+    hash in the lease request). Container envs hash with an ``img:``
+    prefix — the scheduler uses it to require spawn-time (exact-match)
+    workers instead of letting a pristine host worker adopt the env."""
     e = strip_internal(env)
     if not e:
         return ""
     blob = json.dumps(e, sort_keys=True, default=str).encode()
-    return hashlib.blake2b(blob, digest_size=8).hexdigest()
+    digest = hashlib.blake2b(blob, digest_size=8).hexdigest()
+    return f"img:{digest}" if e.get("image_uri") else digest
 
 
 # ---------------------------------------------------------------------------
@@ -290,6 +301,15 @@ def _setup_jax_profiler_hook(value):
     _setup_jax_profiler(value)
 
 
+def _setup_image_uri(value):
+    # No-op INSIDE the worker: the image took effect at spawn time (the
+    # node wrapped the worker command via the container runtime —
+    # runtime_env/container.py); by the time a task applies its env, the
+    # process is already in the image.
+    pass
+
+
+register_plugin("image_uri", _setup_image_uri)
 register_plugin("env_vars", _setup_env_vars)
 register_plugin("jax_profiler", _setup_jax_profiler_hook)
 register_plugin("working_dir", _setup_working_dir)

@@ -227,6 +227,23 @@ def cmd_logs(args):
     return 0
 
 
+def cmd_metrics(args):
+    """``ray-tpu metrics dashboard``: importable Grafana dashboard JSON
+    generated from the LIVE metric registry (reference:
+    dashboard/modules/metrics/grafana_dashboard_factory.py)."""
+    _connect()
+    from ray_tpu.util.grafana import dashboard_json
+
+    text = dashboard_json()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}")
+    else:
+        print(text)
+    return 0
+
+
 def cmd_profile(args):
     """List/fetch jax.profiler captures (reference: nsight runtime-env
     plugin reports; capture with runtime_env={"jax_profiler": True})."""
@@ -397,6 +414,13 @@ def main(argv=None):
     sp = sub.add_parser("profile", help="list/fetch jax.profiler task captures")
     sp.add_argument("profile_id", nargs="?")
     sp.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser(
+        "metrics", help="metrics tooling (dashboard: emit Grafana JSON)"
+    )
+    sp.add_argument("action", choices=["dashboard"])
+    sp.add_argument("--out", default="", help="write JSON here (default: stdout)")
+    sp.set_defaults(fn=cmd_metrics)
     sub.add_parser("dashboard", help="print the dashboard URL").set_defaults(
         fn=cmd_dashboard
     )

@@ -36,7 +36,8 @@ from ray_tpu.models.paged import (
     TRASH_BLOCK,
     PagedConfig,
     init_paged_cache,
-    make_jitted,
+    paged_decode_loop,
+    prefill_and_sample,
 )
 from ray_tpu.models.transformer import TransformerConfig
 
@@ -109,13 +110,29 @@ class LLMEngine:
         cfg: TransformerConfig,
         pcfg: Optional[PagedConfig] = None,
         *,
+        decode_window: int = 1,
         seed: int = 0,
     ):
+        """``params``: the model weights — either an array pytree, or a
+        ZERO-ARG CALLABLE returning one. Prefer the callable for big
+        models: the engine compiles its decode program first, asks XLA
+        which input layout it wants for the weights, and materializes
+        them DIRECTLY in that layout (jit with out_shardings) — an
+        already-materialized tree must instead be relaid out, transiently
+        doubling its HBM footprint (fatal at 7B on a 16 GB chip if the
+        caller still holds a reference).
+
+        ``decode_window``: decode steps per device call (one host
+        sync per window — see paged_decode_loop). >1 trades per-token
+        streaming granularity and up to window-1 wasted steps per
+        finishing sequence for amortized dispatch latency; scheduling
+        (admission, paging, preemption) happens at window boundaries."""
         self.cfg = cfg
         self.pcfg = pcfg or PagedConfig()
         p = self.pcfg
-        self._decode, self._prefill = make_jitted(params, cfg)
+        self.window = max(1, int(decode_window))
         self.cache = init_paged_cache(cfg, p)
+        self._decode, self._prefill, self.params = self._build_programs(params)
         self.alloc = _BlockAllocator(p)
         self.key = jax.random.PRNGKey(seed)
         # Slot state (host-side numpy; shipped to device each step).
@@ -126,6 +143,9 @@ class LLMEngine:
         self.temps = np.zeros(p.max_batch, np.float32)
         self.cur = np.zeros(p.max_batch, np.int32)
         self.waiting: "collections.deque[Request]" = collections.deque()
+        # Prefill first-tokens awaiting ONE batched device→host transfer
+        # (per-prefill int() syncs each pay a full link round-trip).
+        self._pending_first: List = []
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -133,6 +153,77 @@ class LLMEngine:
         # Stats for tests/bench.
         self.stats = {"steps": 0, "tokens": 0, "max_active": 0, "preemptions": 0,
                       "prefills": 0}
+
+    def _build_programs(self, params):
+        """Build the decode window + prefill programs.
+
+        On TPU the decode program is AOT-compiled with AUTO input
+        layouts and ``params`` is device_put into the layout the program
+        chose: decode matvecs prefer a transposed tiling for the big
+        projection stacks, and feeding default-layout params makes XLA
+        insert per-call relayout copies (3 GB of HBM temps at 7B — an
+        OOM on a 16 GB chip next to the weights). Prefill is then
+        compiled to ACCEPT that same layout, so one params tree serves
+        both programs copy-free. Falls back to plain jit where custom
+        layouts are unsupported (CPU tests)."""
+        cfg, p, window = self.cfg, self.pcfg, self.window
+        bs = p.block_size
+
+        def _decode(params, tokens, cache, tables, lens, temps, key):
+            return paged_decode_loop(
+                params, cfg, tokens, cache, tables, lens, temps, key, window
+            )
+
+        def _prefill(params, tokens, cache, block_row, real_len, temp, key):
+            return prefill_and_sample(
+                params, cfg, tokens, cache, block_row, bs, real_len, temp, key
+            )
+
+        try:
+            from jax.experimental.layout import Format, Layout
+
+            sds = jax.ShapeDtypeStruct
+            b, W = p.max_batch, p.max_blocks_per_seq
+            if callable(params):
+                params_s = jax.eval_shape(params)
+            else:
+                params_s = jax.tree.map(lambda x: sds(x.shape, x.dtype), params)
+            cache_s = jax.tree.map(lambda x: sds(x.shape, x.dtype), self.cache)
+            args_s = (
+                params_s,
+                sds((b,), np.int32),
+                cache_s,
+                sds((b, W), np.int32),
+                sds((b,), np.int32),
+                sds((b,), np.float32),
+                sds((2,), np.uint32),
+            )
+            auto = jax.tree.map(lambda _: Format(Layout.AUTO), params_s)
+            dec = jax.jit(
+                _decode, donate_argnums=(2,),
+                in_shardings=(auto, None, None, None, None, None, None),
+            )
+            compiled = dec.lower(*args_s).compile()
+            fmts = compiled.input_formats
+            afmts = fmts[0] if isinstance(fmts, tuple) and len(fmts) == 2 else fmts
+            params_fmt = afmts[0]
+            if callable(params):
+                # Materialize weights directly in the program's layout —
+                # no second copy ever exists on device.
+                params = jax.jit(params, out_shardings=params_fmt)()
+            else:
+                params = jax.device_put(params, params_fmt)
+            prefill = jax.jit(
+                _prefill, donate_argnums=(2,),
+                in_shardings=(params_fmt, None, None, None, None, None, None),
+            )
+            return compiled, prefill, params
+        except Exception:  # noqa: BLE001 — backend without layout support
+            decode = jax.jit(_decode, donate_argnums=(2,))
+            prefill = jax.jit(_prefill, donate_argnums=(2,))
+            if callable(params):
+                params = params()
+            return decode, prefill, params
 
     # ------------------------------------------------------------------
     # Public API
@@ -151,12 +242,16 @@ class LLMEngine:
             req.error = "prompt must be non-empty"
             req.out.put(None)
             return req
-        total = len(req.prompt) + max_new_tokens
+        # The decode window may overshoot a finishing sequence by up to
+        # window-1 positions; capacity must cover the overshoot so those
+        # writes stay inside the slot's own blocks.
+        total = len(req.prompt) + max_new_tokens + self.window - 1
         worst_blocks = -(-total // self.pcfg.block_size)
         if total > self.pcfg.max_seq_len or worst_blocks > self.pcfg.usable_blocks:
             req.error = (
                 f"prompt({len(req.prompt)}) + max_new_tokens({max_new_tokens}) "
-                f"exceeds capacity (max_seq_len={self.pcfg.max_seq_len}, "
+                f"(+ decode_window overshoot {self.window - 1}) exceeds capacity "
+                f"(max_seq_len={self.pcfg.max_seq_len}, "
                 f"usable_blocks={self.pcfg.usable_blocks})"
             )
             req.out.put(None)
@@ -253,14 +348,16 @@ class LLMEngine:
         return True
 
     def _ensure_decode_blocks(self) -> None:
-        """Every active slot must own the block its next write lands in;
-        allocate on demand, preempting if the pool is exhausted."""
+        """Every active slot must own the blocks the coming window's
+        writes land in (positions lens .. lens+window-1 — the table is
+        fixed for the whole device call); allocate on demand, preempting
+        if the pool is exhausted."""
         bs = self.pcfg.block_size
         for i in range(len(self.slots)):
             while self.slots[i] is not None:
-                need_idx = int(self.lens[i]) // bs
+                need_idx = (int(self.lens[i]) + self.window - 1) // bs
                 if need_idx < len(self.slot_blocks[i]):
-                    break  # this slot's next write is covered
+                    break  # this slot's window is covered
                 got = self.alloc.alloc(1)
                 if got is not None:
                     self.slot_blocks[i].append(got[0])
@@ -298,6 +395,15 @@ class LLMEngine:
             self.temps[i] = req.temperature
             self._run_prefill(i, req)
 
+    def _flush_prefills(self):
+        if not self._pending_first:
+            return
+        pend, self._pending_first = self._pending_first, []
+        vals = jax.device_get([t for _, t in pend])  # one batched transfer
+        for (i, _), v in zip(pend, vals):
+            self.cur[i] = int(v)
+            self._emit(i, int(v))
+
     def _run_prefill(self, i: int, req: Request):
         """Prefill slot ``i``'s prompt and emit the first sampled token."""
         p = self.pcfg
@@ -314,13 +420,16 @@ class LLMEngine:
         row[:nreal] = self.slot_blocks[i]
         self.key, sub = jax.random.split(self.key)
         tok, self.cache = self._prefill(
-            jax.numpy.asarray(toks), self.cache, jax.numpy.asarray(row), bs,
+            self.params, jax.numpy.asarray(toks), self.cache,
+            jax.numpy.asarray(row),
             np.int32(plen), np.float32(req.temperature), sub,
         )
         self.stats["prefills"] += 1
         self.lens[i] = plen
-        self.cur[i] = int(tok)
-        self._emit(i, int(tok))
+        # Defer the device→host read: prefill dispatches pipeline without
+        # syncing; _flush_prefills fetches every pending first token in
+        # one transfer after the admission loop.
+        self._pending_first.append((i, tok))
 
     def _emit(self, i: int, tok: int):
         """Record + stream one generated token; retire the slot when done."""
@@ -335,6 +444,7 @@ class LLMEngine:
         """One scheduler iteration: admit → page → decode. Returns True
         if any device work ran (False = idle)."""
         self._admit()
+        self._flush_prefills()
         if self.active_count() == 0:
             return False
         self._ensure_decode_blocks()
@@ -344,16 +454,17 @@ class LLMEngine:
         self.stats["max_active"] = max(self.stats["max_active"], len(active))
         self.key, sub = jax.random.split(self.key)
         nxt, self.cache = self._decode(
-            jax.numpy.asarray(self.cur), self.cache,
+            self.params, jax.numpy.asarray(self.cur), self.cache,
             jax.numpy.asarray(self.tables), jax.numpy.asarray(self.lens),
             jax.numpy.asarray(self.temps), sub,
         )
-        nxt = np.asarray(nxt)
+        nxt = np.asarray(nxt)  # [window, b] — ONE host sync per window
         self.stats["steps"] += 1
         for i in active:
-            if self.slots[i] is None:
-                continue
-            self.lens[i] += 1  # the fed token's KV is now in the cache
-            self.cur[i] = nxt[i]
-            self._emit(i, int(nxt[i]))
+            for k in range(self.window):
+                if self.slots[i] is None:
+                    break  # finished mid-window; rest is overshoot
+                self.lens[i] += 1  # the fed token's KV is now resident
+                self.cur[i] = nxt[k, i]
+                self._emit(i, int(nxt[k, i]))
         return True

@@ -4,11 +4,13 @@ Reference: python/ray/tune (Tuner/tune.run, search spaces, schedulers).
 """
 from ray_tpu.tune.schedulers import (
     AsyncHyperBandScheduler,
+    DistributeResources,
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
     PB2,
     PopulationBasedTraining,
+    ResourceChangingScheduler,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (

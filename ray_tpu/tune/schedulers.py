@@ -471,3 +471,71 @@ class PB2(PopulationBasedTraining):
                     val = max(1, int(round(val)))
                 cfg[k] = val
         return cfg
+
+
+class DistributeResources:
+    """Default allocation policy for ResourceChangingScheduler
+    (reference: tune/schedulers/resource_changing_scheduler.py
+    DistributeResources): spread the cluster's CPUs evenly over the
+    currently-RUNNING trials, never dropping below the experiment's base
+    request. Returns None when the trial's allocation is already right.
+    """
+
+    def __call__(self, controller, trial, result, scheduler):
+        import ray_tpu
+
+        base_res = dict(controller._cfg.resources_per_trial or {})
+        total = ray_tpu.cluster_resources().get("CPU", 1)
+        base = base_res.get("num_cpus", 1) or 1
+        running = [t for t in controller._trials if t.status == "RUNNING"] or [trial]
+        share = max(base, int(total // max(len(running), 1)))
+        # Merge OVER the experiment base so non-CPU keys (num_tpus,
+        # custom resources) survive the first resize.
+        merged = {**base_res, **(trial.resources or {})}
+        if share != merged.get("num_cpus", base):
+            return {**merged, "num_cpus": share}
+        return None
+
+
+class ResourceChangingScheduler(TrialScheduler):
+    """Reallocate trial resources mid-experiment (reference:
+    tune/schedulers/resource_changing_scheduler.py:592): wraps a base
+    scheduler; after each result the ``resources_allocation_function``
+    (signature ``fn(tune_controller, trial, result, scheduler)``, the
+    reference's) may return a new resource dict for the trial. A changed
+    request PAUSEs the trial (checkpoint-based, like PBT exploit) and it
+    resumes on its new allocation."""
+
+    def __init__(self, base_scheduler: Optional[TrialScheduler] = None,
+                 resources_allocation_function=DistributeResources()):
+        self._base = base_scheduler or FIFOScheduler()
+        self._fn = resources_allocation_function
+        self._controller = None
+
+    def set_search_properties(self, metric: str, mode: str):
+        super().set_search_properties(metric, mode)
+        self._base.set_search_properties(metric, mode)
+
+    def set_tune_controller(self, controller):
+        self._controller = controller
+
+    def on_trial_result(self, trial: Trial, result: dict) -> str:
+        decision = self._base.on_trial_result(trial, result)
+        if decision == CONTINUE and self._fn is not None:
+            new = self._fn(self._controller, trial, result, self)
+            if new and dict(new) != (trial.resources or {}):
+                trial.resources = dict(new)
+                return PAUSE  # resume lands on the new allocation
+        return decision
+
+    def on_trial_complete(self, trial: Trial, result: Optional[dict]):
+        self._base.on_trial_complete(trial, result)
+
+    def choose_config(self, trial: Trial) -> Optional[Dict[str, Any]]:
+        return self._base.choose_config(trial)
+
+    def on_trial_pending_resume(self, trial: Trial) -> str:
+        return self._base.on_trial_pending_resume(trial)
+
+    def on_search_exhausted(self):
+        self._base.on_search_exhausted()

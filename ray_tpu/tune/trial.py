@@ -34,6 +34,9 @@ class Trial:
     checkpoint_dir: Optional[str] = None  # last checkpoint (for restore/PBT)
     iteration: int = 0
     paused_at_iteration: int = 0
+    # Per-trial resource override (ResourceChangingScheduler); None →
+    # the experiment's resources_per_trial.
+    resources: Optional[Dict[str, float]] = None
 
     @property
     def is_finished(self) -> bool:
@@ -115,6 +118,10 @@ class TrialRunner:
                  remote_dir=None):
         from ray_tpu.utils.serialization import deserialize_function
 
+        self._fn = deserialize_function(fn_blob)
+        self._setup(config, local_dir, restored_checkpoint, remote_dir)
+
+    def _setup(self, config: dict, local_dir: str, restored_checkpoint, remote_dir):
         global _session
         os.makedirs(local_dir, exist_ok=True)
         if restored_checkpoint:
@@ -130,12 +137,30 @@ class TrialRunner:
                 _sh.rmtree(local, ignore_errors=True)
                 cloudfs.copy_dir(restored_checkpoint, local)
                 restored_checkpoint = local
-        self._fn = deserialize_function(fn_blob)
         self._session = _TuneSession(config, local_dir, restored_checkpoint,
                                      remote_dir=remote_dir)
         _session = self._session
         self._thread = threading.Thread(target=self._run, daemon=True, name="trial-fn")
         self._thread.start()
+
+    def reset(self, config: dict, local_dir: str, restored_checkpoint, remote_dir=None):
+        """Reuse this actor process for a NEW trial of the same
+        experiment (reference: tune/tune.py:297 ``reuse_actors`` +
+        Trainable.reset) — skips the per-trial process spawn, the
+        dominant cost on spawn-bound hosts. Only valid once the previous
+        trainable has returned (the controller reuses only cleanly-
+        finished runners).
+
+        The session is POISONED (None) until _setup succeeds: the
+        controller fire-and-forgets reset before next_result, so a
+        failed reset must surface through next_result (which the
+        controller observes) rather than silently replaying the previous
+        trial's finished session as a zero-iteration success."""
+        self._session = None
+        if self._thread.is_alive():
+            raise RuntimeError("reset() while the previous trial fn is still running")
+        self._setup(config, local_dir, restored_checkpoint, remote_dir)
+        return True
 
     def _run(self):
         try:
@@ -149,6 +174,11 @@ class TrialRunner:
     def next_result(self) -> Optional[dict]:
         """One report, or None when the trainable returned. Raises the
         trainable's error."""
+        if self._session is None:
+            raise RuntimeError(
+                "trial runner has no active session (a preceding reset() "
+                "failed); the controller restarts the trial on a fresh actor"
+            )
         while True:
             try:
                 return self._session.result_queue.get(timeout=0.2)

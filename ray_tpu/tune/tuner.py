@@ -36,6 +36,10 @@ class TuneConfig:
     seed: Optional[int] = None
     max_failures: int = 0
     resources_per_trial: Dict[str, float] = field(default_factory=lambda: {"num_cpus": 1})
+    # Reuse cleanly-finished TrialRunner actors for new trials
+    # (reference: tune/tune.py:297 reuse_actors) — skips per-trial
+    # process spawns, the dominant cost on spawn-bound hosts.
+    reuse_actors: bool = False
 
 
 class ResultGrid:
@@ -139,6 +143,10 @@ class TuneController:
         self._searcher.set_search_properties(tune_config.metric, tune_config.mode)
         self._scheduler = tune_config.scheduler or FIFOScheduler()
         self._scheduler.set_search_properties(tune_config.metric, tune_config.mode)
+        # ResourceChangingScheduler needs the controller to size shares.
+        if hasattr(self._scheduler, "set_tune_controller"):
+            self._scheduler.set_tune_controller(self)
+        self._actor_cache: List[Any] = []  # finished runners for reuse
         self._trials: List[Trial] = []
         self._pending_result: Dict[str, Any] = {}  # trial_id -> in-flight ref
         self._exhausted = False
@@ -223,27 +231,36 @@ class TuneController:
         return max(1, int(total // cpus_per))
 
     def _start_trial(self, t: Trial, restore: bool = False):
-        res = self._cfg.resources_per_trial
-        runner_cls = ray_tpu.remote(
-            num_cpus=res.get("num_cpus", 1),
-            num_tpus=res.get("num_tpus", 0),
-            resources={k: v for k, v in res.items() if k not in ("num_cpus", "num_tpus")},
-            max_restarts=0,
-        )(TrialRunner)
+        # Per-trial override (ResourceChangingScheduler) wins over the
+        # experiment default.
+        res = t.resources or self._cfg.resources_per_trial
         new_cfg = self._scheduler.choose_config(t)
         if new_cfg is not None:
             t.config = new_cfg
         from ray_tpu.utils import cloudfs
 
-        t.actor = runner_cls.remote(
-            self._fn_blob,
-            t.config,
-            os.path.join(self._scratch, t.trial_id),
-            t.checkpoint_dir if restore else None,
-            remote_dir=(
-                cloudfs.join(self._dir, t.trial_id) if self._dir_is_uri else None
-            ),
-        )
+        remote_dir = cloudfs.join(self._dir, t.trial_id) if self._dir_is_uri else None
+        local_dir = os.path.join(self._scratch, t.trial_id)
+        ckpt = t.checkpoint_dir if restore else None
+        # reuse_actors: only default-resourced trials share runners (a
+        # cached runner holds the default allocation).
+        if (
+            self._cfg.reuse_actors
+            and self._actor_cache
+            and res == self._cfg.resources_per_trial
+        ):
+            t.actor = self._actor_cache.pop()
+            t.actor.reset.remote(t.config, local_dir, ckpt, remote_dir=remote_dir)
+        else:
+            runner_cls = ray_tpu.remote(
+                num_cpus=res.get("num_cpus", 1),
+                num_tpus=res.get("num_tpus", 0),
+                resources={k: v for k, v in res.items() if k not in ("num_cpus", "num_tpus")},
+                max_restarts=0,
+            )(TrialRunner)
+            t.actor = runner_cls.remote(
+                self._fn_blob, t.config, local_dir, ckpt, remote_dir=remote_dir
+            )
         t.status = RUNNING
         self._state_dirty = True
         self._pending_result[t.trial_id] = t.actor.next_result.remote()
@@ -306,6 +323,17 @@ class TuneController:
 
     def _process_result(self, t: Trial, payload: Optional[dict]):
         if payload is None:  # trainable returned
+            # Cleanly-finished runner (fn thread exited): cache it for the
+            # next trial instead of killing the process. Only default-
+            # resourced runners are cacheable (see _start_trial).
+            if (
+                self._cfg.reuse_actors
+                and t.actor is not None
+                and (t.resources or self._cfg.resources_per_trial)
+                == self._cfg.resources_per_trial
+            ):
+                self._actor_cache.append(t.actor)
+                t.actor = None  # _stop_trial must not kill it
             self._stop_trial(t, TERMINATED)
             return
         metrics = payload["metrics"]
@@ -369,8 +397,16 @@ class TuneController:
         ) and not self._pending_result
 
     def run(self) -> List[Trial]:
-        while not self.step():
-            pass
+        try:
+            while not self.step():
+                pass
+        finally:
+            for actor in self._actor_cache:
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:  # noqa: BLE001 — already dead
+                    pass
+            self._actor_cache.clear()
         return self._trials
 
 

@@ -199,16 +199,21 @@ def timeline_chrome(filename: Optional[str] = None) -> list:
     return trace
 
 
-def list_profiles() -> List[dict]:
+def list_profiles(session_dir: Optional[str] = None) -> List[dict]:
     """Captured jax.profiler traces in this session (reference: the
     nsight runtime-env plugin's reports, surfaced like `ray logs`).
-    Rows: {id, name, task_id, captured_at, duration_s, path}."""
+    Rows: {id, name, task_id, captured_at, duration_s, path}.
+    ``session_dir``: explicit session (the dashboard gateway passes its
+    own; default = the connected driver's)."""
     import json as _json
 
-    from ray_tpu.core.api import _require_worker
     from ray_tpu.runtime_env.jax_profiler import profiles_root
 
-    root = profiles_root(_require_worker().session_dir)
+    if session_dir is None:
+        from ray_tpu.core.api import _require_worker
+
+        session_dir = _require_worker().session_dir
+    root = profiles_root(session_dir)
     rows = []
     if not os.path.isdir(root):
         return rows

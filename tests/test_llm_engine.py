@@ -194,3 +194,32 @@ def test_empty_prompt_rejected_and_pool_not_drained(tiny_model):
     # And a zero-alloc is an empty list, not the pool.
     assert eng.alloc.alloc(0) == []
     assert eng.alloc.available == free_before
+
+
+def test_windowed_decode_matches_window1(tiny_model):
+    """decode_window > 1 (multi-step scan per device call) must be
+    token-for-token identical to per-step decode under greedy sampling,
+    including eos mid-window and slot refill afterwards."""
+    cfg, params = tiny_model
+    prompts = [[5, 9, 2], [17, 1, 8, 4], [30, 31], [7, 6, 5, 4, 3]]
+    base = _engine(cfg, params).generate_batch(prompts, max_new_tokens=13)
+    eng_w = LLMEngine(
+        params, cfg,
+        PagedConfig(block_size=8, num_blocks=33, max_batch=2, max_blocks_per_seq=8),
+        decode_window=4,
+    )
+    outs = eng_w.generate_batch(prompts, max_new_tokens=13)
+    assert outs == base
+    # 2 slots served 4 requests → retirement + refill at window seams.
+    assert eng_w.stats["prefills"] == 4 and eng_w.stats["max_active"] == 2
+    # eos mid-window stops exactly at the eos token.
+    eos = base[0][5]
+    eng_e = _engine(cfg, params, max_batch=4)
+    eng_we = LLMEngine(
+        params, cfg,
+        PagedConfig(block_size=8, num_blocks=33, max_batch=4, max_blocks_per_seq=8),
+        decode_window=4,
+    )
+    [e1] = eng_e.generate_batch([prompts[0]], max_new_tokens=13, eos_id=eos)
+    [e2] = eng_we.generate_batch([prompts[0]], max_new_tokens=13, eos_id=eos)
+    assert e1 == e2 and e1[-1] == eos

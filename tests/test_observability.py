@@ -279,3 +279,45 @@ def test_log_tailer_overflow_and_blank_lines(tmp_path):
     with open(log, "a") as f:
         f.write(" done\n")
     assert [l for _, l in tailer.poll_once()] == ["partial done"]
+
+
+def test_grafana_dashboard_and_profiles_surface(ray_start_regular):
+    """Grafana dashboard factory (reference: grafana_dashboard_factory.py)
+    + the /profiles page: generated JSON is importable-shaped (uid,
+    panels with Prometheus targets per metric type) and the dashboard
+    serves it plus the capture listing."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu.util import metrics
+    from ray_tpu.util.grafana import generate_dashboard
+
+    c = metrics.Counter("graf_test_total", "a counter")
+    g = metrics.Gauge("graf_test_gauge", "a gauge")
+    h = metrics.Histogram("graf_test_hist", "a histogram", boundaries=[1, 5])
+    c.inc(); g.set(2.0); h.observe(0.5)
+    metrics.flush()
+
+    dash = generate_dashboard()
+    assert dash["uid"] and dash["panels"]
+    by_title = {p["title"]: p for p in dash["panels"]}
+    assert "graf_test_total (rate)" in by_title
+    assert "graf_test_gauge" in by_title
+    assert "graf_test_hist (quantiles)" in by_title
+    rate_expr = by_title["graf_test_total (rate)"]["targets"][0]["expr"]
+    assert rate_expr == "rate(graf_test_total[5m])"
+    quantile_exprs = [t["expr"] for t in by_title["graf_test_hist (quantiles)"]["targets"]]
+    assert any("histogram_quantile(0.99" in e and "graf_test_hist_bucket" in e
+               for e in quantile_exprs)
+    # every panel pins the templated datasource (importability)
+    assert all(p["datasource"] == "${datasource}" for p in dash["panels"])
+
+    url = state_api.dashboard_url()
+    with urllib.request.urlopen(f"{url}/api/grafana/dashboard", timeout=30) as r:
+        served = _json.loads(r.read())
+    assert {p["title"] for p in dash["panels"]} <= {p["title"] for p in served["panels"]}
+    with urllib.request.urlopen(f"{url}/api/profiles", timeout=30) as r:
+        assert isinstance(_json.loads(r.read()), list)
+    with urllib.request.urlopen(f"{url}/profiles", timeout=30) as r:
+        page = r.read().decode()
+    assert "jax.profiler captures" in page

@@ -1,0 +1,122 @@
+"""ResourceChangingScheduler + reuse_actors.
+
+Reference: tune/schedulers/resource_changing_scheduler.py:592 (reallocate
+trial resources mid-experiment) and tune/tune.py:297 (reuse_actors —
+trial-actor reuse across trials; on spawn-bound hosts the dominant cost).
+"""
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import (
+    ResourceChangingScheduler,
+    TuneConfig,
+    Tuner,
+)
+
+
+def test_reuse_actors_shares_runner_processes(ray_start_regular, tmp_path):
+    def trainable(config):
+        tune.report({"score": config["x"], "pid": os.getpid()})
+
+    def fit(reuse):
+        tuner = Tuner(
+            trainable,
+            param_space={"x": tune.grid_search([1, 2, 3, 4, 5, 6])},
+            tune_config=TuneConfig(
+                metric="score", mode="max", max_concurrent_trials=2,
+                reuse_actors=reuse,
+            ),
+            _experiment_dir=str(tmp_path / f"reuse_{reuse}"),
+        )
+        grid = tuner.fit()
+        assert len(grid) == 6 and grid.num_errors == 0
+        return {t.last_result["pid"] for t in grid.trials}
+
+    t0 = time.perf_counter()
+    pids_reuse = fit(True)
+    dt_reuse = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    pids_fresh = fit(False)
+    dt_fresh = time.perf_counter() - t0
+    # With reuse, 6 trials ran on at most 2 runner processes; without,
+    # every trial paid its own spawn.
+    assert len(pids_reuse) <= 2, pids_reuse
+    assert len(pids_fresh) == 6, pids_fresh
+    # And it is measurably faster (spawn cost removed for 4+ trials).
+    assert dt_reuse < dt_fresh, (dt_reuse, dt_fresh)
+
+
+def test_resource_changing_scheduler_reallocates_live_trial(
+    ray_start_regular, tmp_path
+):
+    """After iteration 2 the allocation fn doubles the trial's CPUs: the
+    trial must pause, resume from its checkpoint on the new allocation,
+    and finish; the Trial record carries the new resources."""
+
+    def trainable(config):
+        start = 0
+        ckpt = tune.get_checkpoint_dir()
+        if ckpt:
+            with open(os.path.join(ckpt, "step")) as f:
+                start = int(f.read())
+        for step in range(start + 1, 5):
+            d = tune.make_checkpoint_dir()
+            with open(os.path.join(d, "step"), "w") as f:
+                f.write(str(step))
+            tune.report({"score": float(step), "step": step}, checkpoint_dir=d)
+
+    def realloc(controller, trial, result, scheduler):
+        if result.get("step", 0) >= 2:
+            return {"num_cpus": 2}
+        return None
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1])},
+        tune_config=TuneConfig(
+            metric="score", mode="max",
+            scheduler=ResourceChangingScheduler(
+                resources_allocation_function=realloc
+            ),
+        ),
+        _experiment_dir=str(tmp_path / "rcs"),
+    )
+    grid = tuner.fit()
+    assert grid.num_errors == 0
+    t = grid.trials[0]
+    assert t.resources == {"num_cpus": 2}  # reallocated
+    steps = [r["step"] for r in t.results]
+    assert steps[-1] == 4  # finished after the move
+    # The pause/resume seam did not replay steps (checkpoint restore).
+    assert steps == sorted(set(steps)), steps
+
+
+def test_distribute_resources_policy(ray_start_regular, tmp_path):
+    """The default DistributeResources policy widens a lone trial toward
+    the cluster CPU count."""
+
+    def trainable(config):
+        for step in range(1, 4):
+            d = tune.make_checkpoint_dir()
+            with open(os.path.join(d, "x"), "w") as f:
+                f.write("1")
+            tune.report({"score": float(step)}, checkpoint_dir=d)
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1])},
+        tune_config=TuneConfig(
+            metric="score", mode="max",
+            scheduler=ResourceChangingScheduler(),
+        ),
+        _experiment_dir=str(tmp_path / "dist"),
+    )
+    grid = tuner.fit()
+    assert grid.num_errors == 0
+    t = grid.trials[0]
+    # 4-CPU test cluster, one running trial → it gets all 4.
+    assert t.resources and t.resources["num_cpus"] == 4, t.resources
