@@ -296,6 +296,9 @@ class Controller:
         self.events: List[dict] = []  # task event ring buffer
         self.finished_specs: Dict[TaskID, TaskSpec] = {}  # lineage for reconstruction
         self.metrics: Dict[str, dict] = {}  # aggregated app metrics
+        # Serve engine flight-recorder snapshots, pushed by replicas
+        # (rpc_serve_report) and served at /api/serve/engine.
+        self.serve_state: Dict[str, dict] = {}
         self.dashboard_port: Optional[int] = None
 
         # Head node: controller doubles as its node agent.
@@ -2288,6 +2291,37 @@ class Controller:
             }
             for name, e in self.metrics.items()
         }
+
+    async def rpc_serve_report(self, peer, key: str, snapshot: Optional[dict]):
+        """An LLM engine's periodic flight-recorder snapshot (reference
+        shape: serve replicas pushing autoscaling/queue metrics to the
+        serve controller). Keyed deployment/replica/engine; stale entries
+        (dead replicas) are pruned on the next report. ``snapshot=None``
+        is an idle heartbeat: nothing changed engine-side, just keep the
+        stored snapshot alive."""
+        if snapshot is None:
+            cur = self.serve_state.get(key)
+            if cur is not None:
+                cur["ts"] = time.time()
+            return
+        # Stamp arrival with THIS clock: staleness pruning must not trust
+        # the engine host's wall time (a skewed worker node would have
+        # its live snapshots pruned as stale on arrival).
+        snapshot["ts"] = time.time()
+        self.serve_state[key] = snapshot
+        cutoff = time.time() - 120.0
+        for k in [k for k, v in self.serve_state.items()
+                  if v.get("ts", 0) < cutoff]:
+            del self.serve_state[k]
+
+    async def rpc_serve_state(self, peer):
+        # Filter on read too: after the last engine stops reporting
+        # (deployment deleted, replica dead) nothing triggers the
+        # report-side prune, and a dead engine's occupancy must not be
+        # served as live state forever.
+        cutoff = time.time() - 120.0
+        return {k: v for k, v in self.serve_state.items()
+                if v.get("ts", 0) >= cutoff}
 
     async def rpc_resource_demand(self, peer):
         """Unmet demand for the autoscaler: resource sets of tasks that are

@@ -10,6 +10,7 @@ Routes:
   GET /api/v0/<what>        state JSON: nodes|workers|tasks|actors|objects|
                             events|placement_groups|cluster_resources|
                             available_resources
+  GET /api/serve/engine     serve LLM-engine flight-recorder snapshots
   GET /healthz              liveness probe
   Job submission REST (reference: dashboard/modules/job/job_head.py):
   POST /api/jobs/           {entrypoint, submission_id?, runtime_env?,
@@ -131,6 +132,11 @@ def start_http_gateway(controller, loop: asyncio.AbstractEventLoop, port: int) -
                     from ray_tpu.util.state import list_profiles
 
                     self._json(list_profiles(controller.session_dir))
+                elif path == "/api/serve/engine":
+                    # Engine flight-recorder snapshots pushed by serve
+                    # replicas (llm_engine.report_state): occupancy, step
+                    # ring tails, recent-request latency breakdowns.
+                    self._json(call("rpc_serve_state"))
                 elif path == "/api/grafana/dashboard":
                     # Importable Grafana JSON generated from the live
                     # metric registry (reference: dashboard/modules/

@@ -594,7 +594,13 @@ def _resolve_reply(item):
 
 
 def _maybe_async(result):
-    if asyncio.iscoroutine(result):
+    # inspect.iscoroutine, NOT asyncio.iscoroutine: the latter also
+    # matches plain generator objects (legacy generator-based coroutine
+    # support, Python ≤3.10), which would asyncio.run() streaming task
+    # generators instead of handing them to _report_stream.
+    import inspect
+
+    if inspect.iscoroutine(result):
         return asyncio.run(result)
     return result
 

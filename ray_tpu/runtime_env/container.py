@@ -29,6 +29,7 @@ import os
 import shlex
 import shutil
 import subprocess
+import sys
 import tempfile
 import threading
 import time
@@ -134,9 +135,17 @@ def wrap_command(
 
     The image pull happens INSIDE the spawned shell (cached via a
     per-node marker file), never on the caller: the controller/agent
-    loop must not block minutes on a registry. A failed pull simply
-    means the worker never registers — the scheduler's stale-spawn
-    accounting retries."""
+    loop must not block minutes on a registry. The spawned shell calls
+    back into ``ensure_image`` (``python -m ray_tpu.runtime_env.container``)
+    so concurrent worker spawns share its lock/marker protocol — one
+    puller, the rest wait — instead of N racing ``pull`` processes. A
+    failed pull simply means the worker never registers — the
+    scheduler's stale-spawn accounting retries.
+
+    The in-container command uses the IMAGE's interpreter from PATH
+    (reference: the reference's ``--entrypoint python``), overridable via
+    ``RAY_TPU_CONTAINER_PYTHON`` — the host's absolute ``sys.executable``
+    usually does not exist inside the image."""
     rt = resolve_runtime()
     if rt is None:
         raise RuntimeEnvSetupError(
@@ -154,10 +163,47 @@ def wrap_command(
         if k.startswith(_FORWARD_PREFIXES):
             argv += ["-e", f"{k}={v}"]
     argv.append(image_uri)
+    cmd = list(cmd)
+    if cmd and (cmd[0] == sys.executable or (
+        os.path.isabs(cmd[0]) and os.path.basename(cmd[0]).startswith("python")
+    )):
+        # python3, not python: many images (debian/ubuntu slim) ship only
+        # the versioned name. The per-worker env (runtime_env env_vars)
+        # wins over the node agent's own environment.
+        cmd[0] = (
+            env.get("RAY_TPU_CONTAINER_PYTHON")
+            or os.environ.get("RAY_TPU_CONTAINER_PYTHON")
+            or "python3"
+        )
     argv += cmd
+    # Fast path: marker present → skip the python hook entirely (it pays
+    # a full ray_tpu import); otherwise ensure_image elects one puller
+    # via its lock file and everyone else waits on it.
     marker = _image_marker(rt, image_uri)
     pull = (
         f"test -f {shlex.quote(marker)} || "
-        f"({shlex.join([rt, 'pull', image_uri])} && touch {shlex.quote(marker)})"
+        + shlex.join([sys.executable, "-m", "ray_tpu.runtime_env.container",
+                      image_uri])
     )
     return ["/bin/sh", "-c", f"{pull} && exec {shlex.join(argv)}"]
+
+
+def _main(argv: List[str]) -> int:
+    """``python -m ray_tpu.runtime_env.container <image_uri>`` — the
+    spawn-path pull hook: runs ``ensure_image`` (lock-file protocol, so
+    N concurrently spawning workers elect one puller) on the HOST before
+    the shell execs the container runtime."""
+    if len(argv) != 1:
+        print("usage: python -m ray_tpu.runtime_env.container <image_uri>",
+              file=sys.stderr)
+        return 2
+    try:
+        ensure_image(argv[0])
+    except RuntimeEnvSetupError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_main(sys.argv[1:]))

@@ -25,10 +25,12 @@ from typing import Callable, List, Optional
 class _BatchQueue:
     def __init__(self, fn: Callable, max_batch_size: int, timeout_s: float):
         self.fn = fn
+        self.name = getattr(fn, "__name__", "batch")
         self.max_batch_size = max_batch_size
         self.timeout_s = timeout_s
         self.items: List = []
         self.futures: List[Future] = []
+        self.enqueued_at: List[float] = []
         self.cond = threading.Condition()
         self.flushing = False
 
@@ -37,6 +39,7 @@ class _BatchQueue:
         with self.cond:
             self.items.append(item)
             self.futures.append(fut)
+            self.enqueued_at.append(time.monotonic())
             self.cond.notify_all()
             if len(self.items) >= self.max_batch_size:
                 self._flush_locked()
@@ -57,14 +60,30 @@ class _BatchQueue:
         return fut
 
     def _flush_locked(self):
+        from ray_tpu.serve.metrics import serve_metrics
+        from ray_tpu.util import tracing
+
         items, futs = self.items, self.futures
+        enq, self.enqueued_at = self.enqueued_at, []
         self.items, self.futures = [], []
         # Run the batch OUTSIDE the lock so new arrivals queue up for the
         # next batch while this one computes.
         self.cond.release()
         try:
             try:
-                results = self.fn(items)
+                m = serve_metrics()
+                m.batch_size.observe(len(items), {"fn": self.name})
+                if enq:
+                    m.batch_wait_ms.observe(
+                        (time.monotonic() - min(enq)) * 1000.0, {"fn": self.name}
+                    )
+            except Exception:  # noqa: BLE001 — telemetry must never strand
+                pass  # the callers blocked on their futures below
+            try:
+                with tracing.start_span(
+                    f"serve.batch:{self.name}", {"batch_size": len(items)}
+                ):
+                    results = self.fn(items)
                 if results is None or len(results) != len(items):
                     raise ValueError(
                         f"@serve.batch function must return one result per "

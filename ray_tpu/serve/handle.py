@@ -256,25 +256,49 @@ class DeploymentHandle:
         the model (reference: handle.options(multiplexed_model_id=...))."""
         return self._clone(method=method_name, mux_id=multiplexed_model_id)
 
+    def _request_meta(self) -> dict:
+        """Per-request metadata riding with the call: the submit
+        timestamp lets the replica compute queue wait (submit→execution
+        start) and e2e latency without clock plumbing of its own."""
+        return {
+            "submit_ts": time.time(),
+            "deployment": self.deployment_name,
+            "method": self._method,
+        }
+
     def remote(self, *args, **kwargs) -> DeploymentResponse:
+        from ray_tpu.util import tracing
+
         args = tuple(_unwrap(a) for a in args)
         kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
-        replica = self._router.pick(self._mux_id)
-        ref = replica.handle_request.remote(
-            self._method, args, kwargs, self._mux_id
-        )
+        meta = self._request_meta()
+        # The submit span parents the replica-side execution span (the
+        # trace context is injected into the actor task at .remote()).
+        with tracing.start_span(
+            f"handle:{self.deployment_name}.{self._method}"
+        ):
+            replica = self._router.pick(self._mux_id)
+            ref = replica.handle_request.remote(
+                self._method, args, kwargs, self._mux_id, meta
+            )
         return DeploymentResponse(ref, on_done=lambda r=replica: self._router.done(r))
 
     def stream(self, *args, **kwargs) -> DeploymentStreamingResponse:
         """Streaming call: the deployment method is a generator; items
         arrive as they are yielded (reference: handle.options(stream=True)
         → DeploymentResponseGenerator; the LLM token-streaming path)."""
+        from ray_tpu.util import tracing
+
         args = tuple(_unwrap(a) for a in args)
         kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
-        replica = self._router.pick(self._mux_id)
-        gen = replica.handle_request_stream.options(num_returns="streaming").remote(
-            self._method, args, kwargs, self._mux_id
-        )
+        meta = self._request_meta()
+        with tracing.start_span(
+            f"handle:{self.deployment_name}.{self._method}", {"stream": True}
+        ):
+            replica = self._router.pick(self._mux_id)
+            gen = replica.handle_request_stream.options(num_returns="streaming").remote(
+                self._method, args, kwargs, self._mux_id, meta
+            )
         return DeploymentStreamingResponse(
             gen, on_done=lambda r=replica: self._router.done(r)
         )

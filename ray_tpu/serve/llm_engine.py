@@ -25,9 +25,11 @@ from __future__ import annotations
 import collections
 import dataclasses
 import itertools
+import os
 import queue
 import threading
-from typing import List, Optional, Sequence
+import time
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -42,6 +44,7 @@ from ray_tpu.models.paged import (
 from ray_tpu.models.transformer import TransformerConfig
 
 _req_ids = itertools.count()
+_engine_ids = itertools.count()
 
 
 @dataclasses.dataclass
@@ -58,6 +61,13 @@ class Request:
     generated: List[int] = dataclasses.field(default_factory=list)
     # Set on rejection (prompt too long etc.); the sentinel is still sent.
     error: Optional[str] = None
+    # Telemetry lifecycle marks (flight recorder + TTFT/TPOT accounting).
+    submit_ts: float = dataclasses.field(default_factory=time.time)
+    prefill_ts: Optional[float] = None
+    first_token_ts: Optional[float] = None
+    # Caller's trace context at add_request time, so the pump thread can
+    # parent engine spans under the request's serve-path span tree.
+    trace_ctx: Optional[Dict[str, str]] = None
 
     @property
     def remaining(self) -> int:
@@ -78,6 +88,46 @@ class Request:
                     raise RuntimeError(self.error)
                 return
             yield tok
+
+
+class FlightRecorder:
+    """Fixed-size rings of per-step and per-finished-request records.
+
+    Reference shape: Ray's per-worker task event buffer (bounded, drained
+    for the timeline) and vLLM's engine stats loop. Appends happen on the
+    engine's single scheduler thread and are plain deque appends (the
+    maxlen bound makes them O(1) and allocation-free beyond the record
+    dict) — ``snapshot()`` copies under the GIL, so readers never block
+    the step loop.
+    """
+
+    def __init__(self, step_capacity: int = 256, request_capacity: int = 256):
+        self.steps: "collections.deque[dict]" = collections.deque(maxlen=step_capacity)
+        self.requests: "collections.deque[dict]" = collections.deque(maxlen=request_capacity)
+
+    def record_step(self, rec: dict):
+        self.steps.append(rec)
+
+    def record_request(self, rec: dict):
+        self.requests.append(rec)
+
+    def latency_summary(self) -> Dict[str, Dict[str, float]]:
+        """p50/p95/p99 per latency field over the recent-request ring —
+        queryable without scraping Prometheus."""
+        from ray_tpu.serve.metrics import summarize_latencies
+
+        reqs = list(self.requests)
+        return summarize_latencies({
+            field: [r[field] for r in reqs if r.get(field) is not None]
+            for field in ("queue_ms", "ttft_ms", "tpot_ms", "e2e_ms")
+        })
+
+    def snapshot(self) -> dict:
+        return {
+            "steps": list(self.steps),
+            "recent_requests": list(self.requests),
+            "latency_ms": self.latency_summary(),
+        }
 
 
 class _BlockAllocator:
@@ -112,6 +162,7 @@ class LLMEngine:
         *,
         decode_window: int = 1,
         seed: int = 0,
+        metrics_tags: Optional[Dict[str, str]] = None,
     ):
         """``params``: the model weights — either an array pytree, or a
         ZERO-ARG CALLABLE returning one. Prefer the callable for big
@@ -126,7 +177,11 @@ class LLMEngine:
         sync per window — see paged_decode_loop). >1 trades per-token
         streaming granularity and up to window-1 wasted steps per
         finishing sequence for amortized dispatch latency; scheduling
-        (admission, paging, preemption) happens at window boundaries."""
+        (admission, paging, preemption) happens at window boundaries.
+
+        ``metrics_tags``: {deployment, replica} tags for this engine's
+        metric series; defaults to the ambient serve replica context
+        (set by the Replica actor) or a standalone placeholder."""
         self.cfg = cfg
         self.pcfg = pcfg or PagedConfig()
         p = self.pcfg
@@ -152,7 +207,37 @@ class LLMEngine:
         self._thread: Optional[threading.Thread] = None
         # Stats for tests/bench.
         self.stats = {"steps": 0, "tokens": 0, "max_active": 0, "preemptions": 0,
-                      "prefills": 0}
+                      "prefills": 0, "admitted": 0, "prompt_tokens": 0,
+                      "finished": 0}
+        # -- telemetry ---------------------------------------------------
+        # Flight recorder: bounded rings appended on the scheduler thread.
+        self.recorder = FlightRecorder()
+        self.engine_id = next(_engine_ids)
+        from ray_tpu.serve.metrics import replica_context
+
+        tags = metrics_tags or replica_context() or {
+            "deployment": "_standalone", "replica": f"pid{os.getpid()}",
+        }
+        self.metrics_tags = dict(tags)
+        # Registry metrics are flushed at a throttled cadence (not per
+        # step, never per token): _maybe_flush_metrics diffs stats
+        # against this baseline.
+        self._metric_interval_s = 0.25
+        self._last_metric_flush = 0.0
+        self._flushed_stats: Dict[str, int] = dict(self.stats)
+        # Serializes flushes between the pump thread (step cadence) and
+        # the reporter thread (force=True): both diff against
+        # _flushed_stats, so unsynchronized flushes double-count or drop
+        # counter deltas. The throttle check stays outside the lock — the
+        # step path normally never contends.
+        self._metrics_lock = threading.Lock()
+        self._report_interval_s = 1.0
+        self._reporter: Optional[threading.Thread] = None
+        # Idle suppression: when stats haven't moved since the last full
+        # push, the periodic report degrades to a ts-only heartbeat (a
+        # fleet of idle replicas must not stream ring snapshots at 1 Hz).
+        self._last_pushed_stats: Optional[Dict[str, int]] = None
+        self._last_full_push = 0.0
 
     def _build_programs(self, params):
         """Build the decode window + prefill programs.
@@ -256,6 +341,10 @@ class LLMEngine:
             )
             req.out.put(None)
             return req
+        from ray_tpu.util import tracing
+
+        if tracing.tracing_enabled():
+            req.trace_ctx = tracing.current_context()
         with self._lock:
             self.waiting.append(req)
         self._wake.set()
@@ -275,6 +364,13 @@ class LLMEngine:
 
         self._thread = threading.Thread(target=loop, daemon=True, name="llm-engine")
         self._thread.start()
+        # State reporter: pushes the flight-recorder snapshot to the
+        # controller off the pump thread, so a slow RPC never stalls
+        # decode.
+        self._reporter = threading.Thread(
+            target=self._report_loop, daemon=True, name="llm-engine-report"
+        )
+        self._reporter.start()
 
     def stop(self):
         self._stop.set()
@@ -282,6 +378,10 @@ class LLMEngine:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        if self._reporter is not None:
+            self._reporter.join(timeout=2.0)
+            self._reporter = None
+            self.report_state()  # final snapshot so shutdown state lands
 
     def generate_batch(
         self,
@@ -329,6 +429,32 @@ class LLMEngine:
         req = self.slots[i]
         self._free_slot(i)
         req.out.put(None)
+        self.stats["finished"] += 1
+        now = time.time()
+        n = len(req.generated)
+        rec = {
+            "rid": req.rid,
+            "ts": now,
+            "prompt_tokens": len(req.prompt),
+            "output_tokens": n,
+            "queue_ms": (req.prefill_ts - req.submit_ts) * 1000.0
+            if req.prefill_ts else None,
+            "ttft_ms": (req.first_token_ts - req.submit_ts) * 1000.0
+            if req.first_token_ts else None,
+            "tpot_ms": (now - req.first_token_ts) * 1000.0 / (n - 1)
+            if n > 1 and req.first_token_ts else None,
+            "e2e_ms": (now - req.submit_ts) * 1000.0,
+        }
+        self.recorder.record_request(rec)
+        from ray_tpu.util import tracing
+
+        # Parent the engine-side request span under the serve-path trace
+        # captured at add_request (cross-thread: explicit parenting).
+        tracing.record_span(
+            "engine:request", req.submit_ts, now, req.trace_ctx,
+            {"rid": req.rid, "prompt_tokens": rec["prompt_tokens"],
+             "output_tokens": n},
+        )
 
     def _preempt_one(self) -> bool:
         """Evict the most-recently admitted slot (its prefix is shortest
@@ -393,6 +519,7 @@ class LLMEngine:
             self.tables[i] = TRASH_BLOCK
             self.tables[i, :real_blocks] = got
             self.temps[i] = req.temperature
+            self.stats["admitted"] += 1
             self._run_prefill(i, req)
 
     def _flush_prefills(self):
@@ -425,6 +552,9 @@ class LLMEngine:
             np.int32(plen), np.float32(req.temperature), sub,
         )
         self.stats["prefills"] += 1
+        self.stats["prompt_tokens"] += plen
+        if req.prefill_ts is None:  # first admission (not a resume)
+            req.prefill_ts = time.time()
         self.lens[i] = plen
         # Defer the device→host read: prefill dispatches pipeline without
         # syncing; _flush_prefills fetches every pending first token in
@@ -432,8 +562,12 @@ class LLMEngine:
         self._pending_first.append((i, tok))
 
     def _emit(self, i: int, tok: int):
-        """Record + stream one generated token; retire the slot when done."""
+        """Record + stream one generated token; retire the slot when done.
+        Per-token cost stays allocation-light: one None check for the
+        TTFT mark — histograms/gauges flush at step cadence, not here."""
         req = self.slots[i]
+        if req.first_token_ts is None:
+            req.first_token_ts = time.time()
         req.generated.append(tok)
         req.out.put(tok)
         self.stats["tokens"] += 1
@@ -443,28 +577,146 @@ class LLMEngine:
     def step(self) -> bool:
         """One scheduler iteration: admit → page → decode. Returns True
         if any device work ran (False = idle)."""
+        s0 = (self.stats["tokens"], self.stats["prefills"],
+              self.stats["preemptions"], self.stats["admitted"])
         self._admit()
         self._flush_prefills()
-        if self.active_count() == 0:
-            return False
-        self._ensure_decode_blocks()
-        active = [i for i, s in enumerate(self.slots) if s is not None]
-        if not active:
-            return False
-        self.stats["max_active"] = max(self.stats["max_active"], len(active))
-        self.key, sub = jax.random.split(self.key)
-        nxt, self.cache = self._decode(
-            self.params, jax.numpy.asarray(self.cur), self.cache,
-            jax.numpy.asarray(self.tables), jax.numpy.asarray(self.lens),
-            jax.numpy.asarray(self.temps), sub,
+        active = []
+        if self.active_count():
+            self._ensure_decode_blocks()
+            active = [i for i, s in enumerate(self.slots) if s is not None]
+        if active:
+            self.stats["max_active"] = max(self.stats["max_active"], len(active))
+            self.key, sub = jax.random.split(self.key)
+            nxt, self.cache = self._decode(
+                self.params, jax.numpy.asarray(self.cur), self.cache,
+                jax.numpy.asarray(self.tables), jax.numpy.asarray(self.lens),
+                jax.numpy.asarray(self.temps), sub,
+            )
+            nxt = np.asarray(nxt)  # [window, b] — ONE host sync per window
+            self.stats["steps"] += 1
+            for i in active:
+                for k in range(self.window):
+                    if self.slots[i] is None:
+                        break  # finished mid-window; rest is overshoot
+                    self.lens[i] += 1  # the fed token's KV is now resident
+                    self.cur[i] = nxt[k, i]
+                    self._emit(i, int(nxt[k, i]))
+        s1 = (self.stats["tokens"], self.stats["prefills"],
+              self.stats["preemptions"], self.stats["admitted"])
+        # Record even decode-less iterations that did work — e.g. a
+        # max_new_tokens=1 request finishes entirely inside the prefill
+        # flush and must still appear in the step ring.
+        worked = bool(active) or s1 != s0
+        if worked:
+            self.recorder.record_step({
+                "ts": time.time(),
+                "active": len(active),
+                "waiting": len(self.waiting),
+                "kv_blocks_free": self.alloc.available,
+                "kv_utilization": 1.0 - self.alloc.available
+                / max(1, self.pcfg.usable_blocks),
+                "tokens": s1[0] - s0[0],
+                "prefills": s1[1] - s0[1],
+                "preemptions": s1[2] - s0[2],
+                "admitted": s1[3] - s0[3],
+            })
+            self._maybe_flush_metrics()
+        return worked
+
+    # ------------------------------------------------------------------
+    # Telemetry: registry metrics + controller state reports
+    # ------------------------------------------------------------------
+
+    def _maybe_flush_metrics(self, force: bool = False):
+        """Push stats deltas into the metric registry at a throttled
+        cadence — one batch of Counter/Gauge updates every
+        ``_metric_interval_s``, never per token."""
+        now = time.monotonic()
+        if not force and now - self._last_metric_flush < self._metric_interval_s:
+            return
+        from ray_tpu.serve.metrics import serve_metrics
+
+        with self._metrics_lock:
+            if not force and (
+                time.monotonic() - self._last_metric_flush < self._metric_interval_s
+            ):
+                return  # another thread flushed while we waited
+            self._last_metric_flush = time.monotonic()
+            m = serve_metrics()
+            t = self.metrics_tags
+            s = dict(self.stats)
+            prev = self._flushed_stats
+            for key, counter in (
+                ("steps", m.engine_steps),
+                ("tokens", m.engine_tokens),
+                ("prompt_tokens", m.engine_prompt_tokens),
+                ("prefills", m.engine_prefills),
+                ("preemptions", m.engine_preemptions),
+            ):
+                delta = s[key] - prev.get(key, 0)
+                if delta:
+                    counter.inc(delta, t)
+            self._flushed_stats = s
+            m.engine_active.set(self.active_count(), t)
+            m.engine_waiting.set(len(self.waiting), t)
+            m.engine_kv_free.set(self.alloc.available, t)
+            m.engine_kv_util.set(
+                1.0 - self.alloc.available / max(1, self.pcfg.usable_blocks), t
+            )
+
+    def _report_loop(self):
+        while not self._stop.wait(self._report_interval_s):
+            try:
+                self.report_state()
+            except Exception:  # noqa: BLE001 — telemetry must not kill serving
+                pass
+
+    def report_state(self) -> dict:
+        """Snapshot occupancy + flight recorder and (best-effort) push it
+        to the controller's serve-state table, which backs the
+        ``/api/serve/engine`` endpoint and ``state.summarize_serve()``."""
+        self._maybe_flush_metrics(force=True)
+        snap = self.recorder.snapshot()
+        # The push is a periodic heartbeat — ship the tail of the rings,
+        # not all 256 records, to keep the RPC small.
+        snap["steps"] = snap["steps"][-32:]
+        snap["recent_requests"] = snap["recent_requests"][-64:]
+        snap.update(
+            ts=time.time(),
+            engine_id=self.engine_id,
+            tags=dict(self.metrics_tags),
+            stats=dict(self.stats),
+            occupancy={
+                "active": self.active_count(),
+                "waiting": len(self.waiting),
+                "kv_blocks_free": self.alloc.available,
+                "kv_blocks_total": self.pcfg.usable_blocks,
+                "max_batch": self.pcfg.max_batch,
+            },
         )
-        nxt = np.asarray(nxt)  # [window, b] — ONE host sync per window
-        self.stats["steps"] += 1
-        for i in active:
-            for k in range(self.window):
-                if self.slots[i] is None:
-                    break  # finished mid-window; rest is overshoot
-                self.lens[i] += 1  # the fed token's KV is now resident
-                self.cur[i] = nxt[k, i]
-                self._emit(i, int(nxt[k, i]))
-        return True
+        try:
+            from ray_tpu.core import api
+
+            core = api._global_worker
+            if core is not None:
+                key = "{}/{}/{}".format(
+                    self.metrics_tags.get("deployment", "-"),
+                    self.metrics_tags.get("replica", "-"),
+                    self.engine_id,
+                )
+                now = time.monotonic()
+                # Idle engine: heartbeat only (None), with a periodic
+                # full push as self-repair against a restarted/pruned
+                # controller table.
+                idle = (
+                    snap["stats"] == self._last_pushed_stats
+                    and now - self._last_full_push < 30.0
+                )
+                core._call("serve_report", key, None if idle else snap)
+                if not idle:
+                    self._last_pushed_stats = dict(snap["stats"])
+                    self._last_full_push = now
+        except Exception:  # noqa: BLE001 — controller hiccups are non-fatal
+            pass
+        return snap

@@ -1,0 +1,173 @@
+"""Serve-path SLO metrics: per-request histograms and engine gauges.
+
+Reference: python/ray/serve/_private/metrics_utils.py and the serve
+request metrics the reference records from proxies and replicas
+(serve_num_http_requests, serve_deployment_processing_latency_ms, ...).
+Here the hot-path components (proxy → handle → replica → batcher →
+LLMEngine) record into the process-local metric registry
+(``ray_tpu/util/metrics.py``) and the normal flush pipeline carries the
+series to the controller → Prometheus → Grafana.
+
+All metrics are lazy per-process singletons: the registry keeps every
+constructed Metric alive, so components must share one instance per name
+(``serve_metrics()``) instead of constructing their own.
+
+TTFT/TPOT semantics (LLM serving SLOs): for a streaming request, TTFT is
+submit→first streamed item and TPOT is the mean inter-item gap; for the
+engine's own accounting the flight recorder (llm_engine.py) keeps exact
+per-request breakdowns.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over a pre-sorted list (shared by the
+    engine flight recorder and ``state.summarize_serve``)."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def summarize_latencies(
+    values_by_field: Dict[str, List[float]],
+) -> Dict[str, Dict[str, float]]:
+    """{field: {p50, p95, p99, count}} over raw (unsorted) samples — the
+    one summary shape used by the flight recorder and summarize_serve."""
+    out: Dict[str, Dict[str, float]] = {}
+    for field, raw in values_by_field.items():
+        vals = sorted(raw)
+        out[field] = {
+            "p50": percentile(vals, 0.50),
+            "p95": percentile(vals, 0.95),
+            "p99": percentile(vals, 0.99),
+            "count": len(vals),
+        }
+    return out
+
+# Latency bucket boundaries (ms): sub-ms token cadence up to multi-minute
+# batch jobs — shared by every serve latency histogram so Grafana
+# histogram_quantile panels are comparable across metrics.
+MS_BOUNDARIES = (
+    0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+    1000, 2500, 5000, 10000, 30000, 60000,
+)
+BATCH_BOUNDARIES = (1, 2, 4, 8, 16, 32, 64, 128)
+
+_lock = threading.Lock()
+_metrics: Optional["_ServeMetrics"] = None
+
+# Ambient replica identity: set by the Replica actor before it constructs
+# the user instance, so anything the instance creates (LLMEngine, batch
+# queues) tags its series with the owning deployment/replica without
+# explicit plumbing.
+_replica_ctx: Dict[str, str] = {}
+
+
+def set_replica_context(deployment: str, replica: str) -> None:
+    _replica_ctx.clear()
+    _replica_ctx.update({"deployment": deployment, "replica": replica})
+
+
+def replica_context() -> Dict[str, str]:
+    return dict(_replica_ctx)
+
+
+class _ServeMetrics:
+    def __init__(self):
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        dr = ("deployment", "replica")
+        # -- per-request SLO histograms (recorded by the replica) -------
+        self.queue_ms = Histogram(
+            "serve_request_queue_ms",
+            "Time from handle submit to replica execution start",
+            MS_BOUNDARIES, dr,
+        )
+        self.ttft_ms = Histogram(
+            "serve_ttft_ms",
+            "Time from handle submit to first streamed item (time to first token)",
+            MS_BOUNDARIES, dr,
+        )
+        self.tpot_ms = Histogram(
+            "serve_tpot_ms",
+            "Mean inter-item latency of a streaming response (time per output token)",
+            MS_BOUNDARIES, dr,
+        )
+        self.e2e_ms = Histogram(
+            "serve_e2e_ms",
+            "End-to-end request latency (handle submit to completion)",
+            MS_BOUNDARIES, dr,
+        )
+        self.tokens_out = Counter(
+            "serve_tokens_out_total",
+            "Items streamed back to clients (tokens for LLM deployments)",
+            dr,
+        )
+        self.requests = Counter(
+            "serve_requests_total",
+            "Requests handled by replicas, by outcome",
+            ("deployment", "replica", "outcome"),
+        )
+        # -- ingress -----------------------------------------------------
+        self.proxy_requests = Counter(
+            "serve_proxy_requests_total",
+            "HTTP requests through the serve proxy, by route and status",
+            ("route", "code"),
+        )
+        self.proxy_ms = Histogram(
+            "serve_proxy_request_ms",
+            "Proxy-observed request latency (streaming: full stream duration)",
+            MS_BOUNDARIES, ("route",),
+        )
+        # -- @serve.batch -----------------------------------------------
+        self.batch_size = Histogram(
+            "serve_batch_size",
+            "Items per @serve.batch flush",
+            BATCH_BOUNDARIES, ("fn",),
+        )
+        self.batch_wait_ms = Histogram(
+            "serve_batch_wait_ms",
+            "Oldest item's wait in the batch queue at flush time",
+            MS_BOUNDARIES, ("fn",),
+        )
+        # -- engine (set/inc by the LLMEngine at step cadence, throttled)
+        self.engine_active = Gauge(
+            "serve_engine_active_slots", "Decode slots occupied", dr
+        )
+        self.engine_waiting = Gauge(
+            "serve_engine_waiting", "Requests queued for admission", dr
+        )
+        self.engine_kv_free = Gauge(
+            "serve_engine_kv_blocks_free", "Free KV cache blocks", dr
+        )
+        self.engine_kv_util = Gauge(
+            "serve_engine_kv_utilization", "Fraction of KV blocks in use", dr
+        )
+        self.engine_steps = Counter(
+            "serve_engine_steps_total", "Engine scheduler iterations", dr
+        )
+        self.engine_tokens = Counter(
+            "serve_engine_tokens_total", "Tokens emitted by the engine", dr
+        )
+        self.engine_prompt_tokens = Counter(
+            "serve_engine_prompt_tokens_total", "Prompt tokens prefilled", dr
+        )
+        self.engine_prefills = Counter(
+            "serve_engine_prefills_total", "Prefill program invocations", dr
+        )
+        self.engine_preemptions = Counter(
+            "serve_engine_preemptions_total", "Recompute preemptions", dr
+        )
+
+
+def serve_metrics() -> _ServeMetrics:
+    global _metrics
+    if _metrics is None:
+        with _lock:
+            if _metrics is None:
+                _metrics = _ServeMetrics()
+    return _metrics

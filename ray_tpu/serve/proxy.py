@@ -12,10 +12,17 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict
 
 import ray_tpu
+
+
+def _route(path: str) -> str:
+    """Canonical route for a request path — the ONE normalization used
+    for resolution, metric labels, and span names."""
+    return path.split("?")[0].rstrip("/") or "/"
 
 
 class RouteResolver:
@@ -32,7 +39,7 @@ class RouteResolver:
 
     def handle_for(self, route: str):
         """Raises KeyError for unknown routes."""
-        route = route.split("?")[0].rstrip("/") or "/"
+        route = _route(route)
         name = self.routes().get(route)
         if name is None:
             raise KeyError(route)
@@ -55,9 +62,13 @@ class RouteResolver:
 class ProxyActor:
     def __init__(self, http_port: int = 0):
         from ray_tpu.serve.api import _get_controller, get_deployment_handle
+        from ray_tpu.serve.metrics import serve_metrics
+        from ray_tpu.util import tracing
 
+        tracing.maybe_enable_from_env()
         self._controller = _get_controller()
         self._resolver = RouteResolver(self._controller, get_deployment_handle)
+        self._metrics = serve_metrics()
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -160,6 +171,9 @@ class ProxyActor:
                     self.close_connection = True
 
             def _handle(self, body: bytes):
+                route = _route(self.path)
+                t0 = time.time()
+                code = 200
                 try:
                     # model-multiplexed routing (reference: the
                     # serve_multiplexed_model_id request header)
@@ -175,9 +189,31 @@ class ProxyActor:
                     result = proxy._dispatch(self.path, body, mux_id)
                     self._send(200, json.dumps(result, default=str).encode())
                 except KeyError:
+                    code = 404
+                    # Unmatched paths share ONE label value: the raw path
+                    # is client-controlled, and per-path series from a
+                    # scanner would grow the registry without bound.
+                    route = "_unmatched"
                     self._send(404, b'{"error": "no such route"}')
+                except (BrokenPipeError, ConnectionResetError):
+                    # Client went away mid-response — not a server error;
+                    # label with nginx's 499 so aborts don't masquerade
+                    # as 500-rate on the dashboard. No response attempt:
+                    # the socket is dead.
+                    code = 499
+                    self.close_connection = True
                 except Exception as e:  # noqa: BLE001 — user errors → 500
+                    code = 500
                     self._send(500, json.dumps({"error": str(e)}).encode())
+                finally:
+                    # Streaming responses are timed through here too: the
+                    # try block returns only after the stream drained.
+                    proxy._metrics.proxy_requests.inc(
+                        1, {"route": route, "code": str(code)}
+                    )
+                    proxy._metrics.proxy_ms.observe(
+                        (time.time() - t0) * 1000.0, {"route": route}
+                    )
 
         self._server = ThreadingHTTPServer(("127.0.0.1", http_port), Handler)
         self._port = self._server.server_address[1]
@@ -195,16 +231,24 @@ class ProxyActor:
         return handle, payload
 
     def _dispatch(self, path: str, body: bytes, mux_id: str = ""):
-        handle, payload = self._resolve(path, body)
-        if mux_id:
-            handle = handle.options(multiplexed_model_id=mux_id)
-        return RouteResolver.call(handle, payload)
+        from ray_tpu.util import tracing
+
+        with tracing.start_span(f"proxy:{_route(path)}"):
+            handle, payload = self._resolve(path, body)
+            if mux_id:
+                handle = handle.options(multiplexed_model_id=mux_id)
+            return RouteResolver.call(handle, payload)
 
     def _dispatch_stream(self, path: str, body: bytes, mux_id: str = ""):
-        handle, payload = self._resolve(path, body)
-        if mux_id:
-            handle = handle.options(multiplexed_model_id=mux_id)
-        return RouteResolver.stream(handle, payload)
+        from ray_tpu.util import tracing
+
+        # The span covers resolution + submission; the stream itself is
+        # timed by _handle (proxy_ms) and the replica-side span.
+        with tracing.start_span(f"proxy:{_route(path)}", {"stream": True}):
+            handle, payload = self._resolve(path, body)
+            if mux_id:
+                handle = handle.options(multiplexed_model_id=mux_id)
+            return RouteResolver.stream(handle, payload)
 
     def port(self) -> int:
         return self._port

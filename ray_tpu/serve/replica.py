@@ -1,9 +1,15 @@
 """Replica actor: hosts one instance of a deployment's user class.
 
 Reference: python/ray/serve/_private/replica.py:231 (ReplicaActor) — user
-callable construction, request dispatch by method name, health checks.
+callable construction, request dispatch by method name, health checks —
+plus its request-path metrics (serve_deployment_processing_latency_ms
+etc.): every request records queue-wait/e2e (and TTFT/TPOT for streaming)
+histograms tagged {deployment, replica}, and executes under a span nested
+in the caller's propagated trace context.
 """
 from __future__ import annotations
+
+import time
 
 import ray_tpu
 from ray_tpu.utils.serialization import deserialize_function
@@ -12,7 +18,23 @@ from ray_tpu.utils.serialization import deserialize_function
 @ray_tpu.remote
 class Replica:
     def __init__(self, deployment_name: str, cls_blob: bytes, init_args: tuple, init_kwargs: dict):
+        from ray_tpu.serve.metrics import serve_metrics, set_replica_context
+        from ray_tpu.util import tracing
+
+        tracing.maybe_enable_from_env()
         self.deployment_name = deployment_name
+        try:
+            from ray_tpu.runtime_context import get_runtime_context
+
+            aid = get_runtime_context().get_actor_id()
+            self.replica_tag = (aid or "")[:8] or "unknown"
+        except Exception:  # noqa: BLE001 — identity is a metric tag only
+            self.replica_tag = "unknown"
+        self._tags = {"deployment": deployment_name, "replica": self.replica_tag}
+        self._metrics = serve_metrics()
+        # Ambient identity: anything the user instance constructs in
+        # __init__ (LLMEngine, batch queues) inherits these tags.
+        set_replica_context(deployment_name, self.replica_tag)
         target = deserialize_function(cls_blob)
         if isinstance(target, type):
             self.instance = target(*init_args, **init_kwargs)
@@ -39,39 +61,103 @@ class Replica:
         except Exception:  # noqa: BLE001 — routing hint only
             pass
 
+    def _start_request(self, request_meta, method_name: str):
+        """Record queue wait; return (submit_ts, span attributes)."""
+        now = time.time()
+        submit = (request_meta or {}).get("submit_ts", now)
+        self._metrics.queue_ms.observe(max(0.0, now - submit) * 1000.0, self._tags)
+        return submit, {
+            "deployment": self.deployment_name,
+            "replica": self.replica_tag,
+            "method": method_name,
+        }
+
     def handle_request(self, method_name: str, args: tuple, kwargs: dict,
-                       multiplexed_model_id: str = ""):
+                       multiplexed_model_id: str = "", request_meta: dict = None):
         from ray_tpu.serve.multiplex import _set_current_model_id
+        from ray_tpu.util import tracing
 
         _set_current_model_id(multiplexed_model_id)
-        if method_name == "__call__":
-            return self.instance(*args, **kwargs)
-        return getattr(self.instance, method_name)(*args, **kwargs)
+        submit, attrs = self._start_request(request_meta, method_name)
+        outcome = "ok"
+        try:
+            with tracing.start_span(
+                f"replica:{self.deployment_name}.{method_name}", attrs
+            ):
+                if method_name == "__call__":
+                    return self.instance(*args, **kwargs)
+                return getattr(self.instance, method_name)(*args, **kwargs)
+        except BaseException:
+            outcome = "error"
+            raise
+        finally:
+            # max(0, ·): submit_ts is the caller host's clock — skew must
+            # not feed negative samples into the histograms.
+            self._metrics.e2e_ms.observe(
+                max(0.0, time.time() - submit) * 1000.0, self._tags
+            )
+            self._metrics.requests.inc(1, {**self._tags, "outcome": outcome})
 
     def handle_request_stream(self, method_name: str, args: tuple, kwargs: dict,
-                              multiplexed_model_id: str = ""):
+                              multiplexed_model_id: str = "", request_meta: dict = None):
         """Generator deployments: each yielded item becomes its own
         streamed object (reference: replica.py streaming request path —
         token streaming for LLM serving). Invoke with
-        ``num_returns="streaming"``."""
+        ``num_returns="streaming"``. First-item / inter-item timings feed
+        the TTFT / TPOT SLO histograms."""
         import inspect
 
         from ray_tpu.serve.multiplex import _set_current_model_id
+        from ray_tpu.util import tracing
 
         _set_current_model_id(multiplexed_model_id)
+        submit, attrs = self._start_request(request_meta, method_name)
         target = (
             self.instance if method_name == "__call__" else getattr(self.instance, method_name)
         )
-        result = target(*args, **kwargs)
-        # Only genuine generators/iterators stream element-wise; plain
-        # containers (list/tuple/dict/str) are ONE response — the same
-        # value the non-streaming path would return.
-        if inspect.isgenerator(result) or (
-            hasattr(result, "__next__") and not isinstance(result, (str, bytes))
-        ):
-            yield from result
-            return
-        yield result
+        first_ts = last_ts = None
+        items = 0
+        outcome = "ok"
+        try:
+            with tracing.start_span(
+                f"replica:{self.deployment_name}.{method_name}", attrs
+            ):
+                result = target(*args, **kwargs)
+                # Only genuine generators/iterators stream element-wise;
+                # plain containers (list/tuple/dict/str) are ONE response —
+                # the same value the non-streaming path would return.
+                if not (
+                    inspect.isgenerator(result)
+                    or (hasattr(result, "__next__") and not isinstance(result, (str, bytes)))
+                ):
+                    result = iter((result,))
+                for item in result:
+                    now = time.time()
+                    if first_ts is None:
+                        first_ts = now
+                        self._metrics.ttft_ms.observe(
+                            max(0.0, now - submit) * 1000.0, self._tags
+                        )
+                    last_ts = now
+                    items += 1
+                    yield item
+        except GeneratorExit:
+            outcome = "cancelled"
+            raise
+        except BaseException:
+            outcome = "error"
+            raise
+        finally:
+            self._metrics.e2e_ms.observe(
+                max(0.0, time.time() - submit) * 1000.0, self._tags
+            )
+            if items > 1:
+                self._metrics.tpot_ms.observe(
+                    (last_ts - first_ts) * 1000.0 / (items - 1), self._tags
+                )
+            if items:
+                self._metrics.tokens_out.inc(items, self._tags)
+            self._metrics.requests.inc(1, {**self._tags, "outcome": outcome})
 
     def get_loaded_model_ids(self):
         from ray_tpu.serve.multiplex import loaded_model_ids

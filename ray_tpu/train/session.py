@@ -12,16 +12,109 @@ TrainingIterator).
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import queue
 import shutil
 import threading
+import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Dict, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
 
 _session: Optional["_TrainSession"] = None
+
+# ---------------------------------------------------------------------------
+# Step telemetry (reference: the reference's train ProgressTracker /
+# per-worker metrics; here histograms in the app-metric registry tagged
+# {run, rank} so the Grafana train row gets quantile panels for free).
+# ---------------------------------------------------------------------------
+_STEP_MS_BOUNDARIES = (
+    1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 15000, 60000, 300000,
+)
+_metrics_lock = threading.Lock()
+_train_metrics = None
+_phase_hists: Dict[str, object] = {}
+
+
+class _TrainMetrics:
+    def __init__(self):
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        rr = ("run", "rank")
+        self.step_wall_ms = Histogram(
+            "train_step_wall_ms",
+            "Wall time between consecutive train.report() calls (one step)",
+            _STEP_MS_BOUNDARIES, rr,
+        )
+        self.report_ms = Histogram(
+            "train_report_ms",
+            "Time inside train.report(): rank barrier + checkpoint persist + "
+            "driver queue",
+            _STEP_MS_BOUNDARIES, rr,
+        )
+        self.reports = Counter(
+            "train_reports_total", "train.report() calls (steps reported)", rr
+        )
+        self.steps_per_s = Gauge(
+            "train_steps_per_s", "Reported-step throughput per worker", rr
+        )
+        self.driver_wait_ms = Histogram(
+            "train_driver_wait_ms",
+            "Driver time blocked waiting for the next rank-0 result",
+            _STEP_MS_BOUNDARIES, ("run",),
+        )
+
+
+def train_metrics() -> _TrainMetrics:
+    global _train_metrics
+    if _train_metrics is None:
+        with _metrics_lock:
+            if _train_metrics is None:
+                _train_metrics = _TrainMetrics()
+    return _train_metrics
+
+
+def _ctx_tags(ctx: "TrainContext") -> Dict[str, str]:
+    return {"run": ctx.experiment_name, "rank": str(ctx.world_rank)}
+
+
+def _session_tags() -> Dict[str, str]:
+    if _session is None:
+        return {"run": "_no_session", "rank": "-"}
+    return _ctx_tags(_session.ctx)
+
+
+def _phase_histogram(phase: str):
+    """One histogram per timed phase (``train_step_<phase>_ms``),
+    registered on first use — e.g. data_wait / compile."""
+    with _metrics_lock:
+        h = _phase_hists.get(phase)
+        if h is None:
+            from ray_tpu.util.metrics import Histogram
+
+            h = _phase_hists[phase] = Histogram(
+                f"train_step_{phase}_ms",
+                f"Time attributed to the '{phase}' phase of a train step",
+                _STEP_MS_BOUNDARIES, ("run", "rank"),
+            )
+        return h
+
+
+@contextlib.contextmanager
+def timed(phase: str):
+    """Attribute a chunk of the current step to ``phase`` — e.g.
+    ``with train.timed("data_wait"): batch = next(it)`` or
+    ``with train.timed("compile"): step_fn = jax.jit(...).lower(...).compile()``.
+    Records ``train_step_<phase>_ms`` tagged {run, rank}."""
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        _phase_histogram(phase).observe(
+            (time.monotonic() - t0) * 1000.0, _session_tags()
+        )
 
 
 @dataclass
@@ -58,11 +151,21 @@ class _TrainSession:
         self.latest_checkpoint = latest_checkpoint
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
+        # Step-timing marks: wall time between report() calls is the
+        # step; time inside report() (barrier + persist + queue) is
+        # accounted separately so sync overhead is visible on its own.
+        self._step_start = time.monotonic()
+        self._first_report = self._step_start
 
     # -- worker-side API --------------------------------------------------
     def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
         from ray_tpu import collective
 
+        t_report = time.monotonic()
+        m = train_metrics()
+        tags = _ctx_tags(self.ctx)
+        m.step_wall_ms.observe((t_report - self._step_start) * 1000.0, tags)
+        m.reports.inc(1, tags)
         persisted = None
         if checkpoint is not None:
             from ray_tpu.utils import cloudfs
@@ -99,6 +202,12 @@ class _TrainSession:
                 "ckpt_index": self.ckpt_seq - 1,
             }
         )
+        now = time.monotonic()
+        m.report_ms.observe((now - t_report) * 1000.0, tags)
+        elapsed = now - self._first_report
+        if elapsed > 0:
+            m.steps_per_s.set(self.ckpt_seq / elapsed, tags)
+        self._step_start = now
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return Checkpoint(self.latest_checkpoint) if self.latest_checkpoint else None

@@ -16,6 +16,7 @@ which is exactly what FailureConfig.max_failures drives here.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -80,9 +81,10 @@ class DataParallelTrainer:
             manager.register(self._resume_from, {}, -1)
 
         failure_cfg: FailureConfig = self.run_config.failure_config
+        experiment_name = self.run_config.name or "train_run"
         executor = BackendExecutor(
             self.scaling_config,
-            experiment_name=self.run_config.name or "train_run",
+            experiment_name=experiment_name,
             storage_path=storage,
             max_failures=failure_cfg.max_failures,
         )
@@ -96,9 +98,17 @@ class DataParallelTrainer:
                 latest = manager.latest.checkpoint.path if manager.latest else None
                 executor.setup_sessions(latest)
                 run_refs = executor.start_training(self._train_fn, self._config)
+                from ray_tpu.train.session import train_metrics
+
+                tmetrics = train_metrics()
+                run_tag = {"run": experiment_name}
                 try:
                     while True:
+                        t_wait = time.monotonic()
                         results = executor.next_results(run_refs)
+                        tmetrics.driver_wait_ms.observe(
+                            (time.monotonic() - t_wait) * 1000.0, run_tag
+                        )
                         if results is None:
                             break
                         rank0 = results[0]

@@ -75,6 +75,15 @@ class TrainWorker:
             session.error = e
             session.finished.set()
             raise
+        finally:
+            # The executor kills this actor soon after the loop returns;
+            # push the final step-metric deltas out before that.
+            try:
+                from ray_tpu.util.metrics import flush
+
+                flush()
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
         session.finished.set()
         return True
 

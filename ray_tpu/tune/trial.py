@@ -158,6 +158,12 @@ class TrialRunner:
         trial's finished session as a zero-iteration success."""
         self._session = None
         if self._thread.is_alive():
+            # The previous fn may still be inside its last instants (the
+            # controller observed the final result before the thread's
+            # finally block ran) — give it a bounded grace instead of
+            # poisoning the actor for a benign exit race.
+            self._thread.join(timeout=5)
+        if self._thread.is_alive():
             raise RuntimeError("reset() while the previous trial fn is still running")
         self._setup(config, local_dir, restored_checkpoint, remote_dir)
         return True

@@ -41,6 +41,39 @@ def _target(expr: str, legend: str) -> dict:
     return {"expr": expr, "legendFormat": legend, "datasource": DATASOURCE}
 
 
+def _row_panel(panel_id: int, title: str, y: int) -> dict:
+    """A Grafana row separator (reference: the fixed serve/train rows of
+    the reference's default dashboards)."""
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "row",
+        "collapsed": False,
+        "datasource": DATASOURCE,
+        "gridPos": {"h": 1, "w": 24, "x": 0, "y": y},
+        "panels": [],
+    }
+
+
+# Dashboard rows, matched by metric-name prefix in order; unmatched
+# metrics land in the catch-all Application row.
+ROWS = (
+    ("Serve SLO", ("serve_request_", "serve_ttft", "serve_tpot", "serve_e2e",
+                   "serve_tokens_", "serve_requests_", "serve_proxy_",
+                   "serve_batch_")),
+    ("Serve Engine", ("serve_engine_",)),
+    ("Train", ("train_",)),
+    ("Application", ("",)),
+)
+
+
+def _row_for(name: str) -> str:
+    for title, prefixes in ROWS:
+        if any(name.startswith(p) for p in prefixes):
+            return title
+    return "Application"
+
+
 def panels_for_metric(name: str, mtype: str, description: str = "") -> List[dict]:
     """Prometheus queries per metric type (panel positions filled later)."""
     if mtype == "counter":
@@ -74,17 +107,33 @@ def generate_dashboard(
         from ray_tpu.core.api import _require_worker
 
         snapshot = _require_worker()._call("metrics_snapshot")
-    specs: List[dict] = []
+    # Group panel specs into dashboard rows (Serve SLO / Serve Engine /
+    # Train / Application) so the serving and training stories read as
+    # units instead of one alphabetical wall.
+    by_row: Dict[str, List[dict]] = {}
     for name in sorted(snapshot):
         e = snapshot[name]
-        specs.extend(panels_for_metric(name, e.get("type", "gauge"),
-                                       e.get("description", "")))
+        by_row.setdefault(_row_for(name), []).extend(
+            panels_for_metric(name, e.get("type", "gauge"),
+                              e.get("description", ""))
+        )
     panels = []
-    for i, spec in enumerate(specs):
-        x = (i % 2) * 12
-        y = (i // 2) * 8
-        panels.append(_panel(i + 1, spec["title"], spec["targets"], y, x,
-                             spec.get("description", "")))
+    pid = 1
+    y = 0
+    for title, _prefixes in ROWS:
+        specs = by_row.get(title)
+        if not specs:
+            continue
+        panels.append(_row_panel(pid, title, y))
+        pid += 1
+        y += 1
+        for i, spec in enumerate(specs):
+            x = (i % 2) * 12
+            panels.append(_panel(pid, spec["title"], spec["targets"],
+                                 y + (i // 2) * 8, x,
+                                 spec.get("description", "")))
+            pid += 1
+        y += -(-len(specs) // 2) * 8
     return {
         "uid": uid,
         "title": title,

@@ -111,6 +111,61 @@ def summarize_objects() -> dict:
     }
 
 
+def serve_state() -> dict:
+    """Raw engine flight-recorder snapshots, keyed
+    ``deployment/replica/engine`` (pushed by LLM engines ~1/s; also at
+    ``GET /api/serve/engine`` on the dashboard gateway)."""
+    return _require_worker()._call("serve_state")
+
+
+def summarize_serve() -> dict:
+    """Per-deployment serving summary from the engine flight recorders:
+    occupancy, token/preemption totals, and p50/p95/p99 latency
+    breakdowns (queue/TTFT/TPOT/e2e) over the recent-request rings —
+    percentiles without a Prometheus scrape (reference:
+    ``summarize_*`` in api.py + the serve dashboard's replica detail).
+    """
+    from ray_tpu.serve.metrics import summarize_latencies
+
+    out: dict = {}
+    pooled: dict = {}
+    for key, snap in serve_state().items():
+        dep = snap.get("tags", {}).get("deployment", key.split("/")[0])
+        d = out.setdefault(
+            dep,
+            {
+                "engines": 0,
+                "active": 0,
+                "waiting": 0,
+                "kv_blocks_free": 0,
+                "kv_blocks_total": 0,
+                "tokens": 0,
+                "prompt_tokens": 0,
+                "preemptions": 0,
+                "finished_requests": 0,
+            },
+        )
+        occ = snap.get("occupancy", {})
+        stats = snap.get("stats", {})
+        d["engines"] += 1
+        d["active"] += occ.get("active", 0)
+        d["waiting"] += occ.get("waiting", 0)
+        d["kv_blocks_free"] += occ.get("kv_blocks_free", 0)
+        d["kv_blocks_total"] += occ.get("kv_blocks_total", 0)
+        d["tokens"] += stats.get("tokens", 0)
+        d["prompt_tokens"] += stats.get("prompt_tokens", 0)
+        d["preemptions"] += stats.get("preemptions", 0)
+        d["finished_requests"] += stats.get("finished", 0)
+        pool = pooled.setdefault(dep, {})
+        for rec in snap.get("recent_requests", ()):
+            for field in ("queue_ms", "ttft_ms", "tpot_ms", "e2e_ms"):
+                if rec.get(field) is not None:
+                    pool.setdefault(field, []).append(rec[field])
+    for dep, pool in pooled.items():
+        out[dep]["latency_ms"] = summarize_latencies(pool)
+    return out
+
+
 def summarize_data() -> list:
     """Per-operator stats of this process's most recent Dataset execution
     (reference: the dashboard data module's per-op metrics)."""

@@ -29,6 +29,17 @@ _sink_path: Optional[str] = None
 _sink_lock = threading.Lock()
 
 TRACE_CTX_KEY = "__trace_ctx__"
+TRACE_ENV_VAR = "RAY_TPU_TRACE"
+
+
+def maybe_enable_from_env() -> bool:
+    """Enable tracing when ``RAY_TPU_TRACE`` is set — how long-lived
+    system actors (serve proxy/replicas) opt in without a driver-side
+    call reaching their process. The env var propagates driver → node
+    agent → worker with the rest of the cluster env."""
+    if not _enabled and os.environ.get(TRACE_ENV_VAR, "").lower() in ("1", "true", "on"):
+        enable_tracing(os.environ.get("RAY_TPU_SESSION_DIR") or None)
+    return _enabled
 
 
 def enable_tracing(session_dir: Optional[str] = None):
@@ -45,6 +56,13 @@ def enable_tracing(session_dir: Optional[str] = None):
     _sink_path = os.path.join(logs, f"spans-{os.getpid()}.jsonl")
 
 
+def disable_tracing():
+    """Stop span recording in this process (tests)."""
+    global _enabled, _sink_path
+    _enabled = False
+    _sink_path = None
+
+
 def tracing_enabled() -> bool:
     return _enabled
 
@@ -52,9 +70,16 @@ def tracing_enabled() -> bool:
 def _write(rec: Dict[str, Any]):
     if _sink_path is None:
         return
-    with _sink_lock:
-        with open(_sink_path, "a", encoding="utf-8") as f:
-            f.write(json.dumps(rec) + "\n")
+    try:
+        with _sink_lock:
+            with open(_sink_path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(rec) + "\n")
+    except (OSError, ValueError):
+        # Telemetry must never take down the traced path: a full disk or
+        # removed session dir silently drops spans (the sink is
+        # best-effort by design; spans also close inside engine pump
+        # threads and request finally blocks).
+        pass
 
 
 def current_context() -> Optional[Dict[str, str]]:
@@ -130,6 +155,41 @@ def start_span(name: str, attributes: Optional[Dict[str, Any]] = None):
             }
         )
         _state.span = parent
+
+
+def record_span(
+    name: str,
+    start_ts: float,
+    end_ts: float,
+    ctx: Optional[Dict[str, str]] = None,
+    attributes: Optional[Dict[str, Any]] = None,
+):
+    """Write one completed span explicitly parented under ``ctx`` (a
+    ``current_context()`` capture). For cross-thread work — e.g. the LLM
+    engine's pump thread finishing a request submitted from a replica
+    handler thread — where the ambient thread-local parent can't flow."""
+    if not _enabled:
+        return
+    span_id = uuid.uuid4().hex[:16]
+    trace_id = ctx["trace_id"] if ctx else uuid.uuid4().hex[:16]
+    parent_id = ctx["parent_id"] if ctx else None
+    _write(
+        {
+            "name": name,
+            "cat": "span",
+            "ph": "X",
+            "ts": start_ts * 1e6,
+            "dur": max(0.0, end_ts - start_ts) * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % 100000,
+            "args": {
+                **(attributes or {}),
+                "trace_id": trace_id,
+                "span_id": span_id,
+                "parent_id": parent_id,
+            },
+        }
+    )
 
 
 def trace_span(name: Optional[str] = None):

@@ -93,3 +93,60 @@ def test_prepare_data_loader_sharding(ray_start_regular):
 
     result = TorchTrainer(loop, scaling_config=ScalingConfig(num_workers=2)).fit()
     assert result.error is None and result.metrics["ok"] == 1
+
+
+def test_train_step_metrics(ray_start_regular, tmp_path):
+    """Step telemetry: train.report() feeds train_step_wall_ms /
+    train_report_ms / train_reports_total tagged {run, rank}, and
+    train.timed('data_wait') attributes a phase; the trainer driver
+    records train_driver_wait_ms."""
+    import time as _time
+
+    from ray_tpu.train import DataParallelTrainer, RunConfig, timed
+    from ray_tpu.util import state as state_api
+
+    def loop():
+        for _ in range(3):
+            with timed("data_wait"):
+                _time.sleep(0.01)
+            report({"ok": 1})
+
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="timing_run", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+
+    def _series(name, run):
+        snap = state_api.metrics_snapshot()
+        if name not in snap:
+            return {}
+        return {
+            tuple(map(tuple, k)): v
+            for k, v in snap[name]["series"]
+            if dict(k).get("run") == run
+        }
+
+    def _have():
+        return all(
+            _series(n, "timing_run")
+            for n in ("train_step_wall_ms", "train_step_data_wait_ms",
+                      "train_report_ms", "train_reports_total",
+                      "train_driver_wait_ms")
+        )
+
+    deadline = _time.monotonic() + 12
+    while _time.monotonic() < deadline and not _have():
+        _time.sleep(0.2)
+    assert _have(), sorted(state_api.metrics_snapshot())
+
+    wall = _series("train_step_wall_ms", "timing_run")
+    assert {dict(k)["rank"] for k in wall} == {"0", "1"}
+    assert all(v["state"][-1] == 3 for v in wall.values())  # 3 steps/rank
+    # wall time covers at least the slept data-wait portion
+    assert all(v["state"][-2] >= 30 for v in wall.values())
+    reports = _series("train_reports_total", "timing_run")
+    assert sum(reports.values()) == 6
+    dw = _series("train_step_data_wait_ms", "timing_run")
+    assert all(v["state"][-1] == 3 and v["state"][-2] >= 30 for v in dw.values())
