@@ -273,6 +273,100 @@ def prefill_and_sample(
     return tok, cache
 
 
+def _attend_chunk(q, ck, cv, qpos, cfg: TransformerConfig):
+    """q: [C, H, HD] chunk queries; ck/cv: [m, KV, HD] the slot's gathered
+    block view (prefix + this chunk, post-scatter); qpos: [C] absolute
+    positions — attend over cache positions <= qpos (causal, prefix
+    inclusive). Same f32 einsum/softmax math as ``_attend_paged``."""
+    C, H, HD = q.shape
+    KV = cfg.n_kv_heads
+    G = H // KV
+    qg = q.reshape(C, KV, G, HD)
+    scores = jnp.einsum(
+        "ckgd,mkd->ckgm", qg.astype(jnp.float32), ck.astype(jnp.float32)
+    ) * (HD**-0.5)
+    m = ck.shape[0]
+    valid = jnp.arange(m)[None, :] <= qpos[:, None]  # [C, m]
+    scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    og = jnp.einsum("ckgm,mkd->ckgd", probs, cv.astype(jnp.float32))
+    return og.reshape(C, H * HD).astype(q.dtype)
+
+
+def paged_prefill_chunk(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jax.Array,  # [1, C] int32, C a multiple of block_size (padded)
+    cache: PagedCache,
+    table_row: jax.Array,  # [W] int32 — the slot's FULL block table
+    chunk_row: jax.Array,  # [C // block_size] int32 — blocks receiving this chunk
+    block_size: int,
+    start: jax.Array,  # scalar int32 — absolute position of tokens[0, 0]
+) -> Tuple[jax.Array, PagedCache]:
+    """Prefill positions ``start .. start+C-1`` of ONE slot, attending to
+    the slot's already-resident KV blocks (prefix-cache hits or earlier
+    chunks) plus the chunk itself.
+
+    This is the suffix/chunked counterpart of ``paged_prefill``: instead
+    of full attention over the whole prompt it scatters the chunk's K/V
+    into ``chunk_row`` and attends through the gathered ``table_row``
+    view under a causal position mask — so a prompt whose prefix is
+    already in the cache only pays compute for the novel suffix.
+    ``start`` is traced: one compilation per chunk width C serves every
+    chunk position. Returns (logits [C, V] fp32, cache')."""
+    b, C = tokens.shape
+    assert b == 1 and C % block_size == 0
+    W = table_row.shape[0]
+    KV, HD = cfg.n_kv_heads, cfg.head_dim
+    nb = C // block_size
+    positions = start + jnp.arange(C, dtype=jnp.int32)[None, :]  # [1, C]
+    x = embed(params, tokens, cfg)
+    L = cfg.n_layers
+
+    def body(carry, xs):
+        x, ck_all, cv_all = carry
+        lp, i = xs
+        ck = jax.lax.dynamic_index_in_dim(ck_all, i, 0, keepdims=False)
+        cv = jax.lax.dynamic_index_in_dim(cv_all, i, 0, keepdims=False)
+        h = rms_norm(x, lp["attn_norm"])
+        q, k, v = project_qkv(h, lp, cfg, positions)
+        # Scatter the chunk's K/V block-rows into the pool (padded tail
+        # rows point at the trash block via chunk_row).
+        ck = ck.at[chunk_row].set(k[0].reshape(nb, block_size, KV, HD))
+        cv = cv.at[chunk_row].set(v[0].reshape(nb, block_size, KV, HD))
+        ck_g = ck[table_row].reshape(W * block_size, KV, HD)
+        cv_g = cv[table_row].reshape(W * block_size, KV, HD)
+        o = _attend_chunk(q[0], ck_g, cv_g, positions[0], cfg)
+        x = x + (o @ lp["wo"].astype(o.dtype))[None]
+        x = mlp_block(x, lp, cfg)
+        ck_all = jax.lax.dynamic_update_index_in_dim(ck_all, ck, i, 0)
+        cv_all = jax.lax.dynamic_update_index_in_dim(cv_all, cv, i, 0)
+        return (x, ck_all, cv_all), None
+
+    (x, ks, vs), _ = jax.lax.scan(
+        body, (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(L, dtype=jnp.int32)),
+    )
+    logits = unembed(params, x, cfg)[0]
+    return logits, {"k": ks, "v": vs}
+
+
+def prefill_chunk_and_sample(
+    params, cfg: TransformerConfig, tokens, cache, table_row, chunk_row,
+    block_size: int, start, last_idx, temp, key,
+):
+    """Chunk prefill + on-device sampling at ``last_idx`` (chunk-relative
+    position of the prompt's final token, clamped by the caller). The
+    sampled token is only meaningful on the prompt's FINAL chunk; earlier
+    chunks never fetch it, so the extra sample costs no host sync."""
+    logits, cache = paged_prefill_chunk(
+        params, cfg, tokens, cache, table_row, chunk_row, block_size, start
+    )
+    last = jax.lax.dynamic_index_in_dim(logits, last_idx, axis=0, keepdims=False)
+    tok = sample_tokens(last[None, :], temp[None], key)[0]
+    return tok, cache
+
+
 def make_jitted(cfg: TransformerConfig, decode_window: int = 1):
     """Compile the decode window and prefill. ``params`` is a RUNTIME
     argument, never closed over — closing over it would capture the

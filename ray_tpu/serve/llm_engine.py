@@ -9,12 +9,28 @@ program continuously serves an evolving request mix.
 
 Host/device split:
 - Device (``ray_tpu/models/paged.py``): one jitted decode step over all
-  ``max_batch`` slots; one jitted prefill per prompt bucket. Sampling is
+  ``max_batch`` slots; one jitted prefill per prompt bucket; one chunk
+  program (suffix prefill attending to resident blocks). Sampling is
   on-device; a step moves only ``[b]`` int32 tokens back.
 - Host (this module): block free-list, slot assignment, preemption
   (victim's blocks are freed and the request re-queued with its
   generated prefix folded into the prompt — recompute-on-resume, the
   vLLM default), per-request streaming queues.
+
+Iteration-level perf suite (all opt-in, see ``__init__``):
+- **Prefix-aware KV reuse** (``enable_prefix_cache``): full prompt
+  blocks are published to a refcounted exact-match index at prefill
+  time and kept resident after release (LRU eviction on allocation
+  pressure); requests sharing a prefix map resident blocks into their
+  table and prefill only the novel suffix.
+- **Chunked prefill** (``prefill_chunk``): long prompts advance one
+  fixed-size chunk per scheduler step, interleaved with decode windows,
+  so an admission no longer head-of-line-blocks active streams.
+- **Host/device overlap** (``overlap``): window N+1 is dispatched from
+  window N's device-resident outputs before N's tokens are read; the
+  host consumes/schedules while the device keeps stepping. Decode
+  inputs live on device and only scheduler-dirtied arrays are re-shipped
+  (``_ship``).
 
 Threading: ``step()`` is single-threaded; ``start()`` runs it in a pump
 thread so serve replicas can stream from concurrent handler threads
@@ -40,6 +56,7 @@ from ray_tpu.models.paged import (
     init_paged_cache,
     paged_decode_loop,
     prefill_and_sample,
+    prefill_chunk_and_sample,
 )
 from ray_tpu.models.transformer import TransformerConfig
 
@@ -151,6 +168,132 @@ class _BlockAllocator:
         return len(self.free)
 
 
+class _PrefixCache:
+    """Refcounted index over prefill-resident KV blocks (vLLM automatic
+    prefix caching, re-done for this engine's allocator).
+
+    Each FULL prompt block is keyed by ``(parent_block_id, block_tokens)``
+    — an exact-match chain, so a hit can never alias a different prefix
+    (no hash collisions; the parent link makes position implicit). Blocks
+    referenced by live slots are pinned (refs > 0); released blocks stay
+    RESIDENT in an LRU of refcount-0 blocks and are only returned to the
+    allocator when an allocation actually needs them (eviction cascades
+    to cached descendants, since a re-used parent id must never re-link
+    a stale child chain).
+    """
+
+    ROOT = -1  # parent id for the first block of every prompt
+
+    def __init__(self):
+        # (parent_bid, tokens) -> bid; bid -> [key, parent, refs]
+        self.table: Dict[tuple, int] = {}
+        self.meta: Dict[int, list] = {}
+        self.children: Dict[int, set] = {}
+        # refcount-0 residents, coldest first (re-warmed on hit/release).
+        self.lru: "collections.OrderedDict[int, None]" = collections.OrderedDict()
+
+    @property
+    def resident_blocks(self) -> int:
+        return len(self.meta)
+
+    @property
+    def evictable_blocks(self) -> int:
+        return len(self.lru)
+
+    def match(self, tokens: Sequence[int], bs: int, limit: int) -> List[int]:
+        """Longest cached chain of full blocks covering ``tokens`` (read
+        only — no refcount change), capped at ``limit`` blocks so the
+        caller always keeps >= 1 suffix token to prefill (the engine
+        needs last-position logits to sample the first output token)."""
+        bids: List[int] = []
+        parent = self.ROOT
+        for j in range(limit):
+            bid = self.table.get((parent, tuple(tokens[j * bs:(j + 1) * bs])))
+            if bid is None:
+                break
+            bids.append(bid)
+            parent = bid
+        return bids
+
+    def incref(self, bid: int):
+        m = self.meta[bid]
+        m[2] += 1
+        if m[2] == 1:
+            self.lru.pop(bid, None)  # pinned — no longer evictable
+
+    def release(self, bid: int) -> bool:
+        """Drop one reference; returns False if the block isn't cache-
+        managed (caller then frees it to the allocator). A block hitting
+        refcount 0 stays resident as the WARMEST eviction candidate."""
+        m = self.meta.get(bid)
+        if m is None:
+            return False
+        m[2] -= 1
+        if m[2] == 0:
+            self.lru[bid] = None
+        return True
+
+    def register(self, parent: int, toks: tuple, bid: int) -> int:
+        """Publish ``bid`` for (parent, toks) with one reference held by
+        the registering slot; returns the canonical bid (the existing one
+        on a concurrent-duplicate insert, in which case the caller's own
+        block stays private)."""
+        key = (parent, toks)
+        cur = self.table.get(key)
+        if cur is not None:
+            return cur
+        self.table[key] = bid
+        self.meta[bid] = [key, parent, 1]
+        self.children.setdefault(parent, set()).add(bid)
+        return bid
+
+    def evict_lru(self) -> List[int]:
+        """Evict the coldest refcount-0 block plus its cached descendants
+        (a reused parent id must never re-link a stale child chain);
+        returns the FREED block ids (empty if nothing is evictable).
+
+        A descendant with refs > 0 is possible: a request that registered
+        a novel tail under a chain another request published first shares
+        CONTENT with that chain, not block ownership — its own table maps
+        private duplicates of the parents, so the parents can hit
+        refcount 0 while the child stays pinned. Such a child is
+        UNREGISTERED (its key would dangle off a reusable parent id) but
+        never freed here — its live slot still maps it and returns it to
+        the allocator on release."""
+        while self.lru:
+            bid, _ = self.lru.popitem(last=False)
+            if self.meta.get(bid, [None, None, -1])[2] != 0:
+                continue  # defensive: stale entry
+            freed: List[int] = []
+            stack = [bid]
+            while stack:
+                b = stack.pop()
+                m = self.meta.pop(b, None)
+                if m is None:
+                    continue
+                key, parent, refs = m
+                self.table.pop(key, None)
+                self.children.get(parent, set()).discard(b)
+                stack.extend(self.children.pop(b, ()))
+                self.lru.pop(b, None)
+                if refs == 0:
+                    freed.append(b)
+            return freed  # non-empty: the LRU root itself had refs == 0
+        return []
+
+
+@dataclasses.dataclass
+class _ChunkState:
+    """Progress of one slot's in-flight chunked prefill: positions
+    ``[0, pos)`` of ``tokens`` are KV-resident (cache hits + completed
+    chunks); the slot stays OUT of the decode set until pos == plen."""
+
+    req: Request
+    tokens: List[int]
+    pos: int  # next absolute position to prefill (block-aligned)
+    plen: int
+
+
 class LLMEngine:
     """Continuous-batching engine for one model on one chip/mesh."""
 
@@ -163,6 +306,10 @@ class LLMEngine:
         decode_window: int = 1,
         seed: int = 0,
         metrics_tags: Optional[Dict[str, str]] = None,
+        enable_prefix_cache: bool = False,
+        prefill_chunk: Optional[int] = None,
+        overlap: bool = False,
+        warmup_buckets: bool = False,
     ):
         """``params``: the model weights — either an array pytree, or a
         ZERO-ARG CALLABLE returning one. Prefer the callable for big
@@ -181,22 +328,73 @@ class LLMEngine:
 
         ``metrics_tags``: {deployment, replica} tags for this engine's
         metric series; defaults to the ambient serve replica context
-        (set by the Replica actor) or a standalone placeholder."""
+        (set by the Replica actor) or a standalone placeholder.
+
+        ``enable_prefix_cache``: keep refcounted prompt blocks resident
+        after release and map them into later requests sharing the same
+        prefix (system prompts, few-shot headers, preempt-resume), so
+        only the novel suffix is prefilled. LRU eviction of refcount-0
+        blocks replaces unconditional free.
+
+        ``prefill_chunk``: split prompts longer than this many tokens
+        into fixed-size chunks interleaved with decode windows, so one
+        long admission no longer freezes every active stream (bounds
+        TPOT). Rounded up to a block multiple; None/0 = single-shot
+        prefill (existing behavior).
+
+        ``overlap``: double-buffer decode — dispatch window N+1 from
+        window N's device-resident outputs BEFORE reading N's tokens, so
+        the host consumes/schedules while the device keeps stepping. The
+        capacity margin per request grows to 2*window-1 (a finishing
+        sequence can overshoot into one speculated window).
+
+        ``warmup_buckets``: compile every prefill bucket (and the chunk/
+        decode programs) at build time so first live requests don't pay
+        compilation on the serving path; wall time lands in
+        ``stats["warmup_s"]``."""
         self.cfg = cfg
         self.pcfg = pcfg or PagedConfig()
         p = self.pcfg
         self.window = max(1, int(decode_window))
+        self.overlap = bool(overlap)
+        if prefill_chunk:
+            # Chunks advance the block cursor: round to a block multiple.
+            prefill_chunk = -(-int(prefill_chunk) // p.block_size) * p.block_size
+            prefill_chunk = min(prefill_chunk, p.max_seq_len)
+        self.prefill_chunk = int(prefill_chunk or 0)
+        self.prefix_cache = _PrefixCache() if enable_prefix_cache else None
         self.cache = init_paged_cache(cfg, p)
-        self._decode, self._prefill, self.params = self._build_programs(params)
+        (self._decode, self._prefill, self._prefill_chunk_fn,
+         self.params) = self._build_programs(params)
         self.alloc = _BlockAllocator(p)
         self.key = jax.random.PRNGKey(seed)
-        # Slot state (host-side numpy; shipped to device each step).
+        # Slot state. Host-side numpy is the source of truth; the device
+        # keeps mirrors (``_dev``) that are re-uploaded ONLY when the
+        # scheduler dirtied them — steady-state decode re-ships nothing
+        # (cur/lens ride the decode program's own outputs).
         self.slots: List[Optional[Request]] = [None] * p.max_batch
         self.slot_blocks: List[List[int]] = [[] for _ in range(p.max_batch)]
+        # Bumped on every (re)assignment of a slot: an in-flight window's
+        # lane is only harvested if the slot STILL holds the same
+        # assignment (a preempted request re-admitted into the same slot
+        # would otherwise pass a bare request-identity check and receive
+        # the stale speculated window's tokens twice).
+        self._slot_gen = [0] * p.max_batch
         self.tables = np.full((p.max_batch, p.max_blocks_per_seq), TRASH_BLOCK, np.int32)
         self.lens = np.zeros(p.max_batch, np.int32)
         self.temps = np.zeros(p.max_batch, np.float32)
         self.cur = np.zeros(p.max_batch, np.int32)
+        self._dev: Dict[str, Optional[jax.Array]] = {
+            "tables": None, "lens": None, "temps": None, "cur": None,
+        }
+        self._dirty = {"tables", "lens", "temps", "cur"}
+        # In-flight speculated window: ([(slot, rid), ...], seq device
+        # array). Harvested (ONE host sync) at the top of the next step.
+        self._inflight: Optional[tuple] = None
+        # Slots mid-chunked-prefill (excluded from the decode set);
+        # _chunk_rr rotates which slot advances each step.
+        self._prefilling: Dict[int, _ChunkState] = {}
+        self._chunk_rr = -1
         self.waiting: "collections.deque[Request]" = collections.deque()
         # Prefill first-tokens awaiting ONE batched device→host transfer
         # (per-prefill int() syncs each pay a full link round-trip).
@@ -208,7 +406,13 @@ class LLMEngine:
         # Stats for tests/bench.
         self.stats = {"steps": 0, "tokens": 0, "max_active": 0, "preemptions": 0,
                       "prefills": 0, "admitted": 0, "prompt_tokens": 0,
-                      "finished": 0}
+                      "finished": 0, "prefill_chunks": 0, "spec_windows": 0,
+                      "h2d_ships": 0, "h2d_skips": 0, "prefix_hit_tokens": 0,
+                      "prefix_lookup_tokens": 0, "prefix_evictions": 0}
+        if warmup_buckets:
+            t0 = time.perf_counter()
+            self.stats["warmup_compiles"] = self._warmup()
+            self.stats["warmup_s"] = round(time.perf_counter() - t0, 3)
         # -- telemetry ---------------------------------------------------
         # Flight recorder: bounded rings appended on the scheduler thread.
         self.recorder = FlightRecorder()
@@ -255,13 +459,24 @@ class LLMEngine:
         bs = p.block_size
 
         def _decode(params, tokens, cache, tables, lens, temps, key):
-            return paged_decode_loop(
+            seq, cache = paged_decode_loop(
                 params, cfg, tokens, cache, tables, lens, temps, key, window
             )
+            # Also return next-window inputs (last sampled tokens, advanced
+            # lens) as DEVICE outputs: chained windows and speculative
+            # dispatch re-upload nothing from the host.
+            return seq, seq[-1], lens + window, cache
 
         def _prefill(params, tokens, cache, block_row, real_len, temp, key):
             return prefill_and_sample(
                 params, cfg, tokens, cache, block_row, bs, real_len, temp, key
+            )
+
+        def _chunk(params, tokens, cache, table_row, chunk_row, start, last_idx,
+                   temp, key):
+            return prefill_chunk_and_sample(
+                params, cfg, tokens, cache, table_row, chunk_row, bs, start,
+                last_idx, temp, key,
             )
 
         try:
@@ -302,13 +517,69 @@ class LLMEngine:
                 _prefill, donate_argnums=(2,),
                 in_shardings=(params_fmt, None, None, None, None, None, None),
             )
-            return compiled, prefill, params
+            chunk = jax.jit(
+                _chunk, donate_argnums=(2,),
+                in_shardings=(params_fmt,) + (None,) * 8,
+            )
+            return compiled, prefill, chunk, params
         except Exception:  # noqa: BLE001 — backend without layout support
             decode = jax.jit(_decode, donate_argnums=(2,))
             prefill = jax.jit(_prefill, donate_argnums=(2,))
+            chunk = jax.jit(_chunk, donate_argnums=(2,))
             if callable(params):
                 params = params()
-            return decode, prefill, params
+            return decode, prefill, chunk, params
+
+    def _warmup(self) -> int:
+        """Compile every program shape the serving path can hit: each
+        prefill bucket, the chunk program (fixed chunk width, or every
+        suffix bucket when the prefix cache may shorten prompts), and the
+        decode window. All warmup writes scatter into the trash block, so
+        live cache blocks are untouched. Returns the number of program
+        executions (== compilations on a cold process)."""
+        p = self.pcfg
+        bs = p.block_size
+        sizes = []
+        b = bs
+        while b < p.max_seq_len:
+            sizes.append(b)
+            b *= 2
+        sizes.append(p.max_seq_len)
+        self.key, sub = jax.random.split(self.key)
+        n = 0
+        for S in sizes:
+            _tok, self.cache = self._prefill(
+                self.params, jax.numpy.asarray(np.zeros((1, S), np.int32)),
+                self.cache,
+                jax.numpy.asarray(np.full(S // bs, TRASH_BLOCK, np.int32)),
+                np.int32(1), np.float32(0.0), sub,
+            )
+            n += 1
+        if self.prefill_chunk:
+            chunk_sizes = [self.prefill_chunk]
+        elif self.prefix_cache is not None:
+            chunk_sizes = sizes  # cache hits leave bucketed suffixes
+        else:
+            chunk_sizes = []
+        trow = np.full(p.max_blocks_per_seq, TRASH_BLOCK, np.int32)
+        for C in chunk_sizes:
+            _tok, self.cache = self._prefill_chunk_fn(
+                self.params, jax.numpy.asarray(np.zeros((1, C), np.int32)),
+                self.cache, jax.numpy.asarray(trow),
+                jax.numpy.asarray(np.full(C // bs, TRASH_BLOCK, np.int32)),
+                np.int32(0), np.int32(0), np.float32(0.0), sub,
+            )
+            n += 1
+        # Decode window: a no-op compile on the AOT layout path (already
+        # built), but the fallback jit path compiles here instead of on
+        # the first live request.
+        seq, _cur, _lens, self.cache = self._decode(
+            self.params, jax.numpy.asarray(self.cur), self.cache,
+            jax.numpy.asarray(self.tables), jax.numpy.asarray(self.lens),
+            jax.numpy.asarray(self.temps), sub,
+        )
+        jax.block_until_ready(seq)
+        return n + 1
 
     # ------------------------------------------------------------------
     # Public API
@@ -328,14 +599,17 @@ class LLMEngine:
             req.out.put(None)
             return req
         # The decode window may overshoot a finishing sequence by up to
-        # window-1 positions; capacity must cover the overshoot so those
-        # writes stay inside the slot's own blocks.
-        total = len(req.prompt) + max_new_tokens + self.window - 1
+        # window-1 positions — one extra window with overlap, where an
+        # eos-stopped slot can ride through a speculated window; capacity
+        # must cover the overshoot so those writes stay inside the slot's
+        # own blocks.
+        overshoot = self.window * (2 if self.overlap else 1) - 1
+        total = len(req.prompt) + max_new_tokens + overshoot
         worst_blocks = -(-total // self.pcfg.block_size)
         if total > self.pcfg.max_seq_len or worst_blocks > self.pcfg.usable_blocks:
             req.error = (
                 f"prompt({len(req.prompt)}) + max_new_tokens({max_new_tokens}) "
-                f"(+ decode_window overshoot {self.window - 1}) exceeds capacity "
+                f"(+ decode_window overshoot {overshoot}) exceeds capacity "
                 f"(max_seq_len={self.pcfg.max_seq_len}, "
                 f"usable_blocks={self.pcfg.usable_blocks})"
             )
@@ -417,13 +691,39 @@ class LLMEngine:
         return min(b, self.pcfg.max_seq_len)
 
     def _free_slot(self, i: int):
-        self.alloc.release(self.slot_blocks[i])
+        pc = self.prefix_cache
+        if pc is None:
+            self.alloc.release(self.slot_blocks[i])
+        else:
+            for b in self.slot_blocks[i]:
+                # Cache-managed blocks stay RESIDENT (refcount drop, LRU
+                # when unreferenced); private blocks go back to the pool.
+                if not pc.release(b):
+                    self.alloc.release((b,))
         self.slot_blocks[i] = []
         self.slots[i] = None
+        self._prefilling.pop(i, None)
         self.tables[i] = TRASH_BLOCK
         self.lens[i] = 0
         self.temps[i] = 0.0
         self.cur[i] = 0
+        self._dirty.update(("tables", "lens", "temps", "cur"))
+
+    def _alloc_blocks(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` blocks, evicting cold prefix-cache residents as
+        needed (LRU, refcount-0 only). None if even eviction can't cover."""
+        if n <= 0:
+            return []
+        pc = self.prefix_cache
+        while (
+            self.alloc.available < n and pc is not None and pc.evictable_blocks
+        ):
+            freed = pc.evict_lru()
+            if not freed:
+                break
+            self.alloc.release(freed)
+            self.stats["prefix_evictions"] += len(freed)
+        return self.alloc.alloc(n)
 
     def _finish(self, i: int):
         req = self.slots[i]
@@ -480,14 +780,15 @@ class LLMEngine:
         if the pool is exhausted."""
         bs = self.pcfg.block_size
         for i in range(len(self.slots)):
-            while self.slots[i] is not None:
+            while self.slots[i] is not None and i not in self._prefilling:
                 need_idx = (int(self.lens[i]) + self.window - 1) // bs
                 if need_idx < len(self.slot_blocks[i]):
                     break  # this slot's window is covered
-                got = self.alloc.alloc(1)
+                got = self._alloc_blocks(1)
                 if got is not None:
                     self.slot_blocks[i].append(got[0])
                     self.tables[i, len(self.slot_blocks[i]) - 1] = got[0]
+                    self._dirty.add("tables")
                     continue
                 # Pool exhausted: evict the youngest slot (possibly i
                 # itself, in which case the outer while sees it freed).
@@ -495,7 +796,9 @@ class LLMEngine:
                     return  # nothing evictable; retry next step
 
     def _admit(self):
-        """Move waiting requests into free slots while blocks allow."""
+        """Move waiting requests into free slots while blocks allow; a
+        prefix-cache hit maps already-resident blocks into the slot's
+        table and only the novel suffix is prefilled."""
         p = self.pcfg
         bs = p.block_size
         while True:
@@ -506,36 +809,80 @@ class LLMEngine:
                 if not self.waiting:
                     return
                 req = self.waiting.popleft()
-            plen = len(req.full_prompt)
+            full = req.full_prompt
+            plen = len(full)
             real_blocks = -(-plen // bs)  # ceil
-            got = self.alloc.alloc(real_blocks)
+            hits: List[int] = []
+            if self.prefix_cache is not None:
+                # Pin hits BEFORE allocating — the allocation may evict
+                # refcount-0 residents, which a matched block must not be.
+                hits = self.prefix_cache.match(full, bs, (plen - 1) // bs)
+                for b in hits:
+                    self.prefix_cache.incref(b)
+            got = self._alloc_blocks(real_blocks - len(hits))
             if got is None:
+                for b in hits:
+                    self.prefix_cache.release(b)
                 with self._lock:
                     self.waiting.appendleft(req)
                 return
+            if self.prefix_cache is not None:
+                self.stats["prefix_lookup_tokens"] += plen
+                self.stats["prefix_hit_tokens"] += len(hits) * bs
             i = free_slots[0]
             self.slots[i] = req
-            self.slot_blocks[i] = got
-            self.tables[i] = TRASH_BLOCK
-            self.tables[i, :real_blocks] = got
-            self.temps[i] = req.temperature
+            self._slot_gen[i] += 1
+            self.slot_blocks[i] = hits + got
             self.stats["admitted"] += 1
-            self._run_prefill(i, req)
+            self._start_prefill(i, req, len(hits) * bs)
 
-    def _flush_prefills(self):
-        if not self._pending_first:
-            return
-        pend, self._pending_first = self._pending_first, []
-        vals = jax.device_get([t for _, t in pend])  # one batched transfer
-        for (i, _), v in zip(pend, vals):
-            self.cur[i] = int(v)
-            self._emit(i, int(v))
-
-    def _run_prefill(self, i: int, req: Request):
-        """Prefill slot ``i``'s prompt and emit the first sampled token."""
-        p = self.pcfg
-        bs = p.block_size
+    def _start_prefill(self, i: int, req: Request, start: int):
+        """Begin prefilling slot ``i`` from absolute position ``start``
+        (block-aligned; positions below it are cache hits). Short work
+        runs to completion now; prompts longer than ``prefill_chunk``
+        enter the chunked queue and advance one chunk per step."""
         full = req.full_prompt
+        plen = len(full)
+        if req.prefill_ts is None:  # first admission (not a resume)
+            req.prefill_ts = time.time()
+        self.stats["prefills"] += 1
+        self.stats["prompt_tokens"] += plen - start
+        suffix = plen - start
+        if self.prefill_chunk and suffix > self.prefill_chunk:
+            self._prefilling[i] = _ChunkState(req, full, start, plen)
+            return
+        if start == 0:
+            tok = self._run_full_prefill(i, req, full)
+        else:
+            # Suffix after a cache hit: one chunk-program call. Reuse the
+            # configured chunk width when set (one compiled shape serves
+            # every suffix); otherwise bucket the suffix length.
+            width = self.prefill_chunk or self._bucket(suffix)
+            tok = self._run_chunk(i, req, full, start, width)
+        self._finish_prefill(i, req, tok)
+
+    def _advance_chunked_prefills(self):
+        """ONE chunk of forward progress per step, round-robin across
+        mid-prefill slots — the per-window decode stall is bounded by a
+        single chunk's latency no matter how many long admissions are in
+        flight (a per-slot advance would serialize N chunk programs in
+        front of every window)."""
+        if not self._prefilling:
+            return
+        order = sorted(self._prefilling)
+        i = next((j for j in order if j > self._chunk_rr), order[0])
+        self._chunk_rr = i
+        st = self._prefilling[i]
+        tok = self._run_chunk(i, st.req, st.tokens, st.pos, self.prefill_chunk)
+        st.pos += self.prefill_chunk
+        if st.pos >= st.plen:
+            del self._prefilling[i]
+            self._finish_prefill(i, st.req, tok)
+
+    def _run_full_prefill(self, i: int, req: Request, full: List[int]):
+        """Whole-prompt full-attention prefill (bucketed); returns the
+        first sampled token as a DEVICE scalar."""
+        bs = self.pcfg.block_size
         plen = len(full)
         S = self._bucket(plen)
         toks = np.zeros((1, S), np.int32)
@@ -551,15 +898,84 @@ class LLMEngine:
             jax.numpy.asarray(row),
             np.int32(plen), np.float32(req.temperature), sub,
         )
-        self.stats["prefills"] += 1
-        self.stats["prompt_tokens"] += plen
-        if req.prefill_ts is None:  # first admission (not a resume)
-            req.prefill_ts = time.time()
-        self.lens[i] = plen
+        return tok
+
+    def _run_chunk(self, i: int, req: Request, full: List[int], start: int,
+                   width: int):
+        """One chunk-program invocation covering positions
+        ``start .. start+width-1`` of slot ``i`` (attends to the slot's
+        resident prefix); returns the sampled token (meaningful only when
+        the chunk covers the prompt's final position)."""
+        p = self.pcfg
+        bs = p.block_size
+        plen = len(full)
+        end = min(start + width, plen)
+        toks = np.zeros((1, width), np.int32)
+        toks[0, : end - start] = full[start:end]
+        blocks = self.slot_blocks[i]
+        trow = np.full(p.max_blocks_per_seq, TRASH_BLOCK, np.int32)
+        trow[: len(blocks)] = blocks
+        crow = np.full(width // bs, TRASH_BLOCK, np.int32)
+        b0 = start // bs
+        for j in range(width // bs):
+            if b0 + j < len(blocks):
+                crow[j] = blocks[b0 + j]
+        last_idx = min(max(plen - 1 - start, 0), width - 1)
+        self.key, sub = jax.random.split(self.key)
+        tok, self.cache = self._prefill_chunk_fn(
+            self.params, jax.numpy.asarray(toks), self.cache,
+            jax.numpy.asarray(trow), jax.numpy.asarray(crow),
+            np.int32(start), np.int32(last_idx),
+            np.float32(req.temperature), sub,
+        )
+        self.stats["prefill_chunks"] += 1
+        return tok
+
+    def _finish_prefill(self, i: int, req: Request, tok):
+        """Prompt fully KV-resident: publish the slot to the decode set
+        (tables/lens/temps become decode-visible) and queue the first
+        sampled token for the batched flush."""
+        full = req.full_prompt
+        blocks = self.slot_blocks[i]
+        self.tables[i] = TRASH_BLOCK
+        self.tables[i, : len(blocks)] = blocks
+        self.lens[i] = len(full)
+        self.temps[i] = req.temperature
+        self._dirty.update(("tables", "lens", "temps"))
+        if self.prefix_cache is not None:
+            self._register_prefix(full, blocks)
         # Defer the device→host read: prefill dispatches pipeline without
         # syncing; _flush_prefills fetches every pending first token in
         # one transfer after the admission loop.
-        self._pending_first.append((i, tok))
+        self._pending_first.append((i, req, tok))
+
+    def _register_prefix(self, full: List[int], blocks: List[int]):
+        """Publish the slot's freshly-prefilled FULL blocks into the
+        prefix index (the trailing partial block receives decode writes
+        and is never shared). Already-cached chain links keep their
+        canonical block id as the parent for the next key."""
+        bs = self.pcfg.block_size
+        pc = self.prefix_cache
+        parent = _PrefixCache.ROOT
+        for j in range(len(full) // bs):
+            toks = tuple(full[j * bs:(j + 1) * bs])
+            cur = pc.table.get((parent, toks))
+            if cur is not None:
+                parent = cur  # a hit we mapped, or a concurrent duplicate
+                continue
+            parent = pc.register(parent, toks, blocks[j])
+
+    def _flush_prefills(self):
+        if not self._pending_first:
+            return
+        pend, self._pending_first = self._pending_first, []
+        vals = jax.device_get([t for _, _, t in pend])  # one batched transfer
+        for (i, req, _), v in zip(pend, vals):
+            if self.slots[i] is not req:
+                continue  # preempted between prefill and flush
+            self.cur[i] = int(v)
+            self._dirty.add("cur")
+            self._emit(i, int(v))
 
     def _emit(self, i: int, tok: int):
         """Record + stream one generated token; retire the slot when done.
@@ -574,44 +990,159 @@ class LLMEngine:
         if (req.eos_id is not None and tok == req.eos_id) or req.remaining <= 0:
             self._finish(i)
 
+    def _ship(self) -> Dict[str, jax.Array]:
+        """Device-resident decode inputs, re-uploading ONLY the arrays the
+        scheduler dirtied since the last dispatch (satellite: stop
+        re-shipping tables/lens/temps/cur wholesale every step)."""
+        for name, host in (("tables", self.tables), ("lens", self.lens),
+                           ("temps", self.temps), ("cur", self.cur)):
+            if self._dev[name] is None or name in self._dirty:
+                self._dev[name] = jax.numpy.asarray(host)
+                self._dirty.discard(name)
+                self.stats["h2d_ships"] += 1
+            else:
+                self.stats["h2d_skips"] += 1
+        return self._dev
+
+    def _decode_entries(self) -> List[tuple]:
+        """(slot, rid, slot_gen) for every decodable slot — occupied and
+        not mid-chunked-prefill. rid + generation let a harvest detect a
+        slot that was freed/reused (even by the SAME re-admitted request)
+        while its window was in flight."""
+        return [(i, s.rid, self._slot_gen[i]) for i, s in enumerate(self.slots)
+                if s is not None and i not in self._prefilling]
+
+    def _dispatch_window(self, speculative: bool = False) -> bool:
+        """Dispatch ONE decode window over the decodable slots without
+        reading it back: outputs (sampled tokens, advanced lens) stay on
+        device and feed the next window directly. Host mirrors advance in
+        lockstep (the device program advances EVERY row; idle rows write
+        to the trash block, and their mirror drift is clamped below)."""
+        self._ensure_decode_blocks()
+        entries = self._decode_entries()
+        if not entries:
+            return False
+        if speculative and "cur" in self._dirty:
+            # The host ``cur`` mirror LAGS the in-flight window (its live
+            # rows are window N-1's tokens until the harvest), so a dirty
+            # cur — a prefill flush, or a preemption the _ensure above
+            # just performed — must not be shipped wholesale now: it
+            # would rewind every other slot by one window. Abort the
+            # speculation; the synchronous path re-dispatches after the
+            # harvest has re-synced the mirror.
+            return False
+        self.stats["max_active"] = max(self.stats["max_active"], len(entries))
+        self.key, sub = jax.random.split(self.key)
+        args = self._ship()
+        seq, cur_out, lens_out, self.cache = self._decode(
+            self.params, args["cur"], self.cache,
+            args["tables"], args["lens"], args["temps"], sub,
+        )
+        self._dev["cur"] = cur_out
+        self._dev["lens"] = lens_out
+        self.lens += self.window
+        if int(self.lens.max()) > (1 << 30):
+            # Idle/prefilling rows drift +window per dispatch (the device
+            # program advances EVERY row; their writes go to the trash
+            # block). Reset them to 0 well before int32 wrap — live rows
+            # are capacity-bounded far below this. Resetting (not
+            # clamping AT a ceiling, which would re-trigger every window)
+            # costs one lens re-ship per ~2^30/window dispatches.
+            for i in range(len(self.slots)):
+                if self.slots[i] is None or i in self._prefilling:
+                    self.lens[i] = 0
+            self._dirty.add("lens")
+        self.stats["steps"] += 1
+        self._inflight = (entries, seq)
+        return True
+
+    def _harvest(self) -> bool:
+        if self._inflight is None:
+            return False
+        pending, self._inflight = self._inflight, None
+        return self._harvest_window(pending)
+
+    def _harvest_window(self, pending: tuple) -> bool:
+        """Read one dispatched window's tokens (ONE host sync) and emit
+        them. Slots freed/reused since dispatch fail the rid check and
+        their lanes are discarded (overshoot)."""
+        entries, seq = pending
+        nxt = np.asarray(seq)  # [window, b]
+        for i, rid, gen in entries:
+            req = self.slots[i]
+            if req is None or req.rid != rid or self._slot_gen[i] != gen:
+                continue  # finished / preempted / slot reused in flight
+            for k in range(self.window):
+                if self.slots[i] is not req:
+                    break  # finished mid-window; rest is overshoot
+                self.cur[i] = nxt[k, i]
+                self._emit(i, int(nxt[k, i]))
+        return True
+
+    def _can_speculate(self) -> bool:
+        """Dispatch window N+1 before reading window N's tokens? Not when
+        a slot's cap-finish inside N is already certain (the speculated
+        window would be pure waste), and not when an admission could use
+        a free slot first (it should join N+1, not N+2). An eos-stopped
+        slot can still waste one window — capacity covers it (the
+        2*window-1 overlap margin)."""
+        entries = self._decode_entries()
+        if not entries:
+            return False
+        if self.waiting and any(s is None for s in self.slots):
+            return False
+        if "cur" in self._dirty:
+            return False  # host cur lags the in-flight window — sync first
+        return all(
+            self.slots[i].remaining > self.window for i, _, _ in entries
+        )
+
     def step(self) -> bool:
-        """One scheduler iteration: admit → page → decode. Returns True
-        if any device work ran (False = idle)."""
+        """One scheduler iteration: [speculate] → harvest → admit → page
+        → decode. Returns True if any device work ran (False = idle).
+
+        With ``overlap`` the device is double-buffered: window N+1 is
+        dispatched from N's device-resident outputs BEFORE N's tokens are
+        read, so token emission, admission, paging and prefill dispatch
+        all run while the device executes N+1 (the donated-cache chain
+        serializes device-side writes, so a freed block re-used by a
+        later prefill is always overwritten AFTER the stale window's
+        writes land)."""
         s0 = (self.stats["tokens"], self.stats["prefills"],
-              self.stats["preemptions"], self.stats["admitted"])
+              self.stats["preemptions"], self.stats["admitted"],
+              self.stats["prefill_chunks"], self.stats["prefix_hit_tokens"])
+        worked = False
+        if self._inflight is not None:
+            # Stash window N first: a speculated dispatch installs N+1 as
+            # the new in-flight window, and N still owes its tokens.
+            pending, self._inflight = self._inflight, None
+            if (
+                self.overlap
+                and self._can_speculate()
+                and self._dispatch_window(speculative=True)
+            ):
+                self.stats["spec_windows"] += 1
+            self._harvest_window(pending)
+            worked = True
         self._admit()
+        self._advance_chunked_prefills()
         self._flush_prefills()
-        active = []
-        if self.active_count():
-            self._ensure_decode_blocks()
-            active = [i for i, s in enumerate(self.slots) if s is not None]
-        if active:
-            self.stats["max_active"] = max(self.stats["max_active"], len(active))
-            self.key, sub = jax.random.split(self.key)
-            nxt, self.cache = self._decode(
-                self.params, jax.numpy.asarray(self.cur), self.cache,
-                jax.numpy.asarray(self.tables), jax.numpy.asarray(self.lens),
-                jax.numpy.asarray(self.temps), sub,
-            )
-            nxt = np.asarray(nxt)  # [window, b] — ONE host sync per window
-            self.stats["steps"] += 1
-            for i in active:
-                for k in range(self.window):
-                    if self.slots[i] is None:
-                        break  # finished mid-window; rest is overshoot
-                    self.lens[i] += 1  # the fed token's KV is now resident
-                    self.cur[i] = nxt[k, i]
-                    self._emit(i, int(nxt[k, i]))
+        if self._inflight is None and self._dispatch_window():
+            worked = True
+            if not self.overlap:
+                self._harvest()  # classic synchronous window
         s1 = (self.stats["tokens"], self.stats["prefills"],
-              self.stats["preemptions"], self.stats["admitted"])
+              self.stats["preemptions"], self.stats["admitted"],
+              self.stats["prefill_chunks"], self.stats["prefix_hit_tokens"])
         # Record even decode-less iterations that did work — e.g. a
         # max_new_tokens=1 request finishes entirely inside the prefill
         # flush and must still appear in the step ring.
-        worked = bool(active) or s1 != s0
+        worked = worked or s1 != s0
         if worked:
+            pc = self.prefix_cache
             self.recorder.record_step({
                 "ts": time.time(),
-                "active": len(active),
+                "active": self.active_count(),
                 "waiting": len(self.waiting),
                 "kv_blocks_free": self.alloc.available,
                 "kv_utilization": 1.0 - self.alloc.available
@@ -620,6 +1151,9 @@ class LLMEngine:
                 "prefills": s1[1] - s0[1],
                 "preemptions": s1[2] - s0[2],
                 "admitted": s1[3] - s0[3],
+                "chunks": s1[4] - s0[4],
+                "prefix_hit_tokens": s1[5] - s0[5],
+                "cached_blocks": pc.resident_blocks if pc else 0,
             })
             self._maybe_flush_metrics()
         return worked
@@ -653,6 +1187,11 @@ class LLMEngine:
                 ("prompt_tokens", m.engine_prompt_tokens),
                 ("prefills", m.engine_prefills),
                 ("preemptions", m.engine_preemptions),
+                ("prefill_chunks", m.engine_prefill_chunks),
+                ("spec_windows", m.engine_overlap_windows),
+                ("prefix_hit_tokens", m.engine_prefix_hit_tokens),
+                ("prefix_lookup_tokens", m.engine_prefix_lookup_tokens),
+                ("prefix_evictions", m.engine_prefix_evictions),
             ):
                 delta = s[key] - prev.get(key, 0)
                 if delta:
@@ -664,6 +1203,8 @@ class LLMEngine:
             m.engine_kv_util.set(
                 1.0 - self.alloc.available / max(1, self.pcfg.usable_blocks), t
             )
+            pc = self.prefix_cache
+            m.engine_cached_blocks.set(pc.resident_blocks if pc else 0, t)
 
     def _report_loop(self):
         while not self._stop.wait(self._report_interval_s):
@@ -693,6 +1234,29 @@ class LLMEngine:
                 "kv_blocks_free": self.alloc.available,
                 "kv_blocks_total": self.pcfg.usable_blocks,
                 "max_batch": self.pcfg.max_batch,
+            },
+            prefix_cache={
+                "enabled": self.prefix_cache is not None,
+                "resident_blocks": self.prefix_cache.resident_blocks
+                if self.prefix_cache else 0,
+                "evictable_blocks": self.prefix_cache.evictable_blocks
+                if self.prefix_cache else 0,
+                "hit_tokens": self.stats["prefix_hit_tokens"],
+                "lookup_tokens": self.stats["prefix_lookup_tokens"],
+                "hit_rate": self.stats["prefix_hit_tokens"]
+                / max(1, self.stats["prefix_lookup_tokens"]),
+                "evictions": self.stats["prefix_evictions"],
+            },
+            overlap={
+                "enabled": self.overlap,
+                "windows": self.stats["steps"],
+                "spec_windows": self.stats["spec_windows"],
+                # Fraction of windows dispatched while the previous one
+                # was still unread — host/device overlap occupancy.
+                "occupancy": self.stats["spec_windows"]
+                / max(1, self.stats["steps"]),
+                "h2d_ships": self.stats["h2d_ships"],
+                "h2d_skips": self.stats["h2d_skips"],
             },
         )
         try:

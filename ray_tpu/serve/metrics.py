@@ -162,6 +162,38 @@ class _ServeMetrics:
         self.engine_preemptions = Counter(
             "serve_engine_preemptions_total", "Recompute preemptions", dr
         )
+        # -- engine perf suite (prefix cache / chunked prefill / overlap)
+        self.engine_prefix_hit_tokens = Counter(
+            "serve_engine_prefix_hit_tokens_total",
+            "Prompt tokens served from the prefix KV cache (not recomputed)",
+            dr,
+        )
+        self.engine_prefix_lookup_tokens = Counter(
+            "serve_engine_prefix_lookup_tokens_total",
+            "Prompt tokens looked up in the prefix KV cache (hit-rate denominator)",
+            dr,
+        )
+        self.engine_prefix_evictions = Counter(
+            "serve_engine_prefix_evictions_total",
+            "Prefix-cache blocks evicted (LRU, refcount-0 only)",
+            dr,
+        )
+        self.engine_cached_blocks = Gauge(
+            "serve_engine_prefix_cached_blocks",
+            "KV blocks resident in the prefix cache (pinned + evictable)",
+            dr,
+        )
+        self.engine_prefill_chunks = Counter(
+            "serve_engine_prefill_chunks_total",
+            "Chunk-program invocations (chunked/suffix prefill)",
+            dr,
+        )
+        self.engine_overlap_windows = Counter(
+            "serve_engine_overlap_windows_total",
+            "Decode windows dispatched before the previous window was read "
+            "(host/device overlap)",
+            dr,
+        )
 
 
 def serve_metrics() -> _ServeMetrics:

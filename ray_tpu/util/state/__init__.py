@@ -143,10 +143,17 @@ def summarize_serve() -> dict:
                 "prompt_tokens": 0,
                 "preemptions": 0,
                 "finished_requests": 0,
+                "prefix_cached_blocks": 0,
+                "prefix_hit_tokens": 0,
+                "prefix_lookup_tokens": 0,
+                "prefill_chunks": 0,
+                "overlap_windows": 0,
+                "decode_windows": 0,
             },
         )
         occ = snap.get("occupancy", {})
         stats = snap.get("stats", {})
+        pc = snap.get("prefix_cache", {})
         d["engines"] += 1
         d["active"] += occ.get("active", 0)
         d["waiting"] += occ.get("waiting", 0)
@@ -156,6 +163,12 @@ def summarize_serve() -> dict:
         d["prompt_tokens"] += stats.get("prompt_tokens", 0)
         d["preemptions"] += stats.get("preemptions", 0)
         d["finished_requests"] += stats.get("finished", 0)
+        d["prefix_cached_blocks"] += pc.get("resident_blocks", 0)
+        d["prefix_hit_tokens"] += stats.get("prefix_hit_tokens", 0)
+        d["prefix_lookup_tokens"] += stats.get("prefix_lookup_tokens", 0)
+        d["prefill_chunks"] += stats.get("prefill_chunks", 0)
+        d["overlap_windows"] += stats.get("spec_windows", 0)
+        d["decode_windows"] += stats.get("steps", 0)
         pool = pooled.setdefault(dep, {})
         for rec in snap.get("recent_requests", ()):
             for field in ("queue_ms", "ttft_ms", "tpot_ms", "e2e_ms"):
@@ -163,6 +176,13 @@ def summarize_serve() -> dict:
                     pool.setdefault(field, []).append(rec[field])
     for dep, pool in pooled.items():
         out[dep]["latency_ms"] = summarize_latencies(pool)
+    for d in out.values():
+        d["prefix_hit_rate"] = d["prefix_hit_tokens"] / max(
+            1, d["prefix_lookup_tokens"]
+        )
+        d["overlap_occupancy"] = d["overlap_windows"] / max(
+            1, d["decode_windows"]
+        )
     return out
 
 

@@ -1,0 +1,320 @@
+"""Serve-path engine perf suite: prefix-aware KV reuse, chunked prefill,
+host/device overlap, bucket warmup, and dirty-slot shipping.
+
+Correctness contract for every feature: temp-0 outputs must be
+IDENTICAL to the plain engine (same math, different scheduling /
+memory reuse), plus allocator/refcount invariants that guard against
+cross-request block aliasing.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_tpu.models.paged import PagedConfig, TRASH_BLOCK
+from ray_tpu.models.transformer import TransformerConfig, init_params
+from ray_tpu.serve.llm_engine import LLMEngine, _PrefixCache
+
+
+@pytest.fixture(autouse=True)
+def _highest_precision():
+    """Token-for-token assertions across differently-shaped computations
+    of the same math (full vs chunked prefill, cached vs recomputed KV);
+    fp32 matmul precision keeps rounding from flipping an argmax."""
+    prev = jax.config.jax_default_matmul_precision
+    jax.config.update("jax_default_matmul_precision", "highest")
+    yield
+    jax.config.update("jax_default_matmul_precision", prev)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = TransformerConfig.tiny(dtype=jnp.float32, remat=False)
+    params = init_params(jax.random.PRNGKey(7), cfg)
+    params = jax.tree.map(lambda x: jax.device_put(x), params)
+    return cfg, params
+
+
+def _engine(cfg, params, **kw):
+    pcfg_kw = dict(block_size=8, num_blocks=33, max_batch=4, max_blocks_per_seq=8)
+    for k in list(kw):
+        if k in pcfg_kw:
+            pcfg_kw[k] = kw.pop(k)
+    return LLMEngine(params, cfg, PagedConfig(**pcfg_kw), **kw)
+
+
+SHARED = [7, 3, 9, 1, 4, 6, 2, 8, 11, 12, 13, 14, 15, 16, 17, 18, 21, 22, 23, 24]
+
+
+def _cache_invariants(eng):
+    """No block may be simultaneously free, cached, and/or slot-owned."""
+    pc = eng.prefix_cache
+    assert len(eng.alloc.free) == len(set(eng.alloc.free)), "double-freed block"
+    free = set(eng.alloc.free)
+    cached = set(pc.meta)
+    in_use = {b for bl in eng.slot_blocks for b in bl}
+    assert not free & cached, "block both free and cache-resident"
+    assert TRASH_BLOCK not in free and TRASH_BLOCK not in cached
+    # Every cached-but-referenced block must be mapped by some slot, and
+    # every refcount must equal the number of slots mapping it.
+    for bid, (_key, _parent, refs) in pc.meta.items():
+        mapped = sum(bl.count(bid) for bl in eng.slot_blocks)
+        assert refs == mapped, f"block {bid}: refs {refs} != mapped {mapped}"
+        if refs == 0:
+            assert bid in pc.lru
+            assert bid not in in_use
+    # Full accounting: free + cached(ref0) + slot-owned == usable pool.
+    owned_or_resident = len(free) + len(pc.lru) + len(in_use - cached)
+    # slot-owned cached blocks are counted via in_use∩cached == refs>0 set
+    owned_or_resident += len(in_use & cached)
+    assert owned_or_resident == eng.pcfg.usable_blocks
+
+
+def test_prefix_cache_temp0_outputs_identical(tiny_model):
+    """Requests sharing a prompt prefix must produce byte-identical
+    greedy outputs with the cache on vs off, while >= 30% of prompt
+    tokens are served from cache."""
+    cfg, params = tiny_model
+    prompts = [SHARED + [30 + i, 40 + i, 50 + i] for i in range(4)]
+    base = _engine(cfg, params)
+    expect = [base.generate_batch([p], 8)[0] for p in prompts]
+    eng = _engine(cfg, params, enable_prefix_cache=True)
+    outs = [eng.generate_batch([p], 8)[0] for p in prompts]
+    assert outs == expect
+    s = eng.stats
+    assert s["prefix_lookup_tokens"] == sum(len(p) for p in prompts)
+    # 3 warm requests x 2 full shared blocks (16 tokens) each.
+    assert s["prefix_hit_tokens"] == 48
+    assert s["prefix_hit_tokens"] / s["prefix_lookup_tokens"] >= 0.30
+    # Cached prompt tokens were NOT prefilled again.
+    assert s["prompt_tokens"] == s["prefix_lookup_tokens"] - s["prefix_hit_tokens"]
+    _cache_invariants(eng)
+
+
+def test_prefix_cache_refcounts_and_concurrent_sharing(tiny_model):
+    """Concurrent requests sharing cached blocks pin them (refcount = #
+    of mapping slots); finishing releases them into the LRU, never the
+    free list, and the outputs still match the plain engine."""
+    cfg, params = tiny_model
+    prompts = [SHARED + [60 + i] for i in range(3)]
+    base = _engine(cfg, params)
+    expect = [base.generate_batch([p], 6)[0] for p in prompts]
+    eng = _engine(cfg, params, enable_prefix_cache=True)
+    # Warm the cache, then run the rest concurrently so they share blocks.
+    first = eng.generate_batch([prompts[0]], 6)
+    rest = eng.generate_batch(prompts[1:], 6)
+    assert [first[0]] + rest == expect
+    pc = eng.prefix_cache
+    assert pc.resident_blocks == 2  # the two full shared blocks
+    assert pc.evictable_blocks == 2  # all refs dropped at finish
+    for bid, (_k, _p, refs) in pc.meta.items():
+        assert refs == 0
+    _cache_invariants(eng)
+
+
+def test_prefix_cache_eviction_no_stale_aliasing(tiny_model):
+    """Fill the pool with distinct prompts until cached blocks are
+    evicted and re-allocated, then re-submit the first prompt: it must
+    recompute (no stale hit via a reused block id) and match exactly."""
+    cfg, params = tiny_model
+    # Tiny pool: 12 usable blocks, so distinct prompts evict each other.
+    kw = dict(num_blocks=13, max_batch=2, max_blocks_per_seq=6)
+    base = _engine(cfg, params, **kw)
+    eng = _engine(cfg, params, enable_prefix_cache=True, **kw)
+    first = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17]
+    others = [[i + 20] * 17 for i in range(6)]
+    expect_first = base.generate_batch([first], 6)
+    expect_others = [base.generate_batch([p], 6)[0] for p in others]
+    assert eng.generate_batch([first], 6) == expect_first
+    for p, exp in zip(others, expect_others):
+        assert eng.generate_batch([p], 6)[0] == exp
+        _cache_invariants(eng)
+    assert eng.stats["prefix_evictions"] > 0
+    # Re-run the first prompt after its blocks were evicted/reused.
+    assert eng.generate_batch([first], 6) == expect_first
+    _cache_invariants(eng)
+
+
+def test_prefix_cache_preempt_resume_hits(tiny_model):
+    """Preempted requests resume via re-prefill; with the cache on, the
+    resume maps the already-resident prompt blocks instead of paying the
+    full recompute — and still finishes with identical greedy output."""
+    cfg, params = tiny_model
+    kw = dict(num_blocks=13, max_batch=4, max_blocks_per_seq=6)
+    prompts = [[i + 1, i + 2, i + 3, i + 4] * 2 for i in range(4)]
+    calm = _engine(cfg, params)
+    expect = calm.generate_batch(prompts, 28)
+    eng = _engine(cfg, params, enable_prefix_cache=True, **kw)
+    outs = eng.generate_batch(prompts, 28)
+    assert outs == expect
+    assert eng.stats["preemptions"] > 0
+    assert eng.stats["prefix_hit_tokens"] > 0  # resume reused resident KV
+    _cache_invariants(eng)
+
+
+def test_chunked_prefill_matches_and_interleaves(tiny_model):
+    """A long prompt split into chunks must decode identically, and a
+    short stream admitted alongside keeps producing tokens between the
+    long prompt's chunks (no head-of-line freeze)."""
+    cfg, params = tiny_model
+    long_p = list(range(1, 49))  # 48 tokens -> 6 chunks of 8
+    short_p = [9, 8, 7]
+    base = _engine(cfg, params)
+    expect_long = base.generate_batch([long_p], 8)[0]
+    expect_short = _engine(cfg, params).generate_batch([short_p], 12)[0]
+    eng = _engine(cfg, params, prefill_chunk=8)
+    short_req = eng.add_request(short_p, 12)
+    eng.step()  # admit + prefill the short request first
+    long_req = eng.add_request(long_p, 8)
+    chunks_before_done = None
+    while eng.active_count() or eng.waiting:
+        eng.step()
+        if chunks_before_done is None and short_req.out.qsize() > 2:
+            # Short stream progressed while the long prefill is running.
+            chunks_before_done = eng.stats["prefill_chunks"]
+    assert list(long_req.tokens(timeout=60)) == expect_long
+    assert list(short_req.tokens(timeout=60)) == expect_short
+    assert eng.stats["prefill_chunks"] >= 6
+    assert chunks_before_done is not None and chunks_before_done < 6
+
+
+def test_chunked_prefill_with_cache_and_overlap(tiny_model):
+    """The full perf suite composed: chunked prefill + prefix cache +
+    overlap, greedy outputs identical to the plain engine."""
+    cfg, params = tiny_model
+    prompts = [SHARED + SHARED[:12] + [70 + i] for i in range(4)]  # 33 tokens
+    base = _engine(cfg, params)
+    expect = [base.generate_batch([p], 6)[0] for p in prompts]
+    eng = _engine(
+        cfg, params, enable_prefix_cache=True, prefill_chunk=16,
+        overlap=True, decode_window=2,
+    )
+    outs = [eng.generate_batch([p], 6)[0] for p in prompts]
+    assert outs == expect
+    assert eng.stats["prefill_chunks"] > 0
+    assert eng.stats["prefix_hit_tokens"] > 0
+    _cache_invariants(eng)
+
+
+def test_warmup_buckets(tiny_model):
+    """Opt-in warmup compiles every prefill bucket at build time and
+    records the spent wall time; live requests then behave identically."""
+    cfg, params = tiny_model
+    eng = _engine(cfg, params, warmup_buckets=True, enable_prefix_cache=True)
+    # tiny: buckets 8..64 (4 prefill + 4 suffix-chunk) + decode = 9.
+    assert eng.stats["warmup_compiles"] == 9
+    assert eng.stats["warmup_s"] >= 0
+    assert eng.alloc.available == eng.pcfg.usable_blocks  # warmup hit trash only
+    base = _engine(cfg, params)
+    prompts = [[5, 9, 2, 11, 3], [17, 1, 8]]
+    assert eng.generate_batch(prompts, 8) == base.generate_batch(prompts, 8)
+
+
+def test_dirty_slot_shipping_skips_stable_arrays(tiny_model):
+    """Steady-state decode must not re-upload tables/lens/temps/cur every
+    window: only admission/retirement/paging dirties them."""
+    cfg, params = tiny_model
+    eng = _engine(cfg, params, decode_window=1)
+    eng.generate_batch([[5, 9, 2]], max_new_tokens=24)
+    s = eng.stats
+    assert s["h2d_skips"] > 0
+    # 4 arrays x steps would be the wholesale-upload cost; dirty tracking
+    # must beat it by a wide margin (tables only change on block faults).
+    assert s["h2d_ships"] < 4 * s["steps"] / 2
+
+
+def test_overlap_requires_wider_margin(tiny_model):
+    """Overlap doubles the decode-window overshoot margin: a request that
+    fits the classic margin but not 2*window-1 must be rejected up front
+    (its speculated window could write past its block table)."""
+    cfg, params = tiny_model
+    eng = _engine(cfg, params, decode_window=4, overlap=True)  # max_seq 64
+    req = eng.add_request([1] * 30, max_new_tokens=28)  # 30+28+7 = 65 > 64
+    with pytest.raises(RuntimeError, match="exceeds capacity"):
+        list(req.tokens(timeout=5))
+    ok = eng.add_request([1] * 30, max_new_tokens=27)  # 64 — fits
+    eng_out = []
+    while eng.active_count() or eng.waiting:
+        eng.step()
+    eng_out = list(ok.tokens(timeout=5))
+    assert len(eng_out) == 27
+
+
+def test_eviction_spares_pinned_child_under_unpinned_chain(tiny_model):
+    """A request that registers a novel tail under a chain ANOTHER
+    request published first holds no references on that chain (its own
+    table maps private duplicates of the parents) — so the chain can hit
+    refcount 0 and be evicted while the child is pinned by a live slot.
+    The eviction cascade must unregister such a child but NEVER free it:
+    pre-fix this freed a block still mapped by a decoding request (KV
+    corruption) and then double-freed it at slot release."""
+    cfg, params = tiny_model
+    eng = _engine(cfg, params, enable_prefix_cache=True, prefill_chunk=16,
+                  num_blocks=15, max_batch=4)
+    shared = list(range(1, 17))  # 2 full shared blocks
+    a_prompt = shared + list(range(30, 54))  # 40 tokens, chunked (3 chunks)
+    b_prompt = shared  # 16 tokens, single-shot: registers the chain FIRST
+    c_prompt = [200 + i for i in range(40)]  # distinct: forces eviction
+    calm = _engine(cfg, params)
+    a_ref = calm.generate_batch([a_prompt], 24)[0]
+    b_ref = calm.generate_batch([b_prompt], 2)[0]
+    c_ref = calm.generate_batch([c_prompt], 4)[0]
+    # A (chunked, registration deferred) + B (instant registration) race:
+    # B publishes the shared chain; A's tail registers under B's blocks.
+    a = eng.add_request(a_prompt, 24)
+    b = eng.add_request(b_prompt, 2)
+    while eng.slots[1] is not None or eng.waiting:  # B admitted+finished
+        eng.step()
+    assert list(b.tokens(timeout=60)) == b_ref
+    # B's chain is now refcount-0/evictable while A still decodes with
+    # its tail blocks registered (pinned) beneath it. C's admission must
+    # evict B's chain — and must not touch A's pinned blocks.
+    c = eng.add_request(c_prompt, 4)
+    while eng.active_count() or eng.waiting:
+        eng.step()
+    assert eng.stats["prefix_evictions"] >= 2  # B's two chain blocks
+    assert list(a.tokens(timeout=60)) == a_ref  # A's KV never corrupted
+    assert list(c.tokens(timeout=60)) == c_ref
+    _cache_invariants(eng)
+
+
+def test_prefix_cache_unit_eviction_cascades():
+    """Unit: evicting a parent must evict its cached descendants, so a
+    reused parent id can never falsely re-link a stale child chain."""
+    pc = _PrefixCache()
+    a = pc.register(_PrefixCache.ROOT, (1, 2), 10)
+    b = pc.register(a, (3, 4), 11)
+    c = pc.register(b, (5, 6), 12)
+    assert (a, b, c) == (10, 11, 12)
+    for bid in (10, 11, 12):
+        pc.release(bid)
+    assert pc.evictable_blocks == 3
+    freed = pc.evict_lru()  # coldest = 10, cascades to 11, 12
+    assert set(freed) == {10, 11, 12}
+    assert pc.resident_blocks == 0 and not pc.table
+    # Re-register under the same ids with different tokens: no stale hits.
+    pc.register(_PrefixCache.ROOT, (9, 9), 10)
+    assert pc.match([1, 2, 3, 4], 2, 2) == []
+    assert pc.match([9, 9, 3, 4], 2, 2) == [10]
+
+
+@pytest.mark.slow
+def test_engine_perf_suite_stress(tiny_model):
+    """Long-running mixed workload (cache + chunks + overlap + windows +
+    preemption pressure): invariants hold and every request completes
+    with the right token count."""
+    cfg, params = tiny_model
+    eng = _engine(
+        cfg, params, enable_prefix_cache=True, prefill_chunk=16,
+        overlap=True, decode_window=4, num_blocks=25,
+    )
+    reqs = []
+    for r in range(6):
+        for i in range(6):
+            n = 4 + (i * 7 + r) % 9
+            reqs.append(eng.add_request(SHARED + [r, i], max_new_tokens=n))
+        while eng.active_count() or eng.waiting:
+            eng.step()
+    for q in reqs:
+        toks = list(q.tokens(timeout=60))
+        assert len(toks) == q.max_new_tokens
+    _cache_invariants(eng)

@@ -368,3 +368,44 @@ def test_proxy_request_metrics(traced_serve_cluster):
         assert ok >= 1
     finally:
         serve.delete("echo2")
+
+
+def test_engine_perf_suite_reported(ray_start_regular):
+    """The perf-suite engine (prefix cache + overlap) reports its cache
+    and overlap gauges through report_state -> controller ->
+    summarize_serve: hit rate, resident blocks, speculated-window
+    occupancy (backs the GET /api/serve/engine payload)."""
+    from ray_tpu.models.paged import PagedConfig
+    from ray_tpu.models.transformer import TransformerConfig, init_params
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    cfg = TransformerConfig.tiny(dtype=jnp.float32, remat=False)
+    params = init_params(jax.random.PRNGKey(7), cfg)
+    eng = LLMEngine(
+        params, cfg,
+        PagedConfig(block_size=8, num_blocks=33, max_batch=4,
+                    max_blocks_per_seq=8),
+        decode_window=2, overlap=True, enable_prefix_cache=True,
+    )
+    shared = list(range(1, 19))  # 18 tokens -> 2 full shared blocks
+    for i in range(3):
+        eng.generate_batch([shared + [40 + i]], max_new_tokens=6)
+
+    snap = eng.report_state()
+    pc = snap["prefix_cache"]
+    assert pc["enabled"] and pc["resident_blocks"] >= 2
+    assert pc["hit_tokens"] == 32 and pc["lookup_tokens"] == 57
+    assert pc["hit_rate"] == pytest.approx(32 / 57)
+    ov = snap["overlap"]
+    assert ov["enabled"] and ov["spec_windows"] >= 1
+    assert 0 < ov["occupancy"] <= 1
+    assert ov["h2d_skips"] > 0  # dirty tracking skipped stable arrays
+
+    dep = eng.metrics_tags["deployment"]
+    assert _wait_until(lambda: dep in state_api.summarize_serve())
+    summary = state_api.summarize_serve()[dep]
+    assert summary["prefix_hit_tokens"] == 32
+    assert summary["prefix_hit_rate"] == pytest.approx(32 / 57)
+    assert summary["prefix_cached_blocks"] >= 2
+    assert summary["overlap_windows"] >= 1
+    assert 0 < summary["overlap_occupancy"] <= 1
