@@ -135,6 +135,13 @@ def main():
     value = tokens_per_step / fw_time / n_dev
     vs_baseline = pj_time / fw_time  # >1 → framework faster than plain JAX
 
+    # Peak per-device HBM at the end of the train measurement (telemetry
+    # leg of the perf trajectory: memory regressions show up in BENCH_*
+    # next to throughput). None on backends without memory_stats (CPU).
+    from ray_tpu.core.node_telemetry import peak_device_hbm_gb
+
+    train_peak_hbm = peak_device_hbm_gb()
+
     flops_tok = tf.flops_per_token(cfg, seq)
     peak = {"tpu": 197e12, "cpu": 1e12}.get(platform, 100e12)  # v5e bf16 peak
     mfu = (flops_tok * tokens_per_step / fw_time) / (peak * n_dev)
@@ -182,6 +189,8 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs_baseline, 4),
     }
+    if train_peak_hbm is not None:
+        record["train_peak_hbm_gb"] = train_peak_hbm
     record.update(extra)
     print(json.dumps(record))
 
@@ -383,6 +392,11 @@ def _bench_serving_7b(log):
         results[f"c{c}"] = round(agg, 1)
         log(f"7B serve: concurrency {c}: {agg:.1f} tok/s aggregate ({dt:.2f}s)")
     results.update(_serve_prefix_scenario(eng, cfg, log, tag="7B serve"))
+    from ray_tpu.core.node_telemetry import peak_device_hbm_gb
+
+    peak = peak_device_hbm_gb()
+    if peak is not None:
+        results["peak_hbm_gb"] = peak
     log(f"7B serve engine stats: {eng.stats}")
     return results
 
@@ -457,6 +471,11 @@ def _bench_serving_tiny_cpu(log, cfg):
     res["overlap_occupancy"] = round(
         eng.stats["spec_windows"] / max(1, eng.stats["steps"]), 3
     )
+    from ray_tpu.core.node_telemetry import peak_device_hbm_gb
+
+    peak = peak_device_hbm_gb()
+    if peak is not None:  # CPU backends report no memory_stats
+        res["peak_hbm_gb"] = peak
     log(f"tiny cpu serve engine stats: {eng.stats}")
     return res
 

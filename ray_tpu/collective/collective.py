@@ -233,23 +233,29 @@ def barrier(group_name: str = "default"):
 
 
 def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0):
+    from ray_tpu.collective import diagnostics
+
     group = _group_mgr.get_group(group_name)
     if dst_rank == group.rank:
         raise ValueError("cannot send to self")
     arr, _ = _to_host(tensor)
     # P2P tags live in their own space so they never collide with the
     # per-step tags used by ring collectives.
-    group.send(arr, dst_rank, tag=tag + 2_000_000)
+    with diagnostics.timed_op(group_name, "send", group.rank, arr.nbytes):
+        group.send(arr, dst_rank, tag=tag + 2_000_000)
 
 
 def recv(src_rank: int, group_name: str = "default", tag: int = 0):
     """Receive a tensor from ``src_rank``. Unlike the reference (which
     fills a preallocated tensor), returns the received array — shapes
     travel on the wire, so preallocation is unnecessary."""
+    from ray_tpu.collective import diagnostics
+
     group = _group_mgr.get_group(group_name)
     if src_rank == group.rank:
         raise ValueError("cannot recv from self")
-    return group.recv(src_rank, tag=tag + 2_000_000)
+    with diagnostics.timed_op(group_name, "recv", group.rank):
+        return group.recv(src_rank, tag=tag + 2_000_000)
 
 
 # Multi-tensor variants (reference has *_multigpu; on TPU host path these

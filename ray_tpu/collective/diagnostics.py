@@ -1,0 +1,139 @@
+"""Collective & transfer diagnostics: per-op latency/bytes + straggler skew.
+
+Reference: the reference's collective groups expose no metrics at all —
+debugging a slow ring means printf. Here every eager collective op
+records a latency histogram and byte counter, and each rank additionally
+publishes its LAST op latency as a gauge keyed {group, op, rank}; the
+controller derives ``collective_skew_ms`` (max-min across ranks per
+(group, op)) at snapshot time, which is the per-ring straggler view
+`ray-tpu status` renders (Pathways-style multi-slice skew reporting,
+PAPERS.md).
+
+Recording sites:
+- host_group.HostGroup ring ops  → ``collective_op_ms`` / ``_last_op_ms``
+- collective.py eager wrappers   → ``collective_bytes_total`` (tensor volume)
+- xla_group.in_graph_allreduce   → same series, group="xla"
+- core/object_transfer.py        → ``object_transfer_*`` (node↔node pulls)
+
+All metrics are lazy per-process singletons (the registry keeps every
+constructed Metric alive) and tag cardinality is bounded by the registry
+cap (util/metrics.py) — rank is a tag, so a 1024-rank ring tops out at
+the per-metric series cap, not at 1024 series per op.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+# Sub-ms ring steps on loopback up to multi-minute cross-DCN transfers.
+MS_BOUNDARIES = (
+    0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+    1000, 2500, 5000, 10000, 30000, 60000,
+)
+
+_lock = threading.Lock()
+_metrics = None
+_transfer = None
+
+
+class _CollectiveMetrics:
+    def __init__(self):
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        gor = ("group", "op", "rank")
+        self.op_ms = Histogram(
+            "collective_op_ms",
+            "Eager collective op latency (host ring / in-graph dispatch)",
+            MS_BOUNDARIES, gor,
+        )
+        self.last_ms = Gauge(
+            "collective_last_op_ms",
+            "This rank's most recent op latency — the controller derives "
+            "cross-rank skew (collective_skew_ms) from these",
+            gor,
+        )
+        self.ops = Counter(
+            "collective_ops_total", "Eager collective ops", ("group", "op")
+        )
+        self.bytes = Counter(
+            "collective_bytes_total",
+            "Tensor bytes through eager collective ops",
+            ("group", "op"),
+        )
+        self.p2p_bytes = Counter(
+            "collective_p2p_bytes_total",
+            "Point-to-point bytes through collective groups (ring steps + send/recv)",
+            ("group", "dir"),
+        )
+
+
+class _TransferMetrics:
+    def __init__(self):
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        self.fetch_ms = Histogram(
+            "object_transfer_fetch_ms",
+            "Node-to-node object pull duration (chunked fetch)",
+            MS_BOUNDARIES,
+        )
+        self.bytes = Counter(
+            "object_transfer_bytes_total", "Bytes pulled across nodes"
+        )
+        self.chunks = Counter(
+            "object_transfer_chunks_total", "Chunks fetched across nodes"
+        )
+        self.chunks_served = Counter(
+            "object_transfer_chunks_served_total",
+            "Chunks served to pulling peers (source side)",
+        )
+
+
+def collective_metrics() -> _CollectiveMetrics:
+    global _metrics
+    if _metrics is None:
+        with _lock:
+            if _metrics is None:
+                _metrics = _CollectiveMetrics()
+    return _metrics
+
+
+def transfer_metrics() -> _TransferMetrics:
+    global _transfer
+    if _transfer is None:
+        with _lock:
+            if _transfer is None:
+                _transfer = _TransferMetrics()
+    return _transfer
+
+
+def record_op(group: str, op: str, rank, seconds: float,
+              nbytes: Optional[int] = None):
+    m = collective_metrics()
+    ms = seconds * 1000.0
+    tags = {"group": group, "op": op, "rank": str(rank)}
+    m.op_ms.observe(ms, tags)
+    m.last_ms.set(ms, tags)
+    m.ops.inc(1, {"group": group, "op": op})
+    if nbytes:
+        m.bytes.inc(nbytes, {"group": group, "op": op})
+
+
+def record_bytes(group: str, op: str, nbytes: int):
+    if nbytes:
+        collective_metrics().bytes.inc(nbytes, {"group": group, "op": op})
+
+
+def record_p2p(group: str, direction: str, nbytes: int):
+    if nbytes:
+        collective_metrics().p2p_bytes.inc(nbytes, {"group": group, "dir": direction})
+
+
+@contextmanager
+def timed_op(group: str, op: str, rank, nbytes: Optional[int] = None):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_op(group, op, rank, time.perf_counter() - t0, nbytes)

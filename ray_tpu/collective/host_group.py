@@ -162,7 +162,10 @@ class HostGroup:
 
     # -- p2p -------------------------------------------------------------
     def send(self, arr: np.ndarray, dst: int, tag: int = 0):
+        from ray_tpu.collective import diagnostics
+
         arr = np.ascontiguousarray(arr)
+        diagnostics.record_p2p(self.group_name, "send", arr.nbytes)
         _send_msg(
             self._conn(dst),
             {"dtype": arr.dtype.str, "shape": list(arr.shape), "tag": tag},
@@ -187,6 +190,9 @@ class HostGroup:
                         f"collective peer rank {src} disconnected"
                     )
                 if got_tag == tag:
+                    from ray_tpu.collective import diagnostics
+
+                    diagnostics.record_p2p(self.group_name, "recv", arr.nbytes)
                     return arr
                 stash.append((got_tag, arr))
         finally:
@@ -200,10 +206,21 @@ class HostGroup:
 
     # -- collectives -----------------------------------------------------
     def barrier(self, tag: int = 0):
-        self.allreduce(np.zeros(1, np.float32), ReduceOp.SUM, tag=tag | (1 << 24))
+        from ray_tpu.collective import diagnostics
+
+        with diagnostics.timed_op(self.group_name, "barrier", self.rank):
+            self._allreduce(np.zeros(1, np.float32), ReduceOp.SUM, tag=tag | (1 << 24))
 
     def allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM, tag: int = 0) -> np.ndarray:
         """Ring reduce-scatter + ring all-gather over flattened chunks."""
+        from ray_tpu.collective import diagnostics
+
+        with diagnostics.timed_op(
+            self.group_name, "allreduce", self.rank, arr.nbytes
+        ):
+            return self._allreduce(arr, op, tag)
+
+    def _allreduce(self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM, tag: int = 0) -> np.ndarray:
         ws, rank = self.world_size, self.rank
         if ws == 1:
             return arr
@@ -239,6 +256,16 @@ class HostGroup:
     ) -> np.ndarray:
         """Input split into world_size equal parts along axis 0; returns
         this rank's reduced part."""
+        from ray_tpu.collective import diagnostics
+
+        with diagnostics.timed_op(
+            self.group_name, "reducescatter", self.rank, arr.nbytes
+        ):
+            return self._reducescatter(arr, op, tag)
+
+    def _reducescatter(
+        self, arr: np.ndarray, op: ReduceOp = ReduceOp.SUM, tag: int = 0
+    ) -> np.ndarray:
         ws, rank = self.world_size, self.rank
         if arr.shape[0] % ws:
             raise ValueError(f"reducescatter dim0 {arr.shape[0]} not divisible by {ws}")
@@ -257,6 +284,14 @@ class HostGroup:
         return parts[rank]
 
     def allgather(self, arr: np.ndarray, tag: int = 0) -> List[np.ndarray]:
+        from ray_tpu.collective import diagnostics
+
+        with diagnostics.timed_op(
+            self.group_name, "allgather", self.rank, arr.nbytes
+        ):
+            return self._allgather(arr, tag)
+
+    def _allgather(self, arr: np.ndarray, tag: int = 0) -> List[np.ndarray]:
         ws, rank = self.world_size, self.rank
         if ws == 1:
             return [arr]
@@ -272,6 +307,14 @@ class HostGroup:
         return out  # type: ignore[return-value]
 
     def broadcast(self, arr: np.ndarray, src: int, tag: int = 0) -> np.ndarray:
+        from ray_tpu.collective import diagnostics
+
+        with diagnostics.timed_op(
+            self.group_name, "broadcast", self.rank, arr.nbytes
+        ):
+            return self._broadcast(arr, src, tag)
+
+    def _broadcast(self, arr: np.ndarray, src: int, tag: int = 0) -> np.ndarray:
         ws, rank = self.world_size, self.rank
         if ws == 1:
             return arr
@@ -287,7 +330,12 @@ class HostGroup:
     def reduce(self, arr: np.ndarray, dst: int, op: ReduceOp = ReduceOp.SUM, tag: int = 0):
         # Host groups are small; allreduce and keep the value at dst. The
         # extra all-gather half is the price of code we don't duplicate.
-        out = self.allreduce(arr, op, tag=tag)
+        from ray_tpu.collective import diagnostics
+
+        with diagnostics.timed_op(
+            self.group_name, "reduce", self.rank, arr.nbytes
+        ):
+            out = self._allreduce(arr, op, tag=tag)
         return out if self.rank == dst else arr
 
     def destroy(self):

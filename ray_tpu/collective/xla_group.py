@@ -43,14 +43,24 @@ def in_graph_allreduce(x, mesh=None, axis_name: str = "ranks"):
     from jax import lax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from ray_tpu.collective import diagnostics
+    from ray_tpu.utils import jax_compat
+
     if mesh is None:
         mesh, axis_name = mesh_for_group(axis_name=axis_name)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=P(axis_name), out_specs=P()
+        jax_compat.shard_map, mesh=mesh, in_specs=P(axis_name), out_specs=P()
     )
     def _psum(shard):
         return lax.psum(shard.sum(axis=0), axis_name)
 
-    x = jax.device_put(x, NamedSharding(mesh, P(axis_name)))
-    return jax.jit(_psum)(x)
+    # Times the DISPATCH only (compile included on first call — the
+    # compile tracker attributes that part): blocking on the result here
+    # would force a host sync on a hot path purely for a gauge. Rank 0 =
+    # this process; in-graph collectives are SPMD within it.
+    with diagnostics.timed_op(
+        f"xla:{axis_name}", "in_graph_allreduce", 0, getattr(x, "nbytes", None)
+    ):
+        x = jax.device_put(x, NamedSharding(mesh, P(axis_name)))
+        return jax.jit(_psum)(x)

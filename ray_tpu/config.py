@@ -135,6 +135,19 @@ class Config:
     # 0 = pick a free port for the controller's HTTP observability endpoint
     # (/metrics Prometheus text + /api/v0/* state JSON); -1 disables it.
     dashboard_port: int = 0
+    # Node/device telemetry poll cadence (host CPU/mem + object store in
+    # the agents' controller heartbeat; per-device HBM + compile stats in
+    # workers' device_telemetry reports). 0 disables both loops.
+    node_telemetry_interval_ms: int = 2000
+    # Recompilation-storm detector: >= threshold compiles of the SAME
+    # function name inside the window flags a storm (warning log + state
+    # API + jax_recompile_storms_total).
+    compile_storm_threshold: int = 5
+    compile_storm_window_s: float = 60.0
+    # Per-metric cap on distinct label sets: series past the cap are
+    # dropped (counted in metrics_series_dropped_total) so per-request or
+    # per-task tags can't blow up the registry/controller/Prometheus.
+    metrics_max_series_per_metric: int = 200
 
     # --- fault injection (tests only; reference:
     # python/ray/tests/chaos/chaos_network_delay.yaml injects network

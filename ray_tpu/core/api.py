@@ -105,6 +105,11 @@ def init(
     _global_worker = CoreWorker(
         address, mode="driver", loop_runner=loop_runner, handler=DriverHandler()
     )
+    # Drivers run jax too (single-process training/bench loops): give
+    # them the same device-telemetry + compile-tracking reporting.
+    from ray_tpu.core.node_telemetry import start_process_telemetry
+
+    start_process_telemetry(_global_worker)
     atexit.register(shutdown)
     return {"address": address, "session_dir": _global_worker.session_dir}
 

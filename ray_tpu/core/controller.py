@@ -124,6 +124,10 @@ class NodeRecord:
     workers: Set[WorkerID] = field(default_factory=set)
     num_starting: int = 0
     max_workers: int = 32
+    # Latest telemetry heartbeat from this node's agent (host CPU/mem,
+    # object-store occupancy; controller-sampled for the head). Stamped
+    # with the CONTROLLER's clock on arrival ("ts").
+    telemetry: Dict[str, Any] = field(default_factory=dict)
     # Free TPU chip indices on this host; actors holding TPU resources get
     # concrete chips via TPU_VISIBLE_CHIPS (reference: accelerators/tpu.py
     # :155-195 isolation + resource_instance_set.cc per-instance accounting).
@@ -299,6 +303,10 @@ class Controller:
         # Serve engine flight-recorder snapshots, pushed by replicas
         # (rpc_serve_report) and served at /api/serve/engine.
         self.serve_state: Dict[str, dict] = {}
+        # Per-process device telemetry (HBM gauges + compile-tracker
+        # snapshots) pushed by workers/drivers (rpc_device_telemetry),
+        # keyed "node_hex/proc". Stale entries pruned on read.
+        self.device_state: Dict[str, dict] = {}
         self.dashboard_port: Optional[int] = None
 
         # Head node: controller doubles as its node agent.
@@ -2182,8 +2190,14 @@ class Controller:
 
     async def rpc_list_nodes(self, peer):
         out = []
+        devstate = self._live_device_state()
         for nid, node in self.nodes.items():
             res = self.cluster.nodes.get(nid)
+            devices = []
+            for payload in devstate.values():
+                if (payload.get("node_id") or "") == nid.hex():
+                    pid = payload.get("pid")
+                    devices.extend({**d, "pid": pid} for d in payload.get("devices", ()))
             out.append(
                 {
                     "node_id": nid.hex(),
@@ -2194,6 +2208,8 @@ class Controller:
                     "hostname": node.hostname,
                     "provider_instance_id": node.provider_instance_id,
                     "resources": res.to_dict() if res else {},
+                    "telemetry": node.telemetry,
+                    "devices": devices,
                 }
             )
         return out
@@ -2283,7 +2299,7 @@ class Controller:
                     cur["state"] = [a + b for a, b in zip(cur["state"], payload["state"])]
 
     async def rpc_metrics_snapshot(self, peer):
-        return {
+        snap = {
             name: {
                 "type": e["type"],
                 "description": e["description"],
@@ -2291,6 +2307,21 @@ class Controller:
             }
             for name, e in self.metrics.items()
         }
+        # Derived cross-rank straggler gauge: max-min of the ranks' last
+        # op latency per collective key. Computed at snapshot time (the
+        # controller is the only place all ranks' series meet), so
+        # Prometheus/Grafana see it like any reported gauge.
+        skew = self._collective_skew()
+        if skew:
+            snap["collective_skew_ms"] = {
+                "type": "gauge",
+                "description": "Cross-rank skew (max-min last op latency) per collective",
+                "series": [
+                    ([["group", r["group"]], ["op", r["op"]]], r["skew_ms"])
+                    for r in skew
+                ],
+            }
+        return snap
 
     async def rpc_serve_report(self, peer, key: str, snapshot: Optional[dict]):
         """An LLM engine's periodic flight-recorder snapshot (reference
@@ -2322,6 +2353,192 @@ class Controller:
         cutoff = time.time() - 120.0
         return {k: v for k, v in self.serve_state.items()
                 if v.get("ts", 0) >= cutoff}
+
+    # =================================================================
+    # Node/device telemetry (reference: raylet resource-usage heartbeats
+    # + the dashboard reporter agent's host/GPU stats)
+    # =================================================================
+    async def rpc_node_telemetry(self, peer, node_id: NodeID, sample: dict):
+        node = self.nodes.get(node_id)
+        if node is None:
+            return
+        # Controller clock, same reason as rpc_serve_report: staleness
+        # checks must not trust a skewed worker host's wall time.
+        sample["ts"] = time.time()
+        node.telemetry = sample
+
+    async def rpc_device_telemetry(self, peer, key: str, payload: dict):
+        """A worker/driver process's per-device HBM sample + compile
+        snapshot. Keyed node/proc; dead processes stop reporting and age
+        out (pruned on the next report and on read)."""
+        payload["ts"] = time.time()
+        self.device_state[key] = payload
+        cutoff = time.time() - 60.0
+        for k in [k for k, v in self.device_state.items()
+                  if v.get("ts", 0) < cutoff]:
+            del self.device_state[k]
+
+    def _live_device_state(self) -> Dict[str, dict]:
+        cutoff = time.time() - 60.0
+        return {k: v for k, v in self.device_state.items()
+                if v.get("ts", 0) >= cutoff}
+
+    async def rpc_collective_skew(self, peer):
+        return self._collective_skew()
+
+    async def rpc_compile_state(self, peer):
+        """Per-process compile-tracker snapshots (from device telemetry):
+        {node_hex/proc: compile snapshot}."""
+        return {
+            k: v.get("compile", {})
+            for k, v in self._live_device_state().items()
+            if v.get("compile")
+        }
+
+    async def rpc_summarize_resources(self, peer):
+        """Cluster resource rollup (reference: `ray status` /
+        summarize_* in util/state/api.py): per-node host CPU/mem +
+        object-store occupancy from the telemetry heartbeats, per-device
+        HBM used/limit and compile activity from worker device reports,
+        plus cluster-wide totals."""
+        now = time.time()
+        devstate = self._live_device_state()
+        by_node: Dict[str, list] = {}
+        for key, payload in devstate.items():
+            node_hex = payload.get("node_id") or key.split("/")[0]
+            by_node.setdefault(node_hex, []).append(payload)
+        nodes_out = {}
+        totals = {
+            "mem_used_bytes": 0, "mem_total_bytes": 0,
+            "hbm_used_bytes": 0, "hbm_limit_bytes": 0, "hbm_peak_bytes": 0,
+            "object_store_used": 0, "object_store_capacity": 0,
+            "num_devices": 0, "compiles": 0, "compile_seconds": 0.0,
+            "active_storms": [],
+        }
+        for nid, node in self.nodes.items():
+            res = self.cluster.nodes.get(nid)
+            tel = node.telemetry or {}
+            host = tel.get("host", {})
+            store = tel.get("object_store", {})
+            row = {
+                "hostname": node.hostname,
+                "is_head": node.peer is None,
+                "state": node.state,
+                "num_workers": len(node.workers),
+                "host": host,
+                "object_store": {
+                    "used": store.get("used", 0),
+                    "capacity": store.get("capacity", 0),
+                    "num_objects": store.get("num_objects", 0),
+                    "num_spilled": store.get("num_spilled", 0),
+                },
+                "resources": {
+                    "total": res.total.to_dict() if res else {},
+                    "available": res.available.to_dict() if res else {},
+                },
+                "telemetry_age_s": round(now - tel["ts"], 2) if "ts" in tel else None,
+                "devices": [],
+                "compile": {
+                    "compiles": 0, "compile_seconds": 0.0,
+                    "compiles_per_min": 0.0,
+                    "storms_total": 0, "active_storms": [],
+                },
+            }
+            for payload in by_node.get(nid.hex(), ()):
+                pid = payload.get("pid")
+                for d in payload.get("devices", ()):
+                    row["devices"].append({**d, "pid": pid})
+                comp = payload.get("compile") or {}
+                row["compile"]["compiles"] += comp.get("compiles", 0)
+                row["compile"]["compile_seconds"] += comp.get("compile_seconds", 0.0)
+                row["compile"]["storms_total"] += comp.get("storms_total", 0)
+                # compiles in the tracker's rolling window, normalized to
+                # per-minute — the live "compiles/min" column of `status`
+                window = comp.get("storm_window_s") or 60.0
+                in_window = sum(
+                    f.get("window_count", 0)
+                    for f in (comp.get("functions") or {}).values()
+                )
+                row["compile"]["compiles_per_min"] = round(
+                    row["compile"].get("compiles_per_min", 0.0)
+                    + in_window * 60.0 / window, 1,
+                )
+                for name in (comp.get("active_storms") or {}):
+                    row["compile"]["active_storms"].append(name)
+            row["devices"].sort(key=lambda d: (d.get("pid") or 0, d["id"]))
+            totals["mem_used_bytes"] += host.get("mem_used_bytes", 0)
+            totals["mem_total_bytes"] += host.get("mem_total_bytes", 0)
+            totals["object_store_used"] += row["object_store"]["used"]
+            totals["object_store_capacity"] += row["object_store"]["capacity"]
+            totals["hbm_used_bytes"] += sum(d["bytes_in_use"] for d in row["devices"])
+            totals["hbm_limit_bytes"] += sum(d["bytes_limit"] for d in row["devices"])
+            totals["hbm_peak_bytes"] += sum(
+                d["peak_bytes_in_use"] for d in row["devices"]
+            )
+            totals["num_devices"] += len(row["devices"])
+            totals["compiles"] += row["compile"]["compiles"]
+            totals["compile_seconds"] += round(row["compile"]["compile_seconds"], 4)
+            totals["active_storms"].extend(row["compile"]["active_storms"])
+            nodes_out[nid.hex()] = row
+        totals["collective_skew_ms"] = self._collective_skew()
+        return {"nodes": nodes_out, "totals": totals}
+
+    def _collective_skew(self) -> List[dict]:
+        """Cross-rank skew (max - min of the last per-rank op latency)
+        per collective key, derived from the ``collective_last_op_ms``
+        gauge series every rank reports — the straggler view per
+        ring/mesh. Sorted worst-first."""
+        entry = self.metrics.get("collective_last_op_ms")
+        if not entry:
+            return []
+        per_key: Dict[Tuple[str, str], Dict[str, float]] = {}
+        for tags, value in entry["series"].items():
+            t = dict(tags)
+            key = (t.get("group", "?"), t.get("op", "?"))
+            per_key.setdefault(key, {})[t.get("rank", "?")] = value
+        out = []
+        for (group, op), ranks in per_key.items():
+            if len(ranks) < 2:
+                continue
+            mx, mn = max(ranks.values()), min(ranks.values())
+            out.append(
+                {
+                    "group": group, "op": op,
+                    "skew_ms": round(mx - mn, 3),
+                    "max_ms": round(mx, 3), "min_ms": round(mn, 3),
+                    "slowest_rank": max(ranks, key=ranks.get),
+                    "ranks": len(ranks),
+                }
+            )
+        out.sort(key=lambda r: -r["skew_ms"])
+        return out
+
+    async def _head_telemetry_loop(self):
+        """The controller doubles as the head node's agent — sample the
+        head host + its store on the same cadence the agents report."""
+        interval = self.config.node_telemetry_interval_ms / 1000.0
+        if interval <= 0:
+            return
+        from ray_tpu.core import node_telemetry
+        from ray_tpu.core.memory_monitor import HostCpuSampler
+        from ray_tpu.util import metrics as _metrics
+
+        cpu = HostCpuSampler()
+        cpu.sample()  # prime the delta
+        while not self._shutdown.is_set():
+            await asyncio.sleep(interval)
+            node = self.nodes.get(self.head_node_id)
+            if node is None:
+                continue
+            sample = node_telemetry.build_node_sample(cpu, self.head_store)
+            sample["ts"] = time.time()
+            node.telemetry = sample
+            # Metrics recorded IN the controller process (head-side
+            # object transfers, chunk serving) have no CoreWorker flusher
+            # — fold them straight into the aggregation.
+            records = _metrics.drain_records()
+            if records:
+                await self.rpc_metrics_report(None, records)
 
     async def rpc_resource_demand(self, peer):
         """Unmet demand for the autoscaler: resource sets of tasks that are
@@ -2699,6 +2916,11 @@ class Controller:
         if self.config.object_auto_gc:
             self._gc_task = asyncio.get_running_loop().create_task(
                 self._gc_sweep_loop()
+            )
+        if self.config.node_telemetry_interval_ms > 0:
+            # Strong ref (loop holds tasks weakly, same as the monitor).
+            self._telemetry_task = asyncio.get_running_loop().create_task(
+                self._head_telemetry_loop()
             )
         if self.config.dashboard_port >= 0:
             from ray_tpu.core.http_gateway import start_http_gateway

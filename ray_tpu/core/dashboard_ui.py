@@ -38,6 +38,8 @@ DASHBOARD_HTML = """<!doctype html>
 <div id="err"></div>
 <h2>Resources</h2><div id="resources"></div>
 <h2>Nodes</h2><div id="nodes"></div>
+<h2>Telemetry <small>(host / HBM / compiles / collective skew)</small></h2>
+<div id="telemetry"></div>
 <h2>Actors</h2><div id="actors"></div>
 <h2>Tasks <small>(most recent)</small></h2><div id="tasks"></div>
 <h2>Placement groups</h2><div id="pgs"></div>
@@ -72,6 +74,8 @@ async function refresh() {
     ]);
     let jobs = [];
     try { jobs = await get("/api/jobs"); } catch (e) {}
+    let summary = null;
+    try { summary = await get("/api/v0/summarize_resources"); } catch (e) {}
     document.getElementById("ts").textContent = new Date().toLocaleTimeString();
     document.getElementById("err").textContent = "";
     let res = "<table>";
@@ -94,6 +98,35 @@ async function refresh() {
         const t = (res2.total||{}).CPU ?? "-", a = (res2.available||{}).CPU ?? "-";
         return `${a} / ${t}`; }, "num"],
     ]);
+    const gb = (n) => ((n || 0) / (1 << 30)).toFixed(1);
+    if (summary && summary.nodes) {
+      const rows = Object.entries(summary.nodes).map(([id, n]) => ({id, ...n}));
+      let h = table(rows, [
+        ["node", r => `<code>${esc(r.id.slice(0,10))}</code>` +
+                      (r.is_head ? ' <span class="pill">head</span>' : "")],
+        ["cpu%", r => ((r.host||{}).cpu_percent ?? 0).toFixed(1), "num"],
+        ["mem GB", r => `${gb((r.host||{}).mem_used_bytes)} / ${gb((r.host||{}).mem_total_bytes)}`, "num"],
+        ["store GB", r => `${gb((r.object_store||{}).used)} / ${gb((r.object_store||{}).capacity)}`, "num"],
+        ["HBM used/limit GB", r => (r.devices||[]).map(d => {
+            const pct = d.bytes_limit ? Math.round(100*d.bytes_in_use/d.bytes_limit) : 0;
+            return `${d.id}: ${gb(d.bytes_in_use)}/${gb(d.bytes_limit)}` +
+                   ` <span class="bar" style="width:${Math.min(pct,100)/3}px"></span>`;
+          }).join("<br>") || "<small>no device reports</small>"],
+        ["compiles/min", r => ((r.compile||{}).compiles_per_min ?? 0).toFixed(1), "num"],
+        ["storms", r => ((r.compile||{}).active_storms||[]).map(s =>
+            `<span class="pill bad">${esc(s)}</span>`).join(" ")],
+      ]);
+      const skew = (summary.totals||{}).collective_skew_ms || [];
+      if (skew.length) {
+        h += "<p><b>top-skew collectives</b></p>" + table(skew.slice(0,8), [
+          ["group", r => esc(r.group)], ["op", r => esc(r.op)],
+          ["skew ms", r => r.skew_ms, "num"], ["max ms", r => r.max_ms, "num"],
+          ["min ms", r => r.min_ms, "num"],
+          ["slowest rank", r => esc(r.slowest_rank), "num"],
+        ]);
+      }
+      document.getElementById("telemetry").innerHTML = h;
+    }
     document.getElementById("actors").innerHTML = table(actors, [
       ["actor", r => `<code>${esc(r.actor_id.slice(0,10))}</code>`],
       ["name", r => esc(r.name || "")],

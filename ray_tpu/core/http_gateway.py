@@ -37,6 +37,8 @@ _STATE_ROUTES = {
     "placement_groups": "rpc_pg_table",
     "cluster_resources": "rpc_cluster_resources",
     "available_resources": "rpc_available_resources",
+    "summarize_resources": "rpc_summarize_resources",
+    "compile": "rpc_compile_state",
 }
 
 

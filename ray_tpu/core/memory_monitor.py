@@ -52,6 +52,37 @@ def system_memory() -> Tuple[int, int]:
     return total - avail, max(total, 1)
 
 
+def cpu_times() -> Tuple[int, int]:
+    """(busy_jiffies, total_jiffies) from the aggregate /proc/stat line.
+    Utilization is a DELTA between two samples — see HostCpuSampler."""
+    try:
+        with open("/proc/stat") as f:
+            parts = f.readline().split()[1:]
+    except (FileNotFoundError, IndexError):  # pragma: no cover - non-linux
+        return 0, 1
+    vals = [int(x) for x in parts[:8]]
+    idle = vals[3] + (vals[4] if len(vals) > 4 else 0)  # idle + iowait
+    total = sum(vals)
+    return total - idle, max(total, 1)
+
+
+class HostCpuSampler:
+    """Stateful CPU-utilization sampler (first call returns 0.0; later
+    calls return busy fraction over the interval since the previous
+    call). One instance per polling loop — the deltas are its state."""
+
+    def __init__(self, reader: Callable[[], Tuple[int, int]] = cpu_times):
+        self.reader = reader
+        self._prev: Optional[Tuple[int, int]] = None
+
+    def sample(self) -> float:
+        busy, total = self.reader()
+        prev, self._prev = self._prev, (busy, total)
+        if prev is None or total <= prev[1]:
+            return 0.0
+        return max(0.0, min(1.0, (busy - prev[0]) / (total - prev[1])))
+
+
 class MemoryMonitor:
     def __init__(
         self,

@@ -562,6 +562,9 @@ class NodeAgent:
         monitor_task = asyncio.get_running_loop().create_task(
             self._memory_monitor_loop()
         )
+        telemetry_task = asyncio.get_running_loop().create_task(
+            self._telemetry_loop()
+        )
         try:
             while not self._exit.is_set():
                 reap_children()
@@ -572,9 +575,45 @@ class NodeAgent:
                     pass
         finally:
             monitor_task.cancel()
+            telemetry_task.cancel()
             kill_children()
             self._chunk_reader.close()
             self.store.destroy()
+
+    async def _telemetry_loop(self):
+        """Periodic node telemetry heartbeat: host CPU/mem (cgroup-aware),
+        object-store occupancy, and worker counts, shipped to the
+        controller (reference: the raylet's ReportResourceUsage heartbeat
+        + the dashboard reporter agent's host stats). Also drains this
+        process's metric registry — the agent has no CoreWorker, so the
+        normal metrics flusher can't reach the controller for it (the
+        object-transfer histograms recorded here ride this loop)."""
+        interval_ms = int(self._config.get("node_telemetry_interval_ms", 2000))
+        if interval_ms <= 0:
+            return
+        from ray_tpu.core import node_telemetry
+        from ray_tpu.core.memory_monitor import HostCpuSampler
+        from ray_tpu.util import metrics as _metrics
+
+        cpu = HostCpuSampler()
+        cpu.sample()  # prime the delta
+        while not self._exit.is_set():
+            await asyncio.sleep(interval_ms / 1000.0)
+            sample = node_telemetry.build_node_sample(cpu, self.store)
+            sample["num_direct_workers"] = len(self._direct)
+            sample["num_children"] = len(_children)
+            records = _metrics.drain_records()
+            try:
+                await self._controller_peer.notify(
+                    "node_telemetry", self.node_id, sample
+                )
+                if records:
+                    await self._controller_peer.notify("metrics_report", records)
+            except Exception as e:  # noqa: BLE001 — transient controller hiccup
+                if self._controller_peer.closed or self._exit.is_set():
+                    return
+                _metrics.requeue_records(records)
+                logger.warning("telemetry report failed: %s", e)
 
     async def _memory_monitor_loop(self):
         """Per-node OOM monitoring (reference: every raylet runs its own

@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import logging
+import time as _time
 from typing import Optional
 
 from ray_tpu.utils import rpc
@@ -45,6 +46,10 @@ async def fetch_into(src_peer, oid: ObjectID, size: int, view, chunk_bytes: int,
     BufferError and clean up a torn object."""
     if size <= 0:
         return None
+    from ray_tpu.collective.diagnostics import transfer_metrics
+
+    tm = transfer_metrics()
+    t0 = _time.perf_counter()
     sem = asyncio.Semaphore(max(1, window))
     done_offsets: set = set()
     watermark = 0
@@ -73,10 +78,13 @@ async def fetch_into(src_peer, oid: ObjectID, size: int, view, chunk_bytes: int,
         *(one(off) for off in range(0, size, chunk_bytes)),
         return_exceptions=True,
     )
+    tm.fetch_ms.observe((_time.perf_counter() - t0) * 1000.0)
+    tm.chunks.inc(len(results))
     for r in results:
         if isinstance(r, BaseException):
             # the traceback chain would pin frames that captured `view`
             return r.with_traceback(None)
+    tm.bytes.inc(size)
     return None
 
 
@@ -131,6 +139,9 @@ class ChunkReader:
         self._bufs: "collections.OrderedDict[ObjectID, object]" = collections.OrderedDict()
 
     def read(self, oid: ObjectID, offset: int, length: int) -> bytes:
+        from ray_tpu.collective.diagnostics import transfer_metrics
+
+        transfer_metrics().chunks_served.inc()
         buf = self._bufs.pop(oid, None)
         if buf is None:
             self.store.ensure_local(oid)

@@ -639,6 +639,11 @@ def main():
     runtime_context._set_process(node_id.hex(), worker_id.hex())
     api._attach_worker(core)
     handler.attach_executor(TaskExecutor(core))
+    # Device telemetry (per-device HBM + compile tracking): no-ops until
+    # user code imports jax in this worker, then reports ~every poll.
+    from ray_tpu.core.node_telemetry import start_process_telemetry
+
+    start_process_telemetry(core)
     agent_addr = os.environ.get("RAY_TPU_AGENT_ADDR", "")
     if agent_addr:
         # Direct-pool worker spawned by a node agent: announce to the

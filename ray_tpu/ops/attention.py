@@ -555,7 +555,9 @@ def make_flash_attn_fn(mesh, causal: bool = True):
     """
 
     def attn(q, k, v):
-        cur = jax.sharding.get_abstract_mesh()
+        from ray_tpu.utils import jax_compat
+
+        cur = jax_compat.get_abstract_mesh()
         use = cur if (cur is not None and cur.shape) else mesh
         if getattr(use, "size", 1) <= 1:
             return flash_attention(q, k, v, causal, None)
@@ -594,7 +596,7 @@ def make_flash_attn_fn(mesh, causal: bool = True):
         from jax.sharding import PartitionSpec as P
 
         qspec = P(batch_axes or None, head_axis, None, None)
-        fn = jax.shard_map(
+        fn = jax_compat.shard_map(
             lambda q, k, v: flash_attention(q, k, v, causal, None),
             mesh=use,
             in_specs=(qspec, qspec, qspec),

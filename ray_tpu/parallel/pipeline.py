@@ -23,10 +23,13 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _pipeline_body(stage_params, h_mb, positions, *, stage_fn, num_stages, num_microbatches, axis_name):
+def _pipeline_body(stage_params, stage_ids, h_mb, positions, *, stage_fn, num_stages, num_microbatches, axis_name):
     """shard_map body. stage_params: [1, L/S, ...] (local stage shard);
+    stage_ids: [1] this stage's index (an arange sharded over pp —
+    lax.axis_index lowers to a PartitionId op that the SPMD partitioner
+    rejects inside a partially-manual shard_map on older jax);
     h_mb: [M, mb, s, d] microbatched activations (auto-sharded on batch)."""
-    p = jax.lax.axis_index(axis_name)
+    p = stage_ids[0]
     M, S = num_microbatches, num_stages
     params_local = jax.tree.map(lambda x: x[0], stage_params)
     is_first = p == 0
@@ -95,7 +98,9 @@ def pipeline_apply(
     h_spec = P(None, None, seq_axis, None) if seq_axis else P()
     pos_spec = P(None, seq_axis) if seq_axis else P()
     manual = {axis_name} | ({seq_axis} if seq_axis else set())
-    body = jax.shard_map(
+    from ray_tpu.utils import jax_compat
+
+    body = jax_compat.shard_map(
         functools.partial(
             _pipeline_body,
             stage_fn=stage_fn,
@@ -106,6 +111,7 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=(
             jax.tree.map(lambda _: P(axis_name), stacked_stage_params),
+            P(axis_name),
             h_spec,
             pos_spec,
         ),
@@ -113,7 +119,8 @@ def pipeline_apply(
         axis_names=manual,
         check_vma=False,
     )
-    out = body(stacked_stage_params, h_mb, pos_mb)
+    stage_ids = jnp.arange(num_stages, dtype=jnp.int32)
+    out = body(stacked_stage_params, stage_ids, h_mb, pos_mb)
     return out.reshape(b, *h.shape[1:])
 
 

@@ -35,7 +35,9 @@ def ulysses_attention_local(q, k, v, axis_name: str, scale: Optional[float] = No
     """Per-shard body — call inside shard_map with q,k,v local shards
     ``[b, h, s_local, d]``. Requires ``h % sp == 0`` (heads per device
     after any tp split must still divide sp)."""
-    sp = jax.lax.axis_size(axis_name)
+    from ray_tpu.utils import jax_compat
+
+    sp = jax_compat.axis_size(axis_name)
     h = q.shape[1]
     if h % sp != 0:
         raise ValueError(
@@ -64,9 +66,11 @@ def make_ulysses_attn_fn(mesh: Mesh, axis_name: str = "sp"):
 
     def attn(q, k, v):
         # nestable under a pp shard_map — see ring.make_ring_attn_fn
-        cur = jax.sharding.get_abstract_mesh()
+        from ray_tpu.utils import jax_compat
+
+        cur = jax_compat.get_abstract_mesh()
         use = cur if (cur is not None and cur.shape) else mesh
-        fn = jax.shard_map(
+        fn = jax_compat.shard_map(
             body,
             mesh=use,
             in_specs=(spec, spec, spec),

@@ -113,14 +113,125 @@ def cmd_stop(args):
     return 0
 
 
+def _gb(n) -> str:
+    return f"{(n or 0) / (1 << 30):.1f}"
+
+
+def _render_status(summary: dict, total: dict, avail: dict, out=print):
+    """The `ray-tpu status` cluster view (reference: `ray status` +
+    the dashboard's cluster page): resource availability, per-node host/
+    store/HBM/compile telemetry, and the top-skew collectives table."""
+    nodes = summary.get("nodes", {})
+    totals = summary.get("totals", {})
+    alive = sum(1 for n in nodes.values() if n.get("state") == "ALIVE")
+    out(f"nodes: {len(nodes)} ({alive} alive)")
+    for k in sorted(total):
+        out(f"  {k}: {avail.get(k, 0):g}/{total[k]:g} available")
+    out(
+        f"host memory: {_gb(totals.get('mem_used_bytes'))}/"
+        f"{_gb(totals.get('mem_total_bytes'))} GB  "
+        f"object store: {_gb(totals.get('object_store_used'))}/"
+        f"{_gb(totals.get('object_store_capacity'))} GB"
+    )
+    if totals.get("num_devices"):
+        out(
+            f"device HBM: {_gb(totals.get('hbm_used_bytes'))}/"
+            f"{_gb(totals.get('hbm_limit_bytes'))} GB over "
+            f"{totals['num_devices']} device(s) "
+            f"(peak {_gb(totals.get('hbm_peak_bytes'))} GB)"
+        )
+    out("")
+    hdr = f"{'node':<14}{'host':<16}{'cpu%':>6}{'mem GB':>12}{'store GB':>11}{'compiles/min':>14}  devices (HBM used/limit GB)"
+    out(hdr)
+    for nid, row in nodes.items():
+        host = row.get("host", {})
+        store = row.get("object_store", {})
+        comp = row.get("compile", {})
+        devs = row.get("devices", [])
+        dev_str = " ".join(
+            f"{d['id']}:{_gb(d['bytes_in_use'])}/{_gb(d['bytes_limit'])}"
+            for d in devs
+        ) or "-"
+        name = nid[:10] + ("*" if row.get("is_head") else "")
+        mem = f"{_gb(host.get('mem_used_bytes'))}/{_gb(host.get('mem_total_bytes'))}"
+        st = f"{_gb(store.get('used'))}/{_gb(store.get('capacity'))}"
+        out(
+            f"{name:<14}{row.get('hostname', '?')[:15]:<16}"
+            f"{host.get('cpu_percent', 0):>6.1f}{mem:>12}{st:>11}"
+            f"{comp.get('compiles_per_min', 0):>14.1f}  {dev_str}"
+        )
+        for storm in comp.get("active_storms", ()):
+            out(f"    !! recompilation storm: {storm}")
+    skew = totals.get("collective_skew_ms") or []
+    if skew:
+        out("")
+        out("top-skew collectives (max-min last op latency per ring):")
+        out(f"  {'group':<16}{'op':<14}{'skew ms':>9}{'max ms':>9}{'min ms':>9}  slowest rank")
+        for r in skew[:8]:
+            out(
+                f"  {r['group'][:15]:<16}{r['op']:<14}{r['skew_ms']:>9.2f}"
+                f"{r['max_ms']:>9.2f}{r['min_ms']:>9.2f}  {r['slowest_rank']}"
+            )
+
+
+def _status_fixture() -> tuple:
+    """Canned summarize_resources()-shaped data for `status --offline`:
+    exercises every rendering path (devices, storms, skew) with no
+    cluster — the tier-1 smoke that keeps the view from rotting."""
+    summary = {
+        "nodes": {
+            "aabbccddee00": {
+                "hostname": "tpu-host-0", "is_head": True, "state": "ALIVE",
+                "num_workers": 4,
+                "host": {"cpu_percent": 37.5, "mem_used_bytes": 9 << 30,
+                         "mem_total_bytes": 64 << 30, "load_1m": 2.5},
+                "object_store": {"used": 1 << 28, "capacity": 2 << 30,
+                                 "num_objects": 12, "num_spilled": 0},
+                "resources": {"total": {"CPU": 8, "TPU": 4},
+                              "available": {"CPU": 6, "TPU": 2}},
+                "telemetry_age_s": 1.2,
+                "devices": [
+                    {"id": i, "platform": "tpu", "kind": "TPU v5e", "pid": 1234,
+                     "bytes_in_use": (11 + i) << 30,
+                     "peak_bytes_in_use": (12 + i) << 30,
+                     "bytes_limit": 16 << 30}
+                    for i in range(2)
+                ],
+                "compile": {"compiles": 42, "compile_seconds": 31.5,
+                            "compiles_per_min": 6.0, "storms_total": 1,
+                            "active_storms": ["decode_step"]},
+            },
+        },
+        "totals": {
+            "mem_used_bytes": 9 << 30, "mem_total_bytes": 64 << 30,
+            "hbm_used_bytes": 23 << 30, "hbm_limit_bytes": 32 << 30,
+            "hbm_peak_bytes": 25 << 30, "num_devices": 2,
+            "object_store_used": 1 << 28, "object_store_capacity": 2 << 30,
+            "compiles": 42, "compile_seconds": 31.5,
+            "active_storms": ["decode_step"],
+            "collective_skew_ms": [
+                {"group": "train-ring", "op": "allreduce", "skew_ms": 18.4,
+                 "max_ms": 42.1, "min_ms": 23.7, "slowest_rank": "3",
+                 "ranks": 8},
+            ],
+        },
+    }
+    total = {"CPU": 8.0, "TPU": 4.0}
+    avail = {"CPU": 6.0, "TPU": 2.0}
+    return summary, total, avail
+
+
 def cmd_status(args):
+    if args.offline:
+        summary, total, avail = _status_fixture()
+        _render_status(summary, total, avail)
+        return 0
     rt = _connect()
+    from ray_tpu.util import state as state_api
+
     total = rt.cluster_resources()
     avail = rt.available_resources()
-    nodes = rt.nodes()
-    print(f"nodes: {len(nodes)} ({sum(1 for n in nodes if n['state'] == 'ALIVE')} alive)")
-    for k in sorted(total):
-        print(f"  {k}: {avail.get(k, 0):g}/{total[k]:g} available")
+    _render_status(state_api.summarize_resources(), total, avail)
     return 0
 
 
@@ -388,7 +499,15 @@ def main(argv=None):
     sp = sub.add_parser("attach", help="interactive shell wired to a launched cluster")
     sp.add_argument("cluster")
     sp.set_defaults(fn=cmd_attach)
-    sub.add_parser("status", help="cluster resource status").set_defaults(fn=cmd_status)
+    sp = sub.add_parser(
+        "status",
+        help="cluster table: resources, host/HBM telemetry, compiles, skew",
+    )
+    sp.add_argument(
+        "--offline", action="store_true",
+        help="render from a built-in fixture (no cluster; smoke-tests the view)",
+    )
+    sp.set_defaults(fn=cmd_status)
 
     sp = sub.add_parser("submit", help="submit a job: ray-tpu submit -- python x.py")
     sp.add_argument("--no-wait", action="store_true")

@@ -63,6 +63,10 @@ ROWS = (
                    "serve_batch_")),
     ("Serve Engine", ("serve_engine_",)),
     ("Train", ("train_",)),
+    ("Cluster Resources", ("tpu_hbm_", "node_", "object_store_",
+                           "metrics_series_")),
+    ("Compilation", ("jax_",)),
+    ("Collectives", ("collective_", "object_transfer_")),
     ("Application", ("",)),
 )
 

@@ -19,6 +19,31 @@ from typing import Dict, List, Optional, Sequence, Tuple
 _lock = threading.Lock()
 _registry: List["Metric"] = []
 _flusher_started = False
+# Dropped-series accounting (cardinality cap): metric name -> drop count
+# since the last drain. Synthesized into ``metrics_series_dropped_total``
+# records at flush time — NOT a Metric instance, so the counter itself
+# can never recurse into the cap.
+_dropped_series: Dict[str, float] = {}
+
+
+def _series_cap() -> int:
+    """Per-metric cap on distinct label sets (config
+    ``metrics_max_series_per_metric``). Prefers the cluster config the
+    controller handed this process at registration (so per-init
+    ``_system_config`` overrides reach the recording side), falling back
+    to env/defaults. Read lazily so library imports don't force config
+    initialization."""
+    try:
+        from ray_tpu.core import api
+
+        core = api._global_worker
+        if core is not None:
+            return int(core.config.get("metrics_max_series_per_metric", 200))
+        from ray_tpu.config import get_config
+
+        return int(get_config().metrics_max_series_per_metric)
+    except Exception:  # noqa: BLE001 — config unavailable (odd embedders)
+        return 200
 
 
 def _tags_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
@@ -30,13 +55,20 @@ class Metric:
 
     TYPE = "untyped"
 
-    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = (),
+                 max_series: Optional[int] = None):
         if not name:
             raise ValueError("metric name is required")
         self.name = name
         self.description = description
         self.tag_keys = tuple(tag_keys)
         self._default_tags: Dict[str, str] = {}
+        # Cardinality bound: label sets ever admitted by this metric. A
+        # NEW label set past the cap is dropped (and counted) — a
+        # per-request/per-task tag can't blow up the registry, the
+        # controller aggregation, or the Prometheus exposition.
+        self._seen_keys: set = set()
+        self._max_series = max_series
         with _lock:
             _registry.append(self)
         _ensure_flusher()
@@ -52,6 +84,24 @@ class Metric:
             return out
         return tags
 
+    def _cap(self) -> int:
+        """Resolve the series cap OUTSIDE _lock: _series_cap may import
+        (api/config), and running Python's import machinery under the
+        metrics lock would serialize every recording thread behind it —
+        and risk a _lock→import-lock inversion against a thread
+        constructing a Metric at module import time."""
+        return self._max_series if self._max_series is not None else _series_cap()
+
+    def _admit_locked(self, key: tuple, cap: int) -> bool:
+        """Caller holds _lock. False = series dropped (over the cap)."""
+        if key in self._seen_keys:
+            return True
+        if len(self._seen_keys) >= cap:
+            _dropped_series[self.name] = _dropped_series.get(self.name, 0.0) + 1.0
+            return False
+        self._seen_keys.add(key)
+        return True
+
     # -- flush protocol -----------------------------------------------------
     def _drain(self) -> List[tuple]:
         """Return (name, type, desc, tags, payload) records and reset deltas."""
@@ -61,15 +111,18 @@ class Metric:
 class Counter(Metric):
     TYPE = "counter"
 
-    def __init__(self, name, description="", tag_keys=()):
+    def __init__(self, name, description="", tag_keys=(), max_series=None):
         self._deltas: Dict[tuple, float] = {}
-        super().__init__(name, description, tag_keys)
+        super().__init__(name, description, tag_keys, max_series)
 
     def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
         if value < 0:
             raise ValueError("Counter.inc() requires a non-negative value")
         key = _tags_key(self._merged(tags))
+        cap = self._cap()
         with _lock:
+            if not self._admit_locked(key, cap):
+                return
             self._deltas[key] = self._deltas.get(key, 0.0) + value
 
     def _drain(self):
@@ -81,13 +134,17 @@ class Counter(Metric):
 class Gauge(Metric):
     TYPE = "gauge"
 
-    def __init__(self, name, description="", tag_keys=()):
+    def __init__(self, name, description="", tag_keys=(), max_series=None):
         self._values: Dict[tuple, float] = {}
-        super().__init__(name, description, tag_keys)
+        super().__init__(name, description, tag_keys, max_series)
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tags_key(self._merged(tags))
+        cap = self._cap()
         with _lock:
-            self._values[_tags_key(self._merged(tags))] = float(value)
+            if not self._admit_locked(key, cap):
+                return
+            self._values[key] = float(value)
 
     def _drain(self):
         with _lock:
@@ -98,16 +155,20 @@ class Gauge(Metric):
 class Histogram(Metric):
     TYPE = "histogram"
 
-    def __init__(self, name, description="", boundaries: Sequence[float] = (), tag_keys=()):
+    def __init__(self, name, description="", boundaries: Sequence[float] = (), tag_keys=(),
+                 max_series=None):
         if not boundaries:
             raise ValueError("Histogram requires boundaries")
         self.boundaries = sorted(float(b) for b in boundaries)
         self._state: Dict[tuple, list] = {}  # tags -> [bucket_counts..., sum, count]
-        super().__init__(name, description, tag_keys)
+        super().__init__(name, description, tag_keys, max_series)
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
         key = _tags_key(self._merged(tags))
+        cap = self._cap()
         with _lock:
+            if not self._admit_locked(key, cap):
+                return
             st = self._state.get(key)
             if st is None:
                 st = self._state[key] = [0] * (len(self.boundaries) + 1) + [0.0, 0]
@@ -130,26 +191,55 @@ class Histogram(Metric):
 _unflushed: List[tuple] = []  # drained records a failed report must not lose
 
 
-def _flush_once() -> bool:
+def drain_records() -> List[tuple]:
+    """Drain every registered metric (plus dropped-series accounting and
+    any re-queued unflushed records) into report records. Used by
+    _flush_once AND by processes without a CoreWorker — the node agent
+    ships these over its own controller connection."""
     global _unflushed
+    with _lock:
+        metrics = list(_registry)
+        records, _unflushed = _unflushed, []
+        dropped = dict(_dropped_series)
+        _dropped_series.clear()
+    for m in metrics:
+        records.extend(m._drain())
+    for name, n in dropped.items():
+        records.append(
+            (
+                "metrics_series_dropped_total",
+                "counter",
+                "Metric series dropped by the per-metric label-cardinality cap",
+                (("metric", name),),
+                n,
+            )
+        )
+    return records
+
+
+def requeue_records(records: List[tuple]):
+    """Put drained records back so a failed report isn't lost (bounded:
+    oldest records are trimmed first — the just-drained batch is the
+    newest and goes at the tail)."""
+    global _unflushed
+    with _lock:
+        _unflushed = (_unflushed + records)[-10000:]
+
+
+def _flush_once() -> bool:
     from ray_tpu.core import api
 
     core = api._global_worker
     if core is None:
         return False
-    with _lock:
-        metrics = list(_registry)
-        records, _unflushed = _unflushed, []
-    for m in metrics:
-        records.extend(m._drain())
+    records = drain_records()
     if records:
         try:
             core._call("metrics_report", records)
         except Exception:
             # Re-queue so counter deltas survive transient controller
             # hiccups (bounded: keep the newest ~10k records).
-            with _lock:
-                _unflushed = (records + _unflushed)[-10000:]
+            requeue_records(records)
             return False
     return True
 

@@ -111,6 +111,27 @@ def summarize_objects() -> dict:
     }
 
 
+def summarize_resources() -> dict:
+    """Cluster resource rollup: per-node host CPU/mem + object-store
+    occupancy (agent telemetry heartbeats), per-device HBM used/limit and
+    compile activity (worker device reports), cluster totals, and the
+    cross-rank collective skew table. Rendered by ``ray-tpu status``."""
+    return _require_worker()._call("summarize_resources")
+
+
+def compile_state() -> dict:
+    """Per-process XLA compile-tracker snapshots ({node/proc: snapshot}),
+    including active recompilation storms with the offending shape
+    strings (see ray_tpu.util.compile_tracker)."""
+    return _require_worker()._call("compile_state")
+
+
+def collective_skew() -> list:
+    """Cross-rank skew (max-min last-op latency, ms) per collective
+    (group, op) key, worst first — the straggler view per ring/mesh."""
+    return _require_worker()._call("collective_skew")
+
+
 def serve_state() -> dict:
     """Raw engine flight-recorder snapshots, keyed
     ``deployment/replica/engine`` (pushed by LLM engines ~1/s; also at

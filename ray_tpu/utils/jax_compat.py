@@ -1,0 +1,71 @@
+"""Compatibility shims across jax versions.
+
+The parallelism code targets the jax >= 0.5 surface (``jax.shard_map``
+with ``axis_names=``/``check_vma=``, ``jax.sharding.get_abstract_mesh``);
+older runtimes (0.4.x, as baked into some TPU host images) expose the
+same functionality as ``jax.experimental.shard_map.shard_map`` with
+``auto=``/``check_rep=`` and no ambient abstract-mesh accessor. These
+wrappers translate so the call sites stay written against the modern
+API.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma: Optional[bool] = None):
+    """``jax.shard_map`` when available; else the 0.4.x experimental one.
+
+    ``axis_names`` (modern: the axes to manualize) maps to the legacy
+    ``auto`` frozenset (its complement over the mesh axes);
+    ``check_vma`` maps to legacy ``check_rep``.
+    """
+    import jax
+
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return native(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    kwargs = {}
+    if axis_names is not None:
+        mesh_axes = getattr(mesh, "axis_names", ())
+        kwargs["auto"] = frozenset(mesh_axes) - set(axis_names)
+    if check_vma is not None:
+        kwargs["check_rep"] = bool(check_vma)
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kwargs)
+
+
+def axis_size(axis_name):
+    """Static size of a named mesh axis inside a shard_map/pmap body.
+    ``jax.lax.axis_size`` when available; ``psum(1, axis)`` (a trace-time
+    constant) on older jax."""
+    import jax
+
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def get_abstract_mesh():
+    """The ambient abstract mesh (set inside a modern shard_map trace),
+    or None when this jax has no such accessor / none is active. Callers
+    fall back to their construction-time concrete mesh on None."""
+    import jax
+
+    getter = getattr(jax.sharding, "get_abstract_mesh", None)
+    if getter is None:
+        return None
+    mesh = getter()
+    # modern jax returns an empty AbstractMesh outside any context;
+    # treat anything without a usable shape as "no ambient mesh"
+    return mesh if getattr(mesh, "shape", None) else None

@@ -34,6 +34,25 @@ def test_actor_creation_claims_pooled_worker(cluster_no_prestart):
     pooled = set(ray_tpu.get([task_pid.remote() for _ in range(4)], timeout=60))
     assert pooled
 
+    # Lease release is an async notify fired when the caller's queue
+    # drains — wait for the workers to actually land back in the pool
+    # (state DIRECT), or the claim below races the release and
+    # legitimately cold-spawns. The pool's pids are the claimable set:
+    # the lease ramp may have spawned MORE workers than distinct task
+    # pids (a spawn that attached after the queue drained never ran a
+    # task), and any of them is a valid claim.
+    from ray_tpu.util import state as state_api
+
+    deadline = time.time() + 10
+    pool_pids: set = set()
+    while time.time() < deadline:
+        workers = state_api.list_workers()
+        pool_pids = {w["pid"] for w in workers if w["state"] == "DIRECT"}
+        if pool_pids and not any(w["state"] == "LEASED" for w in workers):
+            break
+        time.sleep(0.05)
+    assert pool_pids >= pooled, (pool_pids, pooled)
+
     @ray_tpu.remote(num_cpus=0.001)
     class A:
         def pid(self):
@@ -41,8 +60,8 @@ def test_actor_creation_claims_pooled_worker(cluster_no_prestart):
 
     a = A.remote()
     apid = ray_tpu.get(a.pid.remote(), timeout=60)
-    assert apid in pooled, (
-        f"actor cold-spawned (pid {apid}) while pooled workers {pooled} sat idle"
+    assert apid in pool_pids, (
+        f"actor cold-spawned (pid {apid}) while pooled workers {pool_pids} sat idle"
     )
 
 

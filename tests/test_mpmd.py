@@ -26,6 +26,16 @@ CFG = tf.TransformerConfig(
     remat=False,
 )
 
+# The in-graph GPipe loss these tests compare against runs a partially-
+# manual shard_map; jax 0.4.x's lowering of that hard-crashes this
+# jaxlib's CPU backend (SIGFPE in the compiled program — uncatchable).
+# The MPMD pipelines themselves work; only the in-graph REFERENCE is
+# gated (see test_parallel.legacy_shard_map).
+ingraph_gpipe_reference = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="in-graph GPipe reference crashes XLA on jax<0.5",
+)
+
 
 def _params_and_batch(batch=4, seq=16):
     params = tf.init_params(jax.random.PRNGKey(0), CFG)
@@ -33,6 +43,7 @@ def _params_and_batch(batch=4, seq=16):
     return params, {"tokens": tokens}
 
 
+@ingraph_gpipe_reference
 def test_mpmd_loss_matches_ingraph_gpipe_bitwise():
     params, batch = _params_and_batch()
 
@@ -106,6 +117,7 @@ def test_mpmd_per_microbatch_mode_close():
     np.testing.assert_allclose(float(l_mb), float(l_full), rtol=1e-6)
 
 
+@ingraph_gpipe_reference
 def test_mpmd_gang_single_process_matches_ingraph():
     """MpmdGangPipeline (hop-bridge handoffs) in the degenerate
     single-process case: the SAME code path as the cross-process gang,
@@ -180,6 +192,7 @@ def test_mpmd_gang_four_stages_single_process():
     assert loss == loss2, (loss, loss2)
 
 
+@ingraph_gpipe_reference
 def test_mpmd_stage_internal_tp_matches_ingraph():
     """pp=2 x tp=2 MPMD (VERDICT r3 #10): stage interiors GSPMD-
     partitioned with the Megatron tp specs; loss must match the in-graph

@@ -321,3 +321,235 @@ def test_grafana_dashboard_and_profiles_surface(ray_start_regular):
     with urllib.request.urlopen(f"{url}/profiles", timeout=30) as r:
         page = r.read().decode()
     assert "jax.profiler captures" in page
+
+
+# ---------------------------------------------------------------------------
+# Cluster & device telemetry (node heartbeats, HBM, compile tracking, skew)
+# ---------------------------------------------------------------------------
+def test_host_telemetry_sampling():
+    """Unit: host sampler reads real /proc numbers; cpu% is a bounded
+    delta (first call primes, second measures)."""
+    from ray_tpu.core.memory_monitor import HostCpuSampler
+    from ray_tpu.core.node_telemetry import sample_host
+
+    s = HostCpuSampler()
+    s.sample()
+    h = sample_host(s)
+    assert h["mem_total_bytes"] > 0
+    assert h["mem_used_bytes"] > 0
+    assert 0.0 <= h["cpu_percent"] <= 100.0
+
+
+def test_node_telemetry_heartbeat_roundtrip(ray_start_cluster):
+    """Agent telemetry heartbeat -> controller: list_nodes() carries the
+    node's host/store sample; summarize_resources() rolls it up."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    from ray_tpu.util import state
+
+    def has_telemetry():
+        agents = [n for n in state.list_nodes() if not n["is_head"]]
+        return bool(
+            agents
+            and agents[0].get("telemetry", {}).get("host", {}).get("mem_total_bytes", 0) > 0
+        )
+
+    assert _wait_until(has_telemetry, timeout=15)
+    summary = state.summarize_resources()
+    assert summary["totals"]["mem_total_bytes"] > 0
+    agent_rows = [r for r in summary["nodes"].values() if not r["is_head"]]
+    assert agent_rows
+    row = agent_rows[0]
+    assert row["object_store"]["capacity"] > 0
+    assert row["host"]["cpu_percent"] >= 0
+    assert row["telemetry_age_s"] is not None
+
+
+def test_device_telemetry_and_summarize_resources(ray_start_regular):
+    """Per-device HBM + compile snapshots aggregate into list_nodes()
+    enrichment and summarize_resources(). CPU backends expose no
+    memory_stats, so ship a synthetic report through the real RPC."""
+    from ray_tpu.core.api import _require_worker
+
+    core = _require_worker()
+    node_hex = core.node_id.hex()
+    payload = {
+        "node_id": node_hex,
+        "pid": 4242,
+        "mode": "worker",
+        "devices": [
+            {"id": 0, "platform": "tpu", "kind": "TPU v5e",
+             "bytes_in_use": 11 << 30, "peak_bytes_in_use": 12 << 30,
+             "bytes_limit": 16 << 30},
+        ],
+        "compile": {
+            "compiles": 7, "compile_seconds": 3.25, "storms_total": 1,
+            "storm_window_s": 60.0,
+            "active_storms": {"decode_step": {"last_ts": time.time()}},
+            "functions": {"decode_step": {"count": 7, "window_count": 6,
+                                          "last_shapes": "f32[1,128]"}},
+        },
+    }
+    core._call("device_telemetry", f"{node_hex}/test", payload)
+
+    summary = state_api.summarize_resources()
+    node = summary["nodes"][node_hex]
+    assert node["devices"][0]["bytes_limit"] == 16 << 30
+    assert node["devices"][0]["pid"] == 4242
+    assert node["compile"]["compiles"] == 7
+    assert node["compile"]["compiles_per_min"] == 6.0
+    assert "decode_step" in node["compile"]["active_storms"]
+    assert summary["totals"]["hbm_used_bytes"] == 11 << 30
+    assert summary["totals"]["hbm_limit_bytes"] == 16 << 30
+    assert summary["totals"]["num_devices"] == 1
+
+    nodes = state_api.list_nodes()
+    head = next(n for n in nodes if n["is_head"])
+    assert head["devices"] and head["devices"][0]["pid"] == 4242
+
+    cs = state_api.compile_state()
+    assert any(v.get("compiles") == 7 for v in cs.values())
+
+
+def test_compile_tracking_counters_and_storm(ray_start_regular):
+    """Forced recompiles advance jax_compilations_total /
+    jax_compile_seconds_total and trip the storm detector with the
+    offending shape strings."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.util import compile_tracker as ct
+
+    assert ct.install(storm_threshold=3, storm_window_s=60.0)
+    before = ct.snapshot()["compiles"]
+
+    def storm_fn(x):
+        return x * 2 + 1
+
+    f = jax.jit(storm_fn)
+    for n in range(3, 7):  # four shapes -> four compiles of storm_fn
+        f(jnp.ones((n,)))
+
+    snap = ct.snapshot()
+    assert snap["compiles"] - before >= 4
+    assert snap["compile_seconds"] > 0
+    assert "storm_fn" in snap["active_storms"], snap["active_storms"]
+    rec = snap["active_storms"]["storm_fn"]
+    assert rec["shapes"] and rec["prev_shapes"] and rec["shapes"] != rec["prev_shapes"]
+    # the default snapshot caps `functions` at the top-20 most active —
+    # under a full-suite run other compiles can crowd storm_fn out
+    funcs = ct.snapshot(max_functions=100000)["functions"]
+    assert funcs["storm_fn"]["window_count"] >= 3
+
+    # the counters reach the controller through the normal metrics flush
+    flush()
+    msnap = state_api.metrics_snapshot()
+    assert msnap["jax_compilations_total"]["series"][0][1] >= 4
+    assert msnap["jax_compile_seconds_total"]["series"][0][1] > 0
+    assert msnap["jax_recompile_storms_total"]["series"][0][1] >= 1
+
+
+def test_collective_op_metrics_and_skew(ray_start_regular):
+    """A 2-rank CPU ring allreduce populates collective_op_ms /
+    collective_last_op_ms per rank; the controller derives the
+    collective_skew_ms gauge and state.collective_skew() ranks it."""
+    import numpy as np
+
+    @ray_tpu.remote(num_cpus=0)
+    class SkewRank:
+        def __init__(self, ws, rank):
+            from ray_tpu import collective
+
+            collective.init_collective_group(ws, rank, "host", "skewg")
+
+        def run(self):
+            import numpy as np
+
+            from ray_tpu import collective
+            from ray_tpu.util.metrics import flush as _flush
+
+            out = collective.allreduce(np.ones(64, np.float32), "skewg")
+            _flush()
+            return float(out[0])
+
+    actors = [SkewRank.remote(2, r) for r in range(2)]
+    for a in actors:
+        ray_tpu.wait_actor_ready(a)
+    outs = ray_tpu.get([a.run.remote() for a in actors], timeout=60)
+    assert outs == [2.0, 2.0]
+
+    def has_both_ranks():
+        snap = state_api.metrics_snapshot()
+        if "collective_op_ms" not in snap or "collective_last_op_ms" not in snap:
+            return False
+        ranks = {
+            dict(map(tuple, k)).get("rank")
+            for k, _v in snap["collective_last_op_ms"]["series"]
+        }
+        return {"0", "1"} <= ranks
+
+    assert _wait_until(has_both_ranks, timeout=10)
+    snap = state_api.metrics_snapshot()
+    assert "collective_skew_ms" in snap, sorted(snap)
+    tags, val = snap["collective_skew_ms"]["series"][0]
+    t = dict(map(tuple, tags))
+    assert t["group"] == "skewg" and t["op"] == "allreduce"
+    assert val >= 0
+    hseries = snap["collective_op_ms"]["series"]
+    assert sum(v["state"][-1] for _k, v in hseries) >= 2  # one op per rank
+
+    skew = state_api.collective_skew()
+    assert skew and skew[0]["ranks"] == 2 and skew[0]["skew_ms"] >= 0
+
+
+def test_metric_series_cardinality_cap():
+    """Unit: label sets past a metric's cap are dropped and counted in
+    metrics_series_dropped_total; admitted series keep recording."""
+    from ray_tpu.util import metrics as m
+
+    m.drain_records()  # clear leftovers from other tests
+    c = m.Counter("cap_test_total", "capped", ("k",), max_series=3)
+    for i in range(10):
+        c.inc(1, {"k": str(i)})
+    g = m.Gauge("cap_test_gauge", "capped", ("k",), max_series=2)
+    for i in range(5):
+        g.set(float(i), {"k": str(i)})
+
+    records = m.drain_records()
+    mine = [r for r in records if r[0] == "cap_test_total"]
+    assert len(mine) == 3
+    gmine = [r for r in records if r[0] == "cap_test_gauge"]
+    assert len(gmine) == 2
+    dropped = {
+        dict(r[3])["metric"]: r[4]
+        for r in records
+        if r[0] == "metrics_series_dropped_total"
+    }
+    assert dropped["cap_test_total"] == 7
+    assert dropped["cap_test_gauge"] == 3
+    # an admitted label set still records after the cap is hit
+    c.inc(1, {"k": "0"})
+    again = [r for r in m.drain_records() if r[0] == "cap_test_total"]
+    assert len(again) == 1 and again[0][4] == 1
+
+
+def test_cli_status_offline_smoke():
+    """`ray-tpu status --offline` renders the cluster view from the
+    built-in fixture with no cluster — keeps the CLI view from rotting."""
+    import os
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "status", "--offline"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=repo_root,
+    )
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "compiles/min" in r.stdout
+    assert "device HBM:" in r.stdout
+    assert "top-skew collectives" in r.stdout
+    assert "recompilation storm" in r.stdout

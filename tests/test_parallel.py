@@ -14,6 +14,16 @@ from ray_tpu.parallel.train_step import build_loss_fn, make_optimizer
 
 CFG = tf.TransformerConfig.tiny(dtype=jnp.float32, remat=False)
 
+# The in-graph GPipe pipeline runs a PARTIALLY-manual shard_map (manual
+# over pp only, dp/fsdp/tp automatic). jax 0.4.x lowers that through a
+# path this jaxlib's CPU backend hard-crashes on (SIGABRT/SIGFPE in
+# backend_compile — not a catchable failure), so pp plans are gated on
+# the modern shard_map surface.
+legacy_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="partial-manual shard_map pipeline crashes XLA on jax<0.5",
+)
+
 
 def _batch(bsz=8, seq=33, seed=1):
     tokens = jax.random.randint(jax.random.PRNGKey(seed), (bsz, seq), 0, CFG.vocab_size)
@@ -71,12 +81,14 @@ def test_sequence_parallel_ring_attention(ref_setup):
     assert abs(loss - ref) < 2e-4, (loss, ref)
 
 
+@legacy_shard_map
 def test_pipeline_parallel(ref_setup):
     plan = MeshPlan(dp=2, pp=4)  # 4 layers → 1 layer/stage
     loss, ref = _plan_loss(plan, ref_setup, num_microbatches=4)
     assert abs(loss - ref) < 2e-4, (loss, ref)
 
 
+@legacy_shard_map
 def test_pipeline_with_tp(ref_setup):
     plan = MeshPlan(pp=2, tp=4)
     loss, ref = _plan_loss(plan, ref_setup, num_microbatches=2)
