@@ -8,11 +8,14 @@ monitor.py:126 (the loop host). Config shape follows the reference's
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional
 
 from ray_tpu.autoscaler.node_provider import FakeMultiNodeProvider, NodeProvider
+
+logger = logging.getLogger("ray_tpu.autoscaler")
 
 
 def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
@@ -124,8 +127,8 @@ class StandardAutoscaler:
         while not self._stop.wait(self.interval_s):
             try:
                 self.update()
-            except Exception:  # noqa: BLE001 — the loop must survive
-                pass
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                logger.warning("autoscaler reconciliation tick failed: %s", e)
 
     # -- one reconciliation tick -------------------------------------------
     def update(self):

@@ -85,8 +85,8 @@ def main(argv=None):
         try:
             admin.disconnect()
             runner.stop()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — head already gone at teardown
+            logger.debug("monitor teardown disconnect failed: %s", e)
     return 0
 
 

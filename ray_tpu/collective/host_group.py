@@ -78,6 +78,7 @@ class HostGroup:
         self._ns = f"collective/{group_name}"
         self._out: Dict[int, socket.socket] = {}
         self._out_lock = threading.Lock()
+        self._dial_locks: Dict[int, threading.Lock] = {}
         self._inbox: Dict[int, Queue] = {r: Queue() for r in range(world_size)}
         self._closed = False
 
@@ -153,10 +154,26 @@ class HostGroup:
     def _conn(self, peer: int) -> socket.socket:
         with self._out_lock:
             sock = self._out.get(peer)
-            if sock is None:
-                sock = socket.create_connection(self._addr(peer), timeout=30)
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                _send_msg(sock, {"rank": self.rank}, b"")
+            if sock is not None:
+                return sock
+            dial_lock = self._dial_locks.setdefault(peer, threading.Lock())
+        # Dial OUTSIDE _out_lock: a slow peer handshake (up to the 30s
+        # connect timeout) must not stall sends to every other rank. A
+        # per-peer dial lock serializes racing dialers instead — a second
+        # socket must never be handshaken and discarded, because the peer
+        # has already spawned a reader for it and closing it would push a
+        # disconnect poison pill into their inbox for this rank.
+        with dial_lock:
+            with self._out_lock:
+                sock = self._out.get(peer)
+                if sock is not None:
+                    return sock
+            # intentionally held: only dialers to this same not-yet-
+            # connected peer wait here  # ray-tpu: lint-ignore[RTL001]
+            sock = socket.create_connection(self._addr(peer), timeout=30)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _send_msg(sock, {"rank": self.rank}, b"")
+            with self._out_lock:
                 self._out[peer] = sock
             return sock
 

@@ -66,6 +66,9 @@ def init(
 ) -> dict:
     """Start (or connect to) a cluster and connect this process as a driver."""
     global _global_worker, _controller_proc, _session_dir
+    from ray_tpu.util import lockwatch
+
+    lockwatch.maybe_install()  # RAY_TPU_LOCKWATCH=1: driver-side watchdog
     if _global_worker is not None:
         if ignore_reinit_error:
             return {"address": _global_worker.address}

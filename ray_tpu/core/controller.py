@@ -48,6 +48,17 @@ from ray_tpu.utils.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskI
 
 logger = logging.getLogger("ray_tpu.controller")
 
+
+async def _notify_quiet(peer, method: str, *args, what: str = ""):
+    """Best-effort notify to a possibly-dead peer. The expected failure
+    mode IS the peer being gone (that is usually why we are notifying), so
+    failures are logged at debug instead of swallowed silently."""
+    try:
+        await peer.notify(method, *args)
+    except Exception as e:  # noqa: BLE001 — peer already gone
+        logger.debug("notify %s(%s) failed: %s", method, what, e)
+
+
 # Object meta shapes returned to clients:
 #   ("inline", bytes, is_error)
 #   ("shm", size, node_id_hex, shm_dir, is_error)
@@ -468,10 +479,7 @@ class Controller:
             w = self.workers.get(wid)
             if w is not None and w.state == "IDLE" and w.env_hash != wanted_hash:
                 w.state = "DEAD"
-                try:
-                    await w.peer.notify("exit")
-                except Exception:  # noqa: BLE001
-                    pass
+                await _notify_quiet(w.peer, "exit", what="recycle idle worker")
                 return True
         return False
 
@@ -631,10 +639,7 @@ class Controller:
             if w.env_hash and w.env_hash != ehash:
                 self._head_direct_free.remove(wid)
                 w.state = "DEAD"
-                try:
-                    await w.peer.notify("exit")
-                except Exception:  # noqa: BLE001
-                    pass
+                await _notify_quiet(w.peer, "exit", what="retire mismatched direct")
                 # Pair the kill with a replacement spawn (mirrors
                 # NodeAgent._retire_mismatched) so the parked caller isn't
                 # left waiting on its own 30s lease timeout for capacity
@@ -697,10 +702,9 @@ class Controller:
             # The agent marked it busy; give it back or the pool slot
             # leaks (e.g. claim raced the worker's controller
             # registration).
-            try:
-                await node.peer.notify("release_direct_worker", wid_hex)
-            except Exception:  # noqa: BLE001 — agent gone
-                pass
+            await _notify_quiet(
+                node.peer, "release_direct_worker", wid_hex, what="agent gone"
+            )
             return None
         return w
 
@@ -712,10 +716,10 @@ class Controller:
         w.state = "DIRECT"
         node = self.nodes.get(w.node_id)
         if node is not None and node.peer is not None:
-            try:
-                await node.peer.notify("release_direct_worker", w.worker_id.hex())
-            except Exception:  # noqa: BLE001 — agent gone; worker dies with it
-                pass
+            await _notify_quiet(
+                node.peer, "release_direct_worker", w.worker_id.hex(),
+                what="agent gone; worker dies with it",
+            )
 
     def _head_direct_put(self, w: WorkerRecord):
         w.state = "DIRECT"
@@ -743,10 +747,7 @@ class Controller:
             if w is not None and w.state != "DEAD":
                 if kill_worker:
                     w.state = "DEAD"
-                    try:
-                        await w.peer.notify("exit")
-                    except Exception:  # noqa: BLE001
-                        pass
+                    await _notify_quiet(w.peer, "exit", what="lease release kill")
                     # keep parked head lease_worker callers from hanging
                     node = self.nodes[rec.node_id]
                     if self._head_direct_waiters and (
@@ -760,10 +761,10 @@ class Controller:
             # the release so a dead lease-holder can't strand it busy
             node = self.nodes.get(rec.node_id)
             if node is not None and node.peer is not None and not node.peer.closed:
-                try:
-                    await node.peer.notify("lease_release", lease_id, kill_worker)
-                except Exception:  # noqa: BLE001 — agent dying too
-                    pass
+                await _notify_quiet(
+                    node.peer, "lease_release", lease_id, kill_worker,
+                    what="agent dying too",
+                )
         self._schedule_pump()
         return True
 
@@ -1442,10 +1443,7 @@ class Controller:
         for wid in list(node.workers):
             w = self.workers.get(wid)
             if w is not None:
-                try:
-                    await w.peer.notify("exit")
-                except Exception:
-                    pass
+                await _notify_quiet(w.peer, "exit", what="node died")
             await self._on_worker_death(wid, "node died")
         # Drop the dead node from EVERY record's location set (objects can
         # have multiple replicas since the network data plane copies them
@@ -2808,10 +2806,7 @@ class Controller:
         # Belt-and-braces: also ask the worker to exit — if the agent's
         # SIGKILL fails (permission, races), the worker still dies and
         # the oom_marked flag stays truthful about the death cause.
-        try:
-            await w.peer.notify("exit")
-        except Exception:  # noqa: BLE001
-            pass
+        await _notify_quiet(w.peer, "exit", what="OOM kill fallback")
         return victim.pid
 
     async def _memory_monitor_loop(self):
@@ -2849,10 +2844,7 @@ class Controller:
             try:
                 os.kill(victim.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
-                try:
-                    await w.peer.notify("exit")
-                except Exception:
-                    pass
+                await _notify_quiet(w.peer, "exit", what="OOM SIGKILL fallback")
 
     async def _restore_persisted(self):
         """Re-create journaled PGs and detached actors after a restart
@@ -2881,10 +2873,7 @@ class Controller:
 
         async def send():
             for peer in list(self.drivers):
-                try:
-                    await peer.notify("log_batch", batch)
-                except Exception:
-                    pass
+                await _notify_quiet(peer, "log_batch", batch, what="driver gone")
 
         asyncio.run_coroutine_threadsafe(send(), self._loop)
 
@@ -2939,16 +2928,10 @@ class Controller:
             self._log_tailer.stop()
         # Teardown: tell everyone to exit.
         for w in list(self.workers.values()):
-            try:
-                await w.peer.notify("exit")
-            except Exception:
-                pass
+            await _notify_quiet(w.peer, "exit", what="cluster teardown")
         for n in self.nodes.values():
             if n.peer is not None:
-                try:
-                    await n.peer.notify("exit")
-                except Exception:
-                    pass
+                await _notify_quiet(n.peer, "exit", what="cluster teardown")
         await asyncio.sleep(0.1)
         server.close()
         self.head_store.destroy()
@@ -2961,12 +2944,15 @@ def _default_store_bytes() -> int:
                 if line.startswith("MemAvailable:"):
                     kb = int(line.split()[1])
                     return min(int(kb * 1024 * 0.3), 16 * 1024**3)
-    except Exception:
-        pass
+    except (OSError, ValueError, IndexError):
+        pass  # no /proc/meminfo (macOS) or unparseable — use the default
     return 2 * 1024**3
 
 
 def main():
+    from ray_tpu.util import lockwatch
+
+    lockwatch.maybe_install()  # RAY_TPU_LOCKWATCH=1: watch locks created from here on
     parser = argparse.ArgumentParser()
     parser.add_argument("--session-dir", required=True)
     parser.add_argument("--port", type=int, default=0)

@@ -10,9 +10,12 @@ them out to connected drivers.
 from __future__ import annotations
 
 import glob
+import logging
 import os
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("ray_tpu.log_monitor")
 
 # A batch is a list of (source, line) tuples; source is the log file's
 # basename (e.g. "worker-ab12cd34.log") which encodes the worker id.
@@ -58,15 +61,15 @@ class LogTailer:
                 batch = self.poll_once()
                 if batch:
                     self.publish(batch)
-            except Exception:  # pragma: no cover — keep tailing
-                pass
+            except Exception as e:  # pragma: no cover — keep tailing
+                logger.debug("log tail poll failed: %s", e)
         # Final sweep so lines written just before shutdown still arrive.
         try:
             batch = self.poll_once()
             if batch:
                 self.publish(batch)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("final log sweep failed: %s", e)
 
     def poll_once(self) -> LogBatch:
         # Overflow from the previous poll goes out first — the offset has

@@ -655,6 +655,9 @@ class NodeAgent:
 
 
 def main():
+    from ray_tpu.util import lockwatch
+
+    lockwatch.maybe_install()  # RAY_TPU_LOCKWATCH=1: watch locks created from here on
     parser = argparse.ArgumentParser()
     parser.add_argument("--controller", required=True)
     parser.add_argument("--session-dir", required=True)

@@ -347,8 +347,8 @@ class NormalSubmitter:
                 try:
                     agent = await self._agent_peer(agent_addr)
                     await agent.notify("lease_return", worker_id_hex, lease_id)
-                except Exception:  # noqa: BLE001 — agent gone with its node
-                    pass
+                except Exception as e:  # noqa: BLE001 — agent gone with its node
+                    logger.debug("lease_return to %s failed: %s", agent_addr, e)
 
             asyncio.ensure_future(_ret())
 

@@ -399,8 +399,8 @@ class TaskExecutor:
                 cmx, profiler_cm = profiler_cm, None
                 try:
                     cmx.__exit__(None, None, None)
-                except Exception:  # noqa: BLE001 — capture teardown only
-                    pass
+                except Exception as e:  # noqa: BLE001 — capture teardown only
+                    logger.debug("profiler capture teardown failed: %s", e)
             # Report inside the span: for streaming tasks the generator
             # body runs during _report, which must be attributed.
             if reply is not None:
@@ -424,8 +424,8 @@ class TaskExecutor:
             if profiler_cm is not None:
                 try:
                     profiler_cm.__exit__(None, None, None)
-                except Exception:  # noqa: BLE001 — capture teardown only
-                    pass
+                except Exception as e:  # noqa: BLE001 — capture teardown only
+                    logger.debug("profiler capture teardown failed: %s", e)
             if trace_span_cm is not None:
                 from ray_tpu.util import tracing as _tracing
 
@@ -607,6 +607,9 @@ def _maybe_async(result):
 
 def main():
     logging.basicConfig(level=logging.INFO, format="[worker] %(levelname)s %(message)s")
+    from ray_tpu.util import lockwatch
+
+    lockwatch.maybe_install()  # RAY_TPU_LOCKWATCH=1: watch locks created from here on
     addr = os.environ["RAY_TPU_CONTROLLER"]
     node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
     worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])

@@ -12,6 +12,7 @@ driver-side BlockMetadata); transforms run as remote tasks returning
 from __future__ import annotations
 
 import collections
+import logging
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -19,6 +20,8 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+
+logger = logging.getLogger("ray_tpu.data")
 from ray_tpu.data.logical import FusedMap, MapLike
 
 
@@ -391,8 +394,8 @@ class ActorPoolMapOperator(PhysicalOperator):
                 if now - since >= timeout:
                     try:
                         ray_tpu.kill(self._actors[i])
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as e:  # noqa: BLE001 — already dead
+                        logger.debug("idle map-actor kill failed: %s", e)
                     del self._actors[i]
                     del self._load[i]
                     self._idle_since.pop(i, None)

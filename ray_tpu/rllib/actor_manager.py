@@ -61,8 +61,8 @@ class FaultTolerantActorManager:
         """Reference: env_runner_group.py restart-and-resync."""
         try:
             ray_tpu.kill(self._actors[i])
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — restarting a dead actor
+            logger.debug("kill before restart failed (actor %d): %s", i, e)
         self._actors[i] = self._make_actor(i)
         self._healthy[i] = True
         self.num_restarts += 1

@@ -7,6 +7,7 @@ rllib/algorithms/algorithm_config.py (fluent config), env/env_runner_group.py:71
 from __future__ import annotations
 
 import json
+import logging
 import os
 import pickle
 import time
@@ -148,8 +149,11 @@ class EnvRunnerGroup:
                 if nodes:
                     # False for inline-small weights (nothing to stage)
                     core._call("object_broadcast", ref.id, None, timeout=300)
-            except Exception:  # noqa: BLE001 — staging is best-effort
-                pass
+            except Exception as e:  # noqa: BLE001 — staging is best-effort
+                logging.getLogger("ray_tpu.rllib").warning(
+                    "weight broadcast staging failed (workers will pull "
+                    "point-to-point): %s", e,
+                )
             self._manager.foreach_actor(
                 "set_state", ref, self._weights_version, timeout=60
             )

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
 import os
 import time
 from typing import Any
@@ -67,8 +68,10 @@ def task_trace(spec, value: Any):
     finally:
         try:
             jax.profiler.stop_trace()
-        except Exception:  # noqa: BLE001 — a failed stop must not mask the task error
-            pass
+        except Exception as e:  # noqa: BLE001 — a failed stop must not mask the task error
+            logging.getLogger("ray_tpu.profiler").debug(
+                "jax.profiler.stop_trace failed: %s", e
+            )
         meta = {
             "task_id": spec.task_id.hex(),
             "name": spec.name,

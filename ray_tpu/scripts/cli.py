@@ -429,6 +429,13 @@ def cmd_microbenchmark(args):
 # ---------------------------------------------------------------------------
 
 
+def cmd_lint(args):
+    """Project-aware static analysis (see ray_tpu/tools/lint/)."""
+    from ray_tpu.tools.lint.cli import cmd_lint as run
+
+    return run(args)
+
+
 def cmd_up(args):
     from ray_tpu.autoscaler.commands import create_or_update_cluster
 
@@ -559,6 +566,15 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_logs)
 
     sub.add_parser("microbenchmark", help="core perf smoke").set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser(
+        "lint",
+        help="static analysis: concurrency/asyncio/jit-recompile/metrics rules",
+    )
+    from ray_tpu.tools.lint.cli import add_lint_args
+
+    add_lint_args(sp)
+    sp.set_defaults(fn=cmd_lint)
 
     args = p.parse_args(argv)
     entry = getattr(args, "entrypoint", None)

@@ -186,8 +186,10 @@ def _spawn_node_proxies(pending):
             )
             try:
                 ray_tpu.kill(p)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 — proxy never came up
+                logging.getLogger("ray_tpu.serve").debug(
+                    "stale proxy kill failed: %s", e
+                )
             continue
         with _lock:
             _node_proxies[node_id] = p

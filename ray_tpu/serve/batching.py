@@ -16,10 +16,13 @@ items are waiting or the oldest has waited ``batch_wait_timeout_s``.
 from __future__ import annotations
 
 import functools
+import logging
 import threading
 import time
 from concurrent.futures import Future
 from typing import Callable, List, Optional
+
+logger = logging.getLogger("ray_tpu.serve")
 
 
 class _BatchQueue:
@@ -77,8 +80,9 @@ class _BatchQueue:
                     m.batch_wait_ms.observe(
                         (time.monotonic() - min(enq)) * 1000.0, {"fn": self.name}
                     )
-            except Exception:  # noqa: BLE001 — telemetry must never strand
-                pass  # the callers blocked on their futures below
+            except Exception as e:  # noqa: BLE001 — telemetry must never strand
+                # the callers blocked on their futures below
+                logger.debug("batch telemetry failed: %s", e)
             try:
                 with tracing.start_span(
                     f"serve.batch:{self.name}", {"batch_size": len(items)}

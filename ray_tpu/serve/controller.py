@@ -8,9 +8,12 @@ queries from handles (replica lists, versioned) and proxies (route table).
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("ray_tpu.serve")
 
 CONTROLLER_NAME = "__serve_controller__"
 
@@ -119,8 +122,8 @@ class ServeController:
                     node = table.get(r._actor_id.hex())
                     if node:  # only cache once actually placed
                         cache[r._actor_id.hex()] = node
-            except Exception:  # noqa: BLE001 — locality is best-effort
-                pass
+            except Exception as e:  # noqa: BLE001 — locality is best-effort
+                logger.debug("replica locality lookup failed: %s", e)
         return cache
 
     def get_version(self) -> int:
@@ -181,8 +184,8 @@ class ServeController:
             try:
                 self._reconcile_once()
                 self._autoscale()
-            except Exception:  # noqa: BLE001 — the loop must survive
-                pass
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                logger.warning("serve reconcile tick failed: %s", e)
 
     @staticmethod
     def _total_load(d: dict) -> float:

@@ -9,10 +9,13 @@ the less loaded (p2c), the same algorithm the reference router runs.
 """
 from __future__ import annotations
 
+import logging
 import random
 import threading
 import time
 from typing import Any, Dict, Optional
+
+logger = logging.getLogger("ray_tpu.serve")
 
 
 class DeploymentResponse:
@@ -73,12 +76,12 @@ class DeploymentStreamingResponse:
                     from ray_tpu.core.api import _require_worker
 
                     _require_worker().cancel_task(self._gen.task_id, False)
-                except Exception:  # noqa: BLE001 — best-effort on teardown
-                    pass
+                except Exception as e:  # noqa: BLE001 — best-effort on teardown
+                    logger.debug("stream cancel on teardown failed: %s", e)
             try:
                 self._on_done()
-            except Exception:  # noqa: BLE001 — release must never raise
-                pass
+            except Exception as e:  # noqa: BLE001 — release must never raise
+                logger.debug("stream release callback failed: %s", e)
 
     def close(self):
         self._finish()
@@ -224,8 +227,8 @@ class _Router:
             avg = sum(self._inflight.values()) / n
         try:
             self._controller.report_load.remote(self._name, self._id, avg)
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — controller restarting
+            logger.debug("router load report failed: %s", e)
 
 
 class DeploymentHandle:

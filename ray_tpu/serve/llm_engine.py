@@ -41,11 +41,14 @@ from __future__ import annotations
 import collections
 import dataclasses
 import itertools
+import logging
 import os
 import queue
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
+
+logger = logging.getLogger("ray_tpu.serve.engine")
 
 import jax
 import numpy as np
@@ -1210,8 +1213,8 @@ class LLMEngine:
         while not self._stop.wait(self._report_interval_s):
             try:
                 self.report_state()
-            except Exception:  # noqa: BLE001 — telemetry must not kill serving
-                pass
+            except Exception as e:  # noqa: BLE001 — telemetry must not kill serving
+                logger.debug("engine state report failed: %s", e)
 
     def report_state(self) -> dict:
         """Snapshot occupancy + flight recorder and (best-effort) push it
@@ -1281,6 +1284,6 @@ class LLMEngine:
                 if not idle:
                     self._last_pushed_stats = dict(snap["stats"])
                     self._last_full_push = now
-        except Exception:  # noqa: BLE001 — controller hiccups are non-fatal
-            pass
+        except Exception as e:  # noqa: BLE001 — controller hiccups are non-fatal
+            logger.debug("engine snapshot push failed: %s", e)
         return snap

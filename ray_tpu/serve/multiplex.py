@@ -18,8 +18,11 @@ from __future__ import annotations
 import collections
 import contextvars
 import functools
+import logging
 import threading
 from typing import Any, Callable, List, Optional
+
+logger = logging.getLogger("ray_tpu.serve")
 
 _current_model_id: contextvars.ContextVar = contextvars.ContextVar(
     "serve_multiplexed_model_id", default=""
@@ -91,14 +94,14 @@ class _MuxCache:
             if callable(unload):
                 try:
                     unload()
-                except Exception:  # noqa: BLE001 — eviction must proceed
-                    pass
+                except Exception as e:  # noqa: BLE001 — eviction must proceed
+                    logger.warning("model __serve_unload__ failed: %s", e)
             del old  # last reference → HBM freed
         if changed and self._on_change is not None:
             try:
                 self._on_change(self.loaded_ids())
-            except Exception:  # noqa: BLE001 — reporting is best-effort
-                pass
+            except Exception as e:  # noqa: BLE001 — reporting is best-effort
+                logger.debug("mux loaded-models report failed: %s", e)
         return model
 
     def loaded_ids(self) -> List[str]:

@@ -9,9 +9,12 @@ in the caller's propagated trace context.
 """
 from __future__ import annotations
 
+import logging
 import time
 
 import ray_tpu
+
+logger = logging.getLogger("ray_tpu.serve")
 from ray_tpu.utils.serialization import deserialize_function
 
 
@@ -46,8 +49,10 @@ class Replica:
         # (reference: multiplexed model id push in replica.py).
         try:
             self.instance._serve_report_models = self._report_models
-        except Exception:  # noqa: BLE001 — e.g. function deployments
-            pass
+        except Exception as e:  # noqa: BLE001 — user __setattr__ may raise anything
+            # e.g. function deployments / __slots__ / validating models:
+            # no resident-model reporting, never a deploy failure
+            logger.debug("model-report hook not attachable: %s", e)
 
     def _report_models(self, model_ids):
         try:
@@ -58,8 +63,8 @@ class Replica:
             ctrl = _ray.get_actor(CONTROLLER_NAME)
             aid = get_runtime_context().get_actor_id()
             ctrl.report_models.remote(self.deployment_name, aid, list(model_ids))
-        except Exception:  # noqa: BLE001 — routing hint only
-            pass
+        except Exception as e:  # noqa: BLE001 — routing hint only
+            logger.debug("resident-model report failed: %s", e)
 
     def _start_request(self, request_meta, method_name: str):
         """Record queue wait; return (submit_ts, span attributes)."""

@@ -1,0 +1,5 @@
+"""Developer tooling that ships with ray_tpu (static analysis, etc.).
+
+Nothing here is imported by the runtime — tools are reached via the
+``ray-tpu`` CLI or directly (``python -m ray_tpu.tools.lint.cli``).
+"""

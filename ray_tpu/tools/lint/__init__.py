@@ -1,0 +1,20 @@
+"""ray_tpu lint: project-aware static analysis.
+
+Public surface:
+
+* :func:`ray_tpu.tools.lint.framework.run_lint` — programmatic runner
+  (used by the tier-1 gate in ``tests/test_lint_clean.py``).
+* ``ray-tpu lint`` / ``python -m ray_tpu.tools.lint.cli`` — the CLI.
+* Rules RTL001–RTL006 live in :mod:`ray_tpu.tools.lint.rules` and
+  self-register on import.
+"""
+from ray_tpu.tools.lint.framework import (  # noqa: F401
+    Baseline,
+    Checker,
+    Finding,
+    LintConfig,
+    LintResult,
+    all_rules,
+    load_config,
+    run_lint,
+)

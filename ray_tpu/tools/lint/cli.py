@@ -1,0 +1,149 @@
+"""``ray-tpu lint`` — run the project-aware static analyzer.
+
+Exit-code contract (stable for CI):
+  0  clean: no non-baselined findings, no stale baseline entries
+  1  findings (or stale baseline entries — the baseline may only shrink)
+  2  usage or configuration error
+
+``--format json`` emits a single machine-readable document on stdout for
+CI annotation; text mode prints one `path:line:col: RULE message` line per
+finding plus a summary.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from ray_tpu.tools.lint.framework import (
+    Baseline,
+    all_rules,
+    baseline_entry,
+    load_config,
+    run_lint,
+    _find_root,
+)
+
+
+def add_lint_args(sp: argparse.ArgumentParser):
+    sp.add_argument("paths", nargs="*", help="files/dirs (default: config paths)")
+    sp.add_argument("--format", choices=["text", "json"], default="text")
+    sp.add_argument("--root", default=None, help="project root (default: auto)")
+    sp.add_argument(
+        "--no-baseline", action="store_true", help="report baselined findings too"
+    )
+    sp.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="re-baseline: write every current finding to the baseline file "
+        "(justifications for existing entries are preserved)",
+    )
+    sp.add_argument("--rules", default=None, help="comma-separated rule subset")
+    sp.add_argument(
+        "--list-rules", action="store_true", help="print the rule table and exit"
+    )
+
+
+def cmd_lint(args) -> int:
+    if args.list_rules:
+        for rule_id, cls in sorted(all_rules().items()):
+            print(f"{rule_id}  {cls.name:<28} {cls.description}")
+        return 0
+    root = os.path.abspath(args.root) if args.root else _find_root()
+    try:
+        config = load_config(root)
+    except Exception as e:  # malformed pyproject section
+        print(f"ray-tpu lint: bad config: {e}", file=sys.stderr)
+        return 2
+    if args.rules:
+        wanted = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+        unknown = set(wanted) - set(all_rules())
+        if unknown:
+            print(f"ray-tpu lint: unknown rules: {sorted(unknown)}", file=sys.stderr)
+            return 2
+        config.enable = wanted
+        config.disable = []  # an explicit --rules request overrides config disables
+    paths = args.paths or None
+    if args.write_baseline:
+        return _write_baseline(root, config, paths)
+    result = run_lint(paths=paths, root=root, config=config,
+                      use_baseline=not args.no_baseline)
+    if result.files_checked == 0:
+        # checking nothing is a config error, not a clean run — a CI job
+        # with a wrong root/paths must not silently pass
+        print(
+            f"ray-tpu lint: no Python files found under {paths or config.paths} "
+            f"(root {root})",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.format == "json":
+        print(json.dumps(result.to_json(), indent=2))
+        return 0 if result.clean else 1
+
+    for f in result.findings:
+        print(f.render())
+    for entry in result.stale_baseline:
+        print(
+            f"{entry.get('path')}: stale baseline entry "
+            f"{entry.get('rule')} [{entry.get('scope', '')}] — the finding is "
+            "gone; remove it from the baseline (baseline may only shrink)"
+        )
+    n, b, s = len(result.findings), len(result.baselined), result.suppressed
+    print(
+        f"ray-tpu lint: {result.files_checked} files, {n} finding(s), "
+        f"{b} baselined, {s} suppressed"
+        + (f", {len(result.stale_baseline)} stale baseline entr(ies)" if result.stale_baseline else "")
+    )
+    if result.parse_errors:
+        for err in result.parse_errors:
+            print(f"parse error: {err}", file=sys.stderr)
+    return 0 if result.clean else 1
+
+
+def _write_baseline(root: str, config, paths: Optional[List[str]]) -> int:
+    """Capture current findings as the new baseline, keeping existing
+    justifications for entries that survive — and keeping entries for
+    files OUTSIDE the scoped paths untouched (a path-scoped re-baseline
+    must not erase the rest of the committed baseline)."""
+    result = run_lint(paths=paths, root=root, config=config, use_baseline=False)
+    if result.files_checked == 0:
+        print(
+            f"ray-tpu lint: refusing to write baseline — no Python files found "
+            f"under {paths or config.paths} (root {root})",
+            file=sys.stderr,
+        )
+        return 2
+    bl_path = os.path.join(root, config.baseline)
+    old = Baseline.load(bl_path)
+    just = {Baseline.entry_key(e): e.get("justification", "") for e in old.entries}
+    checked = set(result.checked_paths)
+    entries = [e for e in old.entries if e.get("path", "") not in checked]
+    kept = len(entries)
+    seen = set()
+    for f in result.findings:
+        if f.key in seen:
+            continue
+        seen.add(f.key)
+        entries.append(baseline_entry(f, just.get(f.key, "TODO: justify")))
+    entries.sort(key=lambda e: (e.get("path", ""), e.get("line", 0), e.get("rule", "")))
+    old.entries = entries
+    old.save()
+    print(
+        f"wrote {len(entries)} baseline entr(ies) to {bl_path}"
+        + (f" ({kept} out-of-scope kept)" if kept else "")
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray-tpu lint", description=__doc__)
+    add_lint_args(p)
+    return cmd_lint(p.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

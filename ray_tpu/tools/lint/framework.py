@@ -1,0 +1,530 @@
+"""Project-aware static-analysis framework for ray_tpu.
+
+Reference shape: a tiny flake8/ruff-style engine, but with whole-project
+context (cross-module lock-order graphs need more than one file at a time)
+and rules tuned to this codebase's real failure classes: blocking calls
+under locks, event-loop stalls, XLA recompile storms, metric-cardinality
+blowups, lock-order inversions, and silent exception swallows.
+
+Three layers:
+
+* ``Finding`` / ``Checker`` / ``register`` — the rule surface. A checker
+  sees one parsed module at a time (``check``) and may emit project-wide
+  findings after every module has been visited (``finalize`` — used by the
+  lock-order graph).
+* suppression — ``# ray-tpu: lint-ignore[RTL001]`` on the finding line or
+  the line above silences one line; ``# ray-tpu: lint-ignore-file[RTL003]``
+  anywhere in a file silences the whole file. An empty rule list
+  (``lint-ignore[]``) is invalid and ignored — directives always name rules.
+* baseline — pre-existing, justified findings live in a committed JSON
+  file keyed by (rule, path, scope, normalized source line) so they stay
+  matched across unrelated line drift. The tier-1 gate asserts zero
+  non-baselined findings AND that every baseline entry still matches (the
+  baseline may only shrink; stale entries fail the gate).
+
+Exit-code contract (see ``cli.py``): 0 clean, 1 findings, 2 usage/config
+error.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# Findings
+
+
+@dataclass
+class Finding:
+    rule: str
+    message: str
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int = 0
+    scope: str = ""  # dotted class/function scope, "" at module level
+    snippet: str = ""  # stripped source line — part of the stable identity
+
+    @property
+    def key(self) -> Tuple[str, str, str, str]:
+        """Line-drift-stable identity used for baseline matching."""
+        return (self.rule, self.path, self.scope, self.snippet)
+
+    @property
+    def fingerprint(self) -> str:
+        h = hashlib.sha1("|".join(self.key).encode()).hexdigest()
+        return h[:12]
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "message": self.message,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "scope": self.scope,
+            "snippet": self.snippet,
+            "fingerprint": self.fingerprint,
+        }
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col + 1}"
+        scope = f" [{self.scope}]" if self.scope else ""
+        return f"{loc}: {self.rule} {self.message}{scope}"
+
+
+# ---------------------------------------------------------------------------
+# Module / project context handed to checkers
+
+
+class ModuleContext:
+    """One parsed module plus the shared helpers every rule needs."""
+
+    def __init__(self, path: str, relpath: str, source: str, tree: ast.Module):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.module_name = _module_name(relpath)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def scope_of(self, node: ast.AST) -> str:
+        """Dotted class/function scope containing ``node``."""
+        parts: List[str] = []
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                parts.append(anc.name)
+        return ".".join(reversed(parts))
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a class nested further out is not *this* node's class
+                continue
+        return None
+
+    def snippet_at(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=rule,
+            message=message,
+            path=self.relpath,
+            line=line,
+            col=getattr(node, "col_offset", 0),
+            scope=self.scope_of(node),
+            snippet=self.snippet_at(line),
+        )
+
+
+def _module_name(relpath: str) -> str:
+    name = relpath[:-3] if relpath.endswith(".py") else relpath
+    name = name.replace("/", ".").replace("\\", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+# ---------------------------------------------------------------------------
+# Checker registry
+
+
+class Checker:
+    rule: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        """Project-wide findings after every module was visited."""
+        return ()
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    assert cls.rule, f"checker {cls.__name__} has no rule id"
+    _REGISTRY[cls.rule] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, type]:
+    # rules.py self-registers on import
+    from ray_tpu.tools.lint import rules  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Suppression directives
+
+_IGNORE_RE = re.compile(r"ray-tpu:\s*lint-ignore\[([A-Za-z0-9_,\s]+)\]")
+_IGNORE_FILE_RE = re.compile(r"ray-tpu:\s*lint-ignore-file\[([A-Za-z0-9_,\s]+)\]")
+
+
+@dataclass
+class Suppressions:
+    by_line: Dict[int, Set[str]] = field(default_factory=dict)
+    file_rules: Set[str] = field(default_factory=set)
+
+    def covers(self, finding: Finding) -> bool:
+        if finding.rule in self.file_rules:
+            return True
+        for line in (finding.line, finding.line - 1):
+            rules = self.by_line.get(line)
+            if rules and finding.rule in rules:
+                return True
+        return False
+
+
+def scan_suppressions(source: str) -> Suppressions:
+    """Collect directives from real comment tokens (never from strings)."""
+    sup = Suppressions()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _IGNORE_FILE_RE.search(tok.string)
+            if m:
+                sup.file_rules.update(_parse_rule_list(m.group(1)))
+                continue
+            m = _IGNORE_RE.search(tok.string)
+            if m:
+                line = tok.start[0]
+                sup.by_line.setdefault(line, set()).update(
+                    _parse_rule_list(m.group(1))
+                )
+    except tokenize.TokenError:
+        pass
+    return sup
+
+
+def _parse_rule_list(raw: str) -> Set[str]:
+    return {r.strip().upper() for r in raw.split(",") if r.strip()}
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+
+
+@dataclass
+class Baseline:
+    path: str
+    entries: List[dict] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls(path=path, entries=[])
+        with open(path) as f:
+            data = json.load(f)
+        return cls(path=path, entries=list(data.get("findings", [])))
+
+    def save(self):
+        with open(self.path, "w") as f:
+            json.dump(
+                {"version": 1, "findings": self.entries}, f, indent=2, sort_keys=False
+            )
+            f.write("\n")
+
+    @staticmethod
+    def entry_key(entry: dict) -> Tuple[str, str, str, str]:
+        return (
+            entry.get("rule", ""),
+            entry.get("path", ""),
+            entry.get("scope", ""),
+            entry.get("snippet", ""),
+        )
+
+    def match(self, findings: Sequence[Finding], checked_paths: Optional[Set[str]] = None):
+        """Split findings into (new, matched); also return stale entries.
+
+        A baseline entry may match several findings with the same identity
+        (e.g. two identical swallows in one function) — identity matching is
+        by key, not 1:1 position. Staleness is only judged for entries whose
+        file was actually checked this run: a path-scoped `ray-tpu lint
+        some/subdir` must not flag out-of-scope entries as stale.
+        """
+        keys = {self.entry_key(e) for e in self.entries}
+        new: List[Finding] = []
+        matched: List[Finding] = []
+        seen_keys: Set[Tuple[str, str, str, str]] = set()
+        for f in findings:
+            if f.key in keys:
+                matched.append(f)
+                seen_keys.add(f.key)
+            else:
+                new.append(f)
+        stale = [
+            e
+            for e in self.entries
+            if self.entry_key(e) not in seen_keys
+            and (checked_paths is None or e.get("path", "") in checked_paths)
+        ]
+        return new, matched, stale
+
+
+def baseline_entry(finding: Finding, justification: str) -> dict:
+    return {
+        "rule": finding.rule,
+        "path": finding.path,
+        "scope": finding.scope,
+        "snippet": finding.snippet,
+        "line": finding.line,  # informational only — not part of identity
+        "justification": justification,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Config ([tool.ray-tpu-lint] in pyproject.toml)
+
+
+@dataclass
+class LintConfig:
+    paths: List[str] = field(default_factory=lambda: ["ray_tpu"])
+    enable: List[str] = field(default_factory=list)  # empty = all registered
+    disable: List[str] = field(default_factory=list)
+    exclude: List[str] = field(default_factory=lambda: ["*/__pycache__/*"])
+    baseline: str = ".lint-baseline.json"
+    root: str = "."
+
+    def rule_ids(self) -> List[str]:
+        rules = all_rules()
+        ids = self.enable or sorted(rules)
+        return [r for r in ids if r in rules and r not in set(self.disable)]
+
+
+def load_config(root: str) -> LintConfig:
+    cfg = LintConfig(root=root)
+    pyproject = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(pyproject):
+        return cfg
+    with open(pyproject) as f:
+        text = f.read()
+    section = _toml_section(text, "tool.ray-tpu-lint")
+    if not section:
+        return cfg
+    for key, value in section.items():
+        if key == "paths" and isinstance(value, list):
+            cfg.paths = value
+        elif key == "enable" and isinstance(value, list):
+            cfg.enable = [v.upper() for v in value]
+        elif key == "disable" and isinstance(value, list):
+            cfg.disable = [v.upper() for v in value]
+        elif key == "exclude" and isinstance(value, list):
+            cfg.exclude = value
+        elif key == "baseline" and isinstance(value, str):
+            cfg.baseline = value
+    return cfg
+
+
+def _toml_section(text: str, name: str) -> Dict[str, object]:
+    """Minimal TOML-subset reader for our own config section.
+
+    py3.10 has no tomllib and the container must not grow dependencies, so
+    parse just what we emit: string / bool / int scalars and single-line or
+    multi-line arrays of strings.
+    """
+    out: Dict[str, object] = {}
+    lines = text.splitlines()
+    in_section = False
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if line.startswith("["):
+            in_section = line == f"[{name}]"
+            continue
+        if not in_section or not line or line.startswith("#"):
+            continue
+        if "=" not in line:
+            continue
+        key, _, raw = line.partition("=")
+        key = key.strip()
+        raw = raw.strip()
+        if raw.startswith("[") and not raw.rstrip().rstrip(",").endswith("]"):
+            # multi-line array: accumulate until the closing bracket
+            while i < len(lines) and "]" not in raw:
+                raw += " " + lines[i].strip()
+                i += 1
+        out[key] = _toml_value(raw)
+    return out
+
+
+def _toml_value(raw: str):
+    raw = raw.split("#", 1)[0].strip() if not raw.startswith(('"', "'")) else raw
+    if raw.startswith("[") and raw.endswith("]"):
+        inner = raw[1:-1]
+        items = [s.strip() for s in inner.split(",")]
+        return [_strip_quotes(s) for s in items if s]
+    if raw in ("true", "false"):
+        return raw == "true"
+    if re.fullmatch(r"-?\d+", raw):
+        return int(raw)
+    return _strip_quotes(raw)
+
+
+def _strip_quotes(s: str) -> str:
+    s = s.strip()
+    if len(s) >= 2 and s[0] == s[-1] and s[0] in "\"'":
+        return s[1:-1]
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Runner
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)  # non-baselined
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline: List[dict] = field(default_factory=list)
+    suppressed: int = 0
+    files_checked: int = 0
+    checked_paths: List[str] = field(default_factory=list)  # relpaths seen
+    parse_errors: List[str] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        # a file we could not parse was not checked — that is not clean
+        return not self.findings and not self.stale_baseline and not self.parse_errors
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "clean": self.clean,
+            "files_checked": self.files_checked,
+            "suppressed": self.suppressed,
+            "findings": [f.to_json() for f in self.findings],
+            "baselined": [f.to_json() for f in self.baselined],
+            "stale_baseline": self.stale_baseline,
+            "parse_errors": self.parse_errors,
+        }
+
+
+def iter_python_files(paths: Sequence[str], root: str, exclude: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            out.append(full)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    rels = []
+    for f in sorted(set(out)):
+        rel = os.path.relpath(f, root).replace(os.sep, "/")
+        if any(fnmatch.fnmatch(rel, pat) or fnmatch.fnmatch("/" + rel, pat) for pat in exclude):
+            continue
+        rels.append(f)
+    return rels
+
+
+def run_lint(
+    paths: Optional[Sequence[str]] = None,
+    root: Optional[str] = None,
+    config: Optional[LintConfig] = None,
+    use_baseline: bool = True,
+) -> LintResult:
+    root = os.path.abspath(root or _find_root())
+    config = config or load_config(root)
+    rule_ids = config.rule_ids()
+    rules = all_rules()
+    checkers: List[Checker] = [rules[r]() for r in rule_ids]
+
+    result = LintResult()
+    raw: List[Finding] = []
+    files = iter_python_files(paths or config.paths, root, config.exclude)
+    for path in files:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            result.parse_errors.append(f"{rel}: {e}")
+            continue
+        result.files_checked += 1
+        result.checked_paths.append(rel)
+        ctx = ModuleContext(path, rel, source, tree)
+        sup = scan_suppressions(source)
+        for checker in checkers:
+            for finding in checker.check(ctx):
+                if sup.covers(finding):
+                    result.suppressed += 1
+                else:
+                    raw.append(finding)
+    # project-wide rules (lock-order graph): suppression re-checked against
+    # the file each finding lands in.
+    sup_cache: Dict[str, Suppressions] = {}
+    for checker in checkers:
+        for finding in checker.finalize():
+            sup = sup_cache.get(finding.path)
+            if sup is None:
+                try:
+                    with open(os.path.join(root, finding.path), encoding="utf-8") as f:
+                        sup = scan_suppressions(f.read())
+                except OSError:
+                    sup = Suppressions()
+                sup_cache[finding.path] = sup
+            if sup.covers(finding):
+                result.suppressed += 1
+            else:
+                raw.append(finding)
+
+    raw.sort(key=lambda f: (f.path, f.line, f.rule))
+    if use_baseline:
+        baseline = Baseline.load(os.path.join(root, config.baseline))
+        new, matched, stale = baseline.match(raw, set(result.checked_paths))
+        result.findings = new
+        result.baselined = matched
+        result.stale_baseline = stale
+    else:
+        result.findings = raw
+    return result
+
+
+def _find_root(start: Optional[str] = None) -> str:
+    """Walk up from ``start`` to the directory holding pyproject.toml."""
+    cur = os.path.abspath(start or os.getcwd())
+    while True:
+        if os.path.exists(os.path.join(cur, "pyproject.toml")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start or os.getcwd())
+        cur = parent

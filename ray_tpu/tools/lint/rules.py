@@ -1,0 +1,671 @@
+"""ray_tpu lint rules RTL001–RTL006.
+
+Each rule targets a failure class this codebase has actually hit (or that
+Ray itself accumulates at scale):
+
+* RTL001 blocking-call-under-lock — a blocking operation (``time.sleep``,
+  socket ops, ``Future.result()``, the sync RPC surface ``._call(...)`` /
+  ``loop_runner.run(...)``, subprocess) inside a ``with <lock>:`` body or
+  between ``.acquire()``/``.release()``. Every waiter on that lock stalls
+  for the full duration; under the GIL-released RPC wait this is the
+  classic source of cluster-wide convoy effects.
+* RTL002 blocking-call-in-async — the same blocking set inside
+  ``async def``. One blocked coroutine stalls the whole event loop: every
+  RPC peer sharing it times out (the py3.10 ``_maybe_async`` generator bug
+  fixed in PR 1 lived one street over from this class).
+* RTL003 jit-recompile-hazard — (a) ``jax.jit``/``pjit`` wrapper
+  construction inside a loop body (a fresh wrapper = a fresh compile cache
+  = one XLA compile per iteration) and (b) calls to jit-decorated
+  functions (no ``static_argnums``/``static_argnames``) passing
+  shape-derived Python ints (``len(...)``, ``.shape``) or ``range()`` loop
+  variables positionally — each distinct value retraces. Static sibling of
+  ``util/compile_tracker.py``'s runtime storm detector.
+* RTL004 unbounded-metric-tags — Counter/Gauge/Histogram record calls
+  whose tag values derive from request/object/task IDs or loop variables.
+  Every distinct value mints a new series; the runtime cardinality cap
+  (PR 3) drops the overflow silently, so the data just vanishes.
+* RTL005 lock-order — builds the project-wide lock-acquisition graph from
+  nested ``with`` statements (lock identities canonicalized through import
+  aliases so cross-module edges meet) and flags A→B / B→A inversions —
+  the static sibling of ``util/lockwatch.py``'s runtime watchdog.
+* RTL006 silent-exception-swallow — bare ``except:`` anywhere, and
+  ``except Exception/BaseException: pass`` bodies. Swallows on control
+  paths turn hard failures into hangs; convert to logged warnings or
+  narrow the type.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.tools.lint.framework import Checker, Finding, ModuleContext, register
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """`a.b.c` -> "a.b.c"; calls/subscripts terminate the chain."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+_LOCK_NAME_RE = re.compile(r"(^|_)(lock|mutex|rlock)s?$", re.IGNORECASE)
+
+
+def is_lock_expr(node: ast.AST) -> bool:
+    """Heuristic: the context-manager expression names a lock.
+
+    Matches ``self._lock``, ``_registry_lock``, ``cls._LOCK``, and
+    ``self._locks[key]``; deliberately does NOT match conditions or
+    semaphores (waiting on a Condition while holding its lock is the
+    correct protocol, not a finding).
+    """
+    if isinstance(node, ast.Subscript):
+        return is_lock_expr(node.value)
+    if isinstance(node, ast.Call):  # e.g. self._lock_for(key)
+        return is_lock_expr(node.func)
+    d = dotted(node)
+    if not d:
+        return False
+    terminal = d.rsplit(".", 1)[-1]
+    return bool(_LOCK_NAME_RE.search(terminal))
+
+
+def lock_text(node: ast.AST) -> str:
+    """Source-ish text of a lock expression, for messages and graph keys."""
+    if isinstance(node, ast.Subscript):
+        return lock_text(node.value) + "[...]"
+    if isinstance(node, ast.Call):
+        return lock_text(node.func) + "(...)"
+    return dotted(node) or "<lock>"
+
+
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep()",
+    "socket.create_connection": "socket.create_connection()",
+    "subprocess.run": "subprocess.run()",
+    "subprocess.call": "subprocess.call()",
+    "subprocess.check_call": "subprocess.check_call()",
+    "subprocess.check_output": "subprocess.check_output()",
+    "subprocess.Popen": "subprocess.Popen()",
+    "urllib.request.urlopen": "urlopen()",
+    "requests.get": "requests.get()",
+    "requests.post": "requests.post()",
+    "requests.request": "requests.request()",
+}
+
+# method names that block regardless of receiver (project RPC surface
+# included: Client._call is the sync controller RPC, loop_runner.run pumps
+# a coroutine to completion on the IO thread)
+_BLOCKING_ATTRS = {
+    "result": "Future.result()",
+    "_call": "sync RPC ._call()",
+    "accept": "socket.accept()",
+    "connect": "socket.connect()",
+    "recv": "socket.recv()",
+    "recv_into": "socket.recv_into()",
+    "sendall": "socket.sendall()",
+}
+
+
+def blocking_call(node: ast.Call, ctx: Optional[ModuleContext] = None) -> Optional[str]:
+    """Return a human label if ``node`` is a known blocking call.
+
+    An awaited call is never blocking (``await rpc.connect(...)`` yields to
+    the loop — only the sync socket/RPC surfaces block the thread).
+    """
+    if ctx is not None and isinstance(ctx.parent(node), ast.Await):
+        return None
+    d = dotted(node.func)
+    if d:
+        if d in _BLOCKING_DOTTED:
+            return _BLOCKING_DOTTED[d]
+        terminal = d.rsplit(".", 1)[-1]
+        if terminal in _BLOCKING_ATTRS:
+            return _BLOCKING_ATTRS[terminal]
+        # loop_runner.run(coro, timeout): the sync bridge into the IO loop
+        if terminal == "run" and "runner" in d.lower():
+            return "loop_runner.run()"
+        # thread/process join — NOT str.join (receiver must look threadish)
+        if terminal == "join":
+            recv = d.rsplit(".", 1)[0].lower()
+            if any(w in recv for w in ("thread", "proc", "worker", "flusher")):
+                return f"{d}()"
+    return None
+
+
+def iter_calls_shallow(nodes: Iterable[ast.stmt]) -> Iterable[ast.Call]:
+    """Walk statements but do not descend into nested function/class
+    definitions or lambdas — their bodies run later, outside this scope."""
+    stack: List[ast.AST] = list(nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """alias -> fully-qualified module (or module attribute) name."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RTL001 — blocking call under a held lock
+
+
+@register
+class BlockingUnderLock(Checker):
+    rule = "RTL001"
+    name = "blocking-call-under-lock"
+    description = "blocking operation while holding a lock"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                locks = [
+                    lock_text(item.context_expr)
+                    for item in node.items
+                    if is_lock_expr(item.context_expr)
+                ]
+                if not locks:
+                    continue
+                for call in self._calls_excluding_inner_locks(node.body):
+                    label = blocking_call(call, ctx)
+                    if label:
+                        findings.append(
+                            ctx.finding(
+                                self.rule,
+                                call,
+                                f"{label} inside `with {locks[0]}:` — blocking "
+                                "while holding a lock stalls every waiter",
+                            )
+                        )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._acquire_spans(ctx, node))
+        return findings
+
+    @staticmethod
+    def _calls_excluding_inner_locks(body: List[ast.stmt]) -> Iterable[ast.Call]:
+        """Like iter_calls_shallow, but stops at nested lock-holding
+        `with` blocks — ast.walk visits those separately, and one blocking
+        call must yield ONE finding (attributed to its innermost lock),
+        not one per enclosing lock."""
+        stack: List[ast.AST] = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+                is_lock_expr(item.context_expr) for item in node.items
+            ):
+                continue
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _acquire_spans(self, ctx: ModuleContext, fn: ast.AST) -> Iterable[Finding]:
+        """Flag blocking calls between explicit .acquire() and .release()
+        at one statement-sequence level (straight-line approximation)."""
+        findings: List[Finding] = []
+        held: List[str] = []
+        for stmt in getattr(fn, "body", ()):
+            acq = self._lock_method(stmt, "acquire")
+            rel = self._lock_method(stmt, "release")
+            if acq:
+                held.append(acq)
+                continue
+            if rel and rel in held:
+                held.remove(rel)
+                continue
+            if held:
+                for call in iter_calls_shallow([stmt]):
+                    label = blocking_call(call, ctx)
+                    if label:
+                        findings.append(
+                            ctx.finding(
+                                self.rule,
+                                call,
+                                f"{label} between {held[-1]}.acquire() and "
+                                ".release()",
+                            )
+                        )
+        return findings
+
+    @staticmethod
+    def _lock_method(stmt: ast.stmt, method: str) -> Optional[str]:
+        if not isinstance(stmt, ast.Expr) or not isinstance(stmt.value, ast.Call):
+            return None
+        func = stmt.value.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == method
+            and is_lock_expr(func.value)
+        ):
+            return lock_text(func.value)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# RTL002 — blocking call in async def
+
+
+@register
+class BlockingInAsync(Checker):
+    rule = "RTL002"
+    name = "blocking-call-in-async"
+    description = "blocking operation inside async def stalls the event loop"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for call in iter_calls_shallow(node.body):
+                label = blocking_call(call, ctx)
+                if not label:
+                    continue
+                findings.append(
+                    ctx.finding(
+                        self.rule,
+                        call,
+                        f"{label} inside `async def {node.name}` — blocks the "
+                        "event loop; use await/asyncio.sleep or an executor",
+                    )
+                )
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# RTL003 — XLA recompile hazards
+
+
+_JIT_NAMES = {"jit", "pjit", "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+
+
+def _is_jit_func(node: ast.AST) -> bool:
+    d = dotted(node)
+    return d in _JIT_NAMES if d else False
+
+
+def _shapeish_arg(arg: ast.AST) -> Optional[str]:
+    """Positional args whose distinct values force retraces."""
+    if isinstance(arg, ast.Call) and dotted(arg.func) == "len":
+        return "len(...)"
+    if isinstance(arg, ast.Attribute) and arg.attr in ("shape", "ndim", "size"):
+        return f".{arg.attr}"
+    if (
+        isinstance(arg, ast.Subscript)
+        and isinstance(arg.value, ast.Attribute)
+        and arg.value.attr == "shape"
+    ):
+        return ".shape[...]"
+    return None
+
+
+@register
+class JitRecompileHazard(Checker):
+    rule = "RTL003"
+    name = "jit-recompile-hazard"
+    description = "pattern that forces repeated XLA compilation"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        findings.extend(self._jit_in_loop(ctx))
+        findings.extend(self._scalar_callsites(ctx))
+        return findings
+
+    def _jit_in_loop(self, ctx: ModuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for call in iter_calls_shallow(loop.body + loop.orelse):
+                if _is_jit_func(call.func):
+                    out.append(
+                        ctx.finding(
+                            self.rule,
+                            call,
+                            "jit wrapper constructed inside a loop — each "
+                            "iteration gets a fresh compile cache (recompile "
+                            "storm); hoist the jit() out of the loop",
+                        )
+                    )
+        return out
+
+    def _scalar_callsites(self, ctx: ModuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        # jit-decorated functions in this module without static argument
+        # declarations
+        hazard_fns: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                if _is_jit_func(dec):
+                    hazard_fns.add(node.name)
+                elif isinstance(dec, ast.Call) and _is_jit_func(dec.func):
+                    kw = {k.arg for k in dec.keywords}
+                    if not kw & {"static_argnums", "static_argnames"}:
+                        hazard_fns.add(node.name)
+        if not hazard_fns:
+            return out
+        # range()-loop index variables per enclosing loop
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            fname = call.func.id if isinstance(call.func, ast.Name) else None
+            if fname not in hazard_fns:
+                continue
+            range_vars = self._enclosing_range_vars(ctx, call)
+            for arg in call.args:
+                why = _shapeish_arg(arg)
+                if why is None and isinstance(arg, ast.Name) and arg.id in range_vars:
+                    why = f"range() loop variable `{arg.id}`"
+                if why:
+                    out.append(
+                        ctx.finding(
+                            self.rule,
+                            call,
+                            f"`{fname}` is jit-compiled without static_argnums/"
+                            f"static_argnames but is called with {why} "
+                            "positionally — every distinct value retraces",
+                        )
+                    )
+        return out
+
+    @staticmethod
+    def _enclosing_range_vars(ctx: ModuleContext, node: ast.AST) -> Set[str]:
+        vars_: Set[str] = set()
+        for anc in ctx.ancestors(node):
+            if (
+                isinstance(anc, ast.For)
+                and isinstance(anc.target, ast.Name)
+                and isinstance(anc.iter, ast.Call)
+                and dotted(anc.iter.func) == "range"
+            ):
+                vars_.add(anc.target.id)
+        return vars_
+
+
+# ---------------------------------------------------------------------------
+# RTL004 — unbounded metric tag values
+
+
+_ID_NAME_RE = re.compile(
+    r"(^|_)(request|req|task|object|obj|job|actor|session|trace|span|replica)_?id$|^rid$|^oid$|^tid$",
+    re.IGNORECASE,
+)
+
+_RECORD_METHODS = {"inc", "observe"}  # value [, tags]
+_RECORD_METHODS_SET = {"set"}  # Gauge.set(value [, tags])
+
+
+def _id_like(node: ast.AST, loop_vars: Set[str]) -> Optional[str]:
+    """Does this tag-value expression derive from an unbounded id?"""
+    if isinstance(node, ast.Name):
+        if _ID_NAME_RE.search(node.id):
+            return f"`{node.id}`"
+        if node.id in loop_vars:
+            return f"loop variable `{node.id}`"
+    if isinstance(node, ast.Attribute) and _ID_NAME_RE.search(node.attr):
+        return f"`.{node.attr}`"
+    if isinstance(node, ast.Call):
+        d = dotted(node.func)
+        if d in ("str", "repr", "hex") and node.args:
+            return _id_like(node.args[0], loop_vars)
+    if isinstance(node, ast.JoinedStr):
+        for part in node.values:
+            if isinstance(part, ast.FormattedValue):
+                hit = _id_like(part.value, loop_vars)
+                if hit:
+                    return hit
+    if isinstance(node, ast.Subscript):
+        return _id_like(node.value, loop_vars)
+    return None
+
+
+@register
+class UnboundedMetricTags(Checker):
+    rule = "RTL004"
+    name = "unbounded-metric-tags"
+    description = "metric tag value derived from an unbounded id"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if not isinstance(call.func, ast.Attribute):
+                continue
+            method = call.func.attr
+            if method not in _RECORD_METHODS | _RECORD_METHODS_SET:
+                continue
+            tags = self._tags_arg(call)
+            if not isinstance(tags, ast.Dict):
+                continue
+            loop_vars = self._loop_vars(ctx, call)
+            for key_node, val_node in zip(tags.keys, tags.values):
+                hit = _id_like(val_node, loop_vars)
+                if not hit:
+                    continue
+                key_repr = (
+                    key_node.value
+                    if isinstance(key_node, ast.Constant)
+                    else "<dynamic>"
+                )
+                findings.append(
+                    ctx.finding(
+                        self.rule,
+                        val_node,
+                        f"metric tag {key_repr!r} set from {hit} — every "
+                        "distinct value mints a new series; the runtime cap "
+                        "will silently drop the overflow. Aggregate or drop "
+                        "the tag",
+                    )
+                )
+        return findings
+
+    @staticmethod
+    def _tags_arg(call: ast.Call) -> Optional[ast.AST]:
+        for kw in call.keywords:
+            if kw.arg == "tags":
+                return kw.value
+        # positional: inc(value, tags) / set(value, tags) / observe(value, tags)
+        if len(call.args) >= 2:
+            return call.args[1]
+        return None
+
+    @staticmethod
+    def _loop_vars(ctx: ModuleContext, node: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.For):
+                for t in ast.walk(anc.target):
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# RTL005 — lock-order inversions (project-wide graph)
+
+
+@register
+class LockOrder(Checker):
+    rule = "RTL005"
+    name = "lock-order"
+    description = "conflicting lock-acquisition order across the project"
+
+    def __init__(self):
+        # (outer_key, inner_key) -> list of (path, line, scope, snippet)
+        self.edges: Dict[Tuple[str, str], List[Tuple[str, int, str, str]]] = {}
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        aliases = import_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            outer_locks = [
+                self._canon(ctx, aliases, item.context_expr, node)
+                for item in node.items
+                if is_lock_expr(item.context_expr)
+            ]
+            if not outer_locks:
+                continue
+            for inner in self._inner_withs(node.body):
+                for item in inner.items:
+                    if not is_lock_expr(item.context_expr):
+                        continue
+                    inner_key = self._canon(ctx, aliases, item.context_expr, inner)
+                    for outer_key in outer_locks:
+                        if outer_key == inner_key:
+                            continue  # reacquisition; RLock-or-bug, not order
+                        site = (
+                            ctx.relpath,
+                            inner.lineno,
+                            ctx.scope_of(inner),
+                            ctx.snippet_at(inner.lineno),
+                        )
+                        self.edges.setdefault((outer_key, inner_key), []).append(site)
+        return ()
+
+    @staticmethod
+    def _inner_withs(body: List[ast.stmt]) -> Iterable[ast.With]:
+        stack: List[ast.AST] = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _canon(self, ctx: ModuleContext, aliases: Dict[str, str], expr: ast.AST,
+               site: ast.AST) -> str:
+        """Canonical lock identity: `self._lock` -> module.Class._lock,
+        bare `_lock` -> module._lock, `metrics._lock` resolved through the
+        import table so cross-module references meet at one node."""
+        text = lock_text(expr)
+        parts = text.split(".")
+        if parts[0] == "self" or parts[0] == "cls":
+            cls = ctx.enclosing_class(site)
+            owner = f"{ctx.module_name}.{cls.name}" if cls else ctx.module_name
+            return ".".join([owner] + parts[1:])
+        if parts[0] in aliases:
+            return ".".join([aliases[parts[0]]] + parts[1:])
+        return f"{ctx.module_name}.{text}"
+
+    def finalize(self) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        reported: Set[Tuple[str, str]] = set()
+        for (a, b), sites in sorted(self.edges.items()):
+            if (b, a) not in self.edges or (b, a) in reported:
+                continue
+            reported.add((a, b))
+            other = self.edges[(b, a)][0]
+            for path, line, scope, snippet in sites:
+                findings.append(
+                    Finding(
+                        rule=self.rule,
+                        message=(
+                            f"lock-order inversion: {a} → {b} here, but "
+                            f"{b} → {a} at {other[0]}:{other[1]} — concurrent "
+                            "callers can deadlock"
+                        ),
+                        path=path,
+                        line=line,
+                        scope=scope,
+                        snippet=snippet,
+                    )
+                )
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# RTL006 — silent exception swallows
+
+
+_CLEANUP_FN_RE = re.compile(
+    r"(shutdown|teardown|close|stop|kill|__del__|disconnect|cleanup|drain)",
+    re.IGNORECASE,
+)
+
+
+@register
+class SilentSwallow(Checker):
+    rule = "RTL006"
+    name = "silent-exception-swallow"
+    description = "bare except or except Exception: pass hides failures"
+
+    _WIDE = {"Exception", "BaseException"}
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                findings.append(
+                    ctx.finding(
+                        self.rule,
+                        node,
+                        "bare `except:` also catches KeyboardInterrupt/"
+                        "SystemExit — name the exception type",
+                    )
+                )
+                continue
+            type_name = dotted(node.type)
+            if type_name in self._WIDE and self._is_silent(node.body):
+                # Project convention: best-effort cleanup (shutdown/close/
+                # __del__/teardown/kill/drain paths) legitimately swallows —
+                # the resource is going away and there is nobody to tell.
+                # Control paths (everything else) must log or narrow.
+                scope = ctx.scope_of(node)
+                innermost = scope.rsplit(".", 1)[-1] if scope else ""
+                if _CLEANUP_FN_RE.search(innermost):
+                    continue
+                findings.append(
+                    ctx.finding(
+                        self.rule,
+                        node,
+                        f"`except {type_name}: pass` silently swallows "
+                        "failures on this path — log it or narrow the type",
+                    )
+                )
+        return findings
+
+    @staticmethod
+    def _is_silent(body: List[ast.stmt]) -> bool:
+        for stmt in body:
+            if isinstance(stmt, ast.Pass):
+                continue
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+                continue  # docstring/ellipsis only
+            return False
+        return True

@@ -7,11 +7,14 @@ by node :363.
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import traceback
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger("ray_tpu.train")
 
 import ray_tpu
 from ray_tpu.train.session import TrainContext, _TrainSession, _set_session
@@ -82,8 +85,8 @@ class TrainWorker:
                 from ray_tpu.util.metrics import flush
 
                 flush()
-            except Exception:  # noqa: BLE001 — telemetry only
-                pass
+            except Exception as e:  # noqa: BLE001 — telemetry only
+                logger.debug("final train-metric flush failed: %s", e)
         session.finished.set()
         return True
 

@@ -9,6 +9,7 @@ trial keeps the control loop non-blocking.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from dataclasses import dataclass, field
@@ -16,6 +17,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.train.trainer import Result
+
+logger = logging.getLogger("ray_tpu.tune")
 from ray_tpu.tune import trial as trial_mod
 from ray_tpu.tune.schedulers import CONTINUE, PAUSE, STOP, FIFOScheduler, TrialScheduler
 from ray_tpu.tune.search import PENDING_SUGGESTION, BasicVariantGenerator, Searcher
@@ -364,8 +367,8 @@ class TuneController:
             if t.actor is not None:
                 try:
                     ray_tpu.kill(t.actor)
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001 — trial actor already dead
+                    logger.debug("trial actor kill failed: %s", e)
                 t.actor = None
             self._start_trial(t, restore=t.checkpoint_dir is not None)
         else:
@@ -404,8 +407,8 @@ class TuneController:
             for actor in self._actor_cache:
                 try:
                     ray_tpu.kill(actor)
-                except Exception:  # noqa: BLE001 — already dead
-                    pass
+                except Exception as e:  # noqa: BLE001 — already dead
+                    logger.debug("cached trial actor kill failed: %s", e)
             self._actor_cache.clear()
         return self._trials
 

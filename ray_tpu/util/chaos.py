@@ -9,6 +9,7 @@ started/stopped around a workload, reporting what they killed.
 """
 from __future__ import annotations
 
+import logging
 import os
 import random
 import signal
@@ -16,6 +17,8 @@ import socket
 import threading
 import time
 from typing import List, Optional
+
+logger = logging.getLogger("ray_tpu.chaos")
 
 import ray_tpu
 
@@ -45,8 +48,8 @@ class _KillerBase:
                 victim = self._kill_one()
                 if victim:
                     self._killed.append(victim)
-            except Exception:  # noqa: BLE001 — chaos must not kill itself
-                pass
+            except Exception as e:  # noqa: BLE001 — chaos must not kill itself
+                logger.debug("chaos kill attempt failed: %s", e)
 
     def stop_run(self) -> List[str]:
         """Stop and report the kill log."""

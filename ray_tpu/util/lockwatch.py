@@ -1,0 +1,347 @@
+"""Runtime lock-order watchdog — the dynamic sibling of lint rule RTL005.
+
+Wraps ``threading.Lock`` / ``threading.RLock`` *creation* in ray_tpu
+modules (caller-module check at the factory, so stdlib and user locks stay
+raw) and bookkeeps every acquire/release:
+
+* **per-thread acquisition stacks** — each thread's currently-held locks
+  with their acquire sites;
+* **order-cycle detection** — a global acquisition-order graph (edge
+  A→B whenever a thread acquires B while holding A). Acquiring an edge
+  whose reverse path already exists is a potential deadlock: it is logged
+  with both acquire sites, counted, and kept in a bounded ring for
+  :func:`state`;
+* **long holds** — releases after more than ``RAY_TPU_LOCKWATCH_HOLD_MS``
+  (default 200) are recorded the same way: a lock held across a blocking
+  call (RTL001's runtime shadow) shows up here even when the static rule
+  could not see it.
+
+Enable with ``RAY_TPU_LOCKWATCH=1`` + :func:`maybe_install` — the tier-1
+conftest does both, so the whole test suite runs under the watchdog.
+Reports flow through the existing plumbing: counters in
+``ray_tpu.util.metrics`` (``lockwatch_order_cycles_total``,
+``lockwatch_long_holds_total``) and the :func:`state` snapshot.
+
+This module must import standalone (no ray_tpu imports at module level):
+the conftest loads it *before* the package so that locks created during
+``import ray_tpu`` are themselves instrumented.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+logger = logging.getLogger("ray_tpu.lockwatch")
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+_meta_lock = _REAL_LOCK()  # guards the order graph + report rings (never wrapped)
+_tls = threading.local()
+
+_installed = False
+_uid = itertools.count(1)
+
+# order graph: lock uid -> set of successor uids (A held while acquiring B)
+_graph: Dict[int, Set[int]] = {}
+# edge -> (site of first observation)
+_edge_sites: Dict[Tuple[int, int], str] = {}
+_names: Dict[int, str] = {}
+
+_MAX_REPORTS = 64
+_cycles: List[dict] = []
+_long_holds: List[dict] = []
+_cycle_pairs_reported: Set[Tuple[int, int]] = set()
+_watched_locks = 0
+
+# counters are created lazily (metrics imports config; this module must
+# stay importable before the package)
+_metric_cycles = None
+_metric_long_holds = None
+
+
+def _hold_threshold_ms() -> float:
+    try:
+        return float(os.environ.get("RAY_TPU_LOCKWATCH_HOLD_MS", "200"))
+    except ValueError:
+        return 200.0
+
+
+def _caller_site(depth: int) -> str:
+    """Cheap acquire-site tag (no traceback machinery on the hot path).
+    Walks past lockwatch's own frames (``with lock:`` enters via
+    __enter__ → acquire) so the tag names user code."""
+    try:
+        f = sys._getframe(depth)
+        while f is not None and f.f_code.co_filename.endswith("lockwatch.py"):
+            f = f.f_back
+        if f is None:
+            return "?"
+        return f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno}"
+    except Exception:  # noqa: BLE001 — frame depth off at thread exit
+        return "?"
+
+
+def _held_stack() -> list:
+    st = getattr(_tls, "held", None)
+    if st is None:
+        st = _tls.held = []
+    return st
+
+
+def _in_watchdog() -> bool:
+    return getattr(_tls, "in_watchdog", False)
+
+
+def _report_metrics(cycles: int = 0, long_holds: int = 0):
+    """Bump the lockwatch counters through util.metrics. Guarded by the
+    reentrancy flag: Counter.inc acquires the (instrumented) metrics lock,
+    which must not recurse into bookkeeping."""
+    global _metric_cycles, _metric_long_holds
+    _tls.in_watchdog = True
+    try:
+        if _metric_cycles is None:
+            from ray_tpu.util.metrics import Counter
+
+            _metric_cycles = Counter(
+                "lockwatch_order_cycles_total",
+                "Lock-order inversions detected by the runtime watchdog",
+            )
+            _metric_long_holds = Counter(
+                "lockwatch_long_holds_total",
+                "Lock holds exceeding RAY_TPU_LOCKWATCH_HOLD_MS",
+            )
+        if cycles:
+            _metric_cycles.inc(cycles)
+        if long_holds:
+            _metric_long_holds.inc(long_holds)
+    except Exception as e:  # noqa: BLE001 — watchdog must never take the process down
+        logger.debug("lockwatch metric report failed: %s", e)
+    finally:
+        _tls.in_watchdog = False
+
+
+def _path_exists(src: int, dst: int) -> bool:
+    """DFS in the order graph (caller holds _meta_lock)."""
+    stack, seen = [src], set()
+    while stack:
+        cur = stack.pop()
+        if cur == dst:
+            return True
+        if cur in seen:
+            continue
+        seen.add(cur)
+        stack.extend(_graph.get(cur, ()))
+    return False
+
+
+class WatchedLock:
+    """Instrumented wrapper over a raw Lock/RLock.
+
+    Supports the full context-manager + acquire/release protocol;
+    everything else (``locked``, RLock owner introspection for
+    ``threading.Condition``) is delegated to the raw lock.
+    """
+
+    def __init__(self, raw, name: str):
+        self._raw = raw
+        self._wuid = next(_uid)
+        _names[self._wuid] = name
+
+    # -- protocol -----------------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if _in_watchdog():
+            return self._raw.acquire(blocking, timeout)
+        held = _held_stack()
+        # Record intent BEFORE blocking: the edge must exist while we wait,
+        # or two threads deadlocking right now would each report nothing.
+        if held:
+            self._note_edges(held)
+        got = self._raw.acquire(blocking, timeout)
+        if got:
+            held.append((self, time.monotonic(), _caller_site(2)))
+        return got
+
+    def release(self):
+        popped = None
+        if not _in_watchdog():
+            held = _held_stack()
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][0] is self:
+                    popped = held.pop(i)
+                    break
+        self._raw.release()
+        # Long-hold reporting AFTER the raw release — logging/metrics must
+        # not extend the very hold they are complaining about.
+        if popped is not None:
+            self._check_hold(popped[1], popped[2])
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        # Everything else — locked() on Lock, RLock internals for
+        # Condition (_is_owned, _release_save, ...) — delegates to the raw
+        # lock, so the wrapper's attribute surface exactly matches what
+        # the unwrapped object would expose on this Python version.
+        return getattr(self._raw, name)
+
+    # -- bookkeeping --------------------------------------------------------
+    def _note_edges(self, held):
+        site = _caller_site(3)
+        new_cycles = 0
+        with _meta_lock:
+            for other, _t0, other_site in held:
+                if other is self:
+                    return  # re-entrant acquire (RLock): no ordering info
+                a, b = other._wuid, self._wuid
+                succ = _graph.setdefault(a, set())
+                if b in succ:
+                    continue
+                # cycle iff the REVERSE direction is already reachable
+                if _path_exists(b, a):
+                    pair = (min(a, b), max(a, b))
+                    if pair not in _cycle_pairs_reported:
+                        _cycle_pairs_reported.add(pair)
+                        new_cycles += 1
+                        info = {
+                            "locks": (_names[a], _names[b]),
+                            "forward": f"{_names[a]} -> {_names[b]} at {site} "
+                                       f"(outer held at {other_site})",
+                            "reverse_first_seen": _edge_sites.get(
+                                (b, a), "(via longer path)"
+                            ),
+                            "thread": threading.current_thread().name,
+                            "time": time.time(),
+                        }
+                        if len(_cycles) < _MAX_REPORTS:
+                            _cycles.append(info)
+                succ.add(b)
+                _edge_sites[(a, b)] = site
+        if new_cycles:
+            logger.warning(
+                "lock-order cycle: acquiring %s while holding %s at %s — "
+                "reverse order seen at %s (potential deadlock)",
+                _names[self._wuid], [_names[o._wuid] for o, _, _ in held],
+                site, _cycles[-1]["reverse_first_seen"] if _cycles else "?",
+            )
+            _report_metrics(cycles=new_cycles)
+
+    def _check_hold(self, t0: float, site: str):
+        dt_ms = (time.monotonic() - t0) * 1000.0
+        if dt_ms < _hold_threshold_ms():
+            return
+        info = {
+            "lock": _names[self._wuid],
+            "held_ms": round(dt_ms, 1),
+            "acquired_at": site,
+            "released_at": _caller_site(3),
+            "thread": threading.current_thread().name,
+            "time": time.time(),
+        }
+        with _meta_lock:
+            if len(_long_holds) < _MAX_REPORTS:
+                _long_holds.append(info)
+        # warn for the first few, then demote to debug — a hot lock with a
+        # systematic long hold would otherwise flood the log
+        level = logging.WARNING if len(_long_holds) <= 20 else logging.DEBUG
+        logger.log(
+            level,
+            "lock %s held %.1f ms (acquired %s, released %s) — blocking "
+            "work under a lock stalls every waiter",
+            info["lock"], dt_ms, site, info["released_at"],
+        )
+        _report_metrics(long_holds=1)
+
+
+def wrap(raw=None, name: Optional[str] = None) -> WatchedLock:
+    """Explicitly instrument a lock (tests / ad-hoc opt-in)."""
+    global _watched_locks
+    if raw is None:
+        raw = _REAL_LOCK()
+    lock = WatchedLock(raw, name or f"lock@{_caller_site(2)}")
+    with _meta_lock:
+        _watched_locks += 1
+    return lock
+
+
+def _should_wrap(module: str) -> bool:
+    return module.startswith("ray_tpu") and module != "ray_tpu.util.lockwatch"
+
+
+def _lock_factory():
+    if _should_wrap(sys._getframe(1).f_globals.get("__name__", "")):
+        return wrap(_REAL_LOCK(), name=f"Lock@{_caller_site(2)}")
+    return _REAL_LOCK()
+
+
+def _rlock_factory():
+    if _should_wrap(sys._getframe(1).f_globals.get("__name__", "")):
+        return wrap(_REAL_RLOCK(), name=f"RLock@{_caller_site(2)}")
+    return _REAL_RLOCK()
+
+
+def install():
+    """Patch threading.Lock/RLock so ray_tpu-created locks are watched.
+
+    Locks created before install (or via ``from threading import Lock``
+    bound earlier) stay raw — call this as early as possible.
+    """
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    logger.info(
+        "lockwatch installed (hold threshold %.0f ms)", _hold_threshold_ms()
+    )
+
+
+def uninstall():
+    global _installed
+    if not _installed:
+        return
+    _installed = False
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+
+
+def maybe_install() -> bool:
+    """Install iff RAY_TPU_LOCKWATCH=1 (the tier-1 conftest entry point)."""
+    if os.environ.get("RAY_TPU_LOCKWATCH", "") == "1":
+        install()
+    return _installed
+
+
+def state() -> dict:
+    """Snapshot for the state API / debugging."""
+    with _meta_lock:
+        return {
+            "installed": _installed,
+            "watched_locks": _watched_locks,
+            "hold_threshold_ms": _hold_threshold_ms(),
+            "order_edges": len(_edge_sites),
+            "cycles": list(_cycles),
+            "long_holds": list(_long_holds),
+        }
+
+
+def reset():
+    """Clear graph + reports (tests)."""
+    with _meta_lock:
+        _graph.clear()
+        _edge_sites.clear()
+        _cycles.clear()
+        _long_holds.clear()
+        _cycle_pairs_reported.clear()

@@ -126,6 +126,17 @@ def compile_state() -> dict:
     return _require_worker()._call("compile_state")
 
 
+def lockwatch_state() -> dict:
+    """THIS process's lock-order-watchdog snapshot (util.lockwatch,
+    enabled via RAY_TPU_LOCKWATCH=1): watched-lock count, the acquisition-
+    order edge count, and bounded rings of detected order cycles and
+    long holds. Cluster-wide counts ride the normal metric flush
+    (``lockwatch_order_cycles_total`` / ``lockwatch_long_holds_total``)."""
+    from ray_tpu.util import lockwatch
+
+    return lockwatch.state()
+
+
 def collective_skew() -> list:
     """Cross-rank skew (max-min last-op latency, ms) per collective
     (group, op) key, worst first — the straggler view per ring/mesh."""

@@ -21,6 +21,29 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Runtime lock-order watchdog (RTL005's dynamic sibling): tier-1 runs with
+# every ray_tpu-created lock instrumented for order cycles and long holds.
+# The module is loaded by file path, pre-seeded into sys.modules under its
+# canonical name, BEFORE `import ray_tpu` anywhere — the package __init__
+# pulls in the whole core, and locks created during that import must
+# already go through the patched factories.
+os.environ.setdefault("RAY_TPU_LOCKWATCH", "1")
+os.environ.setdefault("RAY_TPU_LOCKWATCH_HOLD_MS", "500")
+import importlib.util as _ilu  # noqa: E402
+import sys  # noqa: E402
+
+if "ray_tpu.util.lockwatch" not in sys.modules:
+    _spec = _ilu.spec_from_file_location(
+        "ray_tpu.util.lockwatch",
+        os.path.join(
+            os.path.dirname(__file__), "..", "ray_tpu", "util", "lockwatch.py"
+        ),
+    )
+    _lockwatch = _ilu.module_from_spec(_spec)
+    sys.modules["ray_tpu.util.lockwatch"] = _lockwatch
+    _spec.loader.exec_module(_lockwatch)
+sys.modules["ray_tpu.util.lockwatch"].maybe_install()
+
 # The env vars above only cover worker subprocesses (spawned fresh). For
 # THIS process they are too late: the image's sitecustomize imports jax at
 # interpreter startup, baking JAX_PLATFORMS=axon into jax's config before

@@ -1,0 +1,65 @@
+"""Tier-1 lint gate: ``ray-tpu lint ray_tpu/`` must run clean.
+
+The contract this test enforces (the CI wiring for the analyzer):
+
+* zero non-baselined findings over the configured paths;
+* the committed baseline only shrinks — every entry must still match a
+  live finding (a fixed finding whose entry lingers fails the gate), and
+  it stays small (≤ 25 justified entries);
+* every baseline entry carries a real one-line justification.
+"""
+import json
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from ray_tpu.tools.lint.framework import load_config, run_lint
+
+
+_cached = None
+
+
+def _result():
+    global _cached
+    if _cached is None:
+        _cached = run_lint(root=REPO_ROOT)
+    return _cached
+
+
+def test_lint_runs_clean():
+    res = _result()
+    msgs = "\n".join(f.render() for f in res.findings)
+    assert res.findings == [], (
+        f"new lint findings (fix them, suppress with "
+        f"`# ray-tpu: lint-ignore[RULE]`, or justify in the baseline):\n{msgs}"
+    )
+    assert res.parse_errors == [], res.parse_errors
+    assert res.files_checked > 100  # the walker actually saw the package
+
+
+def test_baseline_only_shrinks():
+    res = _result()
+    stale = "\n".join(json.dumps(e) for e in res.stale_baseline)
+    assert res.stale_baseline == [], (
+        f"baseline entries whose findings are gone — delete them from the "
+        f"baseline file (it may only shrink):\n{stale}"
+    )
+
+
+def test_baseline_is_small_and_justified():
+    cfg = load_config(REPO_ROOT)
+    path = os.path.join(REPO_ROOT, cfg.baseline)
+    with open(path) as f:
+        entries = json.load(f)["findings"]
+    assert len(entries) <= 25, f"baseline grew to {len(entries)} entries"
+    for e in entries:
+        just = e.get("justification", "")
+        assert just and "TODO" not in just, f"unjustified baseline entry: {e}"
+
+
+def test_every_rule_is_registered():
+    from ray_tpu.tools.lint.framework import all_rules
+
+    assert {"RTL001", "RTL002", "RTL003", "RTL004", "RTL005", "RTL006"} <= set(
+        all_rules()
+    )

@@ -1,0 +1,815 @@
+"""Tests for the ray_tpu lint framework (ray_tpu/tools/lint) and the
+runtime lock-order watchdog (ray_tpu/util/lockwatch).
+
+Each rule gets fixture snippets: positive (a true finding), negative
+(idiomatic code that must NOT trip), suppressed (inline directive), and
+baselined (matched by a committed baseline entry). RTL005 additionally
+covers a synthetic A→B / B→A inversion pair, and the lockwatch tests
+provoke a real order cycle and a long hold under threads.
+"""
+import json
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from ray_tpu.tools.lint.framework import (
+    Baseline,
+    LintConfig,
+    baseline_entry,
+    run_lint,
+    scan_suppressions,
+    _toml_section,
+)
+
+
+def lint_src(tmp_path, src, rules=None, extra_files=None, baseline=None):
+    """Write fixture module(s) into a temp project and lint it."""
+    (tmp_path / "mod.py").write_text(textwrap.dedent(src))
+    for name, text in (extra_files or {}).items():
+        (tmp_path / name).write_text(textwrap.dedent(text))
+    cfg = LintConfig(paths=["."], root=str(tmp_path))
+    if rules:
+        cfg.enable = rules
+    if baseline is not None:
+        bl = Baseline(path=str(tmp_path / ".lint-baseline.json"), entries=baseline)
+        bl.save()
+        cfg.baseline = ".lint-baseline.json"
+    return run_lint(paths=None, root=str(tmp_path), config=cfg)
+
+
+def rules_of(result):
+    return [f.rule for f in result.findings]
+
+
+# ---------------------------------------------------------------------------
+# RTL001 blocking-call-under-lock
+
+
+def test_rtl001_positive_with_lock(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import time, threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1.0)
+        """,
+        rules=["RTL001"],
+    )
+    assert rules_of(res) == ["RTL001"]
+    assert "time.sleep" in res.findings[0].message
+
+
+def test_rtl001_positive_acquire_release_span(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import subprocess
+
+        def bad(conn_lock):
+            conn_lock.acquire()
+            subprocess.run(["ls"])
+            conn_lock.release()
+        """,
+        rules=["RTL001"],
+    )
+    assert rules_of(res) == ["RTL001"]
+
+
+def test_rtl001_positive_rpc_call(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        def bad(self):
+            with self._state_lock:
+                self.core._call("metrics_report", [])
+        """,
+        rules=["RTL001"],
+    )
+    assert rules_of(res) == ["RTL001"]
+    assert "RPC" in res.findings[0].message
+
+
+def test_rtl001_nested_locks_single_finding(tmp_path):
+    """One blocking call under two nested locks is ONE defect — reported
+    once, attributed to the innermost lock."""
+    res = lint_src(
+        tmp_path,
+        """
+        import time
+
+        def bad(self):
+            with self._a_lock:
+                with self._b_lock:
+                    time.sleep(1.0)
+        """,
+        rules=["RTL001"],
+    )
+    assert rules_of(res) == ["RTL001"]
+    assert "_b_lock" in res.findings[0].message
+
+
+def test_rtl001_negative(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import time
+
+        def ok(self):
+            with self._lock:
+                x = self.items.pop()
+            time.sleep(0.1)  # outside the lock
+
+        def ok_nested_def(self):
+            with self._lock:
+                def later():
+                    time.sleep(1)  # runs outside the lock scope
+                self.cb = later
+
+        def ok_condition(self):
+            with self._cv:
+                self._cv.wait()  # the correct Condition protocol
+        """,
+        rules=["RTL001"],
+    )
+    assert res.findings == []
+
+
+def test_rtl001_suppressed(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import time
+
+        def held_on_purpose(self):
+            with self._lock:
+                time.sleep(0.001)  # ray-tpu: lint-ignore[RTL001]
+        """,
+        rules=["RTL001"],
+    )
+    assert res.findings == [] and res.suppressed == 1
+
+
+# ---------------------------------------------------------------------------
+# RTL002 blocking-call-in-async
+
+
+def test_rtl002_positive(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import time
+
+        async def handler(fut):
+            time.sleep(0.5)
+            return fut.result()
+        """,
+        rules=["RTL002"],
+    )
+    assert rules_of(res) == ["RTL002", "RTL002"]
+
+
+def test_rtl002_negative_await_and_nested_sync(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import asyncio, time
+        from ray_tpu.utils import rpc
+
+        async def ok():
+            await asyncio.sleep(0.5)
+            peer = await rpc.connect("h", 1, None)  # async connect, not socket
+            def sync_helper():
+                time.sleep(1)  # runs in an executor, not the loop
+            await asyncio.get_event_loop().run_in_executor(None, sync_helper)
+        """,
+        rules=["RTL002"],
+    )
+    assert res.findings == []
+
+
+def test_rtl002_file_suppression(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        # ray-tpu: lint-ignore-file[RTL002]
+        import time
+
+        async def a():
+            time.sleep(1)
+
+        async def b():
+            time.sleep(2)
+        """,
+        rules=["RTL002"],
+    )
+    assert res.findings == [] and res.suppressed == 2
+
+
+# ---------------------------------------------------------------------------
+# RTL003 jit-recompile-hazard
+
+
+def test_rtl003_jit_in_loop(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import jax
+
+        def storm(fns, xs):
+            outs = []
+            for x in xs:
+                outs.append(jax.jit(lambda a: a + 1)(x))
+            return outs
+        """,
+        rules=["RTL003"],
+    )
+    assert rules_of(res) == ["RTL003"]
+    assert "loop" in res.findings[0].message
+
+
+def test_rtl003_scalar_callsite(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def kernel(n, x):
+            return x[:n]
+
+        def drive(batch, x):
+            return kernel(len(batch), x)
+        """,
+        rules=["RTL003"],
+    )
+    assert rules_of(res) == ["RTL003"]
+    assert "len(...)" in res.findings[0].message
+
+
+def test_rtl003_range_loop_var(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(i, x):
+            return x + i
+
+        def drive(x):
+            for i in range(100):
+                x = step(i, x)
+            return x
+        """,
+        rules=["RTL003"],
+    )
+    assert rules_of(res) == ["RTL003"]
+
+
+def test_rtl003_negative_static_args_and_hoisted(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(0,))
+        def kernel(n, x):
+            return x[:n]
+
+        jitted = jax.jit(lambda a: a + 1)  # hoisted: compiled once
+
+        def drive(batch, xs):
+            out = kernel(len(batch), xs)   # static_argnums declared — fine
+            for x in xs:
+                out = jitted(x)            # calling is fine, creating isn't
+            return out
+        """,
+        rules=["RTL003"],
+    )
+    assert res.findings == []
+
+
+def test_rtl003_baselined(tmp_path):
+    src = """
+    import jax
+
+    def build(stages):
+        fns = []
+        for s in stages:
+            fns.append(jax.jit(s))
+        return fns
+    """
+    res = lint_src(tmp_path, src, rules=["RTL003"])
+    assert len(res.findings) == 1
+    entry = baseline_entry(res.findings[0], "one wrapper per stage, bounded")
+    res2 = lint_src(tmp_path, src, rules=["RTL003"], baseline=[entry])
+    assert res2.findings == [] and len(res2.baselined) == 1 and res2.clean
+
+
+# ---------------------------------------------------------------------------
+# RTL004 unbounded-metric-tags
+
+
+def test_rtl004_positive_id_tags(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        def record(m, request_id, task):
+            m.requests.inc(1, tags={"rid": request_id})
+            m.latency.observe(5.0, tags={"task": f"task-{task.task_id}"})
+        """,
+        rules=["RTL004"],
+    )
+    assert rules_of(res) == ["RTL004", "RTL004"]
+
+
+def test_rtl004_positive_loop_var(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        def record(m, replicas):
+            for i, r in enumerate(replicas):
+                m.load.set(r.load, tags={"slot": str(i)})
+        """,
+        rules=["RTL004"],
+    )
+    assert rules_of(res) == ["RTL004"]
+    assert "loop variable" in res.findings[0].message
+
+
+def test_rtl004_negative_bounded_tags(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        def record(m, deployment, rank):
+            m.requests.inc(1, tags={"deployment": deployment})
+            m.step_ms.observe(3.0, tags={"phase": "decode", "rank": str(rank)})
+            m.flags.set(1.0)  # event.set()-style calls without tags: ignored
+        """,
+        rules=["RTL004"],
+    )
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# RTL005 lock-order
+
+
+def test_rtl005_inversion_same_module(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import threading
+
+        _a_lock = threading.Lock()
+        _b_lock = threading.Lock()
+
+        def path1():
+            with _a_lock:
+                with _b_lock:
+                    pass
+
+        def path2():
+            with _b_lock:
+                with _a_lock:
+                    pass
+        """,
+        rules=["RTL005"],
+    )
+    assert rules_of(res) == ["RTL005"]
+    assert "inversion" in res.findings[0].message
+
+
+def test_rtl005_cross_module_inversion(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import threading
+        import other
+
+        _reg_lock = threading.Lock()
+
+        def use():
+            with _reg_lock:
+                with other.flush_lock:
+                    pass
+        """,
+        rules=["RTL005"],
+        extra_files={
+            "other.py": """
+            import threading
+            import mod
+
+            flush_lock = threading.Lock()
+
+            def flush():
+                with flush_lock:
+                    with mod._reg_lock:
+                        pass
+            """,
+        },
+    )
+    assert len(res.findings) >= 1
+    assert all(f.rule == "RTL005" for f in res.findings)
+
+
+def test_rtl005_negative_consistent_order(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import threading
+        _a_lock = threading.Lock()
+        _b_lock = threading.Lock()
+
+        def p1():
+            with _a_lock:
+                with _b_lock:
+                    pass
+
+        def p2():
+            with _a_lock:
+                with _b_lock:
+                    pass
+
+        class C:
+            def reentrant(self):
+                with self._lock:
+                    with self._lock:  # same key: reacquisition, not order
+                        pass
+        """,
+        rules=["RTL005"],
+    )
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# RTL006 silent-exception-swallow
+
+
+def test_rtl006_positive(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        def load(path):
+            try:
+                return open(path).read()
+            except:
+                return None
+
+        def tick(self):
+            try:
+                self.update()
+            except Exception:
+                pass
+        """,
+        rules=["RTL006"],
+    )
+    assert rules_of(res) == ["RTL006", "RTL006"]
+
+
+def test_rtl006_negative_cleanup_and_logged(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def shutdown(self):
+            try:
+                self.conn.close()
+            except Exception:
+                pass  # best-effort teardown: exempt by convention
+
+        def tick(self):
+            try:
+                self.update()
+            except Exception as e:
+                logger.warning("tick failed: %s", e)
+
+        def narrow(path):
+            try:
+                return open(path).read()
+            except OSError:
+                pass
+        """,
+        rules=["RTL006"],
+    )
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# framework: suppression parsing, baseline shrink contract, config
+
+
+def test_suppression_scanning_ignores_strings():
+    sup = scan_suppressions(
+        'x = "# ray-tpu: lint-ignore[RTL001]"\n'
+        "y = 1  # ray-tpu: lint-ignore[RTL002, RTL003]\n"
+    )
+    assert sup.by_line == {2: {"RTL002", "RTL003"}}
+    assert not sup.file_rules
+
+
+def test_suppression_line_above(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import time
+
+        def f(self):
+            with self._lock:
+                # ray-tpu: lint-ignore[RTL001]
+                time.sleep(0.001)
+        """,
+        rules=["RTL001"],
+    )
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_wrong_rule_suppression_does_not_apply(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import time
+
+        def f(self):
+            with self._lock:
+                time.sleep(0.001)  # ray-tpu: lint-ignore[RTL999]
+        """,
+        rules=["RTL001"],
+    )
+    assert rules_of(res) == ["RTL001"]
+
+
+def test_stale_baseline_fails_clean(tmp_path):
+    """The baseline may only shrink: an entry whose finding is gone must
+    be flagged (remove it from the file) rather than silently carried."""
+    stale = {
+        "rule": "RTL001",
+        "path": "mod.py",
+        "scope": "gone",
+        "snippet": "time.sleep(1)",
+        "justification": "was fixed",
+    }
+    res = lint_src(tmp_path, "x = 1\n", rules=["RTL001"], baseline=[stale])
+    assert res.findings == []
+    assert len(res.stale_baseline) == 1
+    assert not res.clean
+
+
+def test_baseline_identity_survives_line_drift(tmp_path):
+    src_v1 = """
+    import time
+
+    def f(self):
+        with self._lock:
+            time.sleep(0.001)
+    """
+    res = lint_src(tmp_path, src_v1, rules=["RTL001"])
+    entry = baseline_entry(res.findings[0], "intentional tiny backoff")
+    # same code, shifted 3 lines down — identity must still match
+    src_v2 = "\n\n\n" + textwrap.dedent(src_v1)
+    (tmp_path / "mod.py").write_text(src_v2)
+    cfg = LintConfig(paths=["."], root=str(tmp_path))
+    cfg.enable = ["RTL001"]
+    Baseline(path=str(tmp_path / ".lint-baseline.json"), entries=[entry]).save()
+    res2 = run_lint(root=str(tmp_path), config=cfg)
+    assert res2.findings == [] and len(res2.baselined) == 1 and res2.clean
+
+
+def test_toml_section_parsing():
+    text = textwrap.dedent(
+        """
+        [project]
+        name = "x"
+
+        [tool.ray-tpu-lint]
+        paths = ["ray_tpu", "tools"]
+        baseline = ".lint-baseline.json"
+        disable = []
+        exclude = [
+            "*/__pycache__/*",
+            "*/vendored/*",
+        ]
+
+        [tool.other]
+        paths = ["nope"]
+        """
+    )
+    sec = _toml_section(text, "tool.ray-tpu-lint")
+    assert sec["paths"] == ["ray_tpu", "tools"]
+    assert sec["baseline"] == ".lint-baseline.json"
+    assert sec["disable"] == []
+    assert sec["exclude"] == ["*/__pycache__/*", "*/vendored/*"]
+
+
+def test_cli_json_and_exit_codes(tmp_path, capsys):
+    from ray_tpu.tools.lint.cli import main
+
+    (tmp_path / "mod.py").write_text(
+        "import time\n\nasync def f():\n    time.sleep(1)\n"
+    )
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.ray-tpu-lint]\npaths = ["."]\n'
+    )
+    rc = main(["--root", str(tmp_path), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["findings"][0]["rule"] == "RTL002"
+    assert out["findings"][0]["fingerprint"]
+    # unknown rule subset -> usage error contract
+    assert main(["--root", str(tmp_path), "--rules", "RTL999"]) == 2
+    # clean tree -> 0
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    assert main(["--root", str(tmp_path), "--format", "json"]) == 0
+
+
+def test_scoped_run_skips_out_of_scope_staleness(tmp_path):
+    """`ray-tpu lint subdir/` must not flag baseline entries for files it
+    did not check as stale."""
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "clean.py").write_text("x = 1\n")
+    other_entry = {
+        "rule": "RTL002",
+        "path": "elsewhere/mod.py",
+        "scope": "f",
+        "snippet": "time.sleep(1)",
+        "justification": "out of scope here",
+    }
+    from ray_tpu.tools.lint.framework import Baseline, LintConfig, run_lint
+
+    Baseline(path=str(tmp_path / ".lint-baseline.json"), entries=[other_entry]).save()
+    cfg = LintConfig(paths=["sub"], root=str(tmp_path))
+    res = run_lint(paths=["sub"], root=str(tmp_path), config=cfg)
+    assert res.stale_baseline == [] and res.clean
+
+
+def test_write_baseline_scoped_keeps_out_of_scope_entries(tmp_path, capsys):
+    from ray_tpu.tools.lint.cli import main
+
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.ray-tpu-lint]\npaths = ["a", "b"]\n'
+    )
+    for d in ("a", "b"):
+        (tmp_path / d).mkdir()
+        (tmp_path / d / "m.py").write_text(
+            "import time\n\nasync def f():\n    time.sleep(1)\n"
+        )
+    assert main(["--root", str(tmp_path), "--write-baseline"]) == 0
+    # fix a/ only, re-baseline only a/ — b/'s entry must survive
+    (tmp_path / "a" / "m.py").write_text("x = 1\n")
+    assert main(["--root", str(tmp_path), "--write-baseline", "a"]) == 0
+    entries = json.load(open(tmp_path / ".lint-baseline.json"))["findings"]
+    assert [e["path"] for e in entries] == ["b/m.py"]
+    assert main(["--root", str(tmp_path)]) == 0
+    capsys.readouterr()
+
+
+def test_zero_files_checked_is_config_error(tmp_path, capsys):
+    from ray_tpu.tools.lint.cli import main
+
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.ray-tpu-lint]\npaths = ["does_not_exist"]\n'
+    )
+    assert main(["--root", str(tmp_path)]) == 2
+    # --write-baseline must refuse too, not "successfully" write an empty file
+    assert main(["--root", str(tmp_path), "--write-baseline"]) == 2
+    capsys.readouterr()
+
+
+def test_rules_flag_overrides_config_disable(tmp_path, capsys):
+    from ray_tpu.tools.lint.cli import main
+
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.ray-tpu-lint]\npaths = ["."]\ndisable = ["RTL002"]\n'
+    )
+    (tmp_path / "mod.py").write_text(
+        "import time\n\nasync def f():\n    time.sleep(1)\n"
+    )
+    assert main(["--root", str(tmp_path)]) == 0  # disabled in config
+    assert main(["--root", str(tmp_path), "--rules", "RTL002"]) == 1  # explicit wins
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order watchdog
+
+
+@pytest.fixture
+def lockwatch():
+    from ray_tpu.util import lockwatch as lw
+
+    lw.reset()
+    yield lw
+    lw.reset()
+
+
+def test_lockwatch_detects_order_cycle(lockwatch):
+    """Two threads acquiring (A then B) and (B then A): the watchdog must
+    flag the inversion even when the interleaving happens not to deadlock."""
+    A = lockwatch.wrap(name="A")
+    B = lockwatch.wrap(name="B")
+    barrier = threading.Barrier(2, timeout=5)
+
+    def ab():
+        with A:
+            with B:
+                barrier.wait()
+
+    def ba():
+        barrier.wait()
+        with B:
+            with A:
+                pass
+
+    t1 = threading.Thread(target=ab)
+    t2 = threading.Thread(target=ba)
+    t1.start(); t2.start(); t1.join(5); t2.join(5)
+
+    st = lockwatch.state()
+    assert len(st["cycles"]) == 1
+    names = set(st["cycles"][0]["locks"])
+    assert names == {"A", "B"}
+
+
+def test_lockwatch_no_false_cycle_on_consistent_order(lockwatch):
+    A = lockwatch.wrap(name="A2")
+    B = lockwatch.wrap(name="B2")
+
+    def ab():
+        with A:
+            with B:
+                pass
+
+    threads = [threading.Thread(target=ab) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5)
+    assert lockwatch.state()["cycles"] == []
+
+
+def test_lockwatch_long_hold(lockwatch, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LOCKWATCH_HOLD_MS", "20")
+    L = lockwatch.wrap(name="slow")
+    with L:
+        time.sleep(0.06)
+    holds = lockwatch.state()["long_holds"]
+    assert holds and holds[0]["lock"] == "slow"
+    assert holds[0]["held_ms"] >= 20
+
+
+def test_lockwatch_wraps_ray_tpu_lock_creation(lockwatch):
+    """After install(), threading.Lock() from a ray_tpu module returns a
+    watched lock; foreign modules keep raw locks."""
+    was_installed = lockwatch.state()["installed"]
+    lockwatch.install()
+    try:
+        ns = {"__name__": "ray_tpu.serve.fake"}
+        exec("import threading\nlock = threading.Lock()", ns)
+        assert isinstance(ns["lock"], lockwatch.WatchedLock)
+        ns2 = {"__name__": "someuser.module"}
+        exec("import threading\nlock = threading.Lock()", ns2)
+        assert not isinstance(ns2["lock"], lockwatch.WatchedLock)
+    finally:
+        if not was_installed:
+            lockwatch.uninstall()
+
+
+def test_lockwatch_reentrant_rlock_ok(lockwatch):
+    L = lockwatch.wrap(threading.RLock(), name="re")
+    with L:
+        with L:
+            pass
+    assert lockwatch.state()["cycles"] == []
+
+
+def test_lockwatch_attribute_surface_matches_raw(lockwatch):
+    """The wrapper exposes exactly what the raw lock would on this Python
+    version: Lock.locked() works; RLock attributes raise AttributeError
+    only when the raw RLock's would."""
+    wrapped = lockwatch.wrap(threading.Lock(), name="l")
+    assert wrapped.locked() is False
+    with wrapped:
+        assert wrapped.locked() is True
+    raw_r = threading.RLock()
+    wrapped_r = lockwatch.wrap(raw_r, name="r")
+    assert hasattr(wrapped_r, "locked") == hasattr(raw_r, "locked")
+    assert hasattr(wrapped_r, "_is_owned")  # Condition protocol delegates
+
+
+def test_lockwatch_enabled_in_tier1(lockwatch):
+    """The conftest sets RAY_TPU_LOCKWATCH=1 and installs the watchdog —
+    tier-1 runs with ray_tpu lock creation instrumented."""
+    assert os.environ.get("RAY_TPU_LOCKWATCH") == "1"
+    assert lockwatch.state()["installed"]
