@@ -356,18 +356,31 @@ class CoreWorker:
                 client = self._plasma_clients[shm_dir] = PlasmaClient(shm_dir)
             return client
 
+    def _resolve_mapping(self, local: bool, shm_dir: str) -> "tuple[PlasmaClient, bool]":
+        """(plasma client whose mapping serves this object on THIS node,
+        whether a missing mapping means a cross-node pull is needed first).
+        The one locality rule shared by the copying (`_read_object`) and
+        pinned (`get_pinned_view`) read paths: remote objects map through
+        the owner's shm_dir only when cross_node_shm says path-opens work
+        (nodes sharing one host's filesystem, the co-located-cluster
+        shortcut); otherwise they are pulled into this node's store."""
+        if local:
+            return self.plasma, False
+        if not self.config.get("cross_node_shm", False):
+            return self.plasma, True
+        return self._plasma_for(shm_dir), False
+
     def _read_object(self, oid: ObjectID, size: int, node_hex: str, shm_dir: str,
                      timeout: Optional[float] = None) -> memoryview:
         local = self.node_id is not None and node_hex == self.node_id.hex()
-        if not local and not self.config.get("cross_node_shm", False):
+        plasma, needs_pull = self._resolve_mapping(local, shm_dir)
+        view = plasma.try_view(oid, size)
+        if view is not None:
+            return view
+        if needs_pull:
             # Network data plane (reference: object_manager.cc Push/Pull):
             # the object lives on another node — pull it into THIS node's
-            # store over the network, then map it locally. Cross-node shm
-            # path-opens only work when nodes share one host's filesystem
-            # (the cross_node_shm=True shortcut for co-located clusters).
-            view = self.plasma.try_view(oid, size)
-            if view is not None:
-                return view
+            # store over the network, then map it locally.
             try:
                 ok = self._call("object_pull", oid, self.node_id, timeout=timeout)
             except (TimeoutError, _CfTimeout):
@@ -376,21 +389,56 @@ class CoreWorker:
                 )
             if not ok:
                 raise ObjectLostError(oid.hex(), "cross-node object pull failed")
-            view = self.plasma.try_view(oid, size)
-            if view is None:
-                raise ObjectLostError(oid.hex(), "object missing after pull")
-            return view
-        plasma = self.plasma if local else self._plasma_for(shm_dir)
-        view = plasma.try_view(oid, size)
-        if view is not None:
-            return view
-        # Possibly spilled to disk — ask the owning node to restore it.
-        if not self._call("object_ensure_local", oid, node_hex):
-            raise ObjectLostError(oid.hex(), "object missing from store")
+            missing = "object missing after pull"
+        else:
+            # Possibly spilled to disk — ask the owning node to restore it.
+            if not self._call("object_ensure_local", oid, node_hex):
+                raise ObjectLostError(oid.hex(), "object missing from store")
+            missing = "object missing from store"
         view = plasma.try_view(oid, size)
         if view is None:
-            raise ObjectLostError(oid.hex(), "object missing from store")
+            raise ObjectLostError(oid.hex(), missing)
         return view
+
+    def get_pinned_view(self, oid: ObjectID, timeout: Optional[float] = None):
+        """Zero-copy read: resolve ``oid`` to a ``(memoryview, release)``
+        pair over the node's shared-memory mapping, pinned against arena
+        eviction until ``release()`` is called (the data layer's zero-copy
+        block decode; reference: plasma client Get returning store buffers
+        that the raylet pins while mapped). Returns None when the object is
+        inline-tier, an error marker, or not mappable — callers fall back
+        to a copying ``get``. Blocks until the object is ready."""
+        e = self.memory_store.lookup(oid.binary())
+        if e is not None:
+            # Owner-local entry: wait for resolution (kind may flip from
+            # inline to shm when a large result lands in the store).
+            try:
+                _, is_err = e.value(timeout)
+            except (TimeoutError, _CfTimeout):
+                raise GetTimeoutError(f"get() timed out after {timeout}s")
+            if is_err or e.kind != "shm":
+                return None
+        resp = self._call("object_get", [oid], timeout)
+        if resp["timeout"]:
+            raise GetTimeoutError(f"get() timed out after {timeout}s")
+        meta = resp["metas"][oid.hex()]
+        if meta[0] != "shm":
+            return None
+        _, size, node_hex, shm_dir, is_error = meta
+        if is_error:
+            return None
+        local = self.node_id is not None and node_hex == self.node_id.hex()
+        plasma, _ = self._resolve_mapping(local, shm_dir)
+        pv = plasma.view_pinned(oid, size)
+        if pv is None:
+            # Spilled, or living on another node: materialize locally
+            # (pull / restore), then map again.
+            try:
+                self._read_object(oid, size, node_hex, shm_dir, timeout=timeout)
+            except ObjectLostError:
+                return None
+            pv = plasma.view_pinned(oid, size)
+        return pv
 
     def get_raw(self, oid: ObjectID) -> tuple[Any, bool]:
         """(value, is_error) without raising — used by arg resolution."""

@@ -107,6 +107,10 @@ class PlasmaStore:
         self.capacity = capacity
         self.used = 0  # file-tier bytes only; the arena self-accounts
         self._entries: Dict[ObjectID, PlasmaEntry] = {}
+        # Arena slots whose refcount-driven delete was refused because a
+        # reader held a pinned view at the time; retried (and freed) on
+        # later eviction passes once the pins drop.
+        self._deferred_deletes: set = set()
         self._lock = threading.Lock()
         self._arena = None
         arena_mod = _try_arena()
@@ -156,18 +160,41 @@ class PlasmaStore:
             self.used += size
         return PlasmaBuffer(self._part_path(oid), size, writable=True)
 
+    def _drain_deferred_deletes(self):
+        """Free arena slots whose delete was refused while pinned (the
+        pins have since dropped for any that succeed here)."""
+        for vid in list(self._deferred_deletes):
+            if self._arena.delete(vid.binary()):
+                self._deferred_deletes.discard(vid)
+
     def _arena_alloc_evicting(self, oid_bytes: bytes, size: int):
         """Arena alloc, spilling LRU victims to disk until it fits (the
         reference's eviction-on-create, plasma/eviction_policy.cc)."""
+        self._drain_deferred_deletes()
+        swept = False
         while True:
             buf = self._arena.create_object(oid_bytes, size)
             if buf is not None:
                 return buf
             victim = self._arena.lru_victim()
             if victim is None:
+                # Everything evictable may be pinned by crashed readers —
+                # reclaim dead-process pins once, then retry.
+                if not swept:
+                    swept = True
+                    if self._arena.sweep_pins() > 0:
+                        self._drain_deferred_deletes()
+                        continue
                 return None  # nothing evictable; caller falls back
             vid_bytes, vsize = victim
             vid = ObjectID(vid_bytes)
+            if vid in self._deferred_deletes:
+                # Refcount-dead, delete deferred while a reader was
+                # pinned; it is unpinned now (lru_victim skips pins) —
+                # free it without spilling (nothing will ever fetch it).
+                if self._arena.delete(vid_bytes):
+                    self._deferred_deletes.discard(vid)
+                continue
             ve = self._entries.get(vid)
             vbuf = self._arena.get(vid_bytes)
             if vbuf is not None:
@@ -179,7 +206,16 @@ class PlasmaStore:
                     with open(self._spill_path(vid), "wb") as f:
                         f.write(vbuf.view())
                 vbuf.close()
-            self._arena.delete(vid_bytes)
+            if not self._arena.delete(vid_bytes):
+                # A reader pinned the victim (view_pinned) after the LRU
+                # scan — the slot must stay resident while mapped. Leave
+                # the entry arena-backed (spilled stays False) and drop
+                # the copy written above: delete() only cleans the cloud
+                # spill path for entries marked spilled, so keeping it
+                # would leak the blob.
+                if vbuf is not None:
+                    self._delete_spilled(vid)
+                continue
             if ve is not None:
                 ve.spilled = True
                 ve.in_arena = False
@@ -274,7 +310,11 @@ class PlasmaStore:
             if e is None:
                 return
             if e.in_arena and self._arena is not None:
-                self._arena.delete(oid.binary())
+                if not self._arena.delete(oid.binary()):
+                    # A live reader holds a pinned view — the slot stays
+                    # resident until the pin drops; eviction passes retry
+                    # the delete (and skip spilling these).
+                    self._deferred_deletes.add(oid)
             elif not e.spilled:
                 self.used -= e.size
             for p in (self._shm_path(oid), self._part_path(oid)):
@@ -388,6 +428,10 @@ class PlasmaStore:
             shutil.rmtree(self.spill_dir, ignore_errors=True)
 
 
+def _noop_release():
+    pass
+
+
 class PlasmaClient:
     """Worker-side view: maps objects created by any process on this node."""
 
@@ -395,17 +439,24 @@ class PlasmaClient:
         self.shm_dir = shm_dir
         self._arena = None
         self._arena_tried = False
+        self._arena_lock = threading.Lock()
 
     def _get_arena(self):
+        # Locked lazy init: concurrent first readers (the data iterator's
+        # prefetch pool) must not observe _arena_tried=True while _arena
+        # is still being opened — that sent them to the file tier for
+        # arena-resident objects ("object missing from store").
         if not self._arena_tried:
-            self._arena_tried = True
-            arena_mod = _try_arena()
-            path = os.path.join(self.shm_dir, "arena")
-            if arena_mod is not None and os.path.exists(path):
-                try:
-                    self._arena = arena_mod.Arena.open(path)
-                except Exception as e:
-                    logger.warning("arena open failed (%s); file mode", e)
+            with self._arena_lock:
+                if not self._arena_tried:
+                    arena_mod = _try_arena()
+                    path = os.path.join(self.shm_dir, "arena")
+                    if arena_mod is not None and os.path.exists(path):
+                        try:
+                            self._arena = arena_mod.Arena.open(path)
+                        except Exception as e:
+                            logger.warning("arena open failed (%s); file mode", e)
+                    self._arena_tried = True
         return self._arena
 
     def _path(self, oid: ObjectID) -> str:
@@ -483,6 +534,9 @@ class PlasmaClient:
             buf = arena.get(oid.binary())
             if buf is not None:
                 return buf.view()
+        return self._file_view(oid, size)
+
+    def _file_view(self, oid: ObjectID, size: int) -> Optional[memoryview]:
         path = self._path(oid)
         try:
             fd = os.open(path, os.O_RDONLY)
@@ -493,3 +547,33 @@ class PlasmaClient:
         finally:
             os.close(fd)
         return memoryview(mm)
+
+    def view_pinned(self, oid: ObjectID, size: int):
+        """Zero-copy ``(view, release)`` of a sealed object, protected from
+        arena eviction until ``release()`` runs (idempotent). None when the
+        object is not mappable here (spilled / never local). The pin count
+        lives in the shared arena table (any process may pin objects any
+        other process wrote) and is taken BEFORE the lookup so eviction
+        cannot recycle the slot between map and use; file-tier views need
+        no pin — the mapping keeps the inode alive across spills and
+        unlinks."""
+        arena = self._get_arena()
+        if arena is not None and arena.pin(oid.binary(), 1) >= 1:
+            buf = arena.get(oid.binary())
+            if buf is not None:
+                lock = threading.Lock()
+                released = [False]
+
+                def release():
+                    with lock:
+                        if released[0]:
+                            return
+                        released[0] = True
+                    arena.pin(oid.binary(), -1)
+
+                return buf.view(), release
+            arena.pin(oid.binary(), -1)  # unsealed or raced away
+        view = self._file_view(oid, size)
+        if view is None:
+            return None
+        return view, _noop_release

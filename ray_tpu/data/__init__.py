@@ -10,6 +10,7 @@ import numpy as np
 
 from ray_tpu.data.aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset, GroupedData
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.datasource import (

@@ -32,6 +32,11 @@ class BlockMetadata:
     schema: Optional[Dict[str, str]] = None
     input_files: List[str] = field(default_factory=list)
     exec_stats: Optional[Dict[str, float]] = None
+    # True iff the block is a dict of ndarray columns — the zero-copy
+    # decode precondition; None = unknown (the consumer probes). Lets the
+    # iterator skip the pinned-view attempt (and its decode-twice
+    # fallback) for blocks known not to qualify.
+    columnar: Optional[bool] = None
 
 
 def _rows_of(block: Block) -> int:
@@ -77,11 +82,15 @@ class BlockAccessor:
         return _size_of(self._block)
 
     def metadata(self, input_files: Optional[List[str]] = None) -> BlockMetadata:
+        b = self._block
         return BlockMetadata(
             num_rows=self.num_rows(),
             size_bytes=self.size_bytes(),
-            schema=_schema_of(self._block),
+            schema=_schema_of(b),
             input_files=input_files or [],
+            columnar=isinstance(b, dict)
+            and bool(b)
+            and all(isinstance(v, np.ndarray) for v in b.values()),
         )
 
     # -- row iteration ----------------------------------------------------

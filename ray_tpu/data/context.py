@@ -18,6 +18,16 @@ class DataContext:
     # Autoscaling actor pools: kill an idle actor above min_size after
     # this long (reference: execution/autoscaler actor-pool scaling).
     actor_idle_timeout_s: float = 2.0
+    # Consumption-end pipeline (data/iterator.py). ``prefetch_blocks``:
+    # bundle refs resolved ahead of the consumer (0 disables both block
+    # prefetch and the background rebatch thread — the fully synchronous
+    # legacy path). ``rebatch_queue_depth``: host batches buffered between
+    # the rebatch thread and the consumer. ``prefetch_to_device``: batches
+    # device_put ahead of the caller in iter_jax_batches (bounds pinned
+    # HBM; 0 = synchronous transfer).
+    prefetch_blocks: int = 2
+    rebatch_queue_depth: int = 2
+    prefetch_to_device: int = 2
 
     _current = None
 
@@ -26,3 +36,19 @@ class DataContext:
         if cls._current is None:
             cls._current = cls()
         return cls._current
+
+    def to_dict(self) -> dict:
+        """Snapshot for shipping to another process (the context is
+        process-local; actors/train workers get the driver's values via
+        this + apply_overrides)."""
+        import dataclasses
+
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def apply_overrides(cls, overrides: "dict | None") -> "DataContext":
+        ctx = cls.get_current()
+        for k, v in (overrides or {}).items():
+            if hasattr(ctx, k):
+                setattr(ctx, k, v)
+        return ctx

@@ -24,6 +24,7 @@ from ray_tpu.data.logical import (
     Union as LUnion,
     Zip as LZip,
 )
+from ray_tpu.data.metrics import data_metrics
 from ray_tpu.data.operators import (
     ActorPoolMapOperator,
     AllToAllOperator,
@@ -131,6 +132,7 @@ class StreamingExecutor:
                 queued_bytes=o.input_bytes(),
                 peak_in_bytes=o.peak_in_bytes,
                 active_tasks=o.num_active_tasks(),
+                backpressure_stalls=o.backpressure_stalls,
             )
             if hasattr(o, "pool_size"):
                 row["actors"] = o.pool_size
@@ -207,8 +209,12 @@ def _step_chain(ops: List[PhysicalOperator]) -> bool:
     # OOMing the store; reference: resource-aware backpressure).
     for i, op in enumerate(ops):
         downstream_full = i + 1 < len(ops) and _input_saturated(ops[i + 1])
-        if not (downstream_full or _output_saturated(op)):
-            op.poll()
+        if downstream_full or _output_saturated(op):
+            if not op.completed():
+                op.backpressure_stalls += 1
+                data_metrics().backpressure_stalls.inc(1, {"op": op.name})
+            continue
+        op.poll()
     return all(o.completed() for o in ops)
 
 
@@ -378,8 +384,19 @@ class SplitCoordinator:
         self._dead = [False] * n
         self._n = n
         self._equal = equal
+        # A pump-thread crash must NOT look like a clean end of stream —
+        # consumers re-raise this instead of stopping at the sentinel
+        # (otherwise every rank trains on partial data and fit() reports
+        # success).
+        self._error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._pump, daemon=True, name="split-pump")
         self._thread.start()
+
+    def _check_error(self):
+        if self._error is not None:
+            raise RuntimeError(
+                "streaming_split execution failed"
+            ) from self._error
 
     def _pump(self):
         import queue as _q
@@ -407,6 +424,8 @@ class SplitCoordinator:
                         # equal=True: must keep round-robin; retry same slot
                         # by rewinding unless it died meanwhile.
                         i -= 1
+        except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
+            self._error = e
         finally:
             for idx, q in enumerate(self._queues):
                 while not self._dead[idx]:
@@ -422,7 +441,54 @@ class SplitCoordinator:
             while True:
                 item = q.get()
                 if item is None:
+                    self._check_error()
                     return
                 yield item
         finally:
             self._dead[idx] = True
+
+    def release(self, idx: int):
+        """Mark split ``idx`` abandoned: the pump skips it from now on and
+        its queued bundles are discarded (the same invariant iter_split's
+        ``finally`` enforces — without it, one consumer stopping early
+        leaves the pump stalled on that split's full queue and starves
+        every other split)."""
+        import queue as _q
+
+        self._dead[idx] = True
+        while True:
+            try:
+                self._queues[idx].get_nowait()
+            except _q.Empty:
+                return
+
+    def next_batch(self, idx: int, max_n: int = 8) -> Optional[List[RefBundle]]:
+        """Up to ``max_n`` bundles for split ``idx`` — blocks for the
+        first (None = end of stream), then drains whatever is immediately
+        ready without blocking. The amortized pull interface the
+        cross-process shard coordinator actor exposes to train workers."""
+        import queue as _q
+
+        q = self._queues[idx]
+        if self._dead[idx]:
+            self._check_error()
+            return None
+        item = q.get()
+        if item is None:
+            self._dead[idx] = True
+            self._check_error()
+            return None
+        out = [item]
+        while len(out) < max_n:
+            try:
+                nxt = q.get_nowait()
+            except _q.Empty:
+                break
+            if nxt is None:
+                # Don't raise mid-drain — the collected bundles still
+                # belong to the consumer; the next call sees _dead and
+                # surfaces any pump error.
+                self._dead[idx] = True
+                break
+            out.append(nxt)
+        return out

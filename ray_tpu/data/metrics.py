@@ -1,0 +1,78 @@
+"""Consumption-end data-pipeline metrics.
+
+Reference: the reference's per-iterator stats (python/ray/data/_internal/
+stats.py ``iter_wait_s``/``iter_total_blocked_s``) exported as metrics.
+These ride the PR-1/PR-3 telemetry pipeline: Counter/Gauge/Histogram
+instances flush to the controller and surface in Prometheus/Grafana (the
+"Data" dashboard row) automatically.
+
+``counts`` is a plain process-local mirror of the counters for tests and
+bench.py: the metric registry drains *deltas* at flush time, so Metric
+internals cannot be read back reliably from the recording process.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_metrics = None
+
+# Wait/transfer times are sub-millisecond when the pipeline keeps up —
+# boundaries start well below the step times train_step_wall_ms uses.
+_MS_BOUNDARIES = (
+    0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 5000,
+)
+
+
+class _DataMetrics:
+    def __init__(self):
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        self.iter_wait_ms = Histogram(
+            "data_iter_wait_ms",
+            "Consumer-side wait for the next batch from a DataIterator "
+            "(pipeline on: queue wait; pipeline off: inline fetch+rebatch)",
+            _MS_BOUNDARIES,
+        )
+        self.prefetch_depth = Gauge(
+            "data_prefetch_depth",
+            "Batches buffered ahead of the consumer in a pipeline stage",
+            ("stage",),
+        )
+        self.zero_copy_hits = Counter(
+            "data_zero_copy_hits_total",
+            "Blocks decoded as numpy views over the shared-memory store "
+            "(no deserialize copy)",
+        )
+        self.zero_copy_misses = Counter(
+            "data_zero_copy_misses_total",
+            "Blocks materialized through the copying get path (inline-tier, "
+            "row blocks, or unviewable objects)",
+        )
+        self.h2d_ms = Histogram(
+            "data_h2d_ms",
+            "Host-to-device dispatch time per batch (jax.device_put)",
+            _MS_BOUNDARIES,
+        )
+        self.backpressure_stalls = Counter(
+            "data_backpressure_stalls_total",
+            "Scheduler ticks that refused to poll an operator because its "
+            "downstream buffer was saturated",
+            ("op",),
+        )
+        # Process-local, non-draining counters (tests/bench read these).
+        self.counts: Dict[str, int] = {}
+
+    def bump(self, key: str, n: int = 1):
+        with _lock:
+            self.counts[key] = self.counts.get(key, 0) + n
+
+
+def data_metrics() -> _DataMetrics:
+    global _metrics
+    if _metrics is None:
+        with _lock:
+            if _metrics is None:
+                _metrics = _DataMetrics()
+    return _metrics

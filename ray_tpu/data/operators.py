@@ -180,6 +180,10 @@ class PhysicalOperator:
         self.blocks_out = 0
         self.tasks_submitted = 0
         self.peak_in_bytes = 0
+        # Scheduler ticks that refused to poll this operator because its
+        # downstream buffer was saturated (backpressure observability —
+        # also data_backpressure_stalls_total{op}).
+        self.backpressure_stalls = 0
 
     # -- executor-facing ---------------------------------------------------
     def add_input(self, bundle: RefBundle):

@@ -1,0 +1,100 @@
+"""Dataset shards for distributed trainers.
+
+Reference: python/ray/data/_internal/iterator/stream_split_iterator.py —
+the reference hosts a ``SplitCoordinator`` actor that runs ONE shared
+streaming execution and fans output bundles out to n consumers.
+``Dataset.streaming_split`` covers same-process consumers via
+executor.SplitCoordinator; this module lifts the same coordinator behind
+an actor so TRAIN WORKERS in other processes can pull bundle *refs* (never
+block payloads — those resolve worker-side through the pipelined
+DataIterator, zero-copy where the tiers allow) from one shared execution.
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.data.operators import RefBundle
+
+logger = logging.getLogger(__name__)
+
+
+class ShardCoordinator:
+    """Actor: runs one streaming execution, fans bundles out to n splits.
+
+    Must be created with ``max_concurrency > n`` — each split's blocking
+    pull occupies an actor thread, and one starved split must not block
+    the others (see :func:`create_shard_coordinator`).
+    """
+
+    def __init__(self, dag, n: int, equal: bool = True,
+                 data_context: Optional[dict] = None):
+        from ray_tpu.data.context import DataContext
+        from ray_tpu.data.executor import SplitCoordinator, plan_to_operators
+        from ray_tpu.data.logical import LogicalPlan
+
+        # The driver's DataContext does not propagate to actor processes —
+        # apply its snapshot so executor knobs (byte budgets, ...) behave
+        # as tuned on the driver.
+        DataContext.apply_overrides(data_context)
+        plan = LogicalPlan(dag).optimized()
+        self._coord = SplitCoordinator(plan_to_operators(plan), n, equal)
+
+    def next_bundles(
+        self, split: int, max_n: int = 8
+    ) -> Optional[List[Tuple]]:
+        """Up to ``max_n`` ``(ref, meta)`` pairs for ``split``; blocks for
+        the first; None at end of stream."""
+        bundles = self._coord.next_batch(split, max_n)
+        if bundles is None:
+            return None
+        return [(b.ref, b.meta) for b in bundles]
+
+    def release_split(self, split: int):
+        """A consumer stopped iterating early — unblock the pump so the
+        remaining splits keep streaming."""
+        self._coord.release(split)
+        return True
+
+
+def create_shard_coordinator(ds, n: int, *, equal: bool = True):
+    """Spawn the coordinator actor for ``ds`` split ``n`` ways."""
+    from ray_tpu.data.context import DataContext
+
+    actor_cls = ray_tpu.remote(ShardCoordinator)
+    return actor_cls.options(max_concurrency=n + 2).remote(
+        ds._dag, n, equal, DataContext.get_current().to_dict()
+    )
+
+
+def shard_iterator(actor, split: int):
+    """Worker-side :class:`DataIterator` over one split of a coordinator
+    actor's execution (what ``train.get_dataset_shard`` hands the loop)."""
+    from ray_tpu.data.iterator import DataIterator
+
+    def factory():
+        done = False
+        try:
+            while True:
+                out = ray_tpu.get(actor.next_bundles.remote(split))
+                if not out:
+                    done = True
+                    return
+                for ref, meta in out:
+                    yield RefBundle(ref, meta)
+        finally:
+            if not done:
+                # Abandoned mid-stream (break / error): tell the
+                # coordinator, or the round-robin pump stalls on this
+                # split's full queue and starves the other ranks.
+                try:
+                    actor.release_split.remote(split)
+                except Exception:
+                    logger.debug(
+                        "release_split(%d) failed (coordinator gone?)",
+                        split,
+                        exc_info=True,
+                    )
+
+    return DataIterator(factory)

@@ -105,6 +105,10 @@ class Arena:
     def pin(self, oid: bytes, delta: int = 1) -> int:
         return self._lib.rt_arena_pin(self._h, oid, delta)
 
+    def sweep_pins(self) -> int:
+        """Drop pins held by dead processes; returns pins reclaimed."""
+        return self._lib.rt_arena_sweep_pins(self._h)
+
     def lru_victim(self) -> Optional[Tuple[bytes, int]]:
         out = (ctypes.c_uint8 * 16)()
         size = ctypes.c_uint64()

@@ -84,6 +84,8 @@ def load() -> Optional[ctypes.CDLL]:
         lib.rt_arena_pin.argtypes = [p, ctypes.c_char_p, ctypes.c_int]
         lib.rt_arena_delete.restype = ctypes.c_int
         lib.rt_arena_delete.argtypes = [p, ctypes.c_char_p]
+        lib.rt_arena_sweep_pins.restype = ctypes.c_int
+        lib.rt_arena_sweep_pins.argtypes = [p]
         lib.rt_arena_lru_victim.restype = ctypes.c_int
         lib.rt_arena_lru_victim.argtypes = [p, u8p, ctypes.POINTER(u64)]
         lib.rt_arena_stats.argtypes = [p, ctypes.POINTER(u64), ctypes.POINTER(u64), ctypes.POINTER(u64)]

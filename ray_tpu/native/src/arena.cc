@@ -19,22 +19,31 @@
 // Exposed as a C ABI for ctypes (no pybind11 in the image).
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <cerrno>
 #include <cstdio>
 
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
 namespace {
 
-constexpr uint64_t kMagic = 0x52545455504c4153ull;  // "RTTUPLAS"
+// Layout v2 (pid-attributed pins); old-magic files refuse to open so a
+// stale arena from a previous build can never be mapped with the wrong
+// slot stride.
+constexpr uint64_t kMagic = 0x52545455504c4155ull;  // "RTTUPLAU"
 constexpr uint64_t kAlign = 64;                     // cacheline
 constexpr uint64_t kMinSplit = 128;
 constexpr uint32_t kIdBytes = 16;
+// Per-slot pin attribution: enough for the handful of reader processes
+// that realistically share one block; further pinners fall into an
+// untracked (unsweepable) overflow count.
+constexpr uint32_t kMaxPinners = 4;
 
 enum SlotState : uint32_t {
   kEmpty = 0,
@@ -48,8 +57,23 @@ struct Slot {
   uint64_t offset;  // data offset from arena base
   uint64_t size;
   uint32_t state;
-  uint32_t pinned;
+  uint32_t pinned;          // total pin count (tracked + untracked)
   uint64_t lru_tick;
+  // Pin ownership, so a reader that dies without unpinning (OOM-kill,
+  // segfault) doesn't make the slot unevictable forever: sweep_pins
+  // drops pins whose pid no longer exists.
+  uint32_t pinner_pid[kMaxPinners];
+  uint32_t pinner_count[kMaxPinners];
+  // Liveness token per pinner: pid-namespace inode + process start time.
+  // A raw pid is ambiguous — containerized readers (own pid namespace,
+  // same mounted arena) report pids that alias unrelated host processes,
+  // and a recycled pid would keep a dead reader's pins alive (or sweep a
+  // live one's). Sweeping only trusts pids from its OWN namespace whose
+  // start time still matches.
+  uint64_t pinner_ns[kMaxPinners];
+  uint64_t pinner_start[kMaxPinners];
+  uint32_t pin_untracked;   // overflow pins with no pid attribution
+  uint32_t _pad;
 };
 
 struct Header {
@@ -91,6 +115,56 @@ inline BlockHeader* block_at(Arena* a, uint64_t off) {
 
 inline uint64_t align_up(uint64_t v, uint64_t align) {
   return (v + align - 1) & ~(align - 1);
+}
+
+// -- pinner liveness tokens --------------------------------------------------
+
+// starttime (field 22 of /proc/<pid>/stat, clock ticks since boot) — the
+// canonical pid-reuse discriminator. 0 = unknown.
+uint64_t proc_start_time_path(const char* path) {
+  FILE* f = fopen(path, "re");
+  if (f == nullptr) return 0;
+  char buf[1024];
+  size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+  fclose(f);
+  if (n == 0) return 0;
+  buf[n] = '\0';
+  // comm (field 2) may itself contain spaces/parens: parse past the
+  // LAST ')' and count space-separated fields from state (field 3).
+  const char* p = strrchr(buf, ')');
+  if (p == nullptr) return 0;
+  p++;
+  int field = 2;
+  while (*p != '\0') {
+    while (*p == ' ') p++;
+    if (*p == '\0') break;
+    field++;
+    if (field == 22) return strtoull(p, nullptr, 10);
+    while (*p != '\0' && *p != ' ') p++;
+  }
+  return 0;
+}
+
+uint64_t self_pid_ns_inode() {
+  struct stat st;
+  if (stat("/proc/self/ns/pid", &st) != 0) return 0;
+  return static_cast<uint64_t>(st.st_ino);
+}
+
+// Per-process cache (post-fork the pid check invalidates it). Concurrent
+// first callers write identical values, so the race is benign.
+uint32_t g_tok_pid = 0;
+uint64_t g_tok_ns = 0;
+uint64_t g_tok_start = 0;
+
+void self_pin_token(uint32_t pid, uint64_t* ns, uint64_t* start) {
+  if (g_tok_pid != pid) {
+    g_tok_ns = self_pid_ns_inode();
+    g_tok_start = proc_start_time_path("/proc/self/stat");
+    g_tok_pid = pid;
+  }
+  *ns = g_tok_ns;
+  *start = g_tok_start;
 }
 
 void lock(Arena* a) {
@@ -352,6 +426,11 @@ int64_t rt_arena_alloc(void* handle, const uint8_t* id, uint64_t size) {
   s->size = size;
   s->state = kCreated;
   s->pinned = 0;
+  memset(s->pinner_pid, 0, sizeof(s->pinner_pid));
+  memset(s->pinner_count, 0, sizeof(s->pinner_count));
+  memset(s->pinner_ns, 0, sizeof(s->pinner_ns));
+  memset(s->pinner_start, 0, sizeof(s->pinner_start));
+  s->pin_untracked = 0;
   s->lru_tick = a->hdr->lru_clock++;
   a->hdr->used += size;
   a->hdr->num_objects++;
@@ -392,20 +471,111 @@ int64_t rt_arena_lookup(void* handle, const uint8_t* id, uint64_t* size_out) {
 
 int rt_arena_pin(void* handle, const uint8_t* id, int delta) {
   Arena* a = static_cast<Arena*>(handle);
+  uint32_t pid = static_cast<uint32_t>(getpid());
   lock(a);
   Slot* s = find_slot(a, id, false);
   int rc = -1;
   if (s && (s->state == kSealed || s->state == kCreated)) {
-    if (delta > 0)
-      s->pinned += delta;
-    else if (s->pinned >= static_cast<uint32_t>(-delta))
-      s->pinned += delta;
-    else
-      s->pinned = 0;
+    if (delta > 0) {
+      // Attribute to the calling pid so sweep_pins can reclaim pins of
+      // dead readers; overflow goes untracked (never swept).
+      uint64_t tok_ns, tok_start;
+      self_pin_token(pid, &tok_ns, &tok_start);
+      Slot* slot = s;
+      int idx = -1;
+      for (uint32_t i = 0; i < kMaxPinners; i++) {
+        if (slot->pinner_count[i] > 0 && slot->pinner_pid[i] == pid) {
+          idx = static_cast<int>(i);
+          break;
+        }
+        if (idx < 0 && slot->pinner_count[i] == 0) idx = static_cast<int>(i);
+      }
+      if (idx >= 0 && slot->pinner_count[idx] > 0 &&
+          slot->pinner_pid[idx] == pid &&
+          (slot->pinner_ns[idx] != tok_ns ||
+           slot->pinner_start[idx] != tok_start)) {
+        // Same pid, different liveness token: the entry belongs to a
+        // DEAD process whose pid we recycled — reclaim its pins before
+        // taking over the entry.
+        uint32_t stale = slot->pinner_count[idx];
+        s->pinned = s->pinned >= stale ? s->pinned - stale : 0;
+        slot->pinner_count[idx] = 0;
+      }
+      if (idx >= 0 && (slot->pinner_count[idx] == 0 ||
+                       slot->pinner_pid[idx] == pid)) {
+        slot->pinner_pid[idx] = pid;
+        slot->pinner_ns[idx] = tok_ns;
+        slot->pinner_start[idx] = tok_start;
+        slot->pinner_count[idx] += static_cast<uint32_t>(delta);
+      } else {
+        slot->pin_untracked += static_cast<uint32_t>(delta);
+      }
+      s->pinned += static_cast<uint32_t>(delta);
+    } else if (delta < 0) {
+      uint32_t dec = static_cast<uint32_t>(-delta);
+      bool found = false;
+      for (uint32_t i = 0; i < kMaxPinners; i++) {
+        if (s->pinner_count[i] > 0 && s->pinner_pid[i] == pid) {
+          uint32_t d = dec < s->pinner_count[i] ? dec : s->pinner_count[i];
+          s->pinner_count[i] -= d;
+          found = true;
+          break;
+        }
+      }
+      if (!found && s->pin_untracked > 0) {
+        uint32_t d = dec < s->pin_untracked ? dec : s->pin_untracked;
+        s->pin_untracked -= d;
+      }
+      s->pinned = s->pinned >= dec ? s->pinned - dec : 0;
+    }
     rc = static_cast<int>(s->pinned);
   }
   unlock(a);
   return rc;
+}
+
+// Drop pins owned by processes that no longer exist (reader crashed
+// before its finalizers ran); returns the number of pins reclaimed.
+// The reference plasma releases a client's pins when its store
+// connection drops — mapped-file readers have no connection, so
+// liveness is checked by pid instead.
+int rt_arena_sweep_pins(void* handle) {
+  Arena* a = static_cast<Arena*>(handle);
+  uint64_t my_ns = self_pid_ns_inode();
+  if (my_ns == 0) return 0;  // cannot establish a namespace: judge nothing
+  lock(a);
+  int reclaimed = 0;
+  for (uint64_t i = 0; i < a->hdr->table_slots; i++) {
+    Slot* s = &a->table[i];
+    if (s->state == kEmpty || s->state == kTombstone || s->pinned == 0)
+      continue;
+    for (uint32_t j = 0; j < kMaxPinners; j++) {
+      uint32_t pid = s->pinner_pid[j];
+      uint32_t cnt = s->pinner_count[j];
+      if (cnt == 0) continue;
+      // Pins from another pid namespace (containerized reader over the
+      // mounted arena) are unjudgeable here — kill() would probe an
+      // unrelated host pid and could sweep a LIVE reader's pin out from
+      // under its mapped views. Never touch them.
+      if (s->pinner_ns[j] == 0 || s->pinner_ns[j] != my_ns) continue;
+      bool dead = false;
+      if (kill(static_cast<pid_t>(pid), 0) == -1 && errno == ESRCH) {
+        dead = true;
+      } else if (s->pinner_start[j] != 0) {
+        char path[64];
+        snprintf(path, sizeof(path), "/proc/%u/stat", pid);
+        uint64_t now = proc_start_time_path(path);
+        if (now != 0 && now != s->pinner_start[j]) dead = true;  // pid reused
+      }
+      if (dead) {
+        s->pinner_count[j] = 0;
+        s->pinned = s->pinned >= cnt ? s->pinned - cnt : 0;
+        reclaimed += static_cast<int>(cnt);
+      }
+    }
+  }
+  unlock(a);
+  return reclaimed;
 }
 
 int rt_arena_delete(void* handle, const uint8_t* id) {
@@ -415,6 +585,13 @@ int rt_arena_delete(void* handle, const uint8_t* id) {
   if (s == nullptr || s->state == kEmpty || s->state == kTombstone) {
     unlock(a);
     return -1;
+  }
+  if (s->pinned > 0) {
+    // A reader took a pin (rt_arena_pin) between the caller's victim
+    // scan and this delete — freeing now would recycle memory a mapped
+    // numpy view still reads. Refuse; the caller picks another victim.
+    unlock(a);
+    return -2;
   }
   heap_free(a, s->offset);
   a->hdr->used -= s->size;

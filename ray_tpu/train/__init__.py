@@ -9,7 +9,13 @@ from ray_tpu.train.config import (
     RunConfig,
     ScalingConfig,
 )
-from ray_tpu.train.session import get_checkpoint, get_context, report, timed
+from ray_tpu.train.session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+    timed,
+)
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
 from ray_tpu.train.torch import TorchTrainer
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
@@ -25,6 +31,7 @@ __all__ = [
     "report",
     "get_context",
     "get_checkpoint",
+    "get_dataset_shard",
     "timed",
     "DataParallelTrainer",
     "JaxTrainer",

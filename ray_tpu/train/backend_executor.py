@@ -59,7 +59,8 @@ class BackendExecutor:
             placement_group=self.pg,
         )
 
-    def setup_sessions(self, latest_checkpoint: Optional[str]):
+    def setup_sessions(self, latest_checkpoint: Optional[str],
+                       dataset_shards: Optional[Dict] = None):
         assert self.worker_group is not None
         group_name = f"__train__{uuid.uuid4().hex[:8]}"
         self._group_name = group_name
@@ -76,10 +77,26 @@ class BackendExecutor:
             )
             env = dict(self.scaling.worker_env or {})
             env.update(self._visibility_env(w, tpu_per_worker))
+            # Each rank gets its split index of every shard coordinator
+            # (rank == split keeps shard assignment stable across ranks).
+            shards = {
+                name: (actor, w.world_rank)
+                for name, actor in (dataset_shards or {}).items()
+            }
+            data_context = None
+            if shards:
+                from ray_tpu.data.context import DataContext
+
+                # Ship the driver's ingest knobs — DataContext is
+                # process-local and would otherwise silently reset to
+                # defaults inside the train workers.
+                data_context = DataContext.get_current().to_dict()
             refs.append(
                 w.actor.setup_session.remote(
                     ctx, group_name, latest_checkpoint, env,
                     jax_distributed=self.scaling.use_jax_distributed,
+                    dataset_shards=shards or None,
+                    data_context=data_context,
                 )
             )
         ray_tpu.get(refs)

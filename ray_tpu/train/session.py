@@ -149,6 +149,9 @@ class _TrainSession:
         self.result_queue: queue.Queue = queue.Queue(maxsize=1)
         self.ckpt_seq = 0
         self.latest_checkpoint = latest_checkpoint
+        # name -> (ShardCoordinator actor handle, split index) for the
+        # trainer's ``datasets`` (see get_dataset_shard).
+        self.dataset_shards: Dict[str, tuple] = {}
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
         # Step-timing marks: wall time between report() calls is the
@@ -270,3 +273,23 @@ def get_context() -> TrainContext:
 
 def get_checkpoint() -> Optional[Checkpoint]:
     return _get_session().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    """This rank's shard of a trainer ``datasets`` entry, as a pipelined
+    :class:`ray_tpu.data.DataIterator` (reference:
+    ``ray.train.get_dataset_shard``). Block prefetch, zero-copy decode,
+    background rebatch and device prefetch are on by default — see
+    ``ray_tpu.data.context.DataContext`` for the knobs. The stream is one
+    pass over the dataset per ``fit()``."""
+    sess = _get_session()
+    spec = sess.dataset_shards.get(name)
+    if spec is None:
+        raise KeyError(
+            f"no dataset shard {name!r} — pass datasets={{{name!r}: ds}} "
+            "to the Trainer"
+        )
+    from ray_tpu.data.shard import shard_iterator
+
+    actor, split = spec
+    return shard_iterator(actor, split)

@@ -61,12 +61,39 @@ class DataParallelTrainer:
         scaling_config: Optional[ScalingConfig] = None,
         run_config: Optional[RunConfig] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
     ):
         self._train_fn = train_loop_per_worker
         self._config = train_loop_config
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self._resume_from = resume_from_checkpoint
+        # name -> ray_tpu.data.Dataset; each fit() attempt splits every
+        # dataset num_workers ways behind a ShardCoordinator actor and the
+        # loop pulls its split via train.get_dataset_shard(name) (the
+        # pipelined ingest path — reference: DataParallelTrainer datasets).
+        self._datasets: Dict[str, Any] = dict(datasets or {})
+
+    def _make_shard_actors(self) -> Dict[str, Any]:
+        if not self._datasets:
+            return {}
+        from ray_tpu.data.shard import create_shard_coordinator
+
+        n = self.scaling_config.num_workers
+        return {
+            name: create_shard_coordinator(ds, n)
+            for name, ds in self._datasets.items()
+        }
+
+    def _stop_shard_actors(self):
+        import ray_tpu
+
+        for name, actor in getattr(self, "_shard_actors", {}).items():
+            try:
+                ray_tpu.kill(actor)
+            except Exception as e:  # noqa: BLE001 — best-effort teardown
+                logger.debug("shard coordinator %s kill failed: %s", name, e)
+        self._shard_actors = {}
 
     def fit(self) -> Result:
         storage = self.run_config.resolve_storage()
@@ -96,7 +123,12 @@ class DataParallelTrainer:
             executor.start()
             while True:
                 latest = manager.latest.checkpoint.path if manager.latest else None
-                executor.setup_sessions(latest)
+                # Fresh shard coordinators per attempt: a gang restart
+                # replays the datasets from the beginning (streams are
+                # single-pass; recovery restarts the epoch).
+                self._stop_shard_actors()
+                self._shard_actors = self._make_shard_actors()
+                executor.setup_sessions(latest, dataset_shards=self._shard_actors)
                 run_refs = executor.start_training(self._train_fn, self._config)
                 from ray_tpu.train.session import train_metrics
 
@@ -138,6 +170,7 @@ class DataParallelTrainer:
                     break
         finally:
             executor.shutdown()
+            self._stop_shard_actors()
 
         best = manager.best
         return Result(

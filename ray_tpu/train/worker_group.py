@@ -46,12 +46,19 @@ class TrainWorker:
         latest_checkpoint: Optional[str],
         env_vars: Optional[Dict[str, str]] = None,
         jax_distributed: bool = False,
+        dataset_shards: Optional[Dict[str, Any]] = None,
+        data_context: Optional[Dict[str, Any]] = None,
     ):
         from ray_tpu import collective
 
         for k, v in (env_vars or {}).items():
             os.environ[k] = v
+        if data_context:
+            from ray_tpu.data.context import DataContext
+
+            DataContext.apply_overrides(data_context)
         self._session = _TrainSession(ctx, group_name, latest_checkpoint)
+        self._session.dataset_shards = dict(dataset_shards or {})
         _set_session(self._session)
         if jax_distributed:
             # One JAX runtime across the gang: rendezvous via controller

@@ -63,6 +63,7 @@ ROWS = (
                    "serve_batch_")),
     ("Serve Engine", ("serve_engine_",)),
     ("Train", ("train_",)),
+    ("Data", ("data_",)),
     ("Cluster Resources", ("tpu_hbm_", "node_", "object_store_",
                            "metrics_series_")),
     ("Compilation", ("jax_",)),

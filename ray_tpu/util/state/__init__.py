@@ -226,6 +226,21 @@ def summarize_data() -> list:
     return last_execution_stats()
 
 
+def summarize_ingest() -> dict:
+    """This process's consumption-side data-pipeline counters (zero-copy
+    hits/misses, blocks fetched) plus total executor backpressure stalls
+    from the last execution — the local companion to the cluster-wide
+    ``data_*`` Prometheus series."""
+    from ray_tpu.data.executor import last_execution_stats
+    from ray_tpu.data.metrics import data_metrics
+
+    out = dict(data_metrics().counts)
+    out["backpressure_stalls_last_execution"] = sum(
+        r.get("backpressure_stalls", 0) for r in last_execution_stats()
+    )
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Logs (reference: api.py get_log :1262 / list_logs)
 # ---------------------------------------------------------------------------
