@@ -9,8 +9,16 @@ measured envelope number or the documented breaking point, plus the
 controller-loop p50 latency while holding the load (the health metric
 for the single-asyncio-loop design).
 
+Each row also carries the control-plane flight recorder's per-phase
+breakdown (``phases``: p50/p95/p99 dwell per lifecycle state, e.g.
+``task.SUBMITTED`` = submit→push latency, ``lease.REQUESTED`` = lease
+scheduling latency, ``task.RUNNING`` = execution; plus ``pending_reasons``
+— why-pending attribution deltas for the row) so a stalled depth says
+WHICH stage to attack. ``--no-recorder`` disables the recorder for A/B
+overhead runs.
+
 Usage: python benchmarks/envelope.py [--queued 100000] [--pgs 1000]
-           [--actor-records 10000] [--live-actors 60]
+           [--actor-records 10000] [--live-actors 60] [--no-recorder]
            [--out benchmarks/ENVELOPE_r03.json]
 """
 from __future__ import annotations
@@ -20,6 +28,38 @@ import json
 import statistics
 import threading
 import time
+
+_prev_reasons: dict = {}
+
+
+def lifecycle_phases() -> dict:
+    """Per-phase dwell breakdown from the flight recorder: p50/p95/p99 ms
+    per (kind, state) over the recorder's bounded sample rings (recent-
+    dominated), plus the why-pending attribution DELTA since the previous
+    row. Empty when the recorder is disabled (--no-recorder)."""
+    global _prev_reasons
+    from ray_tpu.util import state as state_api
+
+    snap = state_api.summarize_lifecycle()
+    if not snap.get("enabled"):
+        return {}
+    phases = {}
+    for kind, states in snap.get("states", {}).items():
+        for st, info in states.items():
+            row = {"count": info.get("count", 0)}
+            d = info.get("dwell_ms") or {}
+            for k in ("p50", "p95", "p99"):
+                if k in d:
+                    row[k] = d[k]
+            phases[f"{kind}.{st}"] = row
+    reasons = snap.get("pending_reasons", {})
+    delta = {
+        k: v - _prev_reasons.get(k, 0)
+        for k, v in reasons.items()
+        if v - _prev_reasons.get(k, 0) > 0
+    }
+    _prev_reasons = dict(reasons)
+    return {"phases": phases, "pending_reasons": delta}
 
 
 class LoopProbe:
@@ -226,12 +266,21 @@ def main():
     p.add_argument("--pgs", type=int, default=1000)
     p.add_argument("--actor-records", type=int, default=10000)
     p.add_argument("--live-actors", type=int, default=60)
+    p.add_argument(
+        "--no-recorder", action="store_true",
+        help="disable the control-plane flight recorder (A/B overhead runs)",
+    )
     p.add_argument("--out", default="")
     args = p.parse_args()
 
     # Logical CPUs sized so the lease ramp can hold --live-actors
     # concurrent warm-up naps (worker pool caps scale with CPU count).
-    ray_tpu.init(num_cpus=max(8, args.live_actors + 4))
+    ray_tpu.init(
+        num_cpus=max(8, args.live_actors + 4),
+        _system_config=(
+            {"lifecycle_events": False} if args.no_recorder else None
+        ),
+    )
     rows = []
     try:
         for fn, fnargs in (
@@ -241,6 +290,7 @@ def main():
             (bench_queued_tasks, (args.queued,)),
         ):
             row = fn(*fnargs)
+            row.update(lifecycle_phases())
             rows.append(row)
             print(json.dumps(row), flush=True)
     finally:

@@ -148,6 +148,16 @@ class Config:
     # dropped (counted in metrics_series_dropped_total) so per-request or
     # per-task tags can't blow up the registry/controller/Prometheus.
     metrics_max_series_per_metric: int = 200
+    # Control-plane flight recorder (core/lifecycle.py): task/actor/PG/
+    # lease/worker state-transition events with per-state dwell times and
+    # why-pending attribution, aggregated controller-side and exposed via
+    # state.summarize_lifecycle() / `ray-tpu timeline`. Off = near-zero
+    # overhead (the envelope A/B knob).
+    lifecycle_events: bool = True
+    # Controller-side event ring bound (newest N transitions kept).
+    lifecycle_ring_size: int = 20000
+    # Per-(kind, state) dwell sample ring bound (percentile source).
+    lifecycle_dwell_samples: int = 4096
 
     # --- fault injection (tests only; reference:
     # python/ray/tests/chaos/chaos_network_delay.yaml injects network

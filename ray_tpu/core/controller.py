@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.config import Config, set_config
+from ray_tpu.core.lifecycle import LifecycleRecorder
 from ray_tpu.core.object_store import PlasmaStore
 from ray_tpu.core.placement_group import PlacementGroupManager
 from ray_tpu.core.resources import NodeResources, ResourceSet
@@ -162,7 +163,8 @@ class LeaseRecord:
 
 
 class _LeaseReq:
-    __slots__ = ("demand", "translated", "strategy", "ehash", "dep_keys", "peer", "fut")
+    __slots__ = ("demand", "translated", "strategy", "ehash", "dep_keys", "peer",
+                 "fut", "req_id", "block_reason")
 
     def __init__(self, demand, translated, strategy, ehash, dep_keys, peer, fut):
         self.demand = demand
@@ -172,6 +174,8 @@ class _LeaseReq:
         self.dep_keys = dep_keys
         self.peer = peer
         self.fut = fut
+        self.req_id = ""  # flight-recorder lease chain id
+        self.block_reason = None  # why the last grant attempt parked
 
 
 @dataclass
@@ -183,6 +187,9 @@ class TaskRecord:
     retries_left: int = 0
     acquired: Optional[ResourceSet] = None
     submitted_at: float = field(default_factory=time.time)
+    # Latest why-pending attribution while blocked (flight recorder
+    # vocabulary, core/lifecycle.py PENDING_REASONS).
+    pending_reason: str = ""
     # Streaming-generator progress (reference: ObjectRefStream,
     # src/ray/core_worker/task_manager.cc streaming-generator returns).
     stream_count: int = 0
@@ -221,7 +228,15 @@ class Controller:
         self.owned = owned
         self.cluster = ClusterState()
         self.scheduler = ClusterResourceScheduler(self.cluster)
-        self.pg_manager = PlacementGroupManager(self.cluster)
+        # Control-plane flight recorder: every task/actor/PG/lease/worker
+        # state transition, with per-state dwell times and why-pending
+        # attribution (reference: gcs_task_manager's task-events backend).
+        self.lifecycle = LifecycleRecorder(
+            ring_size=config.lifecycle_ring_size,
+            dwell_samples=config.lifecycle_dwell_samples,
+            enabled=config.lifecycle_events,
+        )
+        self.pg_manager = PlacementGroupManager(self.cluster, recorder=self.lifecycle)
         self.objects: Dict[ObjectID, ObjectRecord] = {}
         self.workers: Dict[WorkerID, WorkerRecord] = {}
         self.nodes: Dict[NodeID, NodeRecord] = {}
@@ -267,6 +282,7 @@ class Controller:
         self.leases: Dict[bytes, LeaseRecord] = {}
         self._lease_reqs: "_c.deque[_LeaseReq]" = _c.deque()
         self._lease_seq = _it.count(1)
+        self._lreq_seq = _it.count(1)  # lease-request ids (flight recorder)
         self._head_direct_free: List[WorkerID] = []
         self._head_direct_waiters: "_c.deque[Tuple[str, asyncio.Future]]" = _c.deque()
         # In-flight spawns per PRESET env hash (container workers): a
@@ -390,6 +406,13 @@ class Controller:
         listen_addr: str = "", pool: str = "", env_hash: str = "",
     ):
         peer.meta.update(kind="worker", worker_id=worker_id)
+        # Pair the agent/head SPAWNED event with REGISTERED — the dwell is
+        # the worker-startup latency. Drain locally-spawned head events
+        # first so the pair can't arrive out of order.
+        self._drain_spawn_events()
+        self.lifecycle.record(
+            "worker", worker_id.hex(), "REGISTERED", node=node_id.hex()[:12]
+        )
         rec = WorkerRecord(
             worker_id=worker_id, node_id=node_id, peer=peer, pid=pid,
             listen_addr=listen_addr,
@@ -521,20 +544,29 @@ class Controller:
             demand, translated, strategy, ehash, dep_keys, peer,
             asyncio.get_running_loop().create_future(),
         )
+        req.req_id = "R%d" % next(self._lreq_seq)
+        self.lifecycle.record("lease", req.req_id, "REQUESTED")
         grant = self._try_grant_lease(req)
         if grant is not None:
+            self.lifecycle.record(
+                "lease", req.req_id, "GRANTED", node=grant["node_id"][:12]
+            )
             return grant
+        self.lifecycle.pending_reason("lease", req.req_id, req.block_reason)
         self._lease_reqs.append(req)
         return await req.fut
 
     def _try_grant_lease(self, req: _LeaseReq) -> Optional[dict]:
         nid = self._locality_choice(req)
         if nid is None:
-            nid = self.scheduler.schedule(req.demand, req.strategy).node_id
-        if nid is None:
-            return None
+            result = self.scheduler.schedule(req.demand, req.strategy)
+            nid = result.node_id
+            if nid is None:
+                req.block_reason = self._pending_reason(req.strategy, result)
+                return None
         node_res = self.cluster.nodes.get(nid)
         if node_res is None or not node_res.acquire(req.translated):
+            req.block_reason = "insufficient_resources"
             return None
         lease_id = b"L%d" % next(self._lease_seq)
         self.leases[lease_id] = LeaseRecord(
@@ -544,6 +576,26 @@ class Controller:
         node = self.nodes[nid]
         agent_addr = "controller" if node.peer is None else node.fetch_addr
         return {"lease_id": lease_id, "node_id": nid.hex(), "agent_addr": agent_addr}
+
+    def _pending_reason(self, strategy: SchedulingStrategy, result) -> str:
+        """Refine the scheduler's attribution with control-plane context
+        the policy layer can't see: a PLACEMENT_GROUP miss whose group
+        hasn't committed yet is gated on the PG, not on capacity."""
+        reason = result.reason or (
+            "infeasible" if result.infeasible else "insufficient_resources"
+        )
+        if (
+            strategy.kind == "PLACEMENT_GROUP"
+            and reason != "infeasible"
+            and not self.pg_manager.is_ready(strategy.placement_group_id)
+        ):
+            return "pg_unready"
+        return reason
+
+    def _attribute_block(self, rec: TaskRecord, spec: TaskSpec, result):
+        reason = self._pending_reason(spec.scheduling_strategy, result)
+        rec.pending_reason = reason
+        self.lifecycle.pending_reason(*self._lc_key(spec), reason)
 
     def _locality_choice(self, req: _LeaseReq) -> Optional[NodeID]:
         """Prefer the feasible node holding the most dependency bytes
@@ -576,11 +628,16 @@ class Controller:
         while self._lease_reqs:
             req = self._lease_reqs.popleft()
             if req.fut.done() or req.peer.closed:
+                self.lifecycle.record("lease", req.req_id, "ABANDONED")
                 continue  # caller gave up / died
             grant = self._try_grant_lease(req)
             if grant is None:
+                self.lifecycle.pending_reason("lease", req.req_id, req.block_reason)
                 still.append(req)
             else:
+                self.lifecycle.record(
+                    "lease", req.req_id, "GRANTED", node=grant["node_id"][:12]
+                )
                 req.fut.set_result(grant)
         self._lease_reqs.extend(still)
 
@@ -801,6 +858,9 @@ class Controller:
             for oid in spec.return_ids():
                 self._object(oid).creating_task = spec.task_id
             if spec.task_type == TaskType.ACTOR_TASK:
+                self.lifecycle.record(
+                    "task", spec.task_id.hex(), "SUBMITTED", name=spec.name
+                )
                 await self._submit_actor_task(spec)
             else:
                 self.pending_tasks.append(spec.task_id)
@@ -857,6 +917,9 @@ class Controller:
             return
         if actor.state != "ALIVE":
             actor.pending_tasks.append(spec)
+            self.lifecycle.pending_reason(
+                "task", spec.task_id.hex(), "waiting_actor"
+            )
             return
         await self._dispatch_actor_task(actor, spec)
 
@@ -927,6 +990,8 @@ class Controller:
             if q is None:
                 q = self._class_queues[key] = collections.deque()
             q.append(tid)
+            lk, leid = self._lc_key(spec)
+            self.lifecycle.record(lk, leid, "QUEUED")
             for dep in spec.dependencies:
                 self._dep_index.setdefault(dep, set()).add(tid)
         # Keyed by (node, container_image, preset_env_hash): container
@@ -981,6 +1046,8 @@ class Controller:
                     # not block class-mates whose deps are ready); any dep
                     # state change re-enqueues through the intake list
                     self._park_on_dep(dep, tid)
+                    rec.pending_reason = "waiting_deps"
+                    self.lifecycle.pending_reason(*self._lc_key(spec), "waiting_deps")
                     advance = False
                     break
             if not advance or rec.state != "PENDING":
@@ -990,6 +1057,7 @@ class Controller:
             demand = self.scheduler.translated_pg_demand(spec.resources, spec.scheduling_strategy)
             result = self.scheduler.schedule(spec.resources, spec.scheduling_strategy)
             if result.node_id is None:
+                self._attribute_block(rec, spec, result)
                 return  # class blocked: infeasible for now
             # 3. idle worker (env-affine)?
             worker = self._idle_worker_on(result.node_id, ehash)
@@ -1024,6 +1092,9 @@ class Controller:
                     )
                     worker = self._idle_worker_on(result.node_id, ehash)
                 if worker is None:
+                    reason = "spillback" if excluded else "no_idle_worker"
+                    rec.pending_reason = reason
+                    self.lifecycle.pending_reason(*self._lc_key(spec), reason)
                     if result.node_id is not None:
                         # Worker ramp-up for the queued depth, capped by
                         # the node's SCHEDULABLE concurrency for this
@@ -1056,7 +1127,12 @@ class Controller:
             if not node_res.acquire(demand):
                 if claimed_direct:
                     await self._unclaim_direct(worker)
+                rec.pending_reason = "insufficient_resources"
+                self.lifecycle.pending_reason(
+                    *self._lc_key(spec), "insufficient_resources"
+                )
                 return  # class blocked on resources
+            rec.pending_reason = ""
             rec.acquired = demand
             rec.node_id = result.node_id
             rec.worker_id = worker.worker_id
@@ -1246,6 +1322,9 @@ class Controller:
         if actor is None:
             return
         actor.state = "ALIVE"
+        self.lifecycle.record(
+            "actor", spec.actor_id.hex(), "ALIVE", name=spec.name
+        )
         for fut in actor.ready_waiters:
             if not fut.done():
                 fut.set_result(True)
@@ -1300,6 +1379,10 @@ class Controller:
         self._dead_worker_info[worker_id.hex()] = (
             "oom" if worker.oom_marked else reason
         )
+        self.lifecycle.record(
+            "worker", worker_id.hex(), "DEAD",
+            reason="oom" if worker.oom_marked else reason,
+        )
         while len(self._dead_worker_info) > 1000:
             self._dead_worker_info.popitem(last=False)
         # Fail or retry running tasks FIRST: _on_actor_death below requeues
@@ -1319,6 +1402,9 @@ class Controller:
                 if will_restart:
                     continue  # restart path requeues this same spec
                 rec.state = "FAILED"
+                self.lifecycle.record(
+                    "actor", spec.actor_id.hex(), "FAILED", name=spec.name
+                )
                 self._fail_task_objects(
                     spec, ActorDiedError(spec.actor_id.hex(), f"died in __init__ ({reason})")
                 )
@@ -1331,8 +1417,12 @@ class Controller:
                     rec.retries_left -= 1
                     rec.state = "PENDING"
                     actor.pending_tasks.append(spec)
+                    self._event("task", spec, "RETRYING")
                 else:
                     rec.state = "FAILED"
+                    self.lifecycle.record(
+                        "task", spec.task_id.hex(), "FAILED", name=spec.name
+                    )
                     self._fail_task_objects(
                         spec,
                         ActorDiedError(spec.actor_id.hex(), f"actor worker died ({reason})"),
@@ -1342,6 +1432,7 @@ class Controller:
                     rec.retries_left -= 1
                     rec.state = "PENDING"
                     self.pending_tasks.append(tid)
+                    self._event("task", spec, "RETRYING")
                 else:
                     rec.state = "FAILED"
                     if worker.oom_marked:
@@ -1353,6 +1444,9 @@ class Controller:
                         err = WorkerCrashedError(
                             f"worker {worker_id.hex()[:8]} died while running task ({reason})"
                         )
+                    self.lifecycle.record(
+                        "task", spec.task_id.hex(), "FAILED", name=spec.name
+                    )
                     self._fail_task_objects(spec, err)
         if worker.actor_id is not None:
             await self._on_actor_death(worker.actor_id, f"worker died: {reason}")
@@ -2046,10 +2140,36 @@ class Controller:
         await asyncio.gather(*calls)
         return out
 
+    def _drain_spawn_events(self):
+        """Fold worker SPAWNED events recorded by in-process spawns (the
+        controller doubles as the head's agent) into the flight recorder.
+        Agent-side spawns arrive through rpc_task_events instead."""
+        from ray_tpu.core import node_agent as _na
+
+        while True:
+            try:
+                ev = _na._lifecycle_events.popleft()
+            except IndexError:
+                return
+            self.lifecycle.ingest(ev)
+
     async def rpc_task_events(self, peer: rpc.Peer, batch: List[dict]):
         """Batched task events from workers executing direct-push tasks
-        (reference: TaskEventBuffer flushes to the GCS task manager)."""
-        self.events.extend(batch)
+        (reference: TaskEventBuffer flushes to the GCS task manager) —
+        plus driver-side SUBMITTED/WORKER_ASSIGNED and agent-side worker
+        SPAWNED events, all folded into the flight recorder."""
+        for ev in batch:
+            self.lifecycle.ingest(ev)
+        # The legacy ring keeps its pre-recorder semantics — worker
+        # EXECUTION events only. Driver SUBMITTED/WORKER_ASSIGNED and
+        # agent SPAWNED halves live in the flight recorder; letting them
+        # into this buffer would halve the timeline's RUNNING→FINISHED
+        # pairing window at the same task_event_buffer_size.
+        self.events.extend(
+            e for e in batch
+            if e.get("kind") == "task"
+            and e.get("state") in ("RUNNING", "FINISHED", "FAILED")
+        )
         if len(self.events) > self.config.task_event_buffer_size:
             del self.events[: len(self.events) // 2]
         # Keep the state API's task view covering direct-push tasks the
@@ -2061,10 +2181,25 @@ class Controller:
         for ev in batch:
             if ev.get("kind") != "task" or "task_id" not in ev:
                 continue
+            state = ev.get("state", "")
+            if state not in ("RUNNING", "FINISHED", "FAILED"):
+                # The task-row view stays EXECUTION-derived (worker
+                # events only), as before the flight recorder: driver-
+                # side SUBMITTED/WORKER_ASSIGNED halves ride a separate
+                # flush channel and would race terminal rows backwards;
+                # pre-execution states live in the lifecycle ring.
+                continue
+            cur = self._direct_task_rows.get(ev["task_id"])
+            if (
+                cur is not None
+                and cur["state"] in ("FINISHED", "FAILED")
+                and state == "RUNNING"
+            ):
+                continue  # late RUNNING flush must not regress a terminal row
             self._direct_task_rows[ev["task_id"]] = {
                 "task_id": ev["task_id"],
                 "name": ev.get("name", ""),
-                "state": ev.get("state", ""),
+                "state": state,
                 "type": ev.get("type", "NORMAL_TASK"),
                 "node_id": node_hex,
             }
@@ -2097,6 +2232,9 @@ class Controller:
             rec.state = "FAILED"
             rec.retries_left = 0
             self.pending_tasks = [t for t in self.pending_tasks if t != task_id]
+            self.lifecycle.record(
+                *self._lc_key(rec.spec), "FAILED", reason="cancelled"
+            )
             self._fail_task_objects(rec.spec, TaskCancelledError(task_id.hex()))
             self._unindex_deps(rec.spec)
             return True
@@ -2226,9 +2364,13 @@ class Controller:
         ]
 
     async def rpc_list_tasks(self, peer, limit: int = 1000):
+        import collections as _c
+
         out = []
         seen = set()
-        for tid, rec in list(self.tasks.items())[-limit:]:
+        # deque(maxlen) keeps peak memory O(limit) even at 1M+ task
+        # records — the status RPC must not materialize the full table.
+        for tid, rec in _c.deque(self.tasks.items(), maxlen=limit):
             seen.add(tid.hex())
             out.append(
                 {
@@ -2240,10 +2382,64 @@ class Controller:
                 }
             )
         # direct-push tasks (event-derived rows; no TaskRecord exists)
-        for tid_hex, row in list(self._direct_task_rows.items())[-limit:]:
+        for tid_hex, row in _c.deque(self._direct_task_rows.items(), maxlen=limit):
             if tid_hex not in seen:
                 out.append(row)
         return out[-limit:]
+
+    async def rpc_summarize_tasks(self, peer, limit: int = 1000):
+        """O(limit)-payload task rollup (reference: the state API's
+        summarize_tasks backed by GcsTaskManager counters): counts by
+        (name, state) capped to the ``limit`` busiest names, plus
+        UNCAPPED totals by state — at 40k+ tasks the status RPC must not
+        serialize the table."""
+        by_name_state: Dict[Tuple[str, str], int] = {}
+        by_state: Dict[str, int] = {}
+        by_reason: Dict[str, int] = {}
+        total = 0
+        for rec in self.tasks.values():
+            key = (rec.spec.name, rec.state)
+            by_name_state[key] = by_name_state.get(key, 0) + 1
+            by_state[rec.state] = by_state.get(rec.state, 0) + 1
+            if rec.state == "PENDING" and rec.pending_reason:
+                by_reason[rec.pending_reason] = (
+                    by_reason.get(rec.pending_reason, 0) + 1
+                )
+            total += 1
+        for row in self._direct_task_rows.values():
+            key = (row.get("name", ""), row.get("state", ""))
+            by_name_state[key] = by_name_state.get(key, 0) + 1
+            by_state[key[1]] = by_state.get(key[1], 0) + 1
+            total += 1
+        # Cap to the busiest `limit` names (name count is user-bounded in
+        # practice, but one misbehaving generator of unique names must
+        # not blow up the reply).
+        per_name: Dict[str, int] = {}
+        for (name, _state), n in by_name_state.items():
+            per_name[name] = per_name.get(name, 0) + n
+        keep = set(sorted(per_name, key=per_name.get, reverse=True)[: max(0, limit)])
+        names: Dict[str, Dict[str, int]] = {}
+        for (name, state), n in sorted(by_name_state.items()):
+            if name in keep:
+                names.setdefault(name, {})[state] = n
+        return {
+            "tasks": names,
+            "counts_by_state": by_state,
+            "pending_reasons": by_reason,
+            "total": total,
+            "truncated": len(per_name) > len(keep),
+        }
+
+    async def rpc_summarize_lifecycle(self, peer):
+        """Flight-recorder rollup: per-(kind, state) transition counts +
+        dwell p50/p95/p99, why-pending attribution counters, and live
+        pending attribution (see core/lifecycle.py)."""
+        self._drain_spawn_events()
+        return self.lifecycle.snapshot()
+
+    async def rpc_list_lifecycle_events(self, peer, limit: int = 10000):
+        self._drain_spawn_events()
+        return self.lifecycle.tail(limit)
 
     async def rpc_list_actors(self, peer):
         return [
@@ -2259,8 +2455,10 @@ class Controller:
         ]
 
     async def rpc_list_objects(self, peer, limit: int = 1000):
+        import collections as _c
+
         out = []
-        for oid, rec in list(self.objects.items())[-limit:]:
+        for oid, rec in _c.deque(self.objects.items(), maxlen=limit):
             out.append(
                 {
                     "object_id": oid.hex(),
@@ -2525,6 +2723,10 @@ class Controller:
         cpu.sample()  # prime the delta
         while not self._shutdown.is_set():
             await asyncio.sleep(interval)
+            self._drain_spawn_events()
+            # Recorder metrics are throttle-flushed from record(); a
+            # quiet cluster still syncs its last batch here.
+            self.lifecycle.flush_metrics()
             node = self.nodes.get(self.head_node_id)
             if node is None:
                 continue
@@ -2672,6 +2874,14 @@ class Controller:
         return True
 
     # =================================================================
+    def _lc_key(self, spec: TaskSpec) -> Tuple[str, str]:
+        """Flight-recorder entity for a spec: actor-creation tasks chart
+        the ACTOR's chain (SUBMITTED → ... → ALIVE), everything else the
+        task's."""
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK and spec.actor_id:
+            return "actor", spec.actor_id.hex()
+        return "task", spec.task_id.hex()
+
     def _event(self, kind: str, spec: TaskSpec, state: str):
         self.events.append(
             {
@@ -2684,6 +2894,18 @@ class Controller:
         )
         if len(self.events) > self.config.task_event_buffer_size:
             del self.events[: len(self.events) // 2]
+        if kind == "task" and spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            # A creation task's chain is charted under the ACTOR entity
+            # (_lc_key: SUBMITTED/QUEUED/CREATING → ALIVE closes nothing);
+            # a lone task.FINISHED here would inflate task counts with no
+            # matching task.SUBMITTED. Legacy self.events keeps the row.
+            return
+        eid = (
+            spec.actor_id.hex()
+            if kind == "actor" and spec.actor_id
+            else spec.task_id.hex()
+        )
+        self.lifecycle.record(kind, eid, state, name=spec.name)
 
     # =================================================================
     def _oom_candidates(self, head_only: bool, node_id: Optional[NodeID] = None):

@@ -9,7 +9,9 @@ Routes:
   GET /metrics              Prometheus text exposition of app metrics
   GET /api/v0/<what>        state JSON: nodes|workers|tasks|actors|objects|
                             events|placement_groups|cluster_resources|
-                            available_resources
+                            available_resources|summarize_resources|
+                            summarize_lifecycle|summarize_tasks|
+                            lifecycle_events|compile
   GET /api/serve/engine     serve LLM-engine flight-recorder snapshots
   GET /healthz              liveness probe
   Job submission REST (reference: dashboard/modules/job/job_head.py):
@@ -38,6 +40,9 @@ _STATE_ROUTES = {
     "cluster_resources": "rpc_cluster_resources",
     "available_resources": "rpc_available_resources",
     "summarize_resources": "rpc_summarize_resources",
+    "summarize_lifecycle": "rpc_summarize_lifecycle",
+    "summarize_tasks": "rpc_summarize_tasks",
+    "lifecycle_events": "rpc_list_lifecycle_events",
     "compile": "rpc_compile_state",
 }
 

@@ -10,12 +10,14 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import collections
 import json
 import logging
 import os
 import signal
 import subprocess
 import sys
+import time
 from typing import Dict
 
 from ray_tpu.core.object_store import PlasmaStore
@@ -25,6 +27,14 @@ from ray_tpu.utils.ids import NodeID, ObjectID, WorkerID
 logger = logging.getLogger("ray_tpu.node_agent")
 
 _children: Dict[int, subprocess.Popen] = {}
+
+# Worker-lifecycle events recorded at spawn time (flight recorder,
+# core/lifecycle.py): SPAWNED here pairs with REGISTERED at the
+# controller, making the dwell the worker-startup latency. Agents ship
+# the deque over their telemetry channel; the controller (spawning head
+# workers through this same function) drains it in-process. Bounded —
+# an undrained deque (telemetry disabled) must not grow forever.
+_lifecycle_events: "collections.deque" = collections.deque(maxlen=10000)
 
 
 def child_env(needs_tpu: bool) -> dict:
@@ -62,6 +72,15 @@ def spawn_worker(session_dir: str, controller_addr: str, node_id: NodeID, shm_di
     node's container runtime (reference: runtime_env/image_uri.py; here
     ray_tpu/runtime_env/container.py builds the podman/docker argv)."""
     worker_id = WorkerID.from_random()
+    _lifecycle_events.append(
+        {
+            "ts": time.time(),
+            "kind": "worker",
+            "id": worker_id.hex(),
+            "state": "SPAWNED",
+            "node": node_id.hex()[:12],
+        }
+    )
     # Workers may run TPU compute tasks — keep the TPU hook unless the
     # session is pinned to CPU (tests).
     env = child_env(needs_tpu=os.environ.get("JAX_PLATFORMS", "") != "cpu")
@@ -169,6 +188,29 @@ class NodeAgent:
             spawn_worker(self.session_dir, self.controller_addr, self.node_id,
                          self.store.shm_dir, extra_env=extra,
                          container_image=container_image)
+        # Ship SPAWNED promptly: the worker's REGISTERED hits the
+        # controller directly, and the spawn half must arrive first for
+        # the startup dwell to pair (the telemetry loop is the backstop).
+        asyncio.ensure_future(self._flush_lifecycle_events())
+
+    async def _flush_lifecycle_events(self):
+        peer = self._controller_peer
+        if peer is None or peer.closed:
+            return  # not connected yet: leave events queued for the backstop
+        batch = []
+        while _lifecycle_events:
+            batch.append(_lifecycle_events.popleft())
+        if not batch:
+            return
+        try:
+            await peer.notify("task_events", batch)
+        except Exception as e:  # noqa: BLE001 — transient controller hiccup
+            # Re-queue for the telemetry backstop if there's room (the
+            # deque is bounded; a full queue drops this batch rather than
+            # displacing newer spawn events).
+            if (_lifecycle_events.maxlen or 0) - len(_lifecycle_events) >= len(batch):
+                _lifecycle_events.extendleft(reversed(batch))
+            logger.debug("lifecycle event ship failed: %s", e)
 
     def rpc_delete_object(self, peer, oid: ObjectID):
         self._chunk_reader.invalidate(oid)
@@ -417,6 +459,7 @@ class NodeAgent:
             },
         )
         self._direct_spawns.append(proc)
+        asyncio.ensure_future(self._flush_lifecycle_events())
 
     def _reap_direct_spawns(self):
         """A direct worker that died BEFORE attaching (import error, OOM)
@@ -599,6 +642,7 @@ class NodeAgent:
         cpu.sample()  # prime the delta
         while not self._exit.is_set():
             await asyncio.sleep(interval_ms / 1000.0)
+            await self._flush_lifecycle_events()
             sample = node_telemetry.build_node_sample(cpu, self.store)
             sample["num_direct_workers"] = len(self._direct)
             sample["num_children"] = len(_children)

@@ -36,6 +36,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
 from collections import deque
 from typing import Dict, Optional, Tuple
 
@@ -109,6 +110,55 @@ class NormalSubmitter:
         self._handoff = rpc.BatchedHandoff(
             core.loop_runner.loop, lambda item: self._enqueue(*item)
         )
+        # Flight-recorder feed: direct-push tasks bypass the controller,
+        # so the CALLER emits the SUBMITTED/WORKER_ASSIGNED half of each
+        # task's lifecycle chain (the executing worker emits RUNNING/
+        # FINISHED), batched over the same task_events channel
+        # (reference: TaskEventBuffer → gcs_task_manager).
+        self._lc_enabled = bool(cfg.get("lifecycle_events", True))
+        # Bounded: a wedged flush must degrade to dropping the OLDEST
+        # events, never grow the driver's memory.
+        self._lc_events: deque = deque(maxlen=20000)
+        if self._lc_enabled:
+            core.loop_runner.submit(self._lc_flush_loop())
+
+    def _lc_record(self, spec: TaskSpec, state: str, **attrs):
+        if not self._lc_enabled:
+            return
+        ev = {
+            "ts": time.time(),
+            "kind": "task",
+            "task_id": spec.task_id.hex(),
+            "name": spec.name,
+            "state": state,
+        }
+        for k, v in attrs.items():
+            if v:
+                ev[k] = v
+        self._lc_events.append(ev)
+
+    async def _lc_flush_loop(self):
+        interval = float(self.core.config.get("event_flush_period_s", 0.25))
+        while True:
+            await asyncio.sleep(interval)
+            if self.core.peer.closed:
+                return  # driver shutting down
+            if not self._lc_events:
+                continue
+            batch = []
+            while self._lc_events and len(batch) < 20000:
+                batch.append(self._lc_events.popleft())
+            try:
+                await self.core.peer.notify("task_events", batch)
+            except Exception as e:  # noqa: BLE001 — transient controller hiccup
+                if self.core.peer.closed:
+                    return
+                # Survive the hiccup: re-queue if there's room (the deque
+                # is bounded; when full, the failed batch is dropped
+                # rather than displacing newer events) and keep flushing.
+                if (self._lc_events.maxlen or 0) - len(self._lc_events) >= len(batch):
+                    self._lc_events.extendleft(reversed(batch))
+                logger.debug("lifecycle event flush failed: %s", e)
 
     # -- caller thread ---------------------------------------------------
     def submit(self, spec: TaskSpec, pins) -> None:
@@ -137,6 +187,7 @@ class NormalSubmitter:
 
     def _enqueue(self, spec: TaskSpec, call: _NCall) -> None:
         ks = self._key_state(spec)
+        self._lc_record(spec, "SUBMITTED")
         self.tasks[spec.task_id] = (ks, call)
         for oid in spec.return_ids():
             self.returns[oid] = spec.task_id
@@ -296,6 +347,9 @@ class NormalSubmitter:
                 inline = {}
             inline[key] = bytes(payload)
         lease.inflight.add(call)
+        self._lc_record(
+            call.spec, "WORKER_ASSIGNED", worker=lease.worker_id_hex[:12]
+        )
         fut = lease.worker_peer.call_nowait(
             "push_task", pack_normal_task(call.spec), inline
         )

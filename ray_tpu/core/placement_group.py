@@ -58,14 +58,22 @@ def _group_resources(pg_id: PlacementGroupID, index: int, bundle: ResourceSet) -
 
 
 class PlacementGroupManager:
-    def __init__(self, state: ClusterState):
+    def __init__(self, state: ClusterState, recorder=None):
         self.state = state
         self.groups: Dict[PlacementGroupID, PlacementGroupRecord] = {}
+        # Control-plane flight recorder (core/lifecycle.py); None when the
+        # manager is constructed standalone (tests).
+        self.recorder = recorder
+
+    def _record(self, rec: PlacementGroupRecord, state: str):
+        if self.recorder is not None:
+            self.recorder.record("pg", rec.pg_id.hex(), state, name=rec.name)
 
     # ------------------------------------------------------------------
     def create(self, pg_id: PlacementGroupID, bundles: List[ResourceSet], strategy: str, name: str = "") -> PlacementGroupRecord:
         rec = PlacementGroupRecord(pg_id=pg_id, bundles=bundles, strategy=strategy, name=name)
         self.groups[pg_id] = rec
+        self._record(rec, "PENDING")
         self.try_place(rec)
         return rec
 
@@ -77,6 +85,10 @@ class PlacementGroupManager:
             return True
         nodes = schedule_bundles(self.state, rec.bundles, rec.strategy)
         if nodes is None:
+            if self.recorder is not None:
+                self.recorder.pending_reason(
+                    "pg", rec.pg_id.hex(), "insufficient_resources"
+                )
             return False
         # Prepare: acquire base resources on each node.
         acquired: List[tuple] = []
@@ -91,12 +103,20 @@ class PlacementGroupManager:
             for nid, bundle, _ in acquired:
                 if nid in self.state.nodes:
                     self.state.nodes[nid].release(bundle)
+            if self.recorder is not None:
+                self.recorder.pending_reason(
+                    "pg", rec.pg_id.hex(), "insufficient_resources"
+                )
             return False
+        # 2-phase dwell: RESERVED marks prepare (base resources held),
+        # CREATED marks commit (group resources renamed in).
+        self._record(rec, "RESERVED")
         # Commit: add renamed group resources.
         for nid, bundle, idx in acquired:
             self.state.nodes[nid].add_total(_group_resources(rec.pg_id, idx, bundle))
         rec.bundle_nodes = list(nodes)
         rec.state = PGState.CREATED
+        self._record(rec, "CREATED")
         return True
 
     # ------------------------------------------------------------------
@@ -112,6 +132,7 @@ class PlacementGroupManager:
                 node.remove_total(_group_resources(rec.pg_id, idx, bundle))
                 node.release(bundle)
         rec.state = PGState.REMOVED
+        self._record(rec, "REMOVED")
         self._forget_group_ids(rec)
 
     def _forget_group_ids(self, rec):
@@ -142,6 +163,7 @@ class PlacementGroupManager:
                         node.release(bundle)
                 rec.state = PGState.RESCHEDULING
                 rec.bundle_nodes = []
+                self._record(rec, "RESCHEDULING")
                 self.try_place(rec)
 
     def retry_pending(self):

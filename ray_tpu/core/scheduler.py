@@ -31,6 +31,18 @@ logger = logging.getLogger(__name__)
 class ScheduleResult:
     node_id: Optional[NodeID]
     infeasible: bool = False  # no node could EVER run this → autoscaler hint
+    # Why-pending attribution for a None placement (bounded vocabulary,
+    # core/lifecycle.py PENDING_REASONS): "infeasible" vs
+    # "insufficient_resources"; the pump layers pool/PG context on top.
+    reason: Optional[str] = None
+
+
+def _none_reason(node_id, infeasible: bool) -> Optional[str]:
+    """Attribution for a native-core placement miss (the C++ core reports
+    only the infeasible bit)."""
+    if node_id is not None:
+        return None
+    return "infeasible" if infeasible else "insufficient_resources"
 
 
 def match_label_expressions(exprs: Optional[Dict], labels: Dict[str, str]) -> bool:
@@ -160,13 +172,15 @@ class ClusterResourceScheduler:
             node_id, infeasible = self.state.native.schedule_hybrid(
                 demand.items_fp(), threshold
             )
-            return ScheduleResult(node_id, infeasible=infeasible)
+            return ScheduleResult(node_id, infeasible=infeasible,
+                                  reason=_none_reason(node_id, infeasible))
         feasible = self._feasible_nodes(demand, exclude)
         if not feasible:
-            return ScheduleResult(None, infeasible=True)
+            return ScheduleResult(None, infeasible=True, reason="infeasible")
         available = [n for n in feasible if self.state.nodes[n].fits(demand)]
         if not available:
-            return ScheduleResult(None, infeasible=False)
+            return ScheduleResult(None, infeasible=False,
+                                  reason="insufficient_resources")
         for nid in available:
             if self.state.nodes[nid].utilization() < threshold:
                 return ScheduleResult(nid)
@@ -176,13 +190,14 @@ class ClusterResourceScheduler:
     def _spread(self, demand: ResourceSet, exclude=None) -> ScheduleResult:
         if self.state.native is not None and not exclude:
             node_id, infeasible = self.state.native.schedule_spread(demand.items_fp())
-            return ScheduleResult(node_id, infeasible=infeasible)
+            return ScheduleResult(node_id, infeasible=infeasible,
+                                  reason=_none_reason(node_id, infeasible))
         feasible = self._feasible_nodes(demand, exclude)
         if not feasible:
-            return ScheduleResult(None, infeasible=True)
+            return ScheduleResult(None, infeasible=True, reason="infeasible")
         available = [n for n in feasible if self.state.nodes[n].fits(demand)]
         if not available:
-            return ScheduleResult(None)
+            return ScheduleResult(None, reason="insufficient_resources")
         pick = available[self._spread_idx % len(available)]
         self._spread_idx += 1
         return ScheduleResult(pick)
@@ -194,15 +209,15 @@ class ClusterResourceScheduler:
                 # soft affinity is a preference — spill elsewhere
                 return self._hybrid(demand, exclude)
             # hard pin: the node cannot take the task right now — wait
-            return ScheduleResult(None, infeasible=False)
+            return ScheduleResult(None, infeasible=False, reason="no_idle_worker")
         node = self.state.nodes.get(nid)
         if node is not None and not node.draining and node.fits(demand):
             return ScheduleResult(nid)
         if strategy.soft:
             return self._hybrid(demand, exclude)
         if node is None:
-            return ScheduleResult(None, infeasible=True)
-        return ScheduleResult(None)
+            return ScheduleResult(None, infeasible=True, reason="infeasible")
+        return ScheduleResult(None, reason="insufficient_resources")
 
     def _node_label(self, demand: ResourceSet, strategy: SchedulingStrategy,
                     exclude=None) -> ScheduleResult:
@@ -217,13 +232,13 @@ class ClusterResourceScheduler:
             and not (exclude and nid in exclude)
         ]
         if not candidates:
-            return ScheduleResult(None, infeasible=True)
+            return ScheduleResult(None, infeasible=True, reason="infeasible")
         feasible = [n for n in candidates if self.state.nodes[n].is_feasible(demand)]
         if not feasible:
-            return ScheduleResult(None, infeasible=True)
+            return ScheduleResult(None, infeasible=True, reason="infeasible")
         available = [n for n in feasible if self.state.nodes[n].fits(demand)]
         if not available:
-            return ScheduleResult(None)
+            return ScheduleResult(None, reason="insufficient_resources")
         if soft:
             preferred = [
                 n for n in available
@@ -255,7 +270,9 @@ class ClusterResourceScheduler:
                 continue
             if self.state.nodes[nid].fits(translated):
                 return ScheduleResult(nid)
-        return ScheduleResult(None)
+        # The renamed group resources exist only once the PG committed —
+        # the pump refines this to "pg_unready" when the PG isn't CREATED.
+        return ScheduleResult(None, reason="insufficient_resources")
 
     def translated_pg_demand(self, demand: ResourceSet, strategy: SchedulingStrategy) -> ResourceSet:
         if strategy.kind != "PLACEMENT_GROUP":

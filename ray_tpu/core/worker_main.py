@@ -125,7 +125,28 @@ class WorkerHandler:
         return self.executor.current_task_info
 
     def rpc_exit(self, peer):
-        os._exit(0)
+        ex = self.executor
+        events = ex._events if ex is not None else None
+        if not events or self._controller_peer is None or self._controller_peer.closed:
+            os._exit(0)
+        # Best-effort final event flush: an exiting worker (actor kill,
+        # pool retire, teardown) must not eat the tail of its tasks'
+        # lifecycle chains — up to one flush period of RUNNING/FINISHED
+        # events can still be buffered. A timer hard-exits if the
+        # controller connection is wedged.
+        threading.Timer(1.0, lambda: os._exit(0)).start()
+        batch = []
+        while events and len(batch) < 10000:
+            batch.append(events.popleft())
+
+        async def _flush_then_exit():
+            try:
+                await self._controller_peer.notify("task_events", batch)
+            except Exception as e:  # noqa: BLE001 — exiting regardless
+                logger.debug("final event flush failed: %s", e)
+            os._exit(0)
+
+        asyncio.ensure_future(_flush_then_exit())
 
     def rpc_ping(self, peer):
         return "pong"
@@ -178,7 +199,7 @@ class TaskExecutor:
         self.core.loop_runner.submit(self._event_flush_loop())
 
     async def _event_flush_loop(self):
-        interval = self.core.config.get("event_flush_period_s", 1.0)
+        interval = self.core.config.get("event_flush_period_s", 0.25)
         while True:
             await asyncio.sleep(interval)
             if not self._events:

@@ -283,7 +283,12 @@ def cmd_summary(args):
     from ray_tpu.util import state
 
     _connect()
-    fn = {"tasks": state.summarize_tasks, "actors": state.summarize_actors, "objects": state.summarize_objects}[args.what]
+    fn = {
+        "tasks": state.summarize_tasks,
+        "actors": state.summarize_actors,
+        "objects": state.summarize_objects,
+        "lifecycle": state.summarize_lifecycle,
+    }[args.what]
     print(json.dumps(fn(), indent=2))
     return 0
 
@@ -293,8 +298,20 @@ def cmd_timeline(args):
 
     _connect()
     out = args.output or f"timeline-{int(time.time())}.json"
-    trace = state.timeline_chrome(out)
-    print(f"wrote {len(trace)} spans to {out} (load in chrome://tracing or perfetto)")
+    trace = state.timeline_chrome(
+        out,
+        include_lifecycle=not args.no_lifecycle,
+        include_spans=not args.no_spans,
+    )
+    by_cat = {}
+    for ev in trace:
+        cat = ev.get("cat", "span")
+        by_cat[cat] = by_cat.get(cat, 0) + 1
+    detail = ", ".join(f"{n} {cat}" for cat, n in sorted(by_cat.items()))
+    print(
+        f"wrote {len(trace)} events ({detail or 'none'}) to {out} "
+        "(load in chrome://tracing or perfetto)"
+    )
     return 0
 
 
@@ -528,11 +545,22 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_job)
 
     sp = sub.add_parser("summary", help="state summaries")
-    sp.add_argument("what", choices=["tasks", "actors", "objects"])
+    sp.add_argument("what", choices=["tasks", "actors", "objects", "lifecycle"])
     sp.set_defaults(fn=cmd_summary)
 
-    sp = sub.add_parser("timeline", help="dump chrome trace of task events")
+    sp = sub.add_parser(
+        "timeline",
+        help="chrome trace: task slices + control-plane lifecycle + user spans",
+    )
     sp.add_argument("--output", "-o")
+    sp.add_argument(
+        "--no-lifecycle", action="store_true",
+        help="omit flight-recorder lifecycle rows",
+    )
+    sp.add_argument(
+        "--no-spans", action="store_true",
+        help="omit RAY_TPU_TRACE span files",
+    )
     sp.set_defaults(fn=cmd_timeline)
 
     sub.add_parser("memory", help="object store summary").set_defaults(fn=cmd_memory)

@@ -64,6 +64,8 @@ ROWS = (
     ("Serve Engine", ("serve_engine_",)),
     ("Train", ("train_",)),
     ("Data", ("data_",)),
+    ("Control Plane", ("task_state_", "task_pending_", "lease_",
+                       "lockwatch_")),
     ("Cluster Resources", ("tpu_hbm_", "node_", "object_store_",
                            "metrics_series_")),
     ("Compilation", ("jax_",)),

@@ -12,6 +12,7 @@ observability port (reference: dashboard metrics module + `ray metrics`).
 """
 from __future__ import annotations
 
+import concurrent.futures as _futures
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -164,6 +165,15 @@ class Histogram(Metric):
         super().__init__(name, description, tag_keys, max_series)
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        self.observe_many((value,), tags)
+
+    def observe_many(self, values: Sequence[float], tags: Optional[Dict[str, str]] = None):
+        """Bulk observe: one tags-key/cap resolution and one lock
+        acquisition for the whole batch — the flush path for hot-loop
+        recorders (e.g. the lifecycle flight recorder) that must not pay
+        per-event metric overhead."""
+        if not values:
+            return
         key = _tags_key(self._merged(tags))
         cap = self._cap()
         with _lock:
@@ -172,12 +182,15 @@ class Histogram(Metric):
             st = self._state.get(key)
             if st is None:
                 st = self._state[key] = [0] * (len(self.boundaries) + 1) + [0.0, 0]
-            i = 0
-            while i < len(self.boundaries) and value > self.boundaries[i]:
-                i += 1
-            st[i] += 1
-            st[-2] += value
-            st[-1] += 1
+            bounds = self.boundaries
+            nb = len(bounds)
+            for value in values:
+                i = 0
+                while i < nb and value > bounds[i]:
+                    i += 1
+                st[i] += 1
+                st[-2] += value
+                st[-1] += 1
 
     def _drain(self):
         with _lock:
@@ -235,10 +248,21 @@ def _flush_once() -> bool:
     records = drain_records()
     if records:
         try:
-            core._call("metrics_report", records)
-        except Exception:
-            # Re-queue so counter deltas survive transient controller
-            # hiccups (bounded: keep the newest ~10k records).
+            # Bounded wait: this runs on the ONE process-wide flusher
+            # thread — an unbounded call wedged on a cluster mid-shutdown
+            # (stopped loop, half-dead peer) would silently kill metric
+            # delivery for every LATER cluster this process connects to.
+            core._call("metrics_report", records, timeout=5)
+        except (TimeoutError, _futures.TimeoutError):
+            # The in-flight RPC is NOT cancelled by the client-side wait
+            # expiring — a stalled-but-alive controller may still apply
+            # it, so re-sending would double-count deltas. Drop instead:
+            # undercounting one window beats inflating counters.
+            return False
+        except BaseException:  # noqa: BLE001 — incl. loop-shutdown errors
+            # Connection-level failure: the report did not land. Re-queue
+            # so counter deltas survive transient controller hiccups
+            # (bounded: keep the newest ~10k records).
             requeue_records(records)
             return False
     return True
@@ -265,6 +289,29 @@ def _ensure_flusher():
 def flush():
     """Force a synchronous flush (tests / process exit)."""
     _flush_once()
+
+
+# ---------------------------------------------------------------------------
+def summarize_samples(samples) -> Dict[str, float]:
+    """Percentile summary of a bounded sample ring (nearest-rank): the
+    shared shape for dwell-time and latency rollups in the state API and
+    the envelope harness ({samples, mean, p50, p95, p99, max})."""
+    vals = sorted(float(v) for v in samples)
+    if not vals:
+        return {}
+    last = len(vals) - 1
+
+    def pct(q: float) -> float:
+        return vals[min(last, int(q * last + 0.5))]
+
+    return {
+        "samples": len(vals),
+        "mean": round(sum(vals) / len(vals), 3),
+        "p50": round(pct(0.5), 3),
+        "p95": round(pct(0.95), 3),
+        "p99": round(pct(0.99), 3),
+        "max": round(vals[-1], 3),
+    }
 
 
 # ---------------------------------------------------------------------------

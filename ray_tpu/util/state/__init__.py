@@ -85,13 +85,20 @@ def get_placement_group(pg_id: str) -> Optional[dict]:
 # ---------------------------------------------------------------------------
 # Summaries (reference: api.py summarize_tasks/actors/objects)
 # ---------------------------------------------------------------------------
-def summarize_tasks() -> dict:
-    by = _Counter()
-    for t in list_tasks(limit=100000):
-        by[(t["name"], t["state"])] += 1
-    out: dict = {}
-    for (name, state), n in sorted(by.items()):
-        out.setdefault(name, {})[state] = n
+def summarize_tasks(limit: int = 1000) -> dict:
+    """Counts by (name, state), computed controller-side so the RPC stays
+    O(limit) at 40k+ tasks: the ``limit`` busiest task names get per-state
+    rows; the reserved ``_totals`` key carries UNCAPPED counts-by-state,
+    live pending-reason attribution, the total task count, and whether
+    names were truncated."""
+    res = _require_worker()._call("summarize_tasks", limit=limit)
+    out: dict = dict(res.get("tasks", {}))
+    out["_totals"] = {
+        "by_state": res.get("counts_by_state", {}),
+        "pending_reasons": res.get("pending_reasons", {}),
+        "total": res.get("total", 0),
+        "truncated": res.get("truncated", False),
+    }
     return out
 
 
@@ -109,6 +116,22 @@ def summarize_objects() -> dict:
         "total_size": sum(o["size"] or 0 for o in objs),
         "by_state": dict(_Counter(o["state"] for o in objs)),
     }
+
+
+def summarize_lifecycle() -> dict:
+    """Control-plane flight-recorder rollup (core/lifecycle.py): per-
+    (kind, state) transition counts and dwell-time p50/p95/p99 for tasks,
+    actors, placement groups, worker leases, and worker startup, plus
+    why-pending attribution counters (insufficient_resources /
+    no_idle_worker / pg_unready / spillback / infeasible / waiting_*)."""
+    return _require_worker()._call("summarize_lifecycle")
+
+
+def list_lifecycle_events(limit: int = 10000) -> List[dict]:
+    """The newest ``limit`` lifecycle transition events from the
+    controller's bounded ring ({ts, kind, id, state, prev?, dwell_ms?,
+    ...context})."""
+    return _require_worker()._call("list_lifecycle_events", limit=limit)
 
 
 def summarize_resources() -> dict:
@@ -286,19 +309,31 @@ def dashboard_url() -> Optional[str]:
         return f"http://127.0.0.1:{f.read().strip()}"
 
 
-def timeline_chrome(filename: Optional[str] = None) -> list:
-    """Chrome-trace (catapult) JSON from the task event buffer.
+def timeline_chrome(
+    filename: Optional[str] = None,
+    include_lifecycle: bool = True,
+    include_spans: bool = True,
+) -> list:
+    """Chrome-trace (catapult) JSON merging three event sources into ONE
+    chrome://tracing load (reference: `ray timeline` →
+    chrome_tracing_dump, python/ray/_private/state.py:438):
 
-    Reference: `ray timeline` → chrome_tracing_dump
-    (python/ray/_private/state.py:438). Pair RUNNING→FINISHED/FAILED
-    transitions into complete ("ph":"X") events, bucketed by node/worker.
+    - task execution slices paired from the task event buffer
+      (RUNNING → FINISHED/FAILED)
+    - control-plane lifecycle slices from the flight recorder
+      (``include_lifecycle``): scheduler decisions — queue/lease/dispatch
+      dwell — rendered under ``lifecycle:<kind>`` process rows
+    - user/application spans from the per-process JSONL sinks
+      (``include_spans``, populated when RAY_TPU_TRACE=1)
     """
     events = list_cluster_events(limit=1000000)
     open_spans: dict = {}
     trace = []
     for ev in events:
-        key = ev["task_id"]
-        state = ev["state"]
+        key = ev.get("task_id")
+        state = ev.get("state")
+        if key is None or state is None:
+            continue
         if state == "RUNNING":
             open_spans[key] = ev
         elif state in ("FINISHED", "FAILED") and key in open_spans:
@@ -315,6 +350,14 @@ def timeline_chrome(filename: Optional[str] = None) -> list:
                     "args": {"task_id": key, "outcome": state},
                 }
             )
+    if include_lifecycle:
+        from ray_tpu.core.lifecycle import to_chrome
+
+        trace.extend(to_chrome(list_lifecycle_events(limit=1000000)))
+    if include_spans:
+        from ray_tpu.util.tracing import collect_spans
+
+        trace.extend(collect_spans(_require_worker().session_dir))
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

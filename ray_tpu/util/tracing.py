@@ -18,6 +18,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import sys
 import threading
 import time
 import uuid
@@ -27,9 +28,20 @@ _state = threading.local()
 _enabled = False
 _sink_path: Optional[str] = None
 _sink_lock = threading.Lock()
+# Sink bound (single rotation): when the JSONL file would exceed the cap
+# it is renamed to <path>.1 (overwriting any previous rotation) and a
+# fresh file starts — long RAY_TPU_TRACE=1 runs keep at most 2x the cap
+# per process instead of growing without limit.
+_sink_bytes = 0
+_max_sink_bytes = 0
+# Threads whose thread_name metadata has been written to the CURRENT
+# sink file (guarded by _sink_lock; cleared on rotation so the fresh
+# file is self-describing).
+_named_tids: set = set()
 
 TRACE_CTX_KEY = "__trace_ctx__"
 TRACE_ENV_VAR = "RAY_TPU_TRACE"
+TRACE_MAX_MB_VAR = "RAY_TPU_TRACE_MAX_MB"  # per-process sink cap (default 64)
 
 
 def maybe_enable_from_env() -> bool:
@@ -45,7 +57,7 @@ def maybe_enable_from_env() -> bool:
 def enable_tracing(session_dir: Optional[str] = None):
     """Turn on span recording in this process (reference:
     ``ray.init(_tracing_startup_hook=...)`` opt-in)."""
-    global _enabled, _sink_path
+    global _enabled, _sink_path, _sink_bytes, _max_sink_bytes
     _enabled = True
     if session_dir is None:
         from ray_tpu.core import api
@@ -53,27 +65,97 @@ def enable_tracing(session_dir: Optional[str] = None):
         session_dir = getattr(api, "_session_dir", None) or "/tmp/ray_tpu"
     logs = os.path.join(session_dir, "logs")
     os.makedirs(logs, exist_ok=True)
-    _sink_path = os.path.join(logs, f"spans-{os.getpid()}.jsonl")
+    try:
+        cap_mb = float(os.environ.get(TRACE_MAX_MB_VAR, "64"))
+    except ValueError:
+        cap_mb = 64.0
+    with _sink_lock:
+        _sink_path = os.path.join(logs, f"spans-{os.getpid()}.jsonl")
+        _max_sink_bytes = max(1, int(cap_mb * 1024 * 1024))
+        try:
+            _sink_bytes = os.path.getsize(_sink_path)
+        except OSError:
+            _sink_bytes = 0
+        _named_tids.clear()
 
 
 def disable_tracing():
     """Stop span recording in this process (tests)."""
-    global _enabled, _sink_path
+    global _enabled, _sink_path, _sink_bytes
     _enabled = False
-    _sink_path = None
+    with _sink_lock:
+        _sink_path = None
+        _sink_bytes = 0
+        _named_tids.clear()
 
 
 def tracing_enabled() -> bool:
     return _enabled
 
 
+def _process_name() -> str:
+    """Human label for this process's Chrome-trace row."""
+    wid = os.environ.get("RAY_TPU_WORKER_ID", "")
+    if wid:
+        return f"worker-{wid[:8]}"
+    argv = " ".join(sys.argv[:2])
+    if "controller" in argv:
+        return "controller"
+    if "node_agent" in argv:
+        return "node_agent"
+    return f"driver-{os.getpid()}"
+
+
+def _meta_event(name: str, tid: int, value: str) -> Dict[str, Any]:
+    """Chrome-trace metadata ("ph":"M") event: process_name/thread_name
+    records that label the pid/tid rows of merged timelines."""
+    return {
+        "name": name,
+        "ph": "M",
+        "ts": 0,
+        "pid": os.getpid(),
+        "tid": tid,
+        "args": {"name": value},
+    }
+
+
 def _write(rec: Dict[str, Any]):
+    global _sink_bytes
     if _sink_path is None:
         return
+    lines = []
+    tid = rec.get("tid")
     try:
         with _sink_lock:
-            with open(_sink_path, "a", encoding="utf-8") as f:
-                f.write(json.dumps(rec) + "\n")
+            # Encoded bytes, not str length: the cap must track the real
+            # file size even for multi-byte span names/args.
+            line = (json.dumps(rec) + "\n").encode("utf-8")
+            if _sink_bytes + len(line) > _max_sink_bytes and _sink_bytes > 0:
+                # Single rotation: the previous half replaces any older
+                # .1 file, so disk use is bounded at ~2x the cap.
+                os.replace(_sink_path, _sink_path + ".1")
+                _sink_bytes = 0
+                _named_tids.clear()
+            if not _named_tids:
+                lines.append(
+                    (json.dumps(_meta_event("process_name", 0, _process_name()))
+                     + "\n").encode("utf-8")
+                )
+                _named_tids.add(0)
+            if tid is not None and tid not in _named_tids:
+                _named_tids.add(tid)
+                lines.append(
+                    (json.dumps(
+                        _meta_event(
+                            "thread_name", tid, threading.current_thread().name
+                        )
+                    ) + "\n").encode("utf-8")
+                )
+            lines.append(line)
+            with open(_sink_path, "ab") as f:
+                for ln in lines:
+                    f.write(ln)
+                    _sink_bytes += len(ln)
     except (OSError, ValueError):
         # Telemetry must never take down the traced path: a full disk or
         # removed session dir silently drops spans (the sink is
@@ -209,13 +291,17 @@ def trace_span(name: Optional[str] = None):
 
 
 def collect_spans(session_dir: str) -> List[dict]:
-    """Merge every process's span file into one Chrome-trace event list."""
+    """Merge every process's span file (rotated ``.jsonl.1`` halves
+    included) into one Chrome-trace event list."""
     events: List[dict] = []
     logs = os.path.join(session_dir, "logs")
     if not os.path.isdir(logs):
         return events
     for fname in sorted(os.listdir(logs)):
-        if not (fname.startswith("spans-") and fname.endswith(".jsonl")):
+        if not (
+            fname.startswith("spans-")
+            and (fname.endswith(".jsonl") or fname.endswith(".jsonl.1"))
+        ):
             continue
         with open(os.path.join(logs, fname), encoding="utf-8") as f:
             for line in f:
