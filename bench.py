@@ -186,6 +186,13 @@ def main():
             extra["ingest_cpu"] = _bench_ingest_cpu(log)
         except Exception as e:  # noqa: BLE001 — ingest bench must not kill the metric
             log(f"cpu ingest bench failed: {e!r}")
+        try:
+            extra["rl_ppo_cpu"] = _bench_rl_ppo_cpu(log)
+            extra["rl_ppo_env_steps_per_sec"] = extra["rl_ppo_cpu"][
+                "podracer_env_steps_per_s"
+            ]
+        except Exception as e:  # noqa: BLE001 — RL bench must not kill the metric
+            log(f"cpu rl ppo bench failed: {e!r}")
 
     record = {
         "metric": "train_tokens_per_sec_per_chip_750m_bf16" if not cpu_mode else "train_tokens_per_sec_per_chip_tiny_cpu",
@@ -540,6 +547,96 @@ def _bench_ingest_cpu(log):
             f"cpu ingest: {off:.1f} -> {on:.1f} batches/s "
             f"({res['pipeline_speedup']}x, step {step_s*1e3:.2f}ms, "
             f"zero-copy hits {hits})"
+        )
+        return res
+    finally:
+        ray_tpu.shutdown()
+
+
+def _bench_rl_ppo_cpu(log):
+    """RLlib PPO CartPole env-steps/sec (the BASELINE.json north-star
+    metric): synchronous driver loop vs the podracer async pipeline
+    (ISSUE 8, ray_tpu.rllib.podracer), 4 CPU env-runner actors in both
+    arms. Mid-run one podracer runner is KILLED to prove the bench
+    completes through an actor restart (queue keeps flowing, restart
+    recorded in the control-plane lifecycle events)."""
+    import ray_tpu
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.util import state
+
+    def base():
+        # kl_target high = KL early-stop off, so BOTH arms do the exact
+        # same learner work per batch (a clean A/B: the podracer win is
+        # sampling/update overlap, not a shorter epoch cycle).
+        return (
+            PPOConfig()
+            .environment("CartPole-v1")
+            .training(train_batch_size=2048, minibatch_size=256,
+                      num_epochs=4, lr=1e-3, kl_target=10.0)
+            .debugging(seed=0)
+        )
+
+    iters = 6
+    ray_tpu.init(num_cpus=8)
+    try:
+        # -- arm 1: synchronous driver loop (sample -> update -> sync) ----
+        cfg = base().env_runners(
+            num_env_runners=4, num_envs_per_env_runner=2,
+            rollout_fragment_length=256,
+        )
+        algo = cfg.build()
+        algo.train()  # warmup: jit compiles on every runner + the learner
+        t0 = time.perf_counter()
+        steps = 0
+        for _ in range(iters):
+            r = algo.train()
+            steps += r["env_steps_this_iter"]
+        sync_rate = steps / (time.perf_counter() - t0)
+        log(f"rl ppo: sync {sync_rate:.0f} env-steps/s "
+            f"(return {r['episode_return_mean']:.1f})")
+        algo.stop()
+
+        # -- arm 2: podracer async pipeline -------------------------------
+        cfg = base().env_runners(
+            num_envs_per_env_runner=2, rollout_fragment_length=256
+        ).podracer(num_async_runners=4, sample_queue_size=16)
+        algo = cfg.build()
+        algo.train()  # warmup
+        t0 = time.perf_counter()
+        steps = 0
+        for i in range(iters):
+            if i == iters // 2:
+                # kill a runner mid-run: the bench must complete anyway
+                ray_tpu.kill(algo._podracer.manager.actors[0])
+                log("rl ppo: killed runner 0 mid-run")
+            r = algo.train()
+            steps += r["env_steps_this_iter"]
+        pod_rate = steps / (time.perf_counter() - t0)
+        # The measured window can end within the ~0.5s crash-detection
+        # latency; give the pipeline a bounded beat to register the
+        # restart so it is visible in the report and lifecycle events.
+        deadline = time.time() + 15
+        while algo._podracer.num_restarts == 0 and time.time() < deadline:
+            algo._podracer.check_runners()
+            time.sleep(0.25)
+        restarts = algo._podracer.num_restarts
+        death_events = sum(
+            1 for e in state.list_lifecycle_events(limit=100000)
+            if e.get("kind") == "actor" and e.get("state") in ("DEAD", "FAILED")
+        )
+        algo.stop()
+        res = {
+            "sync_env_steps_per_s": round(sync_rate, 1),
+            "podracer_env_steps_per_s": round(pod_rate, 1),
+            "podracer_speedup": round(pod_rate / sync_rate, 2),
+            "runner_restarts": restarts,
+            "lifecycle_runner_death_events": death_events,
+            "num_runners": 4,
+        }
+        log(
+            f"rl ppo: podracer {pod_rate:.0f} env-steps/s "
+            f"({res['podracer_speedup']}x sync, {restarts} runner "
+            f"restart(s) mid-run, {death_events} lifecycle death event(s))"
         )
         return res
     finally:

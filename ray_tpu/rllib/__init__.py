@@ -21,6 +21,14 @@ from ray_tpu.rllib.multi_agent import (
     MultiAgentPPOConfig,
 )
 from ray_tpu.rllib.offline import BC, BCConfig, MARWIL, MARWILConfig
+from ray_tpu.rllib.podracer import (
+    PodracerConfig,
+    PodracerEnvRunner,
+    PodracerPipeline,
+    SampleQueue,
+    VtraceBatchBuilder,
+    WeightBroadcast,
+)
 from ray_tpu.rllib.ope import (
     DirectMethod,
     DoublyRobust,
@@ -53,6 +61,12 @@ __all__ = [
     "IMPALA",
     "IMPALAConfig",
     "vtrace_returns",
+    "PodracerConfig",
+    "PodracerEnvRunner",
+    "PodracerPipeline",
+    "SampleQueue",
+    "VtraceBatchBuilder",
+    "WeightBroadcast",
     "APPO",
     "APPOConfig",
     "MultiAgentEnv",

@@ -44,6 +44,18 @@ class AlgorithmConfig:
         self.num_tpus_per_learner: float = 0
         self.hidden: tuple = (64, 64)
         self.seed: int = 0
+        # -- podracer (Sebulba async actor–learner) section --------------
+        # 0 = synchronous driver loop (seed behaviour); > 0 spawns that
+        # many continuous env-runner actors feeding the bounded sample
+        # queue (see ray_tpu.rllib.podracer).
+        self.num_async_runners: int = 0
+        self.sample_queue_size: int = 16
+        self.max_policy_lag: int = 8
+        self.policy_lag_mode: str = "correct"
+        self.weights_publish_interval: int = 1
+        self.podracer_max_pull: int = 16
+        self.podracer_poll_timeout_s: float = 2.0
+        self.podracer_iteration_timeout_s: float = 300.0
         self.extra: Dict[str, Any] = {}
 
     # fluent setters ------------------------------------------------------
@@ -77,6 +89,32 @@ class AlgorithmConfig:
 
     def debugging(self, seed: int = 0) -> "AlgorithmConfig":
         self.seed = seed
+        return self
+
+    def podracer(
+        self,
+        num_async_runners: int = 0,
+        sample_queue_size: int = 16,
+        max_policy_lag: int = 8,
+        policy_lag_mode: str = "correct",
+        weights_publish_interval: int = 1,
+        max_pull: int = 16,
+        poll_timeout_s: float = 2.0,
+        iteration_timeout_s: float = 300.0,
+    ) -> "AlgorithmConfig":
+        """Sebulba async pipeline section (ray_tpu.rllib.podracer):
+        continuous env-runner actors -> bounded sample queue -> learner,
+        with versioned weight broadcast and ``max_policy_lag`` staleness
+        control (``policy_lag_mode``: "drop" rejects over-stale fragments,
+        "correct" keeps them for V-trace's rho/c truncation)."""
+        self.num_async_runners = num_async_runners
+        self.sample_queue_size = sample_queue_size
+        self.max_policy_lag = max_policy_lag
+        self.policy_lag_mode = policy_lag_mode
+        self.weights_publish_interval = weights_publish_interval
+        self.podracer_max_pull = max_pull
+        self.podracer_poll_timeout_s = poll_timeout_s
+        self.podracer_iteration_timeout_s = iteration_timeout_s
         return self
 
     def rl_module(self, hidden: tuple = (64, 64)) -> "AlgorithmConfig":
@@ -139,21 +177,10 @@ class EnvRunnerGroup:
         self._weights_version += 1
         self.local_runner.set_state(params, self._weights_version)
         if self._manager:
+            from ray_tpu.rllib.podracer.weights import stage_broadcast
+
             ref = ray_tpu.put(params)
-            try:
-                core = ray_tpu.core.api._require_worker()
-                nodes = {
-                    n["node_id"] for n in ray_tpu.nodes()
-                    if n["state"] == "ALIVE" and not n["is_head"]
-                }
-                if nodes:
-                    # False for inline-small weights (nothing to stage)
-                    core._call("object_broadcast", ref.id, None, timeout=300)
-            except Exception as e:  # noqa: BLE001 — staging is best-effort
-                logging.getLogger("ray_tpu.rllib").warning(
-                    "weight broadcast staging failed (workers will pull "
-                    "point-to-point): %s", e,
-                )
+            stage_broadcast(ref)
             self._manager.foreach_actor(
                 "set_state", ref, self._weights_version, timeout=60
             )
@@ -189,8 +216,32 @@ class Algorithm:
     setup in __init__, train() per iteration, save/restore)."""
 
     loss_fn = None  # set by subclass
+    # Podracer needs a V-trace-able on-policy module (PPO/IMPALA/APPO set
+    # True); replay-buffer algorithms keep their own loops.
+    supports_podracer = False
 
     def __init__(self, config: AlgorithmConfig):
+        # Build-time overrides go on a COPY — build() must not edit the
+        # caller's config object as a side effect.
+        if config.num_async_runners > 0:
+            import copy
+
+            if not type(self).supports_podracer:
+                logging.getLogger("ray_tpu.rllib").warning(
+                    "%s does not run on the podracer pipeline — ignoring "
+                    "num_async_runners=%d (synchronous loop used)",
+                    type(self).__name__, config.num_async_runners,
+                )
+                config = copy.copy(config)
+                config.num_async_runners = 0
+            elif config.num_env_runners > 0:
+                logging.getLogger("ray_tpu.rllib").warning(
+                    "podracer mode (num_async_runners=%d) supersedes the "
+                    "synchronous runner fleet — ignoring num_env_runners=%d",
+                    config.num_async_runners, config.num_env_runners,
+                )
+                config = copy.copy(config)
+                config.num_env_runners = 0
         self.config = config
         self.module_spec = config.module_spec()
         self.env_runner_group = EnvRunnerGroup(config, self.module_spec)
@@ -207,13 +258,132 @@ class Algorithm:
         )
         self.iteration = 0
         self._total_env_steps = 0
+        self._batch_builder_cache = None
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        self._podracer = None
+        self._podracer_updates = 0
+        if config.num_async_runners > 0:
+            from ray_tpu.rllib.podracer import PodracerConfig, PodracerPipeline
+
+            self._podracer = PodracerPipeline(
+                PodracerConfig.from_algorithm_config(config), self.module_spec
+            )
+            self._podracer.start(self.learner_group.get_weights())
 
     def _loss_cfg(self) -> dict:
         return {}
 
     def training_step(self) -> Dict[str, Any]:
         raise NotImplementedError
+
+    # -- podracer (Sebulba async) path ------------------------------------
+    def _batch_builder(self):
+        """Shared batched+jitted V-trace batch builder over the target
+        module (the learner's own module locally; a factory-built twin
+        when learners are remote actors)."""
+        if self._batch_builder_cache is None:
+            from ray_tpu.rllib.podracer.vtrace_builder import VtraceBatchBuilder
+            from ray_tpu.rllib.rl_module import make_module
+
+            lg = self.learner_group
+            module = (
+                lg._local.module if lg._local is not None
+                else make_module(self.module_spec)
+            )
+            self._batch_builder_cache = VtraceBatchBuilder(module)
+        return self._batch_builder_cache
+
+    def _podracer_builder_kwargs(self) -> dict:
+        c = self.config
+        return dict(
+            gamma=c.gamma,
+            rho_bar=getattr(c, "rho_bar", 1.0),
+            c_bar=getattr(c, "c_bar", 1.0),
+        )
+
+    def _podracer_min_batch_env_steps(self) -> int:
+        """Env steps accumulated per learner update (IMPALA-style: one
+        fragment's worth, continuous updates; PPO overrides to its full
+        train batch)."""
+        return max(1, self.config.rollout_fragment_length)
+
+    def _podracer_update_fn(self, batch) -> Dict[str, float]:
+        """One learner cycle on a built batch; PPO overrides with its
+        minibatch-epoch loop."""
+        return self.learner_group.update_from_batch(batch)
+
+    def _podracer_training_step(self) -> Dict[str, Any]:
+        from ray_tpu.rllib.podracer.metrics import rl_metrics
+
+        cfg = self.config
+        pr = self._podracer
+        m = rl_metrics()
+        target = cfg.train_batch_size
+        min_pull = self._podracer_min_batch_env_steps()
+        deadline = time.monotonic() + pr.cfg.iteration_timeout_s
+        consumed = 0
+        metrics: Dict[str, float] = {}
+        while consumed < target:
+            if time.monotonic() >= deadline:
+                if consumed:
+                    # Updates already applied this iteration — return the
+                    # partial result so step/return accounting stays
+                    # truthful instead of raising it away.
+                    logging.getLogger("ray_tpu.rllib").warning(
+                        "podracer training step timed out at %d/%d env "
+                        "steps (runner restarts: %d) — returning partial "
+                        "iteration", consumed, target, pr.num_restarts,
+                    )
+                    break
+                raise TimeoutError(
+                    f"podracer training step starved: 0/{target} "
+                    f"env steps within {pr.cfg.iteration_timeout_s}s "
+                    f"(runner restarts: {pr.num_restarts})"
+                )
+            episodes, steps = pr.pull_min(
+                min(min_pull, target - consumed), deadline
+            )
+            if not episodes:
+                continue
+            t0 = time.perf_counter()
+            batch = self._batch_builder().build(
+                self.learner_group.get_weights(),
+                episodes,
+                **self._podracer_builder_kwargs(),
+            )
+            if batch is None:
+                continue
+            metrics = self._podracer_update_fn(batch)
+            self._podracer_updates += 1
+            if self._podracer_updates % cfg.weights_publish_interval == 0:
+                pr.publish(self.learner_group.get_weights())
+            m.learner_step_ms.observe((time.perf_counter() - t0) * 1e3)
+            consumed += steps
+        self._total_env_steps += consumed
+        returns = pr.pop_returns()
+        mean_ret = self._record_returns(returns)
+        return {
+            "env_steps_this_iter": consumed,
+            "episode_return_mean": mean_ret,
+            "num_episodes": len(returns),
+            "podracer/weights_version": pr.version,
+            "podracer/queue_depth": pr.stats["queue_depth"],
+            "podracer/fragments_dropped_stale": pr.stats["fragments_dropped_stale"],
+            "podracer/fragments_lost": pr.stats["fragments_lost"],
+            "podracer/runner_restarts": pr.stats["runner_restarts"],
+            "podracer/max_policy_lag_seen": pr.stats["max_policy_lag_seen"],
+            **{f"learner/{k}": v for k, v in metrics.items()},
+        }
+
+    def _record_returns(self, returns: List[float]) -> float:
+        """Fold completed-episode returns into the rolling-100 window;
+        returns the current mean (0.0 before any episode finishes)."""
+        if returns:
+            self._recent_returns = (
+                getattr(self, "_recent_returns", []) + returns
+            )[-100:]
+        recent = getattr(self, "_recent_returns", None)
+        return float(np.mean(recent)) if recent else 0.0
 
     def train(self) -> Dict[str, Any]:
         t0 = time.time()
@@ -228,6 +398,13 @@ class Algorithm:
         return result
 
     def evaluate(self, num_episodes: int = 5) -> float:
+        if self._podracer is not None:
+            # Podracer publishes weights to the broadcast store only; the
+            # local eval runner never sees them — sync it lazily here so
+            # evaluate() measures the TRAINED policy.
+            self.env_runner_group.local_runner.set_state(
+                self.learner_group.get_weights(), self._podracer.version
+            )
         return self.env_runner_group.evaluate(num_episodes)
 
     # -- checkpointing (reference: Checkpointable mixin,
@@ -251,6 +428,11 @@ class Algorithm:
         self.iteration = st["iteration"]
         self._total_env_steps = st["total_env_steps"]
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        if self._podracer is not None:
+            self._podracer.publish(self.learner_group.get_weights())
 
     def stop(self):
+        if self._podracer is not None:
+            self._podracer.shutdown()
+            self._podracer = None
         self.learner_group.shutdown()

@@ -10,11 +10,6 @@ IMPALA (saturated in-flight sample() calls, harvest-whichever-finished).
 """
 from __future__ import annotations
 
-from typing import Dict, List
-
-import numpy as np
-
-from ray_tpu.rllib.episodes import SingleAgentEpisode
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 
 
@@ -81,16 +76,5 @@ class APPO(IMPALA):
             kl_coeff=c.kl_coeff,
         )
 
-    def _episodes_to_vtrace_batch(self, episodes: List[SingleAgentEpisode]):
-        """V-trace batch plus the behavior logps the surrogate ratio
-        needs (IMPALA's plain PG loss does not use them)."""
-        batch = super()._episodes_to_vtrace_batch(episodes)
-        logps = [
-            np.asarray(ep.logps, dtype=np.float32)
-            for ep in episodes
-            if len(ep) > 0
-        ]
-        batch["logp_old"] = (
-            np.concatenate(logps) if logps else np.zeros(0, np.float32)
-        )
-        return batch
+    # The shared VtraceBatchBuilder already carries the behavior logps
+    # (``logp_old``) the surrogate ratio needs — no batch override.

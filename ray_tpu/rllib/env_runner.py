@@ -43,17 +43,23 @@ class SingleAgentEnvRunner:
 
         self._envs = [_make_env(env_spec) for _ in range(num_envs)]
         self._num_envs = num_envs
+        self._seed = seed
+        self.worker_index = worker_index
         self.module = make_module(module_spec)
         self.params = self.module.init_params(jax.random.PRNGKey(seed))
         self._key = jax.random.PRNGKey(seed * 100003 + worker_index)
         self._explore = jax.jit(self.module.forward_exploration)
-        self._obs = [env.reset(seed=seed + worker_index * 1000 + i)[0] for i, env in enumerate(self._envs)]
+        self._obs = [env.reset(seed=self._env_seed(i))[0] for i, env in enumerate(self._envs)]
         self._episodes = [SingleAgentEpisode(observations=[o]) for o in self._obs]
-        self.worker_index = worker_index
         self._weights_version = 0
         # true per-episode returns across fragment cuts (metrics only)
         self._return_acc = [0.0] * num_envs
         self._completed_returns: List[float] = []
+
+    def _env_seed(self, i: int) -> int:
+        """Per-env reset seed: the construction-time scheme, also used
+        when evaluate() re-seeds the clobbered vector env."""
+        return self._seed + self.worker_index * 1000 + i
 
     # -- weight sync (reference: env_runner_group.sync_weights) ----------
     def set_state(self, params, weights_version: int = 0):
@@ -153,7 +159,10 @@ class SingleAgentEnvRunner:
                 obs, rew, term, trunc, _ = env.step(act)
                 total += float(rew)
                 done = term or trunc
-        # runner state was clobbered; reset in-progress episodes
-        self._obs = [env.reset(seed=i)[0] for i, env in enumerate(self._envs)]
+        # Runner state was clobbered; reset in-progress episodes with the
+        # SAME construction-time seed scheme — ``seed=i`` here silently
+        # collapsed every runner onto identical episode streams post-eval,
+        # perturbing cross-runner determinism.
+        self._obs = [env.reset(seed=self._env_seed(i))[0] for i, env in enumerate(self._envs)]
         self._episodes = [SingleAgentEpisode(observations=[o]) for o in self._obs]
         return total / num_episodes

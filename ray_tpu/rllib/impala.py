@@ -80,6 +80,7 @@ class IMPALAConfig(AlgorithmConfig):
 
 class IMPALA(Algorithm):
     loss_fn = staticmethod(impala_loss)
+    supports_podracer = True
 
     def _loss_cfg(self) -> dict:
         c = self.config
@@ -91,50 +92,24 @@ class IMPALA(Algorithm):
 
     def _episodes_to_vtrace_batch(self, episodes: List[SingleAgentEpisode]):
         """Behavior logps come from the (stale) runner policy; target logps
-        from the current learner params — the V-trace correction."""
-        import jax
-        import jax.numpy as jnp
-
+        from the current learner params — the V-trace correction. The
+        recompute is ONE batched, jitted forward over the concatenated
+        episodes (podracer's VtraceBatchBuilder, bounded shape buckets),
+        replacing the old per-episode unjitted driver forwards; the module
+        comes from the ``make_module`` factory like every other call site."""
         cfg = self.config
-        module = self.learner_group._local.module if self.learner_group._local else None
-        params = self.learner_group.get_weights()
-        if module is None:
-            from ray_tpu.rllib.rl_module import RLModule
-
-            module = RLModule(self.module_spec)
-        obs_l, act_l, pg_l, vt_l = [], [], [], []
-        for ep in episodes:
-            if len(ep) == 0:
-                continue
-            obs = np.asarray(ep.observations[: len(ep)], dtype=np.float32)
-            acts = np.asarray(ep.actions, dtype=np.int32)
-            out = module.logp_entropy(params, jnp.asarray(obs), jnp.asarray(acts))
-            target_logps = np.asarray(out["logp"], dtype=np.float32)
-            values = np.asarray(out["vf"], dtype=np.float32)
-            vs, pg_adv = vtrace_returns(
-                np.asarray(ep.logps, dtype=np.float32),
-                target_logps,
-                np.asarray(ep.rewards, dtype=np.float32),
-                values,
-                ep.final_value,
-                ep.terminated,
-                gamma=cfg.gamma,
-                rho_bar=cfg.rho_bar,
-                c_bar=cfg.c_bar,
-            )
-            obs_l.append(obs)
-            act_l.append(acts)
-            pg_l.append(pg_adv)
-            vt_l.append(vs)
-        return {
-            "obs": np.concatenate(obs_l),
-            "actions": np.concatenate(act_l),
-            "pg_advantages": np.concatenate(pg_l).astype(np.float32),
-            "vtrace_targets": np.concatenate(vt_l).astype(np.float32),
-        }
+        return self._batch_builder().build(
+            self.learner_group.get_weights(),
+            episodes,
+            gamma=cfg.gamma,
+            rho_bar=cfg.rho_bar,
+            c_bar=cfg.c_bar,
+        )
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
+        if self._podracer is not None:
+            return self._podracer_training_step()
         group = self.env_runner_group
         metrics: Dict[str, float] = {}
         if group._manager is None:
@@ -167,12 +142,11 @@ class IMPALA(Algorithm):
         env_steps = sum(len(e) for e in episodes)
         self._total_env_steps += env_steps
         batch = self._episodes_to_vtrace_batch(episodes)
-        metrics = self.learner_group.update_from_batch(batch)
+        if batch is not None:
+            metrics = self.learner_group.update_from_batch(batch)
         group.sync_weights(self.learner_group.get_weights())
         returns = group.pop_metrics()
-        if returns:
-            self._recent_returns = (getattr(self, "_recent_returns", []) + returns)[-100:]
-        mean_ret = float(np.mean(self._recent_returns)) if getattr(self, "_recent_returns", None) else 0.0
+        mean_ret = self._record_returns(returns)
         return {
             "env_steps_this_iter": env_steps,
             "episode_return_mean": mean_ret,

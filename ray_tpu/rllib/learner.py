@@ -103,6 +103,19 @@ class Learner:
         import jax
         import jax.numpy as jnp
 
+        rows = len(next(iter(batch.values()))) if batch else 0
+        if rows == 0:
+            # Empty shard (the driver split fewer rows than learners):
+            # skip the jitted update — a zero-row batch crashes it — but
+            # when this learner replica syncs gradients over a collective
+            # group it MUST still join the allreduce with zero grads and
+            # apply the averaged update, or the peer ranks hang and the
+            # replicas drift apart.
+            if self._collective_group is not None and self._world_size > 1:
+                self._sync_and_apply(
+                    jax.tree.map(jnp.zeros_like, self.params), contributed=False
+                )
+            return {}
         if self.mesh is not None:
             # pad batch rows to a multiple of the mesh size
             n = len(jax.local_devices())
@@ -118,26 +131,7 @@ class Learner:
             self.params, self.opt_state, batch
         )
         if self._collective_group is not None and self._world_size > 1:
-            # Cross-actor gradient sync (the torch-DDP analogue): average
-            # grads over the host collective, then re-apply locally so all
-            # learner replicas stay bit-identical.
-            from ray_tpu import collective
-            from ray_tpu.collective.types import ReduceOp
-            import optax
-
-            flat, treedef = jax.tree.flatten(grads)
-            avg = []
-            for g in flat:
-                arr = np.asarray(g, dtype=np.float32) / self._world_size
-                arr = collective.allreduce(
-                    arr, group_name=self._collective_group, op=ReduceOp.SUM
-                )
-                avg.append(jnp.asarray(arr))
-            grads = jax.tree.unflatten(treedef, avg)
-            updates, self.opt_state = self.optimizer.update(
-                grads, self.opt_state, self.params
-            )
-            self.params = optax.apply_updates(self.params, updates)
+            self._sync_and_apply(grads)
         else:
             self.params, self.opt_state = new_params, new_opt
         out = {}
@@ -147,6 +141,41 @@ class Learner:
             # prioritized replay) pass through.
             out[k] = float(arr) if arr.ndim == 0 else arr
         return out
+
+    def _sync_and_apply(self, grads, contributed: bool = True):
+        """Cross-actor gradient sync (the torch-DDP analogue): average
+        grads over the host collective, then re-apply locally so all
+        learner replicas stay bit-identical. Every rank must call this
+        once per update — including empty-shard ranks, with zero grads
+        and ``contributed=False``. The mean divides by the number of
+        CONTRIBUTING ranks (allreduced alongside the grads), so empty
+        shards don't silently dilute the averaged gradient."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu import collective
+        from ray_tpu.collective.types import ReduceOp
+
+        k = collective.allreduce(
+            np.asarray([1.0 if contributed else 0.0], dtype=np.float32),
+            group_name=self._collective_group,
+            op=ReduceOp.SUM,
+        )
+        denom = max(1.0, float(k[0]))
+        flat, treedef = jax.tree.flatten(grads)
+        avg = []
+        for g in flat:
+            arr = np.asarray(g, dtype=np.float32) / denom
+            arr = collective.allreduce(
+                arr, group_name=self._collective_group, op=ReduceOp.SUM
+            )
+            avg.append(jnp.asarray(arr))
+        grads = jax.tree.unflatten(treedef, avg)
+        updates, self.opt_state = self.optimizer.update(
+            grads, self.opt_state, self.params
+        )
+        self.params = optax.apply_updates(self.params, updates)
 
     def get_weights(self) -> Params:
         return self.params
@@ -239,14 +268,17 @@ class LearnerGroup:
         shard = max(1, rows // n)
         refs = []
         for i, a in enumerate(self._actors):
-            lo = i * shard
-            hi = rows if i == n - 1 else (i + 1) * shard
+            lo = min(i * shard, rows)
+            hi = rows if i == n - 1 else min((i + 1) * shard, rows)
+            # rows < n leaves trailing actors with EMPTY slices; they are
+            # still called (every rank must join the gradient allreduce)
+            # but the Learner skips the jitted update for them.
             refs.append(
                 a.update_from_batch.remote({k: v[lo:hi] for k, v in batch.items()})
             )
-        all_metrics = ray_tpu.get(refs)
+        all_metrics = [m for m in ray_tpu.get(refs) if m]
         out = {}
-        for k in all_metrics[0]:
+        for k in all_metrics[0] if all_metrics else ():
             vals = [m[k] for m in all_metrics]
             if np.ndim(vals[0]) == 0:
                 out[k] = float(np.mean(vals))

@@ -1,0 +1,34 @@
+"""ray_tpu.rllib.podracer — Sebulba-style async actor–learner RL.
+
+Reference: "Podracer architectures for scalable Reinforcement Learning"
+(arXiv:2104.06272). The Sebulba shape on this framework: CPU env-runner
+actors continuously feed a bounded sample queue with trajectory-fragment
+refs; the learner pulls, recomputes target logps with one batched jitted
+forward, V-trace-corrects the off-policyness, runs the mesh-sharded
+update, and publishes versioned weights that runners pull asynchronously.
+
+Enable on any PPO/IMPALA config with::
+
+    PPOConfig().environment("CartPole-v1").podracer(num_async_runners=4)
+
+``num_async_runners=0`` (default) keeps the synchronous driver loop.
+"""
+from ray_tpu.rllib.podracer.config import PodracerConfig
+from ray_tpu.rllib.podracer.metrics import rl_metrics
+from ray_tpu.rllib.podracer.pipeline import PodracerPipeline, partition_stale
+from ray_tpu.rllib.podracer.runner import PodracerEnvRunner
+from ray_tpu.rllib.podracer.sample_queue import SampleQueue
+from ray_tpu.rllib.podracer.vtrace_builder import VtraceBatchBuilder
+from ray_tpu.rllib.podracer.weights import WeightBroadcast, stage_broadcast
+
+__all__ = [
+    "PodracerConfig",
+    "PodracerPipeline",
+    "PodracerEnvRunner",
+    "SampleQueue",
+    "WeightBroadcast",
+    "VtraceBatchBuilder",
+    "partition_stale",
+    "stage_broadcast",
+    "rl_metrics",
+]

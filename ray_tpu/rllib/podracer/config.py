@@ -1,0 +1,64 @@
+"""PodracerConfig: knobs for the async actor–learner pipeline.
+
+Built from an AlgorithmConfig (the fluent ``.podracer(...)`` section) by
+``Algorithm``; both PPO and IMPALA construct their pipeline from this one
+config object. ``num_async_runners=0`` means the podracer pipeline is off
+and the algorithm uses the synchronous driver loop (the seed behaviour).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass
+class PodracerConfig:
+    env_spec: Any = None
+    num_async_runners: int = 0
+    num_envs_per_runner: int = 1
+    rollout_fragment_length: int = 200
+    seed: int = 0
+    # Bounded sample queue between runners and the learner (fragments).
+    sample_queue_size: int = 16
+    # Staleness control: drop or V-trace-correct fragments whose behaviour
+    # policy is > max_policy_lag weight versions behind the learner.
+    max_policy_lag: int = 8
+    policy_lag_mode: str = "correct"  # "correct" | "drop"
+    # Publish a new weights version every N learner updates.
+    weights_publish_interval: int = 1
+    # Learner pull shape: up to max_pull fragments per queue poll, each
+    # poll blocking at most poll_timeout_s.
+    max_pull: int = 16
+    poll_timeout_s: float = 2.0
+    # Hard ceiling on one training_step's wait for env steps (runner
+    # restarts happen within it; only a fully wedged fleet trips it).
+    iteration_timeout_s: float = 300.0
+
+    def validate(self) -> "PodracerConfig":
+        if self.policy_lag_mode not in ("correct", "drop"):
+            raise ValueError(
+                "policy_lag_mode must be 'correct' or 'drop', got "
+                f"{self.policy_lag_mode!r}"
+            )
+        if self.num_async_runners < 0:
+            raise ValueError("num_async_runners must be >= 0")
+        if self.sample_queue_size < 1:
+            raise ValueError("sample_queue_size must be >= 1")
+        return self
+
+    @classmethod
+    def from_algorithm_config(cls, c) -> "PodracerConfig":
+        return cls(
+            env_spec=c.env_spec,
+            num_async_runners=c.num_async_runners,
+            num_envs_per_runner=c.num_envs_per_runner,
+            rollout_fragment_length=c.rollout_fragment_length,
+            seed=c.seed,
+            sample_queue_size=c.sample_queue_size,
+            max_policy_lag=c.max_policy_lag,
+            policy_lag_mode=c.policy_lag_mode,
+            weights_publish_interval=c.weights_publish_interval,
+            max_pull=c.podracer_max_pull,
+            poll_timeout_s=c.podracer_poll_timeout_s,
+            iteration_timeout_s=c.podracer_iteration_timeout_s,
+        ).validate()

@@ -1,0 +1,93 @@
+"""Podracer RL pipeline metrics.
+
+Reference: the Podracer paper's Sebulba diagnostics (actor/learner queue
+occupancy, policy staleness) mapped onto this repo's PR-1/PR-3 telemetry
+pipeline: Counter/Gauge/Histogram instances recorded in ANY process
+(sample-queue actor, env runners, the learner driver) flush to the
+controller automatically and surface in Prometheus/Grafana (the "RL"
+dashboard row) and ``state.summarize_rl()``.
+
+``counts`` is a plain process-local mirror of the counters for tests and
+bench.py: the metric registry drains *deltas* at flush time, so Metric
+internals cannot be read back reliably from the recording process.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_metrics = None
+
+_MS_BOUNDARIES = (
+    0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 15000,
+)
+# Policy lag is measured in weights VERSIONS (learner updates the runner's
+# policy is behind); small integer-ish boundaries.
+_LAG_BOUNDARIES = (0, 1, 2, 4, 8, 16, 32, 64)
+
+
+class _RLMetrics:
+    def __init__(self):
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        self.env_steps = Counter(
+            "rl_env_steps_total",
+            "Environment steps consumed by the learner (accepted fragments)",
+        )
+        self.fragments = Counter(
+            "rl_fragments_total",
+            "Trajectory fragments enqueued by env runners",
+        )
+        self.fragments_dropped = Counter(
+            "rl_fragments_dropped_total",
+            "Fragments dropped by the pipeline; reason is one of the "
+            "bounded vocabulary {capacity, stale, lost}",
+            ("reason",),
+        )
+        self.queue_depth = Gauge(
+            "rl_queue_depth",
+            "Fragments buffered in the sample queue between runners and "
+            "the learner",
+        )
+        self.queue_wait_ms = Histogram(
+            "rl_queue_wait_ms",
+            "Time a fragment spent in the sample queue before the learner "
+            "pulled it",
+            _MS_BOUNDARIES,
+        )
+        self.policy_lag = Histogram(
+            "rl_policy_lag",
+            "Weights-version lag of fragments at learner pull time "
+            "(current learner version minus the behaviour policy version)",
+            _LAG_BOUNDARIES,
+        )
+        self.learner_step_ms = Histogram(
+            "rl_learner_step_ms",
+            "Wall time of one learner cycle: V-trace batch build + the "
+            "jitted mesh update(s)",
+            _MS_BOUNDARIES,
+        )
+        self.weights_published = Counter(
+            "rl_weights_published_total",
+            "Versioned weight broadcasts published by the learner",
+        )
+        self.runner_restarts = Counter(
+            "rl_runner_restarts_total",
+            "Env-runner actors restarted after a crash mid-stream",
+        )
+        # Process-local, non-draining counters (tests/bench read these).
+        self.counts: Dict[str, float] = {}
+
+    def bump(self, key: str, n: float = 1):
+        with _lock:
+            self.counts[key] = self.counts.get(key, 0) + n
+
+
+def rl_metrics() -> _RLMetrics:
+    global _metrics
+    if _metrics is None:
+        with _lock:
+            if _metrics is None:
+                _metrics = _RLMetrics()
+    return _metrics

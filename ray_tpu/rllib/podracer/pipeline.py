@@ -1,0 +1,264 @@
+"""PodracerPipeline: the learner side of the Sebulba actor–learner split.
+
+Owns the bounded SampleQueue, the versioned WeightBroadcast, and the
+fault-tolerant fleet of PodracerEnvRunner actors running continuous
+``run_loop`` tasks. The algorithm's training step drives it:
+
+    episodes, steps = pipeline.pull_min(min_env_steps, deadline)
+    ... build V-trace batch, update learner ...
+    pipeline.publish(new_params)        # every publish_interval updates
+
+Staleness control: fragments are tagged with the behaviour policy's
+``weights_version``; at pull time ``max_policy_lag`` either DROPS
+over-stale fragments (``policy_lag_mode="drop"``) or keeps them and lets
+V-trace's rho/c truncation correct the off-policyness
+(``policy_lag_mode="correct"``, the IMPALA default).
+
+Crash tolerance: a runner dying mid-stream surfaces as its run_loop task
+ref completing with an error; the health check restarts the actor (fresh
+seed/worker_index, pulls current weights on its first poll) and relaunches
+the loop — the queue keeps flowing, matching ``actor_manager`` semantics.
+Restarts land in the control-plane lifecycle recorder (actor DEAD → new
+actor ALIVE) and in ``rl_runner_restarts_total``.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Tuple
+
+import ray_tpu
+from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.episodes import SingleAgentEpisode
+from ray_tpu.rllib.podracer.config import PodracerConfig
+from ray_tpu.rllib.podracer.metrics import rl_metrics
+from ray_tpu.rllib.podracer.runner import make_podracer_runner_cls
+from ray_tpu.rllib.podracer.sample_queue import SampleQueue
+from ray_tpu.rllib.podracer.weights import WeightBroadcast
+
+logger = logging.getLogger("ray_tpu.rllib")
+
+
+def partition_stale(
+    records: List[Dict[str, Any]],
+    current_version: int,
+    max_policy_lag: int,
+    mode: str = "correct",
+) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
+    """Split fragment records into (accepted, dropped_stale).
+
+    ``mode="correct"`` accepts everything — V-trace's importance-sampling
+    truncation corrects arbitrary off-policyness. ``mode="drop"`` rejects
+    fragments whose behaviour policy is more than ``max_policy_lag``
+    weight versions behind the learner. ``max_policy_lag < 0`` disables
+    the cut in either mode.
+    """
+    if mode not in ("correct", "drop"):
+        raise ValueError(f"policy_lag_mode must be 'correct' or 'drop', got {mode!r}")
+    if mode == "correct" or max_policy_lag < 0:
+        return list(records), []
+    accepted, stale = [], []
+    for rec in records:
+        lag = current_version - int(rec.get("weights_version", 0))
+        (stale if lag > max_policy_lag else accepted).append(rec)
+    return accepted, stale
+
+
+class PodracerPipeline:
+    def __init__(self, config: "PodracerConfig", module_spec):
+        self.cfg = config
+        self._queue = SampleQueue(capacity=config.sample_queue_size)
+        self._weights = WeightBroadcast()
+        runner_cls = make_podracer_runner_cls()
+
+        def make(i: int):
+            return runner_cls.remote(
+                config.env_spec,
+                module_spec,
+                num_envs=config.num_envs_per_runner,
+                seed=config.seed,
+                worker_index=i + 1,
+            )
+
+        self._manager = FaultTolerantActorManager(make, config.num_async_runners)
+        self._loop_refs: Dict[int, Any] = {}
+        self._returns: List[float] = []
+        self.stats: Dict[str, float] = {
+            "fragments_accepted": 0,
+            "fragments_dropped_stale": 0,
+            "fragments_lost": 0,
+            "env_steps_accepted": 0,
+            "env_steps_dropped": 0,
+            "runner_restarts": 0,
+            "queue_depth": 0,
+            "max_policy_lag_seen": 0,
+        }
+        self._started = False
+        self._last_health_check = 0.0
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self, params):
+        """Publish the initial weights (version 1) and launch every
+        runner's continuous sample loop."""
+        self.publish(params)
+        for i in self._manager.actors:
+            self._launch_loop(i)
+        self._started = True
+
+    def _launch_loop(self, idx: int):
+        actor = self._manager.actors[idx]
+        self._loop_refs[idx] = actor.run_loop.remote(
+            self._queue.actor,
+            self._weights.actor,
+            self.cfg.rollout_fragment_length,
+        )
+
+    @property
+    def version(self) -> int:
+        return self._weights.version
+
+    @property
+    def num_restarts(self) -> int:
+        return self._manager.num_restarts
+
+    @property
+    def manager(self) -> FaultTolerantActorManager:
+        return self._manager
+
+    def publish(self, params) -> int:
+        return self._weights.publish(params)
+
+    def check_runners(self):
+        """A healthy runner's run_loop ref stays in flight; one that
+        resolved means the loop exited — an error is a crash (restart +
+        relaunch), a clean return means it was stopped."""
+        refs = {ref: idx for idx, ref in self._loop_refs.items()}
+        if not refs:
+            return
+        done, _ = ray_tpu.wait(
+            list(refs), num_returns=len(refs), timeout=0
+        )
+        for ref in done:
+            idx = refs[ref]
+            try:
+                ray_tpu.get(ref)
+            except Exception as e:  # runner crashed mid-stream
+                logger.warning(
+                    "podracer runner %d crashed mid-stream (restarting): %s",
+                    idx, e,
+                )
+                self._manager.restart_actor(idx)
+                m = rl_metrics()
+                m.runner_restarts.inc()
+                m.bump("runner_restarts")
+                self.stats["runner_restarts"] += 1
+                self._launch_loop(idx)
+            else:
+                self._loop_refs.pop(idx, None)
+
+    # -- the learner-side pull --------------------------------------------
+    def pull_min(
+        self, min_env_steps: int, deadline: float
+    ) -> Tuple[List[SingleAgentEpisode], int]:
+        """Accumulate fragments until ``min_env_steps`` accepted env steps
+        (or ``deadline``); returns (episodes, accepted_env_steps)."""
+        m = rl_metrics()
+        cfg = self.cfg
+        episodes: List[SingleAgentEpisode] = []
+        steps = 0
+        while steps < min_env_steps and time.monotonic() < deadline:
+            timeout = min(cfg.poll_timeout_s,
+                          max(0.05, deadline - time.monotonic()))
+            records, info = self._queue.get_batch(
+                max_records=cfg.max_pull, timeout=timeout
+            )
+            self.stats["queue_depth"] = info.get("depth", 0)
+            # Health checks are an RPC: run one when the queue came up
+            # empty (the strongest crash signal) or at most ~1/s.
+            now = time.monotonic()
+            if not records or now - self._last_health_check > 1.0:
+                self._last_health_check = now
+                self.check_runners()
+            if not records:
+                continue
+            current = self.version
+            lags = [max(0, current - int(r.get("weights_version", 0)))
+                    for r in records]
+            m.policy_lag.observe_many(lags)
+            self.stats["max_policy_lag_seen"] = max(
+                self.stats["max_policy_lag_seen"], max(lags)
+            )
+            accepted, stale = partition_stale(
+                records, current, cfg.max_policy_lag, cfg.policy_lag_mode
+            )
+            for rec in stale:
+                m.fragments_dropped.inc(tags={"reason": "stale"})
+                m.bump("fragments_dropped_stale")
+                self.stats["fragments_dropped_stale"] += 1
+                self.stats["env_steps_dropped"] += rec.get("env_steps", 0)
+                # Episode returns are real even when the fragment is too
+                # stale to train on — keep the reward signal dense.
+                self._returns.extend(rec.get("returns", ()))
+            # One batched fetch for the whole pull; fall back to
+            # per-record fetches only to isolate a lost fragment.
+            fetched = None
+            if accepted:
+                try:
+                    fetched = ray_tpu.get(
+                        [rec["ref"] for rec in accepted], timeout=60
+                    )
+                except Exception:  # noqa: BLE001 — isolate the loss below
+                    fetched = None
+            for j, rec in enumerate(accepted):
+                if fetched is not None:
+                    eps = fetched[j]
+                else:
+                    try:
+                        eps = ray_tpu.get(rec["ref"], timeout=60)
+                    except Exception as e:  # producer died before we pulled
+                        logger.warning(
+                            "podracer fragment from runner %s lost: %s",
+                            rec.get("runner_index"), e,
+                        )
+                        m.fragments_dropped.inc(tags={"reason": "lost"})
+                        m.bump("fragments_lost")
+                        self.stats["fragments_lost"] += 1
+                        # The episode returns are queue metadata that
+                        # survived the producer — keep the reward signal
+                        # (same rationale as the stale-drop path).
+                        self._returns.extend(rec.get("returns", ()))
+                        continue
+                episodes.extend(eps)
+                steps += rec.get("env_steps", 0)
+                self.stats["fragments_accepted"] += 1
+                self._returns.extend(rec.get("returns", ()))
+        if steps:
+            m.env_steps.inc(steps)
+            m.bump("env_steps_accepted", steps)
+            self.stats["env_steps_accepted"] += steps
+        return episodes, steps
+
+    def pop_returns(self) -> List[float]:
+        out, self._returns = self._returns, []
+        return out
+
+    def shutdown(self):
+        for idx, actor in self._manager.actors.items():
+            try:
+                actor.stop_loop.remote()
+            except Exception as e:  # noqa: BLE001 — actor already dead
+                logger.debug("stop_loop on runner %d failed: %s", idx, e)
+        # Give loops one fragment boundary to exit cleanly, then kill.
+        refs = list(self._loop_refs.values())
+        if refs:
+            try:
+                ray_tpu.wait(refs, num_returns=len(refs), timeout=5)
+            except Exception as e:  # noqa: BLE001 — cluster tearing down
+                logger.debug("podracer loop drain failed: %s", e)
+        for idx, actor in self._manager.actors.items():
+            try:
+                ray_tpu.kill(actor)
+            except Exception as e:  # noqa: BLE001 — actor already dead
+                logger.debug("kill runner %d failed: %s", idx, e)
+        self._queue.shutdown()
+        self._weights.shutdown()

@@ -1,0 +1,72 @@
+"""PodracerEnvRunner: a SingleAgentEnvRunner driving itself.
+
+The Sebulba actor side (Podracer paper, arXiv:2104.06272): instead of the
+driver calling ``sample.remote()`` in lockstep, each runner executes ONE
+long-running ``run_loop`` task that continuously
+
+    poll weights -> sample a fragment -> put the fragment ref on the queue
+
+until told to stop. Weight pulls are asynchronous version polls against
+the broadcast store (never a barrier with the learner), and fragment
+payloads go to the object store — the queue actor only sees refs.
+
+The actor is created with ``max_concurrency > 1`` so ``stop_loop``/
+``ping`` calls land while ``run_loop`` occupies a thread.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import ray_tpu
+from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+
+
+class PodracerEnvRunner(SingleAgentEnvRunner):
+    def run_loop(
+        self,
+        queue_actor: Any,
+        weight_actor: Any,
+        fragment_len: int,
+        max_fragments: int = 0,
+    ) -> int:
+        """Sample fragments forever (or ``max_fragments``); returns the
+        fragment count when stopped. Raises through if the env or policy
+        dies — the pipeline's health check restarts the actor."""
+        self._stop_loop = False
+        fragments = 0
+        while not getattr(self, "_stop_loop", False):
+            version, refbox = ray_tpu.get(
+                weight_actor.poll.remote(self._weights_version)
+            )
+            if refbox is not None:
+                self.set_state(ray_tpu.get(refbox[0]), version)
+            episodes = self.sample(fragment_len)
+            record = {
+                "ref": ray_tpu.put(episodes),
+                "weights_version": self._weights_version,
+                "env_steps": sum(len(e) for e in episodes),
+                "runner_index": self.worker_index,
+                "returns": self.pop_metrics(),
+                "ts_sampled": time.time(),
+            }
+            ray_tpu.get(queue_actor.put.remote(record))
+            fragments += 1
+            if max_fragments and fragments >= max_fragments:
+                break
+        return fragments
+
+    def stop_loop(self) -> bool:
+        """Cooperative stop flag, checked at each fragment boundary."""
+        self._stop_loop = True
+        return True
+
+
+def make_podracer_runner_cls():
+    """Remote actor class for podracer runners: CPU actor, no automatic
+    restarts (the pipeline's FaultTolerantActorManager owns recovery so a
+    restarted runner is re-seeded AND its run_loop relaunched), thread
+    pool sized so control calls bypass the busy run_loop."""
+    return ray_tpu.remote(num_cpus=1, max_restarts=0, max_concurrency=4)(
+        PodracerEnvRunner
+    )

@@ -1,0 +1,138 @@
+"""Bounded sample queue between env-runner actors and the learner.
+
+Reference: the Sebulba actor–learner split of the Podracer paper
+(arXiv:2104.06272) and IMPALA's learner queues
+(rllib/algorithms/impala/impala.py:273 aggregation + queue plumbing).
+
+Runners ``put`` fragment RECORDS — small dicts whose trajectory payload is
+an object-store ref (``ray_tpu.put`` in the runner process), so the queue
+actor never holds episode data, only metadata:
+
+    {"ref": ObjectRef[List[SingleAgentEpisode]], "weights_version": int,
+     "env_steps": int, "runner_index": int, "returns": [float, ...]}
+
+Backpressure is drop-oldest: a full queue evicts the stalest fragment
+(the one whose behaviour policy is furthest behind) instead of blocking
+the producer — the Podracer shape where actors never stall on the
+learner. Depth, wait-time, and drop metrics ride the telemetry pipeline.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Tuple
+
+import ray_tpu
+from ray_tpu.rllib.podracer.metrics import rl_metrics
+
+
+class _SampleQueueActor:
+    """Queue state lives in one actor; methods run on the actor's thread
+    pool (max_concurrency > 1) so a learner blocked in ``get_batch`` can
+    never starve runner ``put``s."""
+
+    def __init__(self, capacity: int):
+        self._capacity = max(1, int(capacity))
+        self._dq: deque = deque()
+        self._cv = threading.Condition(threading.Lock())
+        self._put_total = 0
+        self._dropped_capacity = 0
+
+    def put(self, record: Dict[str, Any]) -> bool:
+        """Enqueue one fragment record; full queue drops the OLDEST
+        record. Returns False when this put caused a drop (backpressure
+        signal for the runner's own accounting)."""
+        m = rl_metrics()
+        dropped = False
+        record["ts_enqueue"] = time.time()
+        with self._cv:
+            if len(self._dq) >= self._capacity:
+                self._dq.popleft()
+                self._dropped_capacity += 1
+                dropped = True
+            self._dq.append(record)
+            self._put_total += 1
+            depth = len(self._dq)
+            self._cv.notify()
+        m.fragments.inc()
+        m.bump("fragments_put")
+        if dropped:
+            m.fragments_dropped.inc(tags={"reason": "capacity"})
+            m.bump("fragments_dropped_capacity")
+        m.queue_depth.set(depth)
+        return not dropped
+
+    def get_batch(
+        self, max_records: int, timeout: float
+    ) -> Tuple[List[Dict[str, Any]], Dict[str, Any]]:
+        """Dequeue up to ``max_records`` fragment records, blocking up to
+        ``timeout`` seconds for the first one. Returns (records, info);
+        each record gains ``queue_wait_ms``."""
+        m = rl_metrics()
+        deadline = time.monotonic() + max(0.0, timeout)
+        out: List[Dict[str, Any]] = []
+        with self._cv:
+            while not self._dq:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            now = time.time()
+            while self._dq and len(out) < max_records:
+                rec = self._dq.popleft()
+                rec["queue_wait_ms"] = (now - rec.pop("ts_enqueue", now)) * 1e3
+                out.append(rec)
+            info = self._info_locked()
+        if out:
+            m.queue_wait_ms.observe_many([r["queue_wait_ms"] for r in out])
+        m.queue_depth.set(info["depth"])
+        return out, info
+
+    def _info_locked(self) -> Dict[str, Any]:
+        return {
+            "depth": len(self._dq),
+            "capacity": self._capacity,
+            "put_total": self._put_total,
+            "dropped_capacity": self._dropped_capacity,
+        }
+
+    def info(self) -> Dict[str, Any]:
+        with self._cv:
+            return self._info_locked()
+
+    def ping(self) -> str:
+        return "pong"
+
+
+class SampleQueue:
+    """Client wrapper; pass ``.actor`` into runner actors freely."""
+
+    def __init__(self, capacity: int = 16):
+        cls = ray_tpu.remote(num_cpus=0, max_concurrency=8)(_SampleQueueActor)
+        self.actor = cls.remote(capacity)
+        ray_tpu.wait_actor_ready(self.actor)
+
+    def put(self, record: Dict[str, Any]) -> bool:
+        return ray_tpu.get(self.actor.put.remote(record))
+
+    def get_batch(
+        self, max_records: int = 8, timeout: float = 5.0
+    ) -> Tuple[List[Dict[str, Any]], Dict[str, Any]]:
+        return ray_tpu.get(
+            self.actor.get_batch.remote(max_records, timeout),
+            timeout=timeout + 30.0,
+        )
+
+    def info(self) -> Dict[str, Any]:
+        return ray_tpu.get(self.actor.info.remote())
+
+    def shutdown(self):
+        try:
+            ray_tpu.kill(self.actor)
+        except Exception as e:  # noqa: BLE001 — actor already dead at teardown
+            import logging
+
+            logging.getLogger("ray_tpu.rllib").debug(
+                "sample queue kill failed: %s", e
+            )

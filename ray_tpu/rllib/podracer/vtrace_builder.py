@@ -1,0 +1,124 @@
+"""Learner-side V-trace batch building with a batched, jitted recompute.
+
+Replaces ``IMPALA._episodes_to_vtrace_batch``'s per-episode UNJITTED
+module forwards on the driver: all episodes' (obs, actions) are
+concatenated into one flat array, padded up to a bounded shape bucket
+(powers of two — a handful of compiles total, never one per batch size),
+and pushed through ONE jitted ``logp_entropy`` forward. The cheap
+per-episode V-trace scans stay in numpy.
+
+The produced batch carries fields for BOTH loss families so PPO and
+IMPALA run on the same podracer pipeline:
+
+- IMPALA loss:  ``pg_advantages``, ``vtrace_targets``
+- PPO loss:     ``logp_old`` (behaviour), ``advantages`` (= pg_advantages,
+                optionally normalized), ``returns`` (= vtrace targets),
+                ``values_old`` (current-policy values)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.episodes import SingleAgentEpisode
+
+_MIN_BUCKET = 256
+
+
+def _bucket_rows(n: int) -> int:
+    """Next power-of-two bucket >= n (floored at _MIN_BUCKET): bounds the
+    set of shapes the jitted forward ever sees."""
+    b = _MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+class VtraceBatchBuilder:
+    """One jitted forward per module, reused across every batch build."""
+
+    def __init__(self, module):
+        import jax
+
+        self._module = module
+        self._fwd = jax.jit(module.logp_entropy)
+
+    def target_logps_values(self, params, obs: np.ndarray, actions: np.ndarray):
+        """Batched target-policy recompute: logp(a|s) and V(s) under the
+        CURRENT learner params for the whole concatenated batch."""
+        import jax.numpy as jnp
+
+        n = obs.shape[0]
+        bucket = _bucket_rows(n)
+        if bucket != n:
+            pad = bucket - n
+            obs = np.concatenate([obs, np.repeat(obs[-1:], pad, axis=0)])
+            actions = np.concatenate([actions, np.repeat(actions[-1:], pad)])
+        out = self._fwd(params, jnp.asarray(obs), jnp.asarray(actions))
+        return (
+            np.asarray(out["logp"], dtype=np.float32)[:n],
+            np.asarray(out["vf"], dtype=np.float32)[:n],
+        )
+
+    def build(
+        self,
+        params,
+        episodes: List[SingleAgentEpisode],
+        gamma: float = 0.99,
+        rho_bar: float = 1.0,
+        c_bar: float = 1.0,
+        normalize_advantages: bool = False,
+    ) -> Optional[Dict[str, np.ndarray]]:
+        """Episodes -> flat V-trace train batch (None when empty)."""
+        from ray_tpu.rllib.impala import vtrace_returns
+
+        episodes = [ep for ep in episodes if len(ep) > 0]
+        if not episodes:
+            return None
+        lengths = [len(ep) for ep in episodes]
+        obs = np.concatenate(
+            [np.asarray(ep.observations[: len(ep)], dtype=np.float32) for ep in episodes]
+        )
+        actions = np.concatenate(
+            [np.asarray(ep.actions, dtype=np.int32) for ep in episodes]
+        )
+        behaviour_logps = np.concatenate(
+            [np.asarray(ep.logps, dtype=np.float32) for ep in episodes]
+        )
+        target_logps, values = self.target_logps_values(params, obs, actions)
+        pg_l, vt_l = [], []
+        lo = 0
+        for ep, T in zip(episodes, lengths):
+            hi = lo + T
+            vs, pg_adv = vtrace_returns(
+                behaviour_logps[lo:hi],
+                target_logps[lo:hi],
+                np.asarray(ep.rewards, dtype=np.float32),
+                values[lo:hi],
+                ep.final_value,
+                ep.terminated,
+                gamma=gamma,
+                rho_bar=rho_bar,
+                c_bar=c_bar,
+            )
+            pg_l.append(pg_adv)
+            vt_l.append(vs)
+            lo = hi
+        pg_adv = np.concatenate(pg_l).astype(np.float32)
+        vtrace_targets = np.concatenate(vt_l).astype(np.float32)
+        advantages = pg_adv
+        if normalize_advantages:
+            advantages = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+        return {
+            "obs": obs,
+            "actions": actions,
+            # IMPALA fields
+            "pg_advantages": pg_adv,
+            "vtrace_targets": vtrace_targets,
+            # PPO fields (APPO-style surrogate on V-trace targets)
+            "logp_old": behaviour_logps,
+            "advantages": advantages,
+            "returns": vtrace_targets,
+            "values_old": values,
+        }

@@ -1,0 +1,103 @@
+"""Versioned weight broadcast: learner publishes, runners pull async.
+
+Replaces the synchronous ``EnvRunnerGroup.sync_weights`` barrier for the
+podracer pipeline: the learner ``publish``es each new weights version as
+ONE object-store ref held by a tiny store actor; env runners ``poll`` at
+fragment boundaries and pull the ref only when the version advanced — no
+learner-side blocking, no per-runner push fan-out.
+
+Cross-node, large weights are pre-staged onto every node over the
+controller's pipelined broadcast chain (``object_broadcast``, reference:
+push_manager.h) so N runners pulling the same version don't issue N
+competing point-to-point pulls from the learner's node. Staging is
+best-effort — a failure just means runners pull point-to-point.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.rllib.podracer.metrics import rl_metrics
+
+logger = logging.getLogger("ray_tpu.rllib")
+
+
+def stage_broadcast(ref) -> bool:
+    """Best-effort pre-staging of ``ref`` onto every alive non-head node
+    (no-op on single-node clusters / inline-small objects)."""
+    try:
+        core = ray_tpu.core.api._require_worker()
+        nodes = {
+            n["node_id"]
+            for n in ray_tpu.nodes()
+            if n["state"] == "ALIVE" and not n["is_head"]
+        }
+        if nodes:
+            core._call("object_broadcast", ref.id, None, timeout=300)
+        return True
+    except Exception as e:  # noqa: BLE001 — staging is best-effort
+        logger.warning(
+            "weight broadcast staging failed (workers will pull "
+            "point-to-point): %s", e,
+        )
+        return False
+
+
+class _WeightStoreActor:
+    """Holds the newest (version, weights-ref) pair.
+
+    The ref travels BOXED in a 1-element list both ways: a top-level
+    ObjectRef argument is auto-resolved to its value by the task layer
+    (the ``set_state(ref)`` convenience), but the store must hold the ref
+    itself — runners decide when to pull.
+    """
+
+    def __init__(self):
+        self._version = 0
+        self._refbox = None
+
+    def publish(self, refbox, version: int):
+        # Monotonic: a late/duplicate publish of an older version must
+        # never roll runners back.
+        if version > self._version:
+            self._version = version
+            self._refbox = refbox
+        return self._version
+
+    def poll(self, have_version: int) -> Tuple[int, Optional[Any]]:
+        """(version, [ref]) when newer weights exist, else (version, None)."""
+        if self._refbox is not None and self._version > have_version:
+            return self._version, self._refbox
+        return self._version, None
+
+    def ping(self) -> str:
+        return "pong"
+
+
+class WeightBroadcast:
+    """Learner-side publisher; pass ``.actor`` into runner actors."""
+
+    def __init__(self):
+        cls = ray_tpu.remote(num_cpus=0, max_concurrency=4)(_WeightStoreActor)
+        self.actor = cls.remote()
+        ray_tpu.wait_actor_ready(self.actor)
+        self.version = 0
+
+    def publish(self, params) -> int:
+        """Put ``params`` once, stage it cross-node, and advance the
+        published version. Returns the new version."""
+        self.version += 1
+        ref = ray_tpu.put(params)
+        stage_broadcast(ref)
+        ray_tpu.get(self.actor.publish.remote([ref], self.version))
+        m = rl_metrics()
+        m.weights_published.inc()
+        m.bump("weights_published")
+        return self.version
+
+    def shutdown(self):
+        try:
+            ray_tpu.kill(self.actor)
+        except Exception as e:  # noqa: BLE001 — actor already dead at teardown
+            logger.debug("weight store kill failed: %s", e)

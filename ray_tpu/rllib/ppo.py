@@ -66,6 +66,7 @@ class PPOConfig(AlgorithmConfig):
 
 class PPO(Algorithm):
     loss_fn = staticmethod(ppo_loss)
+    supports_podracer = True
 
     def _loss_cfg(self) -> dict:
         c = self.config
@@ -76,14 +77,23 @@ class PPO(Algorithm):
             entropy_coeff=c.entropy_coeff,
         )
 
-    def training_step(self) -> Dict[str, Any]:
+    # -- podracer (Sebulba async) overrides -------------------------------
+    def _podracer_builder_kwargs(self) -> dict:
+        kw = super()._podracer_builder_kwargs()
+        kw["normalize_advantages"] = True
+        return kw
+
+    def _podracer_min_batch_env_steps(self) -> int:
+        # PPO keeps its epoch semantics: one full train batch per cycle.
+        return self.config.train_batch_size
+
+    def _minibatch_epochs(self, batch) -> Dict[str, float]:
+        """The PPO learner cycle (reference: learner minibatch cycle):
+        ``num_epochs`` seeded-permutation passes of ``minibatch_size``
+        updates with the KL early-stop. Shared by the synchronous loop
+        (GAE batches) and the podracer path (V-trace batches, IMPACT-style
+        surrogate against the BEHAVIOUR logp)."""
         cfg = self.config
-        # 1. sample (reference: ppo.py:418 synchronous_parallel_sample)
-        episodes = self.env_runner_group.sample(cfg.train_batch_size)
-        env_steps = sum(len(e) for e in episodes)
-        self._total_env_steps += env_steps
-        batch = episodes_to_batch(episodes, gamma=cfg.gamma, lam=cfg.lam)
-        # 2. minibatch-epoch updates (reference: learner minibatch cycle)
         rows = len(batch["obs"])
         rng = np.random.default_rng(cfg.seed + self.iteration)
         metrics: Dict[str, float] = {}
@@ -93,14 +103,27 @@ class PPO(Algorithm):
                 idx = order[lo : lo + cfg.minibatch_size]
                 mb = {k: v[idx] for k, v in batch.items()}
                 metrics = self.learner_group.update_from_batch(mb)
-            if metrics.get("approx_kl", 0.0) > 1.5 * self.config.kl_target:
+            if metrics.get("approx_kl", 0.0) > 1.5 * cfg.kl_target:
                 break  # KL early-stop (reference: ppo kl coeff logic)
+        return metrics
+
+    _podracer_update_fn = _minibatch_epochs
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        if self._podracer is not None:
+            return self._podracer_training_step()
+        # 1. sample (reference: ppo.py:418 synchronous_parallel_sample)
+        episodes = self.env_runner_group.sample(cfg.train_batch_size)
+        env_steps = sum(len(e) for e in episodes)
+        self._total_env_steps += env_steps
+        batch = episodes_to_batch(episodes, gamma=cfg.gamma, lam=cfg.lam)
+        # 2. minibatch-epoch updates
+        metrics = self._minibatch_epochs(batch)
         # 3. sync weights to runners (reference: ppo.py:500)
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
         returns = self.env_runner_group.pop_metrics()
-        if returns:
-            self._recent_returns = (getattr(self, "_recent_returns", []) + returns)[-100:]
-        mean_ret = float(np.mean(self._recent_returns)) if getattr(self, "_recent_returns", None) else 0.0
+        mean_ret = self._record_returns(returns)
         return {
             "env_steps_this_iter": env_steps,
             "episode_return_mean": mean_ret,

@@ -288,6 +288,7 @@ def cmd_summary(args):
         "actors": state.summarize_actors,
         "objects": state.summarize_objects,
         "lifecycle": state.summarize_lifecycle,
+        "rl": state.summarize_rl,
     }[args.what]
     print(json.dumps(fn(), indent=2))
     return 0
@@ -545,7 +546,7 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_job)
 
     sp = sub.add_parser("summary", help="state summaries")
-    sp.add_argument("what", choices=["tasks", "actors", "objects", "lifecycle"])
+    sp.add_argument("what", choices=["tasks", "actors", "objects", "lifecycle", "rl"])
     sp.set_defaults(fn=cmd_summary)
 
     sp = sub.add_parser(

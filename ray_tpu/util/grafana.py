@@ -63,6 +63,7 @@ ROWS = (
                    "serve_batch_")),
     ("Serve Engine", ("serve_engine_",)),
     ("Train", ("train_",)),
+    ("RL", ("rl_",)),
     ("Data", ("data_",)),
     ("Control Plane", ("task_state_", "task_pending_", "lease_",
                        "lockwatch_")),

@@ -241,6 +241,83 @@ def summarize_serve() -> dict:
     return out
 
 
+def _hist_rollup(entry: Optional[dict]) -> dict:
+    """Merge a controller histogram entry's series and derive count/mean
+    plus bucket-resolution p50/p95/p99 (each quantile reported as the
+    upper boundary of the bucket its rank lands in; the overflow bucket
+    reports the last boundary)."""
+    if not entry:
+        return {}
+    merged = None
+    boundaries: List[float] = []
+    for _tags, payload in entry.get("series", []):
+        st = payload.get("state", [])
+        boundaries = payload.get("boundaries", boundaries)
+        merged = st if merged is None else [a + b for a, b in zip(merged, st)]
+    if not merged:
+        return {}
+    buckets, total, count = merged[:-2], merged[-2], merged[-1]
+    if count <= 0:
+        return {"count": 0}
+
+    def pct(q: float) -> float:
+        rank = q * count
+        cum = 0
+        for i, c in enumerate(buckets):
+            cum += c
+            if cum >= rank:
+                return boundaries[i] if i < len(boundaries) else boundaries[-1]
+        return boundaries[-1]
+
+    return {
+        "count": int(count),
+        "mean": round(total / count, 3),
+        "p50": pct(0.5),
+        "p95": pct(0.95),
+        "p99": pct(0.99),
+    }
+
+
+def summarize_rl() -> dict:
+    """Podracer RL pipeline rollup from the controller's metric snapshot
+    (ray_tpu.rllib.podracer): env-step throughput, sample-queue health
+    (depth/wait/drops), policy-staleness distribution, learner step time,
+    weight-broadcast and runner-restart counts. All series aggregate
+    cluster-wide — queue actors, env runners, and the learner driver all
+    flush into the same pipeline."""
+    snap = metrics_snapshot()
+
+    def counter(name: str) -> float:
+        return sum(v for _t, v in (snap.get(name) or {}).get("series", []))
+
+    def counter_by(name: str, tag: str) -> dict:
+        out: dict = {}
+        for tags, v in (snap.get(name) or {}).get("series", []):
+            key = dict(tuple(t) for t in tags).get(tag, "")
+            out[key] = out.get(key, 0.0) + v
+        return out
+
+    def gauge(name: str) -> float:
+        vals = [v for _t, v in (snap.get(name) or {}).get("series", [])]
+        return vals[-1] if vals else 0.0
+
+    return {
+        "env_steps_total": counter("rl_env_steps_total"),
+        "fragments": {
+            "enqueued": counter("rl_fragments_total"),
+            "dropped": counter_by("rl_fragments_dropped_total", "reason"),
+        },
+        "queue": {
+            "depth": gauge("rl_queue_depth"),
+            "wait_ms": _hist_rollup(snap.get("rl_queue_wait_ms")),
+        },
+        "policy_lag": _hist_rollup(snap.get("rl_policy_lag")),
+        "learner_step_ms": _hist_rollup(snap.get("rl_learner_step_ms")),
+        "weights_published": counter("rl_weights_published_total"),
+        "runner_restarts": counter("rl_runner_restarts_total"),
+    }
+
+
 def summarize_data() -> list:
     """Per-operator stats of this process's most recent Dataset execution
     (reference: the dashboard data module's per-op metrics)."""
