@@ -159,6 +159,26 @@ class Config:
     # Per-(kind, state) dwell sample ring bound (percentile source).
     lifecycle_dwell_samples: int = 4096
 
+    # --- profiling (util/profiling.py) ---
+    # Default sample rate for on-demand `ray-tpu profile cpu` runs.
+    profiling_sample_hz: int = 100
+    # Continuous low-rate background sampler feeding the incident ring
+    # (0 = off, the default; ~5-20 Hz keeps overhead well under the 3%
+    # budget measured by bench.py profiling_overhead_pct).
+    profiling_continuous_hz: float = 0.0
+    # How many seconds of recent samples the incident ring retains.
+    profiling_ring_s: float = 60.0
+    # Incident auto-capture master switch: detector hooks (lockwatch
+    # long-hold/cycle, recompile storms, SLO breaches) flush capture
+    # bundles under <session>/incidents/.
+    profiling_incidents: bool = True
+    # Newest N incident bundles kept on disk (oldest pruned at write).
+    profiling_incident_keep: int = 20
+    # Per-trigger rate limit between captures in one process.
+    profiling_incident_min_interval_s: float = 30.0
+    # Serve TTFT SLO-breach capture threshold in ms (0 = disabled).
+    profiling_slo_ttft_ms: float = 0.0
+
     # --- fault injection (tests only; reference:
     # python/ray/tests/chaos/chaos_network_delay.yaml injects network
     # latency with k8s traffic shaping — here the agents' chunk server

@@ -113,6 +113,11 @@ def init(
     from ray_tpu.core.node_telemetry import start_process_telemetry
 
     start_process_telemetry(_global_worker)
+    # Continuous low-rate CPU sampling for incident auto-capture (no-op
+    # unless profiling_continuous_hz is configured).
+    from ray_tpu.util import profiling
+
+    profiling.ensure_continuous()
     atexit.register(shutdown)
     return {"address": address, "session_dir": _global_worker.session_dir}
 

@@ -787,6 +787,26 @@ class _NullHandler:
     def on_disconnect(self, peer):
         pass
 
+    # Every CoreWorker-embedded process answers the profiling fan-out —
+    # drivers AND handler-less admin connections (cluster_utils,
+    # autoscaler monitor): a wedged driver (deadlocked ray_tpu.get,
+    # stuck user loop) is exactly what `ray-tpu profile stacks` exists
+    # to see.
+    def rpc_stack_dump(self, peer):
+        from ray_tpu.utils.stack_dump import dump_all_threads
+
+        return dump_all_threads()
+
+    def rpc_dump_stacks(self, peer):
+        from ray_tpu.util import profiling
+
+        return profiling.dump_stacks()
+
+    def rpc_profile_cpu(self, peer, duration_s: float = 10.0, hz: float = 100.0):
+        from ray_tpu.util import profiling
+
+        return profiling.sample_async(duration_s, hz)
+
 
 class DriverHandler(_NullHandler):
     """Driver-side handlers for controller pushes (reference: the driver

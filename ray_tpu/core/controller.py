@@ -2140,6 +2140,181 @@ class Controller:
         await asyncio.gather(*calls)
         return out
 
+    # =================================================================
+    # On-demand distributed profiling (util/profiling.py; reference: the
+    # dashboard reporter's per-worker py-spy stack/CPU-profile endpoints)
+    # =================================================================
+    def _profile_targets(self, node: Optional[str] = None,
+                         actor: Optional[str] = None,
+                         workers: Optional[List[str]] = None):
+        """(name, peer) fan-out targets, filterable to one node's
+        processes, one actor's worker, or an explicit worker-id list.
+        Unfiltered = every live process: workers, agents, drivers (the
+        controller profiles itself in-process, not through a peer)."""
+        actor_wids = None
+        if actor:
+            actor_wids = {
+                a.worker_id
+                for a in self.actors.values()
+                if a.worker_id is not None and a.actor_id.hex().startswith(actor)
+            }
+        out = []
+        for w in self.workers.values():
+            if w.state == "DEAD" or w.peer.closed:
+                continue
+            if node and not w.node_id.hex().startswith(node):
+                continue
+            if actor_wids is not None and w.worker_id not in actor_wids:
+                continue
+            if workers and not any(
+                w.worker_id.hex().startswith(p) for p in workers
+            ):
+                continue
+            out.append((f"worker:{w.worker_id.hex()[:8]}:pid{w.pid}", w.peer))
+        if actor_wids is None and not workers:
+            for n in self.nodes.values():
+                if n.peer is None or n.peer.closed:
+                    continue
+                if node and not n.node_id.hex().startswith(node):
+                    continue
+                out.append((f"agent:{n.node_id.hex()[:8]}", n.peer))
+            if not node:
+                for i, d in enumerate(sorted(self.drivers, key=id)):
+                    if not d.closed:
+                        out.append((f"driver:{i}", d))
+        return out
+
+    def _include_self(self, node: Optional[str], actor: Optional[str],
+                      workers: Optional[List[str]]) -> bool:
+        if actor or workers:
+            return False
+        return not node or self.head_node_id.hex().startswith(node)
+
+    async def rpc_profile_stacks(self, peer: rpc.Peer,
+                                 node: Optional[str] = None,
+                                 actor: Optional[str] = None,
+                                 timeout_s: float = 10.0):
+        """Cluster-wide structured stack dump: controller + agents +
+        workers + drivers, merged and deduplicated. The controller's own
+        leg is a lock-free snapshot (``profiling.dump_stacks`` touches no
+        controller state), so dumping mid-scheduling-storm — or mid-
+        deadlock — always returns."""
+        from ray_tpu.util import profiling
+
+        procs: Dict[str, Any] = {}
+        if self._include_self(node, actor, None):
+            procs["controller"] = profiling.dump_stacks()
+
+        async def ask(name: str, p: rpc.Peer):
+            try:
+                procs[name] = await asyncio.wait_for(
+                    p.call("dump_stacks"), timeout_s
+                )
+            except Exception as e:  # noqa: BLE001 — wedged/gone process
+                procs[name] = f"<unavailable: {e}>"
+
+        await asyncio.gather(
+            *(ask(name, p) for name, p in self._profile_targets(node, actor))
+        )
+        return {"procs": procs, "merged": profiling.merge_stack_dumps(procs)}
+
+    async def rpc_profile_cpu_all(self, peer: rpc.Peer,
+                                  duration_s: float = 10.0,
+                                  hz: Optional[float] = None,
+                                  node: Optional[str] = None,
+                                  workers: Optional[List[str]] = None):
+        """Fan out the sampling CPU profiler: every target profiles
+        itself concurrently for ``duration_s`` (samplers run on their own
+        threads; nobody's control plane blocks), results merge into
+        cluster-wide collapsed stacks + per-task CPU attribution."""
+        from ray_tpu.util import profiling
+
+        if hz is None:
+            hz = float(self.config.profiling_sample_hz)
+        duration_s = max(0.05, min(float(duration_s), 600.0))
+        results: Dict[str, Any] = {}
+
+        async def ask(name: str, p: rpc.Peer):
+            try:
+                results[name] = await asyncio.wait_for(
+                    p.call("profile_cpu", duration_s, hz), duration_s + 15.0
+                )
+            except Exception as e:  # noqa: BLE001 — wedged/gone process
+                results[name] = f"<unavailable: {e}>"
+
+        legs = [
+            ask(name, p)
+            for name, p in self._profile_targets(node, None, workers)
+        ]
+        if self._include_self(node, None, workers):
+
+            async def self_leg():
+                results["controller"] = await profiling.sample_async(
+                    duration_s, hz
+                )
+
+            legs.append(self_leg())
+        await asyncio.gather(*legs)
+        merged = profiling.merge_cpu_results(results)
+        merged["hz"] = hz
+        merged["duration_s"] = duration_s
+        merged["ms_per_sample"] = 1000.0 / hz
+        return merged
+
+    async def rpc_profile_device_all(self, peer: rpc.Peer,
+                                     workers: Optional[List[str]] = None,
+                                     duration_s: float = 5.0,
+                                     capture: Optional[str] = None):
+        """Attach jax.profiler traces to already-running workers for
+        ``duration_s`` (start → sleep → stop over their live RPC
+        channels — no restart). Captures land in each worker's session
+        ``profiles/`` root, listed by ``ray-tpu profile captures``."""
+        capture = capture or f"ondemand-{int(time.time())}"
+        duration_s = max(0.1, min(float(duration_s), 600.0))
+        targets = [
+            (name, p)
+            for name, p in self._profile_targets(None, None, workers)
+            if name.startswith("worker:")
+        ]
+        out: Dict[str, dict] = {}
+
+        async def control(name: str, p: rpc.Peer, action: str):
+            try:
+                return await asyncio.wait_for(
+                    p.call("profile_device", action, capture), 15.0
+                )
+            except Exception as e:  # noqa: BLE001 — wedged/gone worker
+                return {"ok": False, "error": str(e)}
+
+        starts = await asyncio.gather(
+            *(control(name, p, "start") for name, p in targets)
+        )
+        started = []
+        for (name, p), res in zip(targets, starts):
+            out[name] = res
+            if res.get("ok"):
+                started.append((name, p))
+        if started:
+            await asyncio.sleep(duration_s)
+            stops = await asyncio.gather(
+                *(control(name, p, "stop") for name, p in started)
+            )
+            for (name, _p), res in zip(started, stops):
+                out[name] = res
+        return {"capture": capture, "duration_s": duration_s, "workers": out}
+
+    async def rpc_profile_incidents(self, peer: rpc.Peer, limit: int = 100):
+        """Incident capture bundles under this session (auto-written by
+        the lockwatch/recompile-storm/SLO detectors)."""
+        from ray_tpu.util import profiling
+
+        return profiling.list_incidents(self.session_dir)[-max(1, limit):]
+
+    async def rpc_get_incident(self, peer: rpc.Peer, incident_id: str):
+        from ray_tpu.util import profiling
+
+        return profiling.get_incident(incident_id, self.session_dir)
+
     def _drain_spawn_events(self):
         """Fold worker SPAWNED events recorded by in-process spawns (the
         controller doubles as the head's agent) into the flight recorder.
@@ -3106,6 +3281,16 @@ class Controller:
         # other hosts must reach the control plane).
         server, self.port = await rpc.serve(self, host=bind_host(), port=port)
         self._loop = asyncio.get_running_loop()
+        # Profiling: continuous incident sampler (off unless configured)
+        # + flight-recorder tail so controller incident bundles carry the
+        # scheduler context alongside stacks/samples.
+        from ray_tpu.util import profiling
+
+        profiling.ensure_continuous(
+            hz=self.config.profiling_continuous_hz,
+            ring_s=self.config.profiling_ring_s,
+        )
+        profiling.set_recorder_tail_provider(lambda: self.lifecycle.tail(500))
         self._log_tailer = None
         if self.config.log_to_driver:
             from ray_tpu.core.log_monitor import LogTailer

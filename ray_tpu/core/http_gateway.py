@@ -13,6 +13,9 @@ Routes:
                             summarize_lifecycle|summarize_tasks|
                             lifecycle_events|compile
   GET /api/serve/engine     serve LLM-engine flight-recorder snapshots
+  GET /api/v0/profile/stacks[?node=&actor=]   cluster-wide stack dump
+  GET /api/v0/profile/cpu[?duration=&hz=&node=]  sampling CPU profile
+  GET /api/v0/profile/incidents[/<id>]        incident capture bundles
   GET /healthz              liveness probe
   Job submission REST (reference: dashboard/modules/job/job_head.py):
   POST /api/jobs/           {entrypoint, submission_id?, runtime_env?,
@@ -48,9 +51,9 @@ _STATE_ROUTES = {
 
 
 def start_http_gateway(controller, loop: asyncio.AbstractEventLoop, port: int) -> int:
-    def call(method_name, **kwargs):
+    def call(method_name, _timeout: float = 10, **kwargs):
         coro = getattr(controller, method_name)(None, **kwargs)
-        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=10)
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(timeout=_timeout)
 
     job_lock = threading.Lock()
 
@@ -166,6 +169,43 @@ def start_http_gateway(controller, loop: asyncio.AbstractEventLoop, port: int) -
                         for k, v in snap.items()
                     }
                     self._send(200, prometheus_text(snap).encode(), "text/plain; version=0.0.4")
+                elif path.startswith("/api/v0/profile"):
+                    # On-demand profiling routes (each handler runs on a
+                    # gateway thread; only /cpu blocks, for its duration).
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+
+                    def qget(key, cast, default):
+                        return cast(q[key][0]) if q.get(key) else default
+
+                    sub = path[len("/api/v0/profile"):].strip("/")
+                    if sub == "stacks":
+                        self._json(call(
+                            "rpc_profile_stacks",
+                            node=qget("node", str, None),
+                            actor=qget("actor", str, None),
+                            _timeout=30,
+                        ))
+                    elif sub == "cpu":
+                        duration = qget("duration", float, 5.0)
+                        self._json(call(
+                            "rpc_profile_cpu_all",
+                            duration_s=duration,
+                            hz=qget("hz", float, None),
+                            node=qget("node", str, None),
+                            _timeout=duration + 30,
+                        ))
+                    elif sub == "incidents":
+                        self._json(call("rpc_profile_incidents"))
+                    elif sub.startswith("incidents/"):
+                        iid = sub[len("incidents/"):]
+                        try:
+                            self._json(call("rpc_get_incident", incident_id=iid))
+                        except FileNotFoundError:
+                            self._json({"error": f"no incident {iid}"}, 404)
+                    else:
+                        self._json({"error": "unknown profile route"}, 404)
                 elif path.startswith("/api/v0/"):
                     what = path[len("/api/v0/") :]
                     method = _STATE_ROUTES.get(what)

@@ -549,6 +549,16 @@ class NodeAgent:
 
         return dump_all_threads()
 
+    def rpc_dump_stacks(self, peer):
+        from ray_tpu.util import profiling
+
+        return profiling.dump_stacks()
+
+    def rpc_profile_cpu(self, peer, duration_s: float = 10.0, hz: float = 100.0):
+        from ray_tpu.util import profiling
+
+        return profiling.sample_async(duration_s, hz)
+
     def on_disconnect(self, peer):
         wid = peer.meta.get("direct_wid")
         if wid is not None:
@@ -602,6 +612,12 @@ class NodeAgent:
         cfg = (info or {}).get("config") or {}
         self._chunk_bytes = int(cfg.get("object_transfer_chunk_bytes", config))
         self._config = cfg
+        from ray_tpu.util import profiling
+
+        profiling.ensure_continuous(
+            hz=float(cfg.get("profiling_continuous_hz", 0.0)),
+            ring_s=float(cfg.get("profiling_ring_s", 60.0)),
+        )
         monitor_task = asyncio.get_running_loop().create_task(
             self._memory_monitor_loop()
         )

@@ -158,6 +158,31 @@ class WorkerHandler:
 
         return dump_all_threads()
 
+    def rpc_dump_stacks(self, peer):
+        """Structured stack dump: thread names + frames + current-task
+        attribution + lockwatch held-lock annotations (the `ray-tpu
+        profile stacks` fan-out leg)."""
+        from ray_tpu.util import profiling
+
+        return profiling.dump_stacks()
+
+    def rpc_profile_cpu(self, peer, duration_s: float = 10.0, hz: float = 100.0):
+        """Sampling CPU profile of this worker for ``duration_s``. The
+        sampler runs on its own thread; the returned coroutine just
+        sleeps, so the worker's control plane stays live."""
+        from ray_tpu.util import profiling
+
+        return profiling.sample_async(duration_s, hz)
+
+    def rpc_profile_device(self, peer, action: str, capture: str = "",
+                           base_dir=None):
+        """Attach/detach a jax.profiler trace on this live worker (no
+        restart). Returns {ok, dir?, error?}; gracefully degrades when
+        jax or the backend profiler is unavailable."""
+        from ray_tpu.util import profiling
+
+        return profiling.device_trace_control(action, capture, base_dir)
+
     def rpc_pubsub_msg(self, peer, channel: str, message):
         from ray_tpu.experimental.pubsub import _deliver
 
@@ -273,8 +298,10 @@ class TaskExecutor:
             if kind == "actor_create" and not self._actor_ready:
                 self._flush_pending_actor_tasks()
             from ray_tpu import runtime_context
+            from ray_tpu.util import profiling
 
             runtime_context._set_task(None, None)
+            profiling.set_thread_task(None)
 
     def _reply(self, reply, payload):
         """Batched exec-thread → loop handoff for completed replies."""
@@ -325,10 +352,15 @@ class TaskExecutor:
                 self._report(spec, None, err)
             return
         from ray_tpu import runtime_context
+        from ray_tpu.util import profiling
 
         runtime_context._set_task(
             spec.task_id.hex(), spec.actor_id.hex() if spec.actor_id else None
         )
+        # CPU-sample attribution: the profiler tags this thread's samples
+        # with the executing task/actor-method name (cleared in finally;
+        # spec.name already carries "actor.<method>" for actor tasks).
+        profiling.set_thread_task(spec.name)
         if reply is not None:
             # Direct pushes bypass the controller, so the worker emits the
             # RUNNING half of the task's timeline span itself (FINISHED
@@ -668,6 +700,11 @@ def main():
     from ray_tpu.core.node_telemetry import start_process_telemetry
 
     start_process_telemetry(core)
+    # Continuous low-rate CPU sampling for incident auto-capture (off
+    # unless profiling_continuous_hz is configured).
+    from ray_tpu.util import profiling
+
+    profiling.ensure_continuous()
     agent_addr = os.environ.get("RAY_TPU_AGENT_ADDR", "")
     if agent_addr:
         # Direct-pool worker spawned by a node agent: announce to the

@@ -14,6 +14,7 @@ Usage:
   ray-tpu job list | job logs ID | job stop ID
   ray-tpu summary tasks|actors|objects
   ray-tpu timeline [--output FILE]
+  ray-tpu profile stacks|cpu|device|incidents|captures [...]
   ray-tpu memory
   ray-tpu logs [FILENAME]
   ray-tpu microbenchmark
@@ -289,6 +290,7 @@ def cmd_summary(args):
         "objects": state.summarize_objects,
         "lifecycle": state.summarize_lifecycle,
         "rl": state.summarize_rl,
+        "profiling": state.summarize_profiling,
     }[args.what]
     print(json.dumps(fn(), indent=2))
     return 0
@@ -303,6 +305,7 @@ def cmd_timeline(args):
         out,
         include_lifecycle=not args.no_lifecycle,
         include_spans=not args.no_spans,
+        include_device=not args.no_device,
     )
     by_cat = {}
     for ev in trace:
@@ -373,25 +376,212 @@ def cmd_metrics(args):
     return 0
 
 
-def cmd_profile(args):
-    """List/fetch jax.profiler captures (reference: nsight runtime-env
-    plugin reports; capture with runtime_env={"jax_profiler": True})."""
+_PROFILE_ACTIONS = ("stacks", "cpu", "device", "incidents", "captures")
+
+
+def _profile_stacks_fixture() -> dict:
+    """Canned fan-out dumps for `profile stacks --offline`: exercises the
+    merge/dedup/held-lock rendering with no cluster (the tier-1 smoke
+    that keeps the report from rotting)."""
+    idle = [
+        {"file": "/usr/lib/python3.10/threading.py", "line": 324,
+         "func": "wait"},
+    ]
+    busy = [
+        {"file": "/app/train.py", "line": 91, "func": "train_loop"},
+        {"file": "/app/train.py", "line": 44, "func": "loss_fn"},
+    ]
+
+    def dump(proc, pid, threads):
+        return {"process": proc, "pid": pid, "ts": 0.0, "threads": threads}
+
+    return {
+        "controller": dump("controller", 100, [
+            {"ident": 1, "name": "MainThread", "daemon": False, "task": None,
+             "idle": True, "frames": idle, "held_locks": []},
+        ]),
+        "worker:aaaa0000:pid201": dump("worker-aaaa0000", 201, [
+            {"ident": 2, "name": "task-exec", "daemon": True,
+             "task": "train_loop", "idle": False, "frames": busy,
+             "held_locks": [{"lock": "Lock@train.py:12",
+                             "acquired_at": "train.py:90",
+                             "held_ms": 1503.2}]},
+            {"ident": 3, "name": "metrics-flush", "daemon": True,
+             "task": None, "idle": True, "frames": idle, "held_locks": []},
+        ]),
+        "worker:bbbb0000:pid202": dump("worker-bbbb0000", 202, [
+            {"ident": 2, "name": "task-exec", "daemon": True, "task": None,
+             "idle": True, "frames": idle, "held_locks": []},
+        ]),
+        "agent:cccc0000": "<unavailable: timed out>",
+    }
+
+
+def _profile_cpu_fixture() -> dict:
+    from ray_tpu.util import profiling
+
+    results = {
+        "worker:aaaa0000:pid201": {
+            "samples": 480, "duration_s": 5.0,
+            "task_cpu_ms": {"train_loop": 4200.0},
+            "stacks": [
+                {"thread": "task-exec", "task": "train_loop", "count": 420,
+                 "busy": 420, "frames": ["train.train_loop", "train.loss_fn"]},
+                {"thread": "metrics-flush", "task": None, "count": 60,
+                 "busy": 0, "frames": ["threading.wait"]},
+            ],
+        },
+        "controller": {
+            "samples": 500, "duration_s": 5.0, "task_cpu_ms": {},
+            "stacks": [
+                {"thread": "MainThread", "task": None, "count": 500,
+                 "busy": 120, "frames": ["controller.run", "selectors.select"]},
+            ],
+        },
+    }
+    merged = profiling.merge_cpu_results(results)
+    merged.update(hz=100.0, duration_s=5.0, ms_per_sample=10.0)
+    return merged
+
+
+def _print_cpu_profile(res: dict, args) -> int:
+    from ray_tpu.util import profiling
+
+    print(
+        f"{res.get('samples', 0)} samples @ {res.get('hz', '?')} Hz over "
+        f"{res.get('duration_s', '?')}s from {len(res.get('procs', {}))} "
+        "process(es)"
+    )
+    task_cpu = res.get("task_cpu_ms", {})
+    if task_cpu:
+        print("task CPU attribution (sampled busy ms):")
+        for name, ms in list(task_cpu.items())[:15]:
+            print(f"  {ms:>10.1f} ms  {name}")
+    for proc, err in res.get("errors", {}).items():
+        print(f"!! {proc}: {err}")
+    if args.out:
+        if args.format == "collapsed":
+            text = profiling.collapsed_text(res)
+        else:
+            text = json.dumps(profiling.speedscope_json(
+                res, ms_per_sample=res.get("ms_per_sample", 10.0)
+            ))
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.format} profile to {args.out} "
+              "(collapsed: flamegraph.pl; speedscope: speedscope.app)")
+    else:
+        top = sorted(
+            res.get("collapsed", {}).items(), key=lambda kv: -kv[1]
+        )[:15]
+        if top:
+            print("top stacks (collapsed; use --out for the full profile):")
+            for line, n in top:
+                print(f"  {n:>6}  {line[:160]}")
+    return 0
+
+
+def _profile_captures(args):
+    """Legacy list/fetch of jax.profiler captures (both per-task
+    runtime_env={'jax_profiler': True} and on-demand device traces)."""
     from ray_tpu.util import state
 
     _connect()
-    if args.profile_id:
-        info = state.get_profile(args.profile_id)
+    if args.target_id:
+        info = state.get_profile(args.target_id)
         print(json.dumps({k: v for k, v in info.items() if k != "files"}, indent=1))
         for f in info["files"]:
             print(f)
     else:
         rows = state.list_profiles()
         if not rows:
-            print("no profiles captured (use runtime_env={'jax_profiler': True})")
+            print("no profiles captured (use runtime_env={'jax_profiler': "
+                  "True} or `ray-tpu profile device`)")
         for r in rows:
             print(f"{r['id']}  task={r.get('task_id', '?')[:12]}  "
                   f"dur={r.get('duration_s', '?')}s  {r['path']}")
     return 0
+
+
+def cmd_profile(args):
+    """On-demand distributed profiling (reference: `ray stack` + the
+    dashboard reporter's per-worker py-spy stack/CPU-profile endpoints):
+
+      ray-tpu profile stacks [--node N | --actor ID]
+      ray-tpu profile cpu --duration 10 [--hz 100] [--out f --format ...]
+      ray-tpu profile device [--workers W1,W2] --duration 5
+      ray-tpu profile incidents [ID]
+      ray-tpu profile captures [ID]        (also: legacy `profile [ID]`)
+    """
+    from ray_tpu.util import profiling
+
+    action = args.action
+    if action not in _PROFILE_ACTIONS:
+        # legacy invocation: `ray-tpu profile [capture_id]`
+        args.target_id = action
+        return _profile_captures(args)
+    if action == "stacks":
+        if args.offline:
+            print(profiling.merge_stack_dumps(_profile_stacks_fixture()))
+            return 0
+        from ray_tpu.util import state
+
+        _connect()
+        res = state.profile_stacks(
+            node=args.node, actor=args.actor, timeout_s=args.timeout
+        )
+        print(res["merged"])
+        return 0
+    if action == "cpu":
+        if args.offline:
+            return _print_cpu_profile(_profile_cpu_fixture(), args)
+        from ray_tpu.util import state
+
+        _connect()
+        workers = args.workers.split(",") if args.workers else None
+        res = state.profile_cpu(
+            duration_s=args.duration, hz=args.hz, node=args.node,
+            workers=workers,
+        )
+        return _print_cpu_profile(res, args)
+    if action == "device":
+        from ray_tpu.util import state
+
+        _connect()
+        workers = args.workers.split(",") if args.workers else None
+        res = state.profile_device(workers=workers, duration_s=args.duration)
+        print(f"capture {res['capture']} ({res['duration_s']}s):")
+        ok = 0
+        for name, r in sorted(res.get("workers", {}).items()):
+            if r.get("ok"):
+                ok += 1
+                print(f"  {name}: {r.get('dir')}")
+            else:
+                print(f"  {name}: FAILED — {r.get('error')}")
+        print(f"{ok} capture(s); list with `ray-tpu profile captures`, "
+              "merge into one trace with `ray-tpu timeline`")
+        return 0 if ok or not res.get("workers") else 1
+    if action == "incidents":
+        from ray_tpu.util import state
+
+        _connect()
+        if args.target_id:
+            info = state.get_incident(args.target_id)
+            print(json.dumps(
+                {k: v for k, v in info.items() if k != "contents"}, indent=1
+            ))
+            for name, content in info.get("contents", {}).items():
+                print(f"===== {name} =====")
+                print(content)
+        else:
+            rows = state.list_incidents()
+            if not rows:
+                print("no incidents captured")
+            for r in rows:
+                print(f"{r['id']}  trigger={r.get('trigger', '?')}  "
+                      f"proc={r.get('process', '?')}  {r['path']}")
+        return 0
+    return _profile_captures(args)
 
 
 def cmd_microbenchmark(args):
@@ -546,7 +736,10 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_job)
 
     sp = sub.add_parser("summary", help="state summaries")
-    sp.add_argument("what", choices=["tasks", "actors", "objects", "lifecycle", "rl"])
+    sp.add_argument(
+        "what",
+        choices=["tasks", "actors", "objects", "lifecycle", "rl", "profiling"],
+    )
     sp.set_defaults(fn=cmd_summary)
 
     sp = sub.add_parser(
@@ -562,12 +755,39 @@ def main(argv=None):
         "--no-spans", action="store_true",
         help="omit RAY_TPU_TRACE span files",
     )
+    sp.add_argument(
+        "--no-device", action="store_true",
+        help="omit captured XLA device-trace events",
+    )
     sp.set_defaults(fn=cmd_timeline)
 
     sub.add_parser("memory", help="object store summary").set_defaults(fn=cmd_memory)
 
-    sp = sub.add_parser("profile", help="list/fetch jax.profiler task captures")
-    sp.add_argument("profile_id", nargs="?")
+    sp = sub.add_parser(
+        "profile",
+        help="on-demand profiling: stacks|cpu|device|incidents|captures",
+    )
+    sp.add_argument(
+        "action", nargs="?",
+        help="stacks|cpu|device|incidents|captures (or a capture id — "
+             "the legacy `profile [ID]` list/fetch still works)",
+    )
+    sp.add_argument("target_id", nargs="?", help="incident or capture id")
+    sp.add_argument("--duration", type=float, default=5.0,
+                    help="cpu/device: capture seconds")
+    sp.add_argument("--hz", type=float,
+                    help="cpu: sample rate (default: profiling_sample_hz)")
+    sp.add_argument("--node", help="filter to one node (node-id hex prefix)")
+    sp.add_argument("--actor",
+                    help="stacks: filter to one actor's worker (id prefix)")
+    sp.add_argument("--workers",
+                    help="cpu/device: comma-separated worker-id prefixes")
+    sp.add_argument("--out", help="cpu: write the full profile here")
+    sp.add_argument("--format", choices=["speedscope", "collapsed"],
+                    default="speedscope", help="cpu --out format")
+    sp.add_argument("--timeout", type=float, default=10.0)
+    sp.add_argument("--offline", action="store_true",
+                    help="render from built-in fixtures (no cluster)")
     sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser(

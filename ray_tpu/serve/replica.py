@@ -140,9 +140,13 @@ class Replica:
                     now = time.time()
                     if first_ts is None:
                         first_ts = now
-                        self._metrics.ttft_ms.observe(
-                            max(0.0, now - submit) * 1000.0, self._tags
-                        )
+                        ttft_ms = max(0.0, now - submit) * 1000.0
+                        self._metrics.ttft_ms.observe(ttft_ms, self._tags)
+                        # SLO-breach incident hook (profiling subsystem):
+                        # no-op unless profiling_slo_ttft_ms is set.
+                        from ray_tpu.util.profiling import slo_breach_check
+
+                        slo_breach_check("serve_ttft_ms", ttft_ms)
                     last_ts = now
                     items += 1
                     yield item

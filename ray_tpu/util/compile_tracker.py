@@ -174,6 +174,16 @@ def _note_compile(name: str, shapes: str, now: Optional[float] = None):
             "changing per call; pad/bucket inputs or hoist the jit.",
             name, in_window, _storm_window_s, shapes, prev_shapes,
         )
+        try:
+            from ray_tpu.util.profiling import incident
+
+            incident(
+                "recompile_storm",
+                {"function": name, "window_count": in_window,
+                 "shapes": shapes, "prev_shapes": prev_shapes},
+            )
+        except Exception as e:  # noqa: BLE001 — detection must survive capture failure
+            logger.debug("storm incident capture failed: %s", e)
 
 
 def install(storm_threshold: Optional[int] = None,

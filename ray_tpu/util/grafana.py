@@ -67,6 +67,7 @@ ROWS = (
     ("Data", ("data_",)),
     ("Control Plane", ("task_state_", "task_pending_", "lease_",
                        "lockwatch_")),
+    ("Profiling", ("task_cpu_", "profiling_")),
     ("Cluster Resources", ("tpu_hbm_", "node_", "object_store_",
                            "metrics_series_")),
     ("Compilation", ("jax_",)),

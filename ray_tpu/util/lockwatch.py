@@ -58,6 +58,10 @@ _cycles: List[dict] = []
 _long_holds: List[dict] = []
 _cycle_pairs_reported: Set[Tuple[int, int]] = set()
 _watched_locks = 0
+# thread ident -> that thread's held-stack LIST OBJECT (the same list
+# _tls.held aliases): lets the profiling stack dumper annotate OTHER
+# threads' held locks. Entries for dead threads are pruned on snapshot.
+_held_registry: Dict[int, list] = {}
 
 # counters are created lazily (metrics imports config; this module must
 # stay importable before the package)
@@ -91,7 +95,40 @@ def _held_stack() -> list:
     st = getattr(_tls, "held", None)
     if st is None:
         st = _tls.held = []
+        _held_registry[threading.get_ident()] = st
     return st
+
+
+def held_snapshot() -> Dict[int, List[dict]]:
+    """Per-thread currently-held watched locks, for stack-dump
+    annotation ({ident: [{lock, acquired_at, held_ms}]}). Deliberately
+    lock-free: it reads each thread's held list (mutated only by its
+    owner; list copies are atomic under the GIL) and the append-only
+    ``_names`` map — so a process wedged on these very locks can still
+    dump itself."""
+    live = {t.ident for t in threading.enumerate()}
+    now = time.monotonic()
+    out: Dict[int, List[dict]] = {}
+    for ident in list(_held_registry):
+        if ident not in live:
+            _held_registry.pop(ident, None)
+            continue
+        items = []
+        for entry in list(_held_registry.get(ident) or ()):
+            try:
+                lock, t0, site = entry
+            except (TypeError, ValueError):
+                continue
+            items.append(
+                {
+                    "lock": _names.get(lock._wuid, "?"),
+                    "acquired_at": site,
+                    "held_ms": round((now - t0) * 1000.0, 1),
+                }
+            )
+        if items:
+            out[ident] = items
+    return out
 
 
 def _in_watchdog() -> bool:
@@ -122,6 +159,21 @@ def _report_metrics(cycles: int = 0, long_holds: int = 0):
             _metric_long_holds.inc(long_holds)
     except Exception as e:  # noqa: BLE001 — watchdog must never take the process down
         logger.debug("lockwatch metric report failed: %s", e)
+    finally:
+        _tls.in_watchdog = False
+
+
+def _maybe_incident(trigger: str, info: dict):
+    """Flush an incident capture bundle for a detector hit (profiling
+    subsystem; rate-limited + bounded there). Runs with the reentrancy
+    flag set so the capture's own lock traffic skips bookkeeping."""
+    _tls.in_watchdog = True
+    try:
+        from ray_tpu.util.profiling import incident
+
+        incident(trigger, info)
+    except Exception as e:  # noqa: BLE001 — watchdog must never take the process down
+        logger.debug("lockwatch incident capture failed: %s", e)
     finally:
         _tls.in_watchdog = False
 
@@ -236,6 +288,11 @@ class WatchedLock:
                 site, _cycles[-1]["reverse_first_seen"] if _cycles else "?",
             )
             _report_metrics(cycles=new_cycles)
+            # A cycle means a deadlock may be forming RIGHT NOW — capture
+            # before this thread blocks on the raw acquire.
+            _maybe_incident(
+                "lockwatch_cycle", _cycles[-1] if _cycles else {"site": site}
+            )
 
     def _check_hold(self, t0: float, site: str):
         dt_ms = (time.monotonic() - t0) * 1000.0
@@ -262,6 +319,7 @@ class WatchedLock:
             info["lock"], dt_ms, site, info["released_at"],
         )
         _report_metrics(long_holds=1)
+        _maybe_incident("lockwatch_long_hold", info)
 
 
 def wrap(raw=None, name: Optional[str] = None) -> WatchedLock:

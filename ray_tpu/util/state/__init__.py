@@ -356,6 +356,95 @@ def get_stack_traces(timeout_s: float = 10.0) -> dict:
     return _require_worker()._call("stack_dump_all", timeout_s)
 
 
+# ---------------------------------------------------------------------------
+# On-demand distributed profiling (util/profiling.py; reference: the
+# dashboard reporter's py-spy stack/CPU-profile endpoints per worker)
+# ---------------------------------------------------------------------------
+def profile_stacks(node: Optional[str] = None, actor: Optional[str] = None,
+                   timeout_s: float = 10.0) -> dict:
+    """Cluster-wide structured stack dump — controller + agents + workers
+    + drivers — with current-task attribution and lockwatch held-lock
+    annotations. Returns {procs: {name: dump}, merged: deduplicated
+    text}. Filter to one node's processes (``node``: node-id hex prefix)
+    or one actor's worker (``actor``: actor-id hex prefix)."""
+    return _require_worker()._call(
+        "profile_stacks", node=node, actor=actor, timeout_s=timeout_s,
+        timeout=timeout_s + 15,
+    )
+
+
+def profile_cpu(duration_s: float = 5.0, hz: Optional[float] = None,
+                node: Optional[str] = None,
+                workers: Optional[List[str]] = None) -> dict:
+    """Cluster-wide sampling CPU profile: every selected process samples
+    itself concurrently for ``duration_s`` at ``hz`` (default
+    ``profiling_sample_hz``); samples are tagged with the executing
+    task's name. Returns merged collapsed stacks + per-task CPU ms —
+    render with ``ray-tpu profile cpu`` or profiling.speedscope_json."""
+    return _require_worker()._call(
+        "profile_cpu_all", duration_s=duration_s, hz=hz, node=node,
+        workers=workers, timeout=duration_s + 30,
+    )
+
+
+def profile_device(workers: Optional[List[str]] = None,
+                   duration_s: float = 5.0,
+                   capture: Optional[str] = None) -> dict:
+    """Attach ``jax.profiler`` traces to already-running workers for
+    ``duration_s`` (no restart). Captures land in the session
+    ``profiles/`` root next to runtime_env captures — list with
+    :func:`list_profiles` / ``ray-tpu profile captures``."""
+    # Timeout covers the controller's worst case — a 15s start timeout on
+    # a wedged worker, the capture sleep, and a 15s stop timeout — with
+    # margin, so one hung worker can't eat the others' finished captures.
+    return _require_worker()._call(
+        "profile_device_all", workers=workers, duration_s=duration_s,
+        capture=capture, timeout=duration_s + 45,
+    )
+
+
+def list_incidents() -> List[dict]:
+    """Incident capture bundles auto-written by the detector hooks
+    (lockwatch long-hold/cycle, recompile storms, serve SLO breaches):
+    {id, trigger, ts, process, pid, path, files} rows, oldest first."""
+    return _require_worker()._call("profile_incidents")
+
+
+def get_incident(incident_id: str) -> dict:
+    """One incident bundle's metadata + file contents (stacks.txt,
+    samples.collapsed, lifecycle_tail.json)."""
+    return _require_worker()._call("get_incident", incident_id)
+
+
+def summarize_profiling() -> dict:
+    """Profiling rollup from the controller metric snapshot: per-task
+    sampled CPU time (bucket-quantile p50/p95/p99 over ``task_cpu_ms``
+    windows), total samples by mode, and incident counts by trigger."""
+    snap = metrics_snapshot()
+
+    def counter_by(name: str, tag: str) -> dict:
+        out: dict = {}
+        for tags, v in (snap.get(name) or {}).get("series", []):
+            key = dict(tuple(t) for t in tags).get(tag, "")
+            out[key] = out.get(key, 0.0) + v
+        return out
+
+    per_task: dict = {}
+    for tags, payload in (snap.get("task_cpu_ms") or {}).get("series", []):
+        tname = dict(tuple(t) for t in tags).get("name", "")
+        per_task.setdefault(tname, {"series": []})["series"].append(
+            (tags, payload)
+        )
+    tasks = {name: _hist_rollup(entry) for name, entry in per_task.items()}
+    return {
+        "task_cpu_ms": dict(
+            sorted(tasks.items(), key=lambda kv: -kv[1].get("count", 0))
+        ),
+        "samples_total": counter_by("profiling_samples_total", "mode"),
+        "incidents_total": counter_by("profiling_incidents_total", "trigger"),
+    }
+
+
 def list_logs() -> List[str]:
     d = _logs_dir()
     return sorted(os.listdir(d)) if os.path.isdir(d) else []
@@ -390,8 +479,9 @@ def timeline_chrome(
     filename: Optional[str] = None,
     include_lifecycle: bool = True,
     include_spans: bool = True,
+    include_device: bool = True,
 ) -> list:
-    """Chrome-trace (catapult) JSON merging three event sources into ONE
+    """Chrome-trace (catapult) JSON merging four event sources into ONE
     chrome://tracing load (reference: `ray timeline` →
     chrome_tracing_dump, python/ray/_private/state.py:438):
 
@@ -402,6 +492,10 @@ def timeline_chrome(
       dwell — rendered under ``lifecycle:<kind>`` process rows
     - user/application spans from the per-process JSONL sinks
       (``include_spans``, populated when RAY_TPU_TRACE=1)
+    - XLA device-trace events from captured jax.profiler runs
+      (``include_device``): every ``*.trace.json[.gz]`` under the session
+      profiles root, re-labelled onto ``xla:<capture>`` rows (device
+      timestamps are capture-relative — own tracks, not wall-aligned)
     """
     events = list_cluster_events(limit=1000000)
     open_spans: dict = {}
@@ -435,6 +529,10 @@ def timeline_chrome(
         from ray_tpu.util.tracing import collect_spans
 
         trace.extend(collect_spans(_require_worker().session_dir))
+    if include_device:
+        from ray_tpu.util.profiling import collect_device_traces
+
+        trace.extend(collect_device_traces(_require_worker().session_dir))
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)
