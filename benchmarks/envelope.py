@@ -18,7 +18,8 @@ WHICH stage to attack. ``--no-recorder`` disables the recorder for A/B
 overhead runs.
 
 Usage: python benchmarks/envelope.py [--queued 100000] [--pgs 1000]
-           [--actor-records 10000] [--live-actors 60] [--no-recorder]
+           [--actor-records 10000] [--live-actors 60] [--churn 20000]
+           [--no-recorder] [--no-memory-census]
            [--out benchmarks/ENVELOPE_r03.json]
 """
 from __future__ import annotations
@@ -258,6 +259,63 @@ def bench_live_pgs(n: int) -> dict:
     }
 
 
+def bench_object_churn(n: int, census_ab: bool = True) -> dict:
+    """Put/free storm through the object directory (reference: the
+    object-store half of the release benchmarks — many small objects
+    created and released at rate). Holds a sliding window of refs so the
+    controller sees creates, holder flushes, AND frees concurrently.
+
+    When ``census_ab`` is set, the driver-side memory-census capture
+    (call-site stack walk + intern at every put — the per-operation cost
+    the census adds) is A/B'd interleaved best-of-2; the budget is <=3%
+    like profiling (``census_overhead_ok``). Controller-side attribution
+    rides the same RPCs either way and is not separable per-process."""
+    import collections
+
+    import ray_tpu
+    from ray_tpu.core import memory_census
+
+    payload = b"c" * 4096  # inline tier: every put is one directory RPC
+
+    def one_arm(count: int) -> float:
+        window = collections.deque()
+        t0 = time.perf_counter()
+        for _ in range(count):
+            window.append(ray_tpu.put(payload))
+            if len(window) >= 64:
+                ray_tpu.free([window.popleft()])
+        ray_tpu.free(list(window))
+        window.clear()
+        return count / (time.perf_counter() - t0)
+
+    one_arm(min(500, n))  # warm the put path / intern cache
+    arms = {"on": 0.0, "off": 0.0}
+    rounds = 2 if census_ab else 1
+    with LoopProbe() as probe:
+        for _ in range(rounds):  # interleaved best-of-N
+            if census_ab:
+                # toggle ONLY inside the A/B: with --no-memory-census the
+                # cluster-config disable must stay in force for this arm
+                # and every later bench row
+                memory_census.set_enabled(False)
+                arms["off"] = max(arms["off"], one_arm(n))
+                memory_census.set_enabled(True)
+            arms["on"] = max(arms["on"], one_arm(n))
+    row = {
+        "benchmark": "object_churn",
+        "n": n,
+        "puts_per_s": round(arms["on"], 1),
+        "controller_rss_mb": controller_rss_mb(),
+        **probe.stats(),
+    }
+    if census_ab:
+        overhead = 100.0 * (arms["off"] - arms["on"]) / max(arms["off"], 1e-9)
+        row["puts_per_s_no_census"] = round(arms["off"], 1)
+        row["census_overhead_pct"] = round(max(0.0, overhead), 2)
+        row["census_overhead_ok"] = overhead <= 3.0
+    return row
+
+
 def main():
     import ray_tpu
 
@@ -266,30 +324,41 @@ def main():
     p.add_argument("--pgs", type=int, default=1000)
     p.add_argument("--actor-records", type=int, default=10000)
     p.add_argument("--live-actors", type=int, default=60)
+    p.add_argument("--churn", type=int, default=20000)
     p.add_argument(
         "--no-recorder", action="store_true",
         help="disable the control-plane flight recorder (A/B overhead runs)",
     )
+    p.add_argument(
+        "--no-memory-census", action="store_true",
+        help="disable memory-census attribution cluster-wide (A/B runs; "
+             "the churn row then skips its built-in driver-side A/B)",
+    )
     p.add_argument("--out", default="")
     args = p.parse_args()
 
+    overrides = {}
+    if args.no_recorder:
+        overrides["lifecycle_events"] = False
+    if args.no_memory_census:
+        overrides["memory_census"] = False
     # Logical CPUs sized so the lease ramp can hold --live-actors
     # concurrent warm-up naps (worker pool caps scale with CPU count).
     ray_tpu.init(
         num_cpus=max(8, args.live_actors + 4),
-        _system_config=(
-            {"lifecycle_events": False} if args.no_recorder else None
-        ),
+        _system_config=overrides or None,
     )
     rows = []
     try:
-        for fn, fnargs in (
-            (bench_live_pgs, (args.pgs,)),
-            (bench_actor_records, (args.actor_records,)),
-            (bench_live_actors, (args.live_actors,)),
-            (bench_queued_tasks, (args.queued,)),
+        for fn, fnargs, fnkw in (
+            (bench_live_pgs, (args.pgs,), {}),
+            (bench_actor_records, (args.actor_records,), {}),
+            (bench_live_actors, (args.live_actors,), {}),
+            (bench_object_churn, (args.churn,),
+             {"census_ab": not args.no_memory_census}),
+            (bench_queued_tasks, (args.queued,), {}),
         ):
-            row = fn(*fnargs)
+            row = fn(*fnargs, **fnkw)
             row.update(lifecycle_phases())
             rows.append(row)
             print(json.dumps(row), flush=True)

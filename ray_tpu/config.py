@@ -159,6 +159,29 @@ class Config:
     # Per-(kind, state) dwell sample ring bound (percentile source).
     lifecycle_dwell_samples: int = 4096
 
+    # --- object & memory observability (core/memory_census.py) ---
+    # Master switch for creation call-site attribution + the per-process
+    # ref census + the controller's leak/pressure detectors (the
+    # envelope A/B knob: benchmarks/envelope.py --no-memory-census).
+    memory_census: bool = True
+    # Bounded call-site intern table: past the cap every new site
+    # collapses into "(other)" so census groups / leak-trend entries /
+    # metric tags built from call-sites stay bounded.
+    memory_callsite_cap: int = 512
+    # Leak detector: flag a call-site whose open-object count rises
+    # monotonically across this many consecutive census sweeps (one
+    # sweep per node_telemetry_interval_ms) ...
+    memory_leak_sweeps: int = 5
+    # ... and sits at or above this floor (small transients don't flag).
+    memory_leak_min_refs: int = 32
+    # Store-pressure incident trigger: object-store occupancy at/above
+    # this fraction fires PR 9's incident machinery with a memory
+    # autopsy bundle (0 disables the occupancy trigger).
+    memory_incident_occupancy_pct: float = 0.95
+    # ... or this many spill operations within one census sweep
+    # (eviction-loop churn; 0 disables the churn trigger).
+    memory_incident_spill_churn: int = 200
+
     # --- profiling (util/profiling.py) ---
     # Default sample rate for on-demand `ray-tpu profile cpu` runs.
     profiling_sample_hz: int = 100

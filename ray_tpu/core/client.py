@@ -32,7 +32,12 @@ class RefTracker:
     refs, src/ray/core_worker/reference_count.h:142). Zero-crossings are
     collected and batch-flushed; ids touched-and-dropped within one flush
     window still flush as drops so the controller learns the object was
-    once held (transient refs must not leak)."""
+    once held (transient refs must not leak).
+
+    Also carries the memory census's creation-site attribution: puts and
+    task submissions :meth:`attribute` their refs with the interned user
+    call-site (reference: reference_count.cc keeps a per-ref call_site
+    string for ``ray memory``); sites drop with their last ref."""
 
     def __init__(self):
         import collections
@@ -40,6 +45,9 @@ class RefTracker:
         self._lock = threading.Lock()
         self._counts: dict[bytes, int] = {}
         self._touched: set[bytes] = set()
+        # oid key -> interned creation call-site (memory_census); absent
+        # for borrowed/deserialized refs.
+        self._sites: dict[bytes, str] = {}
         # dec() is called from ObjectRef.__del__, which the cyclic GC may
         # run on ANY thread — including one currently inside inc()/drain()
         # holding the (non-reentrant) lock. dec therefore never locks: it
@@ -55,6 +63,25 @@ class RefTracker:
     def dec(self, oid):
         self._pending_decs.append(oid.binary())  # lock-free (see __init__)
 
+    def attribute(self, key: bytes, site: str):
+        """Record the creation call-site for a ref this process created
+        (no-op for empty sites — census disabled)."""
+        if not site:
+            return
+        with self._lock:
+            if key not in self._sites:
+                self._sites[key] = site
+
+    def site_of(self, key: bytes) -> str:
+        return self._sites.get(key, "")
+
+    def census_snapshot(self) -> "tuple[dict[bytes, int], dict[bytes, str]]":
+        """(open counts, sites) copies for the memory census dump —
+        pending decs folded first so the snapshot reflects GC'd refs."""
+        with self._lock:
+            self._fold_decs_locked()
+            return dict(self._counts), dict(self._sites)
+
     def _fold_decs_locked(self):
         while True:
             try:
@@ -64,6 +91,7 @@ class RefTracker:
             n = self._counts.get(key, 0) - 1
             if n <= 0:
                 self._counts.pop(key, None)
+                self._sites.pop(key, None)
             else:
                 self._counts[key] = n
             self._touched.add(key)
@@ -174,6 +202,13 @@ class CoreWorker:
         self._ref_flush_task = None
         self._async_errors: list = []
         set_ref_tracker(self.refs)
+        # Memory census: call-site attribution at put/submit (the
+        # ``memory_census`` config is the envelope A/B knob).
+        from ray_tpu.core import memory_census
+
+        memory_census.set_enabled(
+            bool(self.config.get("memory_census", True))
+        )
         if self.config.get("object_auto_gc", True):
             self._ref_flush_task = self.loop_runner.submit(self._ref_flush_loop())
 
@@ -214,32 +249,51 @@ class CoreWorker:
     # Objects
     # ------------------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
+        from ray_tpu.core import memory_census
         from ray_tpu.utils.serialization import assemble_parts
 
+        # Creation-site attribution (reference: reference counting records
+        # a call_site per ref for `ray memory`): captured before the
+        # serialize so deep value graphs can't push the user frame out of
+        # the bounded walk.
+        site = memory_census.capture_callsite()
         oid = ObjectID.for_put(self.worker_id, next(self._put_counter))
         meta, raws, total, contained = _serialize_parts_capturing(value)
         if contained:
             self.promote_refs(contained)  # nested refs escape via the put
         if total <= self.inline_limit:
             self._call(
-                "object_put_inline", oid, assemble_parts(meta, raws), False, contained or []
+                "object_put_inline", oid, assemble_parts(meta, raws), False,
+                contained or [], callsite=site,
             )
         else:
             # Single copy: parts go straight into the shm mapping.
             self.plasma.put_parts(oid, meta, raws, total)
-            self._call("object_put_shm", oid, total, self.node_id, False, contained or [])
-        return ObjectRef(oid)
+            self._call(
+                "object_put_shm", oid, total, self.node_id, False,
+                contained or [], callsite=site,
+            )
+        ref = ObjectRef(oid)
+        self.refs.attribute(oid.binary(), site)
+        return ref
 
     def put_serialized(
-        self, oid: ObjectID, data: bytes, is_error: bool = False, contained: Optional[list] = None
+        self, oid: ObjectID, data: bytes, is_error: bool = False,
+        contained: Optional[list] = None, callsite: str = "",
     ):
         if contained:
             self.promote_refs(contained)
         if len(data) <= self.inline_limit:
-            self._call("object_put_inline", oid, data, is_error, contained or [])
+            self._call(
+                "object_put_inline", oid, data, is_error, contained or [],
+                callsite=callsite,
+            )
         else:
             self.plasma.put_bytes(oid, data)
-            self._call("object_put_shm", oid, len(data), self.node_id, is_error, contained or [])
+            self._call(
+                "object_put_shm", oid, len(data), self.node_id, is_error,
+                contained or [], callsite=callsite,
+            )
 
     def get(self, refs: Sequence[ObjectRef] | ObjectRef, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -563,13 +617,26 @@ class CoreWorker:
         if self._async_errors:
             raise self._async_errors.pop(0)
 
+    def _attribute_returns(self, refs: List[ObjectRef]):
+        """Attribute a submission's return refs to the user call-site
+        (the ``.remote()`` line). One bounded stack walk per submit; the
+        per-code-object intern cache makes steady-state cost a dict hit."""
+        from ray_tpu.core import memory_census
+
+        site = memory_census.capture_callsite()
+        if site:
+            for r in refs:
+                self.refs.attribute(r.id.binary(), site)
+
     def _submit_pipelined(self, spec: TaskSpec, captures: Optional[list]) -> List[ObjectRef]:
         self._check_async_errors()
         fut = self.loop_runner.submit(
             self.peer.notify("submit_task", spec, captures or [])
         )
         fut.add_done_callback(self._note_async_error)
-        return [ObjectRef(oid) for oid in spec.return_ids()]
+        refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        self._attribute_returns(refs)
+        return refs
 
     def submit_task(self, spec: TaskSpec, captures: Optional[list] = None) -> List[ObjectRef]:
         if (
@@ -596,6 +663,7 @@ class CoreWorker:
         rids = spec.return_ids()
         self.memory_store.register_pending([oid.binary() for oid in rids])
         refs = [ObjectRef(oid) for oid in rids]
+        self._attribute_returns(refs)
         if spec.dependencies or captures:
             pins = [ObjectRef(d) for d in spec.dependencies]
             pins += [
@@ -636,6 +704,7 @@ class CoreWorker:
         rids = spec.return_ids()
         self.memory_store.register_pending([oid.binary() for oid in rids])
         refs = [ObjectRef(oid) for oid in rids]
+        self._attribute_returns(refs)
         # Pin args (deps + captures) until the reply lands — the owner-side
         # equivalent of the reference's submitted-task references.
         if spec.dependencies or captures:
@@ -806,6 +875,15 @@ class _NullHandler:
         from ray_tpu.util import profiling
 
         return profiling.sample_async(duration_s, hz)
+
+    def rpc_dump_memory(self, peer, limit: int = 1000):
+        """This process's object/memory census (`ray-tpu memory` fan-out
+        leg): open local refs by creation call-site, owner-local memory
+        store occupancy, live zero-copy pins. Drivers hold refs too — a
+        leak is as often the driver's list as an actor's."""
+        from ray_tpu.core import memory_census
+
+        return memory_census.dump(limit)
 
 
 class DriverHandler(_NullHandler):

@@ -65,6 +65,55 @@ async def _notify_quiet(peer, method: str, *args, what: str = ""):
 #   ("shm", size, node_id_hex, shm_dir, is_error)
 
 
+_mem_metrics = None
+
+
+def _get_mem_metrics():
+    """Lazy controller-process memory gauges (Grafana "Memory" row).
+    Node tags are node-id prefixes (bounded by cluster size); the
+    leak-flag call-site tag is bounded by the detector's trend-table cap
+    plus the registry cardinality cap."""
+    global _mem_metrics
+    if _mem_metrics is None:
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        _mem_metrics = {
+            "store_used": Gauge(
+                "object_store_used_bytes",
+                "Object store bytes in use per node (file tier + arena)",
+                ("node",),
+            ),
+            "store_pinned": Gauge(
+                "object_store_pinned_bytes",
+                "Bytes of store objects held by store-side pins per node",
+                ("node",),
+            ),
+            "store_spilled": Gauge(
+                "object_store_spilled_bytes",
+                "Bytes of store objects spilled to disk per node",
+                ("node",),
+            ),
+            "refs_open": Gauge(
+                "object_refs_open",
+                "Objects in the controller directory by tier",
+                ("kind",),
+            ),
+            "free_latency": Histogram(
+                "object_free_latency_ms",
+                "Wall time of one object free (directory pop + replica "
+                "delete notifies)",
+                boundaries=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50,
+                            100, 250),
+            ),
+            "leak_flags": Counter(
+                "memory_leak_flags_total",
+                "Call-sites newly flagged by the open-ref growth detector",
+                ("callsite",),
+            ),
+        }
+    return _mem_metrics
+
+
 @dataclass
 class ObjectRecord:
     oid: ObjectID
@@ -87,6 +136,11 @@ class ObjectRecord:
     # borrower's "held" flush (<= ref_flush_interval) is still in flight
     # when the last known holder drops.
     gc_marked: bool = False
+    # Memory-census attribution (reference: reference_count.cc call_site
+    # per ref): the creating user frame / task label, interned client-side
+    # (bounded vocabulary), plus who created it.
+    callsite: str = ""
+    creator: str = ""
 
     def meta(self, shm_dirs: Dict[NodeID, str]):
         if self.inline is not None:
@@ -334,6 +388,14 @@ class Controller:
         # snapshots) pushed by workers/drivers (rpc_device_telemetry),
         # keyed "node_hex/proc". Stale entries pruned on read.
         self.device_state: Dict[str, dict] = {}
+        # Memory census (ray-tpu memory): per-callsite open-object trend
+        # windows for the leak detector (bounded vocabulary), live leak
+        # flags, and per-node spill-op watermarks for the store-pressure
+        # churn trigger.
+        self._mem_trends: Dict[str, Any] = {}
+        self._leak_flags: Dict[str, dict] = {}
+        self._spill_ops_prev: Dict[NodeID, int] = {}
+        self._census_tick_n = 0  # sweep counter (scan-stride amortization)
         self.dashboard_port: Optional[int] = None
 
         # Head node: controller doubles as its node agent.
@@ -1279,9 +1341,18 @@ class Controller:
             self.finished_specs[task_id] = spec
             self._event("task", spec, "FINISHED")
             node_id = worker.node_id if worker else rec.node_id
+            census = getattr(self.config, "memory_census", True)
             for item in results:
                 oid, kind = item[0], item[1]
                 orec = self._object(oid)
+                if census and not orec.callsite:
+                    # interned: a generator of unique task names must not
+                    # grow an unbounded call-site vocabulary here
+                    from ray_tpu.core.memory_census import task_site
+
+                    orec.callsite = task_site(spec.name)
+                if census and not orec.creator and worker is not None:
+                    orec.creator = f"worker:{worker.worker_id.hex()[:12]}"
                 if kind == "inline":
                     orec.inline = item[2]
                     orec.size = len(item[2])
@@ -1601,9 +1672,28 @@ class Controller:
     def _shm_dirs(self) -> Dict[NodeID, str]:
         return {nid: n.shm_dir for nid, n in self.nodes.items()}
 
+    @staticmethod
+    def _peer_identity(peer: Optional[rpc.Peer]) -> str:
+        """Short creator label for object attribution rows."""
+        if peer is None:
+            return ""
+        wid = peer.meta.get("worker_id")
+        if wid is not None:
+            return f"worker:{wid.hex()[:12]}"
+        holder = peer.meta.get("holder_id") or ""
+        kind = peer.meta.get("kind") or "proc"
+        return f"{kind}:{holder[:12]}" if holder else kind
+
+    def _attribute_object(self, orec: ObjectRecord, peer: Optional[rpc.Peer],
+                          callsite: str):
+        if callsite and not orec.callsite:
+            orec.callsite = callsite
+        if not orec.creator:
+            orec.creator = self._peer_identity(peer)
+
     async def rpc_object_put_inline(
         self, peer: rpc.Peer, oid: ObjectID, data: bytes, is_error: bool = False,
-        contained: Optional[list] = None,
+        contained: Optional[list] = None, callsite: str = "",
     ):
         orec = self._object(oid)
         orec.inline = data
@@ -1611,13 +1701,14 @@ class Controller:
         orec.is_error = is_error
         if contained:
             orec.children = list(contained)
+        self._attribute_object(orec, peer, callsite)
         orec.state = "READY"
         self._wake(orec)
         return True
 
     async def rpc_object_put_shm(
         self, peer: rpc.Peer, oid: ObjectID, size: int, node_id: NodeID, is_error: bool = False,
-        contained: Optional[list] = None,
+        contained: Optional[list] = None, callsite: str = "",
     ):
         orec = self._object(oid)
         orec.size = size
@@ -1625,6 +1716,7 @@ class Controller:
         orec.locations.add(node_id)
         if contained:
             orec.children = list(contained)
+        self._attribute_object(orec, peer, callsite)
         await self._account_object(node_id, oid, size)
         orec.state = "READY"
         self._wake(orec)
@@ -1811,6 +1903,7 @@ class Controller:
         orec = self.objects.pop(oid, None)
         if orec is None:
             return
+        t0 = time.monotonic()
         self._freed_lru[oid] = None
         while len(self._freed_lru) > 200_000:
             self._freed_lru.popitem(last=False)
@@ -1832,6 +1925,9 @@ class Controller:
                 self.head_store.delete(oid)
             else:
                 await node.peer.notify("delete_object", oid)
+        _get_mem_metrics()["free_latency"].observe(
+            (time.monotonic() - t0) * 1000.0
+        )
 
     # -- distributed ref counting (reference: reference_count.cc; the
     # controller is the authority the way owners are in the reference) ----
@@ -2315,6 +2411,450 @@ class Controller:
 
         return profiling.get_incident(incident_id, self.session_dir)
 
+    # =================================================================
+    # Object & memory observability (`ray-tpu memory`; reference: `ray
+    # memory` / dashboard memory view over core-worker ref counting)
+    # =================================================================
+    async def _dump_memory_fanout(self, node: Optional[str], limit: int,
+                                  timeout_s: float) -> Dict[str, Any]:
+        """Every process answers ``rpc_dump_memory`` over its existing
+        channel (the PR 9 profiling fan-out pattern): workers/drivers
+        return their ref census, agents their store's per-object rows."""
+        procs: Dict[str, Any] = {}
+
+        async def ask(name: str, p: rpc.Peer):
+            try:
+                procs[name] = await asyncio.wait_for(
+                    p.call("dump_memory", limit=limit), timeout_s
+                )
+            except Exception as e:  # noqa: BLE001 — wedged/gone process
+                procs[name] = f"<unavailable: {e}>"
+
+        await asyncio.gather(
+            *(ask(name, p) for name, p in self._profile_targets(node, None))
+        )
+        return procs
+
+    def _store_stats_by_node(self, procs: Dict[str, Any]) -> Dict[str, dict]:
+        """Per-node store stats: the head's live, agents' from their
+        fan-out dump (falling back to the last telemetry heartbeat)."""
+        stores: Dict[str, dict] = {}
+        agent_dumps = {
+            name[len("agent:"):]: d
+            for name, d in procs.items()
+            if name.startswith("agent:") and isinstance(d, dict)
+        }
+        for nid, nrec in self.nodes.items():
+            hexid = nid.hex()
+            if nrec.peer is None:
+                stores[hexid] = self.head_store.stats()
+                continue
+            dump = agent_dumps.get(hexid[:8])
+            if dump is not None and dump.get("store"):
+                stores[hexid] = dump["store"]
+            else:
+                stores[hexid] = (nrec.telemetry or {}).get("object_store", {})
+        return stores
+
+    def _store_object_index(self, procs: Dict[str, Any]) -> Dict[str, dict]:
+        """oid hex -> store row (pinned/spilled/in_arena), merged across
+        the head store and every agent dump — the spill/pin tier source
+        for per-object attribution."""
+        index: Dict[str, dict] = {}
+        for row in self.head_store.object_rows():
+            index[row["object_id"]] = row
+        for name, d in procs.items():
+            if name.startswith("agent:") and isinstance(d, dict):
+                for row in d.get("objects", ()):
+                    index.setdefault(row["object_id"], row)
+        return index
+
+    def _object_tier(self, orec: ObjectRecord,
+                     store_row: Optional[dict]) -> str:
+        if orec.state == "PENDING":
+            return "pending"
+        if orec.state == "FAILED":
+            return "failed"
+        if orec.inline is not None:
+            return "inline"
+        if store_row is not None and store_row.get("spilled"):
+            return "spilled"
+        return "shm"
+
+    async def rpc_summarize_memory(self, peer, limit: int = 50,
+                                   node: Optional[str] = None,
+                                   timeout_s: float = 5.0):
+        """Cluster-wide memory census rollup: controller object directory
+        (size/tier/call-site/holders) merged with per-process ref
+        censuses and per-node store stats. O(limit) call-site rows on the
+        wire; totals are uncapped."""
+        procs = await self._dump_memory_fanout(node, 1000, timeout_s)
+        stores = self._store_stats_by_node(procs)
+        store_index = self._store_object_index(procs)
+        by_site: Dict[str, dict] = {}
+
+        def site_row(site: str) -> dict:
+            row = by_site.get(site)
+            if row is None:
+                row = by_site[site] = {
+                    "objects": 0, "bytes": 0, "spilled_bytes": 0,
+                    "local_refs": 0, "pins": 0,
+                    "tiers": {},
+                }
+            return row
+
+        totals = {
+            "objects": len(self.objects),
+            "inline_bytes": 0, "shm_bytes": 0, "spilled_bytes": 0,
+            "open_refs": 0, "pins": 0, "pin_bytes": 0,
+            "memory_store_entries": 0, "memory_store_bytes": 0,
+        }
+        for oid, orec in self.objects.items():
+            srow = store_index.get(oid.hex())
+            tier = self._object_tier(orec, srow)
+            site = orec.callsite or "(unknown)"
+            row = site_row(site)
+            row["objects"] += 1
+            row["bytes"] += orec.size
+            row["tiers"][tier] = row["tiers"].get(tier, 0) + 1
+            if tier == "inline":
+                totals["inline_bytes"] += orec.size
+            elif tier == "shm":
+                totals["shm_bytes"] += orec.size
+            elif tier == "spilled":
+                totals["spilled_bytes"] += orec.size
+                row["spilled_bytes"] += orec.size
+        proc_rows: Dict[str, dict] = {}
+        pin_pids: Set[int] = set()  # the pin registry is per-PROCESS:
+        # two connections from one process (a driver + its cluster-admin
+        # CoreWorker) must not double-count the same pins
+        for name, d in procs.items():
+            if name.startswith("agent:") or not isinstance(d, dict):
+                if not isinstance(d, dict):
+                    proc_rows[name] = {"error": str(d)}
+                continue
+            refs = d.get("refs", {})
+            pins = d.get("pins", {})
+            ms = d.get("memory_store", {})
+            open_refs = 0
+            for site, info in refs.items():
+                open_refs += info.get("count", 0)
+                row = site_row(site)
+                row["local_refs"] += info.get("count", 0)
+                row["pins"] += info.get("pinned", 0)
+            totals["open_refs"] += open_refs
+            pid = d.get("pid")
+            if pid not in pin_pids:
+                pin_pids.add(pid)
+                totals["pins"] += pins.get("count", 0)
+                totals["pin_bytes"] += pins.get("bytes", 0)
+            totals["memory_store_entries"] += ms.get("entries", 0)
+            totals["memory_store_bytes"] += ms.get("ready_bytes", 0)
+            proc_rows[name] = {
+                "open_refs": open_refs,
+                "memory_store": ms,
+                "pins": {k: pins.get(k, 0) for k in ("count", "bytes")},
+            }
+        keep = sorted(
+            by_site.items(),
+            key=lambda kv: (-kv[1]["bytes"],
+                            -(kv[1]["objects"] + kv[1]["local_refs"])),
+        )
+        return {
+            "totals": totals,
+            "nodes": stores,
+            "by_callsite": dict(keep[: max(1, limit)]),
+            "truncated": len(keep) > limit,
+            "procs": proc_rows,
+            "leaks": sorted(
+                self._leak_flags.values(), key=lambda r: -r.get("count", 0)
+            ),
+        }
+
+    async def rpc_list_object_refs(self, peer, limit: int = 1000,
+                                   node: Optional[str] = None,
+                                   timeout_s: float = 5.0):
+        """Per-object census rows (the `ray memory` table): directory
+        objects with owner/call-site/tier/holders (newest ``limit``),
+        plus owner-local memory-store objects invisible to the directory,
+        attributed by the process fan-out."""
+        import collections as _c
+
+        procs = await self._dump_memory_fanout(node, limit, timeout_s)
+        store_index = self._store_object_index(procs)
+        # borrow/pin attribution per object from the process censuses
+        holders_by_oid: Dict[str, List[str]] = {}
+        local_rows: List[dict] = []
+        for name, d in procs.items():
+            if name.startswith("agent:") or not isinstance(d, dict):
+                continue
+            for row in d.get("objects", ()):
+                hexid = row["object_id"]
+                if row.get("local_only"):
+                    if len(local_rows) < limit:
+                        local_rows.append(
+                            {
+                                "object_id": hexid,
+                                "tier": "memory_store",
+                                "callsite": row.get("callsite", ""),
+                                "creator": name,
+                                "holders": [name],
+                                "local_refs": row.get("count", 0),
+                                "size": None,  # owner-private; size unknown
+                                "state": "READY",
+                                "pinned": bool(row.get("pinned")),
+                            }
+                        )
+                else:
+                    holders_by_oid.setdefault(hexid, []).append(name)
+        # Memory-store rows keep their slots: the owner-local tier is the
+        # one the directory can never show, so a full directory must not
+        # silently squeeze it out of the capped reply.
+        limit = max(1, limit)
+        dir_limit = max(1, limit - len(local_rows))
+        out = []
+        for oid, orec in _c.deque(self.objects.items(), maxlen=dir_limit):
+            hexid = oid.hex()
+            srow = store_index.get(hexid)
+            out.append(
+                {
+                    "object_id": hexid,
+                    "state": orec.state,
+                    "size": orec.size,
+                    "tier": self._object_tier(orec, srow),
+                    "callsite": orec.callsite,
+                    "creator": orec.creator,
+                    "holders": holders_by_oid.get(
+                        hexid, sorted(orec.holders)
+                    ),
+                    "locations": [n.hex() for n in orec.locations],
+                    "pinned": bool(srow and srow.get("pinned")),
+                    "is_error": orec.is_error,
+                }
+            )
+        return (out + local_rows)[:limit]
+
+    async def rpc_summarize_objects(self, peer, limit: int = 100):
+        """Controller-side object rollup (replaces the client pulling
+        100k full rows to count them): uncapped totals by state/tier,
+        call-site counts capped to the ``limit`` largest."""
+        by_state: Dict[str, int] = {}
+        by_tier: Dict[str, int] = {}
+        sites: Dict[str, dict] = {}
+        total_size = 0
+        # Same tier rule as summarize_memory (_object_tier), with the
+        # head store's spill view (local, no fan-out — agent-node spills
+        # show as shm here; full fidelity lives in rpc_summarize_memory).
+        spilled_here = self.head_store.spilled_ids()
+        _SPILLED_ROW = {"spilled": True}
+        for oid, orec in self.objects.items():
+            by_state[orec.state] = by_state.get(orec.state, 0) + 1
+            tier = self._object_tier(
+                orec, _SPILLED_ROW if oid.hex() in spilled_here else None
+            )
+            by_tier[tier] = by_tier.get(tier, 0) + 1
+            total_size += orec.size
+            site = orec.callsite or "(unknown)"
+            row = sites.setdefault(site, {"count": 0, "bytes": 0})
+            row["count"] += 1
+            row["bytes"] += orec.size
+        keep = sorted(sites.items(), key=lambda kv: -kv[1]["bytes"])
+        return {
+            "total": len(self.objects),
+            "total_size": total_size,
+            "by_state": by_state,
+            "by_tier": by_tier,
+            "callsites": dict(keep[: max(1, limit)]),
+            "truncated": len(keep) > limit,
+        }
+
+    def _memory_census_tick(self):
+        """Per-telemetry-sweep census work: the Grafana "Memory" gauges,
+        the open-ref growth (leak) detector, and the store-pressure
+        incident trigger. The object-table pass costs O(objects) of pure
+        Python on the controller loop, so its FREQUENCY is amortized to
+        the table size (one scan per ~50k records' worth of sweeps): at
+        envelope depth the leak sweeps thin out instead of stalling the
+        scheduler every telemetry tick."""
+        if not getattr(self.config, "memory_census", True):
+            return
+        m = _get_mem_metrics()
+        for nid, nrec in self.nodes.items():
+            # The head's heartbeat (built one line before this tick in
+            # _head_telemetry_loop) already carries a fresh stats() dict —
+            # don't pay the O(entries) store scan a second time per sweep.
+            store = (nrec.telemetry or {}).get("object_store") or (
+                self.head_store.stats() if nrec.peer is None else {}
+            )
+            tag = {"node": nid.hex()[:12]}
+            m["store_used"].set(store.get("used", 0), tag)
+            m["store_pinned"].set(store.get("pinned_bytes", 0), tag)
+            m["store_spilled"].set(store.get("spilled_bytes", 0), tag)
+            self._pressure_check_node(nid, store)
+        self._census_tick_n += 1
+        stride = max(1, len(self.objects) // 50_000)
+        if self._census_tick_n % stride == 0:
+            kinds = {"inline": 0, "shm": 0, "pending": 0, "failed": 0}
+            by_site: Dict[str, int] = {}
+            for orec in self.objects.values():
+                if orec.state == "PENDING":
+                    kinds["pending"] += 1
+                elif orec.state == "FAILED":
+                    kinds["failed"] += 1
+                elif orec.inline is not None:
+                    kinds["inline"] += 1
+                else:
+                    kinds["shm"] += 1
+                site = orec.callsite or "(unknown)"
+                by_site[site] = by_site.get(site, 0) + 1
+            for kind, n in kinds.items():
+                m["refs_open"].set(n, {"kind": kind})  # ray-tpu: lint-ignore[RTL004] — fixed 4-value tier vocabulary
+            self._leak_sweep(by_site)
+
+    def _leak_sweep(self, by_site: Dict[str, int]):
+        """Flag call-sites whose open-object count rose monotonically
+        across ``memory_leak_sweeps`` consecutive sweeps and sits above
+        ``memory_leak_min_refs`` — the ref-hoarder signature. Vocabulary
+        is bounded: client-side call-sites are interned under
+        ``memory_callsite_cap`` and the trend table caps at 512 entries."""
+        import collections as _c
+
+        sweeps = max(2, int(getattr(self.config, "memory_leak_sweeps", 5)))
+        floor = int(getattr(self.config, "memory_leak_min_refs", 32))
+        trends = self._mem_trends
+        for site, count in by_site.items():
+            dq = trends.get(site)
+            if dq is None:
+                if len(trends) >= 512:
+                    continue  # bounded vocabulary backstop
+                dq = trends[site] = _c.deque(maxlen=sweeps)
+            dq.append(count)
+        for site in [s for s in trends if s not in by_site]:
+            trends.pop(site, None)
+            self._leak_flags.pop(site, None)
+        for site, dq in trends.items():
+            window = list(dq)
+            cur = window[-1]
+            rising = (
+                len(window) == sweeps
+                and cur >= floor
+                and all(b > a for a, b in zip(window, window[1:]))
+            )
+            if rising:
+                flag = self._leak_flags.get(site)
+                if flag is None:
+                    self._leak_flags[site] = {
+                        "callsite": site,
+                        "count": cur,
+                        "growth": cur - window[0],
+                        "first_flagged": time.time(),
+                    }
+                    _get_mem_metrics()["leak_flags"].inc(
+                        1, {"callsite": site}  # ray-tpu: lint-ignore[RTL004] — bounded by the intern cap + trend-table cap
+                    )
+                    logger.warning(
+                        "memory leak suspect: %s — open refs rising "
+                        "monotonically over %d sweeps (now %d)",
+                        site, sweeps, cur,
+                    )
+                else:
+                    flag["count"] = cur
+                    flag["growth"] = cur - window[0]
+            elif site in self._leak_flags and cur <= window[0]:
+                self._leak_flags.pop(site, None)  # recovered
+
+    def _pressure_check_node(self, nid: NodeID, store: dict):
+        """Store-pressure incident trigger: occupancy past
+        ``memory_incident_occupancy_pct`` or eviction-loop churn past
+        ``memory_incident_spill_churn`` spills per sweep fires PR 9's
+        incident machinery with a memory autopsy bundle."""
+        pct = float(
+            getattr(self.config, "memory_incident_occupancy_pct", 0.95)
+        )
+        churn = int(getattr(self.config, "memory_incident_spill_churn", 200))
+        ops = int(store.get("spill_ops", 0) or 0)
+        prev = self._spill_ops_prev.get(nid)
+        self._spill_ops_prev[nid] = ops
+        cap = store.get("capacity") or 0
+        used = store.get("used", 0) or 0
+        reason = None
+        if pct > 0 and cap > 0 and used / cap >= pct:
+            reason = "occupancy"
+        elif churn > 0 and prev is not None and ops - prev >= churn:
+            reason = "spill_churn"
+        if reason is None:
+            return
+        from ray_tpu.util import profiling
+
+        # Pre-check the rate limit so a sustained-pressure store doesn't
+        # spawn a capture thread per sweep (incident() re-checks it
+        # atomically) — the slo_breach pattern.
+        min_interval = float(
+            self.config.profiling_incident_min_interval_s
+        )
+        if (
+            time.time() - profiling._incident_last.get("memory_pressure", 0.0)
+            < min_interval
+        ):
+            return
+        autopsy = self._memory_autopsy(nid, reason, store)
+        detail = {
+            "node": nid.hex()[:12],
+            "reason": reason,
+            "occupancy": round(used / cap, 4) if cap else None,
+            "spill_ops_delta": (ops - prev) if prev is not None else 0,
+        }
+        import threading as _t
+
+        _t.Thread(
+            target=profiling.incident,
+            args=("memory_pressure", detail),
+            kwargs={"extra_files": {
+                "memory.json": json.dumps(autopsy, indent=1, default=str)
+            }},
+            daemon=True,
+            name="memory-incident",
+        ).start()
+
+    def _memory_autopsy(self, nid: NodeID, reason: str, store: dict) -> dict:
+        """The autopsy bundle body: top call-sites by resident bytes,
+        per-node store stats, and the spill/delete queue depths — enough
+        to answer "who filled the store" from the incident dir alone."""
+        by_site: Dict[str, dict] = {}
+        scan = len(self.objects) <= 300_000
+        if scan:
+            for orec in self.objects.values():
+                if orec.state != "READY" or orec.inline is not None:
+                    continue
+                site = orec.callsite or "(unknown)"
+                row = by_site.setdefault(site, {"objects": 0, "bytes": 0})
+                row["objects"] += 1
+                row["bytes"] += orec.size
+        top = sorted(by_site.items(), key=lambda kv: -kv[1]["bytes"])[:20]
+        nodes = {}
+        for onid, nrec in self.nodes.items():
+            if nrec.peer is None:
+                nodes[onid.hex()[:12]] = self.head_store.stats()
+            else:
+                nodes[onid.hex()[:12]] = (
+                    (nrec.telemetry or {}).get("object_store") or {}
+                )
+        return {
+            "trigger_node": nid.hex()[:12],
+            "reason": reason,
+            "store": store,
+            "spill_queue": {
+                "deferred_deletes": store.get("deferred_deletes", 0),
+                "num_spilled": store.get("num_spilled", 0),
+                "spilled_bytes": store.get("spilled_bytes", 0),
+                "spill_ops": store.get("spill_ops", 0),
+            },
+            "top_callsites": dict(top),
+            "top_callsites_complete": scan,
+            "leaks": list(self._leak_flags.values()),
+            "nodes": nodes,
+        }
+
     def _drain_spawn_events(self):
         """Fold worker SPAWNED events recorded by in-process spawns (the
         controller doubles as the head's agent) into the flight recorder.
@@ -2499,44 +3039,109 @@ class Controller:
             total = total + n.available
         return total.to_dict()
 
+    def _node_row(self, nid: NodeID, node: NodeRecord, devstate: Dict[str, dict]) -> dict:
+        res = self.cluster.nodes.get(nid)
+        devices = []
+        for payload in devstate.values():
+            if (payload.get("node_id") or "") == nid.hex():
+                pid = payload.get("pid")
+                devices.extend({**d, "pid": pid} for d in payload.get("devices", ()))
+        return {
+            "node_id": nid.hex(),
+            "state": node.state,
+            "is_head": node.peer is None,
+            "num_workers": len(node.workers),
+            "agent_pid": node.agent_pid,
+            "hostname": node.hostname,
+            "provider_instance_id": node.provider_instance_id,
+            "resources": res.to_dict() if res else {},
+            "telemetry": node.telemetry,
+            "devices": devices,
+        }
+
     async def rpc_list_nodes(self, peer):
-        out = []
         devstate = self._live_device_state()
-        for nid, node in self.nodes.items():
-            res = self.cluster.nodes.get(nid)
-            devices = []
-            for payload in devstate.values():
-                if (payload.get("node_id") or "") == nid.hex():
-                    pid = payload.get("pid")
-                    devices.extend({**d, "pid": pid} for d in payload.get("devices", ()))
-            out.append(
-                {
-                    "node_id": nid.hex(),
-                    "state": node.state,
-                    "is_head": node.peer is None,
-                    "num_workers": len(node.workers),
-                    "agent_pid": node.agent_pid,
-                    "hostname": node.hostname,
-                    "provider_instance_id": node.provider_instance_id,
-                    "resources": res.to_dict() if res else {},
-                    "telemetry": node.telemetry,
-                    "devices": devices,
-                }
-            )
-        return out
+        return [
+            self._node_row(nid, node, devstate)
+            for nid, node in self.nodes.items()
+        ]
+
+    @staticmethod
+    def _worker_row(w: WorkerRecord, hostname: str) -> dict:
+        return {
+            "worker_id": w.worker_id.hex(),
+            "node_id": w.node_id.hex(),
+            "state": w.state,
+            "pid": w.pid,
+            "hostname": hostname,
+            "actor_id": w.actor_id.hex() if w.actor_id else None,
+        }
+
+    def _hostname_of(self, node_id: NodeID) -> str:
+        node = self.nodes.get(node_id)
+        return node.hostname if node is not None else "localhost"
 
     async def rpc_list_workers(self, peer):
         return [
-            {
-                "worker_id": w.worker_id.hex(),
-                "node_id": w.node_id.hex(),
-                "state": w.state,
-                "pid": w.pid,
-                "hostname": self.nodes[w.node_id].hostname if w.node_id in self.nodes else "localhost",
-                "actor_id": w.actor_id.hex() if w.actor_id else None,
-            }
+            self._worker_row(w, self._hostname_of(w.node_id))
             for w in self.workers.values()
         ]
+
+    # -- targeted gets (reference: the state API's get_* endpoints; a
+    # point lookup must not pull a 100k-row list_* dump over the wire) --
+    async def rpc_get_node(self, peer, node_id: str):
+        try:
+            nid = NodeID.from_hex(node_id)
+        except (ValueError, TypeError):
+            return None
+        node = self.nodes.get(nid)
+        if node is None:
+            return None
+        return self._node_row(nid, node, self._live_device_state())
+
+    async def rpc_get_worker(self, peer, worker_id: str):
+        try:
+            wid = WorkerID.from_hex(worker_id)
+        except (ValueError, TypeError):
+            return None
+        w = self.workers.get(wid)
+        if w is None:
+            return None
+        return self._worker_row(w, self._hostname_of(w.node_id))
+
+    async def rpc_get_task(self, peer, task_id: str):
+        try:
+            tid = TaskID.from_hex(task_id)
+        except (ValueError, TypeError):
+            return None
+        rec = self.tasks.get(tid)
+        if rec is not None:
+            return {
+                "task_id": tid.hex(),
+                "name": rec.spec.name,
+                "state": rec.state,
+                "type": rec.spec.task_type.name,
+                "node_id": rec.node_id.hex() if rec.node_id else None,
+            }
+        # direct-push tasks live only in the event-derived rows
+        return self._direct_task_rows.get(task_id)
+
+    async def rpc_get_actor(self, peer, actor_id: str):
+        try:
+            aid = ActorID.from_hex(actor_id)
+        except (ValueError, TypeError):
+            return None
+        a = self.actors.get(aid)
+        if a is None:
+            return None
+        return {
+            "actor_id": a.actor_id.hex(),
+            "state": a.state,
+            "name": a.name,
+            "num_restarts": a.num_restarts,
+            "node_id": a.node_id.hex() if a.node_id else None,
+            "death_reason": a.death_reason,
+        }
 
     async def rpc_list_tasks(self, peer, limit: int = 1000):
         import collections as _c
@@ -2641,6 +3246,9 @@ class Controller:
                     "size": rec.size,
                     "is_error": rec.is_error,
                     "locations": [n.hex() for n in rec.locations],
+                    "callsite": rec.callsite,
+                    "creator": rec.creator,
+                    "holders": len(rec.holders),
                 }
             )
         return out
@@ -2802,6 +3410,13 @@ class Controller:
                     "capacity": store.get("capacity", 0),
                     "num_objects": store.get("num_objects", 0),
                     "num_spilled": store.get("num_spilled", 0),
+                    # memory-census columns: spill-dir disk usage, store-
+                    # side pins, and the deferred-delete queue depth
+                    "spilled_bytes": store.get("spilled_bytes", 0),
+                    "pinned_slots": store.get("pinned_slots", 0),
+                    "pinned_bytes": store.get("pinned_bytes", 0),
+                    "deferred_deletes": store.get("deferred_deletes", 0),
+                    "spill_ops": store.get("spill_ops", 0),
                 },
                 "resources": {
                     "total": res.total.to_dict() if res else {},
@@ -2908,6 +3523,12 @@ class Controller:
             sample = node_telemetry.build_node_sample(cpu, self.head_store)
             sample["ts"] = time.time()
             node.telemetry = sample
+            # Memory census sweep: Grafana gauges, the open-ref growth
+            # (leak) detector, and the store-pressure incident trigger.
+            try:
+                self._memory_census_tick()
+            except Exception:  # noqa: BLE001 — census must not kill telemetry
+                logger.exception("memory census tick failed")
             # Metrics recorded IN the controller process (head-side
             # object transfers, chunk serving) have no CoreWorker flusher
             # — fold them straight into the aggregation.
@@ -3281,6 +3902,11 @@ class Controller:
         # other hosts must reach the control plane).
         server, self.port = await rpc.serve(self, host=bind_host(), port=port)
         self._loop = asyncio.get_running_loop()
+        # The controller's own incident captures (store pressure, lock
+        # watchdog) resolve the session via this env hint — the spawned
+        # controller process otherwise has no session marker (workers get
+        # it from spawn_worker).
+        os.environ.setdefault("RAY_TPU_SESSION_DIR", self.session_dir)
         # Profiling: continuous incident sampler (off unless configured)
         # + flight-recorder tail so controller incident bundles carry the
         # scheduler context alongside stacks/samples.

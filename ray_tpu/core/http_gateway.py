@@ -11,7 +11,9 @@ Routes:
                             events|placement_groups|cluster_resources|
                             available_resources|summarize_resources|
                             summarize_lifecycle|summarize_tasks|
-                            lifecycle_events|compile
+                            summarize_objects|lifecycle_events|compile
+  GET /api/v0/memory[?limit=&node=]      cluster memory census rollup
+  GET /api/v0/object_refs[?limit=&node=] per-object census rows
   GET /api/serve/engine     serve LLM-engine flight-recorder snapshots
   GET /api/v0/profile/stacks[?node=&actor=]   cluster-wide stack dump
   GET /api/v0/profile/cpu[?duration=&hz=&node=]  sampling CPU profile
@@ -45,9 +47,19 @@ _STATE_ROUTES = {
     "summarize_resources": "rpc_summarize_resources",
     "summarize_lifecycle": "rpc_summarize_lifecycle",
     "summarize_tasks": "rpc_summarize_tasks",
+    "summarize_objects": "rpc_summarize_objects",
+    # cluster-wide memory census (fan-out; reference: `ray memory` /
+    # the dashboard memory view) — ?limit=&node= supported
+    "memory": "rpc_summarize_memory",
+    "object_refs": "rpc_list_object_refs",
     "lifecycle_events": "rpc_list_lifecycle_events",
     "compile": "rpc_compile_state",
 }
+
+# routes accepting ?limit= (and ?node= where listed below)
+_LIMIT_ROUTES = ("tasks", "objects", "events", "memory", "object_refs",
+                 "summarize_objects")
+_NODE_ROUTES = ("memory", "object_refs")
 
 
 def start_http_gateway(controller, loop: asyncio.AbstractEventLoop, port: int) -> int:
@@ -213,13 +225,18 @@ def start_http_gateway(controller, loop: asyncio.AbstractEventLoop, port: int) -
                         self._send(404, b'{"error": "unknown resource"}', "application/json")
                         return
                     kwargs = {}
-                    if "?" in self.path and what in ("tasks", "objects", "events"):
+                    if "?" in self.path:
                         from urllib.parse import parse_qs, urlsplit
 
                         q = parse_qs(urlsplit(self.path).query)
-                        if q.get("limit"):
+                        if q.get("limit") and what in _LIMIT_ROUTES:
                             kwargs["limit"] = int(q["limit"][0])
-                    data = call(method, **kwargs)
+                        if q.get("node") and what in _NODE_ROUTES:
+                            kwargs["node"] = q["node"][0]
+                    # the memory census fans out to every process — give
+                    # it the profile-route timeout, not the default 10s
+                    timeout = 30 if what in _NODE_ROUTES else 10
+                    data = call(method, _timeout=timeout, **kwargs)
                     self._send(200, json.dumps(data, default=str).encode(), "application/json")
                 else:
                     self._send(404, b"not found", "text/plain")

@@ -1,0 +1,230 @@
+"""Per-process object & memory census with creation call-site attribution.
+
+Reference: ``ray memory`` / the dashboard memory view, built on the core
+worker's reference counting (src/ray/core_worker/reference_count.cc keeps
+per-ref ``call_site`` strings captured at creation;
+python/ray/util/state/common.py ObjectState carries them to the user).
+The question this layer answers is the one an OOM'd object store poses:
+**who holds it** — which file:line created the refs that pin store memory.
+
+Three pieces, all cheap enough for the put/submit hot path:
+
+* **call-site capture** — :func:`capture_callsite` walks at most a handful
+  of frames to the first frame outside the ray_tpu package and interns the
+  ``file.py:line:func`` string in a bounded table (:class:`CallsiteTable`,
+  ``memory_callsite_cap``): past the cap every new site collapses into
+  ``(other)`` so the vocabulary — and any metric tag built from it — stays
+  bounded. A per-code-object cache makes repeat captures a dict hit.
+* **attribution** — the CoreWorker's RefTracker maps live ref keys to
+  their creation site (client.py); puts/task submissions attribute at
+  creation, deserialized borrows report as ``(borrowed)``.
+* **process dump** — :func:`dump` snapshots THIS process's census: open
+  local refs grouped by call-site, owner-local memory-store occupancy,
+  and live pinned arena views (PR 5's zero-copy pins, registered by
+  PlasmaClient). Every process answers ``rpc_dump_memory`` with it; the
+  controller fans out and merges (controller.rpc_summarize_memory).
+
+Disabled via the ``memory_census`` config (the envelope A/B knob):
+capture returns ``""`` and the dump degrades to counts without sites.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, Optional
+
+# Trailing separator: a sibling directory whose name merely starts with
+# "ray_tpu" (ray_tpu_contrib/...) must not be classified as internal.
+_PKG_PREFIX = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) + os.sep
+
+OVERFLOW_SITE = "(other)"
+BORROWED_SITE = "(borrowed)"
+
+_enabled = True
+
+
+def set_enabled(flag: bool):
+    global _enabled
+    _enabled = bool(flag)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+class CallsiteTable:
+    """Bounded intern table for creation call-sites.
+
+    The table bounds the attribution vocabulary (and therefore anything
+    keyed by it — census groups, leak-detector trend entries, metric
+    tags): the first ``cap`` distinct sites intern; later ones all map to
+    ``(other)``. Thread-safe; lookups after interning are lock-free dict
+    hits.
+    """
+
+    def __init__(self, cap: int = 512):
+        self.cap = max(8, int(cap))
+        self._lock = threading.Lock()
+        # (filename, lineno, funcname) -> interned site string
+        self._by_frame: Dict[tuple, str] = {}
+        self._sites: Dict[str, None] = {}
+
+    def intern_frame(self, filename: str, lineno: int, func: str) -> str:
+        key = (filename, lineno, func)
+        site = self._by_frame.get(key)
+        if site is not None:
+            return site
+        with self._lock:
+            site = self._by_frame.get(key)
+            if site is not None:
+                return site
+            if len(self._sites) >= self.cap:
+                site = OVERFLOW_SITE
+            else:
+                # trim to the last two path components for readability
+                # (full paths repeat the venv prefix on every row)
+                parts = filename.replace("\\", "/").rsplit("/", 2)
+                short = "/".join(parts[-2:]) if len(parts) > 1 else filename
+                site = f"{short}:{lineno}:{func}"
+                self._sites[site] = None
+            self._by_frame[key] = site
+            return site
+
+    def intern(self, site: str) -> str:
+        """Intern an already-formatted site label (task names etc.)."""
+        if site in self._sites:
+            return site
+        with self._lock:
+            if site in self._sites:
+                return site
+            if len(self._sites) >= self.cap:
+                return OVERFLOW_SITE
+            self._sites[site] = None
+            return site
+
+    def __len__(self):
+        return len(self._sites)
+
+
+_table: Optional[CallsiteTable] = None
+_table_lock = threading.Lock()
+
+
+def _get_table() -> CallsiteTable:
+    global _table
+    if _table is None:
+        with _table_lock:
+            if _table is None:
+                from ray_tpu.util.profiling import _config_value
+
+                _table = CallsiteTable(
+                    int(_config_value("memory_callsite_cap", 512))
+                )
+    return _table
+
+
+def _reset_for_tests(cap: int = 512):
+    global _table, _enabled
+    with _table_lock:
+        _table = CallsiteTable(cap)
+    _enabled = True
+
+
+def capture_callsite(depth: int = 1) -> str:
+    """The creating USER frame as an interned ``file.py:line:func``
+    string, or ``""`` when the census is disabled. Walks outward from the
+    caller until it leaves the ray_tpu package (bounded walk), so
+    ``ray_tpu.put(...)`` in app code attributes to the app line, not to
+    client.py."""
+    if not _enabled:
+        return ""
+    try:
+        f = sys._getframe(depth)  # 1 = capture_callsite's direct caller
+    except ValueError:  # shallow stack (embedding oddities)
+        return "(unknown)"
+    hops = 0
+    while f is not None and hops < 32:
+        fname = f.f_code.co_filename
+        if not fname.startswith(_PKG_PREFIX):
+            return _get_table().intern_frame(
+                fname, f.f_lineno, f.f_code.co_name
+            )
+        f = f.f_back
+        hops += 1
+    return "(internal)"
+
+
+def task_site(name: str) -> str:
+    """Interned label for task-return objects (``(task) <name>``) — task
+    names are the natural call-site for values a task produced."""
+    if not _enabled:
+        return ""
+    return _get_table().intern(f"(task) {name}")
+
+
+# ---------------------------------------------------------------------------
+# Process census dump (the rpc_dump_memory leg)
+# ---------------------------------------------------------------------------
+def dump(limit: int = 1000) -> dict:
+    """Snapshot THIS process's object/memory census.
+
+    Shape::
+
+        {kind: "process", process, pid, worker_id, mode,
+         refs: {site: {count, pinned}},          # open local refs by site
+         objects: [{object_id, callsite, count, local_only, pinned}, ...],
+         memory_store: {entries, ready_bytes, pending, shm},
+         pins: {count, bytes, objects: [hex, ...]}}
+
+    Touches only the ref tracker's lock (briefly) and the pin registry;
+    safe to answer from any process at any time.
+    """
+    from ray_tpu.core import api
+    from ray_tpu.core import object_store as _os_mod
+    from ray_tpu.util.profiling import process_label
+
+    out = {
+        "kind": "process",
+        "process": process_label(),
+        "pid": os.getpid(),
+        "worker_id": None,
+        "mode": None,
+        "refs": {},
+        "objects": [],
+        "memory_store": {},
+        "pins": {},
+    }
+    pins = _os_mod.live_pin_stats()
+    out["pins"] = pins
+    pinned_keys = _os_mod.live_pin_keys()  # uncapped, unlike pins["objects"]
+    core = api._global_worker
+    if core is None:
+        return out
+    out["worker_id"] = core.worker_id.hex()
+    out["mode"] = core.mode
+    out["memory_store"] = core.memory_store.stats()
+    counts, sites = core.refs.census_snapshot()
+    by_site: Dict[str, dict] = {}
+    rows = []
+    for key, count in counts.items():
+        site = sites.get(key) or BORROWED_SITE
+        row = by_site.setdefault(site, {"count": 0, "pinned": 0})
+        row["count"] += count
+        hexid = key.hex()
+        if hexid in pinned_keys:
+            row["pinned"] += 1
+        if len(rows) < limit:
+            rows.append(
+                {
+                    "object_id": hexid,
+                    "callsite": site,
+                    "count": count,
+                    "local_only": core.memory_store.is_local_only(key),
+                    "pinned": hexid in pinned_keys,
+                }
+            )
+    out["refs"] = by_site
+    out["objects"] = rows
+    out["truncated"] = len(counts) > len(rows)
+    return out

@@ -139,6 +139,35 @@ class LocalMemoryStore:
             del self._entries[key]
             return True
 
+    def stats(self) -> dict:
+        """Census snapshot of the owner-local store: entry counts by
+        state/kind and resident payload bytes (ready inline entries only
+        — shm-kind entries hold no payload here, spilled/pending none).
+        O(entries) under the store lock; entry counts are bounded by the
+        process's live refs, so this stays cheap."""
+        entries = ready_bytes = pending = shm = errors = 0
+        with self._lock:
+            for e in self._entries.values():
+                entries += 1
+                if not e.ready:
+                    pending += 1
+                    continue
+                if e.kind == "shm":
+                    shm += 1
+                    continue
+                payload, is_err = e._value
+                if is_err:
+                    errors += 1
+                if isinstance(payload, (bytes, bytearray, memoryview)):
+                    ready_bytes += len(payload)
+        return {
+            "entries": entries,
+            "ready_bytes": ready_bytes,
+            "pending": pending,
+            "shm": shm,
+            "errors": errors,
+        }
+
     def is_local_only(self, key: bytes) -> bool:
         """True for entries that exist here and were never promoted to the
         controller (ref flushes for these stay local)."""

@@ -559,6 +559,17 @@ class NodeAgent:
 
         return profiling.sample_async(duration_s, hz)
 
+    def rpc_dump_memory(self, peer, limit: int = 1000):
+        """This node's store leg of the memory census fan-out: live
+        store stats (occupancy, spill-dir bytes, pins, deferred deletes)
+        plus per-object rows for tier attribution."""
+        return {
+            "kind": "store",
+            "node_id": self.node_id.hex(),
+            "store": self.store.stats(),
+            "objects": self.store.object_rows(limit),
+        }
+
     def on_disconnect(self, peer):
         wid = peer.meta.get("direct_wid")
         if wid is not None:

@@ -71,6 +71,15 @@ class ObjectRef:
             lst.append(self.id)
         return (ObjectRef, (self.id,))
 
+    def call_site(self) -> str:
+        """The creation call-site the memory census recorded for this ref
+        (``file.py:line:func`` for puts, ``(task) <name>`` for task
+        returns; ``""`` for borrowed refs or with the census disabled).
+        Reference: ``ObjectRef.call_site()`` backed by the reference
+        counter's per-ref call_site string."""
+        t = _tracker
+        return t.site_of(self.id.binary()) if t is not None else ""
+
     def future(self):
         """A concurrent.futures.Future resolving to the object's value."""
         from ray_tpu.core.api import _require_worker

@@ -37,6 +37,53 @@ logger = logging.getLogger("ray_tpu.object_store")
 
 _ARENA_DISABLED = os.environ.get("RAY_TPU_DISABLE_NATIVE_ARENA") == "1"
 
+# Live zero-copy pin registry for THIS process (reference: the plasma
+# client tracks its own in-use buffers — client.cc objects_in_use_).
+# view_pinned registers arena pins here so the memory census
+# (core/memory_census.py rpc_dump_memory) can attribute "who pins the
+# store" per process; release() unregisters. Keyed oid bytes -> [refs,
+# bytes] (one object may be pinned by several concurrent readers).
+_pins_lock = threading.Lock()
+_live_pins: Dict[bytes, list] = {}
+
+
+def _pin_register(key: bytes, size: int):
+    with _pins_lock:
+        row = _live_pins.get(key)
+        if row is None:
+            _live_pins[key] = [1, size]
+        else:
+            row[0] += 1
+
+
+def _pin_unregister(key: bytes):
+    with _pins_lock:
+        row = _live_pins.get(key)
+        if row is not None:
+            row[0] -= 1
+            if row[0] <= 0:
+                del _live_pins[key]
+
+
+def live_pin_stats() -> dict:
+    """This process's live pinned arena views: {count, bytes, objects}.
+    The display list caps at 256 ids (``objects_truncated`` set when it
+    did); per-object membership checks must use :func:`live_pin_keys`."""
+    with _pins_lock:
+        return {
+            "count": sum(r[0] for r in _live_pins.values()),
+            "bytes": sum(r[1] for r in _live_pins.values()),
+            "objects": [k.hex() for k in list(_live_pins)[:256]],
+            "objects_truncated": len(_live_pins) > 256,
+        }
+
+
+def live_pin_keys() -> set:
+    """Full hex-id set of this process's live pins (uncapped — the
+    census's per-object attribution source)."""
+    with _pins_lock:
+        return {k.hex() for k in _live_pins}
+
 
 def _try_arena():
     if _ARENA_DISABLED:
@@ -111,6 +158,12 @@ class PlasmaStore:
         # reader held a pinned view at the time; retried (and freed) on
         # later eviction passes once the pins drop.
         self._deferred_deletes: set = set()
+        # Spill-loop churn counter (monotonic): one tick per object
+        # spilled to disk. The controller's store-pressure detector
+        # watches the DELTA per telemetry sweep — a store thrashing the
+        # eviction loop spills continuously even when occupancy hovers
+        # below the incident threshold.
+        self.spill_ops = 0
         self._lock = threading.Lock()
         self._arena = None
         arena_mod = _try_arena()
@@ -219,6 +272,7 @@ class PlasmaStore:
             if ve is not None:
                 ve.spilled = True
                 ve.in_arena = False
+            self.spill_ops += 1
 
     def seal(self, oid: ObjectID):
         with self._lock:
@@ -358,6 +412,7 @@ class PlasmaStore:
             else:
                 shutil.move(self._shm_path(oid), self._spill_path(oid))
             e.spilled = True
+            self.spill_ops += 1
             self.used -= e.size
 
     def _restore_locked(self, oid: ObjectID, e: PlasmaEntry):
@@ -402,11 +457,33 @@ class PlasmaStore:
 
     def stats(self) -> dict:
         with self._lock:
+            spilled_bytes = pinned_slots = pinned_bytes = 0
+            num_spilled = 0
+            for e in self._entries.values():
+                if e.spilled:
+                    num_spilled += 1
+                    spilled_bytes += e.size
+                if e.pinned > 0:
+                    pinned_slots += 1
+                    pinned_bytes += e.size
             out = {
                 "capacity": self.capacity,
                 "used": self.used,
                 "num_objects": len(self._entries),
-                "num_spilled": sum(1 for e in self._entries.values() if e.spilled),
+                "num_spilled": num_spilled,
+                # Spill-dir disk usage, accounted from entry sizes (covers
+                # cloud spill URIs, where statvfs can't see the bytes).
+                "spilled_bytes": spilled_bytes,
+                # Store-side pins only (task-arg/broadcast pins taken via
+                # PlasmaStore.pin); reader zero-copy pins live in each
+                # reading process's census (live_pin_stats).
+                "pinned_slots": pinned_slots,
+                "pinned_bytes": pinned_bytes,
+                # Refcount-dead arena slots whose delete is deferred
+                # behind a live reader pin — the spill queue depth of the
+                # delete path.
+                "deferred_deletes": len(self._deferred_deletes),
+                "spill_ops": self.spill_ops,
                 "native_arena": self._arena is not None,
             }
             if self._arena is not None:
@@ -414,6 +491,33 @@ class PlasmaStore:
                 out["used"] += a["used"]
                 out["arena"] = a
             return out
+
+    def spilled_ids(self) -> set:
+        """Hex ids of currently-spilled entries — the cheap per-object
+        spill lookup for summaries (no row materialization)."""
+        with self._lock:
+            return {
+                oid.hex() for oid, e in self._entries.items() if e.spilled
+            }
+
+    def object_rows(self, limit: int = 1000) -> list:
+        """Per-object store rows for the memory census fan-out (newest-
+        insertion tail, O(limit) like the controller's list RPCs):
+        {object_id, size, sealed, pinned, spilled, in_arena}."""
+        import collections as _c
+
+        with self._lock:
+            return [
+                {
+                    "object_id": oid.hex(),
+                    "size": e.size,
+                    "sealed": e.sealed,
+                    "pinned": e.pinned,
+                    "spilled": e.spilled,
+                    "in_arena": e.in_arena,
+                }
+                for oid, e in _c.deque(self._entries.items(), maxlen=limit)
+            ]
 
     def destroy(self):
         if self._arena is not None:
@@ -563,13 +667,16 @@ class PlasmaClient:
             if buf is not None:
                 lock = threading.Lock()
                 released = [False]
+                key = oid.binary()
+                _pin_register(key, size)
 
                 def release():
                     with lock:
                         if released[0]:
                             return
                         released[0] = True
-                    arena.pin(oid.binary(), -1)
+                    _pin_unregister(key)
+                    arena.pin(key, -1)
 
                 return buf.view(), release
             arena.pin(oid.binary(), -1)  # unsealed or raced away

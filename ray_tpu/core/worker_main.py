@@ -183,6 +183,14 @@ class WorkerHandler:
 
         return profiling.device_trace_control(action, capture, base_dir)
 
+    def rpc_dump_memory(self, peer, limit: int = 1000):
+        """This worker's object/memory census (`ray-tpu memory` fan-out
+        leg): open local refs by creation call-site, owner-local memory
+        store occupancy, and live zero-copy arena pins."""
+        from ray_tpu.core import memory_census
+
+        return memory_census.dump(limit)
+
     def rpc_pubsub_msg(self, peer, channel: str, message):
         from ray_tpu.experimental.pubsub import _deliver
 
@@ -504,8 +512,12 @@ class TaskExecutor:
                             f"expected num_returns={spec.num_returns}"
                         )
                 from ray_tpu.core.client import _serialize_parts_capturing
+                from ray_tpu.core.memory_census import task_site
                 from ray_tpu.utils.serialization import assemble_parts
 
+                # census attribution label — "" (no-op) when the census
+                # is disabled; interned, so unique task names stay bounded
+                site = task_site(spec.name)
                 for oid, value in zip(spec.return_ids(), values):
                     meta, raws, total, contained = _serialize_parts_capturing(value)
                     if contained:
@@ -516,7 +528,8 @@ class TaskExecutor:
                         data = assemble_parts(meta, raws)
                         if contained:
                             self.core._call(
-                                "object_put_inline", oid, data, False, contained
+                                "object_put_inline", oid, data, False, contained,
+                                callsite=site,
                             )
                         # 5th element: globally registered — the caller
                         # must mark its entry promoted so ref flushes
@@ -528,6 +541,7 @@ class TaskExecutor:
                         self.core._call(
                             "object_put_shm", oid, total, self.core.node_id,
                             False, contained or [],
+                            callsite=site,
                         )
                         results.append((oid, "shm"))
             except Exception:  # noqa: BLE001 — unpicklable results
@@ -602,6 +616,7 @@ class TaskExecutor:
         from ray_tpu.utils.ids import ObjectID
 
         from ray_tpu.core.client import _serialize_capturing
+        from ray_tpu.core.memory_census import task_site as _task_site
 
         index = 0
         error = None
@@ -618,7 +633,10 @@ class TaskExecutor:
                     break
                 oid = ObjectID.for_task_return(spec.task_id, index)
                 data, contained = _serialize_capturing(item)
-                self.core.put_serialized(oid, data, contained=contained)
+                self.core.put_serialized(
+                    oid, data, contained=contained,
+                    callsite=_task_site(spec.name),
+                )
                 self.core._call("stream_item", spec.task_id, index)
                 index += 1
         except Exception as e:  # noqa: BLE001 — mid-stream error → final item

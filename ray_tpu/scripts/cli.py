@@ -12,10 +12,10 @@ Usage:
   ray-tpu status
   ray-tpu submit -- python my_script.py              # run as a job
   ray-tpu job list | job logs ID | job stop ID
-  ray-tpu summary tasks|actors|objects
+  ray-tpu summary tasks|actors|objects|memory|lifecycle|rl|profiling
   ray-tpu timeline [--output FILE]
   ray-tpu profile stacks|cpu|device|incidents|captures [...]
-  ray-tpu memory
+  ray-tpu memory [--node N] [--leaks] [--limit K] [--offline] [--json]
   ray-tpu logs [FILENAME]
   ray-tpu microbenchmark
 """
@@ -288,6 +288,7 @@ def cmd_summary(args):
         "tasks": state.summarize_tasks,
         "actors": state.summarize_actors,
         "objects": state.summarize_objects,
+        "memory": state.summarize_memory,
         "lifecycle": state.summarize_lifecycle,
         "rl": state.summarize_rl,
         "profiling": state.summarize_profiling,
@@ -331,11 +332,158 @@ def cmd_stack(args):
     return 0
 
 
+def _render_memory(summary: dict, leaks_only: bool = False, out=print):
+    """The `ray-tpu memory` census view (reference: `ray memory` + the
+    dashboard memory view): per-node store occupancy, open objects
+    grouped by creation call-site across all tiers, process censuses,
+    and the leak detector's flags."""
+    totals = summary.get("totals", {})
+    leaks = summary.get("leaks", [])
+    if not leaks_only:
+        out(
+            f"objects: {totals.get('objects', 0)}  "
+            f"inline {_gb(totals.get('inline_bytes'))} GB  "
+            f"shm {_gb(totals.get('shm_bytes'))} GB  "
+            f"spilled {_gb(totals.get('spilled_bytes'))} GB"
+        )
+        out(
+            f"open local refs: {totals.get('open_refs', 0)}  "
+            f"zero-copy pins: {totals.get('pins', 0)} "
+            f"({_gb(totals.get('pin_bytes'))} GB)  "
+            f"memory-store entries: {totals.get('memory_store_entries', 0)}"
+        )
+        out("")
+        out(
+            f"{'node':<14}{'store GB':>12}{'objects':>9}{'spilled GB':>12}"
+            f"{'pins':>6}{'deferred':>10}"
+        )
+        for nid, store in summary.get("nodes", {}).items():
+            st = f"{_gb(store.get('used'))}/{_gb(store.get('capacity'))}"
+            out(
+                f"{nid[:12]:<14}{st:>12}{store.get('num_objects', 0):>9}"
+                f"{_gb(store.get('spilled_bytes')):>12}"
+                f"{store.get('pinned_slots', 0):>6}"
+                f"{store.get('deferred_deletes', 0):>10}"
+            )
+        out("")
+        rows = summary.get("by_callsite", {})
+        if rows:
+            out("open objects by creation call-site"
+                + (" (truncated)" if summary.get("truncated") else "") + ":")
+            out(
+                f"  {'objects':>8}{'refs':>7}{'pins':>6}{'MB':>10}"
+                f"{'spilled MB':>12}  call-site"
+            )
+            for site, r in rows.items():
+                out(
+                    f"  {r.get('objects', 0):>8}{r.get('local_refs', 0):>7}"
+                    f"{r.get('pins', 0):>6}"
+                    f"{(r.get('bytes', 0) or 0) / (1 << 20):>10.1f}"
+                    f"{(r.get('spilled_bytes', 0) or 0) / (1 << 20):>12.1f}"
+                    f"  {site}"
+                )
+        procs = summary.get("procs", {})
+        if procs:
+            out("")
+            out("per-process census:")
+            for name, p in sorted(procs.items()):
+                if p.get("error"):
+                    out(f"  {name}: !! {p['error']}")
+                    continue
+                ms = p.get("memory_store", {})
+                pins = p.get("pins", {})
+                out(
+                    f"  {name}: {p.get('open_refs', 0)} open refs, "
+                    f"{ms.get('entries', 0)} memory-store entries "
+                    f"({(ms.get('ready_bytes', 0) or 0) / (1 << 20):.1f} MB), "
+                    f"{pins.get('count', 0)} pins"
+                )
+    if leaks:
+        out("")
+        out("!! leak suspects (open refs rising monotonically):")
+        for r in leaks:
+            out(
+                f"  {r.get('count', 0):>7} open (+{r.get('growth', 0)})  "
+                f"{r.get('callsite', '?')}"
+            )
+    elif leaks_only:
+        out("no leak suspects flagged")
+
+
+def _memory_fixture() -> dict:
+    """Canned summarize_memory()-shaped data for `memory --offline`:
+    exercises every rendering path (tiers, pins, procs, leaks) with no
+    cluster — the tier-1 smoke that keeps the view from rotting."""
+    return {
+        "totals": {
+            "objects": 1312, "inline_bytes": 3 << 20,
+            "shm_bytes": 6 << 30, "spilled_bytes": 2 << 30,
+            "open_refs": 1840, "pins": 3, "pin_bytes": 192 << 20,
+            "memory_store_entries": 24, "memory_store_bytes": 1 << 20,
+        },
+        "nodes": {
+            "aabbccddee00": {
+                "used": 5 << 30, "capacity": 8 << 30, "num_objects": 900,
+                "num_spilled": 120, "spilled_bytes": 2 << 30,
+                "pinned_slots": 3, "pinned_bytes": 192 << 20,
+                "deferred_deletes": 2, "spill_ops": 804,
+            },
+            "ffee00112233": {
+                "used": 1 << 30, "capacity": 8 << 30, "num_objects": 412,
+                "num_spilled": 0, "spilled_bytes": 0,
+                "pinned_slots": 0, "pinned_bytes": 0,
+                "deferred_deletes": 0, "spill_ops": 0,
+            },
+        },
+        "by_callsite": {
+            "app/train.py:91:load_shards": {
+                "objects": 800, "bytes": 5 << 30, "spilled_bytes": 2 << 30,
+                "local_refs": 820, "pins": 3,
+                "tiers": {"shm": 680, "spilled": 120},
+            },
+            "(task) preprocess": {
+                "objects": 400, "bytes": 1 << 30, "spilled_bytes": 0,
+                "local_refs": 400, "pins": 0, "tiers": {"shm": 400},
+            },
+            "app/eval.py:12:collect": {
+                "objects": 112, "bytes": 3 << 20, "spilled_bytes": 0,
+                "local_refs": 620, "pins": 0, "tiers": {"inline": 112},
+            },
+        },
+        "truncated": False,
+        "procs": {
+            "driver:0": {
+                "open_refs": 1220,
+                "memory_store": {"entries": 24, "ready_bytes": 1 << 20,
+                                 "pending": 2, "shm": 4},
+                "pins": {"count": 0, "bytes": 0},
+            },
+            "worker:aaaa0000:pid201": {
+                "open_refs": 620,
+                "memory_store": {"entries": 0, "ready_bytes": 0},
+                "pins": {"count": 3, "bytes": 192 << 20},
+            },
+            "worker:bbbb0000:pid202": {"error": "timed out"},
+        },
+        "leaks": [
+            {"callsite": "app/eval.py:12:collect", "count": 620,
+             "growth": 480, "first_flagged": 0.0},
+        ],
+    }
+
+
 def cmd_memory(args):
+    if args.offline:
+        _render_memory(_memory_fixture(), leaks_only=args.leaks)
+        return 0
     from ray_tpu.util import state
 
     _connect()
-    print(json.dumps(state.summarize_objects(), indent=2))
+    summary = state.summarize_memory(limit=args.limit, node=args.node)
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+        return 0
+    _render_memory(summary, leaks_only=args.leaks)
     return 0
 
 
@@ -738,7 +886,8 @@ def main(argv=None):
     sp = sub.add_parser("summary", help="state summaries")
     sp.add_argument(
         "what",
-        choices=["tasks", "actors", "objects", "lifecycle", "rl", "profiling"],
+        choices=["tasks", "actors", "objects", "memory", "lifecycle", "rl",
+                 "profiling"],
     )
     sp.set_defaults(fn=cmd_summary)
 
@@ -761,7 +910,21 @@ def main(argv=None):
     )
     sp.set_defaults(fn=cmd_timeline)
 
-    sub.add_parser("memory", help="object store summary").set_defaults(fn=cmd_memory)
+    sp = sub.add_parser(
+        "memory",
+        help="cluster memory census: objects by call-site, store "
+             "occupancy, pins, leak suspects",
+    )
+    sp.add_argument("--node", help="filter to one node (node-id hex prefix)")
+    sp.add_argument("--leaks", action="store_true",
+                    help="show only the leak detector's flagged call-sites")
+    sp.add_argument("--limit", type=int, default=20,
+                    help="call-site rows to show (default 20)")
+    sp.add_argument("--json", action="store_true",
+                    help="raw summarize_memory() JSON")
+    sp.add_argument("--offline", action="store_true",
+                    help="render from a built-in fixture (no cluster)")
+    sp.set_defaults(fn=cmd_memory)
 
     sp = sub.add_parser(
         "profile",

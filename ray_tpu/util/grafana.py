@@ -68,7 +68,9 @@ ROWS = (
     ("Control Plane", ("task_state_", "task_pending_", "lease_",
                        "lockwatch_")),
     ("Profiling", ("task_cpu_", "profiling_")),
-    ("Cluster Resources", ("tpu_hbm_", "node_", "object_store_",
+    ("Memory", ("object_store_", "object_refs_", "object_free_",
+                "memory_leak_")),
+    ("Cluster Resources", ("tpu_hbm_", "node_",
                            "metrics_series_")),
     ("Compilation", ("jax_",)),
     ("Collectives", ("collective_", "object_transfer_")),

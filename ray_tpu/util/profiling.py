@@ -657,6 +657,8 @@ INCIDENT_TRIGGERS = (
     "lockwatch_cycle",
     "recompile_storm",
     "slo_breach",
+    "memory_pressure",
+    "memory_leak",
     "manual",
 )
 
@@ -694,7 +696,8 @@ def _session_dir() -> str:
     return ""
 
 
-def incident(trigger: str, detail: Optional[dict] = None) -> Optional[str]:
+def incident(trigger: str, detail: Optional[dict] = None,
+             extra_files: Optional[Dict[str, str]] = None) -> Optional[str]:
     """Write one incident capture bundle; returns its directory (or None
     when disabled/rate-limited/sessionless). Bundle contents:
 
@@ -702,6 +705,8 @@ def incident(trigger: str, detail: Optional[dict] = None) -> Optional[str]:
     - ``stacks.txt``   — full formatted stack dump of this process
     - ``samples.collapsed`` — recent continuous-sampler ring (if running)
     - ``lifecycle_tail.json`` — flight-recorder tail (controller only)
+    - any ``extra_files`` the detector supplies ({name: text} — e.g. the
+      store-pressure trigger's ``memory.json`` autopsy)
 
     Bounded on disk: newest ``profiling_incident_keep`` bundles are kept
     per incidents dir; per-trigger writes are rate-limited to one per
@@ -752,6 +757,13 @@ def incident(trigger: str, detail: Optional[dict] = None) -> Optional[str]:
                     json.dump(tail, f, default=str)
             except Exception as e:  # noqa: BLE001 — tail is best-effort context
                 logger.debug("recorder tail capture failed: %s", e)
+        for name, text in (extra_files or {}).items():
+            safe_name = os.path.basename(str(name)) or "extra.txt"
+            try:
+                with open(os.path.join(d, safe_name), "w") as f:
+                    f.write(text)
+            except OSError as e:
+                logger.debug("incident extra file %s failed: %s", safe_name, e)
         _prune_incidents(root)
         try:
             _get_metrics()["incidents"].inc(1, {"trigger": trigger})

@@ -47,32 +47,35 @@ def list_cluster_events(limit: int = 10000) -> List[dict]:
     return _list("events", limit=limit)
 
 
+def _get_targeted(rpc: str, key: str, value: str, lister) -> Optional[dict]:
+    """Point lookup via the controller's targeted get RPC, falling back
+    to the legacy client-side scan over the full list_* dump (servers
+    predating the get RPCs)."""
+    try:
+        return _require_worker()._call(rpc, **{key: value})
+    except Exception:  # noqa: BLE001 — legacy server without the RPC
+        for row in lister():
+            if row.get(key) == value:
+                return row
+        return None
+
+
 def get_task(task_id: str) -> Optional[dict]:
-    for t in list_tasks(limit=100000):
-        if t["task_id"] == task_id:
-            return t
-    return None
+    return _get_targeted(
+        "get_task", "task_id", task_id, lambda: list_tasks(limit=100000)
+    )
 
 
 def get_actor(actor_id: str) -> Optional[dict]:
-    for a in list_actors():
-        if a["actor_id"] == actor_id:
-            return a
-    return None
+    return _get_targeted("get_actor", "actor_id", actor_id, list_actors)
 
 
 def get_node(node_id: str) -> Optional[dict]:
-    for n in list_nodes():
-        if n["node_id"] == node_id:
-            return n
-    return None
+    return _get_targeted("get_node", "node_id", node_id, list_nodes)
 
 
 def get_worker(worker_id: str) -> Optional[dict]:
-    for w in list_workers():
-        if w["worker_id"] == worker_id:
-            return w
-    return None
+    return _get_targeted("get_worker", "worker_id", worker_id, list_workers)
 
 
 def get_placement_group(pg_id: str) -> Optional[dict]:
@@ -109,13 +112,44 @@ def summarize_actors() -> dict:
     return dict(by)
 
 
-def summarize_objects() -> dict:
-    objs = list_objects(limit=100000)
-    return {
-        "total": len(objs),
-        "total_size": sum(o["size"] or 0 for o in objs),
-        "by_state": dict(_Counter(o["state"] for o in objs)),
-    }
+def summarize_objects(limit: int = 100) -> dict:
+    """Controller-side object rollup (O(limit) wire cost — the old
+    client-side path fetched 100k full rows over one RPC just to count
+    them): uncapped totals by state/tier plus the ``limit`` largest
+    creation call-sites. Falls back to the legacy scan against servers
+    without the RPC."""
+    try:
+        return _require_worker()._call("summarize_objects", limit=limit)
+    except Exception:  # noqa: BLE001 — legacy server without the RPC
+        objs = list_objects(limit=100000)
+        return {
+            "total": len(objs),
+            "total_size": sum(o["size"] or 0 for o in objs),
+            "by_state": dict(_Counter(o["state"] for o in objs)),
+        }
+
+
+def summarize_memory(limit: int = 50, node: Optional[str] = None) -> dict:
+    """Cluster-wide memory census (`ray-tpu memory`; reference: `ray
+    memory` over core-worker reference counting): every process's open
+    refs grouped by creation call-site, owner-local memory-store
+    occupancy, zero-copy arena pins, per-node store stats (occupancy /
+    spill-dir bytes / pins / deferred deletes), and the leak detector's
+    live flags. ``node``: restrict the fan-out to one node's processes
+    (node-id hex prefix)."""
+    return _require_worker()._call(
+        "summarize_memory", limit=limit, node=node, timeout=20,
+    )
+
+
+def list_object_refs(limit: int = 1000, node: Optional[str] = None) -> List[dict]:
+    """Per-object census rows across all four tiers: directory objects
+    (inline / shm / spilled) with owner + creation call-site + holder
+    processes, plus owner-local memory-store objects the controller
+    directory never sees, attributed via the process fan-out."""
+    return _require_worker()._call(
+        "list_object_refs", limit=limit, node=node, timeout=20,
+    )
 
 
 def summarize_lifecycle() -> dict:

@@ -1,0 +1,371 @@
+"""Object & memory observability (ISSUE 10, core/memory_census.py):
+cluster-wide `ray-tpu memory` census with ownership/call-site
+attribution across tiers (shm / memory-store / spilled / pinned view),
+the open-ref growth (leak) detector, store-pressure incident autopsies,
+controller-side summarize_objects + targeted get RPCs, bounded call-site
+cardinality, and the CLI offline smoke. All tier-1 (CPU)."""
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import memory_census
+from ray_tpu.util import state as state_api
+
+
+def _wait_until(pred, timeout=10.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _arena_available() -> bool:
+    try:
+        from ray_tpu.native import arena as arena_mod
+
+        return arena_mod.available()
+    except Exception:  # noqa: BLE001 — toolchain missing
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Census round-trip
+# ---------------------------------------------------------------------------
+def test_memory_census_roundtrip_two_nodes(ray_start_cluster):
+    """`ray-tpu memory` acceptance: on a live 2-node cluster every open
+    object is attributed to an owner + creation call-site — shm-tier put,
+    owner-local memory-store task result, and (arena permitting) a
+    zero-copy pinned view, all visible in one summarize_memory() /
+    list_object_refs() round trip."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+
+    big = ray_tpu.put(b"B" * (2 << 20))  # shm tier
+
+    @ray_tpu.remote
+    def small_result():
+        return b"y" * 100
+
+    local_ref = small_result.remote()  # stays owner-local (memory store)
+    assert ray_tpu.get(local_ref) == b"y" * 100
+
+    core = ray_tpu.core.api._require_worker()
+    pv = core.get_pinned_view(big.id)
+    try:
+        summary = state_api.summarize_memory()
+        totals = summary["totals"]
+        assert totals["objects"] >= 1
+        assert totals["shm_bytes"] >= 2 << 20
+        assert totals["memory_store_entries"] >= 1
+        # the driver's census answered the fan-out
+        driver_rows = [
+            p for name, p in summary["procs"].items()
+            if name.startswith("driver:")
+        ]
+        assert driver_rows and driver_rows[0]["open_refs"] >= 2
+        # both nodes' stores are in the rollup
+        assert len(summary["nodes"]) >= 2
+        assert all("capacity" in s for s in summary["nodes"].values())
+
+        # call-site attribution: the put and the task submission both
+        # chart under THIS file
+        sites = summary["by_callsite"]
+        assert any("test_memory_state" in s for s in sites), sorted(sites)
+
+        rows = state_api.list_object_refs(limit=200)
+        by_id = {r["object_id"]: r for r in rows}
+        big_row = by_id[big.id.hex()]
+        assert big_row["tier"] == "shm"
+        assert "test_memory_state" in big_row["callsite"]
+        assert any(h.startswith("driver:") for h in big_row["holders"])
+        local_row = by_id.get(local_ref.id.hex())
+        assert local_row is not None, "owner-local object missing from census"
+        assert local_row["tier"] == "memory_store"
+        assert "test_memory_state" in local_row["callsite"]
+
+        # pinned zero-copy view attribution (arena-tier only: file-tier
+        # views need no pin — the mapping survives eviction)
+        if pv is not None and _arena_available():
+            assert totals["pins"] >= 1
+            assert totals["pin_bytes"] >= 2 << 20
+            assert any(
+                p.get("pins", {}).get("count", 0) >= 1
+                for p in summary["procs"].values()
+                if isinstance(p, dict) and "pins" in p
+            )
+    finally:
+        if pv is not None:
+            pv[1]()
+    if pv is not None and _arena_available():
+        # released: the pin disappears from the next census
+        assert _wait_until(
+            lambda: state_api.summarize_memory()["totals"]["pins"] == 0
+        )
+    # node filter restricts the fan-out (head-node prefix keeps its store)
+    head = next(n for n in state_api.list_nodes() if n["is_head"])
+    filtered = state_api.summarize_memory(node=head["node_id"][:12])
+    assert head["node_id"] in filtered["nodes"]
+
+    # ObjectRef.call_site() exposes the recorded site locally
+    assert "test_memory_state" in big.call_site()
+
+
+def test_spilled_tier_attribution_and_pressure_incident(tmp_path, monkeypatch):
+    """Spill attribution + the store-pressure autopsy: a store driven
+    over capacity spills (spilled_bytes / tier=spilled attributed to the
+    creating call-site) and the occupancy trigger fires PR 9's incident
+    machinery with a memory autopsy bundle, fetchable over /api/v0."""
+    # File-per-object mode makes eviction deterministic: the store's own
+    # accounting drives spills (the arena fast path self-allocates).
+    monkeypatch.setenv("RAY_TPU_DISABLE_NATIVE_ARENA", "1")
+    ray_tpu.init(
+        num_cpus=2,
+        object_store_memory=16 << 20,
+        _system_config={
+            "node_telemetry_interval_ms": 200,
+            "memory_incident_occupancy_pct": 0.3,
+        },
+    )
+    try:
+        refs = [ray_tpu.put(bytes([i]) * (8 << 20)) for i in range(3)]
+        summary = None
+
+        def spilled():
+            nonlocal summary
+            summary = state_api.summarize_memory()
+            return summary["totals"]["spilled_bytes"] > 0
+
+        assert _wait_until(spilled, timeout=15), state_api.summarize_memory()
+        head_store = next(iter(summary["nodes"].values()))
+        assert head_store["spilled_bytes"] > 0
+        assert head_store["spill_ops"] >= 1
+        rows = state_api.list_object_refs(limit=100)
+        spilled_rows = [r for r in rows if r["tier"] == "spilled"]
+        assert spilled_rows, rows
+        assert any(
+            "test_memory_state" in r["callsite"] for r in spilled_rows
+        )
+        # spilled objects still round-trip through restore
+        assert ray_tpu.get(refs[0])[:1] == bytes([0])
+
+        # occupancy (16MB store holding 24MB put) crossed 30% → incident
+        assert _wait_until(
+            lambda: any(
+                r.get("trigger") == "memory_pressure"
+                for r in state_api.list_incidents()
+            ),
+            timeout=15,
+        ), state_api.list_incidents()
+        row = next(
+            r for r in state_api.list_incidents()
+            if r.get("trigger") == "memory_pressure"
+        )
+        assert "memory.json" in row["files"], row
+        bundle = state_api.get_incident(row["id"])
+        autopsy = json.loads(bundle["contents"]["memory.json"])
+        assert autopsy["reason"] in ("occupancy", "spill_churn")
+        assert autopsy["nodes"], autopsy
+        assert "top_callsites" in autopsy
+        assert any(
+            "test_memory_state" in s for s in autopsy["top_callsites"]
+        ), autopsy["top_callsites"]
+
+        # the HTTP gateway serves the census and the incident bundles
+        url = state_api.dashboard_url()
+        if url:
+            from urllib.request import urlopen
+
+            payload = json.load(urlopen(f"{url}/api/v0/memory", timeout=30))
+            assert payload["totals"]["spilled_bytes"] > 0
+            incidents = json.load(
+                urlopen(f"{url}/api/v0/profile/incidents", timeout=10)
+            )
+            assert any(
+                r.get("trigger") == "memory_pressure" for r in incidents
+            )
+        del refs
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Leak detector
+# ---------------------------------------------------------------------------
+def test_leak_detector_flags_ref_hoarding_actor():
+    """A deliberately ref-hoarding actor (appends put refs forever) is
+    flagged BY CALL-SITE after its open-ref count rises monotonically
+    across memory_leak_sweeps census sweeps."""
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "node_telemetry_interval_ms": 150,
+            "memory_leak_sweeps": 3,
+            "memory_leak_min_refs": 8,
+        },
+    )
+    try:
+
+        @ray_tpu.remote
+        class Hoarder:
+            def __init__(self):
+                self.refs = []
+
+            def hoard(self):
+                self.refs.append(ray_tpu.put(b"h" * 2048))
+                return len(self.refs)
+
+        h = Hoarder.remote()
+        ray_tpu.wait_actor_ready(h)
+
+        def leak_flagged():
+            # keep hoarding while the detector sweeps
+            ray_tpu.get([h.hoard.remote() for _ in range(4)])
+            leaks = state_api.summarize_memory(limit=100)["leaks"]
+            return any("test_memory_state" in r["callsite"] for r in leaks)
+
+        assert _wait_until(leak_flagged, timeout=20, interval=0.2), (
+            state_api.summarize_memory()["leaks"]
+        )
+        flag = next(
+            r for r in state_api.summarize_memory(limit=100)["leaks"]
+            if "test_memory_state" in r["callsite"]
+        )
+        assert flag["count"] >= 8 and flag["growth"] >= 1
+        # the CLI's --leaks view renders the same flags
+        from ray_tpu.scripts.cli import _render_memory
+
+        lines = []
+        _render_memory(
+            state_api.summarize_memory(limit=100), leaks_only=True,
+            out=lines.append,
+        )
+        assert any("leak suspects" in ln for ln in lines)
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Bounded call-site vocabulary
+# ---------------------------------------------------------------------------
+def test_callsite_intern_table_bounded():
+    """Past memory_callsite_cap, new call-sites collapse into "(other)"
+    — census groups, leak-trend entries, and metric tags built from
+    call-sites all stay bounded."""
+    table = memory_census.CallsiteTable(cap=8)
+    sites = {
+        table.intern_frame(f"/app/file_{i}.py", i, f"fn_{i}")
+        for i in range(50)
+    }
+    assert memory_census.OVERFLOW_SITE in sites
+    assert len(table) <= 8
+    # every later distinct site maps to the overflow bucket
+    assert table.intern_frame("/app/new.py", 1, "g") == \
+        memory_census.OVERFLOW_SITE
+    assert table.intern("(task) yet-another-name") == \
+        memory_census.OVERFLOW_SITE
+    # repeat captures of an interned site stay stable
+    first = table.intern_frame("/app/file_0.py", 0, "fn_0")
+    assert first == table.intern_frame("/app/file_0.py", 0, "fn_0")
+    assert first != memory_census.OVERFLOW_SITE
+
+
+def test_capture_callsite_disabled_and_user_frame():
+    memory_census._reset_for_tests()
+    try:
+        site = memory_census.capture_callsite()
+        assert "test_memory_state" in site and "test_capture_callsite" in site
+        memory_census.set_enabled(False)
+        assert memory_census.capture_callsite() == ""
+    finally:
+        memory_census._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# Controller-side summaries + targeted gets
+# ---------------------------------------------------------------------------
+def test_summarize_objects_controller_side_and_targeted_gets(ray_start_regular):
+    """summarize_objects() is now an O(limit) controller rollup (not a
+    100k-row list pull), and get_task/get_actor/get_node/get_worker hit
+    targeted RPCs instead of scanning full list_* dumps."""
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    refs = [f.remote() for _ in range(3)]
+    ray_tpu.get(refs)
+    held = ray_tpu.put(b"z" * 4096)
+
+    objs = state_api.summarize_objects()
+    assert objs["total"] >= 1
+    assert objs["total_size"] >= 4096
+    assert "by_state" in objs and "by_tier" in objs
+    assert any("test_memory_state" in s for s in objs["callsites"])
+
+    node = state_api.list_nodes()[0]
+    assert state_api.get_node(node["node_id"])["node_id"] == node["node_id"]
+    assert state_api.get_node("ff" * 16) is None
+    worker = state_api.list_workers()[0]
+    got = state_api.get_worker(worker["worker_id"])
+    assert got["worker_id"] == worker["worker_id"]
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 0
+
+    a = A.remote()
+    ray_tpu.wait_actor_ready(a)
+    row = state_api.get_actor(a._actor_id.hex())
+    assert row["state"] == "ALIVE"
+
+    assert _wait_until(
+        lambda: any(t["state"] == "FINISHED" for t in state_api.list_tasks())
+    )
+    task = next(
+        t for t in state_api.list_tasks() if t["state"] == "FINISHED"
+    )
+    got = state_api.get_task(task["task_id"])
+    assert got is not None and got["task_id"] == task["task_id"]
+    assert state_api.get_task("00" * 16) is None
+    del held
+
+
+# ---------------------------------------------------------------------------
+# Rendering / dashboard plumbing
+# ---------------------------------------------------------------------------
+def test_grafana_memory_row_mapping():
+    from ray_tpu.util.grafana import _row_for
+
+    assert _row_for("object_store_used_bytes") == "Memory"
+    assert _row_for("object_store_pinned_bytes") == "Memory"
+    assert _row_for("object_store_spilled_bytes") == "Memory"
+    assert _row_for("object_refs_open") == "Memory"
+    assert _row_for("object_free_latency_ms") == "Memory"
+    assert _row_for("memory_leak_flags_total") == "Memory"
+    # no theft from neighboring rows
+    assert _row_for("object_transfer_fetch_ms") == "Collectives"
+    assert _row_for("tpu_hbm_used_bytes") == "Cluster Resources"
+
+
+def test_cli_memory_offline_smoke(capsys):
+    """`ray-tpu memory --offline` renders every view path from the
+    built-in fixture with no cluster (same contract as `status
+    --offline`)."""
+    from ray_tpu.scripts.cli import main
+
+    assert main(["memory", "--offline"]) == 0
+    out = capsys.readouterr().out
+    assert "call-site" in out
+    assert "load_shards" in out  # by-callsite row rendered
+    assert "leak suspects" in out  # leak section rendered
+    assert "timed out" in out  # unreachable-process path rendered
+
+    assert main(["memory", "--offline", "--leaks"]) == 0
+    out = capsys.readouterr().out
+    assert "leak suspects" in out and "load_shards" not in out
