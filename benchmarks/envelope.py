@@ -316,6 +316,71 @@ def bench_object_churn(n: int, census_ab: bool = True) -> dict:
     return row
 
 
+def bench_log_churn(n_tasks: int, lines: int, work: int = 20000,
+                    ab: bool = True) -> dict:
+    """Log-churn arm: N concurrent tasks, each emitting M log lines at a
+    realistic rate (every line paired with ``work`` iterations of a small
+    compute kernel — chatty-but-working tasks, not a bare print loop),
+    with structured capture on vs off (interleaved best-of-2, the census
+    arm's shape). The "off" arm prints to ``sys.__stdout__`` — the
+    pre-proxy stream over the SAME redirected log file — so the delta
+    isolates exactly the log plane's per-line machinery (record build +
+    attribution + sidecar append + ship check); budget <=3% of task wall
+    like profiling/census."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0.001)
+    def chatter(n, w, structured):
+        import sys
+
+        stream = sys.stdout if structured else sys.__stdout__
+        payload = "x" * 64
+        acc = 0
+        for i in range(n):
+            for j in range(w):
+                acc += j & 7
+            print(f"log-churn line {i} {payload} {acc & 1}", file=stream)
+        return n
+
+    def one_arm(structured) -> float:
+        """Total wall for the N-task wave (the overhead denominator)."""
+        import ray_tpu as rt
+
+        t0 = time.perf_counter()
+        out = rt.get(
+            [chatter.remote(lines, work, structured) for _ in range(n_tasks)],
+            timeout=900,
+        )
+        dt = time.perf_counter() - t0
+        assert sum(out) == n_tasks * lines
+        return dt
+
+    one_arm(True)  # warm the worker pool + capture path
+    arms = {"on": float("inf"), "off": float("inf")}
+    rounds = 2 if ab else 1
+    with LoopProbe() as probe:
+        for _ in range(rounds):  # interleaved best-of-N (min wall)
+            if ab:
+                arms["off"] = min(arms["off"], one_arm(False))
+            arms["on"] = min(arms["on"], one_arm(True))
+    total_lines = n_tasks * lines
+    row = {
+        "benchmark": "log_churn",
+        "tasks": n_tasks,
+        "lines_per_task": lines,
+        "work_per_line": work,
+        "lines_per_s": round(total_lines / arms["on"], 1),
+        "controller_rss_mb": controller_rss_mb(),
+        **probe.stats(),
+    }
+    if ab:
+        overhead = 100.0 * (arms["on"] - arms["off"]) / max(arms["off"], 1e-9)
+        row["lines_per_s_no_structured"] = round(total_lines / arms["off"], 1)
+        row["log_overhead_pct"] = round(max(0.0, overhead), 2)
+        row["log_overhead_ok"] = overhead <= 3.0
+    return row
+
+
 def main():
     import ray_tpu
 
@@ -334,6 +399,18 @@ def main():
         help="disable memory-census attribution cluster-wide (A/B runs; "
              "the churn row then skips its built-in driver-side A/B)",
     )
+    p.add_argument("--log-tasks", type=int, default=8,
+                   help="log-churn arm: concurrent chatty tasks")
+    p.add_argument("--log-lines", type=int, default=4000,
+                   help="log-churn arm: print lines per task")
+    p.add_argument("--log-work", type=int, default=20000,
+                   help="log-churn arm: compute-kernel iterations per line "
+                        "(paces emission — chatty tasks still do work)")
+    p.add_argument(
+        "--no-log-structured", action="store_true",
+        help="disable structured log capture cluster-wide (A/B runs; the "
+             "log-churn row then skips its built-in stream-level A/B)",
+    )
     p.add_argument("--out", default="")
     args = p.parse_args()
 
@@ -342,6 +419,8 @@ def main():
         overrides["lifecycle_events"] = False
     if args.no_memory_census:
         overrides["memory_census"] = False
+    if args.no_log_structured:
+        overrides["log_structured"] = False
     # Logical CPUs sized so the lease ramp can hold --live-actors
     # concurrent warm-up naps (worker pool caps scale with CPU count).
     ray_tpu.init(
@@ -356,6 +435,8 @@ def main():
             (bench_live_actors, (args.live_actors,), {}),
             (bench_object_churn, (args.churn,),
              {"census_ab": not args.no_memory_census}),
+            (bench_log_churn, (args.log_tasks, args.log_lines),
+             {"work": args.log_work, "ab": not args.no_log_structured}),
             (bench_queued_tasks, (args.queued,), {}),
         ):
             row = fn(*fnargs, **fnkw)

@@ -32,12 +32,15 @@ Cluster YAML schema::
 from __future__ import annotations
 
 import json
+import logging
 import os
 import signal
 import subprocess
 import sys
 import time
 from typing import Dict, Optional
+
+logger = logging.getLogger("ray_tpu.autoscaler.commands")
 
 
 def load_cluster_config(path_or_dict) -> dict:
@@ -281,11 +284,10 @@ def teardown_cluster(name_or_path) -> dict:
         state["teardown_incomplete"] = True
         with open(cluster_state_path(state["cluster_name"]), "w") as f:
             json.dump(state, f, indent=1)
-        print(
-            f"WARNING: monitor for {state['cluster_name']} did not exit "
-            "cleanly; provider nodes may still be running — state kept at "
-            + cluster_state_path(state["cluster_name"]),
-            file=sys.stderr,
+        logger.warning(
+            "monitor for %s did not exit cleanly; provider nodes may "
+            "still be running — state kept at %s",
+            state["cluster_name"], cluster_state_path(state["cluster_name"]),
         )
         return state
     try:
@@ -318,6 +320,8 @@ def attach_cluster(name_or_path) -> int:
     env["RAY_TPU_SESSION_DIR"] = state["session_dir"]
     env["PS1"] = f"(ray-tpu {state['cluster_name']}) " + env.get("PS1", "$ ")
     if not sys.stdin.isatty():
-        print(f"export RAY_TPU_ADDRESS={state['address']}")
+        # shell-evaluable stdout contract (`eval $(ray-tpu attach ...)`)
+        # — must stay raw on stdout, not a formatted/leveled logger line
+        print(f"export RAY_TPU_ADDRESS={state['address']}")  # ray-tpu: lint-ignore[RTL007]
         return 0
     return subprocess.call([shell], env=env)

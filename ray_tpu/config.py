@@ -182,6 +182,33 @@ class Config:
     # (eviction-loop churn; 0 disables the churn trigger).
     memory_incident_spill_churn: int = 200
 
+    # --- log plane (core/log_plane.py) ---
+    # Master switch for structured log capture: every worker (and driver)
+    # stamps logging records + stdout/stderr lines + task tracebacks with
+    # {node, worker, task, severity, ts} into a bounded JSONL sidecar
+    # next to the raw log, ships ERROR records to the controller's error
+    # index, and answers the cluster-wide log search fan-out. The
+    # envelope A/B knob (benchmarks/envelope.py log-churn arm).
+    log_structured: bool = True
+    # Size cap for worker log files — BOTH the raw worker-*.log (rotated
+    # copy-truncate, the redirected-stdout fd keeps appending) and the
+    # structured .jsonl sidecar (rotated by rename). One rotated ``.1``
+    # half is kept, like the PR 6 span sinks — disk is bounded at ~2x
+    # the cap per file.
+    log_rotate_bytes: int = 64 * 1024 * 1024
+    # Worker→controller shipping cadence for ERROR/exception records
+    # (only those ship; the full firehose stays in node-local sidecars
+    # reached by the search fan-out).
+    log_ship_interval_ms: int = 1000
+    # Bounded error-signature index on the controller (same bounded-
+    # intern pattern as the memory census CallsiteTable): past the cap
+    # new signatures collapse into "(other)".
+    log_error_index_size: int = 256
+    # Error-rate-spike incident trigger: this many ERROR records ingested
+    # within one telemetry sweep fires the PR 9 incident machinery with
+    # the offending log tail attached (0 disables).
+    log_error_spike_threshold: int = 50
+
     # --- profiling (util/profiling.py) ---
     # Default sample rate for on-demand `ray-tpu profile cpu` runs.
     profiling_sample_hz: int = 100

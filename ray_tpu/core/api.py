@@ -113,6 +113,24 @@ def init(
     from ray_tpu.core.node_telemetry import start_process_telemetry
 
     start_process_telemetry(_global_worker)
+    # Structured log plane, driver leg: logging records (incl. exception
+    # tracebacks the driver logs) get a driver-<pid>.jsonl sidecar and
+    # ERROR shipping to the controller's error index. Handler-only — the
+    # driver's console streams stay untouched (core/log_plane.py).
+    if _global_worker.config.get("log_structured", True):
+        from ray_tpu.core import log_plane
+
+        log_plane.install(
+            _global_worker.session_dir,
+            node_id=_global_worker.node_id.hex(),
+            worker_id=None,
+            proc=f"driver-{os.getpid()}",
+            capture_streams=False,
+            rotate_bytes=int(
+                _global_worker.config.get("log_rotate_bytes", 64 << 20)
+            ),
+        )
+        log_plane.start_ship_loop(_global_worker)
     # Continuous low-rate CPU sampling for incident auto-capture (no-op
     # unless profiling_continuous_hz is configured).
     from ray_tpu.util import profiling
@@ -172,6 +190,9 @@ def shutdown():
             except Exception:
                 pass
     finally:
+        from ray_tpu.core import log_plane
+
+        log_plane.uninstall()  # driver leg: handler off, sidecar closed
         _global_worker.disconnect()
         _global_worker.loop_runner.stop()
         _global_worker = None

@@ -895,6 +895,14 @@ class DriverHandler(_NullHandler):
 
         print_to_driver(batch)
 
+    def rpc_log_records(self, peer, batch):
+        """Structured follow-mode records (``ray-tpu logs --follow``):
+        the controller pushes filtered sidecar records; the registered
+        sink (or a default stderr renderer) consumes them."""
+        from ray_tpu.core.log_monitor import deliver_records
+
+        deliver_records(batch)
+
     def rpc_pubsub_msg(self, peer, channel: str, message):
         from ray_tpu.experimental.pubsub import _deliver
 

@@ -396,6 +396,16 @@ class Controller:
         self._leak_flags: Dict[str, dict] = {}
         self._spill_ops_prev: Dict[NodeID, int] = {}
         self._census_tick_n = 0  # sweep counter (scan-stride amortization)
+        # Cluster log plane (core/log_plane.py): error-signature index
+        # fed by worker/agent/driver ERROR shipping (rpc_log_errors),
+        # follow-mode subscribers (``ray-tpu logs --follow``) keyed by
+        # their driver connection, and the spike detector's watermark.
+        from ray_tpu.core.log_plane import ErrorIndex
+
+        self._error_index = ErrorIndex(cap=config.log_error_index_size)
+        self._log_followers: Dict[rpc.Peer, dict] = {}
+        self._record_tailer = None
+        self._errors_prev_total = 0
         self.dashboard_port: Optional[int] = None
 
         # Head node: controller doubles as its node agent.
@@ -434,6 +444,7 @@ class Controller:
         if holder:
             self._drop_holder(holder)
         self._drop_subscriber(peer)
+        self._log_followers.pop(peer, None)
         # Leases die with their owner's connection (reference: leased
         # workers are returned when the lease-holder worker dies). The
         # workers may be mid-task on orphaned pushes → kill, don't pool.
@@ -2855,6 +2866,297 @@ class Controller:
             "nodes": nodes,
         }
 
+    # =================================================================
+    # Cluster log plane (core/log_plane.py; reference: the dashboard
+    # StateHead logs API + log_monitor + GCS error-event aggregation)
+    # =================================================================
+    def _log_dir(self) -> str:
+        return os.path.join(self.session_dir, "logs")
+
+    def _worker_node_map(self) -> Dict[str, str]:
+        """worker-id 8-hex prefix -> node hex (log filenames encode the
+        worker; the controller's table supplies the node attribution)."""
+        return {
+            w.worker_id.hex()[:8]: w.node_id.hex()
+            for w in self.workers.values()
+        }
+
+    def _attribute_file_node(self, filename: str, wmap: Dict[str, str],
+                             fallback: Optional[str] = None) -> Optional[str]:
+        stem = os.path.splitext(filename)[0]
+        for prefix in ("worker-", "driver-"):
+            if stem.startswith(prefix):
+                wid = stem[len(prefix):]
+                node = wmap.get(wid[:8])
+                if node:
+                    return node
+        if filename.startswith(("controller", "driver-")):
+            return self.head_node_id.hex()
+        return fallback
+
+    def _log_agent_targets(self, node: Optional[str]):
+        out = []
+        for n in self.nodes.values():
+            if n.peer is None or n.peer.closed:
+                continue
+            if node and not n.node_id.hex().startswith(node):
+                continue
+            out.append(n)
+        return out
+
+    async def rpc_list_logs(self, peer, node: Optional[str] = None,
+                            timeout_s: float = 10.0):
+        """Cluster-wide log listing: the head's session log dir plus
+        every agent's, merged and deduplicated by filename (single-host
+        simulations share one dir; true multi-host nodes each contribute
+        their own), each row attributed to the node whose worker wrote
+        it."""
+        from ray_tpu.core import log_plane
+
+        per_node: Dict[str, list] = {}
+        if not node or self.head_node_id.hex().startswith(node):
+            # off-loop like the agents: listing stats every log file
+            per_node[self.head_node_id.hex()] = await asyncio.to_thread(
+                log_plane.list_local, self._log_dir()
+            )
+
+        async def ask(n: NodeRecord):
+            try:
+                res = await asyncio.wait_for(n.peer.call("list_logs"), timeout_s)
+                per_node[n.node_id.hex()] = res.get("files", [])
+            except Exception as e:  # noqa: BLE001 — wedged/gone agent
+                logger.debug("list_logs on %s failed: %s",
+                             n.node_id.hex()[:8], e)
+
+        await asyncio.gather(*(ask(n) for n in self._log_agent_targets(node)))
+        wmap = self._worker_node_map()
+        rows: Dict[str, dict] = {}
+        for node_hex, files in per_node.items():
+            for f in files:
+                name = f["filename"]
+                if name in rows:
+                    continue
+                f = dict(f)
+                f["node"] = self._attribute_file_node(name, wmap, node_hex)
+                rows[name] = f
+        out = sorted(rows.values(), key=lambda r: r["filename"])
+        if node:
+            out = [r for r in out
+                   if r.get("node") and r["node"].startswith(node)]
+        return out
+
+    async def rpc_get_log(self, peer, filename: str, tail: int = 1000,
+                          node: Optional[str] = None,
+                          timeout_s: float = 10.0):
+        """One log file's tail, wherever it lives: the head's dir first,
+        then the agents (path-traversal guarded on every leg)."""
+        from ray_tpu.core import log_plane
+
+        if not node or self.head_node_id.hex().startswith(node):
+            try:
+                # off-loop: reading a rotation-capped file is up to
+                # ~2x log_rotate_bytes of I/O
+                return await asyncio.to_thread(
+                    log_plane.read_local, self._log_dir(), filename, tail
+                )
+            except FileNotFoundError:
+                pass
+        last_err: Exception = FileNotFoundError(filename)
+        for n in self._log_agent_targets(node):
+            try:
+                return await asyncio.wait_for(
+                    n.peer.call("get_log", filename, tail), timeout_s
+                )
+            except ValueError:
+                raise  # traversal attempt — do not keep probing
+            except Exception as e:  # noqa: BLE001 — missing there / agent gone
+                last_err = e
+        raise last_err
+
+    async def rpc_search_logs(self, peer, pattern: Optional[str] = None,
+                              severity: Optional[str] = None,
+                              task: Optional[str] = None,
+                              actor: Optional[str] = None,
+                              node: Optional[str] = None,
+                              since: Optional[float] = None,
+                              until: Optional[float] = None,
+                              limit: int = 1000,
+                              timeout_s: float = 10.0):
+        """Cluster-wide structured log search (the `ray-tpu logs --grep/
+        --task/--err` backend): regex + severity floor + time range +
+        entity filters fan out to every node's sidecars over the
+        existing channels (the PR 9/10 pattern), results merge bounded
+        and time-ordered, deduplicated by (file, line) for shared-dir
+        single-host nodes."""
+        from ray_tpu.core import log_plane
+
+        limit = max(1, min(int(limit), 10000))
+        filters = dict(pattern=pattern, severity=severity, task=task,
+                       actor=actor, node=node, since=since, until=until,
+                       limit=limit)
+        merged: Dict[tuple, dict] = {}
+
+        def fold(records):
+            for rec in records:
+                merged.setdefault(
+                    (rec.get("file", ""), rec.get("line", 0)), rec
+                )
+
+        if not node or self.head_node_id.hex().startswith(node):
+            # off-loop like the agents: a regex scan over sidecars near
+            # the rotation cap must not stall the scheduler loop
+            fold(await asyncio.to_thread(
+                log_plane.search_local, self._log_dir(), **filters
+            ))
+
+        async def ask(n: NodeRecord):
+            try:
+                fold(await asyncio.wait_for(
+                    n.peer.call("search_logs", **filters), timeout_s
+                ))
+            except Exception as e:  # noqa: BLE001 — wedged/gone agent
+                logger.debug("search_logs on %s failed: %s",
+                             n.node_id.hex()[:8], e)
+
+        await asyncio.gather(*(ask(n) for n in self._log_agent_targets(node)))
+        wmap = self._worker_node_map()
+        out = []
+        for rec in merged.values():
+            if rec.get("node") is None and rec.get("file"):
+                rec["node"] = self._attribute_file_node(rec["file"], wmap)
+                if node and not str(rec["node"] or "").startswith(node):
+                    continue
+            out.append(rec)
+        out.sort(key=lambda r: (r.get("ts") or 0.0, r.get("file", ""),
+                                r.get("line", 0)))
+        return out[:limit]
+
+    async def rpc_log_errors(self, peer, batch: List[dict]):
+        """ERROR/exception records shipped by workers, agents, and
+        drivers — folded into the bounded error-signature index."""
+        for rec in batch:
+            self._error_index.ingest(rec)
+        return True
+
+    async def rpc_summarize_errors(self, peer, limit: int = 50):
+        """The error index: repeated failures collapsed by signature
+        (exception type + interned top user frames) with counts, first/
+        last seen, a sample traceback, and the lifecycle entity link."""
+        return self._error_index.summarize(limit)
+
+    async def rpc_log_follow(self, peer, filters: Optional[dict] = None):
+        """Register this connection for live structured log delivery
+        (``ray-tpu logs --follow``): matching records push as
+        ``log_records`` notifies over the LogTailer→driver channel."""
+        f = dict(filters or {})
+        if f.pop("err", None):
+            f.setdefault("severity", "ERROR")
+        f = {k: v for k, v in f.items() if k in (
+            "pattern", "severity", "task", "actor", "node") and v}
+        self._log_followers[peer] = f
+        self._ensure_record_tailer()
+        return True
+
+    async def rpc_log_unfollow(self, peer):
+        self._log_followers.pop(peer, None)
+        return True
+
+    def _ensure_record_tailer(self):
+        """Lazy structured tailer: worker sidecars only start being
+        tailed once somebody follows (span sinks and raw logs are
+        excluded by the pattern). Like the raw log-to-driver tailer
+        above, this covers every worker logging into the session dir —
+        all nodes on the single-host simulation; a true multi-host
+        deployment would relay per-agent tailers (search/list DO fan
+        out; follow is head-dir scoped)."""
+        if self._record_tailer is not None:
+            return
+        from ray_tpu.core.log_monitor import LogTailer
+
+        self._record_tailer = LogTailer(
+            self._log_dir(), self._broadcast_records,
+            pattern="worker-*.jsonl", start_at_end=True,
+        )
+        self._record_tailer.start()
+
+    def _broadcast_records(self, batch):
+        """Thread→loop bridge: parse tailed sidecar lines once, then fan
+        matching records out to each follower by ITS filters."""
+        if not self._log_followers or self._loop is None:
+            return
+        recs = []
+        for source, line in batch:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            rec["file"] = source
+            recs.append(rec)
+        if not recs:
+            return
+        from ray_tpu.core import log_plane
+
+        async def send():
+            for peer, filters in list(self._log_followers.items()):
+                try:
+                    matched = [
+                        r for r in recs
+                        if log_plane.match_record(r, **filters)
+                    ]
+                except Exception as e:  # noqa: BLE001 — bad follower regex
+                    logger.debug("follow filter failed: %s", e)
+                    continue
+                if matched:
+                    await _notify_quiet(
+                        peer, "log_records", matched, what="follower gone"
+                    )
+
+        asyncio.run_coroutine_threadsafe(send(), self._loop)
+
+    def _error_spike_check(self):
+        """Error-rate-spike trigger: >= log_error_spike_threshold ERROR
+        records ingested within one telemetry sweep fires the PR 9
+        incident machinery with the offending log tail attached."""
+        threshold = int(getattr(self.config, "log_error_spike_threshold", 50))
+        total = self._error_index.total
+        delta = total - self._errors_prev_total
+        self._errors_prev_total = total
+        if threshold <= 0 or delta < threshold:
+            return
+        from ray_tpu.core.log_plane import format_record
+        from ray_tpu.util import profiling
+
+        # Pre-check the rate limit so sustained error storms don't spawn
+        # a capture thread per sweep (incident() re-checks atomically) —
+        # the slo_breach/memory_pressure pattern.
+        min_interval = float(self.config.profiling_incident_min_interval_s)
+        if (
+            time.time() - profiling._incident_last.get("error_spike", 0.0)
+            < min_interval
+        ):
+            return
+        tail = "\n".join(
+            format_record(r) for r in self._error_index.recent_tail(100)
+        )
+        summary = self._error_index.summarize(limit=10)
+        detail = {
+            "errors_this_sweep": delta,
+            "threshold": threshold,
+            "top_signatures": {
+                sig: row["count"]
+                for sig, row in summary["signatures"].items()
+            },
+        }
+        import threading as _t
+
+        _t.Thread(
+            target=profiling.incident,
+            args=("error_spike", detail),
+            kwargs={"extra_files": {"log_tail.txt": tail}},
+            daemon=True,
+            name="error-spike-incident",
+        ).start()
+
     def _drain_spawn_events(self):
         """Fold worker SPAWNED events recorded by in-process spawns (the
         controller doubles as the head's agent) into the flight recorder.
@@ -3529,6 +3831,17 @@ class Controller:
                 self._memory_census_tick()
             except Exception:  # noqa: BLE001 — census must not kill telemetry
                 logger.exception("memory census tick failed")
+            # Log plane sweep: the controller's own captured ERROR
+            # records feed the index in-process (it has no ship loop),
+            # then the error-rate-spike detector runs over the sweep.
+            try:
+                from ray_tpu.core import log_plane as _lp
+
+                for rec in _lp.drain_ship():
+                    self._error_index.ingest(rec)
+                self._error_spike_check()
+            except Exception:  # noqa: BLE001 — log plane must not kill telemetry
+                logger.exception("log plane sweep failed")
             # Metrics recorded IN the controller process (head-side
             # object transfers, chunk serving) have no CoreWorker flusher
             # — fold them straight into the aggregation.
@@ -3917,6 +4230,20 @@ class Controller:
             ring_s=self.config.profiling_ring_s,
         )
         profiling.set_recorder_tail_provider(lambda: self.lifecycle.tail(500))
+        if self.config.log_structured:
+            # Controller leg of the log plane: scheduler warnings/errors
+            # become structured records (handler-only; streams already
+            # land in controller.log) and feed the error index via the
+            # telemetry sweep.
+            from ray_tpu.core import log_plane
+
+            log_plane.install(
+                self.session_dir,
+                node_id=self.head_node_id.hex(),
+                proc="controller",
+                capture_streams=False,
+                rotate_bytes=self.config.log_rotate_bytes,
+            )
         self._log_tailer = None
         if self.config.log_to_driver:
             from ray_tpu.core.log_monitor import LogTailer
@@ -3959,6 +4286,8 @@ class Controller:
         await self._shutdown.wait()
         if self._log_tailer is not None:
             self._log_tailer.stop()
+        if self._record_tailer is not None:
+            self._record_tailer.stop()
         # Teardown: tell everyone to exit.
         for w in list(self.workers.values()):
             await _notify_quiet(w.peer, "exit", what="cluster teardown")

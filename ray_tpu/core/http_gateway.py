@@ -54,11 +54,14 @@ _STATE_ROUTES = {
     "object_refs": "rpc_list_object_refs",
     "lifecycle_events": "rpc_list_lifecycle_events",
     "compile": "rpc_compile_state",
+    # error-signature index (cluster log plane; reference: the GCS's
+    # error-event aggregation surfaced by the dashboard)
+    "summarize_errors": "rpc_summarize_errors",
 }
 
 # routes accepting ?limit= (and ?node= where listed below)
 _LIMIT_ROUTES = ("tasks", "objects", "events", "memory", "object_refs",
-                 "summarize_objects")
+                 "summarize_objects", "summarize_errors")
 _NODE_ROUTES = ("memory", "object_refs")
 
 
@@ -181,6 +184,56 @@ def start_http_gateway(controller, loop: asyncio.AbstractEventLoop, port: int) -
                         for k, v in snap.items()
                     }
                     self._send(200, prometheus_text(snap).encode(), "text/plain; version=0.0.4")
+                elif path.startswith("/api/v0/logs"):
+                    # Cluster log plane (reference: the StateHead logs
+                    # API): list / fetch / structured search, all fanned
+                    # out to the node agents by the controller.
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+
+                    def qget(key, cast, default):
+                        return cast(q[key][0]) if q.get(key) else default
+
+                    sub = path[len("/api/v0/logs"):].strip("/")
+                    if sub == "":
+                        self._json(call(
+                            "rpc_list_logs", node=qget("node", str, None),
+                            _timeout=30,
+                        ))
+                    elif sub == "file":
+                        name = qget("name", str, None)
+                        if not name:
+                            self._json({"error": "missing ?name="}, 400)
+                            return
+                        try:
+                            text = call(
+                                "rpc_get_log", filename=name,
+                                tail=qget("tail", int, 1000),
+                                node=qget("node", str, None), _timeout=30,
+                            )
+                        except FileNotFoundError:
+                            self._json({"error": f"no log {name}"}, 404)
+                            return
+                        except ValueError as e:
+                            self._json({"error": str(e)}, 400)
+                            return
+                        self._json({"filename": name, "text": text})
+                    elif sub == "search":
+                        self._json(call(
+                            "rpc_search_logs",
+                            pattern=qget("pattern", str, None) or qget("grep", str, None),
+                            severity=qget("severity", str, None),
+                            task=qget("task", str, None),
+                            actor=qget("actor", str, None),
+                            node=qget("node", str, None),
+                            since=qget("since", float, None),
+                            until=qget("until", float, None),
+                            limit=qget("limit", int, 1000),
+                            _timeout=30,
+                        ))
+                    else:
+                        self._json({"error": "unknown logs route"}, 404)
                 elif path.startswith("/api/v0/profile"):
                     # On-demand profiling routes (each handler runs on a
                     # gateway thread; only /cpu blocks, for its duration).

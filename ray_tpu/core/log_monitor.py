@@ -6,6 +6,14 @@ tails worker log files and publishes new lines; the driver prints them
 node agent (and the controller for head-node workers), forwards line
 batches over the existing control connection, and the controller fans
 them out to connected drivers.
+
+Rotation tolerance: worker logs are size-capped (``log_rotate_bytes``,
+core/log_plane.py) — the raw file by copy-truncate, the structured
+sidecar by rename, both keeping one ``.1`` half. When a tracked file
+shrinks below (or renames out from under) the stored offset, the tailer
+first drains the unread suffix of the ``.1`` half — which holds exactly
+the pre-rotation content — then resets to offset 0, so rotation emits
+neither duplicated nor silently dropped lines.
 """
 from __future__ import annotations
 
@@ -32,13 +40,19 @@ class LogTailer:
         poll_interval: float = 0.25,
         pattern: str = "worker-*.log",
         max_batch_lines: int = 1000,
+        start_at_end: bool = False,
     ):
         self.log_dir = log_dir
         self.pattern = pattern
         self.publish = publish
         self.poll_interval = poll_interval
         self.max_batch_lines = max_batch_lines
+        # Follow mode: files already on disk when the tailer starts are
+        # picked up from their current END — a follower wants new lines,
+        # not a replay of the whole sidecar.
+        self.start_at_end = start_at_end
         self._offsets: Dict[str, int] = {}
+        self._inodes: Dict[str, int] = {}
         self._partials: Dict[str, str] = {}
         # Lines read but not yet emitted (batch-cap overflow carry-over).
         self._pending: LogBatch = []
@@ -71,6 +85,28 @@ class LogTailer:
         except Exception as e:
             logger.debug("final log sweep failed: %s", e)
 
+    def _read_span(self, path: str, offset: int, size: int) -> Optional[bytes]:
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                return f.read(size - offset)
+        except OSError:
+            return None
+
+    def _append_lines(self, name: str, data: bytes, batch: LogBatch):
+        text = self._partials.pop(name, "") + data.decode("utf-8", errors="replace")
+        lines = text.split("\n")
+        # Trailing element is a partial line (or "" after a newline).
+        if lines and lines[-1]:
+            self._partials[name] = lines[-1]
+        for line in lines[:-1]:
+            # Blank lines are preserved — the driver should reproduce
+            # worker output faithfully.
+            if len(batch) < self.max_batch_lines:
+                batch.append((name, line))
+            else:
+                self._pending.append((name, line))
+
     def poll_once(self) -> LogBatch:
         # Overflow from the previous poll goes out first — the offset has
         # already advanced past those bytes, so dropping them would lose
@@ -82,39 +118,89 @@ class LogTailer:
         for path in sorted(glob.glob(os.path.join(self.log_dir, self.pattern))):
             name = os.path.basename(path)
             try:
-                size = os.path.getsize(path)
+                st = os.stat(path)
             except OSError:
+                continue
+            size = st.st_size
+            if self.start_at_end and name not in self._offsets:
+                self._offsets[name] = size
+                self._inodes[name] = st.st_ino
                 continue
             offset = self._offsets.get(name, 0)
+            prev_ino = self._inodes.get(name)
+            self._inodes[name] = st.st_ino
+            rotated = size < offset or (
+                prev_ino is not None and st.st_ino != prev_ino and offset > 0
+            )
+            if rotated:
+                # Drain the unread pre-rotation suffix from the .1 half:
+                # copy-truncate copies the full old content there, rename
+                # rotation MOVES the old file there — either way bytes
+                # [offset:] of <path>.1 are exactly what we had not read.
+                old = path + ".1"
+                try:
+                    old_size = os.path.getsize(old)
+                except OSError:
+                    old_size = -1
+                if old_size > offset:
+                    data = self._read_span(old, offset, old_size)
+                    if data:
+                        self._append_lines(name, data, batch)
+                elif old_size < offset:
+                    # double rotation between polls (or a plain truncate):
+                    # the unread span is gone — resync rather than re-emit
+                    self._partials.pop(name, None)
+                    logger.debug("log %s rotated past the tail offset", name)
+                offset = self._offsets[name] = 0
             if size <= offset:
                 continue
-            try:
-                with open(path, "rb") as f:
-                    f.seek(offset)
-                    data = f.read(size - offset)
-            except OSError:
+            data = self._read_span(path, offset, size)
+            if data is None:
                 continue
             self._offsets[name] = size
-            text = self._partials.pop(name, "") + data.decode("utf-8", errors="replace")
-            lines = text.split("\n")
-            # Trailing element is a partial line (or "" after a newline).
-            if lines and lines[-1]:
-                self._partials[name] = lines[-1]
-            for line in lines[:-1]:
-                # Blank lines are preserved — the driver should reproduce
-                # worker output faithfully.
-                if len(batch) < self.max_batch_lines:
-                    batch.append((name, line))
-                else:
-                    self._pending.append((name, line))
+            self._append_lines(name, data, batch)
         return batch
 
 
+# ---------------------------------------------------------------------------
+# Driver-side sinks
+# ---------------------------------------------------------------------------
 def print_to_driver(batch: LogBatch):
     """Driver-side sink (reference: print_to_stdstream — prefix lines with
     their source worker)."""
     import sys
 
+    out = []
     for source, line in batch:
         tag = source.replace("worker-", "").replace(".log", "")
-        print(f"({tag}) {line}", file=sys.stderr)
+        out.append(f"({tag}) {line}\n")
+    # direct stream write, not print(): this REPRODUCES worker output on
+    # the driver console — routing it through a logger would re-format,
+    # re-level, and re-capture it
+    sys.stderr.write("".join(out))
+
+
+# Structured follow-mode sink (``ray-tpu logs --follow``): the controller
+# pushes filtered record batches over the driver connection
+# (rpc_log_records); whoever registered the sink renders them.
+_follow_sink: Optional[Callable[[List[dict]], None]] = None
+
+
+def set_follow_sink(fn: Optional[Callable[[List[dict]], None]]):
+    global _follow_sink
+    _follow_sink = fn
+
+
+def deliver_records(batch: List[dict]):
+    sink = _follow_sink
+    if sink is None:
+        import sys
+
+        from ray_tpu.core.log_plane import format_record
+
+        sys.stderr.write("".join(format_record(r) + "\n" for r in batch))
+        return
+    try:
+        sink(batch)
+    except Exception as e:  # noqa: BLE001 — a sink bug must not kill the RPC loop
+        logger.debug("follow sink failed: %s", e)

@@ -1,0 +1,891 @@
+"""Structured cluster log plane: attribution at the source.
+
+Reference: python/ray/_private/log_monitor.py plus the dashboard
+StateHead's logs API (``ray logs --actor-id/--task-id --follow``) and the
+error-event aggregation the GCS keeps per job. The reference attributes
+log lines to workers by file name and to tasks by magic prefix tokens;
+here every record is stamped structurally at the source:
+
+* **capture** — :func:`install` adds a :class:`logging.Handler` to the
+  root logger and (workers only) wraps ``sys.stdout``/``sys.stderr`` in
+  write-through proxies, so logger calls, ``print()`` inside tasks, and
+  uncaught-exception tracebacks all land — attributed — in a bounded
+  JSONL sidecar (``worker-<id>.jsonl``) next to the raw log. Task/actor
+  attribution reuses the per-thread tag ``profiling.set_thread_task``
+  installs around every task execution (PR 9) plus the thread-local
+  task/actor ids in ``runtime_context``.
+* **bounding** — the sidecar rotates by rename at ``log_rotate_bytes``
+  (one ``.1`` half kept, the PR 6 span-sink pattern); the RAW
+  ``worker-*.log`` is rotated copy-truncate by a maintenance thread (the
+  redirected-stdout fd keeps appending; rename would chase the fd). The
+  proxies' write-through shares the raw-file lock with the rotator, so
+  no line this process writes is lost to the copy/truncate window.
+* **shipping** — ERROR/exception records also enqueue for the worker's
+  controller connection (:func:`drain_ship`); the controller folds them
+  into its error-signature index (``state.summarize_errors()``). The
+  full firehose never crosses the wire — cluster search fans out to the
+  node-local sidecars instead (:func:`search_local`).
+
+Disabled via the ``log_structured`` config (the envelope A/B knob):
+capture becomes write-through-only and the sidecar goes quiet.
+"""
+from __future__ import annotations
+
+import collections
+import io
+import json
+import logging
+import os
+import re
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("ray_tpu.log_plane")
+
+# Severity vocabulary (bounded — these become metric tags and filter
+# values). STDOUT/STDERR mark raw stream lines that carry no logger level.
+SEVERITY_RANK = {
+    "DEBUG": 10,
+    "INFO": 20,
+    "STDOUT": 20,
+    "WARNING": 30,
+    "STDERR": 30,
+    "ERROR": 40,
+    "CRITICAL": 50,
+}
+MAX_MSG_BYTES = 8192
+
+_enabled = True
+_writer: Optional["StructuredLogWriter"] = None
+_raw_log_path: Optional[str] = None
+_raw_lock = threading.Lock()  # serializes raw write-through vs. rotation
+# pid cached at install: os.getpid() is a real syscall (~15us under
+# gVisor-class sandboxes) and _build_record runs per captured line
+_context: Dict[str, Any] = {"node": None, "worker": None, "proc": "",
+                            "pid": 0}
+# Per-severity record counts, folded into log_records_total by the
+# maintenance thread — a per-line Counter.inc would pay the global
+# metrics lock + cap resolution on every print (GIL-atomic dict ops;
+# a lost increment under a rare race is acceptable for a rate metric).
+_sev_counts: Dict[str, int] = {}
+# ERROR/exception records awaiting the ship loop (bounded: a controller
+# outage must not grow worker memory; oldest drop first).
+_ship: "collections.deque" = collections.deque(maxlen=2000)
+_installed = False
+_tls = threading.local()  # re-entrancy guard for the capture paths
+_metrics = None
+
+
+def set_enabled(flag: bool):
+    """Runtime toggle (the bench A/B): capture paths become write-through
+    no-ops when off."""
+    global _enabled
+    _enabled = bool(flag)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _get_metrics():
+    global _metrics
+    if _metrics is None:
+        from ray_tpu.util.metrics import Counter
+
+        _metrics = {
+            "records": Counter(
+                "log_records_total",
+                "Structured log records captured in this process, by severity",
+                ("severity",),
+            ),
+        }
+    return _metrics
+
+
+def _config_value(name: str, default):
+    from ray_tpu.util.profiling import _config_value as cv
+
+    return cv(name, default)
+
+
+# ---------------------------------------------------------------------------
+# Sidecar writer (rename rotation — this process owns the handle)
+# ---------------------------------------------------------------------------
+def _encode_record(rec: dict) -> bytes:
+    """Hand-rolled JSONL encoding for the capture hot path: fixed keys,
+    only user-controlled strings (msg/task/logger) pay a real
+    ``json.dumps``; id/hex fields interpolate directly. ~2.5x cheaper
+    than dumps() of the whole dict — this runs once per captured line.
+    Falls back to full dumps on anything surprising."""
+    try:
+        parts = [
+            f'"ts":{rec["ts"]:.6f}',
+            f'"sev":"{rec["sev"]}"',
+            f'"msg":{json.dumps(rec["msg"])}',
+        ]
+        for key in ("node", "worker"):
+            v = rec.get(key)
+            if v is not None:
+                parts.append(f'"{key}":"{v}"')
+        parts.append(f'"pid":{rec.get("pid", 0)}')
+        task = rec.get("task")
+        if task is not None:
+            parts.append(f'"task":{json.dumps(task)}')
+        for key in ("task_id", "actor_id"):
+            v = rec.get(key)
+            if v is not None:
+                parts.append(f'"{key}":"{v}"')
+        for key in ("logger", "exc"):
+            v = rec.get(key)
+            if v is not None:
+                parts.append(f'"{key}":{json.dumps(v)}')
+        return ("{" + ",".join(parts) + "}\n").encode("utf-8", "replace")
+    except (TypeError, ValueError, KeyError):
+        line = json.dumps(rec, separators=(",", ":"), default=str) + "\n"
+        return line.encode("utf-8", "replace")
+
+
+class StructuredLogWriter:
+    """Append-only JSONL sink, size-capped with ONE rotated half
+    (``<path>.1``, the span-sink pattern): disk use is bounded at ~2x
+    ``rotate_bytes``.
+
+    The hot path (``emit``) only encodes and appends to a bounded
+    in-memory queue; the maintenance thread drains it to disk every
+    ~0.25 s. ERROR-and-above records drain inline so incident/error
+    tails are never stale. A hard crash can lose the last <=0.25 s of
+    INFO-level sidecar lines — the raw log's write-through is
+    synchronous, so the lines themselves survive (the reference's
+    TaskEventBuffer makes the same trade)."""
+
+    MAX_QUEUED = 100_000
+
+    def __init__(self, path: str, rotate_bytes: int):
+        self.path = path
+        self.rotate_bytes = max(64 * 1024, int(rotate_bytes))
+        self._lock = threading.Lock()
+        self._fh = None
+        self._written = 0
+        self._queue: "collections.deque" = collections.deque(
+            maxlen=self.MAX_QUEUED
+        )
+
+    def _open(self):
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self._fh = open(self.path, "ab")
+        try:
+            self._written = os.fstat(self._fh.fileno()).st_size
+        except OSError:
+            self._written = 0
+
+    def emit(self, record: dict, flush: bool = False):
+        self._queue.append(_encode_record(record))
+        if flush:
+            self.flush()
+
+    def _drain_locked(self):
+        while self._queue:
+            batch: List[bytes] = []
+            size = 0
+            # chunk drains at the rotation cap so one huge backlog still
+            # rotates at the right boundaries
+            while self._queue and size < self.rotate_bytes // 2:
+                data = self._queue.popleft()
+                batch.append(data)
+                size += len(data)
+            if self._fh is None:
+                self._open()
+            if self._written + size > self.rotate_bytes and self._written:
+                self._fh.close()
+                os.replace(self.path, self.path + ".1")
+                self._open()
+            self._fh.write(b"".join(batch))
+            self._written += size
+        self._fh.flush()
+
+    def flush(self):
+        with self._lock:
+            if not self._queue:
+                return
+            try:
+                self._drain_locked()
+            except OSError as e:
+                logger.debug("sidecar drain failed: %s", e)
+
+    def close(self):
+        self.flush()
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+# ---------------------------------------------------------------------------
+# Record construction + capture legs
+# ---------------------------------------------------------------------------
+_task_tags: Dict[int, str] = {}  # rebound to profiling._task_tags at install
+_task_local = threading.local()  # rebound to runtime_context._task_local
+
+
+def _build_record(severity: str, msg: str, *, logger_name: str = "",
+                  exc_type: str = "") -> dict:
+    if len(msg) > MAX_MSG_BYTES:
+        msg = msg[:MAX_MSG_BYTES] + "...(truncated)"
+    rec = {
+        "ts": time.time(),
+        "sev": severity,
+        "msg": msg,
+        "node": _context["node"],
+        "worker": _context["worker"],
+        "pid": _context["pid"],
+        # per-thread task attribution: profiling tags carry the executing
+        # task/actor-method NAME; runtime_context the thread-local ids
+        "task": _task_tags.get(threading.get_ident()),
+        "task_id": getattr(_task_local, "task_id", None),
+        "actor_id": getattr(_task_local, "actor_id", None),
+    }
+    if logger_name:
+        rec["logger"] = logger_name
+    if exc_type:
+        rec["exc"] = exc_type
+    return rec
+
+
+def _record(severity: str, msg: str, *, logger_name: str = "",
+            exc_type: str = ""):
+    """One captured line → sidecar (+ ship queue for ERROR-and-above).
+    Re-entrancy-guarded: a failure inside the capture path logging about
+    itself must not recurse."""
+    if not _enabled or _writer is None or not msg:
+        return
+    if getattr(_tls, "capturing", False):
+        return
+    _tls.capturing = True
+    try:
+        rec = _build_record(severity, msg, logger_name=logger_name,
+                            exc_type=exc_type)
+        is_err = SEVERITY_RANK.get(severity, 20) >= SEVERITY_RANK["ERROR"] or exc_type
+        _writer.emit(rec, flush=bool(is_err))
+        if is_err:
+            _ship.append(rec)
+        _sev_counts[severity] = _sev_counts.get(severity, 0) + 1
+    except Exception as e:  # noqa: BLE001 — capture must never take the app down
+        logger.debug("log capture failed: %s", e)
+    finally:
+        _tls.capturing = False
+
+
+class _LogHandler(logging.Handler):
+    """Root-logger leg: every logging record, attributed and leveled."""
+
+    def emit(self, record: logging.LogRecord):
+        try:
+            msg = record.getMessage()
+            exc_type = ""
+            if record.exc_info and record.exc_info[0] is not None:
+                exc_type = record.exc_info[0].__name__
+                msg += "\n" + "".join(traceback.format_exception(*record.exc_info))
+            _record(record.levelname, msg, logger_name=record.name,
+                    exc_type=exc_type)
+        # reporting a failure here would re-enter this very handler
+        # (unbounded recursion); silence is the only safe exit
+        # ray-tpu: lint-ignore[RTL006]
+        except Exception:  # noqa: BLE001 — logging must never raise
+            pass
+
+
+_LOGGING_FILE = getattr(logging, "__file__", "<logging>")
+
+
+class _StreamProxy(io.TextIOBase):
+    """Write-through stdout/stderr wrapper: the raw log file keeps
+    receiving everything (log-to-driver tailing unchanged), and complete
+    lines additionally become structured records. Lines written by the
+    logging module's own StreamHandler are skipped — the handler leg
+    already recorded them with their real level."""
+
+    def __init__(self, orig, severity: str):
+        self._orig = orig
+        self._severity = severity
+        self._buffers: Dict[int, str] = {}  # per-thread partial lines
+
+    def write(self, s):
+        with _raw_lock:
+            n = self._orig.write(s)
+        if not _enabled or _writer is None or not s:
+            return n
+        # One-frame peek: logging.StreamHandler.emit's write call comes
+        # from logging/__init__.py — skip (already captured, leveled).
+        try:
+            if sys._getframe(1).f_code.co_filename == _LOGGING_FILE:
+                return n
+        except ValueError:
+            pass
+        ident = threading.get_ident()
+        buf = self._buffers.get(ident, "") + s
+        if "\n" in buf:
+            lines = buf.split("\n")
+            buf = lines[-1]
+            for line in lines[:-1]:
+                if line:
+                    _record(self._severity, line)
+        if buf:
+            self._buffers[ident] = buf
+        else:
+            self._buffers.pop(ident, None)
+        return n
+
+    def flush(self):
+        self._orig.flush()
+
+    def fileno(self):
+        return self._orig.fileno()
+
+    def isatty(self):
+        try:
+            return self._orig.isatty()
+        except (OSError, ValueError):
+            return False
+
+    def writable(self):
+        return True
+
+    @property
+    def buffer(self):
+        return self._orig.buffer
+
+    @property
+    def encoding(self):
+        return getattr(self._orig, "encoding", "utf-8")
+
+
+def record_task_error(task_name: str, task_id: Optional[str], exc: BaseException,
+                      tb_text: str):
+    """Attribution hook for task/actor failures: worker_main calls this
+    with the formatted traceback BEFORE the error crosses the wire, so
+    the error index sees every failure even when the caller swallows the
+    ref (reference: the GCS's per-job error events)."""
+    _record(
+        "ERROR",
+        f"task {task_name} failed: {tb_text}",
+        exc_type=type(exc).__name__,
+    )
+
+
+def drain_ship(max_records: int = 500) -> List[dict]:
+    """Pop queued ERROR records for the controller ship loop."""
+    out: List[dict] = []
+    while _ship and len(out) < max_records:
+        out.append(_ship.popleft())
+    return out
+
+
+def requeue_ship(batch: List[dict]):
+    """Put a failed ship batch back if there is room (bounded deque —
+    a full queue keeps the NEWER records instead)."""
+    room = (_ship.maxlen or 0) - len(_ship)
+    if room >= len(batch):
+        _ship.extendleft(reversed(batch))
+
+
+def start_ship_loop(core):
+    """Ship queued ERROR records over the process's existing controller
+    connection every ``log_ship_interval_ms`` (async on the RPC loop —
+    the PR 6 task-event flush pattern)."""
+    import asyncio
+
+    interval = float(core.config.get("log_ship_interval_ms", 1000)) / 1000.0
+
+    async def loop():
+        while True:
+            await asyncio.sleep(interval)
+            batch = drain_ship()
+            if not batch:
+                continue
+            try:
+                await core.peer.notify("log_errors", batch)
+            except Exception:  # noqa: BLE001 — controller gone
+                requeue_ship(batch)
+                if core.peer.closed:
+                    return
+
+    core.loop_runner.submit(loop())
+
+
+# ---------------------------------------------------------------------------
+# Install / maintenance
+# ---------------------------------------------------------------------------
+def _stdout_path() -> Optional[str]:
+    """Where this process's stdout actually goes (the spawn-redirected
+    worker-*.log) — via /proc so rotation needs no path plumbing."""
+    try:
+        path = os.readlink("/proc/self/fd/1")
+    except OSError:
+        return None
+    if path.endswith(".log") and os.path.isfile(path):
+        return path
+    return None
+
+
+def _rotate_raw(path: str, cap: int):
+    """Copy-truncate rotation for the raw log: the writing fd was
+    inherited O_APPEND by this process at spawn, so rename would chase it
+    — instead copy the content to ``.1`` and truncate in place (O_APPEND
+    writers continue at the new EOF). The raw-file lock closes the
+    copy→truncate window against this process's own (proxied) writers;
+    direct-fd writers in child subprocesses can lose a line across
+    rotation, like any copytruncate logrotate."""
+    import shutil
+
+    with _raw_lock:
+        try:
+            for stream in (sys.stdout, sys.stderr):
+                try:
+                    stream.flush()
+                except (OSError, ValueError):
+                    pass
+            if os.path.getsize(path) <= cap:
+                return
+            with open(path, "rb") as src, open(path + ".1", "wb") as dst:
+                shutil.copyfileobj(src, dst)
+            with open(path, "r+b") as f:
+                f.truncate(0)
+        except OSError as e:
+            logger.debug("raw log rotation failed: %s", e)
+
+
+def _flush_sev_counts():
+    if not _sev_counts:
+        return
+    try:
+        m = _get_metrics()["records"]
+        for sev in list(_sev_counts):
+            n = _sev_counts.pop(sev, 0)
+            if n:
+                m.inc(n, {"severity": sev})  # ray-tpu: lint-ignore[RTL004] — fixed SEVERITY_RANK vocabulary
+    except Exception as e:  # noqa: BLE001 — metrics must not kill maintenance
+        logger.debug("severity count flush failed: %s", e)
+
+
+def _maintenance_loop(stop: threading.Event):
+    while not stop.wait(0.25):
+        w = _writer
+        if w is not None:
+            w.flush()
+        _flush_sev_counts()
+        path = _raw_log_path
+        if path is not None:
+            # cap re-read each sweep: at install time the cluster config
+            # may not be attached yet (worker_main installs before
+            # api._attach_worker), and the writer's cap is authoritative
+            # for the sidecar anyway
+            cap = int(
+                w.rotate_bytes if w is not None
+                else _config_value("log_rotate_bytes", 64 * 1024 * 1024)
+            )
+            try:
+                if os.path.getsize(path) > cap:
+                    _rotate_raw(path, cap)
+            except OSError:
+                pass
+
+
+_maintenance_stop: Optional[threading.Event] = None
+_prev_threading_hook = None
+_handler: Optional[_LogHandler] = None
+
+
+def install(session_dir: str, *, node_id: Optional[str] = None,
+            worker_id: Optional[str] = None, proc: str = "",
+            capture_streams: bool = True, rotate_bytes: Optional[int] = None):
+    """Wire this process into the log plane. Idempotent. Workers pass
+    ``capture_streams=True`` (their stdout IS the spawn-redirected log
+    file); drivers/controller/agents install the logging-handler leg
+    only."""
+    global _writer, _raw_log_path, _installed, _maintenance_stop
+    global _prev_threading_hook, _handler
+    if _installed:
+        return
+    _installed = True
+    _context["node"] = node_id[:12] if node_id else None
+    _context["worker"] = worker_id[:8] if worker_id else None
+    _context["proc"] = proc
+    _context["pid"] = os.getpid()
+    # bind the attribution sources once (hot path: one dict.get + one
+    # getattr per record instead of two module imports)
+    global _task_tags, _task_local
+    from ray_tpu import runtime_context
+    from ray_tpu.util import profiling
+
+    _task_tags = profiling._task_tags
+    _task_local = runtime_context._task_local
+    if rotate_bytes is None:
+        rotate_bytes = int(_config_value("log_rotate_bytes", 64 * 1024 * 1024))
+    name = f"worker-{worker_id[:8]}" if worker_id else (proc or f"driver-{os.getpid()}")
+    _writer = StructuredLogWriter(
+        os.path.join(session_dir, "logs", f"{name}.jsonl"), rotate_bytes
+    )
+    _handler = _LogHandler()
+    logging.getLogger().addHandler(_handler)
+    if capture_streams:
+        _raw_log_path = _stdout_path()
+        sys.stdout = _StreamProxy(sys.stdout, "STDOUT")
+        sys.stderr = _StreamProxy(sys.stderr, "STDERR")
+
+        _prev_threading_hook = threading.excepthook
+
+        def _thread_hook(args):
+            try:
+                _record(
+                    "ERROR",
+                    "uncaught exception in thread "
+                    f"{getattr(args.thread, 'name', '?')}: "
+                    + "".join(traceback.format_exception(
+                        args.exc_type, args.exc_value, args.exc_traceback)),
+                    exc_type=args.exc_type.__name__,
+                )
+            finally:
+                _prev_threading_hook(args)
+
+        threading.excepthook = _thread_hook
+    _maintenance_stop = threading.Event()
+    threading.Thread(
+        target=_maintenance_loop, args=(_maintenance_stop,),
+        daemon=True, name="log-plane-maintenance",
+    ).start()
+
+
+def uninstall():
+    """Detach (driver shutdown): remove the handler, restore hooks, close
+    the sidecar. Stream proxies stay (write-through is inert) — workers
+    exit instead of uninstalling."""
+    global _writer, _installed, _maintenance_stop, _prev_threading_hook
+    global _handler, _raw_log_path
+    if not _installed:
+        return
+    _installed = False
+    if _maintenance_stop is not None:
+        _maintenance_stop.set()
+        _maintenance_stop = None
+    if _handler is not None:
+        logging.getLogger().removeHandler(_handler)
+        _handler = None
+    if _prev_threading_hook is not None:
+        threading.excepthook = _prev_threading_hook
+        _prev_threading_hook = None
+    w, _writer = _writer, None
+    _raw_log_path = None
+    if w is not None:
+        w.close()
+    _ship.clear()
+
+
+# ---------------------------------------------------------------------------
+# Node-local query legs (answered by agents and the controller's head leg)
+# ---------------------------------------------------------------------------
+def list_local(log_dir: str) -> List[dict]:
+    """Rows for every log file under ``log_dir``: {filename, size, mtime,
+    structured} (rotated ``.1`` halves are folded into their live file's
+    size rather than listed)."""
+    rows: List[dict] = []
+    if not os.path.isdir(log_dir):
+        return rows
+    names = sorted(os.listdir(log_dir))
+    live = {n for n in names if not n.endswith(".1")}
+    for name in names:
+        if name.endswith(".1"):
+            continue
+        path = os.path.join(log_dir, name)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        size = st.st_size
+        try:
+            size += os.path.getsize(path + ".1")
+        except OSError:
+            pass
+        rows.append(
+            {
+                "filename": name,
+                "size": size,
+                "mtime": st.st_mtime,
+                "structured": (
+                    name.endswith(".jsonl")
+                    or os.path.splitext(name)[0] + ".jsonl" in live
+                ),
+            }
+        )
+    return rows
+
+
+def read_local(log_dir: str, filename: str, tail: int = 1000) -> str:
+    """Last ``tail`` lines of one log file (rotation-aware: short files
+    borrow their ``.1`` half's tail first). Raises ValueError on paths
+    escaping the log dir."""
+    root = os.path.realpath(log_dir)
+    path = os.path.realpath(os.path.join(log_dir, filename))
+    if os.path.commonpath([path, root]) != root:
+        raise ValueError("log path escapes the session log dir")
+    lines: List[str] = []
+    for p in (path + ".1", path):
+        if not os.path.isfile(p):
+            continue
+        try:
+            with open(p, errors="replace") as f:
+                lines.extend(f.readlines())
+        except OSError:
+            continue
+    if not lines and not os.path.exists(path):
+        raise FileNotFoundError(filename)
+    return "".join(lines[-max(1, tail):])
+
+
+_FILTER_KEYS = ("pattern", "severity", "task", "actor", "node", "since",
+                "until")
+
+
+def match_record(rec: dict, *, pattern=None, severity: Optional[str] = None,
+                 task: Optional[str] = None, actor: Optional[str] = None,
+                 node: Optional[str] = None, since: Optional[float] = None,
+                 until: Optional[float] = None) -> bool:
+    """The one filter rule shared by search, follow, and the CLI:
+    regex over msg, severity floor, time range, and entity (task name /
+    task-id / actor-id prefix) + node prefix filters."""
+    if severity:
+        floor = SEVERITY_RANK.get(severity.upper(), 20)
+        if SEVERITY_RANK.get(str(rec.get("sev", "")).upper(), 20) < floor:
+            return False
+    ts = rec.get("ts")
+    if since is not None and (ts is None or ts < since):
+        return False
+    if until is not None and (ts is None or ts > until):
+        return False
+    if node and not str(rec.get("node") or "").startswith(node[:12]):
+        return False
+    if task:
+        name = str(rec.get("task") or "")
+        tid = str(rec.get("task_id") or "")
+        if task not in name and not tid.startswith(task):
+            return False
+    if actor:
+        aid = str(rec.get("actor_id") or "")
+        name = str(rec.get("task") or "")
+        if not aid.startswith(actor) and not name.startswith(actor):
+            return False
+    if pattern is not None:
+        if isinstance(pattern, str):
+            pattern = re.compile(pattern)
+        if not pattern.search(str(rec.get("msg", ""))):
+            return False
+    return True
+
+
+def search_local(log_dir: str, *, pattern: Optional[str] = None,
+                 severity: Optional[str] = None, task: Optional[str] = None,
+                 actor: Optional[str] = None, node: Optional[str] = None,
+                 since: Optional[float] = None, until: Optional[float] = None,
+                 limit: int = 1000, include_raw: bool = True) -> List[dict]:
+    """Grep this node's sidecars (rotated halves included, oldest first)
+    for records passing the filters; bounded result size. Raw ``.log``
+    files WITHOUT a sidecar (controller.log, agent logs before install)
+    fall back to plain grep when only pattern/time filters apply —
+    severity/entity filters need structure and skip them."""
+    limit = max(1, min(int(limit), 10000))
+    rx = re.compile(pattern) if pattern else None
+    out: List[dict] = []
+    if not os.path.isdir(log_dir):
+        return out
+    names = sorted(os.listdir(log_dir))
+    sidecars = [n for n in names
+                if n.endswith(".jsonl") and not n.startswith("spans-")]
+    structured_stems = {os.path.splitext(n)[0] for n in sidecars}
+    for name in sidecars:
+        base = os.path.join(log_dir, name)
+        for path, fname in ((base + ".1", name + ".1"), (base, name)):
+            # rotated halves keep their ".1" suffix in the result rows:
+            # the cross-node merge dedups on (file, line), and a live
+            # line 5 must not collide with the rotated half's line 5
+            if len(out) >= limit or not os.path.isfile(path):
+                continue
+            try:
+                with open(path, errors="replace") as f:
+                    for lineno, line in enumerate(f, 1):
+                        if len(out) >= limit:
+                            break
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue
+                        if match_record(rec, pattern=rx, severity=severity,
+                                        task=task, actor=actor, node=node,
+                                        since=since, until=until):
+                            rec["file"] = fname
+                            rec["line"] = lineno
+                            out.append(rec)
+            except OSError:
+                continue
+    if include_raw and rx is not None and not (severity or task or actor):
+        for name in names:
+            if (not name.endswith(".log")
+                    or os.path.splitext(name)[0] in structured_stems):
+                continue
+            path = os.path.join(log_dir, name)
+            try:
+                with open(path, errors="replace") as f:
+                    for lineno, line in enumerate(f, 1):
+                        if len(out) >= limit:
+                            break
+                        if rx.search(line):
+                            out.append(
+                                {"ts": None, "sev": None,
+                                 "msg": line.rstrip("\n"),
+                                 "node": None, "worker": None,
+                                 "file": name, "line": lineno}
+                            )
+            except OSError:
+                continue
+    out.sort(key=lambda r: (r.get("ts") or 0.0, r.get("file", ""),
+                            r.get("line", 0)))
+    return out[:limit]
+
+
+def format_record(rec: dict) -> str:
+    """One search/follow record as a human line (the CLI's renderer)."""
+    ts = rec.get("ts")
+    when = (
+        time.strftime("%H:%M:%S", time.localtime(ts)) + f".{int(ts % 1 * 1000):03d}"
+        if ts else "--:--:--"
+    )
+    who = rec.get("worker") or rec.get("file") or "?"
+    node = rec.get("node") or "?"
+    head = f"{when} {str(rec.get('sev') or '-'):8s} {node[:8]}/{who}"
+    if rec.get("task"):
+        head += f" [{rec['task']}]"
+    return f"{head}  {rec.get('msg', '')}"
+
+
+# ---------------------------------------------------------------------------
+# Error signatures (controller-side aggregation helper)
+# ---------------------------------------------------------------------------
+_FRAME_RE = re.compile(r'File "([^"]+)", line \d+, in (\S+)')
+_NOISE_RE = re.compile(r"0x[0-9a-fA-F]+|[0-9a-f]{6,}|\d+")
+_PKG_MARKER = os.sep + "ray_tpu" + os.sep
+
+
+def error_signature(rec: dict, max_frames: int = 3) -> str:
+    """Bounded signature for an ERROR record: exception type + the top
+    (deepest) user frames from its traceback, file-basenamed and
+    line-number-free so signatures survive line drift; records without a
+    traceback group by their digit-normalized message head. The caller
+    interns the result (bounded vocabulary — the PR 10 CallsiteTable
+    pattern)."""
+    msg = str(rec.get("msg", ""))
+    frames = _FRAME_RE.findall(msg)
+    user = [(f, fn) for f, fn in frames if _PKG_MARKER not in f]
+    pick = (user or frames)[-max_frames:]
+    exc = rec.get("exc") or ""
+    if pick:
+        chain = ";".join(
+            f"{os.path.basename(f)}:{fn}" for f, fn in pick
+        )
+        return f"{exc or 'Error'}@{chain}"
+    head = _NOISE_RE.sub("#", msg.splitlines()[0][:80]) if msg else ""
+    return f"{exc or 'ERROR'}@{head}"
+
+
+class ErrorIndex:
+    """Controller-side error aggregation: ERROR records dedupe by bounded
+    :func:`error_signature` into {count, first/last seen, sample
+    traceback, lifecycle entity link} rows — the answer to "what errors
+    is the cluster seeing right now" without reading a single log file
+    (reference: the GCS's per-job error-event table + the dashboard's
+    event aggregation).
+
+    Bounded twice over: signatures intern through a CallsiteTable
+    (``log_error_index_size``; overflow collapses into ``(other)``) and
+    sample tracebacks truncate at 8 KB. ``log_errors_total{signature}``
+    rides the normal metric pipeline (registry cardinality cap
+    backstops)."""
+
+    def __init__(self, cap: int = 256):
+        from ray_tpu.core.memory_census import CallsiteTable
+
+        self._intern = CallsiteTable(cap=cap)
+        self._rows: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self.total = 0
+        self._recent: "collections.deque" = collections.deque(maxlen=200)
+        self._metric = None
+
+    def _counter(self):
+        if self._metric is None:
+            from ray_tpu.util.metrics import Counter
+
+            self._metric = Counter(
+                "log_errors_total",
+                "ERROR log records ingested by the cluster error index, "
+                "by bounded signature",
+                ("signature",),
+            )
+        return self._metric
+
+    def ingest(self, rec: dict, source: str = ""):
+        sig = self._intern.intern(error_signature(rec))
+        now = rec.get("ts") or time.time()
+        with self._lock:
+            self.total += 1
+            row = self._rows.get(sig)
+            if row is None:
+                row = self._rows[sig] = {
+                    "signature": sig,
+                    "exc_type": rec.get("exc") or "",
+                    "count": 0,
+                    "first_seen": now,
+                    "last_seen": now,
+                    "sample": str(rec.get("msg", ""))[:MAX_MSG_BYTES],
+                    "entity": {
+                        "task": rec.get("task"),
+                        "task_id": rec.get("task_id"),
+                        "actor_id": rec.get("actor_id"),
+                        "worker": rec.get("worker"),
+                        "node": rec.get("node"),
+                    },
+                    "nodes": set(),
+                }
+            row["count"] += 1
+            row["last_seen"] = max(row["last_seen"], now)
+            if rec.get("node"):
+                row["nodes"].add(rec["node"])
+            self._recent.append(rec)
+        try:
+            self._counter().inc(1, {"signature": sig[:80]})  # ray-tpu: lint-ignore[RTL004] — interned under log_error_index_size + registry cap
+        except Exception as e:  # noqa: BLE001 — metrics must not break ingest
+            logger.debug("error index metric failed: %s", e)
+
+    def summarize(self, limit: int = 50) -> dict:
+        with self._lock:
+            rows = sorted(self._rows.values(), key=lambda r: -r["count"])
+            keep = rows[: max(1, limit)]
+            out = {
+                "total": self.total,
+                "distinct": len(rows),
+                "truncated": len(rows) > len(keep),
+                "signatures": {
+                    r["signature"]: {**r, "nodes": sorted(r["nodes"])}
+                    for r in keep
+                },
+            }
+        return out
+
+    def recent_tail(self, n: int = 100) -> List[dict]:
+        """Newest ingested ERROR records — the spike incident's attached
+        log tail."""
+        with self._lock:
+            return list(self._recent)[-n:]

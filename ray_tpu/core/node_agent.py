@@ -106,6 +106,10 @@ def spawn_worker(session_dir: str, controller_addr: str, node_id: NodeID, shm_di
         cmd = _container.wrap_command(container_image, cmd, env, session_dir, shm_dir)
     log_dir = os.path.join(session_dir, "logs")
     os.makedirs(log_dir, exist_ok=True)
+    # O_APPEND ("ab") is load-bearing: the worker size-caps this file
+    # in-process by copy-truncate rotation (core/log_plane.py — rename
+    # would chase this inherited fd), and append-mode writes land at the
+    # new EOF after a truncate instead of leaving a sparse hole.
     out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:8]}.log"), "ab")
     proc = subprocess.Popen(
         cmd,
@@ -570,6 +574,34 @@ class NodeAgent:
             "objects": self.store.object_rows(limit),
         }
 
+    # -- log plane fan-out legs (core/log_plane.py; reference: the
+    # dashboard agent's per-node logs grpc service) ---------------------
+    def _log_dir(self) -> str:
+        return os.path.join(self.session_dir, "logs")
+
+    # File I/O runs off-loop (to_thread): a grep over sidecars near the
+    # 64 MB rotation cap must not stall the agent's control channel —
+    # heartbeats, worker RPCs, and spawns share this event loop.
+    async def rpc_list_logs(self, peer):
+        from ray_tpu.core import log_plane
+
+        files = await asyncio.to_thread(log_plane.list_local, self._log_dir())
+        return {"node_id": self.node_id.hex(), "files": files}
+
+    async def rpc_get_log(self, peer, filename: str, tail: int = 1000):
+        from ray_tpu.core import log_plane
+
+        return await asyncio.to_thread(
+            log_plane.read_local, self._log_dir(), filename, tail
+        )
+
+    async def rpc_search_logs(self, peer, **filters):
+        from ray_tpu.core import log_plane
+
+        return await asyncio.to_thread(
+            log_plane.search_local, self._log_dir(), **filters
+        )
+
     def on_disconnect(self, peer):
         wid = peer.meta.get("direct_wid")
         if wid is not None:
@@ -629,6 +661,20 @@ class NodeAgent:
             hz=float(cfg.get("profiling_continuous_hz", 0.0)),
             ring_s=float(cfg.get("profiling_ring_s", 60.0)),
         )
+        if cfg.get("log_structured", True):
+            # Agent leg of the log plane: its own logging records become
+            # a structured sidecar (handler-only — the agent's streams
+            # are the session's agent-*.log already); ERROR records ship
+            # with the telemetry heartbeat.
+            from ray_tpu.core import log_plane
+
+            log_plane.install(
+                self.session_dir,
+                node_id=self.node_id.hex(),
+                proc=f"agent-{self.node_id.hex()[:8]}",
+                capture_streams=False,
+                rotate_bytes=int(cfg.get("log_rotate_bytes", 64 << 20)),
+            )
         monitor_task = asyncio.get_running_loop().create_task(
             self._memory_monitor_loop()
         )
@@ -674,16 +720,22 @@ class NodeAgent:
             sample["num_direct_workers"] = len(self._direct)
             sample["num_children"] = len(_children)
             records = _metrics.drain_records()
+            from ray_tpu.core import log_plane as _lp
+
+            errors = _lp.drain_ship()
             try:
                 await self._controller_peer.notify(
                     "node_telemetry", self.node_id, sample
                 )
                 if records:
                     await self._controller_peer.notify("metrics_report", records)
+                if errors:
+                    await self._controller_peer.notify("log_errors", errors)
             except Exception as e:  # noqa: BLE001 — transient controller hiccup
                 if self._controller_peer.closed or self._exit.is_set():
                     return
                 _metrics.requeue_records(records)
+                _lp.requeue_ship(errors)
                 logger.warning("telemetry report failed: %s", e)
 
     async def _memory_monitor_loop(self):

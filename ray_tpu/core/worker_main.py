@@ -476,6 +476,13 @@ class TaskExecutor:
         except Exception as e:  # noqa: BLE001 — user errors cross the wire
             tb = traceback.format_exc()
             err = e if isinstance(e, TaskError) else TaskError(spec.name, tb, None)
+            # Structured log plane: the failure traceback is recorded —
+            # attributed to this task — BEFORE the error crosses the
+            # wire, so `state.summarize_errors()` sees every failure even
+            # when the caller never gets the ref (core/log_plane.py).
+            from ray_tpu.core import log_plane
+
+            log_plane.record_task_error(spec.name, spec.task_id.hex(), e, tb)
             if reply is not None:
                 self._report_direct(spec, None, err, reply)
             else:
@@ -642,6 +649,9 @@ class TaskExecutor:
         except Exception as e:  # noqa: BLE001 — mid-stream error → final item
             tb = traceback.format_exc()
             err_item = e if isinstance(e, TaskError) else TaskError(spec.name, tb, None)
+            from ray_tpu.core import log_plane
+
+            log_plane.record_task_error(spec.name, spec.task_id.hex(), e, tb)
             oid = ObjectID.for_task_return(spec.task_id, index)
             self.core.put_serialized(oid, serialize(err_item), is_error=True)
             self.core._call("stream_item", spec.task_id, index)
@@ -705,6 +715,23 @@ def main():
         listen_addr=f"{host_ip()}:{listen_port}",
     )
     handler._controller_peer = core.peer
+    # Structured log plane (core/log_plane.py): stamp every logging
+    # record / print() line / task traceback with {node, worker, task,
+    # severity, ts} into the JSONL sidecar next to this worker's raw log,
+    # rotate both at log_rotate_bytes, and ship ERROR records to the
+    # controller's error index. Installed BEFORE the executor attaches so
+    # buffered tasks' output is captured too.
+    if core.config.get("log_structured", True):
+        from ray_tpu.core import log_plane
+
+        log_plane.install(
+            core.session_dir,
+            node_id=node_id.hex(),
+            worker_id=worker_id.hex(),
+            capture_streams=True,
+            rotate_bytes=int(core.config.get("log_rotate_bytes", 64 << 20)),
+        )
+        log_plane.start_ship_loop(core)
     # Make the full public API usable from inside tasks (nested tasks,
     # ray_tpu.get/put in user code) BEFORE any buffered task can run.
     from ray_tpu.core import api

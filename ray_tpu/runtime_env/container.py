@@ -25,6 +25,7 @@ Differences from the reference, by design:
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import shlex
 import shutil
@@ -194,13 +195,19 @@ def _main(argv: List[str]) -> int:
     N concurrently spawning workers elect one puller) on the HOST before
     the shell execs the container runtime."""
     if len(argv) != 1:
-        print("usage: python -m ray_tpu.runtime_env.container <image_uri>",
-              file=sys.stderr)
+        sys.stderr.write(
+            "usage: python -m ray_tpu.runtime_env.container <image_uri>\n"
+        )
         return 2
     try:
         ensure_image(argv[0])
     except RuntimeEnvSetupError as e:
-        print(str(e), file=sys.stderr)
+        # this hook runs inside the spawned worker's shell — its stderr
+        # IS the worker log, and a leveled record reaches the log plane
+        logging.basicConfig(level=logging.INFO)
+        logging.getLogger("ray_tpu.runtime_env.container").error(
+            "image pull failed: %s", e
+        )
         return 1
     return 0
 

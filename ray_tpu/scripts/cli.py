@@ -12,11 +12,12 @@ Usage:
   ray-tpu status
   ray-tpu submit -- python my_script.py              # run as a job
   ray-tpu job list | job logs ID | job stop ID
-  ray-tpu summary tasks|actors|objects|memory|lifecycle|rl|profiling
+  ray-tpu summary tasks|actors|objects|memory|lifecycle|rl|profiling|errors
   ray-tpu timeline [--output FILE]
   ray-tpu profile stacks|cpu|device|incidents|captures [...]
   ray-tpu memory [--node N] [--leaks] [--limit K] [--offline] [--json]
-  ray-tpu logs [FILENAME]
+  ray-tpu logs [FILENAME] [--node N] [--task T] [--actor A] [--grep RE]
+               [--err] [--tail N] [--follow] [--offline]
   ray-tpu microbenchmark
 """
 from __future__ import annotations
@@ -292,6 +293,7 @@ def cmd_summary(args):
         "lifecycle": state.summarize_lifecycle,
         "rl": state.summarize_rl,
         "profiling": state.summarize_profiling,
+        "errors": state.summarize_errors,
     }[args.what]
     print(json.dumps(fn(), indent=2))
     return 0
@@ -495,15 +497,93 @@ def cmd_drain_node(args):
     print(f"draining {args.node_id}")
 
 
+def _logs_fixture() -> list:
+    """Canned search_logs()-shaped records for `logs --offline`:
+    exercises the record renderer (severity, node/worker attribution,
+    task tags, raw-grep fallback rows) with no cluster — the tier-1
+    smoke that keeps the view from rotting."""
+    return [
+        {"ts": 1700000000.103, "sev": "INFO", "node": "aabbccddee00",
+         "worker": "aaaa0000", "pid": 201, "task": "train_loop",
+         "task_id": "11" * 16, "actor_id": None,
+         "msg": "step 41 loss 2.31", "file": "worker-aaaa0000.jsonl",
+         "line": 7},
+        {"ts": 1700000000.250, "sev": "STDOUT", "node": "aabbccddee00",
+         "worker": "aaaa0000", "pid": 201, "task": "train_loop",
+         "task_id": "11" * 16, "actor_id": None,
+         "msg": "checkpoint saved to /tmp/ck-41",
+         "file": "worker-aaaa0000.jsonl", "line": 8},
+        {"ts": 1700000000.912, "sev": "ERROR", "node": "ffee00112233",
+         "worker": "bbbb0000", "pid": 202, "task": "Loader.fetch",
+         "task_id": "22" * 16, "actor_id": "33" * 16,
+         "exc": "ValueError",
+         "msg": "task Loader.fetch failed: Traceback (most recent call "
+                "last):\n  ...\nValueError: bad shard 7",
+         "file": "worker-bbbb0000.jsonl", "line": 3},
+        {"ts": None, "sev": None, "node": None, "worker": None,
+         "msg": "[controller] WARNING lease queue deep",
+         "file": "controller.log", "line": 4021},
+    ]
+
+
+def _render_log_records(rows: list, out=print) -> int:
+    from ray_tpu.core.log_plane import format_record
+
+    for rec in rows:
+        out(format_record(rec))
+    return 0
+
+
 def cmd_logs(args):
+    """``ray-tpu logs``: list files, fetch one, search with attribution
+    filters, or live-follow (reference: `ray logs` + the StateHead logs
+    API; `--task/--actor/--grep/--err` need the structured sidecars the
+    log plane writes — core/log_plane.py)."""
+    severity = "ERROR" if args.err else args.severity
+    filtered = any((args.grep, args.task, args.actor, severity))
+    if args.offline:
+        from ray_tpu.core.log_plane import match_record
+
+        rows = [
+            r for r in _logs_fixture()
+            if match_record(r, pattern=args.grep, severity=severity,
+                            task=args.task, actor=args.actor,
+                            node=args.node)
+        ]
+        return _render_log_records(rows)
     from ray_tpu.util import state
 
     _connect()
-    if args.filename:
-        print(state.get_log(args.filename, tail=args.tail), end="")
-    else:
-        for name in state.list_logs():
-            print(name)
+    if args.follow:
+        import queue as _q
+
+        records: "_q.Queue" = _q.Queue()
+        stop = state.follow_logs(
+            records.put, pattern=args.grep, severity=severity,
+            task=args.task, actor=args.actor, node=args.node,
+        )
+        print("following cluster logs (ctrl-c to stop)...", file=sys.stderr)
+        try:
+            while True:
+                _render_log_records(records.get())
+        except KeyboardInterrupt:
+            stop()
+            return 0
+    if args.filename and not filtered:
+        print(state.get_log(args.filename, tail=args.tail, node=args.node),
+              end="")
+        return 0
+    if filtered:
+        rows = state.search_logs(
+            args.grep, severity=severity,
+            task=args.task, actor=args.actor, node=args.node,
+            limit=args.tail,
+        )
+        return _render_log_records(rows)
+    for row in state.list_log_files(node=args.node):
+        mark = "*" if row.get("structured") else " "
+        node = (row.get("node") or "?")[:12]
+        print(f"{row['filename']:<40} {mark} {row['size']:>12}  {node}")
     return 0
 
 
@@ -887,7 +967,7 @@ def main(argv=None):
     sp.add_argument(
         "what",
         choices=["tasks", "actors", "objects", "memory", "lifecycle", "rl",
-                 "profiling"],
+                 "profiling", "errors"],
     )
     sp.set_defaults(fn=cmd_summary)
 
@@ -972,9 +1052,27 @@ def main(argv=None):
     sp.add_argument("--timeout", type=float, default=300.0)
     sp.set_defaults(fn=cmd_drain_node)
 
-    sp = sub.add_parser("logs", help="list/tail session logs")
+    sp = sub.add_parser(
+        "logs",
+        help="cluster logs: list/tail files, search with task/actor/"
+             "severity attribution, or live-follow",
+    )
     sp.add_argument("filename", nargs="?")
-    sp.add_argument("--tail", type=int, default=1000)
+    sp.add_argument("--tail", type=int, default=1000,
+                    help="lines to fetch / search-result cap")
+    sp.add_argument("--node", help="filter to one node (node-id hex prefix)")
+    sp.add_argument("--task",
+                    help="filter to one task (name substring or id prefix)")
+    sp.add_argument("--actor", help="filter to one actor (id prefix)")
+    sp.add_argument("--grep", help="regex over structured log messages")
+    sp.add_argument("--severity",
+                    help="severity floor (DEBUG/INFO/WARNING/ERROR)")
+    sp.add_argument("--err", action="store_true",
+                    help="shortcut for --severity ERROR")
+    sp.add_argument("--follow", "-f", action="store_true",
+                    help="stream matching records live (ctrl-c to stop)")
+    sp.add_argument("--offline", action="store_true",
+                    help="render from a built-in fixture (no cluster)")
     sp.set_defaults(fn=cmd_logs)
 
     sub.add_parser("microbenchmark", help="core perf smoke").set_defaults(fn=cmd_microbenchmark)

@@ -1,4 +1,4 @@
-"""ray_tpu lint rules RTL001–RTL006.
+"""ray_tpu lint rules RTL001–RTL007.
 
 Each rule targets a failure class this codebase has actually hit (or that
 Ray itself accumulates at scale):
@@ -32,6 +32,11 @@ Ray itself accumulates at scale):
   ``except Exception/BaseException: pass`` bodies. Swallows on control
   paths turn hard failures into hangs; convert to logged warnings or
   narrow the type.
+* RTL007 print-in-package — bare ``print()`` inside library code
+  (CLI/tools modules exempt). Cluster-process output belongs on a
+  logger so the structured log plane (core/log_plane.py) can stamp it
+  with severity + task attribution; a print is invisible to
+  ``ray-tpu logs --err`` and the error index.
 """
 from __future__ import annotations
 
@@ -669,3 +674,46 @@ class SilentSwallow(Checker):
                 continue  # docstring/ellipsis only
             return False
         return True
+
+
+# ---------------------------------------------------------------------------
+# RTL007 — bare print() in package code
+
+
+@register
+class PrintInPackage(Checker):
+    rule = "RTL007"
+    name = "print-in-package"
+    description = (
+        "bare print() in library code bypasses the structured log plane"
+    )
+
+    # CLI surfaces legitimately print to the user's console: the
+    # ``ray-tpu`` entrypoints (scripts/) and the lint tool itself
+    # (tools/). Everything else in the package runs inside cluster
+    # processes whose output should carry severity + task attribution
+    # through the log plane (core/log_plane.py) — a logger call does,
+    # a bare print() does not.
+    _EXEMPT_SEGMENTS = ("scripts", "tools")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        parts = ctx.relpath.replace("\\", "/").split("/")
+        if any(seg in parts[:-1] for seg in self._EXEMPT_SEGMENTS):
+            return ()
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                findings.append(
+                    ctx.finding(
+                        self.rule,
+                        node,
+                        "bare print() in package code — route through a "
+                        "logger (captured + attributed by the log plane) "
+                        "or add a lint-ignore with justification",
+                    )
+                )
+        return findings

@@ -68,6 +68,7 @@ ROWS = (
     ("Control Plane", ("task_state_", "task_pending_", "lease_",
                        "lockwatch_")),
     ("Profiling", ("task_cpu_", "profiling_")),
+    ("Logs & Errors", ("log_",)),
     ("Memory", ("object_store_", "object_refs_", "object_free_",
                 "memory_leak_")),
     ("Cluster Resources", ("tpu_hbm_", "node_",

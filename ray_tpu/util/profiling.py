@@ -659,6 +659,7 @@ INCIDENT_TRIGGERS = (
     "slo_breach",
     "memory_pressure",
     "memory_leak",
+    "error_spike",
     "manual",
 )
 

@@ -479,19 +479,98 @@ def summarize_profiling() -> dict:
     }
 
 
-def list_logs() -> List[str]:
-    d = _logs_dir()
-    return sorted(os.listdir(d)) if os.path.isdir(d) else []
+def list_logs(node: Optional[str] = None) -> List[str]:
+    """Cluster-wide log file names (controller + every node's agent leg,
+    merged/deduplicated; reference: ``ray logs`` / StateHead list_logs).
+    ``node``: restrict to one node (node-id hex prefix). Falls back to
+    the local session log dir against servers without the RPC."""
+    return [r["filename"] for r in list_log_files(node=node)]
 
 
-def get_log(filename: str, tail: int = 1000) -> str:
-    path = os.path.join(_logs_dir(), filename)
-    root = os.path.realpath(_logs_dir())
-    if os.path.commonpath([os.path.realpath(path), root]) != root:
-        raise ValueError("log path escapes the session log dir")
-    with open(path, errors="replace") as f:
-        lines = f.readlines()
-    return "".join(lines[-tail:])
+def list_log_files(node: Optional[str] = None) -> List[dict]:
+    """Detail rows: {filename, size (rotated half folded in), mtime,
+    structured (has a JSONL sidecar), node}."""
+    try:
+        return _require_worker()._call("list_logs", node=node, timeout=20)
+    except Exception:  # noqa: BLE001 — legacy server without the RPC
+        from ray_tpu.core.log_plane import list_local
+
+        return list_local(_logs_dir())
+
+
+def get_log(filename: str, tail: int = 1000, node: Optional[str] = None) -> str:
+    """One log file's tail, wherever in the cluster it lives (rotation-
+    aware: a freshly-rotated file borrows its ``.1`` half's tail).
+    Raises ValueError on paths escaping the log dir."""
+    try:
+        return _require_worker()._call(
+            "get_log", filename, tail=tail, node=node, timeout=20,
+        )
+    except (ValueError, FileNotFoundError):
+        raise
+    except Exception:  # noqa: BLE001 — legacy server without the RPC
+        from ray_tpu.core.log_plane import read_local
+
+        return read_local(_logs_dir(), filename, tail)
+
+
+def search_logs(pattern: Optional[str] = None, *,
+                severity: Optional[str] = None,
+                task: Optional[str] = None,
+                actor: Optional[str] = None,
+                node: Optional[str] = None,
+                since: Optional[float] = None,
+                until: Optional[float] = None,
+                limit: int = 1000) -> List[dict]:
+    """Cluster-wide structured log search (the ``ray-tpu logs --grep``
+    backend; reference: ``ray logs --actor-id/--task-id`` + the StateHead
+    logs API): regex over messages, severity floor (``"ERROR"`` etc.),
+    time range, and entity filters (task name / task-id prefix,
+    actor-id prefix), fanned out to every node's JSONL sidecars. Rows
+    carry {ts, sev, msg, node, worker, task, task_id, actor_id, file,
+    line}; raw .log files without sidecars fall back to plain grep."""
+    return _require_worker()._call(
+        "search_logs", pattern=pattern, severity=severity, task=task,
+        actor=actor, node=node, since=since, until=until, limit=limit,
+        timeout=25,
+    )
+
+
+def summarize_errors(limit: int = 50) -> dict:
+    """The cluster error index: ERROR/exception log records deduplicated
+    controller-side by bounded signature (exception type + interned top
+    user frames — the PR 10 CallsiteTable pattern) with counts,
+    first/last seen, a sample traceback, and the lifecycle entity link
+    ({total, distinct, signatures: {sig: {...}}})."""
+    return _require_worker()._call("summarize_errors", limit=limit)
+
+
+def follow_logs(callback=None, *, pattern: Optional[str] = None,
+                severity: Optional[str] = None, task: Optional[str] = None,
+                actor: Optional[str] = None, node: Optional[str] = None,
+                err: bool = False):
+    """Live-follow structured worker logs (``ray-tpu logs --follow``):
+    registers this driver connection with the controller's record tailer;
+    matching records arrive as pushed batches on the existing
+    LogTailer→driver channel. ``callback(batch: List[dict])`` consumes
+    them (default: render to stderr). Returns a ``stop()`` callable."""
+    from ray_tpu.core.log_monitor import set_follow_sink
+
+    core = _require_worker()
+    if callback is not None:
+        set_follow_sink(callback)
+    core._call("log_follow", {
+        "pattern": pattern, "severity": severity, "task": task,
+        "actor": actor, "node": node, "err": err,
+    })
+
+    def stop():
+        try:
+            core._call("log_unfollow")
+        finally:
+            set_follow_sink(None)
+
+    return stop
 
 
 # ---------------------------------------------------------------------------

@@ -176,11 +176,22 @@ def test_ope_dm_and_dr_finite():
 
 def test_gated_datasources_raise_cleanly(ray_start_regular):
     """Without the optional clients installed, reads must fail with a
-    clear ImportError naming the missing package — not a crash."""
+    clear ImportError naming the missing package — not a crash.
+
+    The bigquery leg injects a raising ``client_factory`` (the
+    documented DI hook): google-cloud-bigquery IS installed on this
+    image, and a real ``bigquery.Client`` burns ~30 s of metadata-server
+    DNS retries before failing on credentials — the gating error this
+    test asserts must not depend on network timeouts."""
     from ray_tpu import data
 
+    def gated_bigquery():
+        raise ImportError("read_bigquery requires google-cloud-bigquery")
+
     for factory, msg in [
-        (lambda: data.read_bigquery("proj", "SELECT 1"), "bigquery"),
+        (lambda: data.read_bigquery("proj", "SELECT 1",
+                                    _client_factory=gated_bigquery),
+         "bigquery"),
         (lambda: data.read_mongo("mongodb://x", "db", "coll"), "pymongo"),
         (lambda: data.read_iceberg("db.tbl"), "pyiceberg"),
     ]:

@@ -207,13 +207,18 @@ def test_graceful_node_drain(ray_start_cluster):
     inflight = [on_a.remote(i) for i in range(2)]
     # Tasks must actually be dispatched before the drain starts — a drain
     # rightly refuses NEW placements, so still-pending tasks would hang.
+    # Both tasks pipeline onto ONE direct-lease worker and execute
+    # serially, so "two simultaneously RUNNING" is unreachable — the old
+    # condition burned its full 30s deadline every run and the drain
+    # always started after both had finished anyway. Wait for that state
+    # (both visibly executed) explicitly instead.
     from ray_tpu.util import state as state_api
 
     deadline = time.time() + 30
     while time.time() < deadline:
-        running = [t for t in state_api.list_tasks() if t["name"] == "on_a"
-                   and t["state"] in ("DISPATCHED", "RUNNING")]
-        if len(running) >= 2:
+        done = [t for t in state_api.list_tasks() if t["name"] == "on_a"
+                and t["state"] == "FINISHED"]
+        if len(done) >= 2:
             break
         time.sleep(0.05)
     ray_tpu.drain_node(target, timeout_s=60)

@@ -508,6 +508,88 @@ def test_rtl006_negative_cleanup_and_logged(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# RTL007 print-in-package
+
+
+def test_rtl007_positive(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        def report(state):
+            print("cluster up at", state["address"])
+            print(f"session: {state['session_dir']}")
+        """,
+        rules=["RTL007"],
+    )
+    assert rules_of(res) == ["RTL007", "RTL007"]
+
+
+def test_rtl007_negative_logger_methods_and_exempt_dirs(tmp_path):
+    # logger calls and method-attribute .print() are not bare prints
+    res = lint_src(
+        tmp_path,
+        """
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def report(state, console):
+            logger.info("cluster up at %s", state["address"])
+            console.print("rich-style renderers are attribute calls")
+        """,
+        rules=["RTL007"],
+    )
+    assert res.findings == []
+    # CLI (scripts/) and lint-tool (tools/) modules are exempt
+    (tmp_path / "scripts").mkdir()
+    (tmp_path / "scripts" / "__init__.py").write_text("")
+    (tmp_path / "scripts" / "cli.py").write_text(
+        'def main():\n    print("user-facing CLI output is fine")\n'
+    )
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "tools" / "render.py").write_text(
+        'def render(f):\n    print(f.render())\n'
+    )
+    res = lint_src(
+        tmp_path,
+        """
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def quiet():
+            logger.debug("nothing to see")
+        """,
+        rules=["RTL007"],
+    )
+    assert res.findings == []
+
+
+def test_rtl007_suppressed(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        def attach(state):
+            print(f"export ADDR={state['address']}")  # ray-tpu: lint-ignore[RTL007] — shell-evaluable stdout
+        """,
+        rules=["RTL007"],
+    )
+    assert res.findings == []
+    assert res.suppressed == 1
+
+
+def test_rtl007_baselined(tmp_path):
+    src = """
+    def legacy():
+        print("grandfathered output")
+    """
+    first = lint_src(tmp_path, src, rules=["RTL007"])
+    assert rules_of(first) == ["RTL007"]
+    entries = [baseline_entry(f, "grandfathered CLI-era output")
+               for f in first.findings]
+    res = lint_src(tmp_path, src, rules=["RTL007"], baseline=entries)
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
 # framework: suppression parsing, baseline shrink contract, config
 
 

@@ -1,0 +1,398 @@
+"""Cluster log plane (ISSUE 11, core/log_plane.py): structured,
+task/actor-attributed logs with cluster-wide search, error-signature
+aggregation, bounded rotation, follow-mode delivery, the /api/v0/logs
+gateway routes, and the CLI offline smoke. All tier-1 (CPU)."""
+import glob
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import log_plane
+from ray_tpu.util import state as state_api
+
+
+def _wait_until(pred, timeout=15.0, interval=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# Attributed round-trip on a 2-node cluster
+# ---------------------------------------------------------------------------
+def test_log_roundtrip_two_nodes(ray_start_cluster):
+    """Acceptance: a chatty actor's print/log lines come back from
+    cluster-wide search attributed to the right task/actor/node/worker
+    with severities; grep + severity + entity filters each restrict the
+    result to exactly their slice; /api/v0/logs* serves the same data."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+
+    @ray_tpu.remote
+    class Chatty:
+        def speak(self, i):
+            import logging
+
+            print(f"LOGPLANE-SPOKEN {i}")
+            logging.getLogger("app").warning("LOGPLANE-WARNED %d", i)
+            return i
+
+        def follow_me(self, i):
+            print(f"FOLLOW-ME line {i}")
+            return i
+
+    @ray_tpu.remote
+    def other():
+        print("LOGPLANE-OTHER-TASK line")
+        return 1
+
+    a = Chatty.remote()
+    ray_tpu.wait_actor_ready(a)
+    assert ray_tpu.get([a.speak.remote(i) for i in range(3)]) == [0, 1, 2]
+    assert ray_tpu.get(other.remote()) == 1
+
+    def spoken():
+        return state_api.search_logs("LOGPLANE-SPOKEN", task="actor.speak")
+
+    assert _wait_until(lambda: len(spoken()) >= 3), spoken()
+    rows = spoken()
+    assert all(r["task"] == "actor.speak" for r in rows)
+    assert all(r["sev"] == "STDOUT" for r in rows)
+    assert all(r["worker"] and r["node"] for r in rows)
+    assert all(r["actor_id"] == a._actor_id.hex() for r in rows)
+    # grep restricts to matching lines only — the actor's WARNING lines
+    # and the other task's output never leak in
+    assert not any("LOGPLANE-OTHER" in r["msg"] for r in rows)
+
+    # severity floor: WARNING+ from this actor is exactly the log lines,
+    # carried with their logger level (the handler leg, not the stream)
+    assert _wait_until(lambda: len(state_api.search_logs(
+        "LOGPLANE-WARNED", severity="WARNING", task="actor.speak")) >= 3)
+    warns = state_api.search_logs(
+        "LOGPLANE-WARNED", severity="WARNING", task="actor.speak"
+    )
+    assert all(r["sev"] == "WARNING" and r.get("logger") == "app"
+               for r in warns)
+    # entity filter by actor id prefix finds the same records
+    by_actor = state_api.search_logs(
+        "LOGPLANE-", actor=a._actor_id.hex()[:12]
+    )
+    assert len(by_actor) >= 6
+    assert all(r["actor_id"] == a._actor_id.hex() for r in by_actor)
+    # the other task's line is attributed to ITS name
+    assert _wait_until(
+        lambda: state_api.search_logs("LOGPLANE-OTHER", task="other")
+    )
+
+    # listing: both raw logs and sidecars, sidecar-backed files flagged
+    files = state_api.list_log_files()
+    by_name = {f["filename"]: f for f in files}
+    assert any(n.startswith("worker-") and n.endswith(".jsonl")
+               for n in by_name)
+    raw = [f for n, f in by_name.items()
+           if n.startswith("worker-") and n.endswith(".log")]
+    assert raw and any(f["structured"] for f in raw)
+    assert any(f.get("node") for f in raw)
+    # plain names view + single-file fetch stay compatible
+    assert any("controller" in n for n in state_api.list_logs())
+    assert isinstance(state_api.get_log("controller.log"), str)
+    with pytest.raises(ValueError):
+        state_api.get_log("../../etc/passwd")
+
+    # HTTP gateway: list, search, and file fetch
+    url = state_api.dashboard_url()
+    if url:
+        from urllib.parse import quote
+        from urllib.request import urlopen
+
+        listing = json.load(urlopen(f"{url}/api/v0/logs", timeout=30))
+        assert any(r["filename"].endswith(".jsonl") for r in listing)
+        hits = json.load(urlopen(
+            f"{url}/api/v0/logs/search?pattern=LOGPLANE-SPOKEN"
+            f"&task={quote('actor.speak')}", timeout=30,
+        ))
+        assert len(hits) >= 3 and all(h["worker"] for h in hits)
+        got = json.load(urlopen(
+            f"{url}/api/v0/logs/file?name=controller.log&tail=50", timeout=30,
+        ))
+        assert got["filename"] == "controller.log"
+
+    # follow-mode delivery on the same cluster: matching records stream
+    # to the registered sink over the LogTailer→driver channel, honoring
+    # the follow filters (speak()'s non-matching lines never arrive)
+    received = []
+    stop = state_api.follow_logs(received.extend, pattern="FOLLOW-ME")
+    try:
+
+        def delivered():
+            ray_tpu.get(a.speak.remote(100))
+            ray_tpu.get([a.follow_me.remote(i) for i in range(2)])
+            return len(received) >= 2
+
+        assert _wait_until(delivered, timeout=20)
+        assert all("FOLLOW-ME" in r["msg"] for r in received)
+        assert all(r["task"] == "actor.follow_me" for r in received)
+        assert all(r["worker"] for r in received)
+        assert not any("LOGPLANE-SPOKEN" in r["msg"] for r in received)
+    finally:
+        stop()
+
+
+# ---------------------------------------------------------------------------
+# Error-signature aggregation + spike incident
+# ---------------------------------------------------------------------------
+def test_error_signature_dedup_and_spike_incident():
+    """A repeatedly-raising task collapses into ONE signature with an
+    accurate count and a sample traceback linked to the task entity, and
+    the error-rate spike fires the PR 9 incident machinery with the log
+    tail attached."""
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "node_telemetry_interval_ms": 200,
+            "log_error_spike_threshold": 3,
+        },
+    )
+    try:
+
+        @ray_tpu.remote(max_retries=0)
+        def kaboom(i):
+            raise ValueError(f"intentional failure {i}")
+
+        for i in range(6):
+            with pytest.raises(Exception):
+                ray_tpu.get(kaboom.remote(i))
+
+        def one_sig():
+            errs = state_api.summarize_errors()
+            sigs = [s for s in errs["signatures"] if "kaboom" in s]
+            return sigs and errs["signatures"][sigs[0]]["count"] >= 6
+
+        assert _wait_until(one_sig), state_api.summarize_errors()
+        errs = state_api.summarize_errors()
+        sig = next(s for s in errs["signatures"] if "kaboom" in s)
+        row = errs["signatures"][sig]
+        # six distinct messages, ONE signature (type + user frames —
+        # message digits don't fan it out)
+        assert sig.startswith("ValueError@")
+        assert row["count"] >= 6
+        assert "ValueError" in row["sample"]
+        assert "Traceback" in row["sample"]
+        assert row["entity"]["task"] == "kaboom"
+        assert row["entity"]["worker"]
+        assert row["first_seen"] <= row["last_seen"]
+
+        # 6 errors in <1 sweep >= threshold 3 → error_spike incident with
+        # the offending log tail attached (incident(extra_files=...))
+        assert _wait_until(
+            lambda: any(r.get("trigger") == "error_spike"
+                        for r in state_api.list_incidents())
+        ), state_api.list_incidents()
+        inc = next(r for r in state_api.list_incidents()
+                   if r.get("trigger") == "error_spike")
+        assert "log_tail.txt" in inc["files"]
+        bundle = state_api.get_incident(inc["id"])
+        assert "kaboom" in bundle["contents"]["log_tail.txt"]
+
+        # searchable too: --err view returns the failure records
+        errs_rows = state_api.search_logs(severity="ERROR", task="kaboom")
+        assert errs_rows and all(r["exc"] == "ValueError" for r in errs_rows)
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Rotation invariants
+# ---------------------------------------------------------------------------
+def test_worker_log_rotation_bounded():
+    """Sustained output provably keeps worker log files under the
+    rotation cap (~2x with the single .1 half): both the raw redirected
+    stdout (copy-truncate) and the structured sidecar (rename)."""
+    cap = 64 * 1024
+    ray_tpu.init(num_cpus=2, _system_config={"log_rotate_bytes": cap})
+    try:
+        session_dir = ray_tpu.core.api._require_worker().session_dir
+
+        @ray_tpu.remote
+        def firehose(n):
+            for i in range(n):
+                print(f"firehose line {i} " + "x" * 120)
+            return n
+
+        # ~3x the cap through one worker, in waves so the 0.25s
+        # maintenance sweeps get to rotate between bursts
+        for _ in range(3):
+            assert ray_tpu.get(firehose.remote(500), timeout=60) == 500
+            time.sleep(0.45)
+        time.sleep(0.6)
+        checked = 0
+        for path in glob.glob(os.path.join(session_dir, "logs", "worker-*")):
+            if path.endswith(".1"):
+                continue
+            size = os.path.getsize(path)
+            assert size <= 2 * cap + 16 * 1024, (path, size)
+            checked += 1
+        assert checked >= 2  # at least one .log + one .jsonl live file
+        # rotated halves exist and are themselves bounded
+        halves = glob.glob(os.path.join(session_dir, "logs", "worker-*.1"))
+        assert halves
+        for path in halves:
+            assert os.path.getsize(path) <= 2 * cap + 16 * 1024
+        # and the lines survive rotation into search (sidecar halves are
+        # searched too)
+        assert state_api.search_logs("firehose line", limit=10)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_log_tailer_survives_rotation(tmp_path):
+    """Unit: an offset past the new file size drains the unread suffix
+    of the .1 half then resets — neither duplicated nor dropped lines,
+    for both copy-truncate (raw) and rename (sidecar) rotation."""
+    from ray_tpu.core.log_monitor import LogTailer
+
+    got = []
+    tailer = LogTailer(str(tmp_path), publish=lambda b: None)
+    path = tmp_path / "worker-rot.log"
+
+    def emit():
+        got.extend(l for _, l in tailer.poll_once())
+
+    path.write_text("".join(f"a{i}\n" for i in range(10)))
+    emit()
+    # lines a10..a14 appended but NOT polled before rotation
+    with open(path, "a") as f:
+        f.write("".join(f"a{i}\n" for i in range(10, 15)))
+    # copy-truncate: .1 = full old content, live file truncates + regrows
+    os.replace(path, str(path) + ".1")  # copy step (same bytes)
+    import shutil
+
+    shutil.copyfile(str(path) + ".1", path)  # restore, then truncate
+    with open(path, "r+b") as f:
+        f.truncate(0)
+    with open(path, "a") as f:
+        f.write("b0\nb1\n")
+    emit()
+    assert got == [f"a{i}" for i in range(15)] + ["b0", "b1"], got
+
+    # rename rotation (the sidecar writer's move): old file BECOMES .1
+    with open(path, "a") as f:
+        f.write("b2\nb3-unread\n")
+    emit()
+    assert got[-2] == "b2"
+    with open(path, "a") as f:
+        f.write("b4-unread\n")
+    os.replace(path, str(path) + ".1")
+    with open(path, "w") as f:
+        f.write("c0\n")
+    emit()
+    assert got[-2:] == ["b4-unread", "c0"], got
+    # a double rotation that destroys the unread span resyncs (no dup)
+    with open(path, "a") as f:
+        f.write("c1\n" * 50)
+    emit()
+    with open(path, "w") as f:
+        f.write("")
+    os.replace(path, str(path) + ".1")  # .1 now SHORTER than the offset
+    with open(path, "w") as f:
+        f.write("d0\n")
+    emit()
+    assert got[-1] == "d0" and got.count("d0") == 1
+
+
+def test_structured_writer_rotates_by_rename(tmp_path):
+    w = log_plane.StructuredLogWriter(str(tmp_path / "x.jsonl"),
+                                      rotate_bytes=64 * 1024)
+    for i in range(3000):
+        w.emit({"ts": i, "msg": "y" * 64})
+    w.close()
+    live = os.path.getsize(tmp_path / "x.jsonl")
+    half = os.path.getsize(tmp_path / "x.jsonl.1")
+    assert live <= 64 * 1024 and half <= 64 * 1024
+    # every line in both halves parses
+    for name in ("x.jsonl.1", "x.jsonl"):
+        with open(tmp_path / name) as f:
+            for line in f:
+                json.loads(line)
+
+
+# ---------------------------------------------------------------------------
+# Units: filters, signatures, index bounds
+# ---------------------------------------------------------------------------
+def test_match_record_filters():
+    rec = {"ts": 100.0, "sev": "WARNING", "msg": "shard 7 is late",
+           "node": "aabbccddee00", "worker": "aaaa0000",
+           "task": "Loader.fetch", "task_id": "11" * 16,
+           "actor_id": "33" * 16}
+    m = log_plane.match_record
+    assert m(rec)
+    assert m(rec, pattern="shard \\d")
+    assert not m(rec, pattern="no-such")
+    assert m(rec, severity="INFO") and not m(rec, severity="ERROR")
+    assert m(rec, task="Loader") and m(rec, task="11" * 8)
+    assert not m(rec, task="Other")
+    assert m(rec, actor="33" * 4) and not m(rec, actor="ff")
+    assert m(rec, node="aabbcc") and not m(rec, node="ffee")
+    assert m(rec, since=50.0, until=150.0) and not m(rec, since=150.0)
+
+
+def test_error_signature_and_index_bounds():
+    tb = ('task f failed: Traceback (most recent call last):\n'
+          '  File "/app/pipeline.py", line 40, in run\n    step()\n'
+          '  File "/srv/ray_tpu/core/worker_main.py", line 1, in _run\n'
+          '    x\n'
+          '  File "/app/steps.py", line 12, in step\n'
+          '    raise ValueError(f"bad {i}")\nValueError: bad 7\n')
+    r1 = {"msg": tb, "exc": "ValueError"}
+    r2 = {"msg": tb.replace("bad 7", "bad 12345"), "exc": "ValueError"}
+    s1, s2 = log_plane.error_signature(r1), log_plane.error_signature(r2)
+    assert s1 == s2  # message digits don't split signatures
+    assert s1.startswith("ValueError@")
+    assert "pipeline.py:run" in s1 and "steps.py:step" in s1
+    assert "worker_main" not in s1  # package frames filtered out
+    # no-traceback records group by digit-normalized message head
+    a = log_plane.error_signature({"msg": "replica 3 died", "exc": ""})
+    b = log_plane.error_signature({"msg": "replica 99 died", "exc": ""})
+    assert a == b
+
+    idx = log_plane.ErrorIndex(cap=8)
+    for i in range(50):
+        idx.ingest({"msg": f"error kind {i} at site_{i}()", "exc": f"E{i}",
+                    "ts": float(i)})
+    out = idx.summarize(limit=100)
+    assert out["total"] == 50
+    # bounded: past the intern cap everything collapses into "(other)"
+    assert out["distinct"] <= 9 and "(other)" in out["signatures"]
+    assert len(idx.recent_tail(10)) == 10
+
+
+# ---------------------------------------------------------------------------
+# CLI offline smoke
+# ---------------------------------------------------------------------------
+def test_cli_logs_offline_smoke(capsys):
+    from ray_tpu.scripts.cli import main
+
+    assert main(["logs", "--offline"]) == 0
+    out = capsys.readouterr().out
+    assert "train_loop" in out          # task attribution rendered
+    assert "ERROR" in out               # severity column rendered
+    assert "controller.log" in out      # raw-grep fallback row rendered
+
+    assert main(["logs", "--offline", "--err"]) == 0
+    out = capsys.readouterr().out
+    assert "Loader.fetch" in out and "train_loop" not in out
+
+    assert main(["logs", "--offline", "--grep", "checkpoint"]) == 0
+    out = capsys.readouterr().out
+    assert "checkpoint saved" in out and "loss" not in out
+
+    assert main(["logs", "--offline", "--task", "train_loop"]) == 0
+    out = capsys.readouterr().out
+    assert "train_loop" in out and "Loader.fetch" not in out
