@@ -381,6 +381,236 @@ def bench_log_churn(n_tasks: int, lines: int, work: int = 20000,
     return row
 
 
+def bench_train_chaos(scenario: str, steps: int = 12) -> dict:
+    """Elastic-gang MTTR arm: a 2-worker gang across per-worker nodes,
+    one train host SIGKILLed mid-run (after checkpoint 1 commits, so the
+    kill provably lands between steps). Reports the recovery machinery's
+    own detect/repair/resume breakdown plus steps lost to the kill.
+
+    ``scenario``: "rejoin" (a spare node is available — replacement
+    worker, same world size) or "remesh" (no spare, min_workers=1 —
+    shrink to the survivor). Runs under its own multi-node cluster; call
+    after the shared-init rows have shut down."""
+    import os
+    import shutil
+    import signal
+    import tempfile
+    import threading
+
+    from ray_tpu.core.cluster_utils import Cluster
+    from ray_tpu.train import (
+        FailureConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    spare = 1 if scenario == "rejoin" else 0
+    cluster = Cluster(head_resources={"CPU": 1})
+    storage = tempfile.mkdtemp(prefix=f"chaos_{scenario}_")
+    try:
+        for _ in range(2 + spare):
+            cluster.add_node(num_cpus=2)
+        cluster.connect()
+
+        def loop(config):
+            import os as _os
+            import tempfile as _tf
+            import time as _t
+
+            import numpy as _np
+
+            from ray_tpu import train
+
+            ctx = train.get_context()
+            start = 0
+            ckpt = train.get_checkpoint()
+            if ckpt is not None:
+                with ckpt.as_directory() as d:
+                    start = int(_np.load(_os.path.join(d, "step.npy"))) + 1
+            for step in range(start, config["steps"]):
+                _t.sleep(0.25)
+                with _tf.TemporaryDirectory() as d:
+                    if ctx.get_world_rank() == 0:
+                        _np.save(_os.path.join(d, "step.npy"),
+                                 _np.int64(step))
+                    train.report(
+                        {"step": step, "ws": ctx.get_world_size(),
+                         "resumed_from": start},
+                        checkpoint=train.Checkpoint.from_directory(d),
+                    )
+
+        scaling_kw = {"min_workers": 1} if scenario == "remesh" else {}
+        trainer = JaxTrainer(
+            loop,
+            train_loop_config={"steps": steps},
+            scaling_config=ScalingConfig(
+                num_workers=2, resources_per_worker={"CPU": 2},
+                **scaling_kw,
+            ),
+            run_config=RunConfig(
+                name=scenario, storage_path=storage,
+                failure_config=FailureConfig(
+                    max_failures=2,
+                    elastic_grace_s=15.0 if spare else 2.0,
+                ),
+            ),
+        )
+        run_dir = os.path.join(storage, scenario)
+
+        def chaos():
+            from ray_tpu.util import state as state_api
+
+            marker = os.path.join(run_dir, "checkpoint_000001", ".complete")
+            deadline = time.time() + 120
+            while time.time() < deadline and not os.path.exists(marker):
+                time.sleep(0.05)
+            hosts = {
+                w["node_id"] for w in state_api.list_workers()
+                if w.get("state") == "ACTOR"
+            }
+            for h in cluster._nodes:
+                if h.node_id_hex in hosts:
+                    h.proc.send_signal(signal.SIGKILL)
+                    return
+
+        killer = threading.Thread(target=chaos, daemon=True)
+        killer.start()
+        t0 = time.perf_counter()
+        result = trainer.fit()
+        wall = time.perf_counter() - t0
+        killer.join(timeout=10)
+        assert result.error is None, result.error
+        assert result.recoveries, "kill never triggered a recovery"
+        rec = result.recoveries[0]
+        # steps_lost = work the dead incarnation reported that the
+        # resumed one re-ran: its furthest step vs the resume point.
+        resumed_from = result.metrics.get("resumed_from", 0)
+        prev = [
+            m["step"] for m in result.metrics_history
+            if m.get("resumed_from", 0) < resumed_from
+        ]
+        steps_lost = max(prev, default=resumed_from - 1) - resumed_from + 1
+        mttr = sum(
+            rec.get(k) or 0.0
+            for k in ("detect_ms", "repair_ms", "resume_ms")
+        )
+        row = {
+            "benchmark": f"train_chaos_{scenario}",
+            "steps": steps,
+            "mode": rec.get("mode"),
+            "detect_ms": rec.get("detect_ms"),
+            "repair_ms": rec.get("repair_ms"),
+            "resume_ms": rec.get("resume_ms"),
+            "mttr_ms": round(mttr, 1),
+            "steps_lost": max(0, steps_lost),
+            "world_size_after": result.metrics.get("ws"),
+            "final_step": result.metrics.get("step"),
+            "wall_s": round(wall, 1),
+        }
+        row.update(lifecycle_phases())
+        return row
+    finally:
+        cluster.shutdown()
+        shutil.rmtree(storage, ignore_errors=True)
+
+
+def bench_checkpoint_ab(payload_mb: int = 32, steps: int = 3,
+                        store_mbps: float = 16.0) -> dict:
+    """Non-blocking checkpoint A/B: the same single-worker loop
+    checkpointing a ``payload_mb`` state, sync vs async upload, with the
+    persistent store throttled to ``store_mbps`` MB/s via the cloudfs
+    seam (models remote-storage bandwidth; the async arm's host-side
+    staging snapshot stays at disk speed). The step-time stall is the
+    in-loop wall of ``train.report`` — budget: async stall <= 10% of the
+    synchronous checkpoint cost."""
+    import shutil
+    import statistics as stats
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu.train import (
+        CheckpointConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    storage = tempfile.mkdtemp(prefix="ckpt_ab_")
+    env = {
+        "RAY_TPU_CLOUDFS_THROTTLE_PATH": storage,
+        "RAY_TPU_CLOUDFS_THROTTLE_MBPS": str(store_mbps),
+    }
+
+    def loop(config):
+        import os as _os
+        import tempfile as _tf
+        import time as _t
+
+        import numpy as _np
+
+        from ray_tpu import train
+
+        arr = _np.zeros(config["payload_mb"] * 262144, _np.float32)
+        prev_ms = 0.0
+        for step in range(config["steps"]):
+            with _tf.TemporaryDirectory() as d:
+                _np.save(_os.path.join(d, "w.npy"), arr)
+                t0 = _t.monotonic()
+                train.report(
+                    {"step": step, "prev_report_ms": prev_ms},
+                    checkpoint=train.Checkpoint.from_directory(d),
+                )
+                prev_ms = (_t.monotonic() - t0) * 1000.0
+
+    ray_tpu.init(num_cpus=4)
+    arms = {}
+    try:
+        for arm, async_upload in (("sync", False), ("async", True)):
+            trainer = JaxTrainer(
+                loop,
+                train_loop_config={"payload_mb": payload_mb,
+                                   "steps": steps},
+                scaling_config=ScalingConfig(
+                    num_workers=1, resources_per_worker={"CPU": 1},
+                    worker_env=env,
+                ),
+                run_config=RunConfig(
+                    name=f"ckpt_{arm}", storage_path=storage,
+                    checkpoint_config=CheckpointConfig(
+                        async_upload=async_upload
+                    ),
+                ),
+            )
+            t0 = time.perf_counter()
+            result = trainer.fit()
+            wall = time.perf_counter() - t0
+            assert result.error is None, result.error
+            stalls = [
+                m["prev_report_ms"] for m in result.metrics_history
+                if m["step"] >= 1
+            ]
+            arms[arm] = {"stall_ms": stats.mean(stalls), "wall_s": wall}
+    finally:
+        ray_tpu.shutdown()
+        shutil.rmtree(storage, ignore_errors=True)
+    stall_pct = 100.0 * arms["async"]["stall_ms"] / max(
+        arms["sync"]["stall_ms"], 1e-9
+    )
+    return {
+        "benchmark": "checkpoint_async_ab",
+        "payload_mb": payload_mb,
+        "steps": steps,
+        "store_mbps": store_mbps,
+        "sync_report_stall_ms": round(arms["sync"]["stall_ms"], 1),
+        "async_report_stall_ms": round(arms["async"]["stall_ms"], 1),
+        "async_stall_pct_of_sync": round(stall_pct, 2),
+        "async_stall_ok": stall_pct <= 10.0,
+        "sync_wall_s": round(arms["sync"]["wall_s"], 1),
+        "async_wall_s": round(arms["async"]["wall_s"], 1),
+    }
+
+
 def main():
     import ray_tpu
 
@@ -411,6 +641,14 @@ def main():
         help="disable structured log capture cluster-wide (A/B runs; the "
              "log-churn row then skips its built-in stream-level A/B)",
     )
+    p.add_argument("--no-chaos", action="store_true",
+                   help="skip the train-chaos MTTR + checkpoint A/B arms")
+    p.add_argument("--chaos-steps", type=int, default=12,
+                   help="chaos arms: train steps per scenario")
+    p.add_argument("--ckpt-mb", type=int, default=32,
+                   help="checkpoint A/B: checkpoint payload size (MB)")
+    p.add_argument("--ckpt-store-mbps", type=float, default=16.0,
+                   help="checkpoint A/B: simulated store bandwidth (MB/s)")
     p.add_argument("--out", default="")
     args = p.parse_args()
 
@@ -445,6 +683,19 @@ def main():
             print(json.dumps(row), flush=True)
     finally:
         ray_tpu.shutdown()
+    if not args.no_chaos:
+        # Chaos arms manage their own cluster lifecycles (the MTTR arms
+        # need per-worker HOST processes to kill) — run them after the
+        # shared-init rows shut down.
+        for scenario in ("rejoin", "remesh"):
+            row = bench_train_chaos(scenario, steps=args.chaos_steps)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+        row = bench_checkpoint_ab(
+            args.ckpt_mb, store_mbps=args.ckpt_store_mbps
+        )
+        rows.append(row)
+        print(json.dumps(row), flush=True)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(rows, f, indent=1)

@@ -6,6 +6,7 @@ axis handout for lax.p* inside pjit programs (xla_group.py).
 """
 from ray_tpu.collective.collective import (
     GroupManager,
+    abort_collective_group,
     allgather,
     allreduce,
     allreduce_multigpu,
@@ -33,6 +34,7 @@ __all__ = [
     "create_collective_group",
     "declare_collective_group",
     "destroy_collective_group",
+    "abort_collective_group",
     "is_group_initialized",
     "get_rank",
     "get_world_size",

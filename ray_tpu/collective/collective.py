@@ -97,6 +97,14 @@ class GroupManager:
         if group is not None:
             group.destroy()
 
+    def abort_group(self, group_name: str) -> bool:
+        with self._lock:
+            group = self._groups.get(group_name)
+        if group is None:
+            return False
+        group.abort()
+        return True
+
 
 _group_mgr = GroupManager()
 
@@ -150,6 +158,14 @@ declare_collective_group = create_collective_group
 
 def destroy_collective_group(group_name: str = "default"):
     _group_mgr.destroy_group(group_name)
+
+
+def abort_collective_group(group_name: str = "default") -> bool:
+    """Fail-fast every blocked collective op in this process's membership
+    of ``group_name`` (each raises ConnectionError). Used by gang repair
+    to break surviving ranks out of a barrier a dead peer will never
+    complete; the group remains to be destroyed normally."""
+    return _group_mgr.abort_group(group_name)
 
 
 def is_group_initialized(group_name: str = "default") -> bool:

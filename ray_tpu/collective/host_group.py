@@ -221,6 +221,18 @@ class HostGroup:
         t.start()
         return t
 
+    @staticmethod
+    def _join_sender(sender: threading.Thread, timeout: float = 60.0):
+        """Bounded ring-step join: the peer pulling our chunk may be dead
+        or partitioned away — the collective must fail loudly (and let the
+        gang's failure detector take over) rather than hang this rank."""
+        sender.join(timeout=timeout)
+        if sender.is_alive():
+            raise TimeoutError(
+                f"collective send did not complete within {timeout}s "
+                "(peer dead or partitioned?)"
+            )
+
     # -- collectives -----------------------------------------------------
     def barrier(self, tag: int = 0):
         from ray_tpu.collective import diagnostics
@@ -258,14 +270,14 @@ class HostGroup:
             sender = self._send_async(chunks[send_idx], nxt, tag + step)
             incoming = self.recv(prv, tag + step)
             reduce_fn(chunks[recv_idx], incoming)
-            sender.join()
+            self._join_sender(sender)
         # all-gather the reduced chunks.
         for step in range(ws - 1):
             send_idx = (rank - step + 1) % ws
             recv_idx = (rank - step) % ws
             sender = self._send_async(chunks[send_idx], nxt, tag + 1000 + step)
             chunks[recv_idx] = self.recv(prv, tag + 1000 + step)
-            sender.join()
+            self._join_sender(sender)
         return chunks.reshape(-1)[:n].reshape(shape)
 
     def reducescatter(
@@ -297,7 +309,7 @@ class HostGroup:
             recv_idx = (rank - step - 2) % ws
             sender = self._send_async(parts[send_idx], nxt, tag + step)
             reduce_fn(parts[recv_idx], self.recv(prv, tag + step))
-            sender.join()
+            self._join_sender(sender)
         return parts[rank]
 
     def allgather(self, arr: np.ndarray, tag: int = 0) -> List[np.ndarray]:
@@ -320,7 +332,7 @@ class HostGroup:
             recv_idx = (rank - step - 1) % ws
             sender = self._send_async(out[send_idx], nxt, tag + step)
             out[recv_idx] = self.recv(prv, tag + step)
-            sender.join()
+            self._join_sender(sender)
         return out  # type: ignore[return-value]
 
     def broadcast(self, arr: np.ndarray, src: int, tag: int = 0) -> np.ndarray:
@@ -354,6 +366,16 @@ class HostGroup:
         ):
             out = self._allreduce(arr, op, tag=tag)
         return out if self.rank == dst else arr
+
+    def abort(self):
+        """Unblock every thread parked in this group's recv/collective
+        with ConnectionError, WITHOUT closing sockets — the poison pill
+        the elastic-train repair path uses to break survivors out of a
+        barrier whose peer died on a non-adjacent ring position (only
+        ring neighbors observe the socket death directly). The group
+        stays destroyable afterwards."""
+        for q in self._inbox.values():
+            q.put((None, None))
 
     def destroy(self):
         self._closed = True

@@ -78,6 +78,18 @@ class Config:
     health_check_failure_threshold: int = 5
     task_retry_delay_s: float = 0.05
     actor_restart_delay_s: float = 0.1
+    # Default bound for control-plane RPCs issued without an explicit
+    # timeout (register/kv/pg-admin/lease bookkeeping/...). Methods that
+    # block by DESIGN (object get/wait, streams, pg readiness, drains)
+    # are exempt — see client._UNBOUNDED_METHODS. A wedged controller
+    # then surfaces as a timeout error instead of a process hung forever.
+    control_call_timeout_s: float = 300.0
+    # Controller-connection loss: workers, agents, and drivers attempt to
+    # reconnect + re-register with jittered backoff for this long before
+    # treating the controller as gone (worker/agent exit; driver raises).
+    # Rides through a controller restart on the same address when the
+    # persistence journal is intact. 0 = legacy exit-on-first-disconnect.
+    controller_reconnect_window_s: float = 10.0
     # fsync the GCS journal on every append (reference analogue: Redis
     # persistence guarantees for GCS FT). Off by default: a torn tail is
     # detected and dropped on replay, and the journal is for whole-process

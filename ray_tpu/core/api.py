@@ -66,9 +66,10 @@ def init(
 ) -> dict:
     """Start (or connect to) a cluster and connect this process as a driver."""
     global _global_worker, _controller_proc, _session_dir
-    from ray_tpu.util import lockwatch
+    from ray_tpu.util import chaos, lockwatch
 
     lockwatch.maybe_install()  # RAY_TPU_LOCKWATCH=1: driver-side watchdog
+    chaos.install_fault_plan_from_env()  # RAY_TPU_FAULT_PLAN: deterministic chaos
     if _global_worker is not None:
         if ignore_reinit_error:
             return {"address": _global_worker.address}
@@ -186,6 +187,9 @@ def shutdown():
     try:
         if _controller_proc is not None:
             try:
+                # Deliberate teardown: the controller dies on receipt, so
+                # never ride the reconnect window on its way down.
+                _global_worker._reconnect_dead = True
                 _global_worker._call("shutdown_cluster", timeout=5)
             except Exception:
                 pass

@@ -26,6 +26,26 @@ from ray_tpu.utils.serialization import deserialize, serialize
 
 INLINE_LIMIT_FALLBACK = 100 * 1024
 
+# Control-plane methods that block by DESIGN (waiting for objects,
+# streams, placement, drains — their duration is the workload's, not the
+# control plane's). Everything else gets the bounded default timeout
+# (``control_call_timeout_s``) when the caller passes none, so a wedged
+# or partitioned controller surfaces as an error instead of a hang.
+_UNBOUNDED_METHODS = frozenset(
+    {
+        "object_get",
+        "object_wait",
+        "object_pull",
+        "object_ensure_local",
+        "object_broadcast",
+        "stream_next",
+        "pg_wait_ready",
+        "wait_actor_ready",
+        "drain_node",
+        "task_done",  # carries result upload; sized by payload, not control
+    }
+)
+
 
 class RefTracker:
     """Per-process local ref table (reference: ReferenceCounter's local
@@ -161,8 +181,18 @@ class CoreWorker:
         self._put_counter = itertools.count()
         self._task_counter = itertools.count()
         self._lock = threading.Lock()
+        self._handler = handler or _NullHandler()
+        self._listen_addr = listen_addr
+        self._reconnect_lock = threading.Lock()
+        self._reconnect_cbs: list = []  # called with the fresh peer
+        # Once a full reconnect window fails (controller truly gone) or
+        # this process initiated the disconnect, later ConnectionLost
+        # errors fail fast instead of burning another window each.
+        self._reconnect_dead = False
+        self._control_timeout: Optional[float] = 300.0  # pre-config fallback
         host, port = address.rsplit(":", 1)
-        self.peer: rpc.Peer = loop_runner.run(rpc.connect(host, int(port), handler or _NullHandler()))
+        self.peer: rpc.Peer = loop_runner.run(rpc.connect(host, int(port), self._handler))
+        self.peer.label = "controller"
         if mode == "driver":
             info = self._call("register_driver")
             self.node_id = NodeID.from_hex(info["head_node_id"])
@@ -180,6 +210,9 @@ class CoreWorker:
         self.session_dir = info["session_dir"]
         self.config = info["config"]
         self.inline_limit = self.config.get("max_inline_object_size", INLINE_LIMIT_FALLBACK)
+        self._control_timeout = (
+            float(self.config.get("control_call_timeout_s", 300.0)) or None
+        )
         self.plasma = PlasmaClient(self.local_shm_dir)
         self._plasma_clients: dict[str, PlasmaClient] = {}
         # Owner-local memory store + direct actor transport (reference:
@@ -240,7 +273,134 @@ class CoreWorker:
 
     # ------------------------------------------------------------------
     def _call(self, method: str, *args, timeout: Optional[float] = None, **kwargs):
-        return self.loop_runner.run(self.peer.call(method, *args, **kwargs), timeout)
+        """Sync controller RPC. Callers that pass no timeout get the
+        bounded ``control_call_timeout_s`` default unless the method
+        blocks by design (:data:`_UNBOUNDED_METHODS`). A connection loss
+        triggers ONE bounded reconnect + re-register attempt (rides
+        through a controller restart) before the error surfaces.
+
+        The post-reconnect retry makes control calls AT-LEAST-ONCE: a
+        request the controller executed whose response died with the
+        connection is re-issued. Controller-restart rides are safe (the
+        journal replay is the state), but a transient drop to a LIVE
+        controller can duplicate a non-idempotent call — exactly-once
+        needs per-request ids + controller-side dedup (roadmap)."""
+        if timeout is None and method not in _UNBOUNDED_METHODS:
+            timeout = self._control_timeout
+        try:
+            return self.loop_runner.run(self.peer.call(method, *args, **kwargs), timeout)
+        except rpc.ConnectionLost:
+            if not self.try_reconnect():
+                raise
+            return self.loop_runner.run(self.peer.call(method, *args, **kwargs), timeout)
+
+    def on_reconnect(self, cb):
+        """Register a callback invoked (from the reconnecting thread)
+        with the fresh controller peer after a successful re-register."""
+        self._reconnect_cbs.append(cb)
+
+    def try_reconnect(self) -> bool:
+        """Bounded reconnect + re-register after controller connection
+        loss (jittered backoff within ``controller_reconnect_window_s``).
+        Safe from any thread; concurrent callers coalesce on the lock.
+        Returns True when ``self.peer`` is live again."""
+        import random as _random
+        import time as _time
+
+        window = 0.0
+        if isinstance(getattr(self, "config", None), dict):
+            window = float(self.config.get("controller_reconnect_window_s", 0.0))
+        if window <= 0 or self._reconnect_dead:
+            return False
+        resumed_peer = None
+        with self._reconnect_lock:
+            if not self.peer.closed:
+                return True  # someone else already reconnected
+            host, port = self.address.rsplit(":", 1)
+            deadline = _time.monotonic() + window
+            wait = 0.1
+            last: Optional[BaseException] = None
+            # Holding _reconnect_lock across the bounded dial/register
+            # is the design: concurrent callers MUST coalesce on one
+            # reconnect attempt  # ray-tpu: lint-ignore-file[RTL001]
+            while _time.monotonic() < deadline:
+                try:
+                    peer = self.loop_runner.run(
+                        rpc.connect(host, int(port), self._handler, retries=1),
+                        timeout=10,
+                    )
+                    peer.label = "controller"
+                    if self.mode == "driver":
+                        self.loop_runner.run(peer.call("register_driver"), 10)
+                    else:
+                        self.loop_runner.run(
+                            peer.call(
+                                "register_worker", self.worker_id, self.node_id,
+                                os.getpid(), listen_addr=self._listen_addr,
+                                # Never re-advertise into a worker pool and
+                                # mark busy: the restarted controller must
+                                # not dispatch onto a possibly-mid-actor
+                                # process it knows nothing about.
+                                pool="",
+                                env_hash=os.environ.get("RAY_TPU_PRESET_ENV_HASH", ""),
+                                rejoining=True,
+                            ),
+                            10,
+                        )
+                    self.peer = peer
+                    resumed_peer = peer
+                    break
+                except Exception as e:  # noqa: BLE001 — retry within window
+                    if "re-registration refused" in str(e):
+                        # Permanent: the live controller declared this
+                        # process dead while it was away — further
+                        # attempts get the identical refusal.
+                        last = e
+                        break
+                    _time.sleep(min(wait * (0.5 + _random.random()),
+                                    max(0.0, deadline - _time.monotonic())))
+                    wait = min(wait * 1.7, 2.0)
+                    last = e
+            if resumed_peer is None:
+                import logging
+
+                logging.getLogger("ray_tpu.client").warning(
+                    "controller reconnect failed after %.0fs: %s", window, last
+                )
+                self._reconnect_dead = True
+                return False
+        # Resume work (pubsub resubscribe, callbacks) issues RPCs of its
+        # own — run it OUTSIDE the lock: a second connection loss here
+        # re-enters try_reconnect on this same thread, which would
+        # self-deadlock on the non-reentrant lock.
+        self._resume_after_reconnect(resumed_peer)
+        return True
+
+    def _resume_after_reconnect(self, peer):
+        import logging
+
+        logging.getLogger("ray_tpu.client").warning(
+            "reconnected to controller at %s (%s)", self.address, self.mode
+        )
+        # Ref-flush loop exits on connection loss — restart it.
+        if self._ref_flush_task is not None and self._ref_flush_task.done():
+            self._ref_flush_task = self.loop_runner.submit(self._ref_flush_loop())
+        # Re-establish pubsub subscriptions (death watchers, etc.).
+        try:
+            from ray_tpu.experimental import pubsub
+
+            pubsub._resubscribe(self)
+        except Exception as e:  # noqa: BLE001 — subscriptions are best-effort
+            logging.getLogger("ray_tpu.client").warning(
+                "pubsub resubscribe failed: %s", e
+            )
+        for cb in list(self._reconnect_cbs):
+            try:
+                cb(peer)
+            except Exception:  # noqa: BLE001 — one bad callback must not block others
+                logging.getLogger("ray_tpu.client").exception(
+                    "reconnect callback failed"
+                )
 
     def _submit(self, method: str, *args, **kwargs) -> Future:
         return self.loop_runner.submit(self.peer.call(method, *args, **kwargs))
@@ -351,6 +511,10 @@ class CoreWorker:
                 local_values[oid.binary()] = (payload, is_err)
         metas = {}
         if resp_fut is not None:
+            # bounded by the caller's get() deadline: the controller leg
+            # resolves this future within the requested timeout (resp
+            # carries the timed-out flag); unbounded only when the USER
+            # asked get(timeout=None)  # ray-tpu: lint-ignore[RTL008]
             resp = resp_fut.result()
             if resp["timeout"]:
                 raise GetTimeoutError(f"get() timed out after {timeout}s")
@@ -826,6 +990,9 @@ class CoreWorker:
     def pg_remove(self, pg_id):
         return self._call("pg_remove", pg_id)
 
+    def pg_shrink(self, pg_id, bundle_indices):
+        return self._call("pg_shrink", pg_id, list(bundle_indices))
+
     def pg_table(self):
         return self._call("pg_table")
 
@@ -843,6 +1010,7 @@ class CoreWorker:
         return self._call(f"list_{what}", **kwargs)
 
     def disconnect(self):
+        self._reconnect_dead = True  # deliberate: never dial back out
         self._refs_closed.set()
         if self._ref_flush_task is not None:
             self._ref_flush_task.cancel()

@@ -122,6 +122,8 @@ class Cluster:
         except Exception:
             pass
         try:
+            # Deliberate teardown — don't ride the reconnect window.
+            self._admin._reconnect_dead = True
             self._admin._call("shutdown_cluster", timeout=5)
         except Exception:
             pass

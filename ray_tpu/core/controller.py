@@ -30,7 +30,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.config import Config, set_config
-from ray_tpu.core.lifecycle import LifecycleRecorder
+from ray_tpu.core.lifecycle import DEATH_CHANNEL, LifecycleRecorder
 from ray_tpu.core.object_store import PlasmaStore
 from ray_tpu.core.placement_group import PlacementGroupManager
 from ray_tpu.core.resources import NodeResources, ResourceSet
@@ -363,6 +363,9 @@ class Controller:
         self._shutdown = asyncio.Event()
         self._gc_wanted = asyncio.Event()
         self._live_pin_tasks: Set[TaskID] = set()
+        # Node ids THIS controller declared dead: re-registration under
+        # the same id is refused (see rpc_register_node).
+        self._dead_node_ids: Set[str] = set()
         # Recently-freed object ids (bounded): a get/wait/dep-check on a
         # freed object fails fast instead of hanging on a resurrected
         # empty PENDING record.
@@ -466,6 +469,7 @@ class Controller:
     # =================================================================
     async def rpc_register_driver(self, peer: rpc.Peer):
         peer.meta.update(kind="driver")
+        peer.label = "driver"
         self.drivers.add(peer)
         return {
             "session_dir": self.session_dir,
@@ -477,8 +481,21 @@ class Controller:
     async def rpc_register_worker(
         self, peer: rpc.Peer, worker_id: WorkerID, node_id: NodeID, pid: int,
         listen_addr: str = "", pool: str = "", env_hash: str = "",
+        rejoining: bool = False,
     ):
+        if rejoining and worker_id.hex() in self._dead_worker_info:
+            # THIS controller already declared the worker dead (its
+            # disconnect ran _on_worker_death: actor restarted / gang
+            # repaired). Accepting the rejoin would resurrect a zombie
+            # twin of an actor that now lives elsewhere. Refuse; the
+            # worker exits. A RESTARTED controller has an empty dead
+            # table, so the restart ride-through stays intact.
+            raise RuntimeError(
+                f"worker {worker_id.hex()[:12]} was declared dead; "
+                "re-registration refused"
+            )
         peer.meta.update(kind="worker", worker_id=worker_id)
+        peer.label = f"worker:{worker_id.hex()[:8]}"
         # Pair the agent/head SPAWNED event with REGISTERED — the dwell is
         # the worker-startup latency. Drain locally-spawned head events
         # first so the pair can't arrive out of order.
@@ -494,11 +511,19 @@ class Controller:
             # use the pristine-adoption fallback).
             env_hash=env_hash,
         )
+        if rejoining:
+            # A surviving worker re-registering after a controller
+            # restart (or transient partition). Its actual occupancy is
+            # unknown to this (fresh) controller — mark it busy so the
+            # pump never dispatches onto it or recycles it as idle; it
+            # exits with the cluster like any other worker.
+            rec.state = "ACTOR"
         self.workers[worker_id] = rec
         node = self.nodes.get(node_id)
         if node is not None:
             node.workers.add(worker_id)
-            node.num_starting = max(0, node.num_starting - 1)
+            if not rejoining:
+                node.num_starting = max(0, node.num_starting - 1)
         if env_hash:
             entry = self._starting_by_env.get(env_hash)
             if entry is not None:
@@ -518,7 +543,22 @@ class Controller:
         return {"session_dir": self.session_dir, "config": self.config.to_dict()}
 
     async def rpc_register_node(self, peer: rpc.Peer, node_id: NodeID, resources: Dict[str, float], shm_dir: str, hostname: str = "localhost", pid: int = 0, fetch_addr: str = "", provider_instance_id: str = "", labels: Optional[Dict[str, str]] = None):
+        if node_id.hex() in self._dead_node_ids:
+            # This controller already declared the node DEAD (connection
+            # lapse → _on_node_death: workers reaped, PGs rescheduled,
+            # gangs repaired). Accepting a re-register would resurrect
+            # the node with pristine availability while its orphaned
+            # workers still occupy it. Refuse; the agent exits and a
+            # fresh agent (new node id) can join cleanly. A RESTARTED
+            # controller has an empty dead-set, so the agent
+            # reconnect-window ride-through stays intact.
+            raise RuntimeError(
+                f"node {node_id.hex()[:12]} was declared dead; "
+                "re-registration refused — restart the agent"
+            )
         peer.meta.update(kind="agent", node_id=node_id)
+        peer.label = f"agent:{node_id.hex()[:8]}"
+        self.lifecycle.record("node", node_id.hex(), "ALIVE", name=hostname)
         total = ResourceSet.from_dict(resources)
         self.cluster.add_node(node_id, NodeResources(total, labels=labels))
         ncpu = int(resources.get("CPU", 1))
@@ -1465,6 +1505,12 @@ class Controller:
             "worker", worker_id.hex(), "DEAD",
             reason="oom" if worker.oom_marked else reason,
         )
+        await self._publish_death(
+            "worker", worker_id.hex(), "DEAD",
+            reason="oom" if worker.oom_marked else reason,
+            node=worker.node_id.hex(),
+            actor=worker.actor_id.hex() if worker.actor_id else "",
+        )
         while len(self._dead_worker_info) > 1000:
             self._dead_worker_info.popitem(last=False)
         # Fail or retry running tasks FIRST: _on_actor_death below requeues
@@ -1584,6 +1630,9 @@ class Controller:
             actor.num_restarts += 1
             actor.state = "RESTARTING"
             self._event("actor", actor.creation_spec, "RESTARTING")
+            await self._publish_death(
+                "actor", actor_id.hex(), "RESTARTING", reason=reason
+            )
             # Re-run the creation task.
             spec = actor.creation_spec
             rec = TaskRecord(spec=spec, retries_left=0)
@@ -1594,6 +1643,10 @@ class Controller:
             actor.state = "DEAD"
             actor.death_reason = reason
             self._event("actor", actor.creation_spec, "DEAD")
+            await self._publish_death(
+                "actor", actor_id.hex(), "DEAD", reason=reason,
+                name=actor.creation_spec.name,
+            )
             if actor.creation_spec.lifetime == "detached":
                 self.journal.actor_dead(actor_id.hex())
             if actor.name:
@@ -1614,8 +1667,11 @@ class Controller:
         node = self.nodes.pop(node_id, None)
         if node is None:
             return
+        self._dead_node_ids.add(node_id.hex())
         node.state = "DEAD"
         self.cluster.remove_node(node_id)
+        self.lifecycle.record("node", node_id.hex(), "DEAD")
+        await self._publish_death("node", node_id.hex(), "DEAD")
         for wid in list(node.workers):
             w = self.workers.get(wid)
             if w is not None:
@@ -2222,6 +2278,38 @@ class Controller:
                 subs.discard(peer)
                 if not subs:
                     del self._pubsub_subs[channel]
+
+    async def _publish_death(self, kind: str, eid: str, state: str, **attrs):
+        """Push a lifecycle death/drain event to DEATH_CHANNEL
+        subscribers (train executors and other gang supervisors watch
+        this instead of waiting for a blocked collective to time out —
+        a SIGKILLed host is detected in well under a second). No-op
+        without subscribers; failures never propagate into the death
+        path itself."""
+        if DEATH_CHANNEL not in self._pubsub_subs:
+            return
+        msg = {"kind": kind, "id": eid, "state": state, "ts": time.time()}
+        msg.update({k: v for k, v in attrs.items() if v})
+        try:
+            await self.rpc_publish(None, DEATH_CHANNEL, msg)
+        except Exception as e:  # noqa: BLE001 — observers only
+            logger.debug("death-event publish failed: %s", e)
+
+    async def rpc_chaos_install(self, peer: rpc.Peer, node_id_hex: str,
+                                plan_json: str):
+        """Install (or clear, plan_json="") a fault plan on a running
+        node agent — the runtime lever for agent-level slow-node
+        throttling (`chaos.install_plan_on_node`). Empty node id targets
+        the controller process itself."""
+        if not node_id_hex:
+            from ray_tpu.util import chaos
+
+            chaos.install_fault_plan(plan_json or None)
+            return True
+        for nid, node in self.nodes.items():
+            if nid.hex() == node_id_hex and node.peer is not None:
+                return await node.peer.call("install_fault_plan", plan_json)
+        raise ValueError(f"no live agent for node {node_id_hex}")
 
     async def rpc_stack_dump_all(self, peer: rpc.Peer, timeout_s: float = 10.0):
         """Live stacks of every cluster process (reference: `ray stack` +
@@ -3311,6 +3399,16 @@ class Controller:
             await asyncio.sleep(0.02)
         return True
 
+    async def rpc_pg_shrink(self, peer, pg_id: PlacementGroupID,
+                            indices: List[int]):
+        ok = self.pg_manager.shrink(pg_id, indices)
+        if ok:
+            # Journaled: a restarted controller must not resurrect the
+            # retired bundles from the pg_create record.
+            self.journal.pg_shrink(pg_id.hex(), indices)
+        self._schedule_pump()
+        return ok
+
     async def rpc_pg_remove(self, peer, pg_id: PlacementGroupID):
         self.pg_manager.remove(pg_id)
         self.journal.pg_remove(pg_id.hex())
@@ -3930,6 +4028,8 @@ class Controller:
             raise ValueError("cannot drain the head node")
         node.state = "DRAINING"
         self.cluster.set_draining(node_id, True)
+        self.lifecycle.record("node", node_id.hex(), "DRAINING")
+        await self._publish_death("node", node_id.hex(), "DRAINING")
 
         # Preempt restartable actors right away (reference: preemption
         # flagging, actor_task_submitter.h:67): their death path restarts
@@ -4185,6 +4285,8 @@ class Controller:
             pg_id = PlacementGroupID.from_hex(pg_hex)
             rs = [ResourceSet.from_dict(b) for b in pg["bundles"]]
             self.pg_manager.create(pg_id, rs, pg["strategy"], pg["name"])
+            if pg.get("retired"):
+                self.pg_manager.shrink(pg_id, pg["retired"])
         for actor_hex, spec in self._restored.actors.items():
             if spec.dependencies:
                 # Arg objects died with the old cluster; without lineage for
@@ -4312,9 +4414,10 @@ def _default_store_bytes() -> int:
 
 
 def main():
-    from ray_tpu.util import lockwatch
+    from ray_tpu.util import chaos, lockwatch
 
     lockwatch.maybe_install()  # RAY_TPU_LOCKWATCH=1: watch locks created from here on
+    chaos.install_fault_plan_from_env()  # RAY_TPU_FAULT_PLAN: deterministic chaos
     parser = argparse.ArgumentParser()
     parser.add_argument("--session-dir", required=True)
     parser.add_argument("--port", type=int, default=0)

@@ -218,6 +218,7 @@ class ActorSubmitter:
             return
         if call.seq not in self.inflight:
             return  # cancelled/raced
+        # already-done future (done-callback): no wait  # ray-tpu: lint-ignore[RTL008]
         results, error = fut.result()
         if (
             error is not None
@@ -409,6 +410,7 @@ def _copy_future(src):
         if exc is not None:
             dst.set_exception(exc)
         else:
+            # already-done future (done-callback): no wait  # ray-tpu: lint-ignore[RTL008]
             dst.set_result(f.result())
 
     src.add_done_callback(_copy)

@@ -36,6 +36,12 @@ import collections
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+# Pubsub channel carrying worker/actor/node DEATH (and node DRAIN)
+# events as they are recorded — subscribers (e.g. the train
+# BackendExecutor's gang watcher) learn about a failure push-style in
+# ~the connection-loss latency instead of waiting out an RPC timeout.
+DEATH_CHANNEL = "lifecycle:deaths"
+
 # Terminal states pop the entity's open entry: the transition chain is
 # complete and the entity must not pin LRU space.
 TERMINAL_STATES = frozenset(
@@ -71,7 +77,7 @@ _CANONICAL = {
     "RECONSTRUCTING": "RETRYING",
 }
 
-_INGEST_KINDS = frozenset({"task", "actor", "pg", "lease", "worker"})
+_INGEST_KINDS = frozenset({"task", "actor", "pg", "lease", "worker", "node"})
 
 _DWELL_BOUNDARIES_MS = (
     1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 15000, 60000,

@@ -413,7 +413,16 @@ def start_ship_loop(core):
             except Exception:  # noqa: BLE001 — controller gone
                 requeue_ship(batch)
                 if core.peer.closed:
-                    return
+                    # Keep ticking while a reconnect may still swap in a
+                    # fresh peer (core.try_reconnect); stop when no window
+                    # is configured OR the reconnect already gave up for
+                    # good — retrying a permanently-dead peer forever is
+                    # just noise (loop_runner teardown cancels us
+                    # regardless on exit).
+                    if getattr(core, "_reconnect_dead", False) or not float(
+                        core.config.get("controller_reconnect_window_s", 0.0)
+                    ):
+                        return
 
     core.loop_runner.submit(loop())
 

@@ -602,6 +602,15 @@ class NodeAgent:
             log_plane.search_local, self._log_dir(), **filters
         )
 
+    def rpc_install_fault_plan(self, peer, plan_json: str):
+        """Install (or clear, empty string) a deterministic fault plan in
+        THIS agent process at runtime — the slow-node throttle lever
+        (`chaos.install_plan_on_node` via the controller fan-out)."""
+        from ray_tpu.util import chaos
+
+        chaos.install_fault_plan(plan_json or None)
+        return True
+
     def on_disconnect(self, peer):
         wid = peer.meta.get("direct_wid")
         if wid is not None:
@@ -610,22 +619,56 @@ class NodeAgent:
         # Only the controller connection is load-bearing; fetch peers
         # (other agents pulling from us) come and go.
         if peer is self._controller_peer or self._controller_peer is None:
-            self._exit.set()
+            window = float(
+                getattr(self, "_config", {}).get("controller_reconnect_window_s", 0.0)
+            )
+            if window <= 0:
+                self._exit.set()
+            else:
+                asyncio.ensure_future(self._reconnect_controller(window))
 
-    async def run(self):
-        from ray_tpu.utils.net import bind_host, host_ip
+    async def _reconnect_controller(self, window: float):
+        """Bounded jittered-backoff reconnect + re-register after the
+        controller connection dropped (rides through a controller
+        restart; a controller that is truly gone still exits this agent,
+        one window later). Workers this agent spawned reconnect on their
+        own — their records re-form controller-side as they re-register."""
+        import random as _random
 
         host, port = self.controller_addr.rsplit(":", 1)
-        # Listener for sibling agents pulling object chunks (reference:
-        # the ObjectManagerService gRPC server every node runs).
-        # Loopback unless RAY_TPU_NODE_IP opts this host into multi-host.
-        _server, fetch_port = await rpc.serve(self, bind_host(), 0)
-        self._listen_addr = f"{host_ip()}:{fetch_port}"
-        peer = await rpc.connect(host, int(port), self)
-        self._controller_peer = peer
-        config = self._chunk_bytes
+        # monotonic: a wall-clock step (NTP) must not stretch or collapse
+        # the reconnect window
+        deadline = time.monotonic() + window
+        wait = 0.1
+        while time.monotonic() < deadline and not self._exit.is_set():
+            try:
+                peer = await rpc.connect(host, int(port), self, retries=1)
+                await self._register(peer)
+                self._controller_peer = peer
+                logger.warning("reconnected to controller at %s", self.controller_addr)
+                return
+            except Exception as e:  # noqa: BLE001 — retry within the window
+                if "re-registration refused" in str(e):
+                    # Permanent: the live controller declared this node
+                    # dead while we were away — burning the rest of the
+                    # window on identical refusals helps nobody.
+                    logger.error("controller refused re-registration: %s", e)
+                    break
+                logger.debug("controller reconnect attempt failed: %s", e)
+                await asyncio.sleep(min(wait * (0.5 + _random.random()),
+                                        max(0.0, deadline - time.monotonic())))
+                wait = min(wait * 1.7, 2.0)
+        logger.error("controller gone for %.0fs — agent exiting", window)
+        self._exit.set()
+
+    async def _register(self, peer: rpc.Peer):
+        """Register (or RE-register after a controller restart) this node
+        on ``peer`` and absorb the returned cluster config."""
         import socket
 
+        from ray_tpu.utils.net import host_ip
+
+        chunk_fallback = self._chunk_bytes
         labels = {}
         raw_labels = os.environ.get("RAY_TPU_NODE_LABELS", "")
         if raw_labels:
@@ -648,13 +691,27 @@ class NodeAgent:
         info = await peer.call(
             "register_node", self.node_id, self.resources, self.store.shm_dir,
             hostname=socket.gethostname(), pid=os.getpid(),
-            fetch_addr=f"{host_ip()}:{fetch_port}",
+            fetch_addr=self._listen_addr,
             provider_instance_id=os.environ.get("RAY_TPU_PROVIDER_INSTANCE_ID", ""),
             labels=labels,
         )
         cfg = (info or {}).get("config") or {}
-        self._chunk_bytes = int(cfg.get("object_transfer_chunk_bytes", config))
+        self._chunk_bytes = int(cfg.get("object_transfer_chunk_bytes", chunk_fallback))
         self._config = cfg
+
+    async def run(self):
+        from ray_tpu.utils.net import bind_host, host_ip
+
+        host, port = self.controller_addr.rsplit(":", 1)
+        # Listener for sibling agents pulling object chunks (reference:
+        # the ObjectManagerService gRPC server every node runs).
+        # Loopback unless RAY_TPU_NODE_IP opts this host into multi-host.
+        _server, fetch_port = await rpc.serve(self, bind_host(), 0)
+        self._listen_addr = f"{host_ip()}:{fetch_port}"
+        peer = await rpc.connect(host, int(port), self)
+        await self._register(peer)
+        self._controller_peer = peer
+        cfg = self._config
         from ray_tpu.util import profiling
 
         profiling.ensure_continuous(
@@ -732,10 +789,15 @@ class NodeAgent:
                 if errors:
                     await self._controller_peer.notify("log_errors", errors)
             except Exception as e:  # noqa: BLE001 — transient controller hiccup
-                if self._controller_peer.closed or self._exit.is_set():
+                if self._exit.is_set():
                     return
                 _metrics.requeue_records(records)
                 _lp.requeue_ship(errors)
+                if self._controller_peer.closed:
+                    # reconnect in progress (on_disconnect) — keep ticking
+                    # so heartbeats resume on the fresh peer; _exit ends
+                    # us if the reconnect window runs out.
+                    continue
                 logger.warning("telemetry report failed: %s", e)
 
     async def _memory_monitor_loop(self):
@@ -778,9 +840,10 @@ class NodeAgent:
 
 
 def main():
-    from ray_tpu.util import lockwatch
+    from ray_tpu.util import chaos, lockwatch
 
     lockwatch.maybe_install()  # RAY_TPU_LOCKWATCH=1: watch locks created from here on
+    chaos.install_fault_plan_from_env()  # RAY_TPU_FAULT_PLAN: deterministic chaos
     parser = argparse.ArgumentParser()
     parser.add_argument("--controller", required=True)
     parser.add_argument("--session-dir", required=True)

@@ -368,6 +368,7 @@ class NormalSubmitter:
                 )
             self._pump(ks)
             return
+        # already-done future (done-callback): no wait  # ray-tpu: lint-ignore[RTL008]
         results, error = fut.result()
         if error is not None and call.spec.retry_exceptions and call.attempts_left > 0:
             call.attempts_left -= 1

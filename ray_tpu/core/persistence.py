@@ -70,6 +70,11 @@ class GcsJournal:
     def pg_remove(self, pg_id_hex: str):
         self.append("pg_remove", pg_id=pg_id_hex)
 
+    def pg_shrink(self, pg_id_hex: str, indices: List[int]):
+        """Elastic re-mesh retired these bundle indices — replay must not
+        resurrect them (they would re-reserve resources no worker uses)."""
+        self.append("pg_shrink", pg_id=pg_id_hex, indices=list(indices))
+
     def close(self):
         if self._f is not None:
             self._f.close()
@@ -123,9 +128,16 @@ class GcsJournal:
                         "bundles": rec["bundles"],
                         "strategy": rec["strategy"],
                         "name": rec["name"],
+                        "retired": rec.get("retired", []),
                     }
                 elif op == "pg_remove":
                     state.pgs.pop(rec["pg_id"], None)
+                elif op == "pg_shrink":
+                    pg = state.pgs.get(rec["pg_id"])
+                    if pg is not None:
+                        pg["retired"] = sorted(
+                            set(pg.get("retired", [])) | set(rec["indices"])
+                        )
         if torn:
             with open(self.path, "rb+") as f:
                 f.truncate(good_bytes)

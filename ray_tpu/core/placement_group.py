@@ -37,6 +37,11 @@ class PlacementGroupRecord:
     state: PGState = PGState.PENDING
     # node per bundle once placed
     bundle_nodes: List[Optional[NodeID]] = field(default_factory=list)
+    # Bundle indices retired by an elastic re-mesh (shrink): never
+    # re-placed, never counted as missing. Kept as indices (not list
+    # surgery) so surviving bundles' interned group resource names —
+    # which embed the original index — stay valid.
+    retired: set = field(default_factory=set)
 
     def to_dict(self):
         return {
@@ -46,6 +51,7 @@ class PlacementGroupRecord:
             "state": self.state.name,
             "bundles": [b.to_dict() for b in self.bundles],
             "bundle_nodes": [n.hex() if n else None for n in self.bundle_nodes],
+            "retired": sorted(self.retired),
         }
 
 
@@ -80,10 +86,31 @@ class PlacementGroupManager:
     def try_place(self, rec: PlacementGroupRecord) -> bool:
         """Prepare + commit. Placement is atomic against the cluster view; if
         any bundle can't be prepared nothing is reserved (the 2PC invariant
-        from the reference)."""
+        from the reference).
+
+        RESCHEDULING groups with surviving placed bundles (elastic gang
+        repair after a host death) re-place ONLY the missing bundles —
+        survivors keep their reservations and the actors inside them keep
+        running.
+        """
         if rec.state == PGState.CREATED:
             return True
-        nodes = schedule_bundles(self.state, rec.bundles, rec.strategy)
+        if len(rec.bundle_nodes) != len(rec.bundles):
+            rec.bundle_nodes = [None] * len(rec.bundles)
+        missing = [
+            i for i, n in enumerate(rec.bundle_nodes)
+            if n is None and i not in rec.retired
+        ]
+        if not missing:
+            rec.state = PGState.CREATED
+            return True
+        occupied = {n for n in rec.bundle_nodes if n is not None}
+        nodes = schedule_bundles(
+            self.state,
+            [rec.bundles[i] for i in missing],
+            rec.strategy,
+            occupied=occupied,
+        )
         if nodes is None:
             if self.recorder is not None:
                 self.recorder.pending_reason(
@@ -93,12 +120,12 @@ class PlacementGroupManager:
         # Prepare: acquire base resources on each node.
         acquired: List[tuple] = []
         ok = True
-        for idx, (nid, bundle) in enumerate(zip(nodes, rec.bundles)):
+        for nid, idx in zip(nodes, missing):
             node = self.state.nodes.get(nid)
-            if node is None or not node.acquire(bundle):
+            if node is None or not node.acquire(rec.bundles[idx]):
                 ok = False
                 break
-            acquired.append((nid, bundle, idx))
+            acquired.append((nid, rec.bundles[idx], idx))
         if not ok:
             for nid, bundle, _ in acquired:
                 if nid in self.state.nodes:
@@ -114,7 +141,7 @@ class PlacementGroupManager:
         # Commit: add renamed group resources.
         for nid, bundle, idx in acquired:
             self.state.nodes[nid].add_total(_group_resources(rec.pg_id, idx, bundle))
-        rec.bundle_nodes = list(nodes)
+            rec.bundle_nodes[idx] = nid
         rec.state = PGState.CREATED
         self._record(rec, "CREATED")
         return True
@@ -124,9 +151,11 @@ class PlacementGroupManager:
         rec = self.groups.get(pg_id)
         if rec is None or rec.state == PGState.REMOVED:
             return
-        if rec.state == PGState.CREATED:
+        if rec.state in (PGState.CREATED, PGState.RESCHEDULING):
+            # RESCHEDULING keeps SURVIVING bundles reserved (partial
+            # re-place after a host death) — release those too.
             for idx, (nid, bundle) in enumerate(zip(rec.bundle_nodes, rec.bundles)):
-                node = self.state.nodes.get(nid)
+                node = self.state.nodes.get(nid) if nid is not None else None
                 if node is None:
                     continue
                 node.remove_total(_group_resources(rec.pg_id, idx, bundle))
@@ -152,19 +181,58 @@ class PlacementGroupManager:
     # ------------------------------------------------------------------
     def on_node_removed(self, node_id: NodeID):
         """Bundles on a dead node → PG goes back to rescheduling
-        (reference: gcs_placement_group_manager.cc OnNodeDead)."""
+        (reference: gcs_placement_group_manager.cc OnNodeDead). Only the
+        DEAD node's bundles are re-placed; surviving bundles keep their
+        reservations so the actors inside them stay warm — the elastic
+        gang-repair invariant (backend_executor.restart rejoin)."""
         for rec in self.groups.values():
-            if rec.state == PGState.CREATED and node_id in rec.bundle_nodes:
-                # Release surviving bundles and re-place the whole group.
-                for idx, (nid, bundle) in enumerate(zip(rec.bundle_nodes, rec.bundles)):
-                    node = self.state.nodes.get(nid)
-                    if node is not None:
-                        node.remove_total(_group_resources(rec.pg_id, idx, bundle))
-                        node.release(bundle)
-                rec.state = PGState.RESCHEDULING
-                rec.bundle_nodes = []
-                self._record(rec, "RESCHEDULING")
+            # RESCHEDULING too: a second node death while earlier dead
+            # bundles are still unplaced must clear ITS slots as well, or
+            # the group would later commit with a bundle pinned to the
+            # second dead node.
+            if (rec.state in (PGState.CREATED, PGState.RESCHEDULING)
+                    and node_id in rec.bundle_nodes):
+                for idx, nid in enumerate(rec.bundle_nodes):
+                    if nid == node_id:
+                        # The node record is already gone — its resource
+                        # accounting died with it; just mark the slot.
+                        rec.bundle_nodes[idx] = None
+                if rec.state != PGState.RESCHEDULING:
+                    rec.state = PGState.RESCHEDULING
+                    self._record(rec, "RESCHEDULING")
                 self.try_place(rec)
+
+    def shrink(self, pg_id: PlacementGroupID, indices: List[int]) -> bool:
+        """Retire bundles after an elastic re-mesh: release any held
+        reservation and stop re-placing them — without this, a shrunken
+        gang's dead bundle would sit RESCHEDULING forever and commit the
+        moment capacity returns, reserving resources no worker will use."""
+        rec = self.groups.get(pg_id)
+        if rec is None or rec.state == PGState.REMOVED:
+            return False
+        for idx in indices:
+            if not 0 <= idx < len(rec.bundles) or idx in rec.retired:
+                continue
+            nid = (
+                rec.bundle_nodes[idx]
+                if idx < len(rec.bundle_nodes) else None
+            )
+            if nid is not None:
+                node = self.state.nodes.get(nid)
+                if node is not None:
+                    node.remove_total(
+                        _group_resources(rec.pg_id, idx, rec.bundles[idx])
+                    )
+                    node.release(rec.bundles[idx])
+                rec.bundle_nodes[idx] = None
+            rec.retired.add(idx)
+        if rec.state == PGState.RESCHEDULING and not any(
+            n is None and i not in rec.retired
+            for i, n in enumerate(rec.bundle_nodes)
+        ):
+            rec.state = PGState.CREATED
+            self._record(rec, "CREATED")
+        return True
 
     def retry_pending(self):
         for rec in self.groups.values():

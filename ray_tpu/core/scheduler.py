@@ -292,6 +292,7 @@ def schedule_bundles(
     state: ClusterState,
     bundles: List[ResourceSet],
     strategy: str,
+    occupied: Optional[set] = None,
 ) -> Optional[List[NodeID]]:
     """Place PG bundles per PACK/SPREAD/STRICT_PACK/STRICT_SPREAD
     (reference: raylet/scheduling/policy/bundle_scheduling_policy.h:82-106).
@@ -299,6 +300,12 @@ def schedule_bundles(
     Returns one node per bundle or None if infeasible. Trial placement is
     done against a scratch copy of availability so multi-bundle-per-node
     accounting is correct.
+
+    ``occupied`` is the node set already holding this group's SURVIVING
+    bundles during a partial re-place (host-death rescheduling): for
+    STRICT_PACK the missing bundles MUST land there (one node), for
+    STRICT_SPREAD they must NOT, and SPREAD prefers fresh nodes first —
+    mirroring how those nodes would look to a full placement.
     """
     # Scratch availability.
     avail: Dict[NodeID, ResourceSet] = {
@@ -306,6 +313,12 @@ def schedule_bundles(
         for nid in state.ordered_nodes()
     }
     order = state.ordered_nodes()
+    occupied = occupied or set()
+    if occupied:
+        if strategy == "STRICT_PACK":
+            order = [n for n in order if n in occupied]
+        elif strategy == "STRICT_SPREAD":
+            order = [n for n in order if n not in occupied]
 
     def try_place(nid: NodeID, demand: ResourceSet) -> bool:
         if avail[nid].fits(demand):
@@ -336,7 +349,7 @@ def schedule_bundles(
         return placement  # type: ignore[return-value]
 
     if strategy in ("SPREAD", "STRICT_SPREAD"):
-        used_nodes: set = set()
+        used_nodes: set = set(occupied) if strategy == "SPREAD" else set()
         for i, b in enumerate(bundles):
             candidates = [n for n in order if n not in used_nodes] + (
                 [] if strategy == "STRICT_SPREAD" else [n for n in order if n in used_nodes]

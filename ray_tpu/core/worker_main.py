@@ -199,8 +199,38 @@ class WorkerHandler:
     def on_disconnect(self, peer):
         # Direct-caller connections come and go; only the controller
         # connection is load-bearing.
-        if peer is self._controller_peer:
+        if peer is not self._controller_peer:
+            return
+        core = self.executor.core if self.executor is not None else None
+        window = 0.0
+        if core is not None and isinstance(getattr(core, "config", None), dict):
+            window = float(core.config.get("controller_reconnect_window_s", 0.0))
+        # Only a BUSY worker (hosting an actor / running a task) has
+        # state worth riding a controller restart for. An idle pool
+        # worker that reconnects just re-idles — exiting now instead of
+        # lingering a full window loses nothing (the agent respawns on
+        # demand) and keeps teardown/chaos tests free of straggler
+        # processes.
+        busy = self.executor is not None and (
+            self.executor.actor_instance is not None
+            or self.executor.current_task_info is not None
+        )
+        if window <= 0 or core is None or not busy:
             os._exit(1)
+
+        # Bounded reconnect (jittered backoff inside try_reconnect):
+        # rides through a controller restart on the same address; a
+        # controller that is truly gone still ends with exit(1), just
+        # one window later. Runs on its own thread — this callback is
+        # on the IO loop the reconnect itself needs.
+        def _rejoin():
+            if core.try_reconnect():
+                self._controller_peer = core.peer
+            else:
+                os._exit(1)
+
+        threading.Thread(target=_rejoin, daemon=True,
+                         name="controller-rejoin").start()
 
 
 class TaskExecutor:
@@ -691,6 +721,9 @@ def main():
     from ray_tpu.util import lockwatch
 
     lockwatch.maybe_install()  # RAY_TPU_LOCKWATCH=1: watch locks created from here on
+    from ray_tpu.util import chaos
+
+    chaos.install_fault_plan_from_env()  # RAY_TPU_FAULT_PLAN: deterministic chaos
     addr = os.environ["RAY_TPU_CONTROLLER"]
     node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
     worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
@@ -766,7 +799,8 @@ def main():
 
         loop_runner.run(_attach())
 
-    threading.Event().wait()  # serve forever; exit via rpc_exit / disconnect
+    # serve-forever park by design; exit via rpc_exit / os._exit  # ray-tpu: lint-ignore[RTL008]
+    threading.Event().wait()
 
 
 if __name__ == "__main__":

@@ -439,6 +439,7 @@ class SplitCoordinator:
         q = self._queues[idx]
         try:
             while True:
+                # pump guarantees a sentinel even on error (finally)  # ray-tpu: lint-ignore[RTL008]
                 item = q.get()
                 if item is None:
                     self._check_error()
@@ -473,6 +474,7 @@ class SplitCoordinator:
         if self._dead[idx]:
             self._check_error()
             return None
+        # pump guarantees a sentinel even on error (finally)  # ray-tpu: lint-ignore[RTL008]
         item = q.get()
         if item is None:
             self._dead[idx] = True

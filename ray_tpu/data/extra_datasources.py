@@ -203,6 +203,7 @@ class BigQueryDatasource(Datasource):
                 f"SELECT * FROM ({query}) AS _rt WHERE "
                 f"MOD(ABS(FARM_FINGERPRINT(TO_JSON_STRING(_rt))), {p}) = {i}"
             )
+            # BigQuery job: workload-duration wait by design  # ray-tpu: lint-ignore[RTL008]
             rows = client.query(q).result()
             yield [dict(r) for r in rows]
 

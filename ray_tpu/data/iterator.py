@@ -195,6 +195,7 @@ def _through_thread(make_gen: Callable[[], Iterator], depth: int, stage: str):
     try:
         while True:
             m.prefetch_depth.set(float(q.qsize()), {"stage": stage})
+            # pump thread guarantees a sentinel/error item (finally)  # ray-tpu: lint-ignore[RTL008]
             err, item = q.get()
             if err is not None:
                 raise err
@@ -268,6 +269,7 @@ class DataIterator:
                     pending.append(pool.submit(_fetch_block, b))
                 if not pending:
                     return
+                # data-plane prefetch: workload-duration wait by design  # ray-tpu: lint-ignore[RTL008]
                 yield pending.popleft().result()
         finally:
             close = getattr(bundles, "close", None)

@@ -88,3 +88,13 @@ def _deliver(channel: str, message: Any):
         subs = list(_subscribers.get(channel, ()))
     for s in subs:
         s._q.put(message)
+
+
+def _resubscribe(core):
+    """Re-issue subscriptions on a fresh controller connection (called
+    by CoreWorker after a reconnect — the restarted controller has no
+    memory of this process's channels)."""
+    with _lock:
+        channels = [ch for ch, subs in _subscribers.items() if subs]
+    for ch in channels:
+        core._call("subscribe", ch)

@@ -102,6 +102,7 @@ class JobManager:
             info["status"] = JobStatus.RUNNING
             info["start_time"] = time.time()
             self._procs[job_id] = proc
+        # supervised job runs arbitrarily long by design  # ray-tpu: lint-ignore[RTL008]
         rc = proc.wait()
         with self._lock:
             self._procs.pop(job_id, None)

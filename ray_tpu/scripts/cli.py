@@ -12,7 +12,7 @@ Usage:
   ray-tpu status
   ray-tpu submit -- python my_script.py              # run as a job
   ray-tpu job list | job logs ID | job stop ID
-  ray-tpu summary tasks|actors|objects|memory|lifecycle|rl|profiling|errors
+  ray-tpu summary tasks|actors|objects|memory|lifecycle|rl|train|profiling|errors
   ray-tpu timeline [--output FILE]
   ray-tpu profile stacks|cpu|device|incidents|captures [...]
   ray-tpu memory [--node N] [--leaks] [--limit K] [--offline] [--json]
@@ -292,6 +292,7 @@ def cmd_summary(args):
         "memory": state.summarize_memory,
         "lifecycle": state.summarize_lifecycle,
         "rl": state.summarize_rl,
+        "train": state.summarize_train,
         "profiling": state.summarize_profiling,
         "errors": state.summarize_errors,
     }[args.what]
@@ -967,7 +968,7 @@ def main(argv=None):
     sp.add_argument(
         "what",
         choices=["tasks", "actors", "objects", "memory", "lifecycle", "rl",
-                 "profiling", "errors"],
+                 "train", "profiling", "errors"],
     )
     sp.set_defaults(fn=cmd_summary)
 

@@ -149,6 +149,7 @@ def batch(
                 q = fn_queue[0]
             else:
                 raise TypeError("@serve.batch handlers take exactly one request arg")
+            # serve data plane: the request waits for its batch result  # ray-tpu: lint-ignore[RTL008]
             return q.submit(item).result()
 
         wrapper._is_serve_batch = True  # noqa: SLF001 — introspection marker

@@ -71,6 +71,8 @@ class _MuxCache:
                 if ev is None:
                     ev = self._loading[model_id] = threading.Event()
                     break  # this thread is the loader
+            # single-flight contract: the loader sets this event on both
+            # success and failure paths  # ray-tpu: lint-ignore[RTL008]
             ev.wait()  # another thread is loading — wait, then re-check
         try:
             model = self._loader(self._owner, model_id)

@@ -1,4 +1,4 @@
-"""ray_tpu lint rules RTL001–RTL007.
+"""ray_tpu lint rules RTL001–RTL008.
 
 Each rule targets a failure class this codebase has actually hit (or that
 Ray itself accumulates at scale):
@@ -37,6 +37,16 @@ Ray itself accumulates at scale):
   logger so the structured log plane (core/log_plane.py) can stamp it
   with severity + task attribution; a print is invisible to
   ``ray-tpu logs --err`` and the error index.
+* RTL008 unbounded-wait — a thread-blocking wait with no bound:
+  zero-argument ``.result()`` / ``.get()`` / ``.join()`` / ``.wait()``
+  (``Future.result`` / ``Queue.get`` / ``Thread.join`` / ``Event.wait``
+  all default to forever), and the explicit ``timeout=None`` opt-out on
+  the RPC surface (``call`` / ``_call``, whose bare default is the
+  bounded ``control_call_timeout_s``). A wedged peer turns every such
+  wait into a silent hang the failure detector can't see past — the
+  static sibling of the elastic-train detect path. Waits that are
+  unbounded BY DESIGN (writer-loop queue pops, workload-duration data
+  waits, serve-forever parks) carry a suppression naming the reason.
 """
 from __future__ import annotations
 
@@ -677,7 +687,145 @@ class SilentSwallow(Checker):
 
 
 # ---------------------------------------------------------------------------
-# RTL007 — bare print() in package code
+# RTL008 — unbounded waits
+
+
+@register
+class UnboundedWait(Checker):
+    rule = "RTL008"
+    name = "unbounded-wait"
+    description = (
+        "blocking wait with no timeout — zero-arg result()/get()/join()/"
+        "wait(), or an RPC call explicitly opting out with timeout=None"
+    )
+
+    # CLI surfaces (scripts/, tools/) legitimately block for as long as
+    # the user's command runs.
+    _EXEMPT_SEGMENTS = ("scripts", "tools")
+    _WAIT_METHODS = {
+        "result": "Future.result()",
+        "get": "Queue.get()",
+        "join": "Thread.join()",
+        "wait": "Event.wait()",
+    }
+    # The project's sync RPC surface: Connection._call applies the bounded
+    # control_call_timeout_s default, so bare calls are fine — only an
+    # EXPLICIT timeout=None opts back into waiting forever.
+    _RPC_NAMES = {"call", "_call"}
+
+    def __init__(self):
+        # "module.name" of every ContextVar assignment seen project-wide;
+        # zero-arg .get() on one of these is an instant read, not a wait.
+        # Resolution is deferred to finalize() because the ContextVar may
+        # be defined in a module visited AFTER its importer.
+        self._ctxvars: Set[str] = set()
+        self._deferred: List[Tuple[Finding, Optional[str]]] = []
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        parts = ctx.relpath.replace("\\", "/").split("/")
+        exempt = any(seg in parts[:-1] for seg in self._EXEMPT_SEGMENTS)
+        aliases = import_aliases(ctx.tree)
+        self._collect_contextvars(ctx)
+        if exempt:
+            return ()
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (
+                fn.attr
+                if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else ""
+            )
+            if (
+                isinstance(fn, ast.Attribute)
+                and name in self._WAIT_METHODS
+                and not node.args
+                and not node.keywords
+                and not self._bounded_context(ctx, node)
+            ):
+                recv = dotted(fn.value) or ""
+                if recv in ("self", "cls"):
+                    # A method calling its own result()/get()/... — that is
+                    # an ordinary method dispatch, not a stdlib wait.
+                    continue
+                finding = ctx.finding(
+                    self.rule,
+                    node,
+                    f"`{recv or '<expr>'}.{name}()` "
+                    f"({self._WAIT_METHODS[name]} semantics) waits forever "
+                    "— pass a timeout, or suppress with the reason this "
+                    "wait is unbounded by design",
+                )
+                if name == "get":
+                    qual = None
+                    if isinstance(fn.value, ast.Name):
+                        qual = aliases.get(
+                            fn.value.id, f"{ctx.module_name}.{fn.value.id}"
+                        )
+                    self._deferred.append((finding, qual))
+                else:
+                    findings.append(finding)
+                continue
+            if name in self._RPC_NAMES:
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "timeout"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None
+                    ):
+                        findings.append(
+                            ctx.finding(
+                                self.rule,
+                                node,
+                                "explicit timeout=None opts this RPC out of "
+                                "the bounded control-call default — give it "
+                                "a real bound or suppress with justification",
+                            )
+                        )
+        return findings
+
+    def _collect_contextvars(self, ctx: ModuleContext):
+        for node in ast.walk(ctx.tree):
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign):
+                target = node.target
+            else:
+                continue
+            value = getattr(node, "value", None)
+            if not isinstance(value, ast.Call) or not isinstance(target, ast.Name):
+                continue
+            d = dotted(value.func) or ""
+            if d.rsplit(".", 1)[-1] == "ContextVar":
+                self._ctxvars.add(f"{ctx.module_name}.{target.id}")
+
+    @staticmethod
+    def _bounded_context(ctx: ModuleContext, node: ast.Call) -> bool:
+        parent = ctx.parent(node)
+        if isinstance(parent, ast.Await):
+            # Awaited waits are cancellable from the loop and boundable by
+            # the caller's asyncio.wait_for — not thread-blocking.
+            return True
+        if isinstance(parent, ast.Call):
+            d = dotted(parent.func) or ""
+            if d.rsplit(".", 1)[-1] == "wait_for":
+                return True  # asyncio.wait_for(x.wait(), timeout=...)
+        return False
+
+    def finalize(self) -> Iterable[Finding]:
+        return [
+            finding
+            for finding, qual in self._deferred
+            if qual is None or qual not in self._ctxvars
+        ]
+
+
+# ---------------------------------------------------------------------------
+# RTL007 — bare print() in package code (registration order is by rule id
+# in the CLI listing; definition order here is immaterial)
 
 
 @register

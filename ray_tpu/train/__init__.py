@@ -19,7 +19,11 @@ from ray_tpu.train.session import (
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
 from ray_tpu.train.torch import TorchTrainer
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup
-from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
+from ray_tpu.train.backend_executor import (
+    BackendExecutor,
+    GangMemberDiedError,
+    TrainingFailedError,
+)
 
 __all__ = [
     "Checkpoint",
@@ -41,4 +45,5 @@ __all__ = [
     "WorkerGroup",
     "BackendExecutor",
     "TrainingFailedError",
+    "GangMemberDiedError",
 ]

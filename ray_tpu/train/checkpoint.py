@@ -1,21 +1,40 @@
-"""Checkpoint object + top-k retention manager.
+"""Checkpoint object + top-k retention manager + async upload writer.
 
 Reference: python/ray/train/_checkpoint.py (Checkpoint = directory handle)
 and train/_internal/checkpoint_manager.py:43,80 (_CheckpointManager).
 Storage paths resolve through ray_tpu.utils.cloudfs (reference:
 train/_internal/storage.py:352 uses pyarrow.fs the same way), so
 ``storage_path="gs://bucket/run"`` works wherever a local path does.
+
+Crash consistency contract (async uploads): a checkpoint directory is
+DURABLE only once it carries a ``.complete`` marker, written by rank 0's
+writer after every rank's ``.rank_<k>.uploaded`` marker landed. Every
+resume path (:attr:`CheckpointManager.latest`,
+:meth:`CheckpointManager.sync_from_storage`) trusts only complete
+checkpoints — a death mid-upload leaves a torn directory that is simply
+never resumed from, never a corrupt "latest".
 """
 from __future__ import annotations
 
 import json
+import logging
 import os
+import queue
 import shutil
 import tempfile
+import threading
 from contextlib import contextmanager
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from ray_tpu.utils import cloudfs
+
+logger = logging.getLogger("ray_tpu.train")
+
+COMPLETE_MARKER = ".complete"
+
+
+def rank_marker(rank: int) -> str:
+    return f".rank_{rank:04d}.uploaded"
 
 
 class Checkpoint:
@@ -68,9 +87,56 @@ class CheckpointManager:
         self._kept: List[ReportedCheckpoint] = []
         cloudfs.makedirs(root)
 
+        # Positive completeness checks are cached (complete never
+        # un-happens); pending async uploads re-check on each read.
+        self._verified: set = set()
+
+    def _is_complete(self, rc: ReportedCheckpoint) -> bool:
+        path = rc.checkpoint.path
+        if path in self._verified:
+            return True
+        base = path.rstrip("/").rsplit("/", 1)[-1]
+        if not base.startswith("checkpoint_"):
+            # External checkpoint (resume_from_checkpoint) — not written
+            # by a session, no marker convention; trust the caller.
+            self._verified.add(path)
+            return True
+        if cloudfs.exists(cloudfs.join(path, COMPLETE_MARKER)):
+            self._verified.add(path)
+            return True
+        return False
+
     @property
     def latest(self) -> Optional[ReportedCheckpoint]:
-        return self._kept[-1] if self._kept else None
+        """Newest COMPLETE checkpoint (the resume anchor). Registered
+        checkpoints whose async upload has not committed yet — or whose
+        writer died mid-upload — are skipped, never resumed from."""
+        for rc in reversed(self._kept):
+            if self._is_complete(rc):
+                return rc
+        return None
+
+    @property
+    def next_index(self) -> int:
+        """First unused checkpoint index: a repaired/restarted session
+        continues numbering here so a new incarnation can never write
+        into a directory an earlier one already touched. Scans the
+        ON-DISK directories too, not just registered checkpoints: a torn
+        async upload (rank markers present, no ``.complete``) is never
+        registered, and reusing its index would let the new incarnation's
+        rank 0 count the STALE rank markers toward its commit and mark a
+        mixed-incarnation checkpoint complete."""
+        newest = max((c.index for c in self._kept), default=-1)
+        try:
+            for entry in cloudfs.listdir(self.root):
+                if entry.startswith("checkpoint_"):
+                    try:
+                        newest = max(newest, int(entry.split("_")[-1]))
+                    except ValueError:
+                        continue
+        except Exception as e:  # noqa: BLE001 — storage listing is advisory
+            logger.debug("next_index storage scan failed: %s", e)
+        return newest + 1
 
     @property
     def best(self) -> Optional[ReportedCheckpoint]:
@@ -102,8 +168,21 @@ class CheckpointManager:
     def _evict(self):
         if self.num_to_keep is None or len(self._kept) <= self.num_to_keep:
             return
-        # Never evict the most recent (resume anchor); evict worst/oldest.
-        candidates = self._kept[:-1]
+        # Never evict the most recent NOR the newest complete one (the
+        # resume anchor — with async uploads they can be different
+        # checkpoints), and never evict ANY not-yet-complete entry: its
+        # writers may still be uploading, and deleting under them would
+        # recreate the directory piecemeal and let rank 0 commit a torn
+        # checkpoint. Incomplete entries either commit (evictable later)
+        # or stay torn and untrusted — harmless either way.
+        protected = {id(self._kept[-1])}
+        anchor = self.latest
+        if anchor is not None:
+            protected.add(id(anchor))
+        candidates = [
+            c for c in self._kept
+            if id(c) not in protected and self._is_complete(c)
+        ]
         if self.score_attr:
             candidates = sorted(
                 candidates,
@@ -128,7 +207,7 @@ class CheckpointManager:
             if (
                 entry.startswith("checkpoint_")
                 and cloudfs.isdir(path)
-                and cloudfs.exists(cloudfs.join(path, ".complete"))
+                and cloudfs.exists(cloudfs.join(path, COMPLETE_MARKER))
                 and path not in known
             ):
                 try:
@@ -152,3 +231,155 @@ class CheckpointManager:
                         )
                     )
         return mgr
+
+
+class WriterKilled(BaseException):
+    """Raised by a test fault hook to simulate the writer thread dying
+    at an exact point (BaseException so user-code except clauses in the
+    hook path can't swallow it)."""
+
+
+class CheckpointWriter:
+    """Per-rank background uploader for non-blocking checkpoints.
+
+    ``train.report(checkpoint=..)`` hands this thread a (staging_dir,
+    dest) job; the step itself blocks only for the local host-side
+    snapshot. The writer uploads the rank's files into the shared dest,
+    commits the per-rank marker, and — on rank 0 — waits for every
+    rank's marker before atomically committing ``.complete`` (the only
+    thing resume paths trust) and enqueueing nothing further until the
+    next report. Reference analogue: orbax's async checkpointing commit
+    protocol (commit_success file after all hosts' writes).
+
+    ``fault_hook(point, dest)`` is the deterministic chaos seam: tests
+    raise :class:`WriterKilled` at seeded points ("before_upload",
+    "mid_upload", "before_rank_marker", "before_complete") to prove a
+    death anywhere mid-upload never yields a trusted torn checkpoint.
+    """
+
+    _POINTS = ("before_upload", "mid_upload", "before_rank_marker",
+               "before_complete")
+
+    def __init__(self, world_rank: int, world_size: int,
+                 fault_hook: Optional[Callable[[str, str], None]] = None,
+                 complete_timeout_s: float = 120.0):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.fault_hook = fault_hook
+        self.complete_timeout_s = complete_timeout_s
+        self.error: Optional[BaseException] = None
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def _hook(self, point: str, dest: str):
+        if self.fault_hook is not None:
+            self.fault_hook(point, dest)
+
+    def submit(self, staging_dir: str, dest: str):
+        """Enqueue one upload job. Raises a previous job's error (the
+        loop must learn persistence is failing, not silently lose
+        durability)."""
+        self.check()
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"ckpt-writer-r{self.world_rank}",
+                )
+                self._thread.start()
+        self._q.put((staging_dir, dest))
+
+    def check(self):
+        if self.error is not None:
+            err, self.error = self.error, None
+            raise RuntimeError(
+                f"async checkpoint upload failed: {err!r}"
+            ) from err
+
+    def _run(self):
+        while True:
+            # writer thread parks for the next snapshot by design  # ray-tpu: lint-ignore[RTL008]
+            job = self._q.get()
+            if job is None:
+                # Sentinel counts toward unfinished_tasks like any job —
+                # settle it or every later drain() sees a phantom pending
+                # upload on a dead thread.
+                self._q.task_done()
+                return
+            staging, dest = job
+            try:
+                self._upload(staging, dest)
+            except WriterKilled as e:
+                # Simulated writer death: the thread is gone mid-job, the
+                # torn dest has no .complete and never will.
+                self.error = e
+                return
+            except BaseException as e:  # noqa: BLE001 — surfaced on next submit
+                self.error = e
+            finally:
+                self._q.task_done()
+
+    def _upload(self, staging: str, dest: str):
+        self._hook("before_upload", dest)
+        cloudfs.makedirs(dest)
+        # Per-file copy with a deterministic mid-upload fault point after
+        # the first file — "mid_upload" means dest holds a PARTIAL rank
+        # shard when the writer dies there.
+        entries = sorted(os.listdir(staging))
+        for i, entry in enumerate(entries):
+            src = os.path.join(staging, entry)
+            if os.path.isdir(src):
+                cloudfs.copy_dir(src, cloudfs.join(dest, entry))
+            else:
+                with open(src, "rb") as f:
+                    cloudfs.write_bytes(cloudfs.join(dest, entry), f.read())
+            if i == 0:
+                self._hook("mid_upload", dest)
+        self._hook("before_rank_marker", dest)
+        cloudfs.touch(cloudfs.join(dest, rank_marker(self.world_rank)))
+        if self.world_rank == 0:
+            self._commit_complete(dest)
+        shutil.rmtree(staging, ignore_errors=True)
+
+    def _commit_complete(self, dest: str):
+        """Rank 0: wait for every rank's upload marker, then commit."""
+        import time
+
+        deadline = time.monotonic() + self.complete_timeout_s
+        while True:
+            markers = [
+                e for e in cloudfs.listdir(dest)
+                if e.startswith(".rank_") and e.endswith(".uploaded")
+            ]
+            if len(markers) >= self.world_size:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"checkpoint {dest}: only {len(markers)}/"
+                    f"{self.world_size} rank uploads landed within "
+                    f"{self.complete_timeout_s}s — leaving it uncommitted"
+                )
+            time.sleep(0.05)
+        self._hook("before_complete", dest)
+        cloudfs.touch(cloudfs.join(dest, COMPLETE_MARKER))
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Block until queued uploads finish (session teardown / clean
+        fit() exit). True when the queue emptied in time."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._q.all_tasks_done:
+                if self._q.unfinished_tasks == 0:
+                    return True
+            if self._thread is None or not self._thread.is_alive():
+                # writer died (fault or error): whatever is queued will
+                # never upload — report drained-with-error
+                return self.error is None and self._q.unfinished_tasks == 0
+            time.sleep(0.02)
+        return False
+
+    def stop(self):
+        self._q.put(None)

@@ -22,6 +22,13 @@ class ScalingConfig:
     use_tpu: bool = False
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
+    # Elastic floor: when a worker's host dies and no replacement becomes
+    # placeable within FailureConfig.elastic_grace_s, the gang RE-MESHES
+    # to the surviving count (resuming from checkpoint at the smaller
+    # data-parallel width) as long as it stays >= min_workers. None (the
+    # default) pins the world shape: recovery always waits for a
+    # replacement (rejoin) and a gang below num_workers is a failure.
+    min_workers: Optional[int] = None
     # e.g. "v5p-16": informs slice-aware placement; None = any chips.
     topology: Optional[str] = None
     # Multi-host SPMD: every worker is one host process of a single JAX
@@ -51,19 +58,39 @@ class ScalingConfig:
 
 @dataclass
 class FailureConfig:
-    """max_failures: worker-group restarts before giving up (-1 = infinite)."""
+    """max_failures: gang recoveries before giving up (-1 = infinite).
+
+    Since the elastic-recovery rework a "failure" no longer implies a
+    tear-down-and-rebuild: surviving workers are kept warm and the group
+    repairs in place (replacement rejoin at the same world size, or
+    re-mesh to the surviving count when ScalingConfig.min_workers
+    allows). ``elastic_grace_s`` bounds how long a repair waits for a
+    replacement worker before falling back to re-mesh (or, without an
+    elastic floor, keeps waiting until the grace expires and the repair
+    degrades to a full gang rebuild)."""
 
     max_failures: int = 0
+    elastic_grace_s: float = 10.0
 
 
 @dataclass
 class CheckpointConfig:
     """Top-k checkpoint retention (reference:
-    train/_internal/checkpoint_manager.py:43)."""
+    train/_internal/checkpoint_manager.py:43).
+
+    ``async_upload=True`` makes ``train.report(checkpoint=...)``
+    non-blocking: the step pays only for a local host-side snapshot of
+    the checkpoint directory; persistence into run storage happens on a
+    per-rank writer thread with crash-consistent commit markers (the
+    ``.complete`` marker is written by rank 0's writer only after every
+    rank's upload landed, so a death mid-upload can never leave a torn
+    "latest" — CheckpointManager.latest/resume only trust complete
+    checkpoints)."""
 
     num_to_keep: Optional[int] = None
     checkpoint_score_attribute: Optional[str] = None
     checkpoint_score_order: str = "max"
+    async_upload: bool = False
 
     def __post_init__(self):
         if self.checkpoint_score_order not in ("max", "min"):

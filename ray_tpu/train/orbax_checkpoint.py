@@ -37,6 +37,35 @@ def save_sharded(path: str, state: Any, *, force: bool = True) -> str:
     return path
 
 
+_async_ckptr = None
+
+
+def save_sharded_async(path: str, state: Any, *, force: bool = True) -> str:
+    """Snapshot-to-host-then-background-write: returns as soon as the
+    device→host copy is done (the only part the train step must block
+    for); serialization + upload continue on orbax's writer threads.
+    Orbax serializes saves on the same checkpointer, so back-to-back
+    calls self-pace; call :func:`wait_for_async_saves` before relying on
+    durability (``session.report`` instead routes its own per-rank
+    commit markers through train.checkpoint.CheckpointWriter — this
+    function is the direct-orbax analogue for loops that checkpoint to
+    cloud storage themselves)."""
+    global _async_ckptr
+    import orbax.checkpoint as ocp
+
+    path = cloudfs.normalize(path)
+    if _async_ckptr is None:
+        _async_ckptr = ocp.StandardCheckpointer()  # AsyncCheckpointer subclass
+    _async_ckptr.save(path, state, force=force)
+    return path
+
+
+def wait_for_async_saves() -> None:
+    """Block until every :func:`save_sharded_async` write committed."""
+    if _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
+
+
 def restore_sharded(path: str, template: Any) -> Any:
     """Restore into the shardings carried by ``template``.
 

@@ -21,9 +21,25 @@ import time
 from dataclasses import dataclass
 from typing import Dict, Optional
 
-from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointWriter
 
 _session: Optional["_TrainSession"] = None
+
+
+class TrainingAborted(RuntimeError):
+    """The driver aborted this rank's training loop (gang repair after a
+    peer death): not a user-code failure — the executor restarts the
+    loop from checkpoint on the repaired gang."""
+
+
+# Test seam: fault hook threaded into every session's CheckpointWriter
+# (see checkpoint.CheckpointWriter docstring).
+_ckpt_fault_hook = None
+
+
+def set_checkpoint_fault_hook(hook):
+    global _ckpt_fault_hook
+    _ckpt_fault_hook = hook
 
 # ---------------------------------------------------------------------------
 # Step telemetry (reference: the reference's train ProgressTracker /
@@ -143,17 +159,28 @@ class TrainContext:
 
 
 class _TrainSession:
-    def __init__(self, ctx: TrainContext, group_name: str, latest_checkpoint: Optional[str]):
+    def __init__(self, ctx: TrainContext, group_name: str, latest_checkpoint: Optional[str],
+                 checkpoint_async: bool = False, ckpt_index_start: int = 0):
         self.ctx = ctx
         self.group_name = group_name
         self.result_queue: queue.Queue = queue.Queue(maxsize=1)
-        self.ckpt_seq = 0
+        # Numbering continues where the previous incarnation left off so
+        # a repaired gang can never write into (tear) a directory an
+        # earlier incarnation already committed.
+        self.ckpt_seq = ckpt_index_start
         self.latest_checkpoint = latest_checkpoint
+        self.checkpoint_async = checkpoint_async
+        self._ckpt_writer: Optional[CheckpointWriter] = None
+        # Driver-initiated abort (gang repair): breaks this rank's loop
+        # out of report()/barrier waits with TrainingAborted.
+        self.aborted = threading.Event()
+        self.abort_reason = ""
         # name -> (ShardCoordinator actor handle, split index) for the
         # trainer's ``datasets`` (see get_dataset_shard).
         self.dataset_shards: Dict[str, tuple] = {}
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
+        self._steps_reported = 0
         # Step-timing marks: wall time between report() calls is the
         # step; time inside report() (barrier + persist + queue) is
         # accounted separately so sync overhead is visible on its own.
@@ -161,6 +188,46 @@ class _TrainSession:
         self._first_report = self._step_start
 
     # -- worker-side API --------------------------------------------------
+    def abort(self, reason: str = "gang repair"):
+        """Driver-initiated abort (via TrainWorker.abort_run): unblocks
+        report()'s barrier and result-queue waits so the loop thread
+        unwinds with TrainingAborted while the ACTOR stays warm."""
+        self.abort_reason = reason
+        self.aborted.set()
+        from ray_tpu import collective
+
+        collective.abort_collective_group(self.group_name)
+
+    def _check_abort(self):
+        if self.aborted.is_set():
+            raise TrainingAborted(self.abort_reason or "aborted")
+
+    def _writer(self) -> CheckpointWriter:
+        if self._ckpt_writer is None:
+            self._ckpt_writer = CheckpointWriter(
+                self.ctx.world_rank, self.ctx.world_size,
+                fault_hook=_ckpt_fault_hook,
+            )
+        return self._ckpt_writer
+
+    def finish_checkpoints(self, timeout: float = 120.0):
+        """Drain pending async uploads (clean loop exit / teardown): a
+        fit() that returned must mean the last checkpoint is durable."""
+        w = self._ckpt_writer
+        if w is None:
+            return
+        drained = w.drain(timeout)
+        # Park the writer thread for good either way — repair-in-place
+        # keeps this actor warm, and the NEXT incarnation builds its own
+        # writer; without stop() every recovery would leak one thread.
+        w.stop()
+        self._ckpt_writer = None
+        if not drained:
+            raise RuntimeError(
+                f"async checkpoint uploads still pending after {timeout}s"
+            )
+        w.check()
+
     def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
         from ray_tpu import collective
 
@@ -169,47 +236,102 @@ class _TrainSession:
         tags = _ctx_tags(self.ctx)
         m.step_wall_ms.observe((t_report - self._step_start) * 1000.0, tags)
         m.reports.inc(1, tags)
+        self._check_abort()
         persisted = None
+        staging = None
         if checkpoint is not None:
             from ray_tpu.utils import cloudfs
 
             dest = cloudfs.join(
                 self.ctx.storage_path, f"checkpoint_{self.ckpt_seq:06d}"
             )
-            cloudfs.makedirs(dest)
-            # Every rank copies its files into the shared checkpoint dir
-            # (sharded checkpoints: orbax writes disjoint per-host files;
-            # reference: storage.py:508 persist_current_checkpoint —
-            # cloudfs uploads when storage_path is a gs://-style URI).
-            if cloudfs.normalize(checkpoint.path) != cloudfs.normalize(dest):
-                cloudfs.copy_dir(checkpoint.path, dest)
+            if self.checkpoint_async:
+                # Non-blocking persistence: the step pays only for a
+                # local host-side snapshot; upload + keep-K + commit
+                # markers run on the writer thread (still surfaces
+                # upload errors — on the NEXT report, via submit()).
+                import tempfile
+
+                staging = tempfile.mkdtemp(
+                    prefix=f"rt_ckpt_stage_r{self.ctx.world_rank}_"
+                )
+                cloudfs.copy_dir(checkpoint.path, staging)
+            else:
+                cloudfs.makedirs(dest)
+                # Every rank copies its files into the shared checkpoint
+                # dir (sharded checkpoints: orbax writes disjoint
+                # per-host files; reference: storage.py:508
+                # persist_current_checkpoint — cloudfs uploads when
+                # storage_path is a gs://-style URI).
+                if cloudfs.normalize(checkpoint.path) != cloudfs.normalize(dest):
+                    cloudfs.copy_dir(checkpoint.path, dest)
             persisted = dest
         self.ckpt_seq += 1
-        # Rank synchronization barrier (reference session.py:403 semantics).
-        collective.barrier(self.group_name)
+        # Rank synchronization barrier (reference session.py:403
+        # semantics). A peer death mid-barrier surfaces as
+        # ConnectionError; when the driver aborted us first, classify as
+        # the abort (repair), not a transport failure.
+        try:
+            collective.barrier(self.group_name)
+        except BaseException as e:
+            # The writer never saw this snapshot — without the cleanup a
+            # gang repair would leak one model-sized staging dir per
+            # surviving rank per recovery.
+            if staging is not None:
+                import shutil
+
+                shutil.rmtree(staging, ignore_errors=True)
+            if isinstance(e, ConnectionError):
+                self._check_abort()
+            raise
         if persisted is not None:
-            # Past the barrier every rank has persisted its shard; the marker
-            # makes the checkpoint discoverable on restart even if the driver
-            # never consumes this report (rank death races the queue).
-            if self.ctx.world_rank == 0:
+            if self.checkpoint_async:
+                # Past the barrier every rank has SNAPSHOTTED (not yet
+                # uploaded): hand the upload to the writer; rank 0's
+                # writer commits .complete only after every rank's
+                # upload marker lands (checkpoint.CheckpointWriter).
+                try:
+                    self._writer().submit(staging, persisted)
+                except BaseException:
+                    # submit() surfaces a PREVIOUS upload's error before
+                    # enqueueing — this snapshot was never handed off, so
+                    # nothing else will ever delete it.
+                    import shutil
+
+                    shutil.rmtree(staging, ignore_errors=True)
+                    raise
+            elif self.ctx.world_rank == 0:
+                # Sync path: past the barrier every rank has persisted;
+                # the marker makes the checkpoint discoverable on
+                # restart even if the driver never consumes this report
+                # (rank death races the queue).
                 from ray_tpu.utils import cloudfs
 
-                cloudfs.touch(cloudfs.join(persisted, ".complete"))
+                from ray_tpu.train.checkpoint import COMPLETE_MARKER
+
+                cloudfs.touch(cloudfs.join(persisted, COMPLETE_MARKER))
             self.latest_checkpoint = persisted
         # Block until the driver consumed the previous result — keeps
-        # training paced with the driver loop.
-        self.result_queue.put(
-            {
-                "metrics": metrics,
-                "checkpoint": persisted,
-                "ckpt_index": self.ckpt_seq - 1,
-            }
-        )
+        # training paced with the driver loop (abort-aware: the driver
+        # stops consuming during a gang repair).
+        item = {
+            "metrics": metrics,
+            "checkpoint": persisted,
+            "ckpt_index": self.ckpt_seq - 1,
+        }
+        while True:
+            self._check_abort()
+            try:
+                self.result_queue.put(item, timeout=0.2)
+                break
+            except queue.Full:
+                continue
         now = time.monotonic()
         m.report_ms.observe((now - t_report) * 1000.0, tags)
+        self._steps_reported += 1
         elapsed = now - self._first_report
         if elapsed > 0:
-            m.steps_per_s.set(self.ckpt_seq / elapsed, tags)
+            m.steps_per_s.set(self._steps_reported / elapsed, tags)
         self._step_start = now
 
     def get_checkpoint(self) -> Optional[Checkpoint]:

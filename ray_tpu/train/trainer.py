@@ -10,8 +10,12 @@ Train-on-Tune).
 SPMD note (SURVEY.md §7 hard parts): on a TPU pod each worker is one host
 of the slice; the gang is placed STRICT_PACK/SPREAD via the scaling
 config's placement strategy, and a worker failure fails the step for the
-whole mesh — so recovery is whole-gang restart from the last checkpoint,
-which is exactly what FailureConfig.max_failures drives here.
+whole mesh. Recovery (FailureConfig.max_failures budget) is ELASTIC:
+surviving workers stay warm while the executor repairs in place — a
+replacement rejoins at the same world size within
+FailureConfig.elastic_grace_s, or the gang re-meshes down to
+ScalingConfig.min_workers and resumes from the last COMPLETE checkpoint
+at the smaller data-parallel width (backend_executor.restart).
 """
 from __future__ import annotations
 
@@ -40,6 +44,10 @@ class Result:
     path: str
     error: Optional[BaseException] = None
     metrics_history: List[dict] = field(default_factory=list)
+    # One entry per gang recovery this run absorbed: {mode: rejoin |
+    # remesh | rebuild | none, detect_ms, repair_ms, resume_ms,
+    # world_size, dead_ranks, ts} (backend_executor.recovery_log).
+    recoveries: List[dict] = field(default_factory=list)
 
     @property
     def best_checkpoints(self):
@@ -74,14 +82,16 @@ class DataParallelTrainer:
         # pipelined ingest path — reference: DataParallelTrainer datasets).
         self._datasets: Dict[str, Any] = dict(datasets or {})
 
-    def _make_shard_actors(self) -> Dict[str, Any]:
+    def _make_shard_actors(self, num_splits: int) -> Dict[str, Any]:
         if not self._datasets:
             return {}
         from ray_tpu.data.shard import create_shard_coordinator
 
-        n = self.scaling_config.num_workers
+        # num_splits follows the EXECUTOR's current world size, not the
+        # configured one — an elastic re-mesh resumes at fewer ranks and
+        # every dataset must re-split to the new width.
         return {
-            name: create_shard_coordinator(ds, n)
+            name: create_shard_coordinator(ds, num_splits)
             for name, ds in self._datasets.items()
         }
 
@@ -114,6 +124,8 @@ class DataParallelTrainer:
             experiment_name=experiment_name,
             storage_path=storage,
             max_failures=failure_cfg.max_failures,
+            elastic_grace_s=failure_cfg.elastic_grace_s,
+            checkpoint_async=ckpt_cfg.async_upload,
         )
 
         last_metrics: Optional[dict] = None
@@ -122,19 +134,35 @@ class DataParallelTrainer:
         try:
             executor.start()
             while True:
+                # manager.latest only yields COMPLETE checkpoints: an
+                # async upload torn by the very death we are recovering
+                # from is skipped, never resumed into.
                 latest = manager.latest.checkpoint.path if manager.latest else None
-                # Fresh shard coordinators per attempt: a gang restart
-                # replays the datasets from the beginning (streams are
-                # single-pass; recovery restarts the epoch).
+                # Fresh shard coordinators per attempt, split to the
+                # executor's CURRENT width (a re-mesh resumes narrower):
+                # a gang restart replays the datasets from the beginning
+                # (streams are single-pass; recovery restarts the epoch).
                 self._stop_shard_actors()
-                self._shard_actors = self._make_shard_actors()
-                executor.setup_sessions(latest, dataset_shards=self._shard_actors)
-                run_refs = executor.start_training(self._train_fn, self._config)
                 from ray_tpu.train.session import train_metrics
 
                 tmetrics = train_metrics()
                 run_tag = {"run": experiment_name}
+                run_refs = None
+                # setup_sessions/start_training sit INSIDE the try: a
+                # gang member dying mid-repair (double fault) must
+                # consume a retry like any other failure, not escape
+                # fit() as a raw exception.
                 try:
+                    self._shard_actors = self._make_shard_actors(
+                        executor.world_size
+                    )
+                    executor.setup_sessions(
+                        latest, dataset_shards=self._shard_actors,
+                        ckpt_index_start=manager.next_index,
+                    )
+                    run_refs = executor.start_training(
+                        self._train_fn, self._config
+                    )
                     while True:
                         t_wait = time.monotonic()
                         results = executor.next_results(run_refs)
@@ -161,10 +189,17 @@ class DataParallelTrainer:
                     logger.warning("training failed: %s", e)
                     if executor.can_retry():
                         manager.sync_from_storage()
-                        executor.restart()
+                        executor.restart(run_refs=run_refs)
                         continue
+                    lf = executor.last_failure
+                    where = (
+                        f" (last failure: rank {lf.rank} on node "
+                        f"{lf.node[:12] or '?'}: {lf.reason})"
+                        if lf is not None else ""
+                    )
                     error = TrainingFailedError(
-                        f"training failed after {executor._failures - 1} retries"
+                        f"training failed after {executor.failures} "
+                        f"failure(s); root cause: {e!r}{where}"
                     )
                     error.__cause__ = e
                     break
@@ -179,6 +214,7 @@ class DataParallelTrainer:
             path=storage,
             error=error,
             metrics_history=history,
+            recoveries=list(executor.recovery_log),
         )
 
 

@@ -48,6 +48,8 @@ class TrainWorker:
         jax_distributed: bool = False,
         dataset_shards: Optional[Dict[str, Any]] = None,
         data_context: Optional[Dict[str, Any]] = None,
+        checkpoint_async: bool = False,
+        ckpt_index_start: int = 0,
     ):
         from ray_tpu import collective
 
@@ -57,7 +59,11 @@ class TrainWorker:
             from ray_tpu.data.context import DataContext
 
             DataContext.apply_overrides(data_context)
-        self._session = _TrainSession(ctx, group_name, latest_checkpoint)
+        self._session = _TrainSession(
+            ctx, group_name, latest_checkpoint,
+            checkpoint_async=checkpoint_async,
+            ckpt_index_start=ckpt_index_start,
+        )
         self._session.dataset_shards = dict(dataset_shards or {})
         _set_session(self._session)
         if jax_distributed:
@@ -81,6 +87,9 @@ class TrainWorker:
         assert session is not None, "setup_session must run first"
         try:
             _call_train_fn(train_fn, config)
+            # A loop that RETURNED must mean its checkpoints are durable:
+            # drain pending async uploads before declaring success.
+            session.finish_checkpoints()
         except BaseException as e:  # noqa: BLE001 — surfaced to the driver
             session.error = e
             session.finished.set()
@@ -101,14 +110,32 @@ class TrainWorker:
         assert self._session is not None
         return self._session.next_result()
 
+    def abort_run(self, reason: str = "gang repair"):
+        """Break the (possibly barrier-blocked) training loop out NOW,
+        keeping this actor warm for the repaired gang. Idempotent; safe
+        when no loop is running."""
+        session = self._session
+        if session is None:
+            return False
+        session.abort(reason)
+        return True
+
     def teardown(self):
+        """Dismantle the session (and its collective/jax runtime
+        memberships). The ACTOR survives — repair-in-place calls
+        setup_session again on the warm process instead of respawning."""
         from ray_tpu import collective
 
         if getattr(self, "_jax_distributed", False):
             from ray_tpu.train.jax_rendezvous import shutdown_jax_distributed
 
             shutdown_jax_distributed()
+            self._jax_distributed = False
         if self._session is not None:
+            try:
+                self._session.finish_checkpoints(timeout=30.0)
+            except Exception as e:  # noqa: BLE001 — teardown is best-effort
+                logger.warning("checkpoint drain at teardown failed: %s", e)
             try:
                 collective.destroy_collective_group(self._session.group_name)
             except Exception:
@@ -126,6 +153,9 @@ class WorkerMetadata:
     world_rank: int = -1
     local_rank: int = -1
     node_rank: int = -1
+    # PG bundle this worker was spawned into (stable across the rank
+    # re-sort; a replacement reuses the dead worker's bundle).
+    bundle_index: int = -1
 
 
 class WorkerGroup:
@@ -141,31 +171,111 @@ class WorkerGroup:
     ):
         self.num_workers = num_workers
         self.workers: List[WorkerMetadata] = []
-        remote_cls = ray_tpu.remote(TrainWorker)
-        opts: Dict[str, Any] = {
+        self._remote_cls = ray_tpu.remote(TrainWorker)
+        self._pg = placement_group
+        self._opts: Dict[str, Any] = {
             "max_concurrency": max_concurrency,
             "num_cpus": resources_per_worker.get("CPU", 1),
         }
         extra = {k: v for k, v in resources_per_worker.items() if k != "CPU"}
         if extra:
-            opts["resources"] = extra
-        handles = []
-        for i in range(num_workers):
-            o = dict(opts)
-            if placement_group is not None:
-                from ray_tpu.util.scheduling_strategies import (
-                    PlacementGroupSchedulingStrategy,
-                )
-
-                o["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
-                    placement_group=placement_group, placement_group_bundle_index=i
-                )
-            handles.append(remote_cls.options(**o).remote())
+            self._opts["resources"] = extra
+        handles = [self._spawn(i) for i in range(num_workers)]
         infos = ray_tpu.get([h.node_info.remote() for h in handles])
         self.workers = [
-            WorkerMetadata(actor=h, node_id=i["node_id"], pid=i["pid"])
-            for h, i in zip(handles, infos)
+            WorkerMetadata(actor=h, node_id=info["node_id"], pid=info["pid"],
+                           bundle_index=b)
+            for b, (h, info) in enumerate(zip(handles, infos))
         ]
+        self._assign_ranks()
+
+    def _spawn(self, bundle_index: int):
+        """One TrainWorker actor handle (not yet ready) on this group's
+        options — bundle-pinned when the group is PG-placed."""
+        o = dict(self._opts)
+        if self._pg is not None:
+            from ray_tpu.util.scheduling_strategies import (
+                PlacementGroupSchedulingStrategy,
+            )
+
+            o["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                placement_group=self._pg,
+                placement_group_bundle_index=bundle_index,
+            )
+        return self._remote_cls.options(**o).remote()
+
+    # -- elastic repair (backend_executor.repair) ------------------------
+    def probe(self, timeout: float = 5.0) -> List[bool]:
+        """Liveness per current worker: ping each actor, False for any
+        whose ping errors or misses the deadline (SIGKILLed host: the
+        ping ref resolves with ActorDiedError ~immediately)."""
+        refs = [w.actor.node_info.remote() for w in self.workers]
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=timeout)
+        alive = []
+        for r in refs:
+            try:
+                ray_tpu.get(r, timeout=0.1)
+                alive.append(True)
+            except Exception:  # noqa: BLE001 — dead/hung either way
+                alive.append(False)
+        return alive
+
+    def replace(self, indices: List[int], grace_s: float) -> bool:
+        """Spawn replacement workers for the members at list positions
+        ``indices`` (reusing each dead member's PG bundle) and wait up to
+        ``grace_s`` for ALL to come up. On success the group keeps its
+        world size (rejoin); on timeout the spawns are killed and the
+        group is untouched (caller decides re-mesh vs rebuild)."""
+        spawned = {i: self._spawn(self.workers[i].bundle_index) for i in indices}
+        refs = {i: h.node_info.remote() for i, h in spawned.items()}
+        ready, _ = ray_tpu.wait(
+            list(refs.values()), num_returns=len(refs), timeout=grace_s
+        )
+        infos = {}
+        try:
+            if len(ready) < len(refs):
+                raise TimeoutError("replacement workers not placeable in time")
+            infos = {i: ray_tpu.get(r, timeout=5) for i, r in refs.items()}
+        except Exception:  # noqa: BLE001 — timeout, or a replacement died arriving
+            for h in spawned.values():
+                try:
+                    ray_tpu.kill(h)
+                # best-effort kill of an abandoned spawn; it may not exist
+                # yet  # ray-tpu: lint-ignore[RTL006]
+                except Exception:  # noqa: BLE001
+                    pass
+            return False
+        for i, h in spawned.items():
+            self.workers[i] = WorkerMetadata(
+                actor=h, node_id=infos[i]["node_id"], pid=infos[i]["pid"],
+                bundle_index=self.workers[i].bundle_index,
+            )
+        self._assign_ranks()
+        return True
+
+    def shrink(self, dead_indices: List[int]):
+        """Drop dead members and re-rank the survivors (elastic
+        re-mesh). The caller has checked the floor (min_workers).
+        The dead members' PG bundles are RETIRED, not left rescheduling:
+        an orphan bundle would otherwise commit (and reserve resources
+        forever) the moment cluster capacity returns."""
+        dead = set(dead_indices)
+        if self._pg is not None:
+            bundles = [
+                self.workers[i].bundle_index for i in dead
+                if self.workers[i].bundle_index >= 0
+            ]
+            if bundles:
+                from ray_tpu.core.api import _require_worker
+
+                try:
+                    _require_worker().pg_shrink(self._pg.id, bundles)
+                except Exception as e:  # noqa: BLE001 — repair continues
+                    logger.warning("pg_shrink failed: %s", e)
+        self.workers = [w for i, w in enumerate(self.workers) if i not in dead]
+        self.num_workers = len(self.workers)
+        for w in self.workers:
+            w.world_rank = -1
         self._assign_ranks()
 
     def _assign_ranks(self):

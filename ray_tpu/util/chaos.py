@@ -6,9 +6,24 @@ killer actors that randomly destroy cluster components while a workload
 runs, and release/nightly_tests/setup_chaos.py which installs them for
 chaos suites. Same shape here: killer actors driven by an interval loop,
 started/stopped around a workload, reporting what they killed.
+
+Beyond the SIGKILL actors, this module owns the DETERMINISTIC side of
+chaos: a seeded :class:`FaultSchedule` that the RPC layer consults on
+every frame (reference analogue: the reference's chaos nightly tests
+shape network faults with k8s traffic control — here the injection point
+is the framework's own RPC peers, so drops/delays/errors/partitions are
+exact and replayable). Install a plan programmatically
+(:func:`install_fault_plan`) or via the ``RAY_TPU_FAULT_PLAN`` env var
+(JSON, or ``@/path/to/plan.json``) which every process entry point
+loads — spawned workers and agents inherit it. Decisions depend only on
+the per-rule match counters and the plan's seed, never on wall-clock, so
+two runs issuing the same RPC sequence inject the identical timeline
+(verified by :func:`injection_log`).
 """
 from __future__ import annotations
 
+import fnmatch
+import json
 import logging
 import os
 import random
@@ -16,7 +31,8 @@ import signal
 import socket
 import threading
 import time
-from typing import List, Optional
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
 
 logger = logging.getLogger("ray_tpu.chaos")
 
@@ -133,3 +149,199 @@ def get_and_run_worker_killer(
     killer = WorkerKillerActor.remote(kill_interval_s, max_kills, seed)
     ray_tpu.get(killer.run.remote())
     return killer
+
+
+# ===========================================================================
+# Deterministic RPC-level fault injection
+# ===========================================================================
+
+class InjectedFaultError(ConnectionError):
+    """An error deliberately injected by a :class:`FaultSchedule` rule.
+
+    Subclasses ConnectionError so the injected failure walks the same
+    recovery paths a real transport fault would (reconnect/backoff/
+    gang-repair), not a user-error path."""
+
+    def __init__(self, detail: str = "injected fault"):
+        self.detail = detail
+        super().__init__(detail)
+
+    def __reduce__(self):
+        return (InjectedFaultError, (self.detail,))
+
+
+@dataclass
+class FaultRule:
+    """One injection rule. Matches RPC frames by (method glob, direction,
+    peer-label substring); fires ``after`` skipped matches, at most
+    ``count`` times (0 = unlimited), with seeded ``probability``.
+
+    Actions: ``delay`` (delay_ms before the frame proceeds), ``drop``
+    (the frame silently vanishes — a dropped request leaves the caller
+    waiting on its timeout, exactly like a lost packet), ``error``
+    (request fails fast with :class:`InjectedFaultError`). A one-way
+    partition is a ``drop`` rule with ``method="*"`` scoped to one
+    direction/peer; agent-level slow-node throttling is a ``delay`` rule
+    with ``method="*"`` installed on that node's processes."""
+
+    method: str = "*"
+    direction: str = "both"  # "in" (frames we receive) | "out" | "both"
+    peer: str = ""  # substring of the connection label ("" = any)
+    action: str = "delay"  # "delay" | "drop" | "error"
+    delay_ms: float = 0.0
+    error: str = "injected fault"
+    after: int = 0
+    count: int = 0
+    probability: float = 1.0
+    # runtime state (not part of the plan)
+    _matched: int = field(default=0, repr=False, compare=False)
+    _fired: int = field(default=0, repr=False, compare=False)
+
+
+class FaultSchedule:
+    """A seeded, replayable injection plan the RPC layer consults.
+
+    Decisions are a pure function of (seed, per-rule match counters):
+    two processes issuing the same RPC sequence against the same plan
+    inject the identical timeline. The bounded :meth:`log` records every
+    injection (seq, method, direction, peer, rule index, action) for
+    replay verification."""
+
+    def __init__(self, rules: List[FaultRule], seed: int = 0):
+        self.seed = seed
+        self.rules = list(rules)
+        self._rngs = [random.Random(f"{seed}:{i}") for i in range(len(self.rules))]
+        self._lock = threading.Lock()
+        self._seq = 0
+        import collections
+
+        self._log: "collections.deque[dict]" = collections.deque(maxlen=10000)
+
+    @classmethod
+    def from_plan(cls, plan: Dict[str, Any]) -> "FaultSchedule":
+        rules = [
+            FaultRule(**{k: v for k, v in r.items() if not k.startswith("_")})
+            for r in plan.get("rules", [])
+        ]
+        return cls(rules, seed=int(plan.get("seed", 0)))
+
+    @classmethod
+    def from_json(cls, raw: str) -> "FaultSchedule":
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                raw = f.read()
+        return cls.from_plan(json.loads(raw))
+
+    def intercept(self, method: str, direction: str, label: str = "") -> Optional[dict]:
+        """First matching rule's action for this frame, or None. Applies
+        after/count/probability bookkeeping under the lock."""
+        with self._lock:
+            for i, rule in enumerate(self.rules):
+                if rule.direction not in ("both", direction):
+                    continue
+                if rule.peer and rule.peer not in (label or ""):
+                    continue
+                if not fnmatch.fnmatchcase(method, rule.method):
+                    continue
+                rule._matched += 1
+                if rule._matched <= rule.after:
+                    continue
+                if rule.count and rule._fired >= rule.count:
+                    continue
+                if rule.probability < 1.0 and self._rngs[i].random() >= rule.probability:
+                    continue
+                rule._fired += 1
+                self._seq += 1
+                entry = {
+                    "seq": self._seq,
+                    "method": method,
+                    "direction": direction,
+                    "peer": label,
+                    "rule": i,
+                    "action": rule.action,
+                }
+                self._log.append(entry)
+                if rule.action == "delay":
+                    return {"action": "delay", "delay_s": rule.delay_ms / 1000.0}
+                if rule.action == "drop":
+                    return {"action": "drop"}
+                return {
+                    "action": "error",
+                    "error": InjectedFaultError(
+                        f"{rule.error} (rule {i}: {rule.method} {direction})"
+                    ),
+                }
+        return None
+
+    def log(self) -> List[dict]:
+        with self._lock:
+            return list(self._log)
+
+
+_install_lock = threading.Lock()
+_env_loaded = False
+
+
+def install_fault_plan(plan) -> Optional[FaultSchedule]:
+    """Install a fault plan in THIS process (None clears). Accepts a
+    FaultSchedule, a plan dict ({"seed": .., "rules": [..]}), or a JSON
+    string / ``@path``. Returns the active schedule."""
+    from ray_tpu.utils import rpc
+
+    if plan is None:
+        sched = None
+    elif isinstance(plan, FaultSchedule):
+        sched = plan
+    elif isinstance(plan, dict):
+        sched = FaultSchedule.from_plan(plan)
+    else:
+        sched = FaultSchedule.from_json(str(plan))
+    rpc.set_fault_schedule(sched)
+    if sched is not None:
+        logger.warning(
+            "fault plan installed: %d rule(s), seed %d (pid %d)",
+            len(sched.rules), sched.seed, os.getpid(),
+        )
+    return sched
+
+
+def active_fault_schedule() -> Optional[FaultSchedule]:
+    from ray_tpu.utils import rpc
+
+    return rpc.get_fault_schedule()
+
+
+def injection_log() -> List[dict]:
+    """This process's injection timeline (empty when no plan active)."""
+    sched = active_fault_schedule()
+    return sched.log() if sched is not None else []
+
+
+def install_fault_plan_from_env() -> Optional[FaultSchedule]:
+    """Load ``RAY_TPU_FAULT_PLAN`` once per process (entry points call
+    this; spawned workers/agents inherit the env var)."""
+    global _env_loaded
+    with _install_lock:
+        if _env_loaded:
+            return active_fault_schedule()
+        _env_loaded = True
+        raw = os.environ.get("RAY_TPU_FAULT_PLAN", "")
+        if not raw:
+            return None
+        try:
+            return install_fault_plan(raw)
+        except Exception as e:  # noqa: BLE001 — a bad plan must not kill the process
+            logger.error("RAY_TPU_FAULT_PLAN unparseable: %s", e)
+            return None
+
+
+def install_plan_on_node(node_id_hex: str, plan: Optional[dict]) -> bool:
+    """Install (or clear, plan=None) a fault plan on a RUNNING node
+    agent — the runtime path for agent-level slow-node throttling:
+    ``install_plan_on_node(nid, {"rules": [{"method": "*",
+    "direction": "in", "action": "delay", "delay_ms": 200}]})``."""
+    from ray_tpu.core.api import _require_worker
+
+    return _require_worker()._call(
+        "chaos_install", node_id_hex, json.dumps(plan) if plan else ""
+    )

@@ -352,6 +352,37 @@ def summarize_rl() -> dict:
     }
 
 
+def summarize_train() -> dict:
+    """Train rollup from the controller's metric snapshot: step/report
+    pacing plus the elastic-recovery counters (``ray-tpu summary
+    train``) — gang member deaths observed, recoveries by mode
+    (rejoin / remesh / rebuild / none), and the MTTR phase breakdown
+    (detect → repair → resume latencies)."""
+    snap = metrics_snapshot()
+
+    def counter(name: str) -> float:
+        return sum(v for _t, v in (snap.get(name) or {}).get("series", []))
+
+    def counter_by(name: str, tag: str) -> dict:
+        out: dict = {}
+        for tags, v in (snap.get(name) or {}).get("series", []):
+            key = dict(tuple(t) for t in tags).get(tag, "")
+            out[key] = out.get(key, 0.0) + v
+        return out
+
+    return {
+        "reports_total": counter("train_reports_total"),
+        "step_wall_ms": _hist_rollup(snap.get("train_step_wall_ms")),
+        "report_ms": _hist_rollup(snap.get("train_report_ms")),
+        "driver_wait_ms": _hist_rollup(snap.get("train_driver_wait_ms")),
+        "worker_deaths": counter("train_worker_deaths_total"),
+        "recoveries": counter_by("train_recoveries_total", "mode"),
+        "detect_ms": _hist_rollup(snap.get("train_detect_ms")),
+        "repair_ms": _hist_rollup(snap.get("train_repair_ms")),
+        "resume_ms": _hist_rollup(snap.get("train_resume_ms")),
+    }
+
+
 def summarize_data() -> list:
     """Per-operator stats of this process's most recent Dataset execution
     (reference: the dashboard data module's per-op metrics)."""

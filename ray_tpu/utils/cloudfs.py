@@ -53,6 +53,27 @@ def _fs(path: str):
     return fs, fs_path
 
 
+def _throttle(path: str, nbytes: int) -> None:
+    """Bench/test seam: pace writes landing under
+    ``RAY_TPU_CLOUDFS_THROTTLE_PATH`` to ``RAY_TPU_CLOUDFS_THROTTLE_MBPS``
+    megabytes/s — models a bandwidth-bound persistent store (the thing a
+    real ``gs://`` storage_path is) next to fast host disk, so the
+    non-blocking-checkpoint A/B measures a real gap on one box. Inactive
+    unless both variables are set; never throttles paths outside the
+    prefix (staging snapshots stay at disk speed)."""
+    prefix = os.environ.get("RAY_TPU_CLOUDFS_THROTTLE_PATH", "")
+    if not prefix or not normalize(path).startswith(normalize(prefix)):
+        return
+    try:
+        mbps = float(os.environ.get("RAY_TPU_CLOUDFS_THROTTLE_MBPS", "") or 0)
+    except ValueError:
+        return
+    if mbps > 0:
+        import time
+
+        time.sleep(nbytes / (mbps * 1024 * 1024))
+
+
 def join(base: str, *parts: str) -> str:
     if is_uri(base):
         return "/".join([base.rstrip("/")] + [p.strip("/") for p in parts])
@@ -102,6 +123,7 @@ def write_bytes(path: str, data: bytes) -> None:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "wb") as f:
             f.write(data)
+    _throttle(path, len(data))
 
 
 def read_bytes(path: str) -> bytes:
@@ -148,7 +170,15 @@ def copy_dir(src: str, dest: str) -> None:
     combination (reference: StorageContext.persist_current_checkpoint
     uploads rank-local dirs to cloud storage)."""
     if not is_uri(src) and not is_uri(dest):
-        shutil.copytree(normalize(src), normalize(dest), dirs_exist_ok=True)
+        dest_n = normalize(dest)
+
+        def _copy(s, d, *, follow_symlinks=True):
+            out = shutil.copy2(s, d, follow_symlinks=follow_symlinks)
+            _throttle(dest_n, os.path.getsize(s))
+            return out
+
+        shutil.copytree(normalize(src), dest_n, dirs_exist_ok=True,
+                        copy_function=_copy)
         return
     if not is_uri(src) and is_uri(dest):
         fs, p = _fs(dest)

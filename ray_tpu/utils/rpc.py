@@ -25,6 +25,38 @@ logger = logging.getLogger(__name__)
 
 _REQ, _RESP, _ERR, _NOTIFY = 0, 1, 2, 3
 _HDR = struct.Struct("<Q")
+
+# ---------------------------------------------------------------------------
+# Deterministic fault injection (ray_tpu.util.chaos.FaultSchedule): when a
+# schedule is installed, every method-addressed frame (request/notify) is
+# offered to it before send ("out") and before dispatch ("in") — matched
+# rules delay, drop, or fail the frame. None (the default) costs one
+# attribute check per frame. The chaos module owns plan parsing and pushes
+# the schedule here to keep this module dependency-free.
+_fault_schedule = None
+# Control frames that manage injection itself are exempt — a drop-all
+# partition must still be clearable at runtime. Both legs: the driver→
+# controller fan-out request AND the controller→agent install.
+_FAULT_EXEMPT = frozenset({"chaos_install", "install_fault_plan"})
+
+
+def set_fault_schedule(schedule) -> None:
+    global _fault_schedule
+    _fault_schedule = schedule
+
+
+def get_fault_schedule():
+    return _fault_schedule
+
+
+def _intercept(method: str, direction: str, label: str):
+    if _fault_schedule is None or method in _FAULT_EXEMPT:
+        return None
+    try:
+        return _fault_schedule.intercept(method, direction, label)
+    except Exception:  # noqa: BLE001 — a broken plan must not break RPC
+        logger.exception("fault schedule intercept failed")
+        return None
 # Out-of-band frame marker: frames normally start with pickle's 0x80
 # protocol opcode; a 0x01 first byte instead means
 # [0x01][u32 head_len][head pickle (kind, msg_id)][raw payload bytes] —
@@ -76,6 +108,9 @@ class Peer:
         self.backlog_limit = 8 * 1024 * 1024
         # Arbitrary metadata the handler may attach (worker id, node id, ...).
         self.meta: dict[str, Any] = {}
+        # Human label for fault-injection peer matching ("controller",
+        # "worker:<hex8>", ...); set by whoever knows the identity.
+        self.label: str = ""
 
     def start(self):
         self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
@@ -145,8 +180,30 @@ class Peer:
             return fut
         msg_id = next(self._ids)
         self._pending[msg_id] = fut
+        act = _intercept(method, "out", self.label)
+        if act is not None:
+            kind = act["action"]
+            if kind == "error":
+                self._pending.pop(msg_id, None)
+                fut.set_exception(act["error"])
+                return fut
+            if kind == "drop":
+                # The frame vanishes like a lost packet: the future stays
+                # pending (caller's timeout governs) and resolves with
+                # ConnectionLost if the connection later closes.
+                return fut
+            asyncio.get_running_loop().create_task(
+                self._enqueue_delayed((_REQ, msg_id, method, (args, kwargs)),
+                                      act["delay_s"])
+            )
+            return fut
         self._enqueue_frame((_REQ, msg_id, method, (args, kwargs)))
         return fut
+
+    async def _enqueue_delayed(self, frame: tuple, delay_s: float):
+        await asyncio.sleep(delay_s)
+        if not self._closed:
+            self._enqueue_frame(frame)
 
     async def call(self, method: str, *args, **kwargs) -> Any:
         return await self.call_nowait(method, *args, **kwargs)
@@ -154,6 +211,13 @@ class Peer:
     async def notify(self, method: str, *args, **kwargs):
         if self._closed:
             return
+        act = _intercept(method, "out", self.label)
+        if act is not None:
+            if act["action"] in ("drop", "error"):
+                return  # fire-and-forget: an injected failure is a drop
+            await asyncio.sleep(act["delay_s"])
+            if self._closed:
+                return
         self._enqueue_frame((_NOTIFY, 0, method, (args, kwargs)))
         if not self._drained.is_set():
             # Backpressure: a fast notifier must not grow the buffer
@@ -199,6 +263,28 @@ class Peer:
     def _dispatch(self, msg_id, method, payload):
         """Run the handler INLINE when it is synchronous (or returns a
         Future) — per-request task creation only for true coroutines."""
+        if _fault_schedule is not None:
+            act = _intercept(method, "in", self.label)
+            if act is not None:
+                kind = act["action"]
+                if kind == "drop":
+                    return  # request vanishes: no response, caller times out
+                if kind == "error":
+                    self._respond_err(msg_id, method, act["error"])
+                    return
+                asyncio.get_running_loop().create_task(
+                    self._dispatch_delayed(msg_id, method, payload,
+                                           act["delay_s"])
+                )
+                return
+        self._dispatch_now(msg_id, method, payload)
+
+    async def _dispatch_delayed(self, msg_id, method, payload, delay_s: float):
+        await asyncio.sleep(delay_s)
+        if not self._closed:
+            self._dispatch_now(msg_id, method, payload)
+
+    def _dispatch_now(self, msg_id, method, payload):
         args, kwargs = payload
         try:
             fn = getattr(self.handler, "rpc_" + method, None)
@@ -254,6 +340,7 @@ class Peer:
         if exc is not None:
             self._respond_err(msg_id, method, exc)
         else:
+            # already-done future (done-callback): no wait  # ray-tpu: lint-ignore[RTL008]
             self._respond(msg_id, method, fut.result())
 
     def _respond_err(self, msg_id, method, e: Exception):
@@ -339,15 +426,33 @@ async def serve(handler_factory: Callable[[], Any] | Any, host: str = "127.0.0.1
     return server, actual_port
 
 
-async def connect(host: str, port: int, handler: Any, retries: int = 60, delay: float = 0.1) -> Peer:
+async def connect(host: str, port: int, handler: Any, retries: int = 60,
+                  delay: float = 0.1, max_delay: float = 2.0,
+                  total_timeout: float = 10.0) -> Peer:
+    """Dial with bounded retry and jittered exponential backoff.
+
+    A fixed retry cadence synchronizes every reconnecting client into
+    thundering-herd waves against a restarting controller; exponential
+    backoff with jitter spreads them out while keeping the first retries
+    fast. Both ``retries`` AND ``total_timeout`` bound the dial — with
+    backed-off waits, the attempt count alone would stretch a dead
+    address from seconds to minutes."""
+    import random as _random
+
     last = None
+    wait = delay
+    deadline = asyncio.get_running_loop().time() + total_timeout
     for _ in range(retries):
         try:
             reader, writer = await asyncio.open_connection(host, port)
             return Peer(reader, writer, handler).start()
         except (ConnectionError, OSError) as e:
             last = e
-            await asyncio.sleep(delay)
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                break
+            await asyncio.sleep(min(wait * (0.5 + _random.random()), remaining))
+            wait = min(wait * 1.5, max_delay)
     raise ConnectionLost(f"could not connect to {host}:{port}: {last}")
 
 

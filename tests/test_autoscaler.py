@@ -42,7 +42,7 @@ def test_autoscaling_cluster_scales_up_and_down():
             "cpu2": {"resources": {"CPU": 2}, "min_workers": 0, "max_workers": 3},
         },
         interval_s=0.5,
-        idle_timeout_s=4.0,
+        idle_timeout_s=2.0,
     )
     try:
         ray_tpu.init(address=cluster.address)
@@ -132,7 +132,7 @@ def test_autoscaler_v2_scales_up_and_down():
         },
         autoscaler_cls=AutoscalerV2,
         interval_s=0.5,
-        idle_timeout_s=4.0,
+        idle_timeout_s=2.0,
     )
     try:
         ray_tpu.init(address=cluster.address)

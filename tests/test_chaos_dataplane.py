@@ -80,7 +80,11 @@ def test_controller_dies_mid_transfer_then_journal_recovery(tmp_path):
     state."""
     cluster = Cluster(
         head_resources={"CPU": 2},
-        system_config={"chaos_fetch_delay_ms": 300},
+        # Short reconnect window: this test asserts the blocked get FAILS
+        # promptly when the controller is gone for good — riding a
+        # restart is test_controller_restart_mid_training's job.
+        system_config={"chaos_fetch_delay_ms": 300,
+                       "controller_reconnect_window_s": 1.0},
     )
     cluster.add_node(num_cpus=2, resources={"src": 1})
     cluster.connect()

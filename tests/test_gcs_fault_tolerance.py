@@ -84,7 +84,7 @@ def test_journal_compact(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def _start_controller(session_dir, port=0):
+def _start_controller(session_dir, port=0, resources=None, config=None):
     from ray_tpu.core.node_agent import child_env
 
     os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
@@ -94,8 +94,8 @@ def _start_controller(session_dir, port=0):
             sys.executable, "-m", "ray_tpu.core.controller",
             "--session-dir", session_dir,
             "--port", str(port),
-            "--resources", json.dumps({"CPU": 4}),
-            "--config", "{}",
+            "--resources", json.dumps(resources or {"CPU": 4}),
+            "--config", json.dumps(config or {}),
         ],
         env=child_env(needs_tpu=False),
         stdout=log,
@@ -111,6 +111,112 @@ def _start_controller(session_dir, port=0):
                 return proc, int(txt)
         time.sleep(0.05)
     raise TimeoutError("controller did not start")
+
+
+def test_controller_restart_mid_training(tmp_path):
+    """Kill -9 the controller while a train gang is between steps
+    (persistence store intact) and restart it on the same port: agents,
+    workers, and the driver all reconnect within
+    ``controller_reconnect_window_s`` and training completes WITHOUT a
+    gang restart — max_failures=0 makes any detect→repair cycle fail the
+    job, so completion proves the restart was invisible to the gang."""
+    import threading
+
+    from ray_tpu.core.cluster_utils import Cluster
+    from ray_tpu.train import (
+        FailureConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    cluster = Cluster(
+        head_resources={"CPU": 1},  # too small for a train bundle
+        system_config={"controller_reconnect_window_s": 30.0},
+    )
+    restarted = {}
+    try:
+        for _ in range(2):
+            cluster.add_node(num_cpus=2)
+        cluster.connect()
+
+        def loop(config):
+            import os as _os
+            import tempfile
+            import time as _time
+
+            import numpy as _np
+
+            from ray_tpu import train
+
+            ctx = train.get_context()
+            start = 0
+            ckpt = train.get_checkpoint()
+            if ckpt is not None:
+                with ckpt.as_directory() as d:
+                    start = int(_np.load(_os.path.join(d, "step.npy"))) + 1
+            for step in range(start, config["steps"]):
+                _time.sleep(0.25)
+                with tempfile.TemporaryDirectory() as d:
+                    if ctx.get_world_rank() == 0:
+                        _np.save(_os.path.join(d, "step.npy"),
+                                 _np.int64(step))
+                    train.report(
+                        {"step": step, "resumed_from": start},
+                        checkpoint=train.Checkpoint.from_directory(d),
+                    )
+
+        trainer = JaxTrainer(
+            loop,
+            train_loop_config={"steps": 8},
+            scaling_config=ScalingConfig(
+                num_workers=2, resources_per_worker={"CPU": 2}
+            ),
+            run_config=RunConfig(
+                name="ctl_restart", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=0),
+            ),
+        )
+        holder = {}
+
+        def run():
+            holder["result"] = trainer.fit()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        # Wait until the gang has committed checkpoint 1 — provably
+        # mid-run, between steps (reports pace at ~0.25s).
+        marker = os.path.join(str(tmp_path), "ctl_restart",
+                              "checkpoint_000001", ".complete")
+        deadline = time.time() + 60
+        while time.time() < deadline and not os.path.exists(marker):
+            time.sleep(0.05)
+        assert os.path.exists(marker), "run never reached the kill point"
+
+        # Hard-kill the control plane; the journal is the persistence
+        # store and stays intact in the session dir.
+        host, port = cluster.address.rsplit(":", 1)
+        cluster._proc.send_signal(signal.SIGKILL)
+        cluster._proc.wait(timeout=10)
+        os.remove(os.path.join(cluster._session_dir, "controller_port"))
+        proc2, port2 = _start_controller(
+            cluster._session_dir, port=int(port), resources={"CPU": 1},
+            config={"controller_reconnect_window_s": 30.0},
+        )
+        restarted["proc"] = proc2
+        cluster._proc = proc2  # cluster.shutdown() reaps the new one
+        assert port2 == int(port)
+
+        t.join(timeout=120)
+        assert not t.is_alive(), "fit() wedged across controller restart"
+        result = holder["result"]
+        assert result.error is None, result.error
+        assert result.metrics["step"] == 7
+        # No gang restart: zero recoveries and no checkpoint resume.
+        assert result.recoveries == []
+        assert result.metrics["resumed_from"] == 0
+    finally:
+        cluster.shutdown()
 
 
 def test_controller_restart_recovers_state(tmp_path):

@@ -60,6 +60,7 @@ def test_baseline_is_small_and_justified():
 def test_every_rule_is_registered():
     from ray_tpu.tools.lint.framework import all_rules
 
-    assert {"RTL001", "RTL002", "RTL003", "RTL004", "RTL005", "RTL006"} <= set(
-        all_rules()
-    )
+    assert {
+        "RTL001", "RTL002", "RTL003", "RTL004", "RTL005", "RTL006",
+        "RTL007", "RTL008",
+    } <= set(all_rules())

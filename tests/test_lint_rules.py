@@ -590,6 +590,115 @@ def test_rtl007_baselined(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# RTL008 unbounded-wait
+
+
+def test_rtl008_positive_zero_arg_waits(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        def drain(fut, q, t, ev, conn):
+            fut.result()
+            q.get()
+            t.join()
+            ev.wait()
+            conn._call("status", timeout=None)
+        """,
+        rules=["RTL008"],
+    )
+    assert rules_of(res) == ["RTL008"] * 5
+
+
+def test_rtl008_negative_bounded_and_non_waits(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import asyncio
+        import contextvars
+
+        _cur = contextvars.ContextVar("cur", default=None)
+
+        async def bounded(ev, q):
+            await asyncio.wait_for(ev.wait(), timeout=5.0)
+            item = await q.get()
+            return item
+
+        def fine(d, fut, t, conn):
+            v = d.get("key")          # dict.get has an argument
+            fut.result(timeout=5.0)
+            t.join(2.0)
+            conn._call("status", timeout=3.0)
+            conn._call("status")      # bare: bounded default applies
+            return v, _cur.get()      # ContextVar read, not a wait
+
+        class Sampler:
+            def result(self):
+                return {}
+
+            def stop(self):
+                return self.result()  # own method, not a Future
+        """,
+        rules=["RTL008"],
+    )
+    assert res.findings == []
+
+
+def test_rtl008_imported_contextvar_not_flagged(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        from ctxmod import _capture
+
+        def snapshot():
+            return _capture.get()
+        """,
+        rules=["RTL008"],
+        extra_files={
+            "ctxmod.py": """
+            import contextvars
+
+            _capture = contextvars.ContextVar("capture", default=None)
+            """,
+        },
+    )
+    assert res.findings == []
+
+
+def test_rtl008_suppressed_and_exempt_dirs(tmp_path):
+    (tmp_path / "scripts").mkdir()
+    (tmp_path / "scripts" / "cli.py").write_text(
+        "def attach(proc):\n    proc.wait()\n"
+    )
+    res = lint_src(
+        tmp_path,
+        """
+        def writer_loop(q):
+            while True:
+                # parks for the next job by design  # ray-tpu: lint-ignore[RTL008]
+                job = q.get()
+                if job is None:
+                    return
+        """,
+        rules=["RTL008"],
+    )
+    assert res.findings == []
+    assert res.suppressed == 1
+
+
+def test_rtl008_baselined(tmp_path):
+    src = """
+    def legacy(fut):
+        return fut.result()
+    """
+    first = lint_src(tmp_path, src, rules=["RTL008"])
+    assert rules_of(first) == ["RTL008"]
+    entries = [baseline_entry(f, "pre-elastic wait, bounded by job runtime")
+               for f in first.findings]
+    res = lint_src(tmp_path, src, rules=["RTL008"], baseline=entries)
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
 # framework: suppression parsing, baseline shrink contract, config
 
 

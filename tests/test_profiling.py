@@ -128,7 +128,9 @@ def test_cpu_profile_attributes_samples_to_task_names(ray_start_regular):
     # before starting the long spins, so the busy workers are in view
     assert _wait_until(lambda: len(state_api.list_workers()) >= 2)
     refs = [
-        spin.options(name="busy_profiled_task").remote(6.0) for _ in range(2)
+        # long enough to span pool-readiness + the 1s profile window;
+        # everything after the profile only needs the tasks FINISHED
+        spin.options(name="busy_profiled_task").remote(3.0) for _ in range(2)
     ]
 
     def busy_running():
