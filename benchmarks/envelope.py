@@ -515,6 +515,102 @@ def bench_train_chaos(scenario: str, steps: int = 12) -> dict:
         shutil.rmtree(storage, ignore_errors=True)
 
 
+def bench_health_actuator(churn: int = 4000) -> dict:
+    """Self-healing arm (the health plane's envelope): a seeded
+    store-pressure plan against a deliberately small store measures the
+    plane's detect→act latency (threshold crossing → ``pressure_spill``
+    acted), the post-act occupancy it leaves, and post-act recovery
+    (every proactively spilled object restores byte-equal); then an
+    on/off A/B of the same put/get churn prices the always-on health
+    plane — the detector sites + engine tick ride the telemetry sweep,
+    so the budget is ≤3% like the other observability legs
+    (``actuator_overhead_ok``). Runs under its own inits (the actuator
+    kill-switch is cluster config)."""
+    import os
+
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    # -- seeded pressure plan: detect→act latency + recovery ------------
+    ray_tpu.init(
+        num_cpus=2,
+        object_store_memory=8 * 1024 * 1024,
+        _system_config={
+            "node_telemetry_interval_ms": 100,
+            "memory_incident_occupancy_pct": 0.5,
+            "health_spill_target_pct": 0.3,
+            "health_action_cooldown_s": 300.0,
+            "profiling_incidents": False,
+        },
+    )
+    try:
+        blobs = [os.urandom(256 * 1024) for _ in range(18)]  # ~56% of cap
+        t0 = time.perf_counter()
+        refs = [ray_tpu.put(b) for b in blobs]
+        acted = None
+        deadline = time.time() + 30
+        while time.time() < deadline and acted is None:
+            for r in state_api.summarize_health().get("actions_recent", []):
+                if (r["actuator"] == "pressure_spill"
+                        and r["outcome"] == "acted"):
+                    acted = r
+                    break
+            if acted is None:
+                time.sleep(0.02)
+        detect_act_ms = (time.perf_counter() - t0) * 1e3
+        assert acted, "pressure_spill never acted"
+        t1 = time.perf_counter()
+        for ref, blob in zip(refs, blobs):
+            assert ray_tpu.get(ref, timeout=30) == blob
+        recover_ms = (time.perf_counter() - t1) * 1e3
+    finally:
+        ray_tpu.shutdown()
+
+    # -- on/off A/B: the price of the always-on plane -------------------
+    payload = b"h" * 4096
+
+    def one_init(enabled: bool) -> float:
+        ray_tpu.init(
+            num_cpus=2,
+            _system_config={
+                "health_actuators": enabled,
+                "node_telemetry_interval_ms": 200,
+                "profiling_incidents": False,
+            },
+        )
+        try:
+            best = 0.0
+            for _ in range(2):  # best-of-2 inside one cluster
+                window = []
+                t0 = time.perf_counter()
+                for _ in range(churn):
+                    window.append(ray_tpu.put(payload))
+                    if len(window) >= 64:
+                        ray_tpu.free(window)
+                        window = []
+                ray_tpu.free(window)
+                best = max(best, churn / (time.perf_counter() - t0))
+            return best
+        finally:
+            ray_tpu.shutdown()
+
+    off = one_init(False)
+    on = one_init(True)
+    overhead = 100.0 * (off - on) / max(off, 1e-9)
+    return {
+        "benchmark": "health_actuator",
+        "detect_act_ms": round(detect_act_ms, 1),
+        "spilled": acted["detail"].get("spilled"),
+        "post_act_occupancy": acted["detail"].get("occupancy"),
+        "recover_ms": round(recover_ms, 1),
+        "churn": churn,
+        "puts_per_s": round(on, 1),
+        "puts_per_s_no_health": round(off, 1),
+        "actuator_overhead_pct": round(max(0.0, overhead), 2),
+        "actuator_overhead_ok": overhead <= 3.0,
+    }
+
+
 def bench_checkpoint_ab(payload_mb: int = 32, steps: int = 3,
                         store_mbps: float = 16.0) -> dict:
     """Non-blocking checkpoint A/B: the same single-worker loop
@@ -643,6 +739,10 @@ def main():
     )
     p.add_argument("--no-chaos", action="store_true",
                    help="skip the train-chaos MTTR + checkpoint A/B arms")
+    p.add_argument("--no-health", action="store_true",
+                   help="skip the self-healing actuator arm")
+    p.add_argument("--health-churn", type=int, default=4000,
+                   help="actuator arm: puts per A/B round")
     p.add_argument("--chaos-steps", type=int, default=12,
                    help="chaos arms: train steps per scenario")
     p.add_argument("--ckpt-mb", type=int, default=32,
@@ -694,6 +794,11 @@ def main():
         row = bench_checkpoint_ab(
             args.ckpt_mb, store_mbps=args.ckpt_store_mbps
         )
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if not args.no_health:
+        # Own inits: the actuator kill-switch is cluster config.
+        row = bench_health_actuator(args.health_churn)
         rows.append(row)
         print(json.dumps(row), flush=True)
     if args.out:

@@ -221,6 +221,36 @@ class Config:
     # the offending log tail attached (0 disables).
     log_error_spike_threshold: int = 50
 
+    # --- self-healing health plane (core/health.py + util/actuators.py) ---
+    # Master switch for the observe→act loop: detector signals (leak /
+    # pressure / storm / error-spike) drive bounded, audited actuators.
+    # Off = detectors keep writing autopsies only (the pre-PR-16 world;
+    # also the envelope A/B knob).
+    health_actuators: bool = True
+    # Comma-separated actuator names forced into dry-run (decision made
+    # + audited + lifecycle event, side effect suppressed). "*" = all.
+    health_dry_run: str = ""
+    # Per-(actuator, target) cooldown: the same remedy never re-fires at
+    # the same target inside this window.
+    health_action_cooldown_s: float = 30.0
+    # Global budget across all actuators (a detector storm must not turn
+    # the health plane into its own denial of service).
+    health_max_actions_per_min: int = 6
+    # error-spike quarantine: hard scheduler avoid of the offending node
+    # (drain semantics) for this long.
+    health_quarantine_s: float = 60.0
+    # store-pressure admission throttle: soft scheduler avoid (node moves
+    # to the back of placement order) for this long.
+    health_throttle_s: float = 30.0
+    # store-pressure proactive spill target: spill LRU entries until the
+    # store's file-tier occupancy is at or below this fraction.
+    health_spill_target_pct: float = 0.6
+    # memory-leak nudge: at most this many holder processes get the
+    # gc/ref-reclamation RPC per action.
+    health_nudge_max_procs: int = 8
+    # Bounded action audit ring in the controller.
+    health_audit_ring: int = 256
+
     # --- profiling (util/profiling.py) ---
     # Default sample rate for on-demand `ray-tpu profile cpu` runs.
     profiling_sample_hz: int = 100

@@ -116,6 +116,12 @@ class RefTracker:
                 self._counts[key] = n
             self._touched.add(key)
 
+    def pending_drops(self) -> int:
+        """Decs queued by ObjectRef.__del__ but not yet folded into the
+        flush — the health plane's gc_nudge reports this as evidence a
+        forced collection actually freed refs."""
+        return len(self._pending_decs)
+
     def drain(self) -> tuple[list[bytes], list[bytes]]:
         """(held, dropped) among ids touched since the last drain."""
         with self._lock:
@@ -133,7 +139,7 @@ def _serialize_parts_capturing(value: Any):
     token = _capture.set([])
     try:
         meta, raws, total = serialize_parts(value)
-        contained = _capture.get()
+        contained = _capture.get()  # ray-tpu: lint-ignore[RTL008] — ContextVar.get(), not a queue: returns immediately
     finally:
         _capture.reset(token)
     if contained:
@@ -1052,6 +1058,18 @@ class _NullHandler:
         from ray_tpu.core import memory_census
 
         return memory_census.dump(limit)
+
+    # The controller broadcasts worker log lines / follow-mode records to
+    # every driver connection; admin connections (cluster_utils, monitor)
+    # have no console to print them to. Drop the pushes silently — a
+    # missing handler would log an ERROR per batch, which the log plane
+    # then ships back as a head-attributed error signature (self-inflicted
+    # spike noise).
+    def rpc_log_batch(self, peer, batch):
+        pass
+
+    def rpc_log_records(self, peer, batch):
+        pass
 
 
 class DriverHandler(_NullHandler):

@@ -409,6 +409,12 @@ class Controller:
         self._log_followers: Dict[rpc.Peer, dict] = {}
         self._record_tailer = None
         self._errors_prev_total = 0
+        # Health plane (core/health.py): the actuator half of the
+        # detectors above — subscribes to leak/pressure/spike/storm
+        # signals and drives bounded, audited remediations.
+        from ray_tpu.core.health import HealthEngine
+
+        self.health = HealthEngine(self)
         self.dashboard_port: Optional[int] = None
 
         # Head node: controller doubles as its node agent.
@@ -2856,6 +2862,12 @@ class Controller:
                         "monotonically over %d sweeps (now %d)",
                         site, sweeps, cur,
                     )
+                    from ray_tpu.util.actuators import HealthSignal
+
+                    self.health.observe(HealthSignal(
+                        "memory_leak", key=site,
+                        detail={"count": cur, "growth": cur - window[0]},
+                    ))
                 else:
                     flag["count"] = cur
                     flag["growth"] = cur - window[0]
@@ -2883,6 +2895,20 @@ class Controller:
             reason = "spill_churn"
         if reason is None:
             return
+        # Health plane BEFORE the incident rate-limit pre-check: the
+        # actuator registry has its own cooldown/budget, and a pressure
+        # episode suppressed here (a capture fired recently) must still
+        # reach the spill actuator.
+        from ray_tpu.util.actuators import HealthSignal
+
+        self.health.observe(HealthSignal(
+            "memory_pressure", key=nid.hex(), target=nid.hex(),
+            detail={
+                "reason": reason,
+                "occupancy": round(used / cap, 4) if cap else None,
+                "spill_ops_delta": (ops - prev) if prev is not None else 0,
+            },
+        ))
         from ray_tpu.util import profiling
 
         # Pre-check the rate limit so a sustained-pressure store doesn't
@@ -3211,6 +3237,24 @@ class Controller:
         self._errors_prev_total = total
         if threshold <= 0 or delta < threshold:
             return
+        # Health plane before the incident rate-limit pre-check (same
+        # rationale as memory_pressure): resolve the loudest signature and
+        # the node it blames so the quarantine actuator has a target.
+        try:
+            top = self._error_index.summarize(limit=1)["signatures"]
+            sig, row = next(iter(top.items())) if top else ("", {})
+            nodes = row.get("nodes") or []
+            from ray_tpu.util.actuators import HealthSignal
+
+            self.health.observe(HealthSignal(
+                "error_spike",
+                key=nodes[0] if nodes else sig[:64],
+                target=nodes[0] if nodes else "",
+                detail={"signature": sig[:160], "errors_this_sweep": delta,
+                        "count": row.get("count", 0)},
+            ))
+        except Exception as e:  # noqa: BLE001 — health must not break detection
+            logger.debug("error-spike health observe failed: %s", e)
         from ray_tpu.core.log_plane import format_record
         from ray_tpu.util import profiling
 
@@ -3621,6 +3665,12 @@ class Controller:
         self._drain_spawn_events()
         return self.lifecycle.tail(limit)
 
+    async def rpc_summarize_health(self, peer, limit: int = 50):
+        """Self-healing plane summary: registered actuators, recent
+        actions with outcomes, per-trigger signal counts, and live
+        avoids (quarantined / throttled nodes)."""
+        return self.health.snapshot(limit=limit)
+
     async def rpc_list_actors(self, peer):
         return [
             {
@@ -3940,6 +3990,12 @@ class Controller:
                 self._error_spike_check()
             except Exception:  # noqa: BLE001 — log plane must not kill telemetry
                 logger.exception("log plane sweep failed")
+            # Health plane tick: expire avoids, refresh gauges, and scan
+            # shipped compile snapshots for new recompile storms.
+            try:
+                self.health.tick()
+            except Exception:  # noqa: BLE001 — health must not kill telemetry
+                logger.exception("health tick failed")
             # Metrics recorded IN the controller process (head-side
             # object transfers, chunk serving) have no CoreWorker flusher
             # — fold them straight into the aggregation.

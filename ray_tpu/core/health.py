@@ -1,0 +1,401 @@
+"""Health plane: the controller-side observe→act loop.
+
+The five observability legs each end in a detector (PR 10's leak sweep
+and store-pressure check, PR 11's error-spike check, the compile-storm
+tracker riding device telemetry, PR 9's incident triggers). Before this
+module they all terminated in an autopsy bundle; :class:`HealthEngine`
+subscribes them to the actuator framework (util/actuators.py) so the
+cluster can also CLOSE the loop — the Podracer-paper discipline of
+feeding measurement back into control.
+
+Detector → actuator wiring (each bounded + cooled + auditable, see the
+README "Self-healing" table):
+
+- ``memory_leak``     → :class:`LeakBackpressureActuator`: gc/ref-
+  reclamation nudge to the worker processes holding the flagged
+  call-site's objects (targeted owner backpressure).
+- ``memory_pressure`` → :class:`PressureSpillActuator`: proactive spill
+  of the pressured node's store down to ``health_spill_target_pct`` +
+  a soft scheduler avoid (admission throttle) for ``health_throttle_s``.
+- ``recompile_storm`` → :class:`StormPinActuator`: pin the storming
+  function's shape buckets in the offending process's compile tracker
+  (``compile_tracker.maybe_bucket`` then pads instead of re-lowering).
+- ``error_spike``     → :class:`SpikeQuarantineActuator`: hard scheduler
+  avoid (drain semantics: no new tasks/actors/PGs/leases) of the node
+  the spiking signature attributes to, for ``health_quarantine_s``.
+
+The engine runs entirely on the controller loop (observe() is called
+from detector sites that already run there; tick() rides the telemetry
+sweep), keeping the single-writer discipline. The fifth actuator —
+podracer policy-lag → broadcast-cadence adaptation — is driver-local by
+nature and lives in rllib/podracer/pipeline.py; its actions ship to this
+controller's lifecycle ring over the ``task_events`` channel, so
+``summarize_health()`` still shows one merged audit.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, TYPE_CHECKING
+
+from ray_tpu.util.actuators import (
+    Actuator,
+    ActuatorRegistry,
+    HealthSignal,
+    _get_metrics,
+    parse_dry_run,
+)
+from ray_tpu.utils.ids import NodeID
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ray_tpu.core.controller import Controller
+
+logger = logging.getLogger("ray_tpu.health")
+
+# Bounded scan when attributing a leaked call-site to holder processes —
+# the objects table can be envelope-sized and fire() runs on the loop.
+_LEAK_SCAN_CAP = 200_000
+
+
+class LeakBackpressureActuator(Actuator):
+    """``memory_leak`` → gc/ref-reclamation nudge at the holders.
+
+    The leak sweep flags a creation call-site whose open-ref count rises
+    monotonically. The remediation is a targeted ``gc_nudge`` RPC to the
+    (bounded set of) worker processes holding that site's objects: each
+    runs ``gc.collect()`` + an immediate local-ref flush, which reclaims
+    refs pinned only by reference cycles (the classic accidental-leak
+    shape) and pushes the drop to the controller without waiting out the
+    flush interval. Processes that don't shrink after the nudge are a
+    REAL leak — the flag stays up and the incident autopsy has the
+    call-site."""
+
+    name = "leak_backpressure"
+    triggers = ("memory_leak",)
+
+    def __init__(self, ctrl: "Controller", **kw):
+        super().__init__(**kw)
+        self._ctrl = ctrl
+        self.max_procs = int(
+            getattr(ctrl.config, "health_nudge_max_procs", 8)
+        )
+
+    def fire(self, signal: HealthSignal):
+        site = signal.key
+        holders: set = set()
+        for i, orec in enumerate(self._ctrl.objects.values()):
+            if i >= _LEAK_SCAN_CAP:
+                break
+            if (orec.callsite or "(unknown)") != site:
+                continue
+            holders.update(orec.holders)
+            if len(holders) >= self.max_procs * 4:
+                break
+        peers = []
+        for w in self._ctrl.workers.values():
+            if w.state == "DEAD" or w.peer.closed:
+                continue
+            if w.worker_id.hex() in holders:
+                peers.append((w.worker_id.hex()[:12], w.peer))
+            if len(peers) >= self.max_procs:
+                break
+        if not peers:
+            return {"outcome": "skipped", "reason": "no_worker_holders",
+                    "holders": len(holders)}
+
+        async def nudge():
+            import asyncio
+
+            freed = {}
+            for wid, peer in peers:
+                try:
+                    freed[wid] = await asyncio.wait_for(
+                        peer.call("gc_nudge"), 5.0
+                    )
+                except Exception as e:  # noqa: BLE001 — a dead holder is fine
+                    freed[wid] = {"error": str(e)}
+            return {"outcome": "acted", "nudged": freed}
+
+        return nudge()
+
+
+class PressureSpillActuator(Actuator):
+    """``memory_pressure`` → proactive spill + admission throttle.
+
+    Instead of waiting for the allocation path to evict victim-by-victim
+    under churn, spill the pressured node's store down to
+    ``health_spill_target_pct`` in one pass, and soft-avoid the node in
+    the scheduler for ``health_throttle_s`` so new placements prefer
+    other nodes while the store drains."""
+
+    name = "pressure_spill"
+    triggers = ("memory_pressure",)
+
+    def __init__(self, ctrl: "Controller", **kw):
+        super().__init__(**kw)
+        self._ctrl = ctrl
+
+    def fire(self, signal: HealthSignal):
+        cfg = self._ctrl.config
+        frac = float(getattr(cfg, "health_spill_target_pct", 0.6))
+        throttle_s = float(getattr(cfg, "health_throttle_s", 30.0))
+        try:
+            nid = NodeID.from_hex(signal.target or signal.key)
+        except Exception:  # noqa: BLE001 — malformed target
+            return {"outcome": "skipped", "reason": "bad_node"}
+        node = self._ctrl.nodes.get(nid)
+        if node is None:
+            return {"outcome": "skipped", "reason": "node_gone"}
+        throttled = False
+        if throttle_s > 0 and len(self._ctrl.nodes) > 1:
+            throttled = self._ctrl.cluster.set_avoid(
+                nid, throttle_s, hard=False
+            )
+        if node.peer is None:  # the head's store is local
+            res = self._ctrl.head_store.spill_to_fraction(frac)
+            res.update(outcome="acted", throttled_s=throttle_s if throttled else 0)
+            return res
+
+        async def spill():
+            import asyncio
+
+            res = await asyncio.wait_for(
+                node.peer.call("spill_store", frac), 10.0
+            )
+            out = dict(res or {})
+            out.update(
+                outcome="acted", throttled_s=throttle_s if throttled else 0
+            )
+            return out
+
+        return spill()
+
+
+class StormPinActuator(Actuator):
+    """``recompile_storm`` → pin shape buckets in the offending process.
+
+    The compile tracker in the storming worker knows the function and
+    its churning shape strings; the remediation tells THAT process to
+    pin the function (``pin_shapes`` RPC → ``compile_tracker.
+    pin_functions``), after which workload code consulting
+    ``compile_tracker.maybe_bucket(name, dim)`` gets power-of-two
+    padded sizes — a bounded shape vocabulary instead of one compile per
+    batch size."""
+
+    name = "storm_pin"
+    triggers = ("recompile_storm",)
+
+    def __init__(self, ctrl: "Controller", **kw):
+        super().__init__(**kw)
+        self._ctrl = ctrl
+
+    def fire(self, signal: HealthSignal):
+        pid = signal.detail.get("pid")
+        node_hex = signal.detail.get("node")
+        fn = signal.detail.get("function") or signal.key
+        target = None
+        for w in self._ctrl.workers.values():
+            if w.state == "DEAD" or w.peer.closed:
+                continue
+            if w.pid == pid and (
+                not node_hex or w.node_id.hex() == node_hex
+            ):
+                target = w
+                break
+        if target is None:
+            # Storms in drivers/controller processes have no worker peer
+            # to reach — visible in compile_state(), not actuatable.
+            return {"outcome": "skipped", "reason": "no_worker_peer",
+                    "pid": pid}
+
+        async def pin():
+            import asyncio
+
+            pinned = await asyncio.wait_for(
+                target.peer.call("pin_shapes", [fn]), 5.0
+            )
+            return {"outcome": "acted", "pinned": pinned,
+                    "worker": target.worker_id.hex()[:12]}
+
+        return pin()
+
+
+class SpikeQuarantineActuator(Actuator):
+    """``error_spike`` → quarantine the node the spike attributes to.
+
+    The error index links each signature to the lifecycle entity that
+    first produced it; when one signature dominates a spike and resolves
+    to a non-head node, hard-avoid that node for
+    ``health_quarantine_s``: running work continues (and releases
+    resources correctly), but no new tasks, actors, placement groups, or
+    worker leases route there — the reference's drain semantics, applied
+    automatically and bounded in time."""
+
+    name = "spike_quarantine"
+    triggers = ("error_spike",)
+
+    def __init__(self, ctrl: "Controller", **kw):
+        super().__init__(**kw)
+        self._ctrl = ctrl
+
+    def fire(self, signal: HealthSignal):
+        cfg = self._ctrl.config
+        quarantine_s = float(getattr(cfg, "health_quarantine_s", 60.0))
+        node_hex = signal.target
+        if not node_hex:
+            return {"outcome": "skipped", "reason": "no_node_attribution"}
+        nid = None
+        for cand in self._ctrl.nodes:
+            if cand.hex() == node_hex or cand.hex().startswith(node_hex):
+                nid = cand
+                break
+        if nid is None:
+            return {"outcome": "skipped", "reason": "node_gone"}
+        node = self._ctrl.nodes.get(nid)
+        if node is not None and node.peer is None:
+            # Never quarantine the head: its "node" hosts the control
+            # plane itself; losing placements there can deadlock small
+            # clusters. The spike stays visible via incidents + index.
+            return {"outcome": "skipped", "reason": "head_node"}
+        if len(self._ctrl.nodes) < 2:
+            return {"outcome": "skipped", "reason": "single_node"}
+        ok = self._ctrl.cluster.set_avoid(nid, quarantine_s, hard=True)
+        if not ok:
+            return {"outcome": "skipped", "reason": "node_gone"}
+        return {
+            "outcome": "acted",
+            "node": nid.hex()[:12],
+            "quarantine_s": quarantine_s,
+            "signature": signal.detail.get("signature", ""),
+        }
+
+
+class HealthEngine:
+    """Controller-side health plane: registry + detector subscriptions.
+
+    ``observe()`` is the single entry point detector sites call (always
+    from the controller loop); ``tick()`` rides the telemetry sweep to
+    scan telemetry-carried detectors (compile storms) and expire
+    scheduler avoids."""
+
+    def __init__(self, ctrl: "Controller"):
+        self._ctrl = ctrl
+        cfg = ctrl.config
+        self.enabled = bool(getattr(cfg, "health_actuators", True))
+        dry_spec = str(getattr(cfg, "health_dry_run", ""))
+        cooldown = float(getattr(cfg, "health_action_cooldown_s", 30.0))
+        self.registry = ActuatorRegistry(
+            audit_ring=int(getattr(cfg, "health_audit_ring", 256)),
+            max_actions_per_min=int(
+                getattr(cfg, "health_max_actions_per_min", 6)
+            ),
+            recorder=ctrl.lifecycle.record,
+        )
+        for cls in (
+            LeakBackpressureActuator,
+            PressureSpillActuator,
+            StormPinActuator,
+            SpikeQuarantineActuator,
+        ):
+            self.registry.register(
+                cls(
+                    ctrl,
+                    cooldown_s=cooldown,
+                    dry_run=parse_dry_run(dry_spec, cls.name),
+                )
+            )
+        # (proc_key, function) storms already acted on this activation —
+        # a storm stays "active" for a whole window; without this the
+        # tick would re-dispatch it every sweep just to hit cooldown.
+        self._storms_seen: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------
+    def observe(self, signal: HealthSignal) -> List[dict]:
+        """Dispatch one detector signal. Cheap and exception-safe — the
+        detector sites must never die because remediation did."""
+        if not self.enabled:
+            return []
+        try:
+            return self.registry.dispatch(signal)
+        except Exception:  # noqa: BLE001 — must not break detectors
+            logger.exception("health dispatch failed (%s)", signal.trigger)
+            return []
+
+    def tick(self):
+        """Telemetry-sweep housekeeping: expire scheduler avoids, sync
+        the avoid gauges, and scan device telemetry for compile storms
+        (the one detector that lives in remote processes and arrives by
+        snapshot rather than by callback)."""
+        if not self.enabled:
+            return
+        cluster = self._ctrl.cluster
+        cluster.prune_avoids()
+        try:
+            counts = {"hard": 0, "soft": 0}
+            for _nid, (_deadline, hard) in cluster.avoids().items():
+                counts["hard" if hard else "soft"] += 1
+            g = _get_metrics()["avoids"]
+            g.set(counts["hard"], {"mode": "hard"})
+            g.set(counts["soft"], {"mode": "soft"})
+        except Exception as e:  # noqa: BLE001 — metrics must not break tick
+            logger.debug("avoid gauge failed: %s", e)
+        now = time.time()
+        window = float(getattr(self._ctrl.config, "compile_storm_window_s", 60.0))
+        for k in [
+            k for k, ts in self._storms_seen.items() if now - ts > 2 * window
+        ]:
+            self._storms_seen.pop(k, None)
+        for proc_key, payload in self._ctrl._live_device_state().items():
+            comp = payload.get("compile") or {}
+            for fn in (comp.get("active_storms") or {}):
+                skey = f"{proc_key}:{fn}"
+                if skey in self._storms_seen:
+                    continue
+                self._storms_seen[skey] = now
+                self.observe(
+                    HealthSignal(
+                        "recompile_storm",
+                        key=skey,
+                        target=proc_key,
+                        detail={
+                            "function": fn,
+                            "pid": payload.get("pid"),
+                            "node": payload.get("node_id"),
+                        },
+                    )
+                )
+
+    # ------------------------------------------------------------------
+    def snapshot(self, limit: int = 50) -> dict:
+        """The ``summarize_health()`` body: actuator configs + outcomes,
+        the recent-action audit (controller actuators AND driver-side
+        ones whose action events arrived over task_events), and the live
+        scheduler avoid set."""
+        out = {
+            "enabled": self.enabled,
+            **self.registry.snapshot(limit=limit),
+        }
+        now = time.monotonic()
+        avoids = {}
+        for nid, (deadline, hard) in self._ctrl.cluster.avoids().items():
+            avoids[nid.hex()[:12]] = {
+                "mode": "quarantine" if hard else "throttle",
+                "remaining_s": round(max(0.0, deadline - now), 2),
+            }
+        out["avoids"] = avoids
+        # Driver-side actuators (podracer cadence) audit through the
+        # lifecycle ring only — merge their action events so the health
+        # summary is the one place to read the whole self-healing story.
+        remote = [
+            ev
+            for ev in self._ctrl.lifecycle.tail(2000)
+            if ev.get("kind") == "action" and ev.get("remote")
+        ]
+        if remote:
+            out["remote_actions"] = remote[-limit:]
+        return out
+
+
+def disabled_snapshot() -> dict:
+    return {"enabled": False, "actuators": [], "actions_recent": [],
+            "signals": {}, "outcomes": {}, "avoids": {}}

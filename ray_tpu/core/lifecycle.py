@@ -77,7 +77,14 @@ _CANONICAL = {
     "RECONSTRUCTING": "RETRYING",
 }
 
-_INGEST_KINDS = frozenset({"task", "actor", "pg", "lease", "worker", "node"})
+_INGEST_KINDS = frozenset(
+    {"task", "actor", "pg", "lease", "worker", "node", "action"}
+)
+
+# Extra attrs forwarded from shipped events into the ring (never metric
+# tags): the self-healing "action" events carry their audit fields here.
+_INGEST_ATTRS = ("name", "node", "worker", "actuator", "trigger", "target",
+                 "outcome", "dry_run", "remote")
 
 _DWELL_BOUNDARIES_MS = (
     1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 15000, 60000,
@@ -279,8 +286,8 @@ class LifecycleRecorder:
         state = ev.get("state")
         if not eid or not state:
             return
-        self.record(kind, eid, state, ts=ev.get("ts"), name=ev.get("name"),
-                    node=ev.get("node"), worker=ev.get("worker"))
+        attrs = {k: ev.get(k) for k in _INGEST_ATTRS if ev.get(k) is not None}
+        self.record(kind, eid, state, ts=ev.get("ts"), **attrs)
 
     def flush_metrics(self, now_m: Optional[float] = None):
         """Sync accumulated transitions/dwell into the cluster metrics

@@ -563,6 +563,12 @@ class NodeAgent:
 
         return profiling.sample_async(duration_s, hz)
 
+    def rpc_spill_store(self, peer, fraction: float = 0.6):
+        """Health-plane pressure actuator: proactively spill this node's
+        store down to ``fraction`` of capacity (both tiers). Runs off-loop
+        — a large arena drain copies bytes and must not stall heartbeats."""
+        return asyncio.to_thread(self.store.spill_to_fraction, fraction)
+
     def rpc_dump_memory(self, peer, limit: int = 1000):
         """This node's store leg of the memory census fan-out: live
         store stats (occupancy, spill-dir bytes, pins, deferred deletes)

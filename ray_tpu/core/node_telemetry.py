@@ -165,13 +165,23 @@ def start_process_telemetry(core) -> Optional[threading.Thread]:
 
         while True:
             time.sleep(interval)
-            if "jax" not in sys.modules:
-                continue
-            compile_tracker.maybe_install()
-            rows = sample_devices()
-            set_device_gauges(rows)
+            if "jax" in sys.modules:
+                compile_tracker.maybe_install()
+                rows = sample_devices()
+                set_device_gauges(rows)
+            else:
+                rows = []
+            # Ship whenever the compile tracker has ANYTHING — jax may be
+            # absent while the tracker still carries data (its logging
+            # hook fires through jax's pure-Python path, and the health
+            # plane's storm actuator needs storms visible either way).
             snap = compile_tracker.snapshot()
-            if not rows and not snap.get("compiles"):
+            if (
+                not rows
+                and not snap.get("compiles")
+                and not snap.get("functions")
+                and not snap.get("active_storms")
+            ):
                 continue
             payload = {
                 "node_id": core.node_id.hex() if core.node_id else None,

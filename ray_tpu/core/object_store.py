@@ -415,6 +415,111 @@ class PlasmaStore:
             self.spill_ops += 1
             self.used -= e.size
 
+    def spill_to_fraction(self, fraction: float) -> dict:
+        """Proactively spill LRU sealed, unpinned entries until store
+        occupancy (file tier + arena) is at or below ``fraction`` of
+        capacity — the health plane's pressure actuator. The allocation
+        path's eviction (:meth:`_maybe_evict` / arena victims) frees just
+        enough for ONE incoming object, so a store under sustained
+        pressure churns the eviction loop; one proactive pass drains it
+        below the incident threshold instead."""
+        fraction = min(max(float(fraction), 0.0), 1.0)
+        spilled = 0
+        freed = 0
+        with self._lock:
+            if self.capacity <= 0:
+                return {"spilled": 0, "freed_bytes": 0, "occupancy": None}
+            target = self.capacity * fraction
+            arena_used = (
+                self._arena.stats()["used"] if self._arena is not None else 0
+            )
+            occupancy = self.used + arena_used
+            # File tier first (a rename per object, no copy)…
+            victims = sorted(
+                (e.last_access, oid, e)
+                for oid, e in self._entries.items()
+                if e.sealed and e.pinned == 0 and not e.spilled and not e.in_arena
+            )
+            for _, oid, e in victims:
+                if occupancy <= target:
+                    break
+                if self._spill_uri:
+                    from ray_tpu.utils import cloudfs
+
+                    with open(self._shm_path(oid), "rb") as f:
+                        cloudfs.write_bytes(self._spill_path(oid), f.read())
+                    os.unlink(self._shm_path(oid))
+                else:
+                    shutil.move(self._shm_path(oid), self._spill_path(oid))
+                e.spilled = True
+                self.spill_ops += 1
+                self.used -= e.size
+                occupancy -= e.size
+                freed += e.size
+                spilled += 1
+            # …then arena victims (copy-out + slot delete), bounded by
+            # the entry count so a pinned-up arena can't loop forever.
+            if self._arena is not None:
+                for _ in range(len(self._entries) + 1):
+                    if occupancy <= target:
+                        break
+                    n = self._spill_one_arena_victim()
+                    if n is None:
+                        break
+                    occupancy -= n
+                    freed += n
+                    spilled += 1
+            return {
+                "spilled": spilled,
+                "freed_bytes": freed,
+                "occupancy": (
+                    round(occupancy / self.capacity, 4) if self.capacity else None
+                ),
+            }
+
+    def _spill_one_arena_victim(self):
+        """Spill the arena's LRU victim to the spill tier; returns the
+        bytes freed, or None when nothing is evictable. Caller holds the
+        lock. Mirrors the victim half of :meth:`_arena_alloc_evicting`
+        (including deferred-delete and late-pin handling) without the
+        allocation retry loop."""
+        self._drain_deferred_deletes()
+        victim = self._arena.lru_victim()
+        if victim is None:
+            return None
+        vid_bytes, vsize = victim
+        vid = ObjectID(vid_bytes)
+        if vid in self._deferred_deletes:
+            # Refcount-dead with a delete deferred behind a reader pin
+            # that has since dropped — free it, nothing to spill.
+            if self._arena.delete(vid_bytes):
+                self._deferred_deletes.discard(vid)
+                return vsize
+            return None
+        ve = self._entries.get(vid)
+        vbuf = self._arena.get(vid_bytes)
+        if vbuf is not None:
+            if self._spill_uri:
+                from ray_tpu.utils import cloudfs
+
+                cloudfs.write_bytes(self._spill_path(vid), bytes(vbuf.view()))
+            else:
+                with open(self._spill_path(vid), "wb") as f:
+                    f.write(vbuf.view())
+            vbuf.close()
+        if not self._arena.delete(vid_bytes):
+            # A reader pinned the victim after the LRU scan — keep it
+            # resident and drop the spilled copy (same rule as the
+            # allocation path's eviction).
+            if vbuf is not None:
+                self._delete_spilled(vid)
+            return None
+        if ve is not None:
+            ve.spilled = True
+            ve.in_arena = False
+        self.spill_ops += 1
+        return vsize
+
     def _restore_locked(self, oid: ObjectID, e: PlasmaEntry):
         if self._arena is not None:
             buf = self._arena_alloc_evicting(oid.binary(), e.size)

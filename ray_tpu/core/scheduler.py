@@ -84,6 +84,12 @@ class ClusterState:
         # Stable ordering for deterministic pack behavior.
         self._order: List[NodeID] = []
         self._spread_rr = itertools.count()
+        # Health-plane avoid set: node -> [monotonic deadline, hard].
+        # hard = quarantine (drain semantics: no new placements at all),
+        # soft = admission throttle (node moves to the back of the
+        # placement order so other nodes absorb new work first). Expiry
+        # is pruned lazily on read and by the health tick.
+        self._avoid: Dict[NodeID, list] = {}
         self.native = None
         if not get_config().disable_native_sched:
             try:
@@ -111,6 +117,7 @@ class ClusterState:
         if res is not None:
             res.bind_native(None, None)
         self._order = [n for n in self._order if n != node_id]
+        self._avoid.pop(node_id, None)
         if self.native is not None:
             self.native.remove_node(node_id)
 
@@ -124,12 +131,75 @@ class ClusterState:
         if self.native is not None:
             self.native.set_draining(node_id, draining)
 
+    # -- health-plane avoids (core/health.py actuators) -----------------
+    def set_avoid(self, node_id: NodeID, duration_s: float,
+                  hard: bool = False) -> bool:
+        """Quarantine (hard) or admission-throttle (soft) a node for
+        ``duration_s``. Hard avoids mirror into the native core as
+        draining so the C++ fast path honors them; the node's OWN
+        ``draining`` flag (user drains) is never touched — an expiring
+        quarantine must not un-drain a node the operator drained."""
+        import time as _time
+
+        res = self.nodes.get(node_id)
+        if res is None:
+            return False
+        prev = self._avoid.get(node_id)
+        self._avoid[node_id] = [_time.monotonic() + float(duration_s), bool(hard)]
+        if hard and self.native is not None and not res.draining:
+            self.native.set_draining(node_id, True)
+        elif not hard and prev is not None and prev[1]:
+            # Downgrade hard -> soft: release the native drain mirror.
+            if self.native is not None and not res.draining:
+                self.native.set_draining(node_id, False)
+        return True
+
+    def clear_avoid(self, node_id: NodeID):
+        entry = self._avoid.pop(node_id, None)
+        if entry is None:
+            return
+        res = self.nodes.get(node_id)
+        if (
+            entry[1]
+            and self.native is not None
+            and res is not None
+            and not res.draining
+        ):
+            self.native.set_draining(node_id, False)
+
+    def prune_avoids(self):
+        import time as _time
+
+        now = _time.monotonic()
+        for nid in [n for n, (dl, _h) in self._avoid.items() if dl <= now]:
+            self.clear_avoid(nid)
+
+    def avoids(self) -> Dict[NodeID, tuple]:
+        self.prune_avoids()
+        return {n: (dl, h) for n, (dl, h) in self._avoid.items()}
+
+    def soft_avoid_active(self) -> bool:
+        if not self._avoid:
+            return False
+        self.prune_avoids()
+        return any(not h for _dl, h in self._avoid.values())
+
     def ordered_nodes(self) -> List[NodeID]:
-        return [
-            n
-            for n in self._order
-            if n in self.nodes and not getattr(self.nodes[n], "draining", False)
-        ]
+        if self._avoid:
+            self.prune_avoids()
+        front: List[NodeID] = []
+        back: List[NodeID] = []
+        for n in self._order:
+            if n not in self.nodes or getattr(self.nodes[n], "draining", False):
+                continue
+            entry = self._avoid.get(n)
+            if entry is None:
+                front.append(n)
+            elif entry[1]:
+                continue  # quarantined: no new placements at all
+            else:
+                back.append(n)  # throttled: last resort only
+        return front + back
 
 
 class ClusterResourceScheduler:
@@ -168,7 +238,14 @@ class ClusterResourceScheduler:
         least-utilized available node (reference:
         hybrid_scheduling_policy.cc HybridPolicyWithFilter)."""
         threshold = get_config().scheduler_spread_threshold
-        if self.state.native is not None and not exclude:
+        # The native fast path knows about quarantines (mirrored as
+        # draining) but not soft throttles (an ORDER preference) — while
+        # any throttle is live, placement takes the Python policy path.
+        if (
+            self.state.native is not None
+            and not exclude
+            and not self.state.soft_avoid_active()
+        ):
             node_id, infeasible = self.state.native.schedule_hybrid(
                 demand.items_fp(), threshold
             )

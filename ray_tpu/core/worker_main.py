@@ -52,6 +52,7 @@ class WorkerHandler:
         self.executor: Optional[TaskExecutor] = None
         self._buffer: list = []
         self._controller_peer = None
+        self._agent_peer = None
 
     def attach_executor(self, executor: "TaskExecutor"):
         self.executor = executor
@@ -196,7 +197,37 @@ class WorkerHandler:
 
         _deliver(channel, message)
 
+    def rpc_gc_nudge(self, peer):
+        """Health-plane leak actuator: force a collection in this worker
+        so unreachable reference cycles holding ObjectRefs break NOW
+        (the refs' __del__ marks them dropped; the ref-flush loop ships
+        the drops within one flush period). Returns collection stats."""
+        import gc
+
+        unreachable = gc.collect()
+        pending = 0
+        core = self.executor.core if self.executor is not None else None
+        if core is not None:
+            pending = core.refs.pending_drops()
+        return {"unreachable": unreachable, "pending_drops": pending}
+
+    def rpc_pin_shapes(self, peer, functions):
+        """Health-plane storm actuator: pin shape-bucketing for the named
+        functions in this worker's compile tracker (util/compile_tracker)
+        so recompile-storm workloads round dynamic dims up to power-of-2
+        buckets instead of recompiling per shape."""
+        from ray_tpu.util import compile_tracker
+
+        return compile_tracker.pin_functions(functions)
+
     def on_disconnect(self, peer):
+        if peer is self._agent_peer:
+            # The spawning agent died (host death, SIGKILL): this worker
+            # is an orphan — nothing will ever retire it, and a rejoined
+            # agent spawns a fresh pool. Self-reap immediately instead of
+            # lingering as a stray process (the PR 13 orphan fix).
+            logger.warning("node agent connection lost; exiting")
+            os._exit(1)
         # Direct-caller connections come and go; only the controller
         # connection is load-bearing.
         if peer is not self._controller_peer:

@@ -229,10 +229,19 @@ int64_t heap_alloc(Arena* a, uint64_t want) {
         tail->has_prev = 1;
         tail->free = 1;
         tail->next_free = b->next_free;
-        // fix the next physical block's prev_size
-        uint64_t after = off + kBlockHdr + b->size + kBlockHdr;
-        if (after < a->hdr->heap_off + a->hdr->heap_size) {
-          block_at(a, after)->prev_size = tail->size;
+        // fix the next physical block's prev_size. The block AFTER the
+        // tail starts where this block's payload used to end (b->size is
+        // still the pre-split size here) — its header is at
+        // off + kBlockHdr + b->size, NOT one extra header past it: the
+        // old +kBlockHdr form wrote tail->size 8 bytes into the next
+        // block's PAYLOAD, corrupting any live object physically after a
+        // split free block (exposed by free-then-realloc patterns like
+        // the health plane's proactive spill).
+        uint64_t after = off + kBlockHdr + b->size;
+        if (after + kBlockHdr <= a->hdr->heap_off + a->hdr->heap_size) {
+          BlockHeader* an = block_at(a, after);
+          an->prev_size = tail->size;
+          an->has_prev = 1;
         }
         b->size = want;
         if (prev_off)

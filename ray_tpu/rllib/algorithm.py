@@ -56,6 +56,11 @@ class AlgorithmConfig:
         self.podracer_max_pull: int = 16
         self.podracer_poll_timeout_s: float = 2.0
         self.podracer_iteration_timeout_s: float = 300.0
+        # Policy-lag cadence actuator (the driver-local health-plane
+        # leg): tighten the publish interval when observed lag exceeds
+        # max_policy_lag, relax back once it recovers.
+        self.adaptive_cadence: bool = True
+        self.cadence_cooldown_s: float = 10.0
         self.extra: Dict[str, Any] = {}
 
     # fluent setters ------------------------------------------------------
@@ -101,6 +106,8 @@ class AlgorithmConfig:
         max_pull: int = 16,
         poll_timeout_s: float = 2.0,
         iteration_timeout_s: float = 300.0,
+        adaptive_cadence: bool = True,
+        cadence_cooldown_s: float = 10.0,
     ) -> "AlgorithmConfig":
         """Sebulba async pipeline section (ray_tpu.rllib.podracer):
         continuous env-runner actors -> bounded sample queue -> learner,
@@ -115,6 +122,8 @@ class AlgorithmConfig:
         self.podracer_max_pull = max_pull
         self.podracer_poll_timeout_s = poll_timeout_s
         self.podracer_iteration_timeout_s = iteration_timeout_s
+        self.adaptive_cadence = adaptive_cadence
+        self.cadence_cooldown_s = cadence_cooldown_s
         return self
 
     def rl_module(self, hidden: tuple = (64, 64)) -> "AlgorithmConfig":
@@ -355,7 +364,10 @@ class Algorithm:
                 continue
             metrics = self._podracer_update_fn(batch)
             self._podracer_updates += 1
-            if self._podracer_updates % cfg.weights_publish_interval == 0:
+            # pr.publish_interval is the cadence actuator's ADAPTED value
+            # (== cfg.weights_publish_interval unless policy lag forced a
+            # tighter broadcast cadence).
+            if self._podracer_updates % pr.publish_interval == 0:
                 pr.publish(self.learner_group.get_weights())
             m.learner_step_ms.observe((time.perf_counter() - t0) * 1e3)
             consumed += steps
@@ -372,6 +384,8 @@ class Algorithm:
             "podracer/fragments_lost": pr.stats["fragments_lost"],
             "podracer/runner_restarts": pr.stats["runner_restarts"],
             "podracer/max_policy_lag_seen": pr.stats["max_policy_lag_seen"],
+            "podracer/publish_interval": pr.publish_interval,
+            "podracer/cadence_adaptations": pr.stats["cadence_adaptations"],
             **{f"learner/{k}": v for k, v in metrics.items()},
         }
 

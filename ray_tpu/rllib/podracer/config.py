@@ -33,6 +33,13 @@ class PodracerConfig:
     # Hard ceiling on one training_step's wait for env steps (runner
     # restarts happen within it; only a fully wedged fleet trips it).
     iteration_timeout_s: float = 300.0
+    # Policy-lag cadence actuator (the driver-local health-plane leg,
+    # see core/health.py): when observed lag exceeds max_policy_lag,
+    # halve the effective publish interval (fresher weights reach the
+    # runners); relax back toward the configured interval once lag
+    # recovers. Each adaptation is an audited "action" lifecycle event.
+    adaptive_cadence: bool = True
+    cadence_cooldown_s: float = 10.0
 
     def validate(self) -> "PodracerConfig":
         if self.policy_lag_mode not in ("correct", "drop"):
@@ -61,4 +68,6 @@ class PodracerConfig:
             max_pull=c.podracer_max_pull,
             poll_timeout_s=c.podracer_poll_timeout_s,
             iteration_timeout_s=c.podracer_iteration_timeout_s,
+            adaptive_cadence=getattr(c, "adaptive_cadence", True),
+            cadence_cooldown_s=getattr(c, "cadence_cooldown_s", 10.0),
         ).validate()

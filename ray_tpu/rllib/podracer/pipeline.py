@@ -35,6 +35,7 @@ from ray_tpu.rllib.podracer.metrics import rl_metrics
 from ray_tpu.rllib.podracer.runner import make_podracer_runner_cls
 from ray_tpu.rllib.podracer.sample_queue import SampleQueue
 from ray_tpu.rllib.podracer.weights import WeightBroadcast
+from ray_tpu.util.actuators import Actuator, ActuatorRegistry, HealthSignal
 
 logger = logging.getLogger("ray_tpu.rllib")
 
@@ -62,6 +63,53 @@ def partition_stale(
         lag = current_version - int(rec.get("weights_version", 0))
         (stale if lag > max_policy_lag else accepted).append(rec)
     return accepted, stale
+
+
+class _CadenceActuator(Actuator):
+    """``policy_lag`` → adapt the weight-broadcast cadence.
+
+    The driver-local leg of the health plane (core/health.py holds the
+    controller-side four): when observed policy lag exceeds
+    ``max_policy_lag``, halve the EFFECTIVE publish interval so runners
+    see fresher weights sooner; once lag drops below half the budget,
+    relax back toward the configured interval. Bounded between 1 and
+    ``weights_publish_interval``, cooled by ``cadence_cooldown_s``, and
+    audited — actions ship to the controller's lifecycle ring over
+    ``task_events`` so ``summarize_health()`` shows them merged."""
+
+    name = "podracer_cadence"
+    triggers = ("policy_lag",)
+
+    def __init__(self, pipeline: "PodracerPipeline", **kw):
+        super().__init__(**kw)
+        self._p = pipeline
+
+    def fire(self, signal: HealthSignal):
+        p = self._p
+        lag = int(signal.detail.get("max_lag", 0))
+        if lag > p.cfg.max_policy_lag:
+            if p.publish_interval <= 1:
+                return {"outcome": "skipped", "reason": "at_floor",
+                        "max_lag": lag}
+            p.publish_interval = max(1, p.publish_interval // 2)
+            p.stats["cadence_adaptations"] += 1
+            direction = "tighten"
+        else:
+            if p.publish_interval >= p.cfg.weights_publish_interval:
+                return {"outcome": "skipped", "reason": "at_config",
+                        "max_lag": lag}
+            p.publish_interval = min(
+                p.cfg.weights_publish_interval, p.publish_interval * 2
+            )
+            p.stats["cadence_adaptations"] += 1
+            direction = "relax"
+        logger.info(
+            "podracer cadence %s: publish_interval -> %d (max lag %d, "
+            "budget %d)", direction, p.publish_interval, lag,
+            p.cfg.max_policy_lag,
+        )
+        return {"outcome": "acted", "direction": direction,
+                "publish_interval": p.publish_interval, "max_lag": lag}
 
 
 class PodracerPipeline:
@@ -92,9 +140,21 @@ class PodracerPipeline:
             "runner_restarts": 0,
             "queue_depth": 0,
             "max_policy_lag_seen": 0,
+            "cadence_adaptations": 0,
         }
         self._started = False
         self._last_health_check = 0.0
+        # Effective broadcast cadence — the cadence actuator's knob; the
+        # algorithm consults pipeline.publish_interval, not the config.
+        self.publish_interval = max(1, int(config.weights_publish_interval))
+        self._cadence: "ActuatorRegistry | None" = None
+        if config.adaptive_cadence:
+            self._cadence = ActuatorRegistry(
+                audit_ring=64, max_actions_per_min=12
+            )
+            self._cadence.register(
+                _CadenceActuator(self, cooldown_s=config.cadence_cooldown_s)
+            )
 
     # -- lifecycle --------------------------------------------------------
     def start(self, params):
@@ -188,6 +248,7 @@ class PodracerPipeline:
             self.stats["max_policy_lag_seen"] = max(
                 self.stats["max_policy_lag_seen"], max(lags)
             )
+            self._observe_lag(max(lags))
             accepted, stale = partition_stale(
                 records, current, cfg.max_policy_lag, cfg.policy_lag_mode
             )
@@ -237,6 +298,54 @@ class PodracerPipeline:
             m.bump("env_steps_accepted", steps)
             self.stats["env_steps_accepted"] += steps
         return episodes, steps
+
+    def _observe_lag(self, max_lag: int):
+        """Feed one pull's worst observed policy lag to the cadence
+        actuator. Dispatch only at the decision boundaries (over budget,
+        or recovered while tightened) — the registry's cooldown guards
+        frequency, this guards pointless dispatches."""
+        if self._cadence is None:
+            return
+        over = max_lag > self.cfg.max_policy_lag
+        recovered = (
+            max_lag <= max(0, self.cfg.max_policy_lag // 2)
+            and self.publish_interval < self.cfg.weights_publish_interval
+        )
+        if not over and not recovered:
+            return
+        rows = self._cadence.dispatch(HealthSignal(
+            "policy_lag", key="learner", target="learner",
+            detail={"max_lag": int(max_lag),
+                    "publish_interval": self.publish_interval},
+        ))
+        self._ship_actions(rows)
+
+    def _ship_actions(self, rows: List[dict]):
+        """Ship completed cadence actions to the controller's lifecycle
+        ring (kind="action", remote=True) over the task_events channel so
+        ``summarize_health()`` merges the driver-local audit."""
+        evs = []
+        for row in rows:
+            if row.get("outcome") in ("cooldown", "throttled", "pending"):
+                continue
+            evs.append({
+                "ts": row["ts"], "kind": "action", "id": row["id"],
+                "state": "FAILED" if row["outcome"] == "failed" else "FINISHED",
+                "actuator": row["actuator"], "trigger": row["trigger"],
+                "target": row["target"], "outcome": row["outcome"],
+                "dry_run": row["dry_run"] or None, "remote": True,
+            })
+        if not evs:
+            return
+        from ray_tpu.core import api
+
+        core = api._global_worker
+        if core is None:
+            return
+        try:
+            core._submit("task_events", evs)
+        except Exception as e:  # noqa: BLE001 — audit ship is best-effort
+            logger.debug("cadence action ship failed: %s", e)
 
     def pop_returns(self) -> List[float]:
         out, self._returns = self._returns, []

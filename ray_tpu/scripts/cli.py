@@ -490,6 +490,120 @@ def cmd_memory(args):
     return 0
 
 
+def _health_fixture() -> dict:
+    """Canned summarize_health()-shaped data for `health --offline`:
+    exercises every rendering path (actuator table, outcomes, actions,
+    avoids, remote actions) with no cluster — the tier-1 smoke that
+    keeps the view from rotting."""
+    return {
+        "enabled": True,
+        "max_actions_per_min": 6,
+        "actuators": [
+            {"name": "leak_backpressure", "triggers": ["memory_leak"],
+             "cooldown_s": 30.0, "dry_run": False},
+            {"name": "pressure_spill", "triggers": ["memory_pressure"],
+             "cooldown_s": 30.0, "dry_run": False},
+            {"name": "storm_pin", "triggers": ["recompile_storm"],
+             "cooldown_s": 30.0, "dry_run": True},
+            {"name": "spike_quarantine", "triggers": ["error_spike"],
+             "cooldown_s": 30.0, "dry_run": False},
+        ],
+        "signals": {"memory_pressure": 4, "error_spike": 1},
+        "outcomes": {
+            "pressure_spill": {"acted": 2, "cooldown": 2},
+            "spike_quarantine": {"acted": 1},
+            "storm_pin": {"dry_run": 1},
+        },
+        "actions_recent": [
+            {"id": "act-1-100", "ts": 1700000000.0,
+             "actuator": "pressure_spill", "trigger": "memory_pressure",
+             "key": "aabbccddee00", "target": "aabbccddee00",
+             "dry_run": False, "outcome": "acted",
+             "detail": {"reason": "occupancy", "spilled": 41,
+                        "freed_bytes": 2 << 30}},
+            {"id": "act-2-250", "ts": 1700000012.5,
+             "actuator": "spike_quarantine", "trigger": "error_spike",
+             "key": "ffee00112233", "target": "ffee00112233",
+             "dry_run": False, "outcome": "acted",
+             "detail": {"signature": "ValueError@Loader.fetch",
+                        "quarantine_s": 60.0}},
+            {"id": "act-3-311", "ts": 1700000031.1,
+             "actuator": "storm_pin", "trigger": "recompile_storm",
+             "key": "aabbccddee00/pid201:train_step",
+             "target": "aabbccddee00/pid201", "dry_run": True,
+             "outcome": "dry_run", "detail": {"function": "train_step"}},
+        ],
+        "avoids": {
+            "ffee00112233": {"mode": "quarantine", "remaining_s": 41.2},
+        },
+        "remote_actions": [
+            {"ts": 1700000044.0, "kind": "action", "id": "padr-1",
+             "state": "FINISHED", "actuator": "podracer_cadence",
+             "trigger": "policy_lag", "target": "learner",
+             "outcome": "acted", "remote": True},
+        ],
+    }
+
+
+def _render_health(summary: dict, out=print):
+    """The `ray-tpu health` self-healing view: actuator configs, live
+    avoids, and the recent trigger → action → outcome audit."""
+    if not summary.get("enabled", False):
+        out("health actuators disabled (health_actuators=False)")
+        return
+    out(f"{'actuator':<20}{'triggers':<22}{'cooldown':>9}{'dry-run':>9}  outcomes")
+    outcomes = summary.get("outcomes", {})
+    for a in summary.get("actuators", []):
+        tally = outcomes.get(a["name"], {})
+        tstr = " ".join(f"{k}:{n}" for k, n in sorted(tally.items())) or "-"
+        out(
+            f"{a['name']:<20}{','.join(a['triggers']):<22}"
+            f"{a['cooldown_s']:>8.0f}s{('yes' if a['dry_run'] else 'no'):>9}  {tstr}"
+        )
+    sig = summary.get("signals", {})
+    if sig:
+        out("")
+        out("signals seen: " + "  ".join(f"{k}={n}" for k, n in sorted(sig.items())))
+    avoids = summary.get("avoids", {})
+    if avoids:
+        out("")
+        out("active avoids:")
+        for nid, row in avoids.items():
+            out(f"  {nid}  {row['mode']:<11} {row['remaining_s']:.0f}s remaining")
+    rows = summary.get("actions_recent", []) + summary.get("remote_actions", [])
+    if rows:
+        out("")
+        out("recent actions:")
+        for r in rows:
+            det = r.get("detail", {})
+            extra = " ".join(
+                f"{k}={v}" for k, v in sorted(det.items()) if k != "signature"
+            )
+            out(
+                f"  {r.get('actuator', '?'):<20}{r.get('trigger', '?'):<18}"
+                f"→ {r.get('target', '?')[:24]:<26}{r.get('outcome', '?'):<10}"
+                + (f" {extra}" if extra else "")
+            )
+    else:
+        out("")
+        out("no actions taken")
+
+
+def cmd_health(args):
+    if args.offline:
+        summary = _health_fixture()
+    else:
+        from ray_tpu.util import state
+
+        _connect()
+        summary = state.summarize_health(limit=args.limit)
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+        return 0
+    _render_health(summary)
+    return 0
+
+
 def cmd_drain_node(args):
     import ray_tpu
 
@@ -1075,6 +1189,17 @@ def main(argv=None):
     sp.add_argument("--offline", action="store_true",
                     help="render from a built-in fixture (no cluster)")
     sp.set_defaults(fn=cmd_logs)
+
+    sp = sub.add_parser(
+        "health",
+        help="self-healing plane: actuators, recent actions, active avoids",
+    )
+    sp.add_argument("--limit", type=int, default=50,
+                    help="recent actions to show")
+    sp.add_argument("--json", action="store_true", help="raw JSON summary")
+    sp.add_argument("--offline", action="store_true",
+                    help="render from a built-in fixture (no cluster)")
+    sp.set_defaults(fn=cmd_health)
 
     sub.add_parser("microbenchmark", help="core perf smoke").set_defaults(fn=cmd_microbenchmark)
 

@@ -1,0 +1,307 @@
+"""Actuator framework: bounded, rate-limited, audited remediation actions.
+
+The observability legs (PR 9 incidents, PR 10 leak/pressure detectors,
+PR 11 error-signature spikes, the compile-storm tracker) DETECT problems;
+this module is the half that ACTS on them. Reference analogues: the
+reference's raylet drains nodes it deems unhealthy and its memory monitor
+kills workers past the usage threshold — detection wired straight into a
+bounded actuator, audited through events. Same discipline here,
+generalized: every remediation is an :class:`Actuator` registered in one
+:class:`ActuatorRegistry` that enforces
+
+- a per-(actuator, signal-key) COOLDOWN (the same remedy never hammers
+  the same target in a loop),
+- a global actions-per-minute budget (a detector storm cannot turn the
+  health plane into its own denial of service),
+- per-actuator DRY-RUN (config ``health_dry_run``: the decision is made,
+  audited, and visible everywhere — the side effect is skipped),
+- a bounded audit ring + ``health_actions_total{actuator, outcome}``
+  metrics + first-class ``action`` lifecycle events (TRIGGERED →
+  FINISHED/FAILED), so "what did the cluster do to itself and why" is
+  answerable from ``state.summarize_health()`` alone.
+
+The registry is single-writer by design: the controller dispatches only
+from its asyncio loop (the controller-state discipline), so no lock is
+needed. Actuator ``fire`` may return a coroutine for remediations that
+cross the RPC plane; the registry schedules it and finalizes the audit
+row / lifecycle chain on completion.
+"""
+from __future__ import annotations
+
+import asyncio
+import collections
+import inspect
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("ray_tpu.actuators")
+
+# Bounded outcome vocabulary — these become metric tags.
+OUTCOMES = (
+    "acted",      # the remediation ran (or was scheduled and completed)
+    "dry_run",    # decision made, side effect suppressed by config
+    "skipped",    # no viable target (e.g. the offender is the head node)
+    "cooldown",   # same (actuator, key) fired too recently
+    "throttled",  # global actions-per-minute budget exhausted
+    "failed",     # the remediation raised / its RPC failed
+)
+
+_metrics: Optional[Dict[str, Any]] = None
+
+
+def _get_metrics() -> Dict[str, Any]:
+    """Process-wide singletons (Metric registers globally; a registry
+    re-created in tests must not duplicate series)."""
+    global _metrics
+    if _metrics is None:
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        _metrics = {
+            "actions": Counter(
+                "health_actions_total",
+                "Self-healing actions dispatched, by actuator and outcome",
+                ("actuator", "outcome"),
+            ),
+            "signals": Counter(
+                "health_signals_total",
+                "Detector signals observed by the health engine, by trigger",
+                ("trigger",),
+            ),
+            "avoids": Gauge(
+                "health_active_avoids",
+                "Nodes currently quarantined (hard) or admission-throttled "
+                "(soft) by the health plane",
+                ("mode",),
+            ),
+        }
+    return _metrics
+
+
+@dataclass
+class HealthSignal:
+    """One detector observation handed to the health plane.
+
+    ``trigger`` is the bounded trigger vocabulary (the incident-trigger
+    names plus detector-only ones); ``key`` is the cooldown/dedup key —
+    the node hex, call-site, or function name the signal is ABOUT;
+    ``target`` is where a remediation would aim (often == key)."""
+
+    trigger: str
+    key: str
+    detail: dict = field(default_factory=dict)
+    target: str = ""
+    ts: float = 0.0
+
+    def __post_init__(self):
+        if not self.ts:
+            self.ts = time.time()
+
+
+class Actuator:
+    """One bounded remediation. Subclasses set ``name`` (metric tag /
+    config key / audit label) and ``triggers`` (the signal kinds it
+    handles) and implement :meth:`fire`.
+
+    ``fire`` returns an outcome dict ``{"outcome": <OUTCOMES>, ...}``
+    (extra keys land in the audit row) or a coroutine resolving to one;
+    raising marks the action ``failed``."""
+
+    name: str = "base"
+    triggers: Tuple[str, ...] = ()
+
+    def __init__(self, cooldown_s: float = 30.0, dry_run: bool = False):
+        self.cooldown_s = float(cooldown_s)
+        self.dry_run = bool(dry_run)
+
+    def fire(self, signal: HealthSignal) -> Any:
+        raise NotImplementedError
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "triggers": list(self.triggers),
+            "cooldown_s": self.cooldown_s,
+            "dry_run": self.dry_run,
+        }
+
+
+class ActuatorRegistry:
+    """Dispatch detector signals to registered actuators under the
+    cooldown / budget / dry-run / audit rules (module docstring)."""
+
+    def __init__(
+        self,
+        audit_ring: int = 256,
+        max_actions_per_min: int = 6,
+        recorder: Optional[Callable[..., Any]] = None,
+    ):
+        self._actuators: List[Actuator] = []
+        self.actions: "collections.deque[dict]" = collections.deque(
+            maxlen=max(8, int(audit_ring))
+        )
+        self._last_fired: Dict[Tuple[str, str], float] = {}
+        self._fired_window: "collections.deque[float]" = collections.deque()
+        self.max_actions_per_min = int(max_actions_per_min)
+        # Lifecycle hook: record(kind, eid, state, **attrs). None in
+        # processes without a recorder (driver-side registries audit +
+        # ship events themselves).
+        self._recorder = recorder
+        self._seq = 0
+        self.signals_seen: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    def register(self, actuator: Actuator) -> Actuator:
+        self._actuators.append(actuator)
+        return actuator
+
+    def get(self, name: str) -> Optional[Actuator]:
+        for a in self._actuators:
+            if a.name == name:
+                return a
+        return None
+
+    # ------------------------------------------------------------------
+    def dispatch(self, signal: HealthSignal) -> List[dict]:
+        """Hand one signal to every actuator claiming its trigger.
+        Returns the audit rows created (possibly still ``pending`` for
+        coroutine-backed remediations)."""
+        self.signals_seen[signal.trigger] = (
+            self.signals_seen.get(signal.trigger, 0) + 1
+        )
+        try:
+            _get_metrics()["signals"].inc(1, {"trigger": signal.trigger})  # ray-tpu: lint-ignore[RTL004] — bounded trigger vocabulary
+        except Exception as e:  # noqa: BLE001 — metrics must not break dispatch
+            logger.debug("signal metric failed: %s", e)
+        rows = []
+        for act in self._actuators:
+            if signal.trigger not in act.triggers:
+                continue
+            rows.append(self._fire_one(act, signal))
+        return rows
+
+    def _fire_one(self, act: Actuator, signal: HealthSignal) -> dict:
+        now = time.monotonic()
+        self._seq += 1
+        row = {
+            "id": f"act-{self._seq}-{int(signal.ts * 1000) % 10_000_000}",
+            "ts": signal.ts,
+            "actuator": act.name,
+            "trigger": signal.trigger,
+            "key": signal.key,
+            "target": signal.target or signal.key,
+            "dry_run": act.dry_run,
+            "outcome": "pending",
+            "detail": dict(signal.detail),
+        }
+        ckey = (act.name, signal.key)
+        last = self._last_fired.get(ckey)
+        if last is not None and now - last < act.cooldown_s:
+            # Cooldown hits are NOT audited as actions (a sustained
+            # detector would flood the ring with no-ops) — only counted.
+            self._count(act.name, "cooldown")
+            row["outcome"] = "cooldown"
+            return row
+        while self._fired_window and now - self._fired_window[0] > 60.0:
+            self._fired_window.popleft()
+        if len(self._fired_window) >= self.max_actions_per_min:
+            self._count(act.name, "throttled")
+            row["outcome"] = "throttled"
+            return row
+        self._last_fired[ckey] = now
+        self._fired_window.append(now)
+        self.actions.append(row)
+        self._record(row, "TRIGGERED")
+        if act.dry_run:
+            self._finish(row, {"outcome": "dry_run"})
+            return row
+        try:
+            res = act.fire(signal)
+        except Exception as e:  # noqa: BLE001 — a broken actuator must not kill dispatch
+            logger.exception("actuator %s failed", act.name)
+            self._finish(row, {"outcome": "failed", "error": str(e)})
+            return row
+        if inspect.iscoroutine(res):
+            self._schedule(row, res, act.name)
+        else:
+            self._finish(row, res or {"outcome": "acted"})
+        return row
+
+    def _schedule(self, row: dict, coro, name: str):
+        """Run a remediation coroutine on the current loop; finalize the
+        audit row + lifecycle chain when it lands."""
+        try:
+            task = asyncio.ensure_future(coro)
+        except RuntimeError:  # no running loop (unit tests)
+            coro.close()
+            self._finish(row, {"outcome": "failed", "error": "no event loop"})
+            return
+
+        def done(t):
+            try:
+                res = t.result()  # ray-tpu: lint-ignore[RTL008] — done-callback: the task is already resolved, never waits
+            except Exception as e:  # noqa: BLE001 — remediation RPC failed
+                logger.warning("actuator %s remediation failed: %s", name, e)
+                self._finish(row, {"outcome": "failed", "error": str(e)})
+                return
+            self._finish(row, res or {"outcome": "acted"})
+
+        task.add_done_callback(done)
+
+    def _finish(self, row: dict, res: dict):
+        outcome = res.get("outcome", "acted")
+        if outcome not in OUTCOMES:
+            outcome = "acted"
+        row["outcome"] = outcome
+        for k, v in res.items():
+            if k != "outcome":
+                row["detail"][k] = v
+        self._count(row["actuator"], outcome)
+        self._record(
+            row, "FAILED" if outcome == "failed" else "FINISHED"
+        )
+
+    def _count(self, actuator: str, outcome: str):
+        try:
+            _get_metrics()["actions"].inc(1, {"actuator": actuator, "outcome": outcome})  # ray-tpu: lint-ignore[RTL004] — bounded actuator + outcome vocabularies
+        except Exception as e:  # noqa: BLE001
+            logger.debug("action metric failed: %s", e)
+
+    def _record(self, row: dict, state: str):
+        if self._recorder is None:
+            return
+        try:
+            self._recorder(
+                "action",
+                row["id"],
+                state,
+                actuator=row["actuator"],
+                trigger=row["trigger"],
+                target=row["target"],
+                outcome=row["outcome"] if state != "TRIGGERED" else None,
+                dry_run=row["dry_run"] or None,
+            )
+        except Exception as e:  # noqa: BLE001 — recorder must not break actions
+            logger.debug("action lifecycle record failed: %s", e)
+
+    # ------------------------------------------------------------------
+    def snapshot(self, limit: int = 50) -> dict:
+        outcomes: Dict[str, Dict[str, int]] = {}
+        for row in self.actions:
+            by = outcomes.setdefault(row["actuator"], {})
+            by[row["outcome"]] = by.get(row["outcome"], 0) + 1
+        return {
+            "actuators": [a.describe() for a in self._actuators],
+            "max_actions_per_min": self.max_actions_per_min,
+            "signals": dict(self.signals_seen),
+            "actions_recent": list(self.actions)[-max(1, limit):],
+            "outcomes": outcomes,
+        }
+
+
+def parse_dry_run(spec: str, name: str) -> bool:
+    """``health_dry_run`` config: comma-separated actuator names forced
+    into dry-run; ``*`` (or ``all``) covers every actuator."""
+    toks = {t.strip() for t in (spec or "").split(",") if t.strip()}
+    return bool(toks) and ("*" in toks or "all" in toks or name in toks)

@@ -49,6 +49,9 @@ _totals = {
 _history: Dict[str, "collections.deque"] = {}
 # per-function storm records: name -> {first_ts, last_ts, count, shapes, prev_shapes}
 _storms: Dict[str, dict] = {}
+# functions the health plane pinned into shape bucketing (storm actuator):
+# workloads consult is_pinned()/maybe_bucket() to pad dynamic dims.
+_pinned: set = set()
 _metrics = None  # lazy _CompileMetrics
 _storm_threshold = 5
 _storm_window_s = 60.0
@@ -242,6 +245,34 @@ def maybe_install() -> bool:
     return install(storm_threshold=threshold, storm_window_s=window)
 
 
+def pin_functions(names) -> dict:
+    """Storm actuator target: mark ``names`` as shape-pinned in this
+    process. Pinning changes no jax internals — it is advisory state the
+    WORKLOAD consults via :func:`maybe_bucket` (pad a dynamic dim up to
+    its power-of-2 bucket) or :func:`is_pinned` (choose a padded path).
+    Returns the full pinned set so the actuator can audit it."""
+    with _lock:
+        for n in names or ():
+            if isinstance(n, str) and n:
+                _pinned.add(n)
+        return {"pinned": sorted(_pinned)}
+
+
+def is_pinned(name: str) -> bool:
+    with _lock:
+        return name in _pinned
+
+
+def maybe_bucket(name: str, n: int) -> int:
+    """Round ``n`` up to the next power of two IF the health plane pinned
+    ``name`` (else return it unchanged). The storm-remediation contract:
+    a recompile storm driven by a drifting dimension collapses to at most
+    log2(max_n) compiles once the workload sizes through this."""
+    if n <= 0 or not is_pinned(name):
+        return n
+    return 1 << (n - 1).bit_length()
+
+
 def snapshot(max_functions: int = 20) -> dict:
     """Per-process compile stats for the state API / telemetry ship."""
     now = time.time()
@@ -272,6 +303,7 @@ def snapshot(max_functions: int = 20) -> dict:
                 for name, rec in _storms.items()
                 if rec["last_ts"] >= cutoff
             },
+            "pinned": sorted(_pinned),
             "functions": top,
         }
 
@@ -282,3 +314,4 @@ def _reset_for_tests():
                        cache_misses=0, storms=0)
         _history.clear()
         _storms.clear()
+        _pinned.clear()

@@ -69,6 +69,7 @@ ROWS = (
                        "lockwatch_")),
     ("Profiling", ("task_cpu_", "profiling_")),
     ("Logs & Errors", ("log_",)),
+    ("Self-healing", ("health_",)),
     ("Memory", ("object_store_", "object_refs_", "object_free_",
                 "memory_leak_")),
     ("Cluster Resources", ("tpu_hbm_", "node_",

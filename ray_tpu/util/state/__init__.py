@@ -161,6 +161,15 @@ def summarize_lifecycle() -> dict:
     return _require_worker()._call("summarize_lifecycle")
 
 
+def summarize_health(limit: int = 50) -> dict:
+    """Self-healing plane summary (core/health.py): registered actuators
+    with cooldown/dry-run config, recent actions with their trigger →
+    target → outcome audit rows, per-trigger signal counts, per-actuator
+    outcome tallies, and nodes currently quarantined or admission-
+    throttled by the health plane. Rendered by ``ray-tpu health``."""
+    return _require_worker()._call("summarize_health", limit=limit)
+
+
 def list_lifecycle_events(limit: int = 10000) -> List[dict]:
     """The newest ``limit`` lifecycle transition events from the
     controller's bounded ring ({ts, kind, id, state, prev?, dwell_ms?,

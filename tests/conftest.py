@@ -72,6 +72,44 @@ def ray_start_regular():
     ray_tpu.shutdown()
 
 
+def shared_cluster_fixtures(**init_kw):
+    """Module-level override for ``ray_start_regular`` that reuses ONE
+    cluster across the whole file instead of init/shutdown per test.
+
+    Usage (in a test module)::
+
+        from conftest import shared_cluster_fixtures
+        ray_start_regular, _shared_cluster = shared_cluster_fixtures(
+            num_cpus=4, resources={"TPU": 4})
+
+    Both names must be module attributes for pytest to collect them. The
+    per-test fixture is keep-alive, not scope="module": a test that needs
+    its own cluster config may call ``ray_tpu.shutdown()`` and init its
+    own (tearing that down again when done) — the NEXT fixture use simply
+    re-inits. The module-scoped guard tears the survivor down at file end.
+    """
+    import ray_tpu  # noqa: F401 — resolved lazily below
+    from ray_tpu.core import api as _api
+
+    @pytest.fixture(name="ray_start_regular")
+    def _shared(_shared_cluster_guard):
+        import ray_tpu
+
+        if _api._global_worker is None:
+            ray_tpu.init(**init_kw)
+        yield ray_tpu
+
+    @pytest.fixture(scope="module")
+    def _shared_cluster_guard():
+        yield
+        import ray_tpu
+
+        if _api._global_worker is not None:
+            ray_tpu.shutdown()
+
+    return _shared, _shared_cluster_guard
+
+
 @pytest.fixture
 def ray_start_cluster():
     """A Cluster object tests can add/remove nodes on (multi-node on one host)."""

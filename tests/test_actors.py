@@ -4,6 +4,16 @@ import pytest
 import ray_tpu
 from ray_tpu.exceptions import ActorDiedError
 
+from conftest import shared_cluster_fixtures
+
+# Shared cluster for the whole file (suite-time headroom). Actors some
+# tests leave running each hold 1 CPU for placement — the wide pool
+# keeps later tests schedulable without per-test teardown.
+ray_start_regular, _shared_cluster_guard = shared_cluster_fixtures(
+    num_cpus=16, resources={"TPU": 4}
+)
+
+
 
 def test_basic_actor(ray_start_regular):
     @ray_tpu.remote

@@ -4,7 +4,14 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from conftest import shared_cluster_fixtures
 from ray_tpu.exceptions import GetTimeoutError, TaskError
+
+# One cluster for the whole file (suite-time headroom): basic put/get/task
+# semantics are stateless between tests on a vanilla 4-CPU node.
+ray_start_regular, _shared_cluster_guard = shared_cluster_fixtures(
+    num_cpus=4, resources={"TPU": 4}
+)
 
 
 def test_put_get(ray_start_regular):

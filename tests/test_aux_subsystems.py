@@ -12,6 +12,15 @@ import ray_tpu
 from ray_tpu import workflow
 from ray_tpu.experimental import pubsub
 
+from conftest import shared_cluster_fixtures
+
+# Shared cluster for the whole file (suite-time headroom); pubsub
+# channels and workflow runs are test-local names.
+ray_start_regular, _shared_cluster_guard = shared_cluster_fixtures(
+    num_cpus=16, resources={"TPU": 4}
+)
+
+
 
 def test_pubsub_roundtrip(ray_start_regular):
     sub = pubsub.subscribe("news")

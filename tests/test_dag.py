@@ -9,8 +9,15 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from conftest import shared_cluster_fixtures
 from ray_tpu.channel import ChannelClosedError, IntraProcessChannel, ShmChannel
 from ray_tpu.dag import InputNode, MultiOutputNode
+
+# One cluster for the whole file (suite-time headroom): compiled-DAG tests
+# all run against a vanilla 4-CPU node and leave no cluster-level residue.
+ray_start_regular, _shared_cluster_guard = shared_cluster_fixtures(
+    num_cpus=4, resources={"TPU": 4}
+)
 
 
 # ---------------------------------------------------------------------------

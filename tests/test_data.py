@@ -4,8 +4,15 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from conftest import shared_cluster_fixtures
 from ray_tpu import data
 from ray_tpu.data.logical import FusedMap, LogicalPlan
+
+# One cluster for the whole file (suite-time headroom): every test here is
+# a pure dataset-pipeline exercise against a vanilla 4-CPU node.
+ray_start_regular, _shared_cluster_guard = shared_cluster_fixtures(
+    num_cpus=4, resources={"TPU": 4}
+)
 
 
 def test_range_take(ray_start_regular):

@@ -8,7 +8,14 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from conftest import shared_cluster_fixtures
 from ray_tpu import data
+
+# One cluster for the whole file (suite-time headroom): format round-trips
+# only exercise datasource IO against a vanilla 4-CPU node.
+ray_start_regular, _shared_cluster_guard = shared_cluster_fixtures(
+    num_cpus=4, resources={"TPU": 4}
+)
 
 
 # -- TFRecord wire format (no cluster needed) --------------------------------

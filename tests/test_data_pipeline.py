@@ -14,9 +14,17 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from conftest import shared_cluster_fixtures
 from ray_tpu import data
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.metrics import data_metrics
+
+# One cluster for the whole file (suite-time headroom). Tests that need a
+# bespoke cluster config (eviction pressure below) shut the shared one
+# down first; the next fixture use re-inits.
+ray_start_regular, _shared_cluster_guard = shared_cluster_fixtures(
+    num_cpus=4, resources={"TPU": 4}
+)
 
 
 def _collect(batches):
@@ -103,6 +111,8 @@ def test_zero_copy_pin_released_when_arrays_die(ray_start_regular):
 def test_zero_copy_batches_survive_eviction_pressure():
     """Pinned batches keep their bytes while ~3x the arena capacity of
     fresh objects churns through the store (lru_victim skips pins)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()  # needs its own (small-store) cluster
     ray_tpu.init(num_cpus=4, object_store_memory=32 * 1024 * 1024)
     try:
         arr = np.arange(400_000, dtype=np.float64)  # 3.2MB over 4 blocks

@@ -12,7 +12,15 @@ import time
 import pytest
 
 import ray_tpu
+from conftest import shared_cluster_fixtures
 from ray_tpu.exceptions import ActorDiedError
+
+# One cluster for the whole file (suite-time headroom). Actor-kill tests
+# are fine on a shared cluster (workers respawn); the fallback-path test
+# below needs its own config and shuts the shared one down first.
+ray_start_regular, _shared_cluster_guard = shared_cluster_fixtures(
+    num_cpus=4, resources={"TPU": 4}
+)
 
 
 @ray_tpu.remote
@@ -224,6 +232,8 @@ def test_large_result_via_shm(ray_start_regular):
 def test_fallback_controller_path():
     """direct_actor_calls=False routes through the controller (the
     pre-direct path stays supported)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()  # needs its own (controller-routed) cluster
     ray_tpu.init(num_cpus=2, _system_config={"direct_actor_calls": False})
     try:
         c = Counter.remote()

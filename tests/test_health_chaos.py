@@ -1,0 +1,448 @@
+"""Seeded chaos scenarios for the self-healing plane.
+
+One inject→detect→act→recover scenario per controller-side actuator
+(pressure spill, error-spike quarantine, storm pin, leak backpressure),
+the PR 13 orphaned-worker self-reap, and cross-trigger incident
+rate-limiting. Detection cadences are tightened via ``_system_config``
+and every wait is an event poll (no fixed sleeps), so the scenarios are
+deterministic and fast.
+"""
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster_utils import Cluster
+from ray_tpu.util import profiling
+from ray_tpu.util import state as state_api
+
+
+def _wait_for(fn, timeout=20.0, interval=0.1, desc="condition"):
+    """Poll ``fn`` until it returns a truthy value; fail with context."""
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {desc}; last={last!r}")
+
+
+def _acted(summary, actuator):
+    """The newest audit row where ``actuator`` actually acted, or None."""
+    for row in reversed(summary.get("actions_recent") or []):
+        if row["actuator"] == actuator and row["outcome"] == "acted":
+            return row
+    return None
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# memory_pressure → PressureSpillActuator
+
+
+def test_pressure_spill_actuator():
+    """Fill the head store past the (lowered) pressure threshold; the
+    health plane must proactively spill it down to the target fraction,
+    audit the action, and keep every object readable (restore path)."""
+    ray_tpu.init(
+        num_cpus=2,
+        object_store_memory=4 * 1024 * 1024,
+        _system_config={
+            "node_telemetry_interval_ms": 150,
+            "memory_incident_occupancy_pct": 0.5,
+            "health_spill_target_pct": 0.3,
+            "health_action_cooldown_s": 60.0,
+            "profiling_incidents": False,
+        },
+    )
+    try:
+        blobs = [os.urandom(256 * 1024) for _ in range(10)]  # 2.5MB ≥ 50%
+        refs = [ray_tpu.put(b) for b in blobs]
+        row = _wait_for(
+            lambda: _acted(state_api.summarize_health(), "pressure_spill"),
+            timeout=20, desc="pressure_spill action",
+        )
+        assert row["trigger"] == "memory_pressure"
+        assert row["detail"].get("spilled", 0) >= 1
+        assert row["detail"]["occupancy"] <= 0.35
+        summary = state_api.summarize_health()
+        assert summary["enabled"] is True
+        assert summary["signals"].get("memory_pressure", 0) >= 1
+        # The action is a first-class lifecycle chain (TRIGGERED→FINISHED).
+        evs = [e for e in state_api.list_lifecycle_events(limit=10000)
+               if e.get("kind") == "action"
+               and e.get("actuator") == "pressure_spill"]
+        assert {e["state"] for e in evs} >= {"TRIGGERED", "FINISHED"}
+        # Recovery: spilled objects restore transparently.
+        for ref, b in zip(refs, blobs):
+            assert ray_tpu.get(ref, timeout=30) == b
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# error_spike → SpikeQuarantineActuator (seeded RPC fault injection)
+
+
+def test_spike_quarantine_with_fault_injection(monkeypatch):
+    """Seeded FaultSchedule: every worker->controller ``task_done`` on
+    the second node errors, so each task completes but its completion
+    report dies — an ERROR-record storm attributed to that node. The
+    health plane must quarantine the node (hard avoid, drain semantics),
+    keep the head schedulable, and lift the quarantine after
+    ``health_quarantine_s``."""
+    plan = {
+        "seed": 16,
+        "rules": [{
+            "method": "task_done", "direction": "out", "action": "error",
+            "count": 100, "probability": 1.0,
+        }],
+    }
+    cluster = Cluster(
+        head_resources={"CPU": 2},
+        system_config={
+            "direct_normal_tasks": False,  # report via task_done RPC
+            "log_error_spike_threshold": 4,
+            "node_telemetry_interval_ms": 200,
+            "health_quarantine_s": 3.0,
+            "health_action_cooldown_s": 60.0,
+            "profiling_incidents": False,
+        },
+    )
+    try:
+        # Arm the fault plan AFTER the head is up and BEFORE the second
+        # node spawns: only that node's agent (and thus its workers)
+        # inherits it — the chaos is scoped to the node under test.
+        monkeypatch.setenv("RAY_TPU_FAULT_PLAN", json.dumps(plan))
+        node = cluster.add_node(num_cpus=4, resources={"SPIKE": 8})
+        monkeypatch.delenv("RAY_TPU_FAULT_PLAN")
+        cluster.connect()
+
+        @ray_tpu.remote(resources={"SPIKE": 1})
+        def boom(i):
+            import logging
+
+            # App-level error burst: one tight batch of identical ERROR
+            # records, attributed to this node by the log plane...
+            for _ in range(8):
+                logging.getLogger("chaos.spike").error(
+                    "chaos spike: injected task_done fault storm"
+                )
+            return i
+
+        # ...and the completion report itself dies to the injected
+        # task_done fault (one more ERROR record, and the lease wedges —
+        # exactly the failure shape a sick node produces). Fire-and-
+        # forget: the results are lost by design.
+        for i in range(2):
+            boom.remote(i)
+
+        row = _wait_for(
+            lambda: _acted(state_api.summarize_health(), "spike_quarantine"),
+            timeout=30, desc="spike_quarantine action",
+        )
+        assert row["trigger"] == "error_spike"
+        assert row["detail"]["node"] == node.node_id[:12]
+        summary = state_api.summarize_health()
+        avoid = summary["avoids"].get(node.node_id[:12])
+        if avoid is not None:  # may already have expired on slow machines
+            assert avoid["mode"] == "quarantine"
+
+        # Drain semantics: the head keeps serving CPU work throughout.
+        @ray_tpu.remote
+        def ok():
+            return "ok"
+
+        assert ray_tpu.get(ok.remote(), timeout=30) == "ok"
+
+        # Recovery: the quarantine expires on its own...
+        _wait_for(
+            lambda: node.node_id[:12]
+            not in state_api.summarize_health()["avoids"],
+            timeout=30, desc="quarantine expiry",
+        )
+
+        # ...and the node takes work again. Clearing the fault plan is
+        # itself the probe: it runs ON the node (SPIKE resource) and its
+        # own task_done succeeds once the in-process plan is cleared.
+        @ray_tpu.remote(resources={"SPIKE": 1})
+        def clear_plan():
+            from ray_tpu.util import chaos
+
+            chaos.install_fault_plan(None)
+            return "cleared"
+
+        got = None
+        for _ in range(5):  # one attempt per (possibly still-armed) worker
+            try:
+                got = ray_tpu.get(clear_plan.remote(), timeout=10)
+                break
+            except Exception:  # noqa: BLE001 — report eaten by the plan
+                continue
+        assert got == "cleared"
+    finally:
+        monkeypatch.delenv("RAY_TPU_FAULT_PLAN", raising=False)
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# recompile_storm → StormPinActuator
+
+
+def test_storm_pin_actuator():
+    """Drive the compile tracker in one actor process past the storm
+    threshold; the storm ships via device telemetry, the controller's
+    health tick pins the function in THAT process, and the workload-side
+    ``maybe_bucket`` contract flips to power-of-two padding."""
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "node_telemetry_interval_ms": 150,
+            "health_action_cooldown_s": 60.0,
+            "profiling_incidents": False,
+        },
+    )
+    try:
+
+        @ray_tpu.remote
+        class Stormer:
+            def storm(self):
+                from ray_tpu.util import compile_tracker
+
+                for i in range(8):  # > default threshold (5) in-window
+                    compile_tracker._note_compile(
+                        "chaos_storm_fn", f"f32[{i},128]"
+                    )
+                return sorted(
+                    compile_tracker.snapshot()["active_storms"]
+                )
+
+            def pin_state(self):
+                from ray_tpu.util import compile_tracker
+
+                return {
+                    "pinned": compile_tracker.is_pinned("chaos_storm_fn"),
+                    "bucket": compile_tracker.maybe_bucket(
+                        "chaos_storm_fn", 100
+                    ),
+                }
+
+        s = Stormer.remote()
+        assert ray_tpu.get(s.storm.remote(), timeout=30) == ["chaos_storm_fn"]
+        row = _wait_for(
+            lambda: _acted(state_api.summarize_health(), "storm_pin"),
+            timeout=30, desc="storm_pin action",
+        )
+        assert row["trigger"] == "recompile_storm"
+        assert "chaos_storm_fn" in row["detail"]["pinned"]["pinned"]
+        # Act landed in the right process: the storming function is now
+        # pinned there and dynamic dims bucket to powers of two.
+        st = _wait_for(
+            lambda: (lambda d: d if d["pinned"] else None)(
+                ray_tpu.get(s.pin_state.remote(), timeout=10)
+            ),
+            timeout=20, desc="pin visible in the storming process",
+        )
+        assert st["bucket"] == 128
+        assert state_api.summarize_health()["signals"].get(
+            "recompile_storm", 0
+        ) >= 1
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# memory_leak → LeakBackpressureActuator
+
+
+def test_leak_backpressure_actuator():
+    """An actor with gc disabled accumulates ObjectRefs trapped in
+    reference cycles — the classic accidental leak. The leak sweep flags
+    the call-site, the actuator gc-nudges the holder process, and the
+    cycles' refs drain back to the controller (recovery = the site's
+    open-object count collapses)."""
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "node_telemetry_interval_ms": 150,
+            "memory_leak_sweeps": 3,
+            "memory_leak_min_refs": 8,
+            "health_action_cooldown_s": 60.0,
+            "ref_flush_interval_ms": 100,
+            "profiling_incidents": False,
+        },
+    )
+    try:
+
+        @ray_tpu.remote
+        class Leaker:
+            def __init__(self):
+                import gc
+
+                gc.disable()
+
+            def leak(self, n):
+                import ray_tpu as rt
+
+                for _ in range(n):
+                    cell = {"ref": rt.put(b"leak-payload-" + b"x" * 4096)}
+                    cell["self"] = cell  # cycle: unreachable, uncollected
+                    del cell
+                return True
+
+        lk = Leaker.remote()
+
+        def leak_site_count():
+            cs = state_api.summarize_memory(limit=50).get("by_callsite") or {}
+            return sum(
+                row.get("objects", 0)
+                for site, row in cs.items()
+                if "test_health_chaos" in site
+            )
+
+        # Keep the call-site growing monotonically until the sweep flags
+        # it and the actuator fires.
+        row = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            ray_tpu.get(lk.leak.remote(6), timeout=10)
+            row = _acted(state_api.summarize_health(), "leak_backpressure")
+            if row:
+                break
+            time.sleep(0.05)
+        assert row, "leak_backpressure never acted"
+        assert row["trigger"] == "memory_leak"
+        nudged = row["detail"]["nudged"]
+        assert nudged, "no holder process was nudged"
+        assert any(
+            isinstance(r, dict) and r.get("unreachable", 0) > 0
+            for r in nudged.values()
+        ), nudged
+        # Recovery: the freed cycles drop their refs; the flagged site's
+        # open count collapses (well below the leak floor).
+        _wait_for(
+            lambda: leak_site_count() < 8, timeout=20,
+            desc="leaked refs reclaimed",
+        )
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# PR 13 orphan fix: workers self-reap when their agent dies
+
+
+def test_orphaned_workers_self_reap_on_agent_death():
+    """SIGKILL a node agent out from under its workers: the workers must
+    notice the dropped agent connection and exit within seconds instead
+    of lingering as strays (the PR 13 orphaned-worker issue)."""
+    cluster = Cluster(head_resources={"CPU": 1})
+    try:
+        node = cluster.add_node(num_cpus=1, resources={"ORPH": 2})
+        cluster.connect()
+
+        @ray_tpu.remote(resources={"ORPH": 1})
+        def worker_pid():
+            return os.getpid()
+
+        wpid = ray_tpu.get(worker_pid.remote(), timeout=30)
+        assert _pid_alive(wpid)
+        node.proc.send_signal(signal.SIGKILL)
+        _wait_for(
+            lambda: not _pid_alive(wpid), timeout=10, interval=0.1,
+            desc=f"orphaned worker {wpid} to self-reap",
+        )
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: cross-trigger incident rate-limiting
+
+
+def test_cross_trigger_incidents_do_not_starve_each_other(tmp_path, monkeypatch):
+    """Concurrent distinct triggers (pressure + spike + long-hold) each
+    capture: the per-trigger rate limit must not act as a global one."""
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path))
+    profiling._incident_last.clear()
+    try:
+        assert profiling.incident("memory_pressure", {"n": 1})
+        # Immediately after another trigger fired — still captures.
+        assert profiling.incident("error_spike", {"n": 1})
+        assert profiling.incident("lockwatch_long_hold", {"n": 1})
+        # Each trigger's OWN immediate repeat is rate-limited.
+        assert profiling.incident("memory_pressure", {"n": 2}) is None
+        assert profiling.incident("error_spike", {"n": 2}) is None
+        assert profiling.incident("lockwatch_long_hold", {"n": 2}) is None
+        # And a fresh trigger is still not starved by the saturated ones.
+        assert profiling.incident("memory_leak", {"n": 1})
+        rows = profiling.list_incidents(str(tmp_path))
+        assert {r["trigger"] for r in rows} == {
+            "memory_pressure", "error_spike", "lockwatch_long_hold",
+            "memory_leak",
+        }
+        assert len(rows) == 4
+    finally:
+        profiling._incident_last.clear()
+
+
+def test_concurrent_same_trigger_races_capture_once(tmp_path, monkeypatch):
+    """N racing detector threads for ONE trigger produce exactly one
+    bundle (the rate-limit check-and-stamp is atomic)."""
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path))
+    profiling._incident_last.clear()
+    try:
+        results = []
+        barrier = threading.Barrier(8)
+
+        def fire(i):
+            barrier.wait()
+            results.append(profiling.incident("memory_pressure", {"i": i}))
+
+        threads = [threading.Thread(target=fire, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len([r for r in results if r]) == 1
+        assert len(profiling.list_incidents(str(tmp_path))) == 1
+    finally:
+        profiling._incident_last.clear()
+
+
+def test_incident_keep_bound_shared_across_triggers(tmp_path, monkeypatch):
+    """The ``profiling_incident_keep`` disk bound applies across ALL
+    triggers by recency — interleaved captures stay bounded and the
+    survivors span multiple triggers (no single trigger evicts the
+    rest wholesale)."""
+    from ray_tpu.config import get_config
+
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path))
+    monkeypatch.setattr(get_config(), "profiling_incident_keep", 6)
+    profiling._incident_last.clear()
+    try:
+        triggers = ("memory_pressure", "error_spike", "lockwatch_long_hold")
+        for n in range(5):
+            for trig in triggers:
+                profiling._incident_last.clear()
+                assert profiling.incident(trig, {"round": n})
+        rows = profiling.list_incidents(str(tmp_path))
+        assert len(rows) == 6
+        # Survivors are the newest captures and keep trigger diversity.
+        assert all(r["detail"]["round"] >= 3 for r in rows)
+        assert {r["trigger"] for r in rows} == set(triggers)
+    finally:
+        profiling._incident_last.clear()

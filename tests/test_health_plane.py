@@ -1,0 +1,526 @@
+"""Health-plane unit tests: the actuator framework (util/actuators.py),
+scheduler avoids, proactive spill, compile-tracker pinning, the cadence
+actuator, lifecycle action-event ingest, and the health CLI render.
+
+Cluster-level inject→detect→act→recover scenarios live in
+tests/test_health_chaos.py; everything here runs in-process.
+"""
+import asyncio
+import os
+import time
+import types
+
+import pytest
+
+from ray_tpu.core.lifecycle import LifecycleRecorder
+from ray_tpu.core.object_store import PlasmaStore
+from ray_tpu.core.resources import NodeResources, ResourceSet
+from ray_tpu.core.scheduler import ClusterResourceScheduler, ClusterState
+from ray_tpu.core.task_spec import SchedulingStrategy
+from ray_tpu.util import compile_tracker
+from ray_tpu.util.actuators import (
+    Actuator,
+    ActuatorRegistry,
+    HealthSignal,
+    parse_dry_run,
+)
+from ray_tpu.utils.ids import NodeID, ObjectID
+
+
+class _CountingActuator(Actuator):
+    name = "counting"
+    triggers = ("test_trigger",)
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.fired = []
+
+    def fire(self, signal):
+        self.fired.append(signal.key)
+        return {"outcome": "acted", "n": len(self.fired)}
+
+
+def test_registry_cooldown_same_key():
+    reg = ActuatorRegistry(max_actions_per_min=100)
+    act = reg.register(_CountingActuator(cooldown_s=60.0))
+    r1 = reg.dispatch(HealthSignal("test_trigger", key="k1"))
+    r2 = reg.dispatch(HealthSignal("test_trigger", key="k1"))
+    assert r1[0]["outcome"] == "acted"
+    assert r2[0]["outcome"] == "cooldown"
+    assert act.fired == ["k1"]
+    # A different key is an independent cooldown bucket.
+    r3 = reg.dispatch(HealthSignal("test_trigger", key="k2"))
+    assert r3[0]["outcome"] == "acted"
+    # Cooldown hits are counted but kept OUT of the audit ring.
+    assert [row["outcome"] for row in reg.actions] == ["acted", "acted"]
+
+
+def test_registry_budget_throttle():
+    reg = ActuatorRegistry(max_actions_per_min=2)
+    reg.register(_CountingActuator(cooldown_s=0.0))
+    outcomes = [
+        reg.dispatch(HealthSignal("test_trigger", key=f"k{i}"))[0]["outcome"]
+        for i in range(4)
+    ]
+    assert outcomes == ["acted", "acted", "throttled", "throttled"]
+    # Throttled rows never enter the ring either.
+    assert len(reg.actions) == 2
+
+
+def test_registry_dry_run_and_recorder():
+    events = []
+
+    def rec(kind, eid, state, **attrs):
+        events.append((kind, eid, state, attrs))
+
+    reg = ActuatorRegistry(recorder=rec)
+    act = reg.register(_CountingActuator(dry_run=True))
+    row = reg.dispatch(HealthSignal("test_trigger", key="k"))[0]
+    assert row["outcome"] == "dry_run"
+    assert act.fired == []  # the side effect was suppressed
+    states = [(k, s) for k, _eid, s, _a in events]
+    assert states == [("action", "TRIGGERED"), ("action", "FINISHED")]
+    assert events[-1][3]["outcome"] == "dry_run"
+    assert events[-1][3]["dry_run"] is True
+
+
+def test_registry_sync_failure_marks_failed():
+    class Boom(Actuator):
+        name = "boom"
+        triggers = ("test_trigger",)
+
+        def fire(self, signal):
+            raise RuntimeError("nope")
+
+    reg = ActuatorRegistry()
+    reg.register(Boom())
+    row = reg.dispatch(HealthSignal("test_trigger", key="k"))[0]
+    assert row["outcome"] == "failed"
+    assert "nope" in row["detail"]["error"]
+
+
+def test_registry_async_fire_finalizes_row():
+    class AsyncAct(Actuator):
+        name = "async"
+        triggers = ("test_trigger",)
+
+        def fire(self, signal):
+            async def go():
+                await asyncio.sleep(0)
+                return {"outcome": "acted", "async": True}
+
+            return go()
+
+    reg = ActuatorRegistry()
+    reg.register(AsyncAct())
+
+    async def main():
+        row = reg.dispatch(HealthSignal("test_trigger", key="k"))[0]
+        assert row["outcome"] == "pending"
+        for _ in range(50):
+            if row["outcome"] != "pending":
+                break
+            await asyncio.sleep(0.01)
+        return row
+
+    row = asyncio.run(main())
+    assert row["outcome"] == "acted"
+    assert row["detail"]["async"] is True
+
+
+def test_registry_async_fire_without_loop_fails_cleanly():
+    class AsyncAct(Actuator):
+        name = "async"
+        triggers = ("test_trigger",)
+
+        def fire(self, signal):
+            async def go():
+                return {"outcome": "acted"}
+
+            return go()
+
+    reg = ActuatorRegistry()
+    reg.register(AsyncAct())
+    row = reg.dispatch(HealthSignal("test_trigger", key="k"))[0]
+    assert row["outcome"] == "failed"
+    assert "no event loop" in row["detail"]["error"]
+
+
+def test_registry_snapshot_shape():
+    reg = ActuatorRegistry(max_actions_per_min=100)
+    reg.register(_CountingActuator(cooldown_s=0.0))
+    reg.dispatch(HealthSignal("test_trigger", key="a"))
+    reg.dispatch(HealthSignal("test_trigger", key="b"))
+    reg.dispatch(HealthSignal("unclaimed_trigger", key="c"))
+    snap = reg.snapshot(limit=10)
+    assert snap["actuators"][0]["name"] == "counting"
+    assert snap["signals"] == {"test_trigger": 2, "unclaimed_trigger": 1}
+    assert snap["outcomes"]["counting"]["acted"] == 2
+    assert len(snap["actions_recent"]) == 2
+
+
+def test_parse_dry_run():
+    assert parse_dry_run("", "spike_quarantine") is False
+    assert parse_dry_run("spike_quarantine", "spike_quarantine") is True
+    assert parse_dry_run("a, spike_quarantine ,b", "spike_quarantine") is True
+    assert parse_dry_run("other", "spike_quarantine") is False
+    assert parse_dry_run("*", "anything") is True
+    assert parse_dry_run("all", "anything") is True
+
+
+# ---------------------------------------------------------------------------
+# Scheduler avoids (the quarantine / throttle half of the actuators)
+
+
+def _mk_state(n, cpus=4):
+    state = ClusterState()
+    ids = []
+    for _ in range(n):
+        nid = NodeID.from_random()
+        state.add_node(nid, NodeResources(ResourceSet.from_dict({"CPU": cpus})))
+        ids.append(nid)
+    return state, ids
+
+
+def test_soft_avoid_moves_node_to_back():
+    state, ids = _mk_state(3)
+    assert state.ordered_nodes() == ids
+    assert state.set_avoid(ids[0], 60.0, hard=False)
+    assert state.ordered_nodes() == [ids[1], ids[2], ids[0]]
+    assert state.soft_avoid_active()
+    state.clear_avoid(ids[0])
+    assert state.ordered_nodes() == ids
+    assert not state.soft_avoid_active()
+
+
+def test_hard_avoid_excludes_node_from_placement():
+    state, ids = _mk_state(2)
+    sched = ClusterResourceScheduler(state)
+    demand = ResourceSet.from_dict({"CPU": 1})
+    assert state.set_avoid(ids[0], 60.0, hard=True)
+    assert state.ordered_nodes() == [ids[1]]
+    for _ in range(3):
+        r = sched.schedule(demand, SchedulingStrategy())
+        assert r.node_id == ids[1]
+        state.nodes[ids[1]].acquire(demand)
+
+
+def test_soft_avoid_still_usable_as_last_resort():
+    state, ids = _mk_state(1)
+    sched = ClusterResourceScheduler(state)
+    state.set_avoid(ids[0], 60.0, hard=False)
+    r = sched.schedule(ResourceSet.from_dict({"CPU": 1}), SchedulingStrategy())
+    assert r.node_id == ids[0]  # the only node still takes the work
+
+
+def test_avoid_expires():
+    state, ids = _mk_state(2)
+    state.set_avoid(ids[0], 0.05, hard=True)
+    assert ids[0] not in state.ordered_nodes()
+    time.sleep(0.08)
+    assert state.ordered_nodes() == ids
+    assert state.avoids() == {}
+
+
+def test_avoid_missing_node_and_removal():
+    state, ids = _mk_state(2)
+    assert state.set_avoid(NodeID.from_random(), 60.0) is False
+    state.set_avoid(ids[0], 60.0, hard=True)
+    state.remove_node(ids[0])
+    assert state.avoids() == {}
+
+
+def test_hard_avoid_never_undrains_operator_drain():
+    state, ids = _mk_state(2)
+    state.set_draining(ids[0], True)
+    state.set_avoid(ids[0], 0.01, hard=True)
+    time.sleep(0.03)
+    state.prune_avoids()
+    # The quarantine expired but the operator's drain must survive.
+    assert state.nodes[ids[0]].draining is True
+    assert ids[0] not in state.ordered_nodes()
+
+
+# ---------------------------------------------------------------------------
+# Proactive spill (the pressure actuator's store half)
+
+
+def test_spill_to_fraction_drains_store(tmp_path):
+    store = PlasmaStore(str(tmp_path / "sess"), capacity=8 * 1024 * 1024,
+                        name="health-t1")
+    try:
+        blobs = {}
+        for _ in range(6):
+            oid = ObjectID.from_random()
+            data = os.urandom(1024 * 1024)
+            store.put_bytes(oid, data)
+            blobs[oid] = data
+        res = store.spill_to_fraction(0.25)
+        assert res["spilled"] >= 4
+        assert res["occupancy"] is not None and res["occupancy"] <= 0.26
+        st = store.stats()
+        assert st["spill_ops"] >= res["spilled"]
+        # Every object remains readable through the restore path.
+        for oid, data in blobs.items():
+            assert store.ensure_local(oid)
+            buf = store.get(oid)
+            assert bytes(buf.view()) == data
+            buf.close()
+        # Already below target → no-op.
+        res2 = store.spill_to_fraction(1.0)
+        assert res2["spilled"] == 0
+    finally:
+        store.destroy()
+
+
+def test_spill_to_fraction_skips_pinned(tmp_path):
+    store = PlasmaStore(str(tmp_path / "sess"), capacity=4 * 1024 * 1024,
+                        name="health-t2")
+    try:
+        oid = ObjectID.from_random()
+        store.put_bytes(oid, os.urandom(1024 * 1024))
+        buf = store.get(oid)  # reader pin
+        res = store.spill_to_fraction(0.0)
+        assert store.ensure_local(oid)
+        buf.close()
+        assert res["spilled"] == 0 or not store._entries[oid].spilled
+    finally:
+        store.destroy()
+
+
+# ---------------------------------------------------------------------------
+# Compile-tracker pinning (the storm actuator's worker half)
+
+
+def test_compile_tracker_pinning():
+    compile_tracker._reset_for_tests()
+    try:
+        assert compile_tracker.maybe_bucket("f", 100) == 100  # unpinned
+        out = compile_tracker.pin_functions(["f", "", None, "g"])
+        assert out["pinned"] == ["f", "g"]
+        assert compile_tracker.is_pinned("f")
+        assert not compile_tracker.is_pinned("h")
+        # Pinned: power-of-two padding gives a bounded shape vocabulary.
+        assert compile_tracker.maybe_bucket("f", 100) == 128
+        assert compile_tracker.maybe_bucket("f", 128) == 128
+        assert compile_tracker.maybe_bucket("f", 129) == 256
+        assert compile_tracker.maybe_bucket("f", 1) == 1
+        assert compile_tracker.maybe_bucket("f", 0) == 0
+        assert compile_tracker.snapshot()["pinned"] == ["f", "g"]
+    finally:
+        compile_tracker._reset_for_tests()
+
+
+def test_compile_tracker_storm_detection_direct():
+    compile_tracker._reset_for_tests()
+    try:
+        for i in range(compile_tracker._storm_threshold + 1):
+            compile_tracker._note_compile("hot_fn", f"f32[{i},8]")
+        snap = compile_tracker.snapshot()
+        assert "hot_fn" in snap["active_storms"]
+    finally:
+        compile_tracker._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# HealthEngine against a fake controller: storm tick + snapshot merge
+
+
+class _FakeConfig:
+    health_actuators = True
+    health_dry_run = ""
+    health_action_cooldown_s = 30.0
+    health_max_actions_per_min = 6
+    health_audit_ring = 64
+    health_quarantine_s = 60.0
+    health_throttle_s = 30.0
+    health_spill_target_pct = 0.6
+    health_nudge_max_procs = 8
+    compile_storm_window_s = 60.0
+
+
+def _fake_ctrl(device_state=None):
+    ctrl = types.SimpleNamespace()
+    ctrl.config = _FakeConfig()
+    ctrl.lifecycle = LifecycleRecorder(ring_size=512)
+    ctrl.cluster = ClusterState()
+    ctrl.nodes = {}
+    ctrl.workers = {}
+    ctrl.objects = {}
+    ctrl._live_device_state = lambda: dict(device_state or {})
+    return ctrl
+
+
+def test_health_engine_storm_tick_dedup():
+    from ray_tpu.core.health import HealthEngine
+
+    dev = {
+        "abc123:4242": {
+            "node_id": "abc123",
+            "pid": 4242,
+            "compile": {"active_storms": {"hot_fn": {"count": 9}}},
+        }
+    }
+    ctrl = _fake_ctrl(dev)
+    eng = HealthEngine(ctrl)
+    eng.tick()
+    snap = eng.snapshot()
+    # No worker with that pid exists → the pin is skipped but audited.
+    assert snap["signals"].get("recompile_storm") == 1
+    rows = [r for r in snap["actions_recent"] if r["actuator"] == "storm_pin"]
+    assert rows and rows[0]["outcome"] == "skipped"
+    assert rows[0]["detail"]["reason"] == "no_worker_peer"
+    # The same active storm must not re-dispatch every telemetry sweep.
+    eng.tick()
+    eng.tick()
+    assert eng.snapshot()["signals"].get("recompile_storm") == 1
+
+
+def test_health_engine_disabled_noop():
+    from ray_tpu.core.health import HealthEngine
+
+    ctrl = _fake_ctrl({"k:1": {"compile": {"active_storms": {"f": {}}}}})
+    ctrl.config.health_actuators = False
+    eng = HealthEngine(ctrl)
+    assert eng.observe(HealthSignal("memory_leak", key="site")) == []
+    eng.tick()
+    assert eng.snapshot()["signals"] == {}
+
+
+def test_health_engine_snapshot_merges_remote_actions():
+    from ray_tpu.core.health import HealthEngine
+
+    ctrl = _fake_ctrl()
+    eng = HealthEngine(ctrl)
+    # A driver-side cadence action arriving over task_events → ingest.
+    ctrl.lifecycle.ingest({
+        "ts": time.time(), "kind": "action", "id": "act-7-1",
+        "state": "FINISHED", "actuator": "podracer_cadence",
+        "trigger": "policy_lag", "target": "learner",
+        "outcome": "acted", "remote": True,
+    })
+    snap = eng.snapshot()
+    remote = snap.get("remote_actions") or []
+    assert len(remote) == 1
+    assert remote[0]["actuator"] == "podracer_cadence"
+    assert remote[0]["outcome"] == "acted"
+    assert remote[0]["remote"] is True
+
+
+def test_lifecycle_ingest_action_events():
+    rec = LifecycleRecorder(ring_size=64)
+    rec.ingest({"ts": time.time(), "kind": "action", "id": "a1",
+                "state": "TRIGGERED", "actuator": "x", "trigger": "t",
+                "target": "n"})
+    rec.ingest({"ts": time.time(), "kind": "action", "id": "a1",
+                "state": "FINISHED", "actuator": "x", "trigger": "t",
+                "target": "n", "outcome": "acted"})
+    evs = [e for e in rec.tail(10) if e["kind"] == "action"]
+    assert [e["state"] for e in evs] == ["TRIGGERED", "FINISHED"]
+    assert evs[1]["outcome"] == "acted"
+    assert evs[1]["actuator"] == "x"
+    # The chain closed: FINISHED is terminal for actions too.
+    assert ("action", "a1") not in rec._open
+
+
+# ---------------------------------------------------------------------------
+# Podracer cadence actuator (the driver-local fifth leg)
+
+
+def _fake_pipeline(publish_interval=8, max_policy_lag=8):
+    cfg = types.SimpleNamespace(
+        max_policy_lag=max_policy_lag, weights_publish_interval=publish_interval
+    )
+    return types.SimpleNamespace(
+        cfg=cfg,
+        publish_interval=publish_interval,
+        stats={"cadence_adaptations": 0},
+    )
+
+
+def test_cadence_actuator_tighten_and_relax():
+    from ray_tpu.rllib.podracer.pipeline import _CadenceActuator
+
+    p = _fake_pipeline(publish_interval=8, max_policy_lag=4)
+    act = _CadenceActuator(p, cooldown_s=0.0)
+    # Over budget → halve the effective interval.
+    r = act.fire(HealthSignal("policy_lag", key="learner",
+                              detail={"max_lag": 9}))
+    assert r["outcome"] == "acted" and r["direction"] == "tighten"
+    assert p.publish_interval == 4
+    act.fire(HealthSignal("policy_lag", key="learner", detail={"max_lag": 9}))
+    act.fire(HealthSignal("policy_lag", key="learner", detail={"max_lag": 9}))
+    assert p.publish_interval == 1
+    # At the floor: no further tighten, audited as skipped.
+    r = act.fire(HealthSignal("policy_lag", key="learner",
+                              detail={"max_lag": 9}))
+    assert r["outcome"] == "skipped" and r["reason"] == "at_floor"
+    # Recovered → relax back toward the configured interval.
+    r = act.fire(HealthSignal("policy_lag", key="learner",
+                              detail={"max_lag": 0}))
+    assert r["outcome"] == "acted" and r["direction"] == "relax"
+    assert p.publish_interval == 2
+    act.fire(HealthSignal("policy_lag", key="learner", detail={"max_lag": 0}))
+    act.fire(HealthSignal("policy_lag", key="learner", detail={"max_lag": 0}))
+    assert p.publish_interval == 8  # clamped at the configured value
+    r = act.fire(HealthSignal("policy_lag", key="learner",
+                              detail={"max_lag": 0}))
+    assert r["outcome"] == "skipped" and r["reason"] == "at_config"
+    assert p.stats["cadence_adaptations"] == 6
+
+
+def test_podracer_config_carries_cadence_knobs():
+    from ray_tpu.rllib.podracer.config import PodracerConfig
+
+    cfg = PodracerConfig()
+    assert cfg.adaptive_cadence is True
+    assert cfg.cadence_cooldown_s == 10.0
+
+
+# ---------------------------------------------------------------------------
+# CLI render (offline fixture path)
+
+
+def test_cli_health_offline_render(capsys):
+    from ray_tpu.scripts import cli
+
+    rc = cli.cmd_health(types.SimpleNamespace(offline=True, json=False,
+                                              limit=20))
+    out = capsys.readouterr().out
+    assert rc == 0
+    for needle in ("leak_backpressure", "pressure_spill", "storm_pin",
+                   "spike_quarantine", "podracer_cadence", "quarantine"):
+        assert needle in out
+
+
+def test_cli_health_offline_json(capsys):
+    import json as _json
+
+    from ray_tpu.scripts import cli
+
+    rc = cli.cmd_health(types.SimpleNamespace(offline=True, json=True,
+                                              limit=20))
+    out = capsys.readouterr().out
+    assert rc == 0
+    data = _json.loads(out)
+    assert data["enabled"] is True
+    assert {a["name"] for a in data["actuators"]} >= {
+        "leak_backpressure", "pressure_spill", "storm_pin",
+        "spike_quarantine",
+    }
+
+
+def test_cli_render_disabled():
+    from ray_tpu.scripts import cli
+
+    lines = []
+    cli._render_health({"enabled": False}, out=lines.append)
+    assert any("disabled" in ln for ln in lines)
+
+
+def test_grafana_self_healing_row():
+    from ray_tpu.util.grafana import _row_for
+
+    assert _row_for("health_actions_total") == "Self-healing"
+    assert _row_for("health_active_avoids") == "Self-healing"
+    assert _row_for("log_records_total") == "Logs & Errors"

@@ -8,8 +8,18 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from conftest import shared_cluster_fixtures
 from ray_tpu.core import api
 
+# One cluster for the whole file (suite-time headroom), on a fast GC
+# cadence: flush 50ms + sweep 150ms (the 2x safety floor) means one full
+# flush+sweep cycle is ~0.2s, so the "several cycles" sleeps below stay
+# several cycles at a fraction of the default 0.2s+1s wall time.
+ray_start_regular, _shared_cluster_guard = shared_cluster_fixtures(
+    num_cpus=4,
+    resources={"TPU": 4},
+    _system_config={"ref_flush_interval_ms": 50, "gc_sweep_interval_ms": 150},
+)
 
 BIG = 300_000  # > inline limit → shm object
 
@@ -24,7 +34,7 @@ def _wait_freed(hex_id: str, timeout: float = 12.0) -> bool:
     while time.time() < deadline:
         if not _object_listed(hex_id):
             return True
-        time.sleep(0.25)
+        time.sleep(0.05)
     return False
 
 
@@ -49,7 +59,7 @@ def test_dropped_inline_put_is_freed(ray_start_regular):
 
 def test_held_ref_is_not_freed(ray_start_regular):
     ref = ray_tpu.put(np.ones(BIG, np.uint8))
-    time.sleep(2.5)  # several flush+sweep cycles
+    time.sleep(0.8)  # several flush+sweep cycles (~0.2s each here)
     assert ray_tpu.get(ref)[0] == 1
 
 
@@ -88,7 +98,7 @@ def test_borrowed_ref_keeps_object_alive(ray_start_regular):
     assert ray_tpu.get(h.keep.remote([ref])) is True
     del ref
     gc.collect()
-    time.sleep(2.5)  # flushes + sweeps: borrower must protect it
+    time.sleep(0.8)  # flushes + sweeps: borrower must protect it
     assert _object_listed(hex_id), "borrowed object was wrongly freed"
     assert ray_tpu.get(h.read.remote()) == 9
     ray_tpu.kill(h)
@@ -102,7 +112,7 @@ def test_contained_ref_pinned_by_container(ray_start_regular):
 
     out_ref = make.remote()
     out = ray_tpu.get(out_ref)
-    time.sleep(2.0)  # the producing worker's local ref is long gone
+    time.sleep(0.6)  # the producing worker's local ref is long gone
     assert ray_tpu.get(out["inner"])[0] == 7
     # dropping the container AND the extracted inner ref frees the inner
     inner_hex = out["inner"].hex()
@@ -114,7 +124,7 @@ def test_contained_ref_pinned_by_container(ray_start_regular):
 def test_pending_task_args_pinned(ray_start_regular):
     @ray_tpu.remote
     def slow(x, lst):
-        time.sleep(2)
+        time.sleep(0.8)
         inner = ray_tpu.get(lst[0])
         return float(x[0] + inner[0])
 
@@ -123,7 +133,7 @@ def test_pending_task_args_pinned(ray_start_regular):
     fut = slow.remote(top, [nested])
     del top, nested
     gc.collect()
-    time.sleep(0.6)  # driver's drops flush while the task still runs
+    time.sleep(0.3)  # driver's drops flush while the task still runs
     assert ray_tpu.get(fut) == 7.0
 
 
@@ -137,14 +147,20 @@ def test_explicit_free_still_works(ray_start_regular):
 
 
 def test_auto_gc_can_be_disabled():
-    cfg = {"object_auto_gc": False}
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()  # needs its own (auto_gc off) cluster
+    cfg = {
+        "object_auto_gc": False,
+        "ref_flush_interval_ms": 50,
+        "gc_sweep_interval_ms": 150,
+    }
     ray_tpu.init(num_cpus=1, _system_config=cfg)
     try:
         ref = ray_tpu.put(np.zeros(BIG, np.uint8))
         hex_id = ref.hex()
         del ref
         gc.collect()
-        time.sleep(2.0)
+        time.sleep(0.8)  # several flush+sweep cycles on the fast cadence
         assert _object_listed(hex_id), "object freed despite auto_gc off"
     finally:
         ray_tpu.shutdown()
@@ -167,7 +183,7 @@ def test_actor_creation_args_pinned(ray_start_regular):
     a = A.options(max_restarts=1).remote(top, [nested])
     del top, nested
     gc.collect()
-    time.sleep(2.5)  # flush + sweep cycles while creation may be pending
+    time.sleep(0.8)  # flush + sweep cycles while creation may be pending
     assert ray_tpu.get(a.read.remote()) == 5
     ray_tpu.kill(a)
 

@@ -11,6 +11,16 @@ import ray_tpu
 from ray_tpu.exceptions import RuntimeEnvSetupError, TaskError
 from ray_tpu.runtime_env import RuntimeEnv, env_hash
 
+from conftest import shared_cluster_fixtures
+
+# Shared cluster for the whole file (suite-time headroom): runtime-env
+# worker affinity is keyed by env hash, so cached env workers from
+# earlier tests route correctly for later ones.
+ray_start_regular, _shared_cluster_guard = shared_cluster_fixtures(
+    num_cpus=16, resources={"TPU": 4}
+)
+
+
 
 def test_runtime_env_validation():
     e = RuntimeEnv(env_vars={"A": "1"}, working_dir="/tmp")

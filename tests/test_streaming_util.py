@@ -11,6 +11,15 @@ import ray_tpu
 from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.queue import Empty, Full, Queue
 
+from conftest import shared_cluster_fixtures
+
+# Shared cluster for the whole file (suite-time headroom). ActorPool /
+# Queue actors left running hold 1 CPU each — the wide pool absorbs them.
+ray_start_regular, _shared_cluster_guard = shared_cluster_fixtures(
+    num_cpus=16, resources={"TPU": 4}
+)
+
+
 
 def test_streaming_task(ray_start_regular):
     @ray_tpu.remote(num_returns="streaming")

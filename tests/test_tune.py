@@ -12,6 +12,15 @@ from ray_tpu import tune
 from ray_tpu.tune.schedulers import CONTINUE, STOP
 from ray_tpu.tune.trial import Trial
 
+from conftest import shared_cluster_fixtures
+
+# Shared cluster for the whole file (suite-time headroom): tune tears
+# its trial actors down at the end of each fit().
+ray_start_regular, _shared_cluster_guard = shared_cluster_fixtures(
+    num_cpus=16, resources={"TPU": 4}
+)
+
+
 
 def test_grid_search_expansion():
     gen = tune.BasicVariantGenerator(
