@@ -11,11 +11,12 @@ for the single-asyncio-loop design).
 
 Each row also carries the control-plane flight recorder's per-phase
 breakdown (``phases``: p50/p95/p99 dwell per lifecycle state, e.g.
-``task.SUBMITTED`` = submit→push latency, ``lease.REQUESTED`` = lease
-scheduling latency, ``task.RUNNING`` = execution; plus ``pending_reasons``
-— why-pending attribution deltas for the row) so a stalled depth says
-WHICH stage to attack. ``--no-recorder`` disables the recorder for A/B
-overhead runs.
+``task.SUBMITTED`` = submission handling + dep resolution,
+``task.QUEUED`` = waiting for lease/worker capacity, ``lease.REQUESTED``
+= lease scheduling latency, ``task.RUNNING`` = execution; plus
+``pending_reasons`` — why-pending attribution deltas for the row) so a
+stalled depth says WHICH stage to attack. ``--no-recorder`` disables
+the recorder for A/B overhead runs.
 
 Usage: python benchmarks/envelope.py [--queued 100000] [--pgs 1000]
            [--actor-records 10000] [--live-actors 60] [--churn 20000]
@@ -23,6 +24,10 @@ Usage: python benchmarks/envelope.py [--queued 100000] [--pgs 1000]
            [--out benchmarks/ENVELOPE_r03.json]
 """
 from __future__ import annotations
+
+# ray-tpu: lint-ignore-file[RTL007] — benchmark CLI: stdout JSON rows
+# (and the log-churn arm's deliberately chatty prints) ARE the output
+# contract, not package logging.
 
 import argparse
 import json
@@ -515,6 +520,91 @@ def bench_train_chaos(scenario: str, steps: int = 12) -> dict:
         shutil.rmtree(storage, ignore_errors=True)
 
 
+def _drain_noops(n: int) -> float:
+    """Submit+drain n single-CPU noops; returns drain throughput/s."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=1)
+    def noop():
+        return 0
+
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    out = ray_tpu.get(refs, timeout=3600)
+    dt = time.perf_counter() - t0
+    assert len(out) == n
+    return n / dt
+
+
+def bench_lease_ab(n: int, rounds: int = 2) -> dict:
+    """Round-17 on/off A/B: the same queued drain under the batched
+    lease/push control plane vs the legacy per-task lease path
+    (``lease_batching: False``). The kill-switch is cluster config, so
+    each arm is its own init; arms interleave B/L/B/L so box drift hits
+    both equally."""
+    import ray_tpu
+
+    arms = {"batched": [], "legacy": []}
+    for _ in range(rounds):
+        for name, flag in (("batched", True), ("legacy", False)):
+            ray_tpu.init(num_cpus=8, _system_config={"lease_batching": flag})
+            try:
+                arms[name].append(_drain_noops(n))
+            finally:
+                ray_tpu.shutdown()
+    batched = statistics.median(arms["batched"])
+    legacy = statistics.median(arms["legacy"])
+    return {
+        "benchmark": "lease_ab",
+        "n": n,
+        "rounds": rounds,
+        "batched_drain_per_s": round(batched, 1),
+        "legacy_drain_per_s": round(legacy, 1),
+        "speedup": round(batched / legacy, 2),
+    }
+
+
+def bench_recorder_ab(n: int, rounds: int = 2) -> dict:
+    """Recorder-overhead A/B on the batched path: with the flight
+    recorder (batch ingestion, round 17) vs ``lifecycle_events: False``.
+    Budget: the recorder may cost at most 3% of drain throughput."""
+    import ray_tpu
+
+    arms = {"on": [], "off": []}
+    for _ in range(rounds):
+        for name, flag in (("on", True), ("off", False)):
+            ray_tpu.init(num_cpus=8, _system_config={"lifecycle_events": flag})
+            try:
+                arms[name].append(_drain_noops(n))
+            finally:
+                ray_tpu.shutdown()
+    on = statistics.median(arms["on"])
+    off = statistics.median(arms["off"])
+    overhead_pct = max(0.0, (off - on) / off * 100.0)
+    return {
+        "benchmark": "recorder_ab",
+        "n": n,
+        "rounds": rounds,
+        "recorder_on_drain_per_s": round(on, 1),
+        "recorder_off_drain_per_s": round(off, 1),
+        "recorder_overhead_pct": round(overhead_pct, 2),
+        "recorder_overhead_ok": overhead_pct <= 3.0,
+    }
+
+
+# Seeded slow-node plan (--slow-node-seed): jitters the driver's control
+# RPCs — lease grants and batched pushes — so the scale arms re-run
+# under exactly-replayable link jitter. Deterministic given the seed.
+_SLOW_NODE_RULES = [
+    {"method": "lease_batch", "direction": "out", "action": "delay",
+     "delay_ms": 40.0, "probability": 0.25},
+    {"method": "lease_worker*", "direction": "out", "action": "delay",
+     "delay_ms": 40.0, "probability": 0.25},
+    {"method": "push_task*", "direction": "out", "action": "delay",
+     "delay_ms": 20.0, "probability": 0.15},
+]
+
+
 def bench_health_actuator(churn: int = 4000) -> dict:
     """Self-healing arm (the health plane's envelope): a seeded
     store-pressure plan against a deliberately small store measures the
@@ -749,6 +839,16 @@ def main():
                    help="checkpoint A/B: checkpoint payload size (MB)")
     p.add_argument("--ckpt-store-mbps", type=float, default=16.0,
                    help="checkpoint A/B: simulated store bandwidth (MB/s)")
+    p.add_argument("--lease-ab", type=int, default=10000,
+                   help="lease-batching on/off A/B arm: tasks per round "
+                        "(0 = skip)")
+    p.add_argument("--recorder-ab", type=int, default=10000,
+                   help="recorder-overhead A/B arm: tasks per round "
+                        "(0 = skip)")
+    p.add_argument("--slow-node-seed", type=int, default=0,
+                   help="install a seeded FaultSchedule slow-node delay "
+                        "plan in the driver for the shared-init rows "
+                        "(0 = off); rows are tagged with the seed")
     p.add_argument("--out", default="")
     args = p.parse_args()
 
@@ -765,6 +865,12 @@ def main():
         num_cpus=max(8, args.live_actors + 4),
         _system_config=overrides or None,
     )
+    if args.slow_node_seed:
+        from ray_tpu.util import chaos
+
+        chaos.install_fault_plan(
+            {"seed": args.slow_node_seed, "rules": _SLOW_NODE_RULES}
+        )
     rows = []
     try:
         for fn, fnargs, fnkw in (
@@ -779,10 +885,24 @@ def main():
         ):
             row = fn(*fnargs, **fnkw)
             row.update(lifecycle_phases())
+            if args.slow_node_seed:
+                row["slow_node_seed"] = args.slow_node_seed
             rows.append(row)
             print(json.dumps(row), flush=True)
     finally:
         ray_tpu.shutdown()
+        if args.slow_node_seed:
+            from ray_tpu.util import chaos
+
+            chaos.install_fault_plan(None)
+    if args.lease_ab:
+        row = bench_lease_ab(args.lease_ab)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if args.recorder_ab:
+        row = bench_recorder_ab(args.recorder_ab)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
     if not args.no_chaos:
         # Chaos arms manage their own cluster lifecycles (the MTTR arms
         # need per-worker HOST processes to kill) — run them after the

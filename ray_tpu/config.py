@@ -116,10 +116,32 @@ class Config:
     # Outstanding lease requests + held leases per scheduling key
     # (reference: max_pending_lease_requests_per_scheduling_category).
     max_leases_per_scheduling_key: int = 10
+    # Batched control plane (round 17): one rpc_lease_batch round-trip
+    # grants up to N leases per scheduling key, and pushes to an
+    # already-leased worker coalesce into one framed push_task_batch RPC
+    # with ONE gathered reply. Dynamic windows (grow on full grants /
+    # clean batch completion, shrink on spillback / failure) replace the
+    # static per-lease and per-key caps above, which then only serve the
+    # legacy path. Off = the round-13 per-task path (the bench A/B knob).
+    lease_batching: bool = True
+    # Cap on leases granted per batch request — also the ceiling of the
+    # per-key dynamic lease window.
+    lease_batch_max: int = 16
+    # Cap on tasks per push_task_batch frame — also the ceiling of the
+    # per-lease dynamic in-flight window.
+    task_push_batch_max: int = 64
 
     # --- control plane ---
     raylet_heartbeat_period_s: float = 0.5
     pubsub_batch_size: int = 1000
+    # Topic-bus resource sync (round 17): capacity changes publish
+    # coalesced per-node availability deltas on RESOURCES_CHANNEL no
+    # more often than this; subscribers mirror push-on-change instead of
+    # polling per sweep. 0 = publish every change uncoalesced.
+    resource_broadcast_min_interval_ms: int = 100
+    # Periodic full-snapshot reconciliation for topic-bus mirrors
+    # (out-of-order / dropped deltas self-heal within one period).
+    resource_reconcile_interval_s: float = 10.0
     task_event_buffer_size: int = 100000
     # Worker-side task-event flush cadence. The state API is eventually
     # consistent for direct-push tasks (reference: GCS task events are

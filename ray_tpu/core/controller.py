@@ -67,6 +67,11 @@ async def _notify_quiet(peer, method: str, *args, what: str = ""):
 
 _mem_metrics = None
 
+# Max object records walked per memory-census sweep (round 17): the
+# object-table census runs in bounded shards across sweeps instead of
+# one O(objects) controller-loop stall per publish.
+_CENSUS_CHUNK = 25_000
+
 
 def _get_mem_metrics():
     """Lazy controller-process memory gauges (Grafana "Memory" row).
@@ -112,6 +117,28 @@ def _get_mem_metrics():
             ),
         }
     return _mem_metrics
+
+
+_batch_m = None
+
+
+def _batch_metrics():
+    """Lazy batched-control-plane histograms (Grafana "Control Plane"
+    row): how many leases each rpc_lease_batch round-trip granted. The
+    caller-side twin (task_push_batch_size) lives in normal_direct.py
+    and ships over the ordinary metric channel."""
+    global _batch_m
+    if _batch_m is None:
+        from ray_tpu.util.metrics import Histogram
+
+        _batch_m = {
+            "lease_batch": Histogram(
+                "lease_batch_size",
+                "Leases granted per lease_batch round-trip",
+                boundaries=(1, 2, 4, 8, 16, 32, 64),
+            ),
+        }
+    return _batch_m
 
 
 @dataclass
@@ -380,7 +407,16 @@ class Controller:
 
         self._pulls: Dict[Tuple[ObjectID, NodeID], asyncio.Future] = {}
         self._fetch_peers = FetchPeerCache()
-        self._pubsub_subs: Dict[str, Set[rpc.Peer]] = {}
+        # Topic bus (core/pubsub.py): DEATH_CHANNEL plus the round-17
+        # resource/avoid channels ride the same subscriber registry.
+        from ray_tpu.core.pubsub import TopicBus
+
+        self.bus = TopicBus()
+        # Per-node monotonic sequence numbers for resource-delta pubsub
+        # (subscriber mirrors drop stale/out-of-order deltas by seq).
+        self._resource_seq: Dict[NodeID, int] = {}
+        self._last_resource_broadcast = 0.0
+        self._last_resource_reconcile = 0.0
         self.events: List[dict] = []  # task event ring buffer
         self.finished_specs: Dict[TaskID, TaskSpec] = {}  # lineage for reconstruction
         self.metrics: Dict[str, dict] = {}  # aggregated app metrics
@@ -398,7 +434,10 @@ class Controller:
         self._mem_trends: Dict[str, Any] = {}
         self._leak_flags: Dict[str, dict] = {}
         self._spill_ops_prev: Dict[NodeID, int] = {}
-        self._census_tick_n = 0  # sweep counter (scan-stride amortization)
+        self._census_tick_n = 0  # sweep counter
+        # In-progress sharded object-table census cycle (round 17):
+        # {"keys", "pos", "kinds", "by_site"} or None between cycles.
+        self._census_cycle: Optional[dict] = None
         # Cluster log plane (core/log_plane.py): error-signature index
         # fed by worker/agent/driver ERROR shipping (rpc_log_errors),
         # follow-mode subscribers (``ray-tpu logs --follow``) keyed by
@@ -675,6 +714,51 @@ class Controller:
         self._lease_reqs.append(req)
         return await req.fut
 
+    async def rpc_lease_batch(
+        self, peer: rpc.Peer, demand_items: list, strategy: SchedulingStrategy,
+        ehash: str, dep_keys: list, queued: int = 0, count: int = 1,
+    ):
+        """Grant up to ``count`` leases for one scheduling key in ONE
+        round-trip (round 17 — the per-task lease RPC was the measured
+        submission wall). Placement runs per lease against the live
+        resource view (the demand-shape index makes each decision O(1)),
+        but the lifecycle recording is ONE batched REQUESTED→GRANTED pair
+        and the reply is one frame. Partial fills are normal: the caller
+        shrinks its window on them (spillback signal). Zero immediate
+        grants parks a single request on the legacy path so the
+        pending-reason / ABANDONED semantics stay in one place."""
+        count = max(1, min(int(count), self.config.lease_batch_max))
+        demand = ResourceSet(dict(demand_items))
+        translated = self.scheduler.translated_pg_demand(demand, strategy)
+        t0 = time.time()
+        req = _LeaseReq(
+            demand, translated, strategy, ehash, dep_keys, peer,
+            asyncio.get_running_loop().create_future(),
+        )
+        grants = []
+        for _ in range(count):
+            grant = self._try_grant_lease(req)
+            if grant is None:
+                break
+            grants.append(grant)
+        if grants:
+            n = len(grants)
+            self.lifecycle.record_batch("lease", "REQUESTED", n, ts=t0)
+            self.lifecycle.record_batch(
+                "lease", "GRANTED", n, prev="REQUESTED",
+                dwell_ms=(time.time() - t0) * 1000.0,
+                node=grants[0]["node_id"][:12],
+            )
+            _batch_metrics()["lease_batch"].observe(n)
+            return {"grants": grants}
+        req.req_id = "R%d" % next(self._lreq_seq)
+        self.lifecycle.record("lease", req.req_id, "REQUESTED")
+        self.lifecycle.pending_reason("lease", req.req_id, req.block_reason)
+        self._lease_reqs.append(req)
+        grant = await req.fut
+        _batch_metrics()["lease_batch"].observe(1)
+        return {"grants": [grant]}
+
     def _try_grant_lease(self, req: _LeaseReq) -> Optional[dict]:
         nid = self._locality_choice(req)
         if nid is None:
@@ -713,8 +797,34 @@ class Controller:
 
     def _attribute_block(self, rec: TaskRecord, spec: TaskSpec, result):
         reason = self._pending_reason(spec.scheduling_strategy, result)
-        rec.pending_reason = reason
+        self._mark_pending(rec, spec, reason)
         self.lifecycle.pending_reason(*self._lc_key(spec), reason)
+
+    def _mark_pending(self, rec: TaskRecord, spec: TaskSpec, reason: str):
+        """Blocked-with-a-reason is its own lifecycle state (round 17):
+        QUEUED measures decision latency (intake → first verdict),
+        PENDING the attributed park time — a ghost-actor storm no longer
+        charges its deliberate hold to the scheduler. Guarded so
+        re-pumping a still-blocked record doesn't fragment the dwell."""
+        if not rec.pending_reason:
+            self.lifecycle.record(*self._lc_key(spec), "PENDING")
+        rec.pending_reason = reason
+
+    def _mark_class_pending(self, q, reason: str):
+        """Extend the head's block verdict to its class-mates: a blocked
+        class FIFO blocks every member behind the head. Marked members
+        form a queue PREFIX (intake clears the mark, so new arrivals are
+        unmarked at the tail), so the reverse walk stops at the first
+        marked member — O(new arrivals) amortized, not O(queue) per
+        block."""
+        for tid in reversed(q):
+            rec = self.tasks.get(tid)
+            if rec is None or rec.state != "PENDING":
+                continue
+            if rec.pending_reason:
+                break
+            rec.pending_reason = reason
+            self.lifecycle.record(*self._lc_key(rec.spec), "PENDING")
 
     def _locality_choice(self, req: _LeaseReq) -> Optional[NodeID]:
         """Prefer the feasible node holding the most dependency bytes
@@ -805,6 +915,41 @@ class Controller:
         w.state = "LEASED"
         w.env_hash = ehash or w.env_hash
         return {"worker_addr": w.listen_addr, "worker_id": w.worker_id.hex()}
+
+    async def rpc_lease_worker_batch(self, peer: rpc.Peer, lease_ids: list,
+                                     ehash: str):
+        """Hand out head-node workers for a BATCH of granted leases in
+        one round-trip (round 17). Strictly non-blocking pops — no await
+        between pop and bind, so the lease-release race rpc_lease_worker
+        guards against cannot happen here. Misses return None in place;
+        the caller falls back to the parking single-worker path for
+        those (and shrinks its window — the spillback signal). One
+        replacement spawn is triggered per miss so capacity catches up."""
+        out = []
+        misses = 0
+        for lease_id in lease_ids:
+            rec = self.leases.get(lease_id)
+            if rec is None:
+                out.append(None)  # released while the batch was in flight
+                continue
+            w = self._head_direct_pop(ehash)
+            if w is None:
+                out.append(None)
+                misses += 1
+                continue
+            rec.worker_id = w.worker_id
+            w.state = "LEASED"
+            w.env_hash = ehash or w.env_hash
+            out.append({"worker_addr": w.listen_addr,
+                        "worker_id": w.worker_id.hex()})
+        if misses:
+            node = self.nodes[self.head_node_id]
+            for _ in range(misses):
+                if len(node.workers) + node.num_starting < node.max_workers:
+                    self._spawn_head_direct(node)
+                else:
+                    await self._retire_mismatched_direct(ehash, node)
+        return out
 
     async def _retire_mismatched_direct(self, ehash: str, node=None):
         for wid in list(self._head_direct_free):
@@ -1111,6 +1256,10 @@ class Controller:
             q.append(tid)
             lk, leid = self._lc_key(spec)
             self.lifecycle.record(lk, leid, "QUEUED")
+            # Back in the queue = awaiting a fresh verdict: clear any
+            # stale block mark so the next verdict re-records PENDING
+            # (and keeps _mark_class_pending's marked-prefix invariant).
+            rec.pending_reason = ""
             for dep in spec.dependencies:
                 self._dep_index.setdefault(dep, set()).add(tid)
         # Keyed by (node, container_image, preset_env_hash): container
@@ -1165,7 +1314,7 @@ class Controller:
                     # not block class-mates whose deps are ready); any dep
                     # state change re-enqueues through the intake list
                     self._park_on_dep(dep, tid)
-                    rec.pending_reason = "waiting_deps"
+                    self._mark_pending(rec, spec, "waiting_deps")
                     self.lifecycle.pending_reason(*self._lc_key(spec), "waiting_deps")
                     advance = False
                     break
@@ -1177,6 +1326,7 @@ class Controller:
             result = self.scheduler.schedule(spec.resources, spec.scheduling_strategy)
             if result.node_id is None:
                 self._attribute_block(rec, spec, result)
+                self._mark_class_pending(q, rec.pending_reason)
                 return  # class blocked: infeasible for now
             # 3. idle worker (env-affine)?
             worker = self._idle_worker_on(result.node_id, ehash)
@@ -1212,7 +1362,7 @@ class Controller:
                     worker = self._idle_worker_on(result.node_id, ehash)
                 if worker is None:
                     reason = "spillback" if excluded else "no_idle_worker"
-                    rec.pending_reason = reason
+                    self._mark_pending(rec, spec, reason)
                     self.lifecycle.pending_reason(*self._lc_key(spec), reason)
                     if result.node_id is not None:
                         # Worker ramp-up for the queued depth, capped by
@@ -1232,6 +1382,7 @@ class Controller:
                                 result.node_id, image, ehash if image else ""
                             )
                             spawn_requests[skey] = spawn_requests.get(skey, 0) + n
+                    self._mark_class_pending(q, reason)
                     return  # class blocked until a worker attaches/frees
             # 4. acquire resources + dispatch. The recycle loop above
             # awaited: the task may have been cancelled/failed meanwhile —
@@ -1246,10 +1397,11 @@ class Controller:
             if not node_res.acquire(demand):
                 if claimed_direct:
                     await self._unclaim_direct(worker)
-                rec.pending_reason = "insufficient_resources"
+                self._mark_pending(rec, spec, "insufficient_resources")
                 self.lifecycle.pending_reason(
                     *self._lc_key(spec), "insufficient_resources"
                 )
+                self._mark_class_pending(q, "insufficient_resources")
                 return  # class blocked on resources
             rec.pending_reason = ""
             rec.acquired = demand
@@ -2240,50 +2392,33 @@ class Controller:
 
     # -- general pub/sub (reference: src/ray/pubsub/ — long-poll batched
     # publisher/subscriber; here subscribers ride their existing control
-    # connection, so publish is a push notify per subscriber) -----------
+    # connection, so publish is a push notify per subscriber). The
+    # subscriber registry and fan-out live in core/pubsub.py's TopicBus;
+    # these RPCs are thin delegates. On subscribe to the resource
+    # channel, the current full snapshot is pushed first so the mirror
+    # starts from a consistent base before deltas stream in.
     async def rpc_subscribe(self, peer: rpc.Peer, channel: str):
-        self._pubsub_subs.setdefault(channel, set()).add(peer)
-        peer.meta.setdefault("subscriptions", set()).add(channel)
+        from ray_tpu.core import pubsub as _ps
+
+        self.bus.subscribe(channel, peer)
+        if channel == _ps.RESOURCES_CHANNEL:
+            await peer.notify("pubsub_msg", channel, self._resource_snapshot())
+        elif channel == _ps.AVOID_CHANNEL:
+            await peer.notify("pubsub_msg", channel, self._avoid_snapshot())
         return True
 
     async def rpc_unsubscribe(self, peer: rpc.Peer, channel: str):
-        subs = self._pubsub_subs.get(channel)
-        if subs is not None:
-            subs.discard(peer)
-            if not subs:
-                del self._pubsub_subs[channel]
-        peer.meta.get("subscriptions", set()).discard(channel)
+        self.bus.unsubscribe(channel, peer)
         return True
 
     async def rpc_publish(self, peer: rpc.Peer, channel: str, msg) -> int:
         """Fan a message out to the channel's subscribers CONCURRENTLY
         (one wedged subscriber's backpressure must not stall the rest or
         the publisher); returns the number of live subscribers."""
-        subs = self._pubsub_subs.get(channel)
-        if not subs:
-            return 0
-        live = []
-        for p in list(subs):
-            if p.closed:
-                subs.discard(p)
-            else:
-                live.append(p)
-        if not subs:
-            self._pubsub_subs.pop(channel, None)
-        if live:
-            await asyncio.gather(
-                *(p.notify("pubsub_msg", channel, msg) for p in live),
-                return_exceptions=True,
-            )
-        return len(live)
+        return await self.bus.publish(channel, msg)
 
     def _drop_subscriber(self, peer: rpc.Peer):
-        for channel in list(peer.meta.get("subscriptions", ())):
-            subs = self._pubsub_subs.get(channel)
-            if subs is not None:
-                subs.discard(peer)
-                if not subs:
-                    del self._pubsub_subs[channel]
+        self.bus.drop_peer(peer)
 
     async def _publish_death(self, kind: str, eid: str, state: str, **attrs):
         """Push a lifecycle death/drain event to DEATH_CHANNEL
@@ -2292,7 +2427,7 @@ class Controller:
         a SIGKILLed host is detected in well under a second). No-op
         without subscribers; failures never propagate into the death
         path itself."""
-        if DEATH_CHANNEL not in self._pubsub_subs:
+        if not self.bus.has(DEATH_CHANNEL):
             return
         msg = {"kind": kind, "id": eid, "state": state, "ts": time.time()}
         msg.update({k: v for k, v in attrs.items() if v})
@@ -2776,11 +2911,13 @@ class Controller:
     def _memory_census_tick(self):
         """Per-telemetry-sweep census work: the Grafana "Memory" gauges,
         the open-ref growth (leak) detector, and the store-pressure
-        incident trigger. The object-table pass costs O(objects) of pure
-        Python on the controller loop, so its FREQUENCY is amortized to
-        the table size (one scan per ~50k records' worth of sweeps): at
-        envelope depth the leak sweeps thin out instead of stalling the
-        scheduler every telemetry tick."""
+        incident trigger. The object-table pass is SHARDED (round 17):
+        each sweep walks at most ``_CENSUS_CHUNK`` records against a
+        key snapshot taken at cycle start, accumulating kinds/by_site
+        across the cycle; gauges and the leak sweep publish once per
+        completed cycle. Per-tick controller-loop work is thereby
+        bounded regardless of table size — the old stride amortization
+        still paid one full O(objects) stall whenever it did fire."""
         if not getattr(self.config, "memory_census", True):
             return
         m = _get_mem_metrics()
@@ -2797,24 +2934,43 @@ class Controller:
             m["store_spilled"].set(store.get("spilled_bytes", 0), tag)
             self._pressure_check_node(nid, store)
         self._census_tick_n += 1
-        stride = max(1, len(self.objects) // 50_000)
-        if self._census_tick_n % stride == 0:
-            kinds = {"inline": 0, "shm": 0, "pending": 0, "failed": 0}
-            by_site: Dict[str, int] = {}
-            for orec in self.objects.values():
-                if orec.state == "PENDING":
-                    kinds["pending"] += 1
-                elif orec.state == "FAILED":
-                    kinds["failed"] += 1
-                elif orec.inline is not None:
-                    kinds["inline"] += 1
-                else:
-                    kinds["shm"] += 1
-                site = orec.callsite or "(unknown)"
-                by_site[site] = by_site.get(site, 0) + 1
+        if self._census_cycle is None:
+            # New cycle: snapshot the key list (a ref copy — milliseconds
+            # even at envelope depth) so the shard walk stays stable
+            # while the table churns underneath it.
+            self._census_cycle = {
+                "keys": list(self.objects),
+                "pos": 0,
+                "kinds": {"inline": 0, "shm": 0, "pending": 0, "failed": 0},
+                "by_site": {},
+            }
+        cyc = self._census_cycle
+        keys = cyc["keys"]
+        pos = cyc["pos"]
+        end = min(len(keys), pos + _CENSUS_CHUNK)
+        kinds = cyc["kinds"]
+        by_site: Dict[str, int] = cyc["by_site"]
+        objects = self.objects
+        for key in keys[pos:end]:
+            orec = objects.get(key)
+            if orec is None:
+                continue  # freed since the cycle's snapshot
+            if orec.state == "PENDING":
+                kinds["pending"] += 1
+            elif orec.state == "FAILED":
+                kinds["failed"] += 1
+            elif orec.inline is not None:
+                kinds["inline"] += 1
+            else:
+                kinds["shm"] += 1
+            site = orec.callsite or "(unknown)"
+            by_site[site] = by_site.get(site, 0) + 1
+        cyc["pos"] = end
+        if end >= len(keys):
             for kind, n in kinds.items():
                 m["refs_open"].set(n, {"kind": kind})  # ray-tpu: lint-ignore[RTL004] — fixed 4-value tier vocabulary
             self._leak_sweep(by_site)
+            self._census_cycle = None
 
     def _leak_sweep(self, by_site: Dict[str, int]):
         """Flag call-sites whose open-object count rose monotonically
@@ -3306,8 +3462,16 @@ class Controller:
         """Batched task events from workers executing direct-push tasks
         (reference: TaskEventBuffer flushes to the GCS task manager) —
         plus driver-side SUBMITTED/WORKER_ASSIGNED and agent-side worker
-        SPAWNED events, all folded into the flight recorder."""
-        for ev in batch:
+        SPAWNED events, all folded into the flight recorder.
+
+        Ingest is chunked: a 100k-task drain can land tens of thousands
+        of events in one flush, and a single synchronous walk that size
+        stalls the controller loop (and every lease/push RPC behind it)
+        for ~100 ms. Yielding between chunks keeps loop_p50 flat while
+        the recorder absorbs the same volume."""
+        for i, ev in enumerate(batch):
+            if i and i % 2000 == 0:
+                await asyncio.sleep(0)
             self.lifecycle.ingest(ev)
         # The legacy ring keeps its pre-recorder semantics — worker
         # EXECUTION events only. Driver SUBMITTED/WORKER_ASSIGNED and
@@ -3654,12 +3818,81 @@ class Controller:
             "truncated": len(per_name) > len(keep),
         }
 
+    def _control_plane_summary(self) -> dict:
+        """Round-17 control-plane rollup for ``ray-tpu state``: batch-size
+        histograms (how well batching amortizes the lease/push RPCs) and
+        the scheduler's fast-path vs full-scan split (how often placement
+        was a dict lookup + heap peek vs an O(nodes) walk)."""
+
+        def counter(name: str) -> Dict[str, float]:
+            e = self.metrics.get(name)
+            if not e:
+                return {}
+            out: Dict[str, float] = {}
+            for tags, v in e["series"].items():
+                label = ",".join(f"{k}={val}" for k, val in tags) or "(all)"
+                out[label] = out.get(label, 0) + v
+            return out
+
+        def hist(name: str):
+            e = self.metrics.get(name)
+            if not e:
+                return None
+            merged = bounds = None
+            for _tags, payload in e["series"].items():
+                st = payload["state"]
+                bounds = payload.get("boundaries") or bounds
+                merged = (
+                    list(st) if merged is None
+                    else [a + b for a, b in zip(merged, st)]
+                )
+            if merged is None or not bounds:
+                return None
+            count = int(merged[-1])
+            total = merged[-2]
+            def _lbl(b):
+                return int(b) if float(b).is_integer() else b
+
+            buckets = {}
+            for i, b in enumerate(bounds):
+                buckets[f"<={_lbl(b)}"] = merged[i]
+            buckets[f">{_lbl(bounds[-1])}"] = merged[len(bounds)]
+            return {
+                "count": count,
+                "sum": total,
+                "avg": round(total / count, 2) if count else 0.0,
+                "buckets": buckets,
+            }
+
+        return {
+            "scheduler_fast_path_total": counter("scheduler_fast_path_total"),
+            "scheduler_full_scan_total": sum(
+                counter("scheduler_full_scan_total").values()
+            ),
+            "lease_batch_size": hist("lease_batch_size"),
+            "task_push_batch_size": hist("task_push_batch_size"),
+            "pubsub_channels": self.bus.channels(),
+            "resource_deltas_published": sum(self._resource_seq.values()),
+        }
+
     async def rpc_summarize_lifecycle(self, peer):
         """Flight-recorder rollup: per-(kind, state) transition counts +
-        dwell p50/p95/p99, why-pending attribution counters, and live
-        pending attribution (see core/lifecycle.py)."""
+        dwell p50/p95/p99, why-pending attribution counters, live
+        pending attribution (see core/lifecycle.py), and the round-17
+        control-plane section (batch sizes, scheduler fast-path split)."""
+        from ray_tpu.util import metrics as _metrics
+
         self._drain_spawn_events()
-        return self.lifecycle.snapshot()
+        snap = self.lifecycle.snapshot()
+        # Fold any counters/histograms still sitting in this process's
+        # metric registry so the summary reflects work up to now, not up
+        # to the last telemetry sweep.
+        self.scheduler.drain_counters()
+        records = _metrics.drain_records()
+        if records:
+            await self.rpc_metrics_report(None, records)
+        snap["control_plane"] = self._control_plane_summary()
+        return snap
 
     async def rpc_list_lifecycle_events(self, peer, limit: int = 10000):
         self._drain_spawn_events()
@@ -3949,6 +4182,82 @@ class Controller:
         out.sort(key=lambda r: -r["skew_ms"])
         return out
 
+    # -- resource-view pubsub (round 17: push-on-change replaces
+    # per-sweep polling; core/pubsub.py documents the delivery model) --
+    def _resource_row(self, nid: NodeID, avoids) -> dict:
+        res = self.cluster.nodes[nid]
+        av = avoids.get(nid)
+        return {
+            "available": res.available.to_dict(),
+            "total": res.total.to_dict(),
+            "draining": res.draining,
+            "avoid": ("hard" if av[1] else "soft") if av else None,
+        }
+
+    def _resource_snapshot(self) -> dict:
+        avoids = self.cluster.avoids()
+        nodes = {}
+        for nid in self.cluster.nodes:
+            row = self._resource_row(nid, avoids)
+            row["seq"] = self._resource_seq.setdefault(nid, 0)
+            nodes[nid.hex()] = row
+        return {"snapshot": True, "nodes": nodes}
+
+    def _avoid_snapshot(self) -> dict:
+        avoids = self.cluster.avoids()
+        return {
+            "snapshot": True,
+            "avoid": {
+                nid.hex(): {"hard": hard, "deadline": dl}
+                for nid, (dl, hard) in avoids.items()
+            },
+            "draining": [
+                nid.hex() for nid, res in self.cluster.nodes.items() if res.draining
+            ],
+        }
+
+    async def _broadcast_resource_deltas(self):
+        """Drain the scheduler's dirty-node set into per-node seq'd
+        deltas on RESOURCES_CHANNEL, coalesced to at most one publish
+        per resource_broadcast_min_interval_ms; a full snapshot rides
+        the same channel every resource_reconcile_interval_s so mirrors
+        converge past any dropped/reordered deltas. Avoid/drain state
+        pushes to AVOID_CHANNEL on the reconcile cadence (it also rides
+        every resource delta, so agents gating on the resource mirror
+        see it immediately)."""
+        from ray_tpu.core import pubsub as _ps
+
+        dirty = self.cluster.dirty_nodes
+        if not (self.bus.has(_ps.RESOURCES_CHANNEL) or self.bus.has(_ps.AVOID_CHANNEL)):
+            dirty.clear()  # nobody listening — don't let the set grow
+            return
+        now = time.monotonic()
+        min_iv = self.config.resource_broadcast_min_interval_ms / 1000.0
+        if dirty and now - self._last_resource_broadcast >= min_iv:
+            self._last_resource_broadcast = now
+            avoids = self.cluster.avoids()
+            batch = list(dirty)
+            dirty.clear()
+            for nid in batch:
+                seq = self._resource_seq.get(nid, 0) + 1
+                self._resource_seq[nid] = seq
+                if nid not in self.cluster.nodes:
+                    # Seq floor is kept: a re-registered node continues
+                    # the sequence so mirrors never mistake its first
+                    # post-rejoin delta for a stale pre-removal one.
+                    msg = {"node": nid.hex(), "seq": seq, "removed": True}
+                else:
+                    msg = self._resource_row(nid, avoids)
+                    msg["node"] = nid.hex()
+                    msg["seq"] = seq
+                await self.bus.publish(_ps.RESOURCES_CHANNEL, msg)
+        if now - self._last_resource_reconcile >= self.config.resource_reconcile_interval_s:
+            self._last_resource_reconcile = now
+            if self.bus.has(_ps.RESOURCES_CHANNEL):
+                await self.bus.publish(_ps.RESOURCES_CHANNEL, self._resource_snapshot())
+            if self.bus.has(_ps.AVOID_CHANNEL):
+                await self.bus.publish(_ps.AVOID_CHANNEL, self._avoid_snapshot())
+
     async def _head_telemetry_loop(self):
         """The controller doubles as the head node's agent — sample the
         head host + its store on the same cadence the agents report."""
@@ -3996,6 +4305,16 @@ class Controller:
                 self.health.tick()
             except Exception:  # noqa: BLE001 — health must not kill telemetry
                 logger.exception("health tick failed")
+            # Resource-view pubsub: coalesced dirty-node deltas plus the
+            # periodic reconcile snapshot (round 17).
+            try:
+                await self._broadcast_resource_deltas()
+            except Exception:  # noqa: BLE001 — pubsub must not kill telemetry
+                logger.exception("resource delta broadcast failed")
+            # Scheduler fast-path/full-scan counters accumulate as plain
+            # ints on the decision path (a metrics inc per placement
+            # would cost more than the fast path saves) — flush here.
+            self.scheduler.drain_counters()
             # Metrics recorded IN the controller process (head-side
             # object transfers, chunk serving) have no CoreWorker flusher
             # — fold them straight into the aggregation.

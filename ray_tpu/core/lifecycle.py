@@ -255,6 +255,64 @@ class LifecycleRecorder:
         self._recorded += 1
         return ev
 
+    def record_batch(self, kind: str, state: str, n: int,
+                     ts: Optional[float] = None, prev: Optional[str] = None,
+                     dwell_ms: Optional[float] = None, **attrs) -> Optional[dict]:
+        """Record ``n`` identical transitions as ONE ring event.
+
+        The batched lease path grants N leases in one controller
+        round-trip; recording them one-by-one would re-serialize exactly
+        what the batching won (N record() calls, N ring appends, N
+        entries churning the _open LRU). This folds the whole grant
+        batch into one ring event carrying ``count``, one count bump of
+        n, and one bulk dwell extension.
+
+        Only for chains that OPEN AND CLOSE within the same call site
+        (e.g. lease REQUESTED→GRANTED inside rpc_lease_batch): it never
+        touches the ``_open``/``_closed`` maps, so out-of-order merging
+        against per-event record() calls for the same entities is the
+        caller's responsibility.
+        """
+        if not self.enabled or n <= 0:
+            return None
+        state = _CANONICAL.get(state, state)
+        if ts is None:
+            ts = time.time()
+        if dwell_ms is not None and prev is not None:
+            pkey = (kind, prev)
+            dq = self._dwell.get(pkey)
+            if dq is None:
+                dq = self._dwell[pkey] = collections.deque(
+                    maxlen=self._dwell_samples
+                )
+            dq.extend([dwell_ms] * n)
+            pend = self._pending_dwell.get(pkey)
+            if pend is None:
+                pend = self._pending_dwell[pkey] = []
+            pend.extend([dwell_ms] * n)
+            if kind == "lease" and state == "GRANTED":
+                self._pending_lease.extend([dwell_ms] * n)
+        skey = (kind, state)
+        self._counts[skey] = self._counts.get(skey, 0) + n
+        self._pending_transitions[skey] = (
+            self._pending_transitions.get(skey, 0) + n
+        )
+        now_m = time.monotonic()
+        if now_m - self._last_metric_flush >= self._METRIC_FLUSH_S:
+            self.flush_metrics(now_m)
+        ev = {"ts": ts, "kind": kind, "id": "(batch)", "state": state,
+              "count": n}
+        if prev is not None:
+            ev["prev"] = prev
+        if dwell_ms is not None:
+            ev["dwell_ms"] = round(dwell_ms, 3)
+        for k, v in attrs.items():
+            if v is not None and v != "":
+                ev[k] = v
+        self.events.append(ev)
+        self._recorded += n
+        return ev
+
     def pending_reason(self, kind: str, eid: str, reason: Optional[str]):
         """Attribute WHY an entity is stuck pending. Counted once per
         reason CHANGE (a blocked class re-visited every pump must not

@@ -183,8 +183,69 @@ class NodeAgent:
         ncpu = int(resources.get("CPU", 1))
         self._max_direct = max(4 * max(ncpu, 1), 16)
         self._listen_addr = ""  # set in run()
+        # Push-fed local cluster view (round 17, core/pubsub.py): the
+        # controller streams per-node availability deltas and avoid/
+        # drain state instead of the agent polling per decision. Mirror
+        # stats ride the telemetry heartbeat; self-avoid transitions are
+        # logged for operators.
+        from ray_tpu.core.pubsub import ResourceViewMirror
+
+        self.resource_mirror = ResourceViewMirror()
+        self._avoid_view: Dict = {"avoid": {}, "draining": []}
+        self._self_avoided = False
 
     # -- notifications from the controller ------------------------------
+    def rpc_pubsub_msg(self, peer, channel: str, message):
+        """Topic-bus push (round 17): resource deltas/snapshots feed the
+        local mirror; avoid/drain snapshots update the avoid view. Both
+        are at-most-once pushes — the periodic reconcile snapshot is
+        what guarantees convergence (see core/pubsub.py)."""
+        from ray_tpu.core import pubsub as _ps
+
+        if channel == _ps.RESOURCES_CHANNEL:
+            self.resource_mirror.ingest(message)
+            self._note_self_avoid()
+        elif channel == _ps.AVOID_CHANNEL:
+            if isinstance(message, dict) and message.get("snapshot"):
+                self._avoid_view = {
+                    "avoid": message.get("avoid", {}),
+                    "draining": message.get("draining", []),
+                }
+                self._note_self_avoid()
+
+    def _note_self_avoid(self):
+        """Log transitions of THIS node's avoid/drain standing (pushed,
+        not polled — the operator sees quarantine land in the agent log
+        within one broadcast interval)."""
+        me = self.node_id.hex()
+        view = self.resource_mirror.nodes.get(me) or {}
+        avoided = bool(
+            view.get("avoid")
+            or view.get("draining")
+            or me in self._avoid_view.get("avoid", {})
+            or me in self._avoid_view.get("draining", [])
+        )
+        if avoided != self._self_avoided:
+            self._self_avoided = avoided
+            if avoided:
+                logger.warning(
+                    "this node is now avoided/draining (pushed via topic "
+                    "bus) — existing leases keep running; no new placements"
+                )
+            else:
+                logger.warning("this node's avoid/drain standing cleared")
+
+    def rpc_resource_view(self, peer):
+        """The agent's push-fed mirror, for tests and `ray-tpu` debug
+        tooling (equivalence vs. the controller's authoritative view)."""
+        return {
+            "nodes": self.resource_mirror.nodes,
+            "applied": self.resource_mirror.applied,
+            "stale": self.resource_mirror.stale,
+            "reconciles": self.resource_mirror.reconciles,
+            "avoid_view": self._avoid_view,
+        }
+
     def rpc_start_workers(self, peer, n: int, container_image: str = None,
                           preset_env_hash: str = ""):
         extra = {"RAY_TPU_PRESET_ENV_HASH": preset_env_hash} if preset_env_hash else None
@@ -452,6 +513,38 @@ class NodeAgent:
             self._granting.discard(lid)
             self._released_leases.discard(lid)
 
+    def rpc_lease_worker_batch(self, peer, lease_ids: list, ehash: str):
+        """Hand out workers for a BATCH of controller-granted leases in
+        one round-trip (round 17). Strictly non-blocking: no await
+        between pop and bind, so the lease-release race rpc_lease_worker
+        parks against cannot happen here. Misses return None in place —
+        the caller falls back to the parking single-worker path for
+        those — and each miss triggers one spawn/retire so pool capacity
+        catches up with the window."""
+        out = []
+        misses = 0
+        for lease_id in lease_ids:
+            lid = bytes(lease_id)
+            if lid in self._released_leases:
+                self._released_leases.discard(lid)
+                out.append(None)
+                continue
+            w = self._pop_free(ehash)
+            if w is None:
+                out.append(None)
+                misses += 1
+                continue
+            w.busy = True
+            w.env_hash = ehash or w.env_hash
+            self._lease_workers[lid] = w.wid
+            out.append({"worker_addr": w.addr, "worker_id": w.wid})
+        for _ in range(misses):
+            if len(self._direct) + self._direct_starting < self._max_direct:
+                self._spawn_direct()
+            else:
+                self._retire_mismatched(ehash)
+        return out
+
     def _spawn_direct(self):
         self._direct_starting += 1
         proc = spawn_worker(
@@ -704,6 +797,17 @@ class NodeAgent:
         cfg = (info or {}).get("config") or {}
         self._chunk_bytes = int(cfg.get("object_transfer_chunk_bytes", chunk_fallback))
         self._config = cfg
+        # Join the push-fed resource/avoid channels (round 17). Runs on
+        # every (re-)register, so a controller restart re-subscribes and
+        # the first snapshot re-seeds the mirror. Best-effort: an old
+        # controller without the bus just leaves the mirror empty.
+        try:
+            from ray_tpu.core import pubsub as _ps
+
+            await peer.call("subscribe", _ps.RESOURCES_CHANNEL)
+            await peer.call("subscribe", _ps.AVOID_CHANNEL)
+        except Exception as e:  # noqa: BLE001 — mirror is observability
+            logger.debug("resource pubsub subscribe failed: %s", e)
 
     async def run(self):
         from ray_tpu.utils.net import bind_host, host_ip
@@ -782,6 +886,12 @@ class NodeAgent:
             sample = node_telemetry.build_node_sample(cpu, self.store)
             sample["num_direct_workers"] = len(self._direct)
             sample["num_children"] = len(_children)
+            sample["resource_mirror"] = {
+                "nodes": len(self.resource_mirror.nodes),
+                "applied": self.resource_mirror.applied,
+                "stale": self.resource_mirror.stale,
+                "reconciles": self.resource_mirror.reconciles,
+            }
             records = _metrics.drain_records()
             from ray_tpu.core import log_plane as _lp
 

@@ -6,27 +6,42 @@ workers, PushNormalTask pushes to the leased worker) +
 src/ray/core_worker/lease_policy.cc (locality-aware raylet choice) +
 src/ray/raylet/local_task_manager.cc:122 (the node-local dispatch half).
 
-Shape here, mapped onto the controller/agent split:
+Shape here, mapped onto the controller/agent split (round 17 — every
+hop is BATCHED; one arrow carries a window, not one task):
 
-  caller ──lease_request──▶ controller   (PLACEMENT ONLY: picks the node —
-                                          locality-aware — and reserves the
-                                          lease's resources)
-  caller ──lease_worker───▶ node agent   (the agent owns the node's
-                                          free-worker view and hands out /
-                                          spawns a worker; the controller
-                                          plays this role for head-node
-                                          leases)
-  caller ──push_task──────▶ worker       (direct, pipelined, lease reused
-                                          across the scheduling key's
-                                          queue; results land in the
-                                          caller's owner-local memory
-                                          store)
+  caller ──lease_batch─────────▶ controller  (PLACEMENT ONLY: grants up
+                                              to lease_batch_max leases
+                                              for the key in ONE
+                                              round-trip — locality-
+                                              aware, resources reserved
+                                              per lease)
+  caller ──lease_worker_batch──▶ node agent  (the agent owns the node's
+                                              free-worker view; binds a
+                                              worker per grant non-
+                                              blocking, None = miss →
+                                              parked single claim; the
+                                              controller plays this role
+                                              for head-node leases)
+  caller ──push_task_batch─────▶ worker      (a WINDOW of tasks per
+                                              frame, ONE gathered reply,
+                                              two frames double-buffered
+                                              per lease; results land in
+                                              the caller's owner-local
+                                              memory store)
 
-The controller is consulted once per LEASE, not once per task — a queue of
-10k same-shaped tasks costs a handful of lease round-trips, and every push
-and reply travels caller↔worker. Dependencies are resolved caller-side
-before a task becomes leaseable (reference: LocalDependencyResolver), so a
-leased worker never blocks on a dep fetch while holding its slot.
+Both windows are dynamic (TCP-style slow start): the per-key lease
+window doubles while full batch requests come back fully granted and
+halves on partial grants / pool misses (spillback); the per-lease push
+window doubles on clean full-window completions and halves on a lost
+worker. ``lease_batching=False`` restores the per-lease/per-task
+round-13 path (the bench A/B knob).
+
+The controller is consulted once per LEASE BATCH, not once per task — a
+queue of 10k same-shaped tasks costs a handful of batched round-trips,
+and every push and reply travels caller↔worker. Dependencies are
+resolved caller-side before a task becomes leaseable (reference:
+LocalDependencyResolver), so a leased worker never blocks on a dep
+fetch while holding its slot.
 
 All submitter state is mutated ONLY on the CoreWorker's asyncio loop
 thread (same single-writer discipline as direct.py).
@@ -47,6 +62,24 @@ from ray_tpu.utils import rpc
 
 logger = logging.getLogger("ray_tpu.normal_direct")
 
+_push_m = None
+
+
+def _push_batch_hist():
+    """Lazy caller-side batch-size histogram (one observe per FRAME, not
+    per task; ships to the controller over the ordinary metric channel —
+    the controller-side twin lease_batch_size lives in controller.py)."""
+    global _push_m
+    if _push_m is None:
+        from ray_tpu.util.metrics import Histogram
+
+        _push_m = Histogram(
+            "task_push_batch_size",
+            "Tasks per push_task_batch frame",
+            boundaries=(1, 2, 4, 8, 16, 32, 64, 128),
+        )
+    return _push_m
+
 
 class _NCall:
     __slots__ = ("spec", "pins", "attempts_left", "cancelled", "global_deps")
@@ -60,7 +93,8 @@ class _NCall:
 
 
 class _Lease:
-    __slots__ = ("lease_id", "worker_peer", "worker_id_hex", "agent_addr", "inflight")
+    __slots__ = ("lease_id", "worker_peer", "worker_id_hex", "agent_addr",
+                 "inflight", "window", "batches_inflight")
 
     def __init__(self, lease_id: bytes, worker_peer: rpc.Peer, worker_id_hex: str, agent_addr: str):
         self.lease_id = lease_id
@@ -68,6 +102,13 @@ class _Lease:
         self.worker_id_hex = worker_id_hex
         self.agent_addr = agent_addr  # "controller" for head-node leases
         self.inflight: set = set()
+        # Dynamic per-lease push window (batched path): tasks per
+        # push_task_batch frame. Doubles on a clean full-window batch
+        # completion (capped at task_push_batch_max), halves on failure.
+        self.window = 2
+        # Batches on the wire to this worker (double buffering: one
+        # executing, one in flight keeps the serial executor fed).
+        self.batches_inflight = 0
 
 
 class _KeyState:
@@ -75,7 +116,7 @@ class _KeyState:
     in normal_task_submitter.h:40-54)."""
 
     __slots__ = ("key", "demand_items", "strategy", "ehash", "queue", "leases",
-                 "pending_requests", "resolving")
+                 "pending_requests", "resolving", "lease_window")
 
     def __init__(self, key, spec: TaskSpec, ehash: str):
         self.key = key
@@ -86,6 +127,11 @@ class _KeyState:
         self.leases: list = []
         self.pending_requests = 0
         self.resolving = 0  # calls still waiting on dependencies
+        # Dynamic lease window (batched path): leases to ask for in the
+        # next lease_batch round-trip. Slow-start: doubles while full
+        # requests come back fully granted (capped at lease_batch_max),
+        # halves on a partial grant or a worker-pool miss (spillback).
+        self.lease_window = 1
 
 
 class _PeerHandler:
@@ -102,6 +148,15 @@ class NormalSubmitter:
         self.pipeline = int(cfg.get("max_tasks_in_flight_per_lease", 2))
         self.max_leases = int(cfg.get("max_leases_per_scheduling_key", 10))
         self.lease_timeout = float(cfg.get("worker_lease_timeout_s", 30.0))
+        # Batched control plane (round 17): one lease_batch round-trip
+        # grants a window of leases, pushes coalesce into
+        # push_task_batch frames with one gathered reply. Off = the
+        # legacy per-lease/per-task path above (the bench A/B knob).
+        self.batching = bool(cfg.get("lease_batching", True))
+        self.lease_batch_max = int(cfg.get("lease_batch_max", 16))
+        self.push_batch_max = int(cfg.get("task_push_batch_max", 64))
+        # Fresh leases start at this push window (slow-start floor).
+        self.push_init = max(2, self.pipeline)
         self.keys: Dict[Tuple, _KeyState] = {}
         self.tasks: Dict = {}  # TaskID -> (_KeyState, _NCall) for cancel
         self.returns: Dict = {}  # return ObjectID -> TaskID
@@ -111,10 +166,12 @@ class NormalSubmitter:
             core.loop_runner.loop, lambda item: self._enqueue(*item)
         )
         # Flight-recorder feed: direct-push tasks bypass the controller,
-        # so the CALLER emits the SUBMITTED/WORKER_ASSIGNED half of each
-        # task's lifecycle chain (the executing worker emits RUNNING/
-        # FINISHED), batched over the same task_events channel
-        # (reference: TaskEventBuffer → gcs_task_manager).
+        # so the CALLER emits the SUBMITTED/QUEUED/WORKER_ASSIGNED half
+        # of each task's lifecycle chain (the executing worker emits
+        # RUNNING/FINISHED), batched over the same task_events channel
+        # (reference: TaskEventBuffer → gcs_task_manager). SUBMITTED
+        # dwell = handling + dep resolution; QUEUED dwell = capacity
+        # wait; WORKER_ASSIGNED dwell = push → worker pickup.
         self._lc_enabled = bool(cfg.get("lifecycle_events", True))
         # Bounded: a wedged flush must degrade to dropping the OLDEST
         # events, never grow the driver's memory.
@@ -228,12 +285,20 @@ class NormalSubmitter:
             self._pump(ks)
             return
         ks.queue.append(call)
+        # The task is now LEASEABLE: SUBMITTED dwell = submission
+        # handling + dep resolution (the control plane's share), QUEUED
+        # dwell = waiting for lease/worker capacity (the cluster's
+        # share) — same vocabulary as the controller pump's intake.
+        self._lc_record(call.spec, "QUEUED")
         self._pump(ks)
 
     # -- lease + dispatch pump -------------------------------------------
     def _pump(self, ks: _KeyState) -> None:
         if self.core.peer.closed:
             return  # shutting down: no new lease requests, no retries
+        if self.batching:
+            self._pump_batched(ks)
+            return
         for lease in list(ks.leases):
             while ks.queue and len(lease.inflight) < self.pipeline:
                 self._send(ks, lease, ks.queue.popleft())
@@ -254,6 +319,147 @@ class NormalSubmitter:
         # key's queue empties).
         for lease in [l for l in ks.leases if not l.inflight]:
             self._release_lease(ks, lease)
+
+    def _pump_batched(self, ks: _KeyState) -> None:
+        """Batched pump (round 17): feed each lease whole WINDOWS of
+        tasks (one framed RPC per window, double-buffered), then keep at
+        most ONE lease_batch request in flight for the backlog."""
+        for lease in list(ks.leases):
+            while ks.queue and lease.batches_inflight < 2:
+                n = min(len(ks.queue), lease.window)
+                self._send_batch(
+                    ks, lease, [ks.queue.popleft() for _ in range(n)]
+                )
+        if ks.queue:
+            if not ks.pending_requests:
+                ks.pending_requests = 1
+                asyncio.get_running_loop().create_task(
+                    self._lease_batch_task(ks)
+                )
+            return
+        if ks.resolving:
+            return  # tasks still resolving deps will want these leases
+        for lease in [l for l in ks.leases if not l.inflight]:
+            self._release_lease(ks, lease)
+
+    async def _lease_batch_task(self, ks: _KeyState) -> None:
+        """One batched lease round-trip: ask the controller for a WINDOW
+        of leases, then claim workers for every grant per agent in one
+        lease_worker_batch RPC. Pool misses fall back to the parking
+        single-worker path and shrink the window (spillback)."""
+        try:
+            dep_hint = []
+            if ks.queue:
+                head = ks.queue[0]
+                if head.global_deps:
+                    dep_hint = [d.binary() for d in head.global_deps]
+            # Enough leases to cover the backlog at the slow-start push
+            # window, capped by the dynamic lease window.
+            need = -(-len(ks.queue) // self.push_init)
+            count = max(1, min(ks.lease_window, need))
+            resp = await self.core.peer.call(
+                "lease_batch", list(ks.demand_items), ks.strategy, ks.ehash,
+                dep_hint, len(ks.queue), count,
+            )
+            if resp is None:
+                return  # shutting down
+            grants = resp["grants"]
+            if len(grants) == count and count == ks.lease_window:
+                ks.lease_window = min(self.lease_batch_max, ks.lease_window * 2)
+            elif len(grants) < count:
+                ks.lease_window = max(1, ks.lease_window // 2)
+            by_agent: Dict[str, list] = {}
+            for g in grants:
+                by_agent.setdefault(g["agent_addr"], []).append(g)
+            await asyncio.gather(
+                *(self._claim_workers(ks, addr, gs)
+                  for addr, gs in by_agent.items())
+            )
+        except Exception as e:  # noqa: BLE001 — controller unreachable
+            if ks.queue and not self.core.peer.closed:
+                logger.warning("lease batch failed (%s); retrying", e)
+                await asyncio.sleep(0.05)
+        finally:
+            ks.pending_requests = 0
+            self._pump(ks)
+
+    async def _claim_workers(self, ks: _KeyState, agent_addr: str,
+                             grants: list) -> None:
+        """Claim workers for a batch of grants on ONE agent (or the
+        controller for head-node leases) in one round-trip."""
+        lease_ids = [g["lease_id"] for g in grants]
+        try:
+            if agent_addr == "controller":
+                peer = self.core.peer
+            else:
+                peer = await self._agent_peer(agent_addr)
+            outs = await asyncio.wait_for(
+                peer.call("lease_worker_batch", lease_ids, ks.ehash),
+                self.lease_timeout,
+            )
+        except Exception as e:  # noqa: BLE001 — agent unreachable, timeout
+            for g in grants:
+                self._notify_release(g["lease_id"], None, None)
+            if ks.queue and not self.core.peer.closed:
+                logger.warning("batch worker handout failed (%s); retrying", e)
+            return
+        misses = []
+        for g, out in zip(grants, outs):
+            if out is None:
+                misses.append(g)
+                continue
+            try:
+                wpeer = await self._worker_peer(out["worker_addr"])
+            except Exception:  # noqa: BLE001 — worker died before connect
+                self._notify_release(g["lease_id"], agent_addr, out["worker_id"])
+                continue
+            self._adopt_lease(
+                ks, _Lease(g["lease_id"], wpeer, out["worker_id"], agent_addr)
+            )
+        if misses:
+            # Worker-pool spillback: shrink the lease window and park the
+            # missed leases on the blocking single-worker path (spawns
+            # are already in flight agent-side).
+            ks.lease_window = max(1, ks.lease_window // 2)
+            for g in misses:
+                asyncio.get_running_loop().create_task(
+                    self._claim_one(ks, agent_addr, g)
+                )
+
+    async def _claim_one(self, ks: _KeyState, agent_addr: str, grant: dict) -> None:
+        """Parked single-worker claim for a batch grant whose agent pool
+        had no free worker (same contract as the legacy _lease_task
+        inner half: waits for a spawn, bounded by the lease timeout)."""
+        lease_id = grant["lease_id"]
+        try:
+            if agent_addr == "controller":
+                peer = self.core.peer
+            else:
+                peer = await self._agent_peer(agent_addr)
+            out = await asyncio.wait_for(
+                peer.call("lease_worker", lease_id, ks.ehash),
+                self.lease_timeout,
+            )
+            wpeer = await self._worker_peer(out["worker_addr"])
+        except Exception as e:  # noqa: BLE001 — timeout / worker gone
+            self._notify_release(lease_id, None, None)
+            if ks.queue and not self.core.peer.closed:
+                logger.warning("parked worker claim failed (%s)", e)
+            return
+        self._adopt_lease(
+            ks, _Lease(lease_id, wpeer, out["worker_id"], agent_addr)
+        )
+
+    def _adopt_lease(self, ks: _KeyState, lease: _Lease) -> None:
+        lease.window = self.push_init
+        if ks.queue:
+            ks.leases.append(lease)
+            self._pump(ks)
+        else:
+            # burst already drained by other leases
+            self._notify_release(
+                lease.lease_id, lease.agent_addr, lease.worker_id_hex
+            )
 
     async def _lease_task(self, ks: _KeyState) -> None:
         lease = None
@@ -377,6 +583,97 @@ class NormalSubmitter:
             return
         complete_results(self.core, call.spec, results, error)
         self._done(call)
+        self._pump(ks)
+
+    def _send_batch(self, ks: _KeyState, lease: _Lease, calls: list) -> None:
+        """Push a window of tasks in ONE framed RPC with one gathered
+        reply (round 17) — the per-task push + reply frames were half
+        the measured per-task control cost. Inline deps are merged
+        across the batch (dedup: same dep bytes travel once)."""
+        inline = None
+        good = []
+        ms = self.core.memory_store
+        for call in calls:
+            if call.cancelled:
+                self._fail(call, TaskCancelledError(call.spec.task_id.hex()))
+                continue
+            bad_dep = False
+            for dep in call.spec.dependencies:
+                key = dep.binary()
+                e = ms.lookup(key)
+                if e is None or e.kind != "inline" or not e.ready:
+                    continue
+                payload, is_err = e.value()
+                if isinstance(payload, Exception) or is_err:
+                    from ray_tpu.utils.serialization import serialize
+
+                    blob = (
+                        bytes(payload) if not isinstance(payload, Exception)
+                        else serialize(payload)
+                    )
+                    self._fail(call, None, serialized=blob)
+                    bad_dep = True
+                    break
+                if inline is None:
+                    inline = {}
+                inline[key] = bytes(payload)
+            if bad_dep:
+                continue
+            good.append(call)
+        if not good:
+            return
+        for call in good:
+            lease.inflight.add(call)
+            self._lc_record(
+                call.spec, "WORKER_ASSIGNED", worker=lease.worker_id_hex[:12]
+            )
+        _push_batch_hist().observe(len(good))
+        fut = lease.worker_peer.call_nowait(
+            "push_task_batch", [pack_normal_task(c.spec) for c in good], inline
+        )
+        lease.batches_inflight += 1
+        sent_full = len(calls) >= lease.window
+        fut.add_done_callback(
+            lambda f: self._on_batch_reply(ks, lease, good, sent_full, f)
+        )
+
+    def _on_batch_reply(self, ks: _KeyState, lease: _Lease, calls: list,
+                        sent_full: bool, fut: asyncio.Future) -> None:
+        lease.batches_inflight -= 1
+        for call in calls:
+            lease.inflight.discard(call)
+        if fut.cancelled() or fut.exception() is not None:
+            # Whole-batch connection loss: retry semantics are PER TASK,
+            # unchanged from the single-push path — each call burns one
+            # attempt and requeues (order preserved), or fails terminally.
+            self._lease_lost(ks, lease)
+            lease.window = max(1, lease.window // 2)
+            for call in reversed(calls):
+                if call.attempts_left > 0:
+                    call.attempts_left -= 1
+                    ks.queue.appendleft(call)
+                else:
+                    asyncio.get_running_loop().create_task(
+                        self._fail_worker_death(call, lease.worker_id_hex)
+                    )
+            self._pump(ks)
+            return
+        # already-done future (done-callback): no wait  # ray-tpu: lint-ignore[RTL008]
+        replies = fut.result()
+        for call, (results, error) in zip(calls, replies):
+            if (
+                error is not None
+                and call.spec.retry_exceptions
+                and call.attempts_left > 0
+            ):
+                call.attempts_left -= 1
+                ks.queue.appendleft(call)
+                continue
+            complete_results(self.core, call.spec, results, error)
+            self._done(call)
+        if sent_full:
+            # Clean completion of a full window: grow toward the cap.
+            lease.window = min(self.push_batch_max, lease.window * 2)
         self._pump(ks)
 
     # -- lease lifecycle ---------------------------------------------------

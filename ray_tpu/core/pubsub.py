@@ -1,0 +1,193 @@
+"""Topic bus: the generalized control-plane pub/sub plane.
+
+Round 13 added one hardcoded channel (``lifecycle:deaths``) wired
+directly through the controller's ``_pubsub_subs`` dict. This module
+promotes that into a small topic bus (reference: src/ray/pubsub/ — the
+reference's publisher/subscriber carries resource views, actor state,
+AND worker failures over the same machinery) and adds the two channels
+that move the resource hot path from per-sweep polling to
+push-on-change:
+
+  RESOURCES_CHANNEL  controller -> subscribers: per-node availability
+                     deltas, coalesced at resource_broadcast_min_interval_ms,
+                     plus periodic full-snapshot reconciliation
+  AVOID_CHANNEL      controller -> agents: scheduler avoid/drain state
+                     (quarantines, throttles, drains) pushed on change —
+                     agents gate spawn decisions on a local mirror
+                     instead of asking per spawn
+
+Delivery is at-most-once per subscriber per publish (one ``pubsub_msg``
+notify on the subscriber's existing control connection — no long-poll,
+no redelivery), so every push channel pairs with reconciliation:
+:class:`ResourceViewMirror` applies per-node sequence-numbered deltas,
+drops stale/out-of-order ones, and converges on the periodic snapshot
+no matter what the delta stream dropped or reordered.
+
+Single-writer: the bus lives on the controller and is mutated only from
+its asyncio loop — no locks (same discipline as every controller map).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, Optional, Set
+
+from ray_tpu.core.lifecycle import DEATH_CHANNEL  # noqa: F401  (re-export)
+from ray_tpu.utils import rpc
+
+logger = logging.getLogger(__name__)
+
+# Per-node availability deltas + periodic snapshots (push-on-change
+# replacement for polling rpc_cluster_resources per sweep).
+RESOURCES_CHANNEL = "cluster:resources"
+# Scheduler avoid/drain state (controller -> agents).
+AVOID_CHANNEL = "cluster:avoid"
+
+
+class TopicBus:
+    """Channel -> subscriber-peer fan-out with closed-peer pruning.
+
+    Publish is concurrent per subscriber (one wedged subscriber's
+    backpressure must not stall the rest or the publisher) and
+    fire-and-forget (``notify`` — no reply frames on the hot path).
+    """
+
+    def __init__(self):
+        self._subs: Dict[str, Set[rpc.Peer]] = {}
+
+    def subscribe(self, channel: str, peer: rpc.Peer):
+        self._subs.setdefault(channel, set()).add(peer)
+        peer.meta.setdefault("subscriptions", set()).add(channel)
+
+    def unsubscribe(self, channel: str, peer: rpc.Peer):
+        subs = self._subs.get(channel)
+        if subs is not None:
+            subs.discard(peer)
+            if not subs:
+                del self._subs[channel]
+        peer.meta.get("subscriptions", set()).discard(channel)
+
+    def drop_peer(self, peer: rpc.Peer):
+        for channel in list(peer.meta.get("subscriptions", ())):
+            subs = self._subs.get(channel)
+            if subs is not None:
+                subs.discard(peer)
+                if not subs:
+                    del self._subs[channel]
+
+    def has(self, channel: str) -> bool:
+        """Any subscribers? Publishers check this first so building the
+        message costs nothing on clusters that never subscribed."""
+        return bool(self._subs.get(channel))
+
+    def channels(self) -> Dict[str, int]:
+        return {c: len(s) for c, s in self._subs.items()}
+
+    async def publish(self, channel: str, msg: Any) -> int:
+        """Fan ``msg`` out to the channel's subscribers concurrently;
+        returns the number of live subscribers notified."""
+        subs = self._subs.get(channel)
+        if not subs:
+            return 0
+        live = []
+        for p in list(subs):
+            if p.closed:
+                subs.discard(p)
+            else:
+                live.append(p)
+        if not subs:
+            self._subs.pop(channel, None)
+        if live:
+            await asyncio.gather(
+                *(p.notify("pubsub_msg", channel, msg) for p in live),
+                return_exceptions=True,
+            )
+        return len(live)
+
+
+class ResourceViewMirror:
+    """Subscriber-side materialization of RESOURCES_CHANNEL.
+
+    Deltas carry a per-node monotonic ``seq``; a delta at or below the
+    last applied seq for that node is stale (reordered or duplicated in
+    flight) and is dropped. ``reconcile`` replaces the whole view from a
+    full snapshot — nodes absent from the snapshot are removed, and the
+    snapshot's seqs become the new floors — so the mirror converges on
+    the poll-equivalent state within one reconcile period regardless of
+    what the delta stream lost.
+    """
+
+    def __init__(self):
+        # node hex -> {"available": {...}, "total": {...},
+        #              "draining": bool, "avoid": str|None}
+        self.nodes: Dict[str, dict] = {}
+        self._seq: Dict[str, int] = {}
+        self.applied = 0
+        self.stale = 0
+        self.reconciles = 0
+
+    def ingest(self, msg: dict) -> bool:
+        """Dispatch one RESOURCES_CHANNEL message: full snapshots (marked
+        ``{"snapshot": True}``) reconcile, everything else is a delta."""
+        if not isinstance(msg, dict):
+            return False
+        if msg.get("snapshot"):
+            self.reconcile(msg)
+            return True
+        return self.apply(msg)
+
+    def apply(self, delta: dict) -> bool:
+        """Apply one per-node delta; returns False if it was stale."""
+        node = delta.get("node")
+        seq = delta.get("seq")
+        if not node or not isinstance(seq, int):
+            return False
+        if delta.get("removed"):
+            # Removal tombstone: drop the node but KEEP its seq floor so
+            # a reordered pre-removal delta can't resurrect it.
+            if seq <= self._seq.get(node, -1):
+                self.stale += 1
+                return False
+            self._seq[node] = seq
+            self.nodes.pop(node, None)
+            self.applied += 1
+            return True
+        if seq <= self._seq.get(node, -1):
+            self.stale += 1
+            return False
+        self._seq[node] = seq
+        view = self.nodes.setdefault(node, {})
+        for k in ("available", "total", "draining", "avoid"):
+            if k in delta:
+                view[k] = delta[k]
+        self.applied += 1
+        return True
+
+    def reconcile(self, snapshot: dict):
+        """Replace the view from a full snapshot
+        (``{"nodes": {hex: {seq, available, total, draining, avoid}}}``)."""
+        rows = snapshot.get("nodes")
+        if not isinstance(rows, dict):
+            return
+        fresh: Dict[str, dict] = {}
+        for node, row in rows.items():
+            fresh[node] = {
+                "available": row.get("available", {}),
+                "total": row.get("total", {}),
+                "draining": bool(row.get("draining")),
+                "avoid": row.get("avoid"),
+            }
+            seq = row.get("seq")
+            if isinstance(seq, int):
+                self._seq[node] = max(self._seq.get(node, -1), seq)
+        # Forget seq floors for nodes the authority no longer knows:
+        # a reused hex (never in practice) starts a fresh seq space.
+        for node in list(self._seq):
+            if node not in fresh:
+                self._seq.pop(node, None)
+        self.nodes = fresh
+        self.reconciles += 1
+
+    def available(self, node: str) -> Optional[dict]:
+        view = self.nodes.get(node)
+        return None if view is None else view.get("available")

@@ -99,12 +99,26 @@ class NodeResources:
         # over its own dense view. Python stays the source of truth.
         self._native = None
         self._native_id = None
+        # Optional capacity watcher (ClusterState's shape index + dirty
+        # tracking for the resource pubsub channel): notified after every
+        # availability/capacity mutation, same back-binding pattern as
+        # the native mirror.
+        self._watcher = None
+        self._watcher_id = None
         # Graceful drain: excluded from placement, accounting kept live.
         self.draining = False
 
     def bind_native(self, sched, node_id):
         self._native = sched
         self._native_id = node_id
+
+    def bind_watcher(self, watcher, node_id):
+        self._watcher = watcher
+        self._watcher_id = node_id
+
+    def _notify_watcher(self):
+        if self._watcher is not None:
+            self._watcher.note_capacity_changed(self._watcher_id)
 
     def fits(self, demand: ResourceSet) -> bool:
         return self.available.fits(demand)
@@ -129,6 +143,7 @@ class NodeResources:
                 self._native.sync_node(
                     self._native_id, self.total.items_fp(), self.available.items_fp()
                 )
+        self._notify_watcher()
         return True
 
     def release(self, demand: ResourceSet):
@@ -142,6 +157,7 @@ class NodeResources:
             cap = self.total.get(k)
             m[k] = min(v, cap) if cap else v
         self.available = ResourceSet(m)
+        self._notify_watcher()
 
     def utilization(self) -> float:
         """Max utilization across resource kinds — drives the hybrid policy's
@@ -159,12 +175,14 @@ class NodeResources:
         self.available = self.available + extra
         if self._native is not None:
             self._native.add_total(self._native_id, extra.items_fp())
+        self._notify_watcher()
 
     def remove_total(self, extra: ResourceSet):
         self.total = self.total - extra
         self.available = self.available - extra
         if self._native is not None:
             self._native.remove_total(self._native_id, extra.items_fp())
+        self._notify_watcher()
 
     def to_dict(self):
         return {

@@ -14,10 +14,12 @@ scheduling can be reintroduced when nodes own their local view.
 """
 from __future__ import annotations
 
+import collections
+import heapq
 import itertools
 import logging
-from dataclasses import dataclass
-from typing import Dict, List, Optional
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
 
 from ray_tpu.config import get_config
 from ray_tpu.core.resources import NodeResources, ResourceSet
@@ -25,6 +27,31 @@ from ray_tpu.core.task_spec import SchedulingStrategy
 from ray_tpu.utils.ids import NodeID, PlacementGroupID
 
 logger = logging.getLogger(__name__)
+
+_sched_metrics: Optional[Dict[str, object]] = None
+
+
+def _get_sched_metrics() -> Dict[str, object]:
+    """Process-wide metric singletons (a scheduler re-created in tests
+    must not duplicate series)."""
+    global _sched_metrics
+    if _sched_metrics is None:
+        from ray_tpu.util.metrics import Counter
+
+        _sched_metrics = {
+            "fast": Counter(
+                "scheduler_fast_path_total",
+                "Placement decisions served by an O(1) path "
+                "(native core or the demand-shape index)",
+                ("strategy",),
+            ),
+            "full": Counter(
+                "scheduler_full_scan_total",
+                "Placement decisions that rescanned every node "
+                "(label/affinity/PG strategies, exclude filters, cold shapes)",
+            ),
+        }
+    return _sched_metrics
 
 
 @dataclass
@@ -69,6 +96,28 @@ def match_label_expressions(exprs: Optional[Dict], labels: Dict[str, str]) -> bo
     return True
 
 
+@dataclass
+class _ShapeEntry:
+    """Feasibility bucket for one demand shape (round 17, O(1) hot path).
+
+    ``fits`` is the live set of nodes whose availability satisfies the
+    shape RIGHT NOW, maintained incrementally by capacity-change
+    callbacks; ``heap`` is a lazy-deleted min-heap over (pack-order
+    position, node) of (a superset of) that set, so the hybrid policy's
+    pack-first pick is a heap peek instead of a cluster rescan.
+    Duplicate heap entries after a node leaves and re-enters ``fits``
+    are harmless — membership in ``fits`` is the truth, stale tops are
+    popped on peek. Any topology/drain/avoid change invalidates the
+    whole cache (rare); only capacity changes are tracked per node.
+    """
+
+    demand: ResourceSet
+    pos: Dict[NodeID, int] = field(default_factory=dict)
+    fits: Set[NodeID] = field(default_factory=set)
+    feasible: Set[NodeID] = field(default_factory=set)
+    heap: List[Tuple[int, NodeID]] = field(default_factory=list)
+
+
 class ClusterState:
     """Authoritative view of node resources (reference:
     ClusterResourceManager, cluster_resource_data.h).
@@ -90,6 +139,18 @@ class ClusterState:
         # placement order so other nodes absorb new work first). Expiry
         # is pruned lazily on read and by the health tick.
         self._avoid: Dict[NodeID, list] = {}
+        # Demand-shape feasibility index (round 17): shape key -> live
+        # fits/feasible sets + pack-order heap, LRU-bounded. See
+        # _ShapeEntry. Kept coherent by NodeResources watcher callbacks
+        # (capacity) and wholesale invalidation (topology/drain/avoid).
+        self._shape_cache: "collections.OrderedDict[tuple, _ShapeEntry]" = (
+            collections.OrderedDict()
+        )
+        self._shape_cache_cap = 128
+        # Nodes whose availability changed since the last resource-delta
+        # broadcast (core/pubsub.py RESOURCES_CHANNEL) — the controller's
+        # coalesced publisher drains this.
+        self.dirty_nodes: Set[NodeID] = set()
         self.native = None
         if not get_config().disable_native_sched:
             try:
@@ -111,15 +172,21 @@ class ClusterState:
         if self.native is not None:
             self.native.add_node(node_id, resources.total.items_fp())
             resources.bind_native(self.native, node_id)
+        resources.bind_watcher(self, node_id)
+        self._invalidate_shapes()
+        self.dirty_nodes.add(node_id)
 
     def remove_node(self, node_id: NodeID):
         res = self.nodes.pop(node_id, None)
         if res is not None:
             res.bind_native(None, None)
+            res.bind_watcher(None, None)
         self._order = [n for n in self._order if n != node_id]
         self._avoid.pop(node_id, None)
         if self.native is not None:
             self.native.remove_node(node_id)
+        self._invalidate_shapes()
+        self.dirty_nodes.add(node_id)
 
     def set_draining(self, node_id: NodeID, draining: bool = True):
         """Graceful drain (reference: NodeManager drain / rpc::DrainNode):
@@ -130,6 +197,8 @@ class ClusterState:
             res.draining = draining
         if self.native is not None:
             self.native.set_draining(node_id, draining)
+        self._invalidate_shapes()
+        self.dirty_nodes.add(node_id)
 
     # -- health-plane avoids (core/health.py actuators) -----------------
     def set_avoid(self, node_id: NodeID, duration_s: float,
@@ -146,6 +215,7 @@ class ClusterState:
             return False
         prev = self._avoid.get(node_id)
         self._avoid[node_id] = [_time.monotonic() + float(duration_s), bool(hard)]
+        self._invalidate_shapes()
         if hard and self.native is not None and not res.draining:
             self.native.set_draining(node_id, True)
         elif not hard and prev is not None and prev[1]:
@@ -158,6 +228,7 @@ class ClusterState:
         entry = self._avoid.pop(node_id, None)
         if entry is None:
             return
+        self._invalidate_shapes()
         res = self.nodes.get(node_id)
         if (
             entry[1]
@@ -201,11 +272,90 @@ class ClusterState:
                 back.append(n)  # throttled: last resort only
         return front + back
 
+    # -- demand-shape feasibility index (round 17) ----------------------
+    def _invalidate_shapes(self):
+        if self._shape_cache:
+            self._shape_cache.clear()
+
+    def note_capacity_changed(self, node_id: NodeID):
+        """NodeResources watcher callback: availability (or capacity —
+        PG commits add renamed group resources via add_total) changed on
+        ``node_id``. O(#cached shapes) set/heap maintenance, never a
+        cluster scan."""
+        self.dirty_nodes.add(node_id)
+        if not self._shape_cache:
+            return
+        nr = self.nodes.get(node_id)
+        if nr is None:
+            return
+        for e in self._shape_cache.values():
+            pos = e.pos.get(node_id)
+            if pos is None:
+                continue
+            if nr.is_feasible(e.demand):
+                e.feasible.add(node_id)
+            else:
+                e.feasible.discard(node_id)
+            if nr.available.fits(e.demand):
+                if node_id not in e.fits:
+                    e.fits.add(node_id)
+                    heapq.heappush(e.heap, (pos, node_id))
+            else:
+                e.fits.discard(node_id)
+
+    def shape_entry(self, demand: ResourceSet) -> _ShapeEntry:
+        """The feasibility bucket for ``demand``'s shape, building it
+        with ONE full scan on first sight (amortized away across every
+        later decision for the same shape)."""
+        key = tuple(sorted(demand.items_fp()))
+        e = self._shape_cache.get(key)
+        if e is not None:
+            self._shape_cache.move_to_end(key)
+            return e
+        e = _ShapeEntry(demand=ResourceSet(dict(demand.items_fp())))
+        for i, nid in enumerate(self.ordered_nodes()):
+            e.pos[nid] = i
+            nr = self.nodes[nid]
+            if nr.is_feasible(demand):
+                e.feasible.add(nid)
+                if nr.available.fits(demand):
+                    e.fits.add(nid)
+                    heapq.heappush(e.heap, (i, nid))
+        while len(self._shape_cache) >= self._shape_cache_cap:
+            self._shape_cache.popitem(last=False)
+        self._shape_cache[key] = e
+        return e
+
 
 class ClusterResourceScheduler:
     def __init__(self, state: ClusterState):
         self.state = state
         self._spread_idx = 0
+        # Fast-path vs full-scan decision accounting. Plain ints on the
+        # decision path (a Counter.inc costs ~10us — the very overhead
+        # the fast path removes); drain_counters() bulk-flushes into the
+        # cluster metrics from the telemetry sweep.
+        self._fast_counts: Dict[str, int] = {}
+        self._full_scans = 0
+
+    def _count_fast(self, strategy: str):
+        self._fast_counts[strategy] = self._fast_counts.get(strategy, 0) + 1
+
+    def drain_counters(self):
+        """Flush accumulated decision counts into
+        ``scheduler_fast_path_total{strategy}`` /
+        ``scheduler_full_scan_total`` (called from the controller's
+        telemetry sweep, and by summarize_lifecycle)."""
+        fast, self._fast_counts = self._fast_counts, {}
+        full, self._full_scans = self._full_scans, 0
+        if not fast and not full:
+            return
+        m = _get_sched_metrics()
+        for strategy, n in fast.items():
+            # bounded vocabulary: hybrid_native/hybrid_shape/spread_native
+            m["fast"].inc(n, {"strategy": strategy})  # ray-tpu: lint-ignore[RTL004] — bounded strategy vocabulary (fast-path kinds only)
+        if full:
+            m["full"].inc(full)
 
     # ------------------------------------------------------------------
     def schedule(self, demand: ResourceSet, strategy: SchedulingStrategy,
@@ -246,11 +396,39 @@ class ClusterResourceScheduler:
             and not exclude
             and not self.state.soft_avoid_active()
         ):
+            self._count_fast("hybrid_native")
             node_id, infeasible = self.state.native.schedule_hybrid(
                 demand.items_fp(), threshold
             )
             return ScheduleResult(node_id, infeasible=infeasible,
                                   reason=_none_reason(node_id, infeasible))
+        if not exclude:
+            # Demand-shape index: the common no-filter decision is a
+            # heap peek + one utilization check instead of a cluster
+            # rescan. ``exclude`` (spillback) takes the scan path — the
+            # filter is per-request and must not pollute shared buckets.
+            e = self.state.shape_entry(demand)
+            self._count_fast("hybrid_shape")
+            if not e.fits:
+                if e.feasible:
+                    return ScheduleResult(None, infeasible=False,
+                                          reason="insufficient_resources")
+                return ScheduleResult(None, infeasible=True,
+                                      reason="infeasible")
+            heap = e.heap
+            while heap and heap[0][1] not in e.fits:
+                heapq.heappop(heap)  # lazy-deleted / duplicate entries
+            first = heap[0][1]
+            if self.state.nodes[first].utilization() < threshold:
+                return ScheduleResult(first)
+            # Past-threshold tail (rare): same semantics as the scan
+            # path, but over the fits set only.
+            for _p, nid in sorted((e.pos[n], n) for n in e.fits):
+                if self.state.nodes[nid].utilization() < threshold:
+                    return ScheduleResult(nid)
+            best = min(e.fits, key=lambda n: self.state.nodes[n].utilization())
+            return ScheduleResult(best)
+        self._full_scans += 1
         feasible = self._feasible_nodes(demand, exclude)
         if not feasible:
             return ScheduleResult(None, infeasible=True, reason="infeasible")
@@ -266,9 +444,11 @@ class ClusterResourceScheduler:
 
     def _spread(self, demand: ResourceSet, exclude=None) -> ScheduleResult:
         if self.state.native is not None and not exclude:
+            self._count_fast("spread_native")
             node_id, infeasible = self.state.native.schedule_spread(demand.items_fp())
             return ScheduleResult(node_id, infeasible=infeasible,
                                   reason=_none_reason(node_id, infeasible))
+        self._full_scans += 1
         feasible = self._feasible_nodes(demand, exclude)
         if not feasible:
             return ScheduleResult(None, infeasible=True, reason="infeasible")
@@ -303,6 +483,7 @@ class ClusterResourceScheduler:
         soft expressions rank the survivors."""
         labels = strategy.node_labels or {}
         hard, soft = labels.get("hard"), labels.get("soft")
+        self._full_scans += 1
         candidates = [
             nid for nid in self.state.ordered_nodes()
             if match_label_expressions(hard, self.state.nodes[nid].labels)
@@ -342,6 +523,7 @@ class ClusterResourceScheduler:
         if strategy.bundle_index >= 0:
             wildcard = ResourceSet({f"{k}_group_{pgid.hex()}": v for k, v in demand.items_fp()})
             translated = translated + wildcard
+        self._full_scans += 1
         for nid in self.state.ordered_nodes():
             if exclude and nid in exclude:
                 continue

@@ -104,6 +104,27 @@ class WorkerHandler:
         self.executor.submit(spec, "task", reply=(loop, fut), inline_deps=inline_deps)
         return fut
 
+    def rpc_push_task_batch(self, peer, packed_list: list, inline_deps=None):
+        """Push a BATCH of normal tasks in one frame with ONE gathered
+        reply (round 17): the reply frame is half the per-task RPC cost,
+        and the execution pool is serial anyway, so per-task replies buy
+        nothing. ``inline_deps`` is the merged dep dict for the whole
+        batch. Resolves to a list of per-task (results, error) tuples in
+        submission order."""
+        from ray_tpu.core.task_spec import unpack_normal_task
+
+        specs = [unpack_normal_task(p) for p in packed_list]
+        if self.executor is None:
+            return self._push_batch_when_ready(specs, inline_deps)
+        loop = asyncio.get_running_loop()
+        futs = []
+        for spec in specs:
+            fut = loop.create_future()
+            self.executor.submit(spec, "task", reply=(loop, fut),
+                                 inline_deps=inline_deps)
+            futs.append(fut)
+        return asyncio.gather(*futs)
+
     async def _push_when_ready(self, spec: TaskSpec, kind: str, inline_deps):
         while self.executor is None:  # registration race (first push only)
             await asyncio.sleep(0.002)
@@ -111,6 +132,18 @@ class WorkerHandler:
         fut = loop.create_future()
         self.executor.submit(spec, kind, reply=(loop, fut), inline_deps=inline_deps)
         return fut
+
+    async def _push_batch_when_ready(self, specs: list, inline_deps):
+        while self.executor is None:  # registration race (first push only)
+            await asyncio.sleep(0.002)
+        loop = asyncio.get_running_loop()
+        futs = []
+        for spec in specs:
+            fut = loop.create_future()
+            self.executor.submit(spec, "task", reply=(loop, fut),
+                                 inline_deps=inline_deps)
+            futs.append(fut)
+        return await asyncio.gather(*futs)
 
     def rpc_cancel(self, peer, task_id: TaskID):
         if self.executor is not None:

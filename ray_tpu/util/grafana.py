@@ -66,7 +66,7 @@ ROWS = (
     ("RL", ("rl_",)),
     ("Data", ("data_",)),
     ("Control Plane", ("task_state_", "task_pending_", "lease_",
-                       "lockwatch_")),
+                       "lockwatch_", "task_push_", "scheduler_")),
     ("Profiling", ("task_cpu_", "profiling_")),
     ("Logs & Errors", ("log_",)),
     ("Self-healing", ("health_",)),
