@@ -9,6 +9,19 @@ core is accelerator-agnostic and worker processes must start fast. JAX loads
 when you import ray_tpu.parallel / ray_tpu.ops / ray_tpu.models /
 ray_tpu.train et al.
 """
+import os as _os
+
+if _os.environ.get("RAY_TPU_CONCSAN", "") == "1":
+    # Opt-in concurrency sanitizer (ConcSan): every cluster process —
+    # controller, agents, workers are subprocesses inheriting the env —
+    # self-arms on import, BEFORE any locks or guarded containers are
+    # created, so lockwatch wraps them all and the checked container
+    # variants get selected at construction.
+    from ray_tpu.tools.sanitizer import runtime as _concsan
+
+    _concsan.maybe_enable()
+del _os
+
 from ray_tpu.core.api import (
     available_resources,
     cancel,

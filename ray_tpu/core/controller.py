@@ -44,6 +44,7 @@ from ray_tpu.exceptions import (
     WorkerCrashedError,
 )
 from ray_tpu.runtime_env import env_hash as _env_hash
+from ray_tpu.util.guards import OWNER_THREAD, GuardedDict, GuardedSet, snapshot
 from ray_tpu.utils import rpc
 from ray_tpu.utils.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
 
@@ -318,13 +319,30 @@ class Controller:
             enabled=config.lifecycle_events,
         )
         self.pg_manager = PlacementGroupManager(self.cluster, recorder=self.lifecycle)
-        self.objects: Dict[ObjectID, ObjectRecord] = {}
-        self.workers: Dict[WorkerID, WorkerRecord] = {}
-        self.nodes: Dict[NodeID, NodeRecord] = {}
-        self.tasks: Dict[TaskID, TaskRecord] = {}
-        self.actors: Dict[ActorID, ActorRecord] = {}
-        self.named_actors: Dict[str, ActorID] = {}
-        self.kv: Dict[str, Dict[bytes, bytes]] = {}
+        # Single-writer maps (mutated only from the controller's asyncio
+        # loop — the module's no-locks discipline). The OWNER_THREAD
+        # guard makes that discipline machine-checked under ConcSan.
+        self.objects: Dict[ObjectID, ObjectRecord] = GuardedDict(
+            OWNER_THREAD, owner=self, name="objects"
+        )
+        self.workers: Dict[WorkerID, WorkerRecord] = GuardedDict(
+            OWNER_THREAD, owner=self, name="workers"
+        )
+        self.nodes: Dict[NodeID, NodeRecord] = GuardedDict(
+            OWNER_THREAD, owner=self, name="nodes"
+        )
+        self.tasks: Dict[TaskID, TaskRecord] = GuardedDict(
+            OWNER_THREAD, owner=self, name="tasks"
+        )
+        self.actors: Dict[ActorID, ActorRecord] = GuardedDict(
+            OWNER_THREAD, owner=self, name="actors"
+        )
+        self.named_actors: Dict[str, ActorID] = GuardedDict(
+            OWNER_THREAD, owner=self, name="named_actors"
+        )
+        self.kv: Dict[str, Dict[bytes, bytes]] = GuardedDict(
+            OWNER_THREAD, owner=self, name="kv"
+        )
         # GCS fault tolerance (reference: gcs/store_client/ Redis FT): an
         # append-only journal of {KV, detached actors, PGs}; a restarting
         # controller on the same session dir replays it.
@@ -333,7 +351,9 @@ class Controller:
         self.journal = GcsJournal(session_dir, sync=config.gcs_journal_fsync)
         self._restored = self.journal.replay()
         if not self._restored.empty:
-            self.kv = self._restored.kv
+            self.kv = GuardedDict(
+                OWNER_THREAD, self._restored.kv, owner=self, name="kv"
+            )
             # Compact on every restart: bounds replay cost for long-lived
             # clusters that overwrite the same KV keys repeatedly.
             self.journal.compact(self._restored)
@@ -353,14 +373,18 @@ class Controller:
         # O(#tasks) (reference: SchedulingClass queues in
         # cluster_task_manager.cc; fixes the measured O(n²) registration
         # collapse at 10k pending actor records).
-        self._class_queues: Dict[Tuple, "_c.deque"] = {}
+        self._class_queues: Dict[Tuple, "_c.deque"] = GuardedDict(
+            OWNER_THREAD, owner=self, name="class_queues"
+        )
         self._dep_parked: Set[TaskID] = set()
         # dep object → pending tasks that consume it: lets an object free
         # fail its dependents in O(dependents) instead of scanning every
         # pending task (objects free routinely via GC sweeps).
         self._dep_index: Dict[ObjectID, Set[TaskID]] = {}
 
-        self.leases: Dict[bytes, LeaseRecord] = {}
+        self.leases: Dict[bytes, LeaseRecord] = GuardedDict(
+            OWNER_THREAD, owner=self, name="leases"
+        )
         self._lease_reqs: "_c.deque[_LeaseReq]" = _c.deque()
         self._lease_seq = _it.count(1)
         self._lreq_seq = _it.count(1)  # lease-request ids (flight recorder)
@@ -382,7 +406,9 @@ class Controller:
         # direct-push callers query this to turn a connection loss into
         # the right error (reference: NodeDeathInfo / worker exit detail).
         self._dead_worker_info: "_c.OrderedDict[str, str]" = _c.OrderedDict()
-        self.drivers: Set[rpc.Peer] = set()
+        self.drivers: Set[rpc.Peer] = GuardedSet(
+            OWNER_THREAD, owner=self, name="drivers"
+        )
         self._drain_tasks: Set[asyncio.Task] = set()
         self._pump_scheduled = False
         self._pump_running = False
@@ -4676,7 +4702,12 @@ class Controller:
     def _broadcast_logs(self, batch):
         """Thread→loop bridge: fan worker-log lines out to drivers
         (reference: log_monitor publish + driver print_to_stdstream)."""
-        if not self.drivers or self._loop is None:
+        # Runs on the log-tailer THREAD; ``drivers`` is loop-owned. The
+        # emptiness peek here is only an optimization (skip scheduling a
+        # coroutine when nobody listens), so take it as an atomic
+        # snapshot — the authoritative read happens in send() on the
+        # loop. ConcSan flagged the bare read (owner_thread finding).
+        if not snapshot(self.drivers) or self._loop is None:
             return
 
         async def send():

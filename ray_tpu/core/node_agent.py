@@ -21,6 +21,7 @@ import time
 from typing import Dict
 
 from ray_tpu.core.object_store import PlasmaStore
+from ray_tpu.util.guards import OWNER_THREAD, GuardedDict, GuardedSet
 from ray_tpu.utils import rpc
 from ray_tpu.utils.ids import NodeID, ObjectID, WorkerID
 
@@ -163,23 +164,35 @@ class NodeAgent:
         self._fetch_peers = FetchPeerCache()
         self._chunk_reader = ChunkReader(self.store)
         self._chunk_bytes = 8 * 1024 * 1024
-        self._inflight_pulls: Dict = {}  # oid -> InflightPull (broadcast hops)
+        # Single-writer agent state (asyncio-loop discipline, same as the
+        # controller's maps): OWNER_THREAD guards make it ConcSan-checked.
+        self._inflight_pulls: Dict = GuardedDict(
+            OWNER_THREAD, owner=self, name="inflight_pulls"
+        )  # oid -> InflightPull (broadcast hops)
         # Direct-lease worker pool: THE AGENT owns this node's free-worker
         # view (reference: the raylet's WorkerPool, worker_pool.h:174); the
         # controller only places leases onto the node.
         import collections
 
-        self._direct: Dict[str, _DirectWorker] = {}
+        self._direct: Dict[str, _DirectWorker] = GuardedDict(
+            OWNER_THREAD, owner=self, name="direct"
+        )
         self._direct_waiters: "collections.deque" = collections.deque()
         self._direct_starting = 0
         self._direct_spawns: list = []  # Popen handles not yet attached
-        self._lease_workers: Dict[bytes, str] = {}  # lease_id -> worker id
+        self._lease_workers: Dict[bytes, str] = GuardedDict(
+            OWNER_THREAD, owner=self, name="lease_workers"
+        )  # lease_id -> worker id
         # rpc_lease_worker grants in flight, and leases released while
         # their grant was still in flight (bounded: only grants currently
         # executing can enter _released_leases; the grant's finally
         # clears both).
-        self._granting: set = set()
-        self._released_leases: set = set()
+        self._granting: set = GuardedSet(
+            OWNER_THREAD, owner=self, name="granting"
+        )
+        self._released_leases: set = GuardedSet(
+            OWNER_THREAD, owner=self, name="released_leases"
+        )
         ncpu = int(resources.get("CPU", 1))
         self._max_direct = max(4 * max(ncpu, 1), 16)
         self._listen_addr = ""  # set in run()

@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
+from ray_tpu.util.guards import GuardedDict, GuardedSet, guarded_by
 from ray_tpu.utils.ids import ObjectID
 
 logger = logging.getLogger("ray_tpu.object_store")
@@ -153,11 +154,15 @@ class PlasmaStore:
         cloudfs.makedirs(self.spill_dir)
         self.capacity = capacity
         self.used = 0  # file-tier bytes only; the arena self-accounts
-        self._entries: Dict[ObjectID, PlasmaEntry] = {}
+        self._entries: Dict[ObjectID, PlasmaEntry] = GuardedDict(
+            "_lock", owner=self, name="entries"
+        )
         # Arena slots whose refcount-driven delete was refused because a
         # reader held a pinned view at the time; retried (and freed) on
         # later eviction passes once the pins drop.
-        self._deferred_deletes: set = set()
+        self._deferred_deletes: set = GuardedSet(
+            "_lock", owner=self, name="deferred_deletes"
+        )
         # Spill-loop churn counter (monotonic): one tick per object
         # spilled to disk. The controller's store-pressure detector
         # watches the DELTA per telemetry sweep — a store thrashing the
@@ -213,6 +218,7 @@ class PlasmaStore:
             self.used += size
         return PlasmaBuffer(self._part_path(oid), size, writable=True)
 
+    @guarded_by("_lock")
     def _drain_deferred_deletes(self):
         """Free arena slots whose delete was refused while pinned (the
         pins have since dropped for any that succeed here)."""
@@ -220,6 +226,7 @@ class PlasmaStore:
             if self._arena.delete(vid.binary()):
                 self._deferred_deletes.discard(vid)
 
+    @guarded_by("_lock")
     def _arena_alloc_evicting(self, oid_bytes: bytes, size: int):
         """Arena alloc, spilling LRU victims to disk until it fits (the
         reference's eviction-on-create, plasma/eviction_policy.cc)."""
@@ -388,6 +395,7 @@ class PlasmaStore:
                     pass
 
     # -- eviction / spilling (file tier) -----------------------------------
+    @guarded_by("_lock")
     def _maybe_evict(self, incoming: int):
         """Spill LRU sealed, unpinned file-tier objects until ``incoming``
         fits."""
@@ -477,6 +485,7 @@ class PlasmaStore:
                 ),
             }
 
+    @guarded_by("_lock")
     def _spill_one_arena_victim(self):
         """Spill the arena's LRU victim to the spill tier; returns the
         bytes freed, or None when nothing is evictable. Caller holds the
@@ -520,6 +529,7 @@ class PlasmaStore:
         self.spill_ops += 1
         return vsize
 
+    @guarded_by("_lock")
     def _restore_locked(self, oid: ObjectID, e: PlasmaEntry):
         if self._arena is not None:
             buf = self._arena_alloc_evicting(oid.binary(), e.size)

@@ -33,6 +33,7 @@ import logging
 from typing import Any, Dict, Optional, Set
 
 from ray_tpu.core.lifecycle import DEATH_CHANNEL  # noqa: F401  (re-export)
+from ray_tpu.util.guards import OWNER_THREAD, GuardedDict
 from ray_tpu.utils import rpc
 
 logger = logging.getLogger(__name__)
@@ -53,7 +54,10 @@ class TopicBus:
     """
 
     def __init__(self):
-        self._subs: Dict[str, Set[rpc.Peer]] = {}
+        # single-writer (controller loop): ConcSan checks thread affinity
+        self._subs: Dict[str, Set[rpc.Peer]] = GuardedDict(
+            OWNER_THREAD, owner=self, name="subs"
+        )
 
     def subscribe(self, channel: str, peer: rpc.Peer):
         self._subs.setdefault(channel, set()).add(peer)
@@ -120,8 +124,14 @@ class ResourceViewMirror:
     def __init__(self):
         # node hex -> {"available": {...}, "total": {...},
         #              "draining": bool, "avoid": str|None}
-        self.nodes: Dict[str, dict] = {}
-        self._seq: Dict[str, int] = {}
+        # single-writer (the subscriber's ingest loop); GuardedDict
+        # pickles down to a plain dict when the view crosses RPC
+        self.nodes: Dict[str, dict] = GuardedDict(
+            OWNER_THREAD, owner=self, name="nodes"
+        )
+        self._seq: Dict[str, int] = GuardedDict(
+            OWNER_THREAD, owner=self, name="seq"
+        )
         self.applied = 0
         self.stale = 0
         self.reconciles = 0
@@ -185,7 +195,10 @@ class ResourceViewMirror:
         for node in list(self._seq):
             if node not in fresh:
                 self._seq.pop(node, None)
-        self.nodes = fresh
+        # in place, not `self.nodes = fresh`: a rebind would replace the
+        # guard-annotated dict with a plain one (RTL010 flags that)
+        self.nodes.clear()
+        self.nodes.update(fresh)
         self.reconciles += 1
 
     def available(self, node: str) -> Optional[dict]:

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from ray_tpu.config import get_config
 from ray_tpu.core.resources import NodeResources, ResourceSet
 from ray_tpu.core.task_spec import SchedulingStrategy
+from ray_tpu.util.guards import OWNER_THREAD, GuardedDict, GuardedSet
 from ray_tpu.utils.ids import NodeID, PlacementGroupID
 
 logger = logging.getLogger(__name__)
@@ -129,7 +130,12 @@ class ClusterState:
     """
 
     def __init__(self):
-        self.nodes: Dict[NodeID, NodeResources] = {}
+        # Controller-loop single-writer state (no locks by design):
+        # GuardedDict/GuardedSet give the ConcSan witness thread-affinity
+        # checks when RAY_TPU_CONCSAN=1 and cost nothing otherwise.
+        self.nodes: Dict[NodeID, NodeResources] = GuardedDict(
+            OWNER_THREAD, owner=self, name="nodes"
+        )
         # Stable ordering for deterministic pack behavior.
         self._order: List[NodeID] = []
         self._spread_rr = itertools.count()
@@ -138,7 +144,9 @@ class ClusterState:
         # soft = admission throttle (node moves to the back of the
         # placement order so other nodes absorb new work first). Expiry
         # is pruned lazily on read and by the health tick.
-        self._avoid: Dict[NodeID, list] = {}
+        self._avoid: Dict[NodeID, list] = GuardedDict(
+            OWNER_THREAD, owner=self, name="avoid"
+        )
         # Demand-shape feasibility index (round 17): shape key -> live
         # fits/feasible sets + pack-order heap, LRU-bounded. See
         # _ShapeEntry. Kept coherent by NodeResources watcher callbacks
@@ -150,7 +158,9 @@ class ClusterState:
         # Nodes whose availability changed since the last resource-delta
         # broadcast (core/pubsub.py RESOURCES_CHANNEL) — the controller's
         # coalesced publisher drains this.
-        self.dirty_nodes: Set[NodeID] = set()
+        self.dirty_nodes: Set[NodeID] = GuardedSet(
+            OWNER_THREAD, owner=self, name="dirty_nodes"
+        )
         self.native = None
         if not get_config().disable_native_sched:
             try:

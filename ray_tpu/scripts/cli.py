@@ -987,6 +987,13 @@ def cmd_lint(args):
     return run(args)
 
 
+def cmd_sanitize(args):
+    """Concurrency sanitizer gate (see ray_tpu/tools/sanitizer/)."""
+    from ray_tpu.tools.sanitizer.cli import cmd_sanitize as run
+
+    return run(args)
+
+
 def cmd_up(args):
     from ray_tpu.autoscaler.commands import create_or_update_cluster
 
@@ -1211,6 +1218,16 @@ def main(argv=None):
 
     add_lint_args(sp)
     sp.set_defaults(fn=cmd_lint)
+
+    sp = sub.add_parser(
+        "sanitize",
+        help="concurrency sanitizer: guard-annotation checks (RTL009-011), "
+        "lock-order cross-check, runtime witness reports",
+    )
+    from ray_tpu.tools.sanitizer.cli import add_sanitize_args
+
+    add_sanitize_args(sp)
+    sp.set_defaults(fn=cmd_sanitize)
 
     args = p.parse_args(argv)
     entry = getattr(args, "entrypoint", None)

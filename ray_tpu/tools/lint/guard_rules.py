@@ -1,0 +1,449 @@
+"""Lint rules RTL009–RTL011: the static side of ConcSan.
+
+These check the ``@guarded_by`` / ``GuardedDict`` / ``GuardedSet``
+annotation vocabulary (``ray_tpu/util/guards.py``) lexically:
+
+* RTL009 unguarded-access — a read/write of a lock-guarded attribute
+  that is not inside ``with self.<guard>:`` (without crossing a
+  function boundary — a nested def's body runs later, on somebody
+  else's stack), not in a ``@guarded_by("<guard>")`` method, not in
+  ``__init__``/``__new__`` (construction is single-threaded by
+  definition), and not a sanctioned atomic read (``snapshot()`` /
+  ``cycle_snapshot()`` argument, ``len()``/``bool()``).
+* RTL010 guard-inconsistency — the annotation itself is incoherent:
+  the same attribute declared with two different guards, an access
+  lexically under a *different* lock than the declared one (the
+  classic wrong-lock bug TSan calls "mutex mismatch"), or a
+  ``@guarded_by`` naming an attribute the class never assigns.
+* RTL011 callback-touches-guarded-state — a nested function or lambda
+  handed to a registrar (``subscribe``, ``add_done_callback``,
+  ``add_callback``, ...) whose body touches a guard-annotated
+  attribute directly. Callbacks run on whatever thread the registrar
+  chooses — pubsub IO threads, executor completion threads — so the
+  lexical guard context where the callback was *created* proves
+  nothing about where it *runs*. OWNER_THREAD state is checked here
+  too (its whole contract is "only the owner thread touches this").
+
+Scope: self/cls attribute accesses within the declaring module. The
+dynamic witness (``tools/sanitizer/runtime.py``) covers what the AST
+cannot see — aliased references, cross-module access, real thread
+identities.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.tools.lint.framework import Checker, Finding, ModuleContext, register
+from ray_tpu.tools.lint.rules import dotted, lock_text, is_lock_expr
+
+OWNER_THREAD = "@owner-thread"
+
+_GUARD_CTORS = {"GuardedDict", "GuardedSet"}
+# Sanctioned atomic single-op reads of a guarded container: one C-level
+# operation under the GIL, no torn state observable.
+_SNAPSHOT_FUNCS = {"snapshot", "cycle_snapshot"}
+_ATOMIC_FUNCS = {"len", "bool"}
+# Callback registrars whose callables run on another thread (or on a
+# thread the AST cannot determine).
+_REGISTRARS = {
+    "subscribe",
+    "add_done_callback",
+    "add_callback",
+    "add_listener",
+    "register",
+    "register_handler",
+    "on_message",
+    "call_soon_threadsafe",
+    "Thread",  # target=... callables literally run on another thread
+}
+
+
+class _Decl:
+    __slots__ = ("guard", "node", "cls_name")
+
+    def __init__(self, guard: str, node: ast.AST, cls_name: str):
+        self.guard = guard
+        self.node = node
+        self.cls_name = cls_name
+
+
+def _guard_arg(call: ast.Call) -> str:
+    """The declared guard of a GuardedDict/GuardedSet constructor call."""
+    if not call.args:
+        return OWNER_THREAD
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    d = dotted(arg)
+    if d and d.rsplit(".", 1)[-1] == "OWNER_THREAD":
+        return OWNER_THREAD
+    return OWNER_THREAD
+
+
+def _is_guard_ctor(call: ast.AST) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    d = dotted(call.func)
+    return bool(d) and d.rsplit(".", 1)[-1] in _GUARD_CTORS
+
+
+class _ModuleGuards:
+    """Per-module annotation inventory shared by the three rules."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        # class name -> attr -> _Decl
+        self.decls: Dict[str, Dict[str, _Decl]] = {}
+        self.conflicts: List[Tuple[_Decl, _Decl, str]] = []
+        # class name -> every self.<attr> ever assigned (for RTL010's
+        # unknown-guard check)
+        self.assigned: Dict[str, Set[str]] = {}
+        self._collect()
+
+    def _collect(self):
+        ctx = self.ctx
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            cls = ctx.enclosing_class(node)
+            if cls is None:
+                continue
+            for target in node.targets:
+                if not (
+                    isinstance(target, ast.Attribute)
+                    and dotted(target.value) in ("self", "cls")
+                ):
+                    continue
+                self.assigned.setdefault(cls.name, set()).add(target.attr)
+                if not _is_guard_ctor(node.value):
+                    continue
+                guard = _guard_arg(node.value)
+                decl = _Decl(guard, node, cls.name)
+                prev = self.decls.setdefault(cls.name, {}).setdefault(
+                    target.attr, decl
+                )
+                if prev is not decl and prev.guard != guard:
+                    self.conflicts.append((prev, decl, target.attr))
+
+    def decl_for(self, cls_name: str, attr: str) -> Optional[_Decl]:
+        return self.decls.get(cls_name, {}).get(attr)
+
+    def guarded_accesses(self) -> Iterable[Tuple[ast.Attribute, _Decl]]:
+        """Every self/cls access of an annotated attribute, minus the
+        declaration assignments themselves."""
+        ctx = self.ctx
+        decl_targets = {
+            id(t)
+            for attrs in self.decls.values()
+            for d in attrs.values()
+            for t in getattr(d.node, "targets", ())
+        }
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if dotted(node.value) not in ("self", "cls"):
+                continue
+            if id(node) in decl_targets:
+                continue
+            cls = ctx.enclosing_class(node)
+            if cls is None:
+                continue
+            decl = self.decl_for(cls.name, node.attr)
+            if decl is not None:
+                yield node, decl
+
+
+_cache: Dict[int, _ModuleGuards] = {}
+
+
+def _guards_of(ctx: ModuleContext) -> _ModuleGuards:
+    # The three rules run over the same module in sequence; build the
+    # inventory once per module (keyed by tree identity — a tmp-path
+    # fixture module and a real module never collide).
+    mg = _cache.get(id(ctx.tree))
+    if mg is None or mg.ctx is not ctx:
+        _cache.clear()
+        mg = _cache[id(ctx.tree)] = _ModuleGuards(ctx)
+    return mg
+
+
+def _enclosing_fn(ctx: ModuleContext, node: ast.AST):
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return anc
+    return None
+
+
+def _locks_between(ctx: ModuleContext, node: ast.AST) -> List[str]:
+    """Lock names held lexically at ``node`` — ``with`` items on the
+    ancestor path up to (not crossing) the first function boundary."""
+    out: List[str] = []
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            break
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                if is_lock_expr(item.context_expr):
+                    text = lock_text(item.context_expr)
+                    if text.startswith(("self.", "cls.")):
+                        out.append(text.split(".", 1)[1])
+                    else:
+                        out.append(text)
+    return out
+
+
+def _fn_guard_decoration(fn) -> Optional[str]:
+    for dec in getattr(fn, "decorator_list", ()):
+        if (
+            isinstance(dec, ast.Call)
+            and (dotted(dec.func) or "").rsplit(".", 1)[-1] == "guarded_by"
+            and dec.args
+            and isinstance(dec.args[0], ast.Constant)
+            and isinstance(dec.args[0].value, str)
+        ):
+            return dec.args[0].value
+    return None
+
+
+def _sanctioned_read(ctx: ModuleContext, access: ast.AST) -> bool:
+    parent = ctx.parent(access)
+    if not isinstance(parent, ast.Call) or access not in parent.args:
+        return False
+    d = dotted(parent.func) or ""
+    return d.rsplit(".", 1)[-1] in _SNAPSHOT_FUNCS | _ATOMIC_FUNCS
+
+
+def _classify(ctx: ModuleContext, access: ast.Attribute, decl: _Decl) -> str:
+    """'ok' | 'unguarded' (RTL009) | 'wrong_lock' (RTL010)."""
+    if _sanctioned_read(ctx, access):
+        return "ok"
+    fn = _enclosing_fn(ctx, access)
+    fn_name = getattr(fn, "name", "")
+    if fn_name in ("__init__", "__new__"):
+        cls = ctx.enclosing_class(fn)
+        if cls is not None and cls.name == decl.cls_name:
+            return "ok"  # construction is single-threaded
+    if fn is not None and _fn_guard_decoration(fn) == decl.guard:
+        return "ok"
+    held = _locks_between(ctx, access)
+    if decl.guard in held:
+        return "ok"
+    if held:
+        return "wrong_lock"
+    return "unguarded"
+
+
+# ---------------------------------------------------------------------------
+# RTL009 — unguarded access to guard-annotated state
+
+
+@register
+class UnguardedAccess(Checker):
+    rule = "RTL009"
+    name = "unguarded-access"
+    description = "guard-annotated attribute accessed without its lock"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for access, decl in _guards_of(ctx).guarded_accesses():
+            if decl.guard == OWNER_THREAD:
+                continue  # thread affinity is RTL011's + the runtime's job
+            if _classify(ctx, access, decl) != "unguarded":
+                continue
+            parent = ctx.parent(access)
+            op = "read"
+            if isinstance(access.ctx, (ast.Store, ast.Del)) or (
+                isinstance(parent, ast.Subscript)
+                and isinstance(parent.ctx, (ast.Store, ast.Del))
+            ):
+                op = "write"
+            findings.append(
+                ctx.finding(
+                    self.rule,
+                    access,
+                    f"{op} of {decl.cls_name}.{access.attr} (guarded by "
+                    f"`self.{decl.guard}`) outside `with self.{decl.guard}:`"
+                    " — take the lock, use snapshot()/cycle_snapshot(), or "
+                    "mark the method @guarded_by",
+                )
+            )
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# RTL010 — inconsistent guard annotations
+
+
+@register
+class GuardInconsistency(Checker):
+    rule = "RTL010"
+    name = "guard-inconsistency"
+    description = "guard annotation conflicts with itself or with usage"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        mg = _guards_of(ctx)
+        findings: List[Finding] = []
+        for prev, dup, attr in mg.conflicts:
+            findings.append(
+                ctx.finding(
+                    self.rule,
+                    dup.node,
+                    f"{dup.cls_name}.{attr} re-declared with guard "
+                    f"`{dup.guard}` but first declared with `{prev.guard}` "
+                    f"(line {prev.node.lineno}) — one structure, one guard",
+                )
+            )
+        for access, decl in mg.guarded_accesses():
+            if decl.guard == OWNER_THREAD:
+                continue
+            if _classify(ctx, access, decl) != "wrong_lock":
+                continue
+            held = _locks_between(ctx, access)
+            findings.append(
+                ctx.finding(
+                    self.rule,
+                    access,
+                    f"{decl.cls_name}.{access.attr} is guarded by "
+                    f"`self.{decl.guard}` but accessed under "
+                    f"`{held[0]}` — holding the wrong lock protects "
+                    "nothing",
+                )
+            )
+        # rebinding an OWNER_THREAD-annotated attribute outside __init__
+        # silently REPLACES the GuardedDict with whatever plain value the
+        # right-hand side built — the annotation (and the runtime witness
+        # with it) is gone. Rebuild in place: clear() + update().
+        # (lock-guarded rebinds are already RTL009 unguarded-writes.)
+        for access, decl in mg.guarded_accesses():
+            if decl.guard != OWNER_THREAD:
+                continue
+            if not isinstance(access.ctx, ast.Store):
+                continue
+            parent = ctx.parent(access)
+            if isinstance(parent, ast.Assign) and _is_guard_ctor(parent.value):
+                continue  # re-annotating is fine
+            fn = _enclosing_fn(ctx, access)
+            if getattr(fn, "name", "") in ("__init__", "__new__"):
+                continue
+            findings.append(
+                ctx.finding(
+                    self.rule,
+                    access,
+                    f"rebinding {decl.cls_name}.{access.attr} discards its "
+                    "guard annotation (the new value is a plain container) "
+                    "— mutate in place (clear() + update()) or re-declare "
+                    "the GuardedDict/GuardedSet",
+                )
+            )
+        # @guarded_by naming an attribute the class never assigns
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            guard = _fn_guard_decoration(node)
+            if guard is None or guard == OWNER_THREAD:
+                continue
+            cls = ctx.enclosing_class(node)
+            if cls is None:
+                continue
+            if guard not in mg.assigned.get(cls.name, set()):
+                findings.append(
+                    ctx.finding(
+                        self.rule,
+                        node,
+                        f"@guarded_by({guard!r}) on {cls.name}.{node.name} "
+                        f"but {cls.name} never assigns `self.{guard}` — "
+                        "the contract names a lock that does not exist",
+                    )
+                )
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# RTL011 — callbacks touching guarded state
+
+
+@register
+class CallbackTouchesGuarded(Checker):
+    rule = "RTL011"
+    name = "callback-touches-guarded-state"
+    description = "cross-thread callback touches guard-annotated state"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        mg = _guards_of(ctx)
+        if not mg.decls:
+            return ()
+        findings: List[Finding] = []
+        for call in ast.walk(ctx.tree):
+            registrar = self._registrar_name(call)
+            if registrar is None:
+                continue
+            for cb in self._callback_nodes(ctx, call):
+                findings.extend(self._scan_callback(ctx, mg, registrar, cb))
+        return findings
+
+    @staticmethod
+    def _registrar_name(node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        fn = node.func
+        name = (
+            fn.attr
+            if isinstance(fn, ast.Attribute)
+            else fn.id if isinstance(fn, ast.Name) else ""
+        )
+        return name if name in _REGISTRARS else None
+
+    @staticmethod
+    def _callback_nodes(ctx: ModuleContext, call: ast.Call) -> Iterable[ast.AST]:
+        """The callable AST nodes handed to this registrar: inline
+        lambdas, or nested defs referenced by name from the same
+        function scope."""
+        candidates = list(call.args) + [
+            kw.value for kw in call.keywords if kw.arg in ("callback", "target", "fn", "handler")
+        ]
+        local_defs: Dict[str, ast.AST] = {}
+        fn = _enclosing_fn(ctx, call)
+        if fn is not None:
+            for stmt in ast.walk(fn):
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    local_defs[stmt.name] = stmt
+        for arg in candidates:
+            if isinstance(arg, ast.Lambda):
+                yield arg
+            elif isinstance(arg, ast.Name) and arg.id in local_defs:
+                yield local_defs[arg.id]
+
+    def _scan_callback(
+        self, ctx: ModuleContext, mg: _ModuleGuards, registrar: str, cb: ast.AST
+    ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        cls = ctx.enclosing_class(cb)
+        if cls is None:
+            return out
+        for node in ast.walk(cb):
+            if not (
+                isinstance(node, ast.Attribute)
+                and dotted(node.value) in ("self", "cls")
+            ):
+                continue
+            decl = mg.decl_for(cls.name, node.attr)
+            if decl is None:
+                continue
+            # a callback that takes the declared lock itself is fine
+            if decl.guard != OWNER_THREAD and decl.guard in _locks_between(
+                ctx, node
+            ):
+                continue
+            out.append(
+                ctx.finding(
+                    self.rule,
+                    node,
+                    f"callback registered via .{registrar}() touches "
+                    f"{decl.cls_name}.{node.attr} (guarded by "
+                    f"`{decl.guard}`) directly — callbacks run on the "
+                    "registrar's thread; marshal onto the owner (loop/"
+                    "queue) or take the guard inside the callback",
+                )
+            )
+        return out

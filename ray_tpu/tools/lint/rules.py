@@ -865,3 +865,8 @@ class PrintInPackage(Checker):
                     )
                 )
         return findings
+
+
+# RTL009–RTL011 (the ConcSan guard-annotation rules) live in their own
+# module; importing it here self-registers them alongside RTL001–RTL008.
+from ray_tpu.tools.lint import guard_rules  # noqa: E402,F401

@@ -1,0 +1,23 @@
+"""ConcSan — the two-sided concurrency sanitizer.
+
+Static side: lint rules RTL009–RTL011 (``tools/lint/guard_rules.py``)
+check the ``@guarded_by`` / ``GuardedDict`` annotation vocabulary
+(``util/guards.py``) lexically. Dynamic side, this package:
+
+* :mod:`runtime` — the lockset-style runtime witness (Eraser
+  algorithm): records the held-lock set at every annotated-state access
+  and flags accesses whose lockset intersection goes empty, plus
+  owner-thread violations on the control plane's single-writer state.
+* :mod:`fuzzer` — a seeded deterministic thread-interleaving fuzzer
+  injecting preemptions at lock-boundary yield points; a finding's seed
+  replays the schedule that produced it.
+* :mod:`lockorder` — cross-checks lockwatch's observed lock-order
+  edges against the static graph RTL005 builds, reporting static-only
+  (never exercised) and dynamic-only (invisible to the AST) edges.
+* :mod:`cli` — ``ray-tpu sanitize`` (human + ``--json``).
+
+Enable per process with ``RAY_TPU_CONCSAN=1`` (+ optionally
+``RAY_TPU_CONCSAN_DIR=<dir>`` to have every cluster process dump its
+findings as ``concsan-<pid>.json`` at exit — the controller and agents
+are subprocesses, so in-memory state never crosses back).
+"""

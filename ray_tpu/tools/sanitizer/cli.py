@@ -1,0 +1,226 @@
+"""``ray-tpu sanitize`` — the ConcSan concurrency-correctness gate.
+
+One command, three passes, one verdict:
+
+1. **static guards** — lint rules RTL009–RTL011 over the guard
+   annotations (``GuardedDict``/``GuardedSet``/``@guarded_by``);
+2. **static lock graph** — RTL005's lexical acquisition graph plus
+   one-hop call-through derived edges (``lockorder.build_static``);
+3. **dynamic** (optional) — ConcSan process reports from
+   ``--dynamic-dir`` (or produced on the spot by ``--pytest``): runtime
+   witness findings (empty locksets, owner-thread violations,
+   ``@guarded_by`` contract breaks) and the static↔dynamic lock-order
+   cross-check. A dynamic-only edge — an acquisition order the AST
+   cannot see and no allowlist entry explains — fails the gate, because
+   RTL005's inversion detection is blind to it.
+
+Exit-code contract (stable for CI):
+  0  clean
+  1  findings (static guard findings, runtime findings, or unexplained
+     dynamic-only lock-order edges)
+  2  usage or configuration error
+
+``--json`` emits one machine-readable document on stdout.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from typing import List, Optional
+
+from ray_tpu.tools.lint.framework import _find_root, load_config, run_lint
+from ray_tpu.tools.sanitizer import lockorder
+from ray_tpu.tools.sanitizer.runtime import load_reports
+
+GUARD_RULES = ["RTL009", "RTL010", "RTL011"]
+
+
+def add_sanitize_args(sp: argparse.ArgumentParser):
+    sp.add_argument(
+        "paths", nargs="*", help="files/dirs to analyze (default: config paths)"
+    )
+    sp.add_argument("--root", default=None, help="project root (default: auto)")
+    sp.add_argument("--json", action="store_true", help="machine-readable output")
+    sp.add_argument(
+        "--dynamic-dir",
+        default=None,
+        metavar="DIR",
+        help="directory of concsan-<pid>.json process reports to cross-check "
+        "(produced by running any workload with RAY_TPU_CONCSAN=1 and "
+        "RAY_TPU_CONCSAN_DIR=DIR)",
+    )
+    sp.add_argument(
+        "--pytest",
+        nargs=argparse.REMAINDER,
+        default=None,
+        metavar="ARGS",
+        help="run `pytest ARGS` under ConcSan in a subprocess, then analyze "
+        "its reports (convenience wrapper around --dynamic-dir)",
+    )
+    sp.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report baselined static findings too",
+    )
+
+
+def _run_pytest_under_concsan(
+    pytest_args: List[str], report_dir: str, json_mode: bool
+) -> int:
+    env = dict(os.environ)
+    env["RAY_TPU_CONCSAN"] = "1"
+    env["RAY_TPU_CONCSAN_DIR"] = report_dir
+    cmd = [sys.executable, "-m", "pytest", *pytest_args]
+    print(f"ray-tpu sanitize: running {' '.join(cmd)} under ConcSan", file=sys.stderr)
+    if not json_mode:
+        return subprocess.call(cmd, env=env)
+    # --json promises a single JSON document on stdout; the workload's
+    # output must not interleave with it.
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True)
+    sys.stderr.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    return proc.returncode
+
+
+def cmd_sanitize(args) -> int:
+    root = os.path.abspath(args.root) if args.root else _find_root()
+    try:
+        config = load_config(root)
+    except Exception as e:  # malformed pyproject section
+        print(f"ray-tpu sanitize: bad config: {e}", file=sys.stderr)
+        return 2
+    paths = args.paths or None
+
+    # -- pass 1: static guard checking (RTL009–011) ---------------------
+    config.enable = list(GUARD_RULES)
+    config.disable = []
+    static_result = run_lint(
+        paths=paths, root=root, config=config, use_baseline=not args.no_baseline
+    )
+    # Only guard rules ran, so baseline entries for the other rules
+    # naturally went unmatched — that is `ray-tpu lint`'s staleness to
+    # police, not this gate's.
+    static_result.stale_baseline = [
+        e for e in static_result.stale_baseline if e.get("rule") in GUARD_RULES
+    ]
+    if static_result.files_checked == 0:
+        print(
+            f"ray-tpu sanitize: no Python files found under "
+            f"{paths or config.paths} (root {root})",
+            file=sys.stderr,
+        )
+        return 2
+
+    # -- pass 2: static lock graph --------------------------------------
+    static_graph = lockorder.build_static(root, paths=paths, config=load_config(root))
+
+    # -- pass 3: dynamic reports (optional) -----------------------------
+    dynamic_dir: Optional[str] = args.dynamic_dir
+    pytest_rc: Optional[int] = None
+    tmp: Optional[tempfile.TemporaryDirectory] = None
+    if args.pytest is not None:
+        if dynamic_dir is None:
+            tmp = tempfile.TemporaryDirectory(prefix="concsan-")
+            dynamic_dir = tmp.name
+        pytest_rc = _run_pytest_under_concsan(args.pytest, dynamic_dir, args.json)
+
+    runtime_findings: List[dict] = []
+    cross: Optional[dict] = None
+    reports: List[dict] = []
+    try:
+        if dynamic_dir is not None:
+            reports = load_reports(dynamic_dir)
+            if not reports:
+                print(
+                    f"ray-tpu sanitize: no ConcSan reports under {dynamic_dir} "
+                    "(was the workload run with RAY_TPU_CONCSAN=1 and "
+                    "RAY_TPU_CONCSAN_DIR set?)",
+                    file=sys.stderr,
+                )
+                return 2
+            dynamic_edges = [e for r in reports for e in r.get("lock_graph", [])]
+            runtime_findings = [
+                f for r in reports for f in r.get("findings", [])
+            ]
+            cross = lockorder.cross_check(
+                root, dynamic_edges, static=static_graph, paths=paths
+            )
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+    dynamic_only = cross["dynamic_only"] if cross else []
+    failed = bool(
+        not static_result.clean or runtime_findings or dynamic_only
+        or (pytest_rc not in (None, 0))
+    )
+
+    doc = {
+        "version": 1,
+        "clean": not failed,
+        "static": static_result.to_json(),
+        "lock_graph": {
+            "static_edges": len(static_graph.edges),
+            "derived_edges": len(static_graph.derived),
+            "creation_sites": len(static_graph.creation_sites),
+        },
+        "runtime_findings": runtime_findings,
+        "cross_check": cross,
+        "processes_reported": len(reports),
+        "pytest_exit": pytest_rc,
+    }
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 1 if failed else 0
+
+    for f in static_result.findings:
+        print(f.render())
+    for f in runtime_findings:
+        print(
+            f"runtime {f.get('kind')}: {f.get('state')} "
+            f"op={f.get('op')} at {f.get('site')} thread={f.get('thread')} "
+            f"held={f.get('held')}"
+            + (
+                f" fuzz_seed={f['fuzz_seed']}"
+                if f.get("fuzz_seed") is not None
+                else ""
+            )
+        )
+    for e in dynamic_only:
+        print(
+            f"dynamic-only lock edge: {e['src']} -> {e['dst']} "
+            f"(observed {e['observed_at']}; no lexical/derived/allowlisted "
+            "explanation — RTL005 cannot see inversions against it)"
+        )
+    summary = (
+        f"ray-tpu sanitize: {static_result.files_checked} files, "
+        f"{len(static_result.findings)} static finding(s), "
+        f"{len(static_graph.edges)} static lock edges "
+        f"(+{len(static_graph.derived)} derived)"
+    )
+    if cross is not None:
+        summary += (
+            f"; dynamic: {len(reports)} process report(s), "
+            f"{len(runtime_findings)} runtime finding(s), "
+            f"{len(cross['matched'])} matched / {len(dynamic_only)} "
+            f"dynamic-only / {len(cross['allowlisted'])} allowlisted edges "
+            f"({cross['external_edges']} external)"
+        )
+    if pytest_rc is not None:
+        summary += f"; pytest exit {pytest_rc}"
+    print(summary)
+    return 1 if failed else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray-tpu sanitize", description=__doc__)
+    add_sanitize_args(p)
+    return cmd_sanitize(p.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

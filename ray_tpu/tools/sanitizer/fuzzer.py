@@ -1,0 +1,146 @@
+"""Seeded thread-interleaving fuzzer: deterministic preemption injection.
+
+A data race needs two things to be SEEN: the buggy access pattern and an
+unlucky interleaving. The lockset witness (runtime.py) removes the
+second requirement for lock-discipline bugs, but actually *corrupting*
+state — and re-corrupting it in a regression test — takes control over
+where threads get preempted. This module injects sleeps at the three
+yield points the sanitizer already instruments:
+
+* ``("acquire", lock)`` — before blocking on a watched lock;
+* ``("release", lock)`` — just after letting one go;
+* ``("access", state)`` — before each guarded-container access.
+
+Decisions are a pure function of ``(seed, thread name, point kind,
+per-thread counter)`` through crc32 — **not** the builtin ``hash``
+(randomized per process) and **not** wall-clock or ``random`` state — so
+the same seed replays the same injection schedule in any process. A
+finding records the active seed; a regression test replays it:
+
+    with fuzzing(seed=finding["fuzz_seed"]):
+        run_the_racy_workload()
+
+The schedule keeps a bounded trace of its decisions for debugging and
+for asserting replay identity in tests.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import zlib
+from typing import Iterable, List, Optional, Tuple
+
+from ray_tpu.util import lockwatch
+from ray_tpu.tools.sanitizer import runtime
+
+_POINTS = ("acquire", "release", "access")
+
+
+class FuzzSchedule:
+    """One deterministic preemption schedule, parameterized by seed.
+
+    ``period`` controls injection density (one preemption per ~period
+    decisions per thread); ``max_sleep_us`` bounds each injected sleep.
+    Defaults are tuned so a fuzzed test runs ~2-3x its normal wall time,
+    not 100x.
+    """
+
+    def __init__(
+        self,
+        seed: int,
+        period: int = 4,
+        max_sleep_us: int = 500,
+        points: Iterable[str] = _POINTS,
+    ):
+        self.seed = int(seed)
+        self.period = max(1, int(period))
+        self.max_sleep_us = max(1, int(max_sleep_us))
+        self.points = frozenset(points)
+        self._tls = threading.local()
+        self._trace_lock = lockwatch._REAL_LOCK()
+        self._trace: List[Tuple[str, str, int, int]] = []
+        self._MAX_TRACE = 4096
+
+    def decide(self, thread_name: str, point: str, counter: int) -> int:
+        """Pure decision function: microseconds to sleep (0 = don't).
+        Exposed for replay-identity tests."""
+        h = zlib.crc32(
+            f"{self.seed}:{thread_name}:{point}:{counter}".encode()
+        )
+        if h % self.period:
+            return 0
+        return 1 + (h >> 8) % self.max_sleep_us
+
+    def __call__(self, point: str, detail: str) -> None:
+        if point not in self.points:
+            return
+        counters = getattr(self._tls, "counters", None)
+        if counters is None:
+            counters = self._tls.counters = {}
+        n = counters.get(point, 0)
+        counters[point] = n + 1
+        name = threading.current_thread().name
+        us = self.decide(name, point, n)
+        if not us:
+            return
+        with self._trace_lock:
+            if len(self._trace) < self._MAX_TRACE:
+                self._trace.append((name, point, n, us))
+        time.sleep(us / 1e6)
+
+    def trace(self) -> List[Tuple[str, str, int, int]]:
+        with self._trace_lock:
+            return list(self._trace)
+
+
+_active: Optional[FuzzSchedule] = None
+
+
+def install(schedule: FuzzSchedule) -> None:
+    """Route both yield-point sources (lockwatch lock boundaries, ConcSan
+    guarded accesses) through the schedule and stamp its seed into
+    findings."""
+    global _active
+    _active = schedule
+    lockwatch.set_yield_hook(schedule)
+    runtime.set_access_hook(schedule)
+    runtime.set_fuzz_seed(schedule.seed)
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+    lockwatch.set_yield_hook(None)
+    runtime.set_access_hook(None)
+    runtime.set_fuzz_seed(None)
+
+
+def active() -> Optional[FuzzSchedule]:
+    return _active
+
+
+@contextlib.contextmanager
+def fuzzing(seed: int, **kw):
+    """Run a block under a seeded preemption schedule (replay entry
+    point: pass a finding's ``fuzz_seed``)."""
+    sched = FuzzSchedule(seed, **kw)
+    install(sched)
+    try:
+        yield sched
+    finally:
+        uninstall()
+
+
+def sweep(workload, seeds: Iterable[int], **kw) -> Optional[int]:
+    """Run ``workload()`` once per seed; return the first seed whose run
+    produced a ConcSan finding (None if all clean). The witness findings
+    are reset per seed so attribution is unambiguous."""
+    for seed in seeds:
+        runtime.reset()
+        with fuzzing(seed, **kw):
+            workload()
+        if runtime.report()["findings"]:
+            return seed
+    runtime.reset()
+    return None

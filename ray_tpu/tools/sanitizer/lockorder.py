@@ -1,0 +1,275 @@
+"""Static ↔ dynamic lock-order cross-check.
+
+RTL005 builds the project's lexical lock-acquisition graph from nested
+``with`` statements; lockwatch observes the real one at runtime. Each
+side sees things the other cannot:
+
+* **static-only edges** — orders written in the source but never
+  exercised by the suite (coverage gaps: informational);
+* **dynamic-only edges** — orders the AST cannot see (locks taken
+  through calls, callbacks, or data-driven dispatch). These are the
+  dangerous ones: RTL005's inversion detection is blind to them, so an
+  inversion against a dynamic-only edge ships silently.
+
+The join key is the lock's CREATION site: lockwatch records the
+``(file, line)`` where ``threading.Lock()`` ran (see
+``lockwatch.graph_snapshot``), and this module AST-scans the same
+assignment sites (``self._lock = threading.Lock()``) to name them
+canonically the way RTL005 does (``module.Class._lock``).
+
+To keep "dynamic-only" honest, the static side is widened with ONE hop
+of call-through: a lock held around ``self.m(...)`` reaches the locks
+``m`` acquires lexically, and a ``@guarded_by("g")`` method's body
+counts as holding ``g``. Derived edges EXPLAIN dynamic observations;
+they never feed RTL005's inversion reporting. Remaining explained
+dynamic-only edges live in a committed allowlist
+(``.concsan-edges.json``) with one-line justifications — the gate
+fails on any edge in none of these buckets.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.tools.lint.framework import (
+    LintConfig,
+    ModuleContext,
+    iter_python_files,
+    load_config,
+)
+from ray_tpu.tools.lint.rules import (
+    LockOrder,
+    dotted,
+    import_aliases,
+    is_lock_expr,
+    lock_text,
+)
+
+ALLOWLIST_FILE = ".concsan-edges.json"
+
+_LOCK_FACTORIES = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+
+
+def _is_lock_ctor(call: ast.Call, aliases: Dict[str, str]) -> bool:
+    d = dotted(call.func)
+    if not d:
+        return False
+    head = d.split(".", 1)[0]
+    resolved = d.replace(head, aliases.get(head, head), 1)
+    return resolved in _LOCK_FACTORIES or resolved.endswith(
+        ("threading.Lock", "threading.RLock")
+    )
+
+
+class StaticGraph:
+    """The static side: lexical edges (RTL005's), one-hop derived edges,
+    and the creation-site → canonical-name map."""
+
+    def __init__(self):
+        self.edges: Set[Tuple[str, str]] = set()
+        self.derived: Set[Tuple[str, str]] = set()
+        self.creation_sites: Dict[Tuple[str, int], str] = {}
+        # class canon prefix -> method name -> locks acquired lexically
+        self._method_locks: Dict[str, Dict[str, Set[str]]] = {}
+        # (held lock, class canon, called method name)
+        self._calls_under_lock: Set[Tuple[str, str, str]] = set()
+        # (guard canon, class canon, method name) for @guarded_by bodies
+        self._guarded_methods: List[Tuple[str, str, str]] = []
+
+
+def build_static(
+    root: str,
+    paths: Optional[Iterable[str]] = None,
+    config: Optional[LintConfig] = None,
+) -> StaticGraph:
+    root = os.path.abspath(root)
+    config = config or load_config(root)
+    lock_order = LockOrder()
+    g = StaticGraph()
+    for path in iter_python_files(list(paths or config.paths), root, config.exclude):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue
+        ctx = ModuleContext(path, rel, source, tree)
+        lock_order.check(ctx)  # accumulates lexical edges
+        _scan_module(ctx, g)
+    g.edges = set(lock_order.edges)
+    _expand_one_hop(g)
+    return g
+
+
+def _scan_module(ctx: ModuleContext, g: StaticGraph) -> None:
+    aliases = import_aliases(ctx.tree)
+    canon = LockOrder()._canon  # reuse RTL005's identity rules
+
+    for node in ast.walk(ctx.tree):
+        # creation sites: self.X = threading.Lock() / module _X = Lock()
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            value = node.value
+            if isinstance(value, ast.Call) and _is_lock_ctor(value, aliases):
+                for target in targets:
+                    name = canon(ctx, aliases, target, node)
+                    g.creation_sites[(ctx.relpath, value.lineno)] = name
+            continue
+
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        cls = ctx.enclosing_class(node)
+        cls_canon = (
+            f"{ctx.module_name}.{cls.name}" if cls else ctx.module_name
+        )
+        acquired = _locks_acquired(ctx, aliases, canon, node)
+        if acquired:
+            g._method_locks.setdefault(cls_canon, {})[node.name] = acquired
+        for guard in _guard_decorations(node):
+            g._guarded_methods.append(
+                (f"{cls_canon}.{guard.lstrip('.')}", cls_canon, node.name)
+            )
+        for held, callee in _self_calls_under_locks(ctx, aliases, canon, node):
+            g._calls_under_lock.add((held, cls_canon, callee))
+
+
+def _guard_decorations(fn: ast.AST) -> Iterable[str]:
+    for dec in getattr(fn, "decorator_list", ()):
+        if (
+            isinstance(dec, ast.Call)
+            and dotted(dec.func) in ("guarded_by", "guards.guarded_by")
+            and dec.args
+            and isinstance(dec.args[0], ast.Constant)
+            and isinstance(dec.args[0].value, str)
+            and dec.args[0].value != "@owner-thread"
+        ):
+            yield dec.args[0].value
+
+
+def _locks_acquired(ctx, aliases, canon, fn) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if is_lock_expr(item.context_expr):
+                    out.add(canon(ctx, aliases, item.context_expr, node))
+    return out
+
+
+def _self_calls_under_locks(ctx, aliases, canon, fn):
+    """(held lock canon, method name) for every ``self.m(...)`` call
+    lexically inside a lock-holding ``with`` within this function."""
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        held = [
+            canon(ctx, aliases, item.context_expr, node)
+            for item in node.items
+            if is_lock_expr(item.context_expr)
+        ]
+        if not held:
+            continue
+        for call in ast.walk(node):
+            if (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and dotted(call.func.value) in ("self", "cls")
+            ):
+                for h in held:
+                    yield h, call.func.attr
+
+
+def _expand_one_hop(g: StaticGraph) -> None:
+    for held, cls_canon, callee in g._calls_under_lock:
+        for inner in g._method_locks.get(cls_canon, {}).get(callee, ()):
+            if inner != held:
+                g.derived.add((held, inner))
+    for guard_canon, cls_canon, method in g._guarded_methods:
+        for inner in g._method_locks.get(cls_canon, {}).get(method, ()):
+            if inner != guard_canon:
+                g.derived.add((guard_canon, inner))
+
+
+def load_allowlist(root: str) -> Dict[Tuple[str, str], str]:
+    path = os.path.join(root, ALLOWLIST_FILE)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    return {
+        (e["src"], e["dst"]): e.get("justification", "")
+        for e in data.get("edges", [])
+    }
+
+
+def cross_check(
+    root: str,
+    dynamic_edges: Iterable[dict],
+    static: Optional[StaticGraph] = None,
+    paths: Optional[Iterable[str]] = None,
+) -> dict:
+    """Classify every observed (dynamic) edge against the static graph.
+
+    ``dynamic_edges`` is a concatenation of ``lock_graph`` lists from
+    ConcSan process reports (``lockwatch.graph_snapshot`` format).
+    Edges whose endpoints are not package creation sites (locks made by
+    tests, or created before lockwatch installed) classify as
+    ``external`` — visible in the report, not gate failures.
+    """
+    root = os.path.abspath(root)
+    static = static or build_static(root, paths=paths)
+    allow = load_allowlist(root)
+
+    def _canon_of(site: dict) -> Optional[str]:
+        path, line = site.get("file", "?"), site.get("line", 0)
+        if not path or path == "?":
+            return None
+        try:
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+        except ValueError:
+            return None
+        if rel.startswith(".."):
+            return None
+        return static.creation_sites.get((rel, line))
+
+    matched: List[dict] = []
+    dynamic_only: List[dict] = []
+    allowlisted: List[dict] = []
+    external: List[dict] = []
+    seen: Set[Tuple[str, str]] = set()
+    for edge in dynamic_edges:
+        a = _canon_of(edge.get("src_site", {}))
+        b = _canon_of(edge.get("dst_site", {}))
+        if a is None or b is None:
+            external.append(edge)
+            continue
+        pair = (a, b)
+        if pair in seen:
+            continue
+        seen.add(pair)
+        entry = {"src": a, "dst": b, "observed_at": edge.get("observed_at", "?")}
+        if pair in static.edges or pair in static.derived:
+            matched.append(entry)
+        elif pair in allow:
+            allowlisted.append({**entry, "justification": allow[pair]})
+        else:
+            dynamic_only.append(entry)
+
+    static_only = sorted(
+        f"{a} -> {b}"
+        for (a, b) in static.edges
+        if (a, b) not in {(e["src"], e["dst"]) for e in matched}
+    )
+    return {
+        "matched": matched,
+        "dynamic_only": dynamic_only,
+        "allowlisted": allowlisted,
+        "external_edges": len(external),
+        "static_only": static_only,
+        "static_edges": len(static.edges),
+        "derived_edges": len(static.derived),
+        "creation_sites": len(static.creation_sites),
+    }

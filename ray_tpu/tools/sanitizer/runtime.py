@@ -1,0 +1,345 @@
+"""ConcSan runtime witness: the Eraser lockset algorithm over guarded state.
+
+Every access to a ``GuardedDict`` / ``GuardedSet`` (checked variants,
+selected only when this module is enabled) lands in :func:`note_access`
+with the container's :class:`~ray_tpu.util.guards.GuardMeta`. The witness
+piggybacks on lockwatch — :func:`ray_tpu.util.lockwatch.current_held`
+gives the calling thread's held watched-lock set for free — and runs the
+classic per-variable state machine:
+
+    virgin → exclusive → shared_read → shared_mod
+
+* ``virgin → exclusive``: first access binds the owning thread; a
+  single-threaded container never refines a lockset (constructor fills,
+  test-local use, etc. stay silent).
+* ``exclusive → shared_*``: a second thread arrives. For lock-guarded
+  state the candidate lockset C(v) initializes to the held set and every
+  later access intersects it; C(v) = ∅ on a *write-shared* container is
+  the race candidate (``empty_lockset`` finding, counted through
+  ``lockwatch_empty_lockset_total`` so it lands in the Grafana
+  Self-healing row).
+* ``OWNER_THREAD`` guards (the controller/agent asyncio single-writer
+  discipline) use thread identity instead of locksets, with exactly ONE
+  ownership transfer allowed — the constructor-thread → loop-thread
+  handoff every cluster process performs — after which any foreign
+  access is an ``owner_thread`` finding.
+
+Deliberately NOT here: sampling or probabilistic throttling. The checked
+containers only exist when ConcSan is on, so the full-fidelity witness
+costs nothing in production.
+"""
+from __future__ import annotations
+
+import atexit
+import contextlib
+import itertools
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.util import lockwatch
+from ray_tpu.util.guards import OWNER_THREAD, GuardMeta
+
+logger = logging.getLogger("ray_tpu.concsan")
+
+_enabled = False
+# Raw (never-watched, never-fuzzed) lock for the findings list: watched
+# locks would feed the witness's own bookkeeping back into locksets.
+_state_lock = lockwatch._REAL_LOCK()
+_MAX_FINDINGS = 256
+_findings: List[dict] = []
+_finding_keys: set = set()
+_tls = threading.local()
+_thread_names: Dict[int, str] = {}
+# threading.get_ident() values are RECYCLED when threads exit — two
+# sequential short-lived threads routinely get the same ident, which
+# would make the witness see one thread where there were two (missed
+# sharing) or mistake a fresh thread for a dead owner. Each OS thread
+# instead gets a process-unique token on first contact, pinned in its
+# TLS for its lifetime.
+_thread_tokens = itertools.count(1)
+
+
+def _thread_token() -> int:
+    tok = getattr(_tls, "token", None)
+    if tok is None:
+        tok = _tls.token = next(_thread_tokens)
+        _thread_names[tok] = threading.current_thread().name
+    return tok
+
+# Installed by the fuzzer: called as hook("access", describe) before each
+# guarded access so injected preemptions widen read-modify-write windows.
+_access_hook = None
+# The active fuzzer seed, stamped into findings so any race the fuzzer
+# surfaces carries its replay schedule.
+_fuzz_seed: Optional[int] = None
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(report_dir: Optional[str] = None) -> None:
+    """Turn the witness on for THIS process (idempotent).
+
+    Installs lockwatch if needed (the held-set source), registers this
+    module with ``util.guards`` so containers pick the checked variants,
+    and — when ``report_dir`` is given — registers an atexit dump so
+    subprocess findings survive process exit.
+    """
+    global _enabled
+    if _enabled:
+        return
+    lockwatch.install()
+    from ray_tpu.util import guards
+
+    guards._runtime = sys.modules[__name__]
+    _enabled = True
+    if report_dir:
+        atexit.register(_dump_report, report_dir)
+    logger.info("ConcSan enabled (report_dir=%s)", report_dir or "<none>")
+
+
+def disable() -> None:
+    """Turn the witness off (tests). Containers constructed while it was
+    on keep their checked accessors but ``note_access`` early-outs, so
+    they revert to plain-dict cost minus one predictable branch;
+    containers constructed after this are plain again. Does not
+    uninstall lockwatch (other tooling shares it)."""
+    global _enabled
+    _enabled = False
+
+
+def maybe_enable() -> bool:
+    """Enable iff ``RAY_TPU_CONCSAN=1`` — called from ``ray_tpu/__init__``
+    so every cluster process (controller/agents/workers are subprocesses
+    inheriting the env) self-arms on import."""
+    if os.environ.get("RAY_TPU_CONCSAN", "") == "1":
+        enable(os.environ.get("RAY_TPU_CONCSAN_DIR") or None)
+    return _enabled
+
+
+def set_fuzz_seed(seed: Optional[int]) -> None:
+    global _fuzz_seed
+    _fuzz_seed = seed
+
+
+def set_access_hook(hook) -> None:
+    global _access_hook
+    _access_hook = hook
+
+
+@contextlib.contextmanager
+def sanctioned():
+    """Mark this thread's accesses as sanctioned (the ``snapshot()`` /
+    ``cycle_snapshot()`` helpers: one atomic GIL-protected copy is the
+    blessed way to read guarded state without its guard)."""
+    prev = getattr(_tls, "sanctioned", 0)
+    _tls.sanctioned = prev + 1
+    try:
+        yield
+    finally:
+        _tls.sanctioned = prev
+
+
+def _site() -> str:
+    """First stack frame outside guards.py/runtime.py — the user access."""
+    try:
+        f = sys._getframe(2)
+        while f is not None and f.f_code.co_filename.endswith(
+            ("guards.py", os.path.join("sanitizer", "runtime.py"))
+        ):
+            f = f.f_back
+        if f is None:
+            return "?"
+        return f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno}"
+    except Exception:  # noqa: BLE001 — frame depth off at thread exit
+        return "?"
+
+
+def _add_finding(kind: str, meta_desc: str, detail: dict) -> bool:
+    """Record one deduplicated finding; returns True if it was new."""
+    site = detail.get("site", "?")
+    key = (kind, meta_desc, site)
+    with _state_lock:
+        if key in _finding_keys or len(_findings) >= _MAX_FINDINGS:
+            return False
+        _finding_keys.add(key)
+        finding = {
+            "kind": kind,
+            "state": meta_desc,
+            "fuzz_seed": _fuzz_seed,
+            "pid": os.getpid(),
+            "time": time.time(),
+            **detail,
+        }
+        _findings.append(finding)
+    logger.warning("ConcSan %s: %s %s", kind, meta_desc, detail)
+    return True
+
+
+def note_access(meta: GuardMeta, op: str) -> None:
+    """One guarded-container access (called by the checked variants)."""
+    if not _enabled or getattr(_tls, "sanctioned", 0):
+        return
+    hook = _access_hook
+    if hook is not None:
+        try:
+            hook("access", meta.describe())
+        except Exception as e:  # noqa: BLE001
+            # the fuzzer must never break the program under test
+            logger.debug("ConcSan access hook failed: %s", e)
+    t = _thread_token()
+    held = lockwatch.current_held()
+    held_ids = frozenset(id(entry[0]) for entry in held)
+
+    with _state_lock:
+        kind = _step(meta, op, t, held_ids)
+    if kind is None:
+        return
+    # Finding emission happens OUTSIDE _state_lock: _add_finding retakes
+    # it, and the metrics counter behind note_empty_lockset acquires a
+    # watched lock — neither may nest inside the state machine's lock.
+    new = _add_finding(
+        kind,
+        meta.describe(),
+        {
+            "op": op,
+            "site": _site(),
+            "thread": _thread_names.get(t, str(t)),
+            "owner": _thread_names.get(meta.owner_thread, str(meta.owner_thread)),
+            "held": [_lock_name(e[0]) for e in held],
+            "guard": meta.guard,
+        },
+    )
+    if new and kind == "empty_lockset":
+        lockwatch.note_empty_lockset()
+
+
+def _step(meta: GuardMeta, op: str, t: int, held_ids) -> Optional[str]:
+    """Advance one meta's Eraser state machine (caller holds _state_lock).
+    Returns the finding kind to emit, or None."""
+    if len(meta.threads_seen) < 32:
+        meta.threads_seen.add(t)
+
+    if meta.state == "virgin":
+        meta.state = "exclusive"
+        meta.owner_thread = t
+        return None
+
+    if meta.guard == OWNER_THREAD:
+        if t == meta.owner_thread:
+            return None
+        if not meta.transferred:
+            # the one blessed handoff: constructed on the spawning thread,
+            # owned by the event-loop thread ever after
+            meta.transferred = True
+            meta.owner_thread = t
+            return None
+        if "owner_thread" in meta.reported:
+            return None
+        meta.reported.add("owner_thread")
+        return "owner_thread"
+
+    # lock-named guard: Eraser proper
+    if meta.state == "exclusive":
+        if t == meta.owner_thread:
+            return None
+        meta.state = "shared_mod" if op == "write" else "shared_read"
+        meta.lockset = held_ids
+        return None
+
+    if op == "write":
+        meta.state = "shared_mod"
+    meta.lockset = (
+        held_ids if meta.lockset is None else meta.lockset & held_ids
+    )
+    if meta.state != "shared_mod" or meta.lockset:
+        return None
+    if "empty_lockset" in meta.reported:
+        return None
+    meta.reported.add("empty_lockset")
+    return "empty_lockset"
+
+
+def note_method_entry(obj, guard: str, qualname: str) -> None:
+    """``@guarded_by("<lock>")`` contract check on method entry: the named
+    lock must already be held by this thread (callers acquire)."""
+    if not _enabled or guard == OWNER_THREAD:
+        return
+    lock = getattr(obj, guard, None)
+    if lock is None or not isinstance(lock, lockwatch.WatchedLock):
+        return  # unwatched guard: identity can't be checked, skip
+    if any(entry[0] is lock for entry in lockwatch.current_held()):
+        return
+    _add_finding(
+        "guard_method",
+        f"{qualname} (guarded_by {guard})",
+        {
+            "op": "call",
+            "site": _site(),
+            "thread": threading.current_thread().name,
+            "held": [
+                _lock_name(e[0]) for e in lockwatch.current_held()
+            ],
+        },
+    )
+
+
+def _lock_name(lock) -> str:
+    try:
+        return lockwatch._names.get(lock._wuid, "?")
+    except Exception:  # noqa: BLE001 — foreign lock object
+        return "?"
+
+
+def report() -> dict:
+    """Everything the CLI / gate consumes, JSON-safe."""
+    with _state_lock:
+        findings = list(_findings)
+    return {
+        "enabled": _enabled,
+        "pid": os.getpid(),
+        "fuzz_seed": _fuzz_seed,
+        "findings": findings,
+        "lock_graph": lockwatch.graph_snapshot(),
+    }
+
+
+def reset() -> None:
+    """Clear findings (tests). Does not touch lockwatch's graph."""
+    with _state_lock:
+        _findings.clear()
+        _finding_keys.clear()
+
+
+def _dump_report(report_dir: str) -> None:
+    try:
+        os.makedirs(report_dir, exist_ok=True)
+        path = os.path.join(report_dir, f"concsan-{os.getpid()}.json")
+        with open(path, "w") as f:
+            json.dump(report(), f, indent=1, sort_keys=True)
+    except Exception as e:  # noqa: BLE001 — exit path, nothing to crash
+        logger.warning("ConcSan report dump failed: %s", e)
+
+
+def load_reports(report_dir: str) -> List[dict]:
+    """Read every ``concsan-*.json`` a cluster's processes dumped."""
+    out: List[dict] = []
+    try:
+        names = sorted(os.listdir(report_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("concsan-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(report_dir, name)) as f:
+                out.append(json.load(f))
+        except (OSError, ValueError) as e:
+            logger.warning("unreadable ConcSan report %s: %s", name, e)
+    return out

@@ -69,7 +69,7 @@ ROWS = (
                        "lockwatch_", "task_push_", "scheduler_")),
     ("Profiling", ("task_cpu_", "profiling_")),
     ("Logs & Errors", ("log_",)),
-    ("Self-healing", ("health_",)),
+    ("Self-healing", ("health_", "lockwatch_empty_lockset_")),
     ("Memory", ("object_store_", "object_refs_", "object_free_",
                 "memory_leak_")),
     ("Cluster Resources", ("tpu_hbm_", "node_",
@@ -81,10 +81,15 @@ ROWS = (
 
 
 def _row_for(name: str) -> str:
+    # Longest matching prefix wins (not first match): a specific series
+    # like lockwatch_empty_lockset_* routes to Self-healing even though
+    # the broader lockwatch_* family lives in Control Plane.
+    best, best_len = "Application", -1
     for title, prefixes in ROWS:
-        if any(name.startswith(p) for p in prefixes):
-            return title
-    return "Application"
+        for p in prefixes:
+            if name.startswith(p) and len(p) > best_len:
+                best, best_len = title, len(p)
+    return best
 
 
 def panels_for_metric(name: str, mtype: str, description: str = "") -> List[dict]:

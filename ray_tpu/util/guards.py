@@ -1,0 +1,277 @@
+"""Guard annotations: which lock protects which map.
+
+The concurrency-correctness vocabulary (round 19). PR 17 multiplied the
+control plane's shared mutable state — sharded controller hot maps,
+TopicBus subscriber registries, agent-side resource mirrors, batched
+lease windows — and the existing tooling (RTL001–RTL008, lockwatch)
+can say *that* a lock was held too long or acquired out of order, but
+not *which* lock protects which structure. These annotations close that
+gap, in the spirit of Clang's ``GUARDED_BY`` thread-safety attributes
+and the TSan discipline the Ray reference leans on:
+
+* ``self._tasks = GuardedDict("_lock", owner=self, name="tasks")`` —
+  a dict whose every access must hold ``self._lock``;
+* ``self._subs = GuardedDict(OWNER_THREAD, owner=self, name="subs")`` —
+  single-writer state owned by one thread (the asyncio-loop discipline
+  every controller map follows: no locks, loop-only mutation);
+* ``@guarded_by("_lock")`` on a method — the method is only ever called
+  with ``self._lock`` already held (callers acquire), so its accesses
+  to ``"_lock"``-guarded state are sanctioned;
+* :func:`snapshot` / :func:`cycle_snapshot` — sanctioned unguarded
+  reads: an atomic shallow copy (list()/dict() under the GIL) taken for
+  iteration outside the lock, the idiom the controller's ``_CENSUS_CHUNK``
+  census cycle and the lint allow-list both recognize.
+
+Two consumers:
+
+* **static** — lint rules RTL009–RTL011 (``tools/lint/guard_rules.py``)
+  AST-check every read/write of an annotated attribute lexically;
+* **dynamic** — the ConcSan runtime witness
+  (``tools/sanitizer/runtime.py``) records the held-lock set at every
+  access when ``RAY_TPU_CONCSAN=1`` and applies the Eraser lockset
+  algorithm on top of the declared guard.
+
+Cost discipline: with ConcSan off (the default), ``GuardedDict`` /
+``GuardedSet`` are plain dict/set subclasses with **no overridden
+accessors** — every operation stays a C-speed builtin call. The checked
+variants are only selected at construction when the sanitizer is
+enabled, so production and the normal test suite pay nothing.
+
+Both containers degrade to their plain builtin across pickling (the
+RPC layer and the GCS journal are pickle-based): the guard annotation
+is a property of the *owning process's* instance, never of the wire
+form.
+"""
+from __future__ import annotations
+
+import functools
+import weakref
+from typing import Any, List, Optional, Set
+
+# Sentinel guard: single-writer state owned by one thread (the asyncio
+# event-loop discipline of the controller/agent). The runtime witness
+# binds the owner thread on first access and allows exactly one
+# ownership transfer (constructor thread -> loop thread handoff).
+OWNER_THREAD = "@owner-thread"
+
+
+class GuardMeta:
+    """Per-container annotation record, read by the ConcSan runtime."""
+
+    __slots__ = (
+        "guard", "attr", "owner_ref", "owner_cls",
+        # Eraser state, mutated only by tools/sanitizer/runtime.py:
+        "state", "owner_thread", "transferred", "threads_seen",
+        "lockset", "reported",
+    )
+
+    def __init__(self, guard: str, attr: str, owner: Any = None):
+        self.guard = guard
+        self.attr = attr
+        self.owner_ref = weakref.ref(owner) if owner is not None else None
+        self.owner_cls = type(owner).__name__ if owner is not None else ""
+        self.state = "virgin"  # virgin|exclusive|shared_read|shared_mod
+        self.owner_thread: Optional[int] = None
+        self.transferred = False
+        self.threads_seen: Set[int] = set()
+        self.lockset: Optional[frozenset] = None
+        self.reported: Set[str] = set()  # finding kinds already emitted
+
+    def describe(self) -> str:
+        owner = self.owner_cls or "?"
+        return f"{owner}.{self.attr or '?'} (guarded_by {self.guard})"
+
+
+# Resolved lazily so importing guards never drags the sanitizer in on
+# the production path; the sanitizer installs itself here on enable().
+_runtime = None
+
+
+def _sanitizer():
+    global _runtime
+    if _runtime is None:
+        from ray_tpu.tools.sanitizer import runtime as _rt
+
+        _runtime = _rt
+    return _runtime
+
+
+def concsan_enabled() -> bool:
+    """Is the runtime witness on for THIS process? (env or explicit)."""
+    import os
+
+    if _runtime is not None:
+        return _runtime.enabled()
+    # Cheap pre-import check: don't import the sanitizer package just to
+    # learn it is off.
+    if os.environ.get("RAY_TPU_CONCSAN", "") != "1":
+        return False
+    return _sanitizer().enabled()
+
+
+def guarded_by(guard: str):
+    """Declare that a method is only called with ``self.<guard>`` held
+    (or, for :data:`OWNER_THREAD`, only from the owning thread).
+
+    Static: RTL009 treats the method body as holding the named lock.
+    Dynamic: with ConcSan enabled at import, the method is wrapped to
+    verify the contract on entry; otherwise the declaration is free
+    (attribute stamp only — no wrapper on the call path).
+    """
+
+    def deco(fn):
+        fn.__guarded_by__ = guard
+        if not concsan_enabled():
+            return fn
+
+        @functools.wraps(fn)
+        def checked(self, *args, **kw):
+            _sanitizer().note_method_entry(self, guard, fn.__qualname__)
+            return fn(self, *args, **kw)
+
+        checked.__guarded_by__ = guard
+        return checked
+
+    return deco
+
+
+def _plain_copy(container) -> Any:
+    if isinstance(container, dict):
+        return dict(container)
+    if isinstance(container, (set, frozenset)):
+        return set(container)
+    return list(container)
+
+
+def snapshot(container) -> Any:
+    """Sanctioned unguarded read: one atomic shallow copy (GIL) of a
+    guarded container, for iteration/inspection outside the lock.
+    Dict -> dict, set -> set, anything else -> list."""
+    if concsan_enabled():
+        with _sanitizer().sanctioned():
+            return _plain_copy(container)
+    return _plain_copy(container)
+
+
+def cycle_snapshot(container) -> List:
+    """Sanctioned unguarded read for chunked cycle iteration (the
+    controller's ``_CENSUS_CHUNK`` census pattern): an atomic key/member
+    list the caller may walk across many ticks while the live structure
+    keeps mutating."""
+    if concsan_enabled():
+        with _sanitizer().sanctioned():
+            return list(container)
+    return list(container)
+
+
+class GuardedDict(dict):
+    """A dict annotated with the lock (or owner thread) that guards it.
+
+    Construction chooses the class: the plain variant (this class — no
+    overridden accessors, zero overhead) normally, the checked variant
+    when the ConcSan witness is enabled in this process.
+    """
+
+    __slots__ = ("__guard_meta__",)
+
+    def __new__(cls, guard: str = OWNER_THREAD, *args, **kw):
+        if cls is GuardedDict and concsan_enabled():
+            cls = _CheckedGuardedDict
+        return super().__new__(cls)
+
+    def __init__(self, guard: str = OWNER_THREAD, *args,
+                 owner: Any = None, name: str = "", **kw):
+        super().__init__(*args, **kw)
+        self.__guard_meta__ = GuardMeta(guard, name, owner)
+
+    def __reduce__(self):
+        # Wire/journal form is a plain dict: the annotation belongs to
+        # the owning process's instance, and the RPC peer's pickle must
+        # not need this class (or its guard) to exist.
+        return (dict, (dict(self),))
+
+
+class GuardedSet(set):
+    """Set sibling of :class:`GuardedDict`."""
+
+    __slots__ = ("__guard_meta__",)
+
+    def __new__(cls, guard: str = OWNER_THREAD, *args, **kw):
+        if cls is GuardedSet and concsan_enabled():
+            cls = _CheckedGuardedSet
+        return super().__new__(cls)
+
+    def __init__(self, guard: str = OWNER_THREAD, *args,
+                 owner: Any = None, name: str = "", **kw):
+        super().__init__(*args, **kw)
+        self.__guard_meta__ = GuardMeta(guard, name, owner)
+
+    def __reduce__(self):
+        return (set, (set(self),))
+
+
+# ---------------------------------------------------------------------------
+# Checked variants — selected only when the sanitizer is enabled.
+
+def _note(container, op: str):
+    _sanitizer().note_access(container.__guard_meta__, op)
+
+
+def _rd(name):
+    base = getattr(dict, name)
+
+    def method(self, *a, **kw):
+        _note(self, "read")
+        return base(self, *a, **kw)
+
+    method.__name__ = name
+    return method
+
+
+def _wr(name, base_cls=dict):
+    base = getattr(base_cls, name)
+
+    def method(self, *a, **kw):
+        _note(self, "write")
+        return base(self, *a, **kw)
+
+    method.__name__ = name
+    return method
+
+
+class _CheckedGuardedDict(GuardedDict):
+    __slots__ = ()
+
+    for _m in ("__getitem__", "__contains__", "__iter__", "__len__",
+               "get", "keys", "values", "items", "copy", "__eq__"):
+        locals()[_m] = _rd(_m)
+    for _m in ("__setitem__", "__delitem__", "pop", "popitem", "clear",
+               "update", "setdefault"):
+        locals()[_m] = _wr(_m)
+    del _m
+    __hash__ = None  # dicts are unhashable; keep that true here
+
+
+def _srd(name):
+    base = getattr(set, name)
+
+    def method(self, *a, **kw):
+        _note(self, "read")
+        return base(self, *a, **kw)
+
+    method.__name__ = name
+    return method
+
+
+class _CheckedGuardedSet(GuardedSet):
+    __slots__ = ()
+
+    for _m in ("__contains__", "__iter__", "__len__", "__eq__",
+               "isdisjoint", "issubset", "issuperset", "copy"):
+        locals()[_m] = _srd(_m)
+    for _m in ("add", "discard", "remove", "pop", "clear", "update",
+               "difference_update", "intersection_update",
+               "symmetric_difference_update"):
+        locals()[_m] = _wr(_m, set)
+    del _m
+    __hash__ = None  # sets are unhashable; keep that true here

@@ -58,6 +58,15 @@ _cycles: List[dict] = []
 _long_holds: List[dict] = []
 _cycle_pairs_reported: Set[Tuple[int, int]] = set()
 _watched_locks = 0
+# lock uid -> (absolute creation file, line): lets the ConcSan lock-order
+# cross-check map a runtime lock back to the `self._lock = Lock()` site
+# the static graph (RTL005) names.
+_creation_sites: Dict[int, Tuple[str, int]] = {}
+# Optional preemption hook installed by the interleaving fuzzer
+# (tools/sanitizer/fuzzer.py): called as hook(point_kind, lock_name) at
+# lock-boundary yield points. Plain module global read — when None (the
+# default) the hot path pays one load + is-None test.
+_yield_hook = None
 # thread ident -> that thread's held-stack LIST OBJECT (the same list
 # _tls.held aliases): lets the profiling stack dumper annotate OTHER
 # threads' held locks. Entries for dead threads are pruned on snapshot.
@@ -67,6 +76,7 @@ _held_registry: Dict[int, list] = {}
 # stay importable before the package)
 _metric_cycles = None
 _metric_long_holds = None
+_metric_empty_locksets = None
 
 
 def _hold_threshold_ms() -> float:
@@ -91,12 +101,64 @@ def _caller_site(depth: int) -> str:
         return "?"
 
 
+def _caller_frame(depth: int):
+    try:
+        f = sys._getframe(depth)
+        while f is not None and f.f_code.co_filename.endswith("lockwatch.py"):
+            f = f.f_back
+        return f
+    except Exception:  # noqa: BLE001 — frame depth off at thread exit
+        return None
+
+
+def _caller_full_site(depth: int) -> Tuple[str, int]:
+    """Creation-site tag with the FULL path (the short :func:`_caller_site`
+    form is ambiguous across same-named files; the ConcSan lock-order
+    cross-check needs to join on (path, line))."""
+    f = _caller_frame(depth + 1)
+    if f is None:
+        return ("?", 0)
+    return (f.f_code.co_filename, f.f_lineno)
+
+
 def _held_stack() -> list:
     st = getattr(_tls, "held", None)
     if st is None:
         st = _tls.held = []
         _held_registry[threading.get_ident()] = st
     return st
+
+
+def current_held() -> List[tuple]:
+    """The CURRENT thread's held watched locks, innermost last, as
+    ``(WatchedLock, acquired_monotonic, acquire_site)`` tuples. Lock-free
+    (the list is only mutated by this thread); the ConcSan runtime calls
+    this on every guarded-state access, so it must stay allocation-light.
+    """
+    return list(getattr(_tls, "held", None) or ())
+
+
+def set_yield_hook(hook) -> None:
+    """Install (or clear, with ``None``) the fuzzer's preemption hook.
+
+    The hook runs at every lock-boundary yield point —
+    ``("acquire", name)`` before blocking on a watched lock and
+    ``("release", name)`` after letting it go — and may sleep to widen
+    race windows. Installed only by the interleaving fuzzer; anything
+    else should leave this alone.
+    """
+    global _yield_hook
+    _yield_hook = hook
+
+
+def _maybe_yield(point: str, wuid: int) -> None:
+    hook = _yield_hook
+    if hook is None or _in_watchdog():
+        return
+    try:
+        hook(point, _names.get(wuid, "?"))
+    except Exception as e:  # noqa: BLE001 — fuzzer must never take the process down
+        logger.debug("lockwatch yield hook failed: %s", e)
 
 
 def held_snapshot() -> Dict[int, List[dict]]:
@@ -135,11 +197,11 @@ def _in_watchdog() -> bool:
     return getattr(_tls, "in_watchdog", False)
 
 
-def _report_metrics(cycles: int = 0, long_holds: int = 0):
+def _report_metrics(cycles: int = 0, long_holds: int = 0, empty_locksets: int = 0):
     """Bump the lockwatch counters through util.metrics. Guarded by the
     reentrancy flag: Counter.inc acquires the (instrumented) metrics lock,
     which must not recurse into bookkeeping."""
-    global _metric_cycles, _metric_long_holds
+    global _metric_cycles, _metric_long_holds, _metric_empty_locksets
     _tls.in_watchdog = True
     try:
         if _metric_cycles is None:
@@ -153,14 +215,28 @@ def _report_metrics(cycles: int = 0, long_holds: int = 0):
                 "lockwatch_long_holds_total",
                 "Lock holds exceeding RAY_TPU_LOCKWATCH_HOLD_MS",
             )
+            _metric_empty_locksets = Counter(
+                "lockwatch_empty_lockset_total",
+                "Guarded-state accesses whose Eraser lockset went empty "
+                "(ConcSan race candidates)",
+            )
         if cycles:
             _metric_cycles.inc(cycles)
         if long_holds:
             _metric_long_holds.inc(long_holds)
+        if empty_locksets:
+            _metric_empty_locksets.inc(empty_locksets)
     except Exception as e:  # noqa: BLE001 — watchdog must never take the process down
         logger.debug("lockwatch metric report failed: %s", e)
     finally:
         _tls.in_watchdog = False
+
+
+def note_empty_lockset(n: int = 1) -> None:
+    """ConcSan entry point: a guarded access's lockset intersection went
+    empty. Exported here (not in the sanitizer) so the finding rides the
+    lockwatch metric plumbing into the Grafana Self-healing row."""
+    _report_metrics(empty_locksets=n)
 
 
 def _maybe_incident(trigger: str, info: dict):
@@ -200,15 +276,19 @@ class WatchedLock:
     ``threading.Condition``) is delegated to the raw lock.
     """
 
-    def __init__(self, raw, name: str):
+    def __init__(self, raw, name: str, csite: Optional[Tuple[str, int]] = None):
         self._raw = raw
         self._wuid = next(_uid)
         _names[self._wuid] = name
+        if csite is not None:
+            _creation_sites[self._wuid] = csite
 
     # -- protocol -----------------------------------------------------------
     def acquire(self, blocking: bool = True, timeout: float = -1):
         if _in_watchdog():
             return self._raw.acquire(blocking, timeout)
+        if _yield_hook is not None:
+            _maybe_yield("acquire", self._wuid)
         held = _held_stack()
         # Record intent BEFORE blocking: the edge must exist while we wait,
         # or two threads deadlocking right now would each report nothing.
@@ -228,6 +308,8 @@ class WatchedLock:
                     popped = held.pop(i)
                     break
         self._raw.release()
+        if _yield_hook is not None:
+            _maybe_yield("release", self._wuid)
         # Long-hold reporting AFTER the raw release — logging/metrics must
         # not extend the very hold they are complaining about.
         if popped is not None:
@@ -273,6 +355,10 @@ class WatchedLock:
                             "reverse_first_seen": _edge_sites.get(
                                 (b, a), "(via longer path)"
                             ),
+                            # the full held SET, not just the edge pair —
+                            # with three or more locks in play, the pair
+                            # alone hides which discipline was violated
+                            "held": [_names[o._wuid] for o, _, _ in held],
                             "thread": threading.current_thread().name,
                             "time": time.time(),
                         }
@@ -303,6 +389,9 @@ class WatchedLock:
             "held_ms": round(dt_ms, 1),
             "acquired_at": site,
             "released_at": _caller_site(3),
+            # locks STILL held after this release — a non-empty set here
+            # means the long hold happened inside a nested critical section
+            "held": [_names.get(o._wuid, "?") for o, _, _ in _held_stack()],
             "thread": threading.current_thread().name,
             "time": time.time(),
         }
@@ -327,7 +416,9 @@ def wrap(raw=None, name: Optional[str] = None) -> WatchedLock:
     global _watched_locks
     if raw is None:
         raw = _REAL_LOCK()
-    lock = WatchedLock(raw, name or f"lock@{_caller_site(2)}")
+    lock = WatchedLock(
+        raw, name or f"lock@{_caller_site(2)}", csite=_caller_full_site(2)
+    )
     with _meta_lock:
         _watched_locks += 1
     return lock
@@ -393,6 +484,30 @@ def state() -> dict:
             "cycles": list(_cycles),
             "long_holds": list(_long_holds),
         }
+
+
+def graph_snapshot() -> List[dict]:
+    """The observed lock-order graph as a list of edges, each carrying
+    both locks' CREATION sites (full path + line). This is the dynamic
+    half of the ConcSan lock-order cross-check: the sanitizer joins
+    these creation sites against the static graph RTL005 builds from
+    ``self._x = threading.Lock()`` assignment sites."""
+
+    def _site(uid: int):
+        path, line = _creation_sites.get(uid, ("?", 0))
+        return {"file": path, "line": line}
+
+    with _meta_lock:
+        return [
+            {
+                "src": _names.get(a, "?"),
+                "dst": _names.get(b, "?"),
+                "src_site": _site(a),
+                "dst_site": _site(b),
+                "observed_at": site,
+            }
+            for (a, b), site in _edge_sites.items()
+        ]
 
 
 def reset():

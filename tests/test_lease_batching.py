@@ -454,9 +454,19 @@ def test_batched_path_correct_and_observable():
         ]
         core = ray_tpu.core.api._require_worker()
         assert core._normal_sub is not None and core._normal_sub.batching
-        snap = core._call("summarize_lifecycle")
-        cp = snap["control_plane"]
-        hist = cp["task_push_batch_size"]
+        # The controller ingests task events asynchronously (batched, with
+        # yields every 2k) — get() returning does not mean the recorder has
+        # caught up, so poll until the histogram reflects all 300 pushes.
+        deadline = time.monotonic() + 20
+        while True:
+            snap = core._call("summarize_lifecycle")
+            cp = snap["control_plane"]
+            hist = cp["task_push_batch_size"]
+            if hist and hist["count"] >= 1 and hist["sum"] >= 300:
+                break
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.1)
         assert hist and hist["count"] >= 1 and hist["sum"] >= 300
         # batching actually batched: mean tasks per frame > 1
         assert hist["avg"] > 1.0, hist

@@ -62,5 +62,5 @@ def test_every_rule_is_registered():
 
     assert {
         "RTL001", "RTL002", "RTL003", "RTL004", "RTL005", "RTL006",
-        "RTL007", "RTL008",
+        "RTL007", "RTL008", "RTL009", "RTL010", "RTL011",
     } <= set(all_rules())

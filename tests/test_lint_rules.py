@@ -1004,3 +1004,262 @@ def test_lockwatch_enabled_in_tier1(lockwatch):
     tier-1 runs with ray_tpu lock creation instrumented."""
     assert os.environ.get("RAY_TPU_LOCKWATCH") == "1"
     assert lockwatch.state()["installed"]
+
+
+# ---------------------------------------------------------------------------
+# RTL009 unguarded access to guard-annotated state
+
+
+_GUARDED_CLASS = """
+    import threading
+    from ray_tpu.util.guards import GuardedDict, guarded_by, snapshot, cycle_snapshot
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._stats_lock = threading.Lock()
+            self._entries = GuardedDict("_lock", owner=self, name="entries")
+"""
+
+
+def test_rtl009_positive_unguarded_write(tmp_path):
+    res = lint_src(
+        tmp_path,
+        _GUARDED_CLASS
+        + """
+        def bad(self):
+            self._entries["k"] = 1
+        """,
+        rules=["RTL009"],
+    )
+    assert rules_of(res) == ["RTL009"]
+    assert "write" in res.findings[0].message
+
+
+def test_rtl009_negative_locked_and_guarded(tmp_path):
+    res = lint_src(
+        tmp_path,
+        _GUARDED_CLASS
+        + """
+        def ok_locked(self):
+            with self._lock:
+                self._entries["k"] = 1
+
+        @guarded_by("_lock")
+        def ok_helper(self):
+            return self._entries.get("k")
+        """,
+        rules=["RTL009"],
+    )
+    assert rules_of(res) == []
+
+
+def test_rtl009_negative_snapshot_helpers(tmp_path):
+    res = lint_src(
+        tmp_path,
+        _GUARDED_CLASS
+        + """
+        def ok_snapshot(self):
+            return snapshot(self._entries)
+
+        def ok_cycle(self):
+            return cycle_snapshot(self._entries)[:10]
+
+        def ok_len(self):
+            return len(self._entries)
+        """,
+        rules=["RTL009"],
+    )
+    assert rules_of(res) == []
+
+
+def test_rtl009_owner_thread_state_is_skipped(tmp_path):
+    """OWNER_THREAD guards are a thread-affinity discipline — lexical
+    lock checking does not apply (the runtime witness owns that check)."""
+    res = lint_src(
+        tmp_path,
+        """
+        from ray_tpu.util.guards import OWNER_THREAD, GuardedDict
+
+        class Bus:
+            def __init__(self):
+                self._subs = GuardedDict(OWNER_THREAD, owner=self, name="subs")
+
+            def touch(self):
+                self._subs["c"] = set()
+        """,
+        rules=["RTL009"],
+    )
+    assert rules_of(res) == []
+
+
+def test_rtl009_nested_def_does_not_inherit_lock(tmp_path):
+    """A callback defined under `with lock:` runs LATER on another stack —
+    the lexically enclosing lock must not sanction its accesses."""
+    res = lint_src(
+        tmp_path,
+        _GUARDED_CLASS
+        + """
+        def bad(self):
+            with self._lock:
+                def cb():
+                    return self._entries.get("k")
+                return cb
+        """,
+        rules=["RTL009"],
+    )
+    assert rules_of(res) == ["RTL009"]
+
+
+# ---------------------------------------------------------------------------
+# RTL010 guard consistency
+
+
+def test_rtl010_positive_wrong_lock(tmp_path):
+    res = lint_src(
+        tmp_path,
+        _GUARDED_CLASS
+        + """
+        def bad(self):
+            with self._stats_lock:
+                self._entries["k"] = 1
+        """,
+        rules=["RTL010"],
+    )
+    assert rules_of(res) == ["RTL010"]
+    assert "_stats_lock" in res.findings[0].message
+
+
+def test_rtl010_positive_rebind_loses_annotation(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        from ray_tpu.util.guards import OWNER_THREAD, GuardedDict
+
+        class Mirror:
+            def __init__(self):
+                self.nodes = GuardedDict(OWNER_THREAD, owner=self, name="nodes")
+
+            def reconcile(self, fresh):
+                self.nodes = fresh
+        """,
+        rules=["RTL010"],
+    )
+    assert rules_of(res) == ["RTL010"]
+    assert "rebind" in res.findings[0].message.lower()
+
+
+def test_rtl010_negative_rebind_with_guarded_value(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        from ray_tpu.util.guards import OWNER_THREAD, GuardedDict
+
+        class Mirror:
+            def __init__(self):
+                self.kv = GuardedDict(OWNER_THREAD, owner=self, name="kv")
+                self.kv = GuardedDict(OWNER_THREAD, {"restored": 1},
+                                      owner=self, name="kv")
+        """,
+        rules=["RTL010"],
+    )
+    assert rules_of(res) == []
+
+
+def test_rtl010_positive_guarded_by_unknown_attr(tmp_path):
+    res = lint_src(
+        tmp_path,
+        """
+        import threading
+        from ray_tpu.util.guards import guarded_by
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            @guarded_by("_lokc")
+            def helper(self):
+                pass
+        """,
+        rules=["RTL010"],
+    )
+    assert rules_of(res) == ["RTL010"]
+
+
+# ---------------------------------------------------------------------------
+# RTL011 cross-thread callbacks touching guarded state
+
+
+def test_rtl011_positive_callback_touches_guarded(tmp_path):
+    res = lint_src(
+        tmp_path,
+        _GUARDED_CLASS
+        + """
+        def bad(self, bus):
+            bus.subscribe("chan", lambda msg: self._entries.pop(msg, None))
+        """,
+        rules=["RTL011"],
+    )
+    assert rules_of(res) == ["RTL011"]
+
+
+def test_rtl011_positive_thread_target(tmp_path):
+    res = lint_src(
+        tmp_path,
+        _GUARDED_CLASS
+        + """
+        def bad(self):
+            import threading as t
+
+            def worker():
+                self._entries.clear()
+
+            t.Thread(target=worker).start()
+        """,
+        rules=["RTL011"],
+    )
+    assert rules_of(res) == ["RTL011"]
+
+
+def test_rtl011_negative_callback_takes_guard(tmp_path):
+    res = lint_src(
+        tmp_path,
+        _GUARDED_CLASS
+        + """
+        def ok(self, bus):
+            def handler(msg):
+                with self._lock:
+                    self._entries[msg] = 1
+
+            bus.subscribe("chan", handler)
+        """,
+        rules=["RTL011"],
+    )
+    assert rules_of(res) == []
+
+
+def test_rtl011_negative_plain_callback(tmp_path):
+    res = lint_src(
+        tmp_path,
+        _GUARDED_CLASS
+        + """
+        def ok(self, bus):
+            bus.subscribe("chan", lambda msg: print(msg))
+        """,
+        rules=["RTL011"],
+    )
+    assert rules_of(res) == []
+
+
+def test_guard_rules_suppressible(tmp_path):
+    res = lint_src(
+        tmp_path,
+        _GUARDED_CLASS
+        + """
+        def tolerated(self):
+            return self._entries.get("k")  # ray-tpu: lint-ignore[RTL009]
+        """,
+        rules=["RTL009"],
+    )
+    assert rules_of(res) == []
+    assert res.suppressed == 1

@@ -1,0 +1,442 @@
+"""ConcSan unit tests: the runtime lockset witness, the owner-thread
+discipline, sanctioned snapshots, the seeded interleaving fuzzer, and
+the static↔dynamic lock-order cross-check.
+
+The witness is process-global; every test runs inside the ``concsan``
+fixture, which enables it, resets findings, and disables on the way out
+so the rest of the tier-1 suite keeps its zero-overhead containers.
+"""
+import json
+import textwrap
+import threading
+
+import pytest
+
+from ray_tpu.util import lockwatch
+from ray_tpu.util.guards import (
+    OWNER_THREAD,
+    GuardedDict,
+    GuardedSet,
+    guarded_by,
+    snapshot,
+)
+from ray_tpu.tools.sanitizer import fuzzer, lockorder, runtime
+
+
+@pytest.fixture
+def concsan():
+    runtime.enable()
+    runtime.reset()
+    yield runtime
+    fuzzer.uninstall()
+    runtime.reset()
+    runtime.disable()
+
+
+def _run_threads(*fns):
+    threads = [
+        threading.Thread(target=fn, name=f"t{i}") for i, fn in enumerate(fns)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def _kinds():
+    return [f["kind"] for f in runtime.report()["findings"]]
+
+
+class _Owner:
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Eraser lockset witness
+
+
+def test_checked_variant_selected_when_enabled(concsan):
+    d = GuardedDict("_lock", owner=_Owner(), name="d")
+    assert type(d).__name__ == "_CheckedGuardedDict"
+    s = GuardedSet(OWNER_THREAD, owner=_Owner(), name="s")
+    assert type(s).__name__ == "_CheckedGuardedSet"
+
+
+def test_plain_variant_when_disabled():
+    assert not runtime.enabled()
+    d = GuardedDict("_lock", owner=_Owner(), name="d")
+    assert type(d) is GuardedDict  # no checked accessors, C-speed ops
+    # and it still round-trips through pickle as a plain dict
+    import pickle
+
+    assert pickle.loads(pickle.dumps(d)) == {}
+
+
+def test_clean_locked_sharing_is_silent(concsan):
+    owner = _Owner()
+    lock = lockwatch.wrap(threading.Lock(), name="clean_lock")
+    d = GuardedDict("_lock", owner=owner, name="d")
+
+    def work():
+        for i in range(50):
+            with lock:
+                d[i] = d.get(i, 0) + 1
+
+    _run_threads(work, work)
+    assert _kinds() == []
+
+
+def test_unsynchronized_write_sharing_flags_empty_lockset(concsan):
+    d = GuardedDict("_lock", owner=_Owner(), name="racy")
+
+    def work():
+        for i in range(50):
+            d[i] = i  # no lock held anywhere: C(v) = ∅ once shared
+
+    _run_threads(work, work)
+    assert "empty_lockset" in _kinds()
+    f = next(
+        f for f in runtime.report()["findings"] if f["kind"] == "empty_lockset"
+    )
+    assert "racy" in f["state"] and f["held"] == []
+
+
+def test_wrong_lock_sharing_flags_empty_lockset(concsan):
+    """Two threads each hold *a* lock — but never the same one, so the
+    candidate lockset intersects to ∅ (the classic wrong-lock race)."""
+    d = GuardedDict("_lock", owner=_Owner(), name="wrong")
+    l1 = lockwatch.wrap(threading.Lock(), name="l1")
+    l2 = lockwatch.wrap(threading.Lock(), name="l2")
+
+    def write(lock, key):
+        def run():
+            with lock:
+                d[key] = key
+
+        return run
+
+    _run_threads(write(l1, 0))  # virgin -> exclusive
+    _run_threads(write(l2, 1))  # shared_mod, C(v) = {l2}
+    assert _kinds() == []  # consistent so far — each holds *a* lock
+    _run_threads(write(l1, 2))  # C(v) = {l2} ∩ {l1} = ∅
+    assert _kinds() == ["empty_lockset"]
+
+
+def test_single_thread_use_never_flags(concsan):
+    d = GuardedDict("_lock", owner=_Owner(), name="local")
+    for i in range(100):
+        d[i] = i  # exclusive state: no lockset refinement single-threaded
+    assert _kinds() == []
+
+
+# ---------------------------------------------------------------------------
+# OWNER_THREAD discipline
+
+
+def test_owner_thread_allows_one_transfer_then_flags(concsan):
+    d = GuardedDict(OWNER_THREAD, owner=_Owner(), name="loop_state")
+    d["ctor"] = 1  # constructor thread binds ownership
+
+    def loop():
+        for i in range(10):
+            d[i] = i  # the one blessed handoff: ctor -> loop thread
+
+    t = threading.Thread(target=loop, name="loop")
+    t.start()
+    t.join()
+    assert _kinds() == []
+
+    def intruder():
+        d["x"] = 1  # third thread: the transfer budget is spent
+
+    t = threading.Thread(target=intruder, name="pool-1")
+    t.start()
+    t.join()
+    assert _kinds() == ["owner_thread"]
+    f = runtime.report()["findings"][0]
+    assert f["thread"] == "pool-1" and f["owner"] == "loop"
+
+
+def test_owner_thread_snapshot_is_sanctioned(concsan):
+    d = GuardedDict(OWNER_THREAD, owner=_Owner(), name="mirror")
+    d["a"] = 1
+
+    def loop():
+        d["b"] = 2
+
+    t = threading.Thread(target=loop, name="loop")
+    t.start()
+    t.join()
+
+    out = {}
+
+    def foreign_reader():
+        out["copy"] = snapshot(d)  # the blessed cross-thread read
+
+    t = threading.Thread(target=foreign_reader, name="telemetry")
+    t.start()
+    t.join()
+    assert out["copy"] == {"a": 1, "b": 2} and isinstance(out["copy"], dict)
+    assert _kinds() == []
+
+
+def test_regression_log_tailer_drivers_peek(concsan):
+    """Regression for the race ConcSan surfaced in the controller's log
+    plane: ``_broadcast_logs`` (log-tailer thread) peeked at the
+    loop-owned ``drivers`` set bare, spending the one ownership transfer
+    and flagging the loop's own next access. The fix reads through
+    ``snapshot()``. Replayed under the seeded schedule that surfaced it."""
+
+    def scenario(peek):
+        drivers = GuardedSet(OWNER_THREAD, owner=_Owner(), name="drivers")
+
+        def loop():
+            for i in range(5):
+                drivers.add(i)
+
+        def tailer():
+            for _ in range(5):
+                peek(drivers)
+
+        t1 = threading.Thread(target=loop, name="loop")
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=tailer, name="log-tailer")
+        t3 = threading.Thread(target=loop, name="loop-2")
+        t2.start()
+        t2.join()
+        t3.start()
+        t3.join()
+
+    with fuzzer.fuzzing(seed=0):
+        scenario(lambda s: bool(s))  # the pre-fix bare peek
+    assert "owner_thread" in _kinds()
+    assert runtime.report()["findings"][0]["fuzz_seed"] == 0
+
+    runtime.reset()
+    with fuzzer.fuzzing(seed=0):
+        scenario(lambda s: bool(snapshot(s)))  # the fix
+    assert _kinds() == []
+
+
+# ---------------------------------------------------------------------------
+# @guarded_by runtime contract
+
+
+def test_guarded_by_method_entry_checked(concsan):
+    class Store:
+        def __init__(self):
+            self._lock = lockwatch.wrap(threading.Lock(), name="store_lock")
+
+        @guarded_by("_lock")
+        def helper(self):
+            return 1
+
+    s = Store()
+    with s._lock:
+        s.helper()
+    assert _kinds() == []
+    s.helper()  # contract break: callers must hold _lock
+    assert _kinds() == ["guard_method"]
+
+
+# ---------------------------------------------------------------------------
+# Fuzzer: determinism, sweep, replay
+
+
+def test_fuzz_schedule_is_deterministic():
+    a = fuzzer.FuzzSchedule(seed=7)
+    b = fuzzer.FuzzSchedule(seed=7)
+    seq_a = [a.decide("worker", "access", i) for i in range(200)]
+    seq_b = [b.decide("worker", "access", i) for i in range(200)]
+    assert seq_a == seq_b
+    assert any(seq_a), "schedule never injects — period too sparse"
+    c = fuzzer.FuzzSchedule(seed=8)
+    assert seq_a != [c.decide("worker", "access", i) for i in range(200)]
+
+
+def test_fuzzing_context_installs_and_uninstalls(concsan):
+    assert fuzzer.active() is None
+    with fuzzer.fuzzing(seed=3) as sched:
+        assert fuzzer.active() is sched
+        assert runtime.report()["fuzz_seed"] == 3
+    assert fuzzer.active() is None
+    assert runtime.report()["fuzz_seed"] is None
+
+
+def test_sweep_finds_seed_and_replay_reproduces(concsan):
+    seeds = range(3)
+
+    def racy_workload():
+        d = GuardedDict("_lock", owner=_Owner(), name="swept")
+
+        def work():
+            for i in range(30):
+                d[i] = i
+
+        _run_threads(work, work)
+
+    seed = fuzzer.sweep(racy_workload, seeds, max_sleep_us=50)
+    assert seed is not None
+    runtime.reset()
+    with fuzzer.fuzzing(seed, max_sleep_us=50):
+        racy_workload()
+    findings = runtime.report()["findings"]
+    assert findings and findings[0]["fuzz_seed"] == seed
+
+
+# ---------------------------------------------------------------------------
+# Static ↔ dynamic lock-order cross-check
+
+
+_LOCKORDER_SRC = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+            self._c_lock = threading.Lock()
+
+        def nested(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+"""
+
+
+def _write_project(tmp_path):
+    (tmp_path / "mod.py").write_text(textwrap.dedent(_LOCKORDER_SRC))
+    return str(tmp_path)
+
+
+def _site_of(graph, root, canon):
+    for (rel, line), name in graph.creation_sites.items():
+        if name == canon:
+            import os
+
+            return {"file": os.path.join(root, rel), "line": line}
+    raise AssertionError(f"no creation site for {canon}")
+
+
+def test_build_static_edges_and_sites(tmp_path):
+    root = _write_project(tmp_path)
+    g = lockorder.build_static(root, paths=["."])
+    assert ("mod.C._a_lock", "mod.C._b_lock") in g.edges
+    assert {"mod.C._a_lock", "mod.C._b_lock", "mod.C._c_lock"} <= set(
+        g.creation_sites.values()
+    )
+
+
+def test_cross_check_classification(tmp_path):
+    root = _write_project(tmp_path)
+    g = lockorder.build_static(root, paths=["."])
+    a = _site_of(g, root, "mod.C._a_lock")
+    b = _site_of(g, root, "mod.C._b_lock")
+    c = _site_of(g, root, "mod.C._c_lock")
+
+    def edge(src, dst):
+        return {"src_site": src, "dst_site": dst, "observed_at": "mod.py:1"}
+
+    dynamic = [
+        edge(a, b),  # lexically explained
+        edge(b, c),  # order the AST never saw
+        edge({"file": "/elsewhere/x.py", "line": 1}, a),  # test-created lock
+    ]
+    out = lockorder.cross_check(root, dynamic, static=g, paths=["."])
+    assert [e["src"] for e in out["matched"]] == ["mod.C._a_lock"]
+    assert [(e["src"], e["dst"]) for e in out["dynamic_only"]] == [
+        ("mod.C._b_lock", "mod.C._c_lock")
+    ]
+    assert out["external_edges"] == 1
+
+    # an allowlist entry with a justification reclassifies the edge
+    (tmp_path / lockorder.ALLOWLIST_FILE).write_text(
+        json.dumps(
+            {
+                "edges": [
+                    {
+                        "src": "mod.C._b_lock",
+                        "dst": "mod.C._c_lock",
+                        "justification": "b->c reached via data-driven dispatch",
+                    }
+                ]
+            }
+        )
+    )
+    out = lockorder.cross_check(root, dynamic, static=g, paths=["."])
+    assert out["dynamic_only"] == []
+    assert out["allowlisted"][0]["justification"].startswith("b->c")
+
+
+def test_guarded_by_counts_as_holding_its_guard(tmp_path):
+    (tmp_path / "mod.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+            from ray_tpu.util.guards import guarded_by
+
+            class C:
+                def __init__(self):
+                    self._outer_lock = threading.Lock()
+                    self._inner_lock = threading.Lock()
+
+                @guarded_by("_outer_lock")
+                def helper(self):
+                    with self._inner_lock:
+                        pass
+            """
+        )
+    )
+    g = lockorder.build_static(str(tmp_path), paths=["."])
+    assert ("mod.C._outer_lock", "mod.C._inner_lock") in g.derived
+
+
+def test_one_hop_call_through_derives_edge(tmp_path):
+    (tmp_path / "mod.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._outer_lock = threading.Lock()
+                    self._inner_lock = threading.Lock()
+
+                def outer(self):
+                    with self._outer_lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._inner_lock:
+                        pass
+            """
+        )
+    )
+    g = lockorder.build_static(str(tmp_path), paths=["."])
+    assert ("mod.C._outer_lock", "mod.C._inner_lock") in g.derived
+    assert ("mod.C._outer_lock", "mod.C._inner_lock") not in g.edges
+
+
+# ---------------------------------------------------------------------------
+# Process reports
+
+
+def test_report_dump_and_load(tmp_path, concsan):
+    d = GuardedDict("_lock", owner=_Owner(), name="dumped")
+
+    def work():
+        for i in range(30):
+            d[i] = i
+
+    _run_threads(work, work)
+    assert _kinds()  # the planted race above produced at least one
+    runtime._dump_report(str(tmp_path))
+    reports = runtime.load_reports(str(tmp_path))
+    assert len(reports) == 1
+    r = reports[0]
+    assert r["enabled"] and r["findings"]
+    assert isinstance(r["lock_graph"], list)
+    # unreadable files are skipped, not fatal
+    (tmp_path / "concsan-9999.json").write_text("{not json")
+    assert len(runtime.load_reports(str(tmp_path))) == 1
